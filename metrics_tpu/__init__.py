@@ -1,0 +1,28 @@
+"""metrics_tpu: TPU-native machine-learning metrics (JAX/XLA/pallas).
+
+A from-scratch re-design of the TorchMetrics capability surface
+(`/root/reference`, v0.9.0dev) for TPU: metric state lives as pytrees of jnp
+arrays in HBM, update/compute are jit-traceable XLA computations, and
+distributed synchronization lowers to mesh collectives
+(psum/pmin/pmax/all_gather) over ICI/DCN.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+__version__ = "0.1.0"
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402, F401
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "SumMetric",
+]

@@ -1,0 +1,164 @@
+"""Aggregation metrics: running max/min/sum/cat/mean over raw values.
+
+Equivalent surface to the reference's ``torchmetrics/aggregation.py``
+(``BaseAggregator`` :24, ``MaxMetric`` :101, ``MinMetric`` :158, ``SumMetric``
+:215, ``CatMetric`` :271, ``MeanMetric`` :328).
+"""
+from typing import Any, Callable, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for aggregation metrics: one state, a NaN strategy, scalar-or-array input.
+
+    Args:
+        fn: reduction spec for the state ("sum"/"max"/"min"/"cat").
+        default_value: reset value for the state.
+        nan_strategy: "error" | "warn" | "ignore" | float (impute value).
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
+        """Cast input to float array and apply the NaN strategy
+        (reference ``aggregation.py:72``)."""
+        if not isinstance(x, (jnp.ndarray, jax.Array)):
+            x = jnp.asarray(x, dtype=jnp.float32)
+        x = x.astype(jnp.float32) if not jnp.issubdtype(x.dtype, jnp.floating) else x
+        nans = jnp.isnan(x)
+        if bool(nans.any()):
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy == "warn":
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                x = x[~nans]
+            elif self.nan_strategy == "ignore":
+                x = x[~nans]
+            else:
+                x = jnp.where(nans, jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
+        return x.astype(jnp.float32)
+
+    def update(self, value: Union[float, Array]) -> None:  # noqa: D102
+        pass
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum (reference ``aggregation.py:101``)."""
+
+    full_state_update = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", -jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.maximum(self.value, value.max())
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum (reference ``aggregation.py:158``)."""
+
+    full_state_update = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, value.min())
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:215``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = self.value + value.sum()
+
+
+class CatMetric(BaseAggregator):
+    """Concatenation of all seen values (reference ``aggregation.py:271``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:328``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        # Broadcast BEFORE the NaN strategy so value/weight stay aligned when
+        # rows are dropped (independent filtering would misalign them).
+        value = jnp.asarray(value, dtype=jnp.float32) if not isinstance(value, (jnp.ndarray, jax.Array)) else value
+        weight = jnp.asarray(weight, dtype=jnp.float32) if not isinstance(weight, (jnp.ndarray, jax.Array)) else weight
+        weight = jnp.broadcast_to(weight, value.shape)
+        nans = jnp.isnan(value) | jnp.isnan(weight.astype(jnp.float32))
+        if bool(nans.any()):
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy in ("warn", "ignore"):
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                value, weight = value[~nans], weight[~nans]
+            else:
+                fill = jnp.asarray(self.nan_strategy, dtype=jnp.float32)
+                value = jnp.where(jnp.isnan(value), fill, value)
+                weight = jnp.where(jnp.isnan(weight.astype(jnp.float32)), fill, weight)
+        value = value.astype(jnp.float32)
+        weight = weight.astype(jnp.float32)
+        if value.size == 0:
+            return
+        self.value = self.value + (value * weight).sum()
+        self.weight = self.weight + weight.sum()
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -1,0 +1,4 @@
+from metrics_tpu.utilities.checks import _check_same_shape  # noqa: F401
+from metrics_tpu.utilities.data import apply_to_collection  # noqa: F401
+from metrics_tpu.utilities.distributed import class_reduce, gather_all_tensors, reduce  # noqa: F401
+from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn  # noqa: F401

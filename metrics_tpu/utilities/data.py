@@ -1,0 +1,137 @@
+"""Tensor utilities: dim-0 reductions, one-hot/top-k encoders, collection maps.
+
+Equivalent surface to the reference's ``torchmetrics/utilities/data.py``
+(``dim_zero_*`` at data.py:22-48, ``to_onehot`` :68, ``select_topk`` :102,
+``to_categorical`` :128, ``apply_to_collection`` :146, ``get_group_indexes``
+:196, ``_bincount`` :231) — re-designed on jnp. All kernels here are pure and
+jittable; ``apply_to_collection`` / ``get_group_indexes`` are host-side
+structural helpers.
+"""
+from collections import namedtuple
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly list-valued) state along dim 0."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    x = [jnp.atleast_1d(y) for y in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert a dense label tensor ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Mirrors reference ``utilities/data.py:68`` but uses a static
+    ``num_classes`` under jit (falls back to a value peek when eager).
+    """
+    if num_classes is None:
+        num_classes = int(label_tensor.max()) + 1
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # Move the new class axis to dim 1: (N, ..., C) -> (N, C, ...)
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binarize a probability tensor by its top-k entries along ``dim``.
+
+    Mirrors reference ``utilities/data.py:102``; implemented with
+    ``jax.lax.top_k`` + scatter-free one-hot sum so it stays jittable.
+    """
+    if topk == 1:  # cheap fast-path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    onehots = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return jnp.moveaxis(jnp.minimum(onehots, 1), -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Convert probability tensor to dense labels via argmax."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
+
+    Mirrors reference ``utilities/data.py:146``.
+    """
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return type(data)({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data))
+    if isinstance(data, (list, tuple)):
+        return type(data)(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data)
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group positions by query id; returns one index array per group.
+
+    API-parity helper for the reference's ``utilities/data.py:196``. Note the
+    retrieval metrics in this package do NOT use this Python loop on the hot
+    path — they use sort + segment ops (`functional/retrieval`) — this exists
+    for user code parity and host-side tooling.
+    """
+    import numpy as np
+
+    idx = np.asarray(indexes)
+    order = np.argsort(idx, kind="stable")
+    sorted_idx = idx[order]
+    boundaries = np.flatnonzero(np.diff(sorted_idx)) + 1
+    return [jnp.asarray(g) for g in np.split(order, boundaries)]
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Deterministic bincount with a static length (jit-safe).
+
+    Replaces reference ``utilities/data.py:231``'s CUDA-deterministic fallback;
+    on TPU a segment-sum based bincount is always deterministic.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.reshape(()) if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return apply_to_collection(data, jax.Array, _squeeze_scalar_element_tensor)

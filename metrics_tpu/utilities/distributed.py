@@ -1,0 +1,143 @@
+"""Mesh-aware distributed synchronization for metric states.
+
+TPU-native replacement for the reference's ``torchmetrics/utilities/
+distributed.py`` (``gather_all_tensors`` :102, ``reduce`` :22, ``class_reduce``
+:44). Instead of ``torch.distributed.all_gather`` over NCCL/gloo process
+groups, synchronization lowers to XLA collectives over a ``jax.sharding.Mesh``:
+
+* **in-jit (SPMD)**: per-state reduction specs lower to ``lax.psum`` /
+  ``lax.pmin`` / ``lax.pmax`` / ``lax.all_gather`` over named mesh axes inside
+  ``shard_map`` / ``pmap`` — collectives ride ICI.
+* **eager multi-process (DCN)**: host-side states are exchanged with
+  ``jax.experimental.multihost_utils.process_allgather``, with the reference's
+  pad-to-max/trim trick (distributed.py:128-151) for uneven shapes.
+
+The reference's ``process_group`` argument maps to a tuple of mesh axis names.
+"""
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+# Reduction spec vocabulary shared with Metric.add_state's dist_reduce_fx.
+_SUM_LIKE = ("sum", "mean")
+
+
+def reduce(x: Array, reduction: str) -> Array:
+    """Reduce a tensor: 'elementwise_mean' | 'sum' | 'none'.
+
+    Parity with reference ``utilities/distributed.py:22``.
+    """
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in ("none", None):
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Class-wise score reduction: 'micro' | 'macro' | 'weighted' | 'none'.
+
+    Parity with reference ``utilities/distributed.py:44``.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction) if class_reduction != "micro" else jnp.nan_to_num(fraction)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights / jnp.sum(weights)))
+    if class_reduction in ("none", None):
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+# ---------------------------------------------------------------------------
+# In-jit collectives (SPMD over mesh axes, inside shard_map / pmap)
+# ---------------------------------------------------------------------------
+
+
+def sync_reduce_in_context(x: Array, reduce_fx: Union[str, Callable, None], axis_name: Union[str, Tuple[str, ...]]) -> Array:
+    """Apply one state's distributed reduction inside a shard_map/pmap context.
+
+    ``sum|mean`` -> psum (mean divides by axis size), ``max`` -> pmax,
+    ``min`` -> pmin, ``cat``/None/callable -> all_gather along a new leading
+    device axis (the callable / None case mirrors the reference's behaviour of
+    handing the gathered per-rank stack to user code, metric.py:294-304).
+    """
+    if reduce_fx == "sum":
+        return lax.psum(x, axis_name)
+    if reduce_fx == "mean":
+        return lax.pmean(x, axis_name)
+    if reduce_fx == "max":
+        return lax.pmax(x, axis_name)
+    if reduce_fx == "min":
+        return lax.pmin(x, axis_name)
+    # cat / None / custom callable: gather per-device values. Implemented as
+    # psum of a zero-padded scatter rather than lax.all_gather: psum outputs
+    # are replicated-typed under shard_map's varying-axes system (all_gather
+    # outputs stay device-varying and fail out_specs=P() inference), and XLA
+    # lowers this dual form to the same all-gather collective on ICI.
+    gathered = _all_gather_replicated(x, axis_name)  # (n_dev, ...) leading axis
+    if reduce_fx == "cat":
+        return gathered.reshape((-1,) + x.shape[1:]) if x.ndim >= 1 else gathered.reshape(-1)
+    if callable(reduce_fx):
+        return reduce_fx(gathered)
+    return gathered
+
+
+def _all_gather_replicated(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> Array:
+    """All-gather whose output is replicated-typed: psum(one-hot scatter)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    padded = jnp.zeros((n,) + x.shape, x.dtype).at[idx].set(x)
+    return lax.psum(padded, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Eager cross-process gather (DCN / multi-host, host-side states)
+# ---------------------------------------------------------------------------
+
+
+def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """All-gather an array across JAX processes, handling uneven dim-0 shapes.
+
+    Parity with reference ``utilities/distributed.py:102-151``: gathers local
+    shapes first, pads dim 0 to the max, gathers, then trims. Returns a list
+    with one entry per process (single-process: ``[result]``). ``group`` is
+    accepted for API parity and ignored (mesh axes handle grouping in-jit).
+    """
+    if jax.process_count() == 1:
+        return [result]
+    from jax.experimental import multihost_utils
+
+    local_size = jnp.asarray(result.shape, dtype=jnp.int32)
+    all_sizes = multihost_utils.process_allgather(local_size)  # (P, ndim)
+    max_size = tuple(int(s) for s in all_sizes.max(axis=0))
+    all_equal = bool((all_sizes == all_sizes[0]).all())
+    if all_equal:
+        gathered = multihost_utils.process_allgather(result)
+        return [gathered[i] for i in range(gathered.shape[0])]
+    pad_width = [(0, m - s) for m, s in zip(max_size, result.shape)]
+    padded = jnp.pad(result, pad_width)
+    gathered = multihost_utils.process_allgather(padded)
+    out = []
+    for i in range(gathered.shape[0]):
+        slices = tuple(slice(0, int(d)) for d in all_sizes[i])
+        out.append(gathered[i][slices])
+    return out
+
+
+def distributed_available() -> bool:
+    """True when more than one JAX process participates (DCN case)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False

@@ -1,0 +1,52 @@
+"""Case-insensitive string enums for metric options.
+
+Equivalent surface to the reference's ``torchmetrics/utilities/enums.py``
+(``DataType``/``AverageMethod``/``MDMCAverageMethod``).
+"""
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """String enum with case-insensitive ``from_str`` lookup."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, str):
+            return self.value.lower() == other.lower()
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input-case taxonomy."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Class-reduction method."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class reduction method."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

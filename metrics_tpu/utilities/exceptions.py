@@ -1,0 +1,12 @@
+"""User-facing exception types.
+
+Equivalent surface to the reference's ``torchmetrics/utilities/exceptions.py``.
+"""
+
+
+class MetricsTPUUserError(Exception):
+    """Error raised on misuse of the metrics API (lifecycle violations etc.)."""
+
+
+# Alias kept so code reading like the reference's name still works.
+TorchMetricsUserError = MetricsTPUUserError

@@ -1,0 +1,32 @@
+"""Optional-dependency probes gating extras.
+
+Equivalent surface to the reference's ``torchmetrics/utilities/imports.py``
+(``_package_available`` :25, flags :94-120). Flags cover the packages this
+framework can optionally use; anything absent degrades to a clear error at
+metric construction time, never at import time.
+"""
+import importlib.util
+
+
+def _package_available(package_name: str) -> bool:
+    """Check (without importing) whether a package is installed."""
+    try:
+        return importlib.util.find_spec(package_name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_JAX_AVAILABLE = _package_available("jax")
+_FLAX_AVAILABLE = _package_available("flax")
+_ORBAX_AVAILABLE = _package_available("orbax")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_NLTK_AVAILABLE = _package_available("nltk")
+_REGEX_AVAILABLE = _package_available("regex")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_TORCH_AVAILABLE = _package_available("torch")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_FAST_BSS_EVAL_AVAILABLE = _package_available("fast_bss_eval")
+_TORCHVISION_AVAILABLE = _package_available("torchvision")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")

@@ -1,0 +1,43 @@
+"""Rank-zero-gated logging helpers.
+
+Equivalent surface to the reference's ``torchmetrics/utilities/prints.py``,
+with rank resolution via ``jax.process_index()`` (falling back to the
+``LOCAL_RANK`` env var when JAX distributed is not initialised).
+"""
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+_logger = logging.getLogger("metrics_tpu")
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, stacklevel: int = 3, **kwargs: Any) -> None:
+    warnings.warn(message, *args, stacklevel=stacklevel, **kwargs)
+
+
+rank_zero_info = rank_zero_only(partial(_logger.info))
+rank_zero_debug = rank_zero_only(partial(_logger.debug))

@@ -1,0 +1,67 @@
+"""Aggregation metric tests (reference ``tests/bases/test_aggregation.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    "metric_cls, fn",
+    [
+        (MaxMetric, np.max),
+        (MinMetric, np.min),
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+    ],
+)
+def test_aggregation_vs_numpy(metric_cls, fn):
+    rng = np.random.default_rng(42)
+    values = rng.normal(size=(4, 32)).astype(np.float32)
+    m = metric_cls()
+    for batch in values:
+        m.update(jnp.asarray(batch))
+    assert float(m.compute()) == pytest.approx(float(fn(values)), rel=1e-5)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(2.0, weight=1.0)
+    m.update(4.0, weight=3.0)
+    assert float(m.compute()) == pytest.approx((2.0 + 12.0) / 4.0)
+
+
+@pytest.mark.parametrize("strategy", ["error", "warn", "ignore", 0.0])
+def test_nan_strategies(strategy):
+    m = SumMetric(nan_strategy=strategy)
+    x = jnp.asarray([1.0, float("nan"), 2.0])
+    if strategy == "error":
+        with pytest.raises(RuntimeError):
+            m.update(x)
+    elif strategy == "warn":
+        with pytest.warns(UserWarning):
+            m.update(x)
+        assert float(m.compute()) == pytest.approx(3.0)
+    else:
+        m.update(x)
+        assert float(m.compute()) == pytest.approx(3.0)
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError):
+        SumMetric(nan_strategy="bad")
+
+
+def test_aggregation_forward():
+    m = SumMetric()
+    v = m(jnp.asarray([1.0, 2.0]))
+    assert float(v) == pytest.approx(3.0)
+    m(jnp.asarray([4.0]))
+    assert float(m.compute()) == pytest.approx(7.0)

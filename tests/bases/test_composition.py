@@ -1,0 +1,81 @@
+"""Compositional metric algebra tests (reference ``tests/bases/test_composition.py``)."""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import SumMetric
+from metrics_tpu.metric import CompositionalMetric
+
+
+def _sum_metric(value: float) -> SumMetric:
+    m = SumMetric()
+    m.update(jnp.asarray(value))
+    return m
+
+
+@pytest.mark.parametrize(
+    "build, expected",
+    [
+        (lambda a, b: a + b, 5.0),
+        (lambda a, b: a - b, -1.0),
+        (lambda a, b: a * b, 6.0),
+        (lambda a, b: a / b, 2.0 / 3.0),
+        (lambda a, b: b // a, 1.0),
+        (lambda a, b: b % a, 1.0),
+        (lambda a, b: a**b, 8.0),
+        (lambda a, b: 10 + a, 12.0),
+        (lambda a, b: 10 - a, 8.0),
+        (lambda a, b: 2 * b, 6.0),
+        (lambda a, b: 6 / b, 2.0),
+    ],
+)
+def test_binary_ops(build, expected):
+    a, b = _sum_metric(2.0), _sum_metric(3.0)
+    comp = build(a, b)
+    assert isinstance(comp, CompositionalMetric)
+    assert float(comp.compute()) == pytest.approx(expected)
+
+
+def test_unary_ops():
+    a = _sum_metric(-2.0)
+    assert float(abs(a).compute()) == pytest.approx(2.0)
+    assert float((-a).compute()) == pytest.approx(2.0)
+
+
+def test_comparison_ops():
+    a, b = _sum_metric(2.0), _sum_metric(3.0)
+    assert bool((a < b).compute())
+    assert bool((a <= b).compute())
+    assert not bool((a > b).compute())
+    assert not bool((a == b).compute())
+    assert bool((a != b).compute())
+
+
+def test_nested_composition():
+    a, b = _sum_metric(1.0), _sum_metric(2.0)
+    comp = (a + b) / 2
+    assert float(comp.compute()) == pytest.approx(1.5)
+
+
+def test_composition_forward_updates_children():
+    a, b = SumMetric(), SumMetric()
+    comp = a + b
+    out = comp(jnp.asarray(2.0))
+    assert float(out) == pytest.approx(4.0)
+    comp.update(jnp.asarray(1.0))
+    assert float(a.compute()) == pytest.approx(3.0)
+    assert float(comp.compute()) == pytest.approx(6.0)
+
+
+def test_composition_reset_propagates():
+    a, b = _sum_metric(1.0), _sum_metric(2.0)
+    comp = a + b
+    comp.reset()
+    assert float(a.value) == 0.0
+    assert float(b.value) == 0.0
+
+
+def test_getitem():
+    m = CatMetricLike = SumMetric()
+    m.update(jnp.asarray([1.0, 5.0]).sum())
+    comp = m[()]
+    assert float(comp.compute()) == pytest.approx(6.0)

@@ -1,0 +1,216 @@
+"""Core Metric lifecycle tests.
+
+Covers the semantics of reference ``tests/bases/test_metric.py`` (410 LoC):
+state registry, update/compute/reset, caching, forward single-pass value,
+pickling, clone independence, dtype casting, and compositional basics.
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+
+class DummySum(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32).sum()
+
+    def compute(self):
+        return self.x
+
+
+class DummyCat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.x.append(jnp.atleast_1d(jnp.asarray(x, dtype=jnp.float32)))
+
+    def compute(self):
+        return jnp.concatenate(self.x)
+
+
+class DummyMeanPair(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + x.sum()
+        self.n = self.n + x.size
+
+    def compute(self):
+        return self.total / self.n
+
+
+def test_add_state_registry():
+    m = DummySum()
+    assert "x" in m._defaults
+    assert m._reductions["x"] == "sum"
+    with pytest.raises(ValueError):
+        m.add_state("y", jnp.asarray(0.0), dist_reduce_fx="bad")
+    with pytest.raises(ValueError):
+        m.add_state("z", [1.0], dist_reduce_fx="cat")
+
+
+def test_update_accumulates():
+    m = DummySum()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    assert m._update_count == 2
+    assert float(m.compute()) == 6.0
+
+
+def test_compute_caching():
+    m = DummySum()
+    m.update(jnp.asarray(2.0))
+    v1 = m.compute()
+    assert m._computed is not None
+    v2 = m.compute()
+    assert v1 is v2
+    m.update(jnp.asarray(1.0))
+    assert m._computed is None
+    assert float(m.compute()) == 3.0
+
+
+def test_reset():
+    m = DummySum()
+    m.update(jnp.asarray(5.0))
+    m.reset()
+    assert m._update_count == 0
+    assert float(m.x) == 0.0
+    mc = DummyCat()
+    mc.update(jnp.asarray([1.0]))
+    mc.reset()
+    assert mc.x == []
+    # reset must not alias the default list between instances
+    mc2 = DummyCat()
+    mc.update(jnp.asarray([2.0]))
+    assert mc2.x == []
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummyMeanPair()
+    v1 = m.forward(jnp.asarray([2.0, 4.0]))  # batch mean 3.0
+    assert float(v1) == pytest.approx(3.0)
+    v2 = m(jnp.asarray([8.0]))  # batch mean 8.0
+    assert float(v2) == pytest.approx(8.0)
+    # accumulated mean over all 3 samples
+    assert float(m.compute()) == pytest.approx(14.0 / 3)
+    assert m._update_count == 2
+
+
+def test_forward_cat_state():
+    m = DummyCat()
+    v = m.forward(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(v), [1.0, 2.0])
+    m.forward(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_forward_full_state_update_path():
+    class FullState(DummyMeanPair):
+        full_state_update = True
+
+    m = FullState()
+    v = m.forward(jnp.asarray([2.0, 4.0]))
+    assert float(v) == pytest.approx(3.0)
+    m.forward(jnp.asarray([8.0]))
+    assert float(m.compute()) == pytest.approx(14.0 / 3)
+
+
+def test_pickle_roundtrip():
+    m = DummySum()
+    m.update(jnp.asarray(3.0))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 3.0
+
+
+def test_clone_is_independent():
+    m = DummySum()
+    m.update(jnp.asarray(1.0))
+    m2 = m.clone()
+    m2.update(jnp.asarray(10.0))
+    assert float(m.compute()) == 1.0
+    assert float(m2.compute()) == 11.0
+
+
+def test_hash_is_instance_based():
+    m1, m2 = DummySum(), DummySum()
+    assert hash(m1) != hash(m2)
+    assert hash(m1) == hash(m1)
+
+
+def test_state_dict_persistence():
+    m = DummySum()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(jnp.asarray(4.0))
+    sd = m.state_dict()
+    assert float(sd["x"]) == 4.0
+    m2 = DummySum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    m2._update_count = 1
+    assert float(m2.compute()) == 4.0
+
+
+def test_state_pytree_roundtrip():
+    m = DummyMeanPair()
+    m.update(jnp.asarray([1.0, 3.0]))
+    tree = m.state_pytree()
+    m2 = DummyMeanPair()
+    m2.load_state_pytree(tree)
+    m2._update_count = 1
+    assert float(m2.compute()) == 2.0
+
+
+def test_set_dtype():
+    m = DummySum()
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+    m.update(jnp.asarray(1.0))
+    assert m.x.dtype == jnp.bfloat16
+
+
+def test_update_after_sync_raises():
+    m = DummySum()
+    m.update(jnp.asarray(1.0))
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError):
+        m.update(jnp.asarray(1.0))
+
+
+def test_unsync_without_sync_raises():
+    m = DummySum()
+    with pytest.raises(MetricsTPUUserError):
+        m.unsync()
+
+
+def test_filter_kwargs():
+    class KwargMetric(Metric):
+        def update(self, preds, target):
+            pass
+
+        def compute(self):
+            return jnp.asarray(0.0)
+
+    m = KwargMetric()
+    filtered = m._filter_kwargs(preds=1, target=2, extra=3)
+    assert filtered == {"preds": 1, "target": 2}
+
+
+def test_compute_before_update_warns():
+    m = DummySum()
+    with pytest.warns(UserWarning, match="called before"):
+        m.compute()

@@ -1,0 +1,30 @@
+"""Test configuration: run on XLA's CPU backend with 8 virtual devices.
+
+Mirrors the reference's cluster-free DDP testing (gloo pool,
+``tests/helpers/testers.py:35-59``) with JAX's
+``--xla_force_host_platform_device_count`` trick: an 8-device CPU mesh lets
+every sharding/collective path compile and execute without TPU hardware.
+"""
+import os
+
+# jax may already be imported by the interpreter's platform hook, so env vars
+# can be too late — jax.config.update works until the backend initializes.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def pytest_configure(config):
+    assert jax.device_count() >= 8, "tests expect 8 virtual CPU devices"
