@@ -1,0 +1,107 @@
+"""Benchmark: Accuracy update+compute wall-clock at 1M-sample accumulation.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: multiclass accuracy, 10 classes, 1M samples in 16 batches (the
+BASELINE.md headline config). Ours = the fused jitted (state, batch) ->
+(state', value) StatScores kernel on the default JAX device (TPU when
+available). Baseline = the reference's eager-op pattern (torchmetrics
+0.9 ``_stat_scores_update`` data path: argmax/eq/masked sums per batch)
+in torch on CPU — the reference publishes no numbers (BASELINE.md), so
+vs_baseline is measured speedup over that torch-eager equivalent on this
+host. value = our wall-clock in ms.
+"""
+import json
+import time
+
+N_SAMPLES = 1_000_000
+N_BATCHES = 16
+N_CLASSES = 10
+BATCH = N_SAMPLES // N_BATCHES
+
+
+def bench_tpu() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+
+    @jax.jit
+    def step(tp, fp, tn, fn, preds, target):
+        # The shipped kernel: input gate + stat scores, jitted end-to-end.
+        btp, bfp, btn, bfn = _stat_scores_update(
+            preds, target, reduce="micro", threshold=0.5, validate_args=False
+        )
+        return tp + btp, fp + bfp, tn + btn, fn + bfn
+
+    @jax.jit
+    def compute(tp, fp, tn, fn):
+        return tp / jnp.maximum(tp + fn, 1)
+
+    key = jax.random.PRNGKey(0)
+    preds = jax.random.normal(key, (N_BATCHES, BATCH, N_CLASSES), dtype=jnp.bfloat16)
+    target = jax.random.randint(jax.random.PRNGKey(1), (N_BATCHES, BATCH), 0, N_CLASSES)
+    preds.block_until_ready()
+
+    def run():
+        z = jnp.zeros((), dtype=jnp.int32)
+        tp, fp, tn, fn = z, z, z, z
+        for i in range(N_BATCHES):
+            tp, fp, tn, fn = step(tp, fp, tn, fn, preds[i], target[i])
+        return compute(tp, fp, tn, fn).block_until_ready()
+
+    run()  # warmup + compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000.0  # ms
+
+
+def bench_torch_eager() -> float:
+    import torch
+
+    torch.manual_seed(0)
+    preds = torch.randn(N_BATCHES, BATCH, N_CLASSES)
+    target = torch.randint(0, N_CLASSES, (N_BATCHES, BATCH))
+
+    def run():
+        tp = fp = tn = fn = torch.zeros((), dtype=torch.long)
+        for i in range(N_BATCHES):
+            onehot_p = torch.nn.functional.one_hot(preds[i].argmax(-1), N_CLASSES)
+            onehot_t = torch.nn.functional.one_hot(target[i], N_CLASSES)
+            true_pred = onehot_t == onehot_p
+            pos_pred = onehot_p == 1
+            tp = tp + (true_pred & pos_pred).sum()
+            fp = fp + (~true_pred & pos_pred).sum()
+            tn = tn + (true_pred & ~pos_pred).sum()
+            fn = fn + (~true_pred & ~pos_pred).sum()
+        return tp.float() / torch.clamp(tp + fn, min=1)
+
+    run()
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000.0
+
+
+def main() -> None:
+    ours_ms = bench_tpu()
+    base_ms = bench_torch_eager()
+    print(
+        json.dumps(
+            {
+                "metric": "accuracy_1M_update_compute_wallclock",
+                "value": round(ours_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(base_ms / ours_ms, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

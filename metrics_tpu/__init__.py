@@ -14,15 +14,20 @@ _logger.setLevel(logging.INFO)
 
 __version__ = "0.1.0"
 
+from metrics_tpu import functional  # noqa: E402, F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402, F401
+from metrics_tpu.classification import Accuracy, StatScores  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 
 __all__ = [
+    "Accuracy",
     "CatMetric",
     "CompositionalMetric",
     "MaxMetric",
     "MeanMetric",
     "Metric",
     "MinMetric",
+    "StatScores",
     "SumMetric",
+    "functional",
 ]

@@ -1,0 +1,2 @@
+from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
+from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401

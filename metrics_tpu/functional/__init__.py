@@ -1,0 +1,7 @@
+from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+
+__all__ = [
+    "accuracy",
+    "stat_scores",
+]

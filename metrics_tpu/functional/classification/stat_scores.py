@@ -1,0 +1,245 @@
+"""TP/FP/TN/FN sufficient statistics — the classification backbone.
+
+Behavioral equivalent of the reference's
+``torchmetrics/functional/classification/stat_scores.py`` (``_stat_scores``
+:63, ``_stat_scores_update`` :110, ``_stat_scores_compute`` :196,
+``_reduce_stat_scores`` :231, ``stat_scores`` :288), on jnp.
+
+XLA-first notes:
+
+* ``_stat_scores`` and ``_reduce_stat_scores`` are pure, static-shape, fully
+  jittable kernels.
+* Where the reference drops classes with data-dependent boolean indexing, the
+  ignore sentinel (denominator < 0 -> class excluded) is used instead so
+  shapes stay static under jit (see ``_reduce_stat_scores``).
+* ``ignore_index`` column-deletion is a static-index slice (jit-safe);
+  negative-``ignore_index`` row dropping is value-dependent and eager-only.
+"""
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import AverageMethod, DataType, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    """Delete the class column at a static index (reference :23)."""
+    return jnp.concatenate([data[:, :idx], data[:, (idx + 1):]], axis=1)
+
+
+def _drop_negative_ignored_indices(
+    preds: Array, target: Array, ignore_index: int, mode: DataType
+) -> Tuple[Array, Array]:
+    """Drop rows whose target equals a negative ``ignore_index`` (reference :28).
+
+    Value-dependent output shape — eager-only (not jit-traceable).
+    """
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        num_classes = preds.shape[1]
+        n_dims = preds.ndim
+        preds = jnp.swapaxes(preds, 1, n_dims - 1).reshape(-1, num_classes)
+        target = target.reshape(-1)
+    if mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        keep = target != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+    return preds, target
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over binary ``(N, C)`` or ``(N, C, X)`` tensors.
+
+    Output shapes per the reference contract (:63-107):
+    ``(N, C)`` input -> micro: scalar; macro: ``(C,)``; samples: ``(N,)``.
+    ``(N, C, X)`` input -> micro: ``(N,)``; macro: ``(N, C)``; samples: ``(N, X)``.
+    """
+    dim: Union[int, Tuple[int, ...]] = 1  # for "samples"
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+
+    true_pred = target == preds
+    false_pred = target != preds
+    pos_pred = preds == 1
+    neg_pred = preds == 0
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum(false_pred & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & neg_pred, axis=dim)
+    fn = jnp.sum(false_pred & neg_pred, axis=dim)
+    dtype = jnp.int32
+    return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    mode: Optional[DataType] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array, Array]:
+    """Normalize inputs and count tp/fp/tn/fn (reference :110-193)."""
+    _negative_index_dropped = False
+    if ignore_index is not None and ignore_index < 0 and mode is not None:
+        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        _negative_index_dropped = True
+
+    preds, target, _ = _input_format_classification(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+        validate_args=validate_args,
+    )
+
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro" and not _negative_index_dropped:
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Concatenate [tp, fp, tn, fn, support] along a new last axis (reference :196)."""
+    stats = [
+        tp[..., None],
+        fp[..., None],
+        tn[..., None],
+        fn[..., None],
+        tp[..., None] + fn[..., None],  # support
+    ]
+    outputs = jnp.concatenate(stats, axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Reduce ``numerator/denominator`` scores by the averaging method.
+
+    Jit-safe equivalent of reference :231-285: a negative denominator marks an
+    ignored entry (class masked out of the average, or NaN when
+    ``average='none'``); a zero denominator scores ``zero_division``.
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / weights.sum(axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    # sum(weights) == 0 (e.g. the only present class is ignored) -> 0/0 NaN
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE and scores.ndim > 0:
+        # (0-d scores arise when samplewise is set but inputs were not
+        # multi-dim; torch's 0-d mean(dim=0) is a no-op, match that.)
+        scores = scores.mean(axis=0)
+        ignore_mask = ignore_mask.sum(axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = scores.sum()
+    return scores
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Compute ``[tp, fp, tn, fn, support]`` (reference ``stat_scores`` :288).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> preds  = jnp.asarray([1, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='macro', num_classes=3)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+        >>> stat_scores(preds, target, reduce='micro')
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

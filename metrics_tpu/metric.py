@@ -83,6 +83,7 @@ class Metric(ABC):
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
         sync_on_compute: bool = True,
+        distributed_available_fn: Optional[Callable] = None,
         **kwargs: Any,
     ) -> None:
         if kwargs:
@@ -92,6 +93,7 @@ class Metric(ABC):
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.sync_on_compute = sync_on_compute
+        self.distributed_available_fn = distributed_available_fn or distributed_available
 
         self._defaults: Dict[str, Union[Array, List]] = {}
         self._persistent: Dict[str, bool] = {}
@@ -305,7 +307,7 @@ class Metric(ABC):
         """Synchronize state across processes (reference ``metric.py:325``)."""
         if self._is_synced and should_sync:
             raise MetricsTPUUserError("The Metric has already been synced.")
-        is_distributed = (distributed_available_fn or distributed_available)()
+        is_distributed = (distributed_available_fn or self.distributed_available_fn)()
         if not should_sync or not is_distributed:
             return
         if dist_sync_fn is None:

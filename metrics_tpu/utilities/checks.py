@@ -53,7 +53,9 @@ def _basic_input_validation(
         return
     if _is_floating(target):
         raise ValueError("The `target` has to be an integer tensor.")
-    if target.min() < 0:
+    # A negative ignore_index legitimizes negative padding labels (dropped
+    # upstream by _drop_negative_ignored_indices); mirror reference :46-49.
+    if (ignore_index is None or ignore_index >= 0) and target.min() < 0:
         raise ValueError("The `target` has to be a non-negative tensor.")
     preds_float = _is_floating(preds)
     if not preds_float and preds.min() < 0:

@@ -1,0 +1,63 @@
+"""Deterministic random classification fixtures.
+
+Mirrors reference ``tests/classification/inputs.py:24-61``: one namedtuple of
+(preds, target) per input case, covering binary / multilabel / multiclass /
+multidim-multiclass, each in both probability and label form.
+"""
+from collections import namedtuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(1)
+
+
+def _arr(x):
+    return jnp.asarray(x)
+
+
+_binary_prob_inputs = Input(
+    preds=_arr(_rng.random((NUM_BATCHES, BATCH_SIZE), dtype=np.float32)),
+    target=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+)
+
+_binary_inputs = Input(
+    preds=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+    target=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))),
+)
+
+_multilabel_prob_inputs = Input(
+    preds=_arr(_rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), dtype=np.float32)),
+    target=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+)
+
+_multilabel_inputs = Input(
+    preds=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+    target=_arr(_rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))),
+)
+
+_mc_prob = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), dtype=np.float32)
+_multiclass_prob_inputs = Input(
+    preds=_arr(_mc_prob / _mc_prob.sum(axis=-1, keepdims=True)),
+    target=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+)
+
+_multiclass_inputs = Input(
+    preds=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+    target=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))),
+)
+
+_mdmc_prob = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), dtype=np.float32)
+_multidim_multiclass_prob_inputs = Input(
+    preds=_arr(_mdmc_prob / _mdmc_prob.sum(axis=2, keepdims=True)),
+    target=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+)
+
+_multidim_multiclass_inputs = Input(
+    preds=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+    target=_arr(_rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM))),
+)

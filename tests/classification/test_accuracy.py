@@ -1,0 +1,140 @@
+"""Accuracy vs sklearn oracle (reference ``tests/classification/test_accuracy.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy_score
+
+from metrics_tpu.classification.accuracy import Accuracy
+from metrics_tpu.functional.classification.accuracy import accuracy
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    sk_preds, sk_target, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds, sk_target = np.transpose(sk_preds, (0, 2, 1)), np.transpose(sk_target, (0, 2, 1))
+        sk_preds = sk_preds.reshape(-1, sk_preds.shape[2])
+        sk_target = sk_target.reshape(-1, sk_target.shape[2])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.all(sk_preds == sk_target, axis=(1, 2)).mean()
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+
+    return sk_accuracy_score(y_true=sk_target, y_pred=sk_preds)
+
+
+_cases = [
+    pytest.param(_binary_prob_inputs, False, id="binary_prob"),
+    pytest.param(_binary_inputs, False, id="binary"),
+    pytest.param(_multilabel_prob_inputs, False, id="multilabel_prob"),
+    pytest.param(_multilabel_prob_inputs, True, id="multilabel_prob_subset"),
+    pytest.param(_multilabel_inputs, False, id="multilabel"),
+    pytest.param(_multiclass_prob_inputs, False, id="multiclass_prob"),
+    pytest.param(_multiclass_inputs, False, id="multiclass"),
+    pytest.param(_multidim_multiclass_prob_inputs, False, id="mdmc_prob"),
+    pytest.param(_multidim_multiclass_prob_inputs, True, id="mdmc_prob_subset"),
+    pytest.param(_multidim_multiclass_inputs, False, id="mdmc"),
+]
+
+
+class TestAccuracy(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs, subset_accuracy", _cases)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_accuracy_class(self, inputs, subset_accuracy, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "mdmc_average": "global"},
+        )
+
+    @pytest.mark.parametrize("inputs, subset_accuracy", _cases)
+    def test_accuracy_fn(self, inputs, subset_accuracy):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "mdmc_average": "global"},
+        )
+
+    def test_accuracy_ddp_sync_on_step(self):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            ddp=True,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=Accuracy,
+            sk_metric=_sk_accuracy,
+            dist_sync_on_step=True,
+            metric_args={"threshold": THRESHOLD, "mdmc_average": "global"},
+        )
+
+
+def test_accuracy_topk():
+    """top-k accuracy counts a hit when the label is in the top-k (reference test_accuracy.py top-k cases)."""
+    preds = jnp.asarray(
+        [
+            [0.35, 0.4, 0.25],
+            [0.1, 0.5, 0.4],
+            [0.2, 0.1, 0.7],
+            [0.6, 0.3, 0.1],
+            [0.05, 0.15, 0.8],
+        ]
+    )
+    target = jnp.asarray([0, 2, 2, 1, 0])
+    assert float(accuracy(preds, target)) == pytest.approx(1 / 5)
+    assert float(accuracy(preds, target, top_k=2)) == pytest.approx(4 / 5)
+    acc = Accuracy(top_k=2)
+    assert float(acc(preds, target)) == pytest.approx(4 / 5)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_accuracy_averages(average):
+    """macro/weighted/per-class averages vs sklearn recall (accuracy == recall per class)."""
+    from sklearn.metrics import recall_score
+
+    preds = _multiclass_inputs.preds[0]
+    target = _multiclass_inputs.target[0]
+    result = accuracy(preds, target, average=average, num_classes=NUM_CLASSES)
+    sk_avg = None if average == "none" else average
+    expected = recall_score(np.asarray(target), np.asarray(preds), average=sk_avg, zero_division=0)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_accuracy_ignore_index():
+    preds = jnp.asarray([0, 1, 1, 2, 2])
+    target = jnp.asarray([0, 1, 2, 1, 2])
+    res = accuracy(preds, target, ignore_index=0, num_classes=3, average="micro")
+    # class 0 dropped: remaining targets [1, 2, 1, 2], preds [1, 1, 2, 2] -> 2/4
+    assert float(res) == pytest.approx(2 / 4)
+
+
+def test_accuracy_invalid_average():
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray([0, 1]), jnp.asarray([0, 1]), average="bad")
+
+
+def test_accuracy_wrong_mode_mix():
+    acc = Accuracy()
+    acc.update(jnp.asarray([0.2, 0.7, 0.6]), jnp.asarray([0, 1, 0]))  # binary
+    with pytest.raises(ValueError, match="You can not use"):
+        acc.update(jnp.asarray([[0.1, 0.9], [0.8, 0.2]]), jnp.asarray([[0, 1], [1, 0]]))  # multilabel

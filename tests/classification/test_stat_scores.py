@@ -1,0 +1,132 @@
+"""StatScores vs numpy oracle (reference ``tests/classification/test_stat_scores.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.utilities.checks import _input_format_classification
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _np_stat_scores(preds, target, reduce, num_classes=None, mdmc_reduce=None, top_k=None, ignore_index=None):
+    """Independent numpy oracle: format inputs, count tp/fp/tn/fn directly."""
+    p, t, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, top_k=top_k, ignore_index=ignore_index
+    )
+    p, t = np.asarray(p), np.asarray(t)
+
+    if p.ndim == 3 and mdmc_reduce == "global":
+        p = np.transpose(p, (0, 2, 1)).reshape(-1, p.shape[1])
+        t = np.transpose(t, (0, 2, 1)).reshape(-1, t.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        p = np.delete(p, ignore_index, axis=1)
+        t = np.delete(t, ignore_index, axis=1)
+
+    if reduce == "micro":
+        axis = (0, 1) if p.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        axis = 0 if p.ndim == 2 else 2
+    else:
+        axis = 1
+
+    tp = np.logical_and(p == 1, t == 1).sum(axis)
+    fp = np.logical_and(p == 1, t == 0).sum(axis)
+    tn = np.logical_and(p == 0, t == 0).sum(axis)
+    fn = np.logical_and(p == 0, t == 1).sum(axis)
+    out = np.stack([tp, fp, tn, fn, tp + fn], axis=-1).astype(np.int64)
+    if ignore_index is not None and reduce == "macro":
+        out[..., ignore_index, :] = -1
+    return out
+
+
+_cases = [
+    pytest.param(_binary_prob_inputs, "micro", None, None, id="binary_prob-micro"),
+    pytest.param(_multilabel_prob_inputs, "micro", None, None, id="multilabel-micro"),
+    pytest.param(_multilabel_prob_inputs, "macro", NUM_CLASSES, None, id="multilabel-macro"),
+    pytest.param(_multiclass_prob_inputs, "micro", None, None, id="multiclass_prob-micro"),
+    pytest.param(_multiclass_prob_inputs, "macro", NUM_CLASSES, None, id="multiclass_prob-macro"),
+    pytest.param(_multiclass_inputs, "macro", NUM_CLASSES, None, id="multiclass-macro"),
+    pytest.param(_multiclass_inputs, "samples", None, None, id="multiclass-samples"),
+    pytest.param(_multidim_multiclass_inputs, "micro", None, "global", id="mdmc-global-micro"),
+    pytest.param(_multidim_multiclass_inputs, "macro", NUM_CLASSES, "global", id="mdmc-global-macro"),
+    pytest.param(_multidim_multiclass_inputs, "micro", None, "samplewise", id="mdmc-samplewise-micro"),
+    pytest.param(_multidim_multiclass_inputs, "macro", NUM_CLASSES, "samplewise", id="mdmc-samplewise-macro"),
+]
+
+
+class TestStatScores(MetricTester):
+    @pytest.mark.parametrize("inputs, reduce, num_classes, mdmc_reduce", _cases)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_stat_scores_class(self, inputs, reduce, num_classes, mdmc_reduce, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=StatScores,
+            sk_metric=lambda p, t: _np_stat_scores(p, t, reduce, num_classes, mdmc_reduce),
+            metric_args={
+                "threshold": THRESHOLD,
+                "reduce": reduce,
+                "num_classes": num_classes,
+                "mdmc_reduce": mdmc_reduce,
+            },
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("inputs, reduce, num_classes, mdmc_reduce", _cases)
+    def test_stat_scores_fn(self, inputs, reduce, num_classes, mdmc_reduce):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=stat_scores,
+            sk_metric=lambda p, t: _np_stat_scores(p, t, reduce, num_classes, mdmc_reduce),
+            metric_args={
+                "threshold": THRESHOLD,
+                "reduce": reduce,
+                "num_classes": num_classes,
+                "mdmc_reduce": mdmc_reduce,
+            },
+        )
+
+
+def test_stat_scores_ignore_index():
+    preds = jnp.asarray([1, 0, 2, 1])
+    target = jnp.asarray([1, 1, 2, 0])
+    out = stat_scores(preds, target, reduce="macro", num_classes=3, ignore_index=0)
+    np.testing.assert_array_equal(np.asarray(out)[0], [-1, -1, -1, -1, -1])
+    expected = _np_stat_scores(preds, target, "macro", num_classes=3, ignore_index=0)
+    np.testing.assert_array_equal(np.asarray(out), expected)
+
+
+def test_stat_scores_doctest_values():
+    """The reference docstring example (stat_scores.py:403-412)."""
+    preds = jnp.asarray([1, 0, 2, 1])
+    target = jnp.asarray([1, 1, 2, 0])
+    np.testing.assert_array_equal(
+        np.asarray(stat_scores(preds, target, reduce="macro", num_classes=3)),
+        [[0, 1, 2, 1, 1], [1, 1, 1, 1, 2], [1, 0, 3, 0, 1]],
+    )
+    np.testing.assert_array_equal(np.asarray(stat_scores(preds, target, reduce="micro")), [2, 2, 6, 2, 4])
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {"reduce": "bad"},
+        {"mdmc_reduce": "bad"},
+        {"reduce": "macro"},  # missing num_classes
+        {"num_classes": 3, "ignore_index": 5},
+    ],
+)
+def test_stat_scores_invalid_args(kwargs):
+    with pytest.raises(ValueError):
+        StatScores(**kwargs)
