@@ -16,11 +16,62 @@ __version__ = "0.1.0"
 
 from metrics_tpu import functional  # noqa: E402, F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402, F401
-from metrics_tpu.classification import Accuracy, StatScores  # noqa: E402, F401
+from metrics_tpu.classification import (  # noqa: E402, F401
+    AUC,
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
+    CoverageError,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+    PrecisionRecallCurve,
+    ROC,
+    CohenKappa,
+    ConfusionMatrix,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "CalibrationError",
+    "CoverageError",
+    "HingeLoss",
+    "KLDivergence",
+    "LabelRankingAveragePrecision",
+    "LabelRankingLoss",
+    "PrecisionRecallCurve",
+    "ROC",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "Precision",
+    "Recall",
+    "Specificity",
     "CatMetric",
     "CompositionalMetric",
     "MaxMetric",

@@ -1,0 +1,182 @@
+"""Binned (fixed-threshold) precision-recall metrics — the TPU-native curve template.
+
+Behavioral equivalent of reference
+``torchmetrics/classification/binned_precision_recall.py`` (317 LoC):
+``BinnedPrecisionRecallCurve`` :45, ``BinnedAveragePrecision`` :186,
+``BinnedRecallAtFixedPrecision`` :242.
+
+Unlike the exact curve metrics (unbounded cat-list states, eager compute),
+these keep O(1) fixed-shape ``(C, T)`` count states and a fully jittable
+update — the design SURVEY.md §7 recommends for all curve metrics on TPU.
+The reference iterates thresholds one at a time in Python "to conserve
+memory" (:164-169); here the whole ``(N, C, T)`` comparison is one fused XLA
+computation.
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import to_onehot
+
+Array = jax.Array
+
+METRIC_EPS = 1e-6
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall whose precision >= min_precision (reference :25-42).
+
+    The reference takes ``max((r, p, t))`` over valid triples — lexicographic
+    on recall, then precision, then threshold; reproduced here with jit-safe
+    masked argmax passes (thresholds has one fewer entry than p/r, so the
+    appended (1, 0) end point is excluded, like the reference's zip).
+    """
+    n_t = thresholds.shape[0]
+    precision, recall = precision[:n_t], recall[:n_t]
+    valid = precision >= min_precision
+    best_r = jnp.max(jnp.where(valid, recall, -jnp.inf))
+    cand = valid & (recall == best_r)
+    best_p = jnp.max(jnp.where(cand, precision, -jnp.inf))
+    cand = cand & (precision == best_p)
+    idx = jnp.argmax(jnp.where(cand, jnp.arange(n_t), -1))
+    any_valid = valid.any()
+    max_recall = jnp.where(any_valid, recall[idx], 0.0)
+    best_threshold = jnp.where(any_valid & (max_recall > 0), thresholds[idx], 1e6)
+    return max_recall.astype(recall.dtype), best_threshold.astype(thresholds.dtype)
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    """Precision-recall pairs at fixed thresholds with O(1) state.
+
+    Example (binary case):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedPrecisionRecallCurve
+        >>> pred = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> precision
+        Array([0.5      , 0.5      , 1.       , 1.       , 0.99999  , 1.       ],      dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0.5, 0.5, 0. , 0. ], dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float], None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jnp.ndarray, jax.Array)):
+                raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+            self.thresholds = jnp.asarray(thresholds)
+            self.num_thresholds = self.thresholds.size
+        else:
+            self.num_thresholds = 100
+            self.thresholds = jnp.linspace(0, 1.0, 100)
+
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name=name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Vectorized over all thresholds: one (N, C, T) comparison."""
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+        target = (target == 1)[:, :, None]  # (N, C, 1)
+        predictions = preds[:, :, None] >= self.thresholds[None, None, :]  # (N, C, T)
+        self.TPs = self.TPs + (target & predictions).sum(axis=0)
+        self.FPs = self.FPs + ((~target) & predictions).sum(axis=0)
+        self.FNs = self.FNs + (target & (~predictions)).sum(axis=0)
+
+    def _compute_curve(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+        # guarantee the curve ends at precision=1, recall=0
+        t_ones = jnp.ones((self.num_classes, 1), dtype=precisions.dtype)
+        precisions = jnp.concatenate([precisions, t_ones], axis=1)
+        t_zeros = jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)
+        recalls = jnp.concatenate([recalls, t_zeros], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        return self._compute_curve()
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    """Average precision from the binned curve (reference :186).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> average_precision = BinnedAveragePrecision(num_classes=1, thresholds=10)
+        >>> average_precision(pred, target)
+        Array(1., dtype=float32)
+    """
+
+    def compute(self) -> Union[List[Array], Array]:
+        precisions, recalls, _ = self._compute_curve()
+        return _average_precision_compute_with_precision_recall(precisions, recalls, self.num_classes, average=None)
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    """Highest recall at a minimum precision (reference :242).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedRecallAtFixedPrecision
+        >>> pred = jnp.asarray([0.0, 0.2, 0.5, 0.8])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> average_precision = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        >>> average_precision(pred, target)
+        (Array(1., dtype=float32), Array(0.11111111, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float], None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, **kwargs)
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        precisions, recalls, thresholds = self._compute_curve()
+        if self.num_classes == 1:
+            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
+        out = [
+            _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
+            for i in range(self.num_classes)
+        ]
+        recalls_at_p = jnp.stack([o[0] for o in out])
+        thresholds_at_p = jnp.stack([o[1] for o in out])
+        return recalls_at_p, thresholds_at_p

@@ -1,0 +1,79 @@
+"""Multilabel ranking metric classes (reference ``torchmetrics/classification/ranking.py``, 192 LoC)."""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _RankingMetricBase(Metric):
+    """Shared sum-state machinery for the ranking metrics."""
+
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_elements", jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("sample_weight", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._weighted = False
+
+    def _accumulate(self, score: Array, n_elements: int, sample_weight: Optional[Array]) -> None:
+        self.score = self.score + score
+        self.n_elements = self.n_elements + n_elements
+        if sample_weight is not None:
+            self._weighted = True
+            self.sample_weight = self.sample_weight + sample_weight
+
+
+class CoverageError(_RankingMetricBase):
+    """How far down the label ranking to go to cover all true labels."""
+
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, n, sw = _coverage_error_update(preds, target, sample_weight)
+        self._accumulate(score, n, sw)
+
+    def compute(self) -> Array:
+        return _coverage_error_compute(self.score, self.n_elements, self.sample_weight if self._weighted else None)
+
+
+class LabelRankingAveragePrecision(_RankingMetricBase):
+    """Label ranking average precision for multilabel data."""
+
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, n, sw = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self._accumulate(score, n, sw)
+
+    def compute(self) -> Array:
+        return _label_ranking_average_precision_compute(
+            self.score, self.n_elements, self.sample_weight if self._weighted else None
+        )
+
+
+class LabelRankingLoss(_RankingMetricBase):
+    """Average fraction of incorrectly ordered label pairs."""
+
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, n, sw = _label_ranking_loss_update(preds, target, sample_weight)
+        self._accumulate(score, n, sw)
+
+    def compute(self) -> Array:
+        return _label_ranking_loss_compute(self.score, self.n_elements, self.sample_weight if self._weighted else None)

@@ -1,0 +1,110 @@
+"""Average precision kernel.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/average_precision.py`` (235 LoC).
+"""
+import warnings
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.utilities.data import _bincount
+
+Array = jax.Array
+
+
+def _average_precision_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Format inputs; micro flattens the label-indicator matrix (reference :27)."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    if average == "micro":
+        if preds.ndim == target.ndim:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+        else:
+            raise ValueError("Cannot use `micro` average with multi-class input")
+    return preds, target, num_classes, pos_label
+
+
+def _average_precision_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """AP from the precision-recall curve (reference :59)."""
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    if average == "weighted":
+        if preds.ndim == target.ndim and target.ndim > 1:
+            weights = target.sum(axis=0).astype(jnp.float32)
+        else:
+            weights = _bincount(target, minlength=num_classes).astype(jnp.float32)
+        weights = weights / jnp.sum(weights)
+    else:
+        weights = None
+    return _average_precision_compute_with_precision_recall(precision, recall, num_classes, average, weights)
+
+
+def _average_precision_compute_with_precision_recall(
+    precision: Union[Array, List[Array]],
+    recall: Union[Array, List[Array]],
+    num_classes: int,
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Union[List[Array], Array]:
+    """Step-function integral of the PR curve (reference :121)."""
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = [-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)]
+
+    if average in ("macro", "weighted"):
+        res = jnp.stack(res)
+        if bool(jnp.isnan(res).any()):
+            warnings.warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+                UserWarning,
+            )
+        if average == "macro":
+            return res[~jnp.isnan(res)].mean()
+        weights = jnp.ones_like(res) if weights is None else weights
+        return (res * weights)[~jnp.isnan(res)].sum()
+    if average is None:
+        return res
+    allowed_average = ("micro", "macro", "weighted", None)
+    raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[Array], Array]:
+    """Compute average precision (reference ``average_precision`` :178).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> average_precision(pred, target, pos_label=1)
+        Array(1., dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label, average)
+    return _average_precision_compute(preds, target, num_classes, pos_label, average, sample_weights)

@@ -1,0 +1,99 @@
+"""Calibration error kernels (ECE / RMSCE / MCE).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/calibration_error.py`` (208 LoC).
+The reference's ``scatter_add_`` binning (:53-82) becomes jit-safe
+``.at[idx].add`` segment accumulation (deterministic on TPU).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import DataType
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries: Array
+) -> Tuple[Array, Array, Array]:
+    """Per-bin mean accuracy/confidence and bin mass (reference :52)."""
+    n_bins = bin_boundaries.shape[0] - 1
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
+    ones = jnp.ones_like(confidences)
+    count_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(ones)
+    conf_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(confidences)
+    conf_bin = jnp.nan_to_num(conf_bin / count_bin)
+    acc_bin = jnp.zeros(n_bins, dtype=confidences.dtype).at[indices].add(accuracies)
+    acc_bin = jnp.nan_to_num(acc_bin / count_bin)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error under the given norm (reference :85)."""
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    # l2
+    ce = jnp.sum(jnp.power(acc_bin - conf_bin, 2) * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * confidences.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Extract top-1 confidences and correctness (reference :132)."""
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.BINARY:
+        confidences, accuracies = preds, target
+    elif mode == DataType.MULTICLASS:
+        confidences = preds.max(axis=1)
+        predictions = preds.argmax(axis=1)
+        accuracies = (predictions == target)
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        # reshape (N, C, ...) -> (N*..., C)
+        n_classes = preds.shape[1]
+        preds_flat = jnp.moveaxis(preds, 1, -1).reshape(-1, n_classes)
+        target_flat = target.reshape(-1)
+        confidences = preds_flat.max(axis=1)
+        accuracies = (preds_flat.argmax(axis=1) == target_flat)
+    else:
+        raise ValueError(f"Calibration error is not well-defined for data with size {preds.shape} and targets {target.shape}.")
+    return confidences.astype(jnp.float32).reshape(-1), accuracies.astype(jnp.float32).reshape(-1)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    """Compute top-label calibration error (reference ``calibration_error`` :165).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import calibration_error
+        >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+        >>> target = jnp.asarray([0, 1, 1, 1])
+        >>> float(calibration_error(preds, target, n_bins=2, norm='l1')) > 0
+        True
+    """
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+    confidences, accuracies = _ce_update(preds, target)
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

@@ -1,0 +1,61 @@
+"""Cohen's kappa kernel.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/cohen_kappa.py`` (110 LoC):
+``_cohen_kappa_update`` == confusion-matrix update, ``_cohen_kappa_compute``
+:28 (observed vs expected agreement, optional linear/quadratic weighting).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_compute, _confusion_matrix_update
+
+Array = jax.Array
+
+_cohen_kappa_update = _confusion_matrix_update
+
+
+def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array:
+    """kappa = 1 - sum(w * observed) / sum(w * expected) (reference :28)."""
+    confmat = _confusion_matrix_compute(confmat)
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = confmat.sum(axis=0, keepdims=True)
+    sum1 = confmat.sum(axis=1, keepdims=True)
+    expected = sum1 @ sum0 / sum0.sum()
+
+    if weights is None:
+        w_mat = jnp.ones((n_classes, n_classes), dtype=confmat.dtype)
+        w_mat = w_mat - jnp.eye(n_classes, dtype=confmat.dtype)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.broadcast_to(jnp.arange(n_classes, dtype=confmat.dtype), (n_classes, n_classes))
+        diff = w_mat - w_mat.T
+        w_mat = jnp.abs(diff) if weights == "linear" else jnp.power(diff, 2.0)
+    else:
+        raise ValueError(f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'")
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    threshold: float = 0.5,
+) -> Array:
+    """Compute Cohen's kappa (reference :66).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> cohen_kappa(preds, target, num_classes=2)
+        Array(0.5, dtype=float32)
+    """
+    confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
+    return _cohen_kappa_compute(confmat, weights)

@@ -1,0 +1,60 @@
+"""Dice score kernel.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/dice.py`` (113 LoC) — but the
+reference's per-class Python loop (:103-112) is vectorized into one
+class-parallel computation (jit-friendly, MXU-sized reductions).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import to_categorical
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def dice_score(
+    preds: Array,
+    target: Array,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Compute the dice score per class, then reduce (reference :63).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice_score
+        >>> pred = jnp.asarray([[0.85, 0.05, 0.05, 0.05],
+        ...                     [0.05, 0.85, 0.05, 0.05],
+        ...                     [0.05, 0.05, 0.85, 0.05],
+        ...                     [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> dice_score(pred, target)
+        Array(0.33333334, dtype=float32)
+    """
+    num_classes = preds.shape[1]
+    if preds.ndim == target.ndim + 1:
+        preds = to_categorical(preds, argmax_dim=1)
+
+    bg_inv = 1 - int(bg)
+    classes = jnp.arange(bg_inv, num_classes)
+
+    # vectorized per-class tp/fp/fn (reference loops classes in Python)
+    p_onehot = preds[..., None] == classes  # (..., C')
+    t_onehot = target[..., None] == classes
+    reduce_axes = tuple(range(p_onehot.ndim - 1))
+    tp = jnp.sum(p_onehot & t_onehot, axis=reduce_axes)
+    fp = jnp.sum(p_onehot & ~t_onehot, axis=reduce_axes)
+    fn = jnp.sum(~p_onehot & t_onehot, axis=reduce_axes)
+
+    denom = (2 * tp + fp + fn).astype(jnp.float32)
+    scores = jnp.where(denom == 0, nan_score, (2 * tp).astype(jnp.float32) / jnp.where(denom == 0, 1.0, denom))
+    has_fg = jnp.sum(t_onehot, axis=reduce_axes) > 0
+    scores = jnp.where(has_fg, scores, no_fg_score)
+
+    return reduce(scores, reduction=reduction)

@@ -1,0 +1,137 @@
+"""F-beta / F1 kernels.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/f_beta.py`` (354 LoC):
+``_safe_divide`` :24, ``_fbeta_compute`` :30, ``fbeta_score`` :113,
+``f1_score`` :225. In-place sentinel assignment is replaced with jit-safe
+``where`` masking.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall import _check_average_arg
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """num / denom with zero denominators mapped to 1 (reference :24)."""
+    denom = jnp.where(denom == 0, 1, denom)
+    return num / denom
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """F-beta from stat scores (reference :30-108)."""
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # mask ignore sentinel entries (tp == -1 from macro ignore_index)
+        mask = (tp >= 0).astype(tp.dtype)
+        prec = _safe_divide((tp * mask).sum().astype(jnp.float32), ((tp + fp) * mask).sum())
+        rec = _safe_divide((tp * mask).sum().astype(jnp.float32), ((tp + fn) * mask).sum())
+    else:
+        prec = _safe_divide(tp.astype(jnp.float32), tp + fp)
+        rec = _safe_divide(tp.astype(jnp.float32), tp + fn)
+
+    num = (1 + beta**2) * prec * rec
+    denom = beta**2 * prec + rec
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # classes absent from preds AND target are meaningless -> NaN
+        absent = (tp + fp + fn) == 0
+        num = jnp.where(absent, -1.0, num)
+        denom = jnp.where(absent, -1.0, denom)
+
+    if ignore_index is not None:
+        if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES) and mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+            num = num.at[..., ignore_index].set(-1.0)
+            denom = denom.at[..., ignore_index].set(-1.0)
+        elif average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            num = num.at[ignore_index, ...].set(-1.0)
+            denom = denom.at[ignore_index, ...].set(-1.0)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        absent = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        denom = jnp.where(absent, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Compute F-beta (reference :113).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta_score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> fbeta_score(preds, target, num_classes=3, beta=0.5)
+        Array(0.33333334, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1 (reference :225).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1_score
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> f1_score(preds, target, num_classes=3)
+        Array(0.33333334, dtype=float32)
+    """
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -1,0 +1,107 @@
+"""Hinge loss kernels.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/hinge.py`` (231 LoC): binary,
+Crammer-Singer multiclass, and one-vs-all modes. Boolean fancy indexing is
+replaced with where-masking (jit-safe).
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_squeeze
+from metrics_tpu.utilities.data import to_onehot
+from metrics_tpu.utilities.enums import DataType, EnumStr
+
+Array = jax.Array
+
+
+class MulticlassMode(EnumStr):
+    """Possible multiclass modes of hinge (reference :24)."""
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> DataType:
+    """Resolve binary vs multiclass from shapes (reference :36)."""
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        return DataType.BINARY
+    if preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape in the first dimension,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        return DataType.MULTICLASS
+    raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+
+
+def _hinge_update(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[Array, Array]:
+    """Sum of hinge losses + observation count (reference :76)."""
+    preds, target = _input_squeeze(preds, target)
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target_onehot = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER):
+        # margin = score of true class - best score among other classes
+        true_score = jnp.sum(jnp.where(target_onehot, preds, 0.0), axis=1)
+        other_best = jnp.max(jnp.where(target_onehot, -jnp.inf, preds), axis=1)
+        margin = true_score - other_best
+    elif mode == DataType.BINARY or multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        if mode == DataType.BINARY:
+            t = target.astype(bool)
+        else:
+            t = target_onehot
+        margin = jnp.where(t, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            f"(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL, got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures**2
+
+    total = jnp.asarray(target.shape[0])
+    return measures.sum(axis=0), total
+
+
+def _hinge_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    """Compute the mean hinge loss (reference ``hinge_loss`` :154).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge_loss
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> hinge_loss(preds, target)
+        Array(0.3, dtype=float32)
+    """
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

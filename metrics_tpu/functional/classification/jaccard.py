@@ -1,0 +1,61 @@
+"""Jaccard index (IoU) kernel.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/jaccard.py`` (129 LoC):
+``_jaccard_from_confmat`` :25, ``jaccard_index`` :70.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Per-class intersection-over-union from a confusion matrix (reference :25)."""
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    intersection = jnp.diag(confmat)
+    union = confmat.sum(axis=0) + confmat.sum(axis=1) - intersection
+
+    scores = intersection.astype(jnp.float32) / union.astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+
+    return reduce(scores, reduction=reduction)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Compute the Jaccard index (reference :70).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import jaccard_index
+        >>> target = jnp.asarray([[0, 1, 1], [1, 1, 0]])
+        >>> preds = jnp.asarray([[0, 1, 0], [1, 1, 1]])
+        >>> jaccard_index(preds, target, num_classes=2)
+        Array(0.58333334, dtype=float32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

@@ -1,0 +1,196 @@
+"""Precision / Recall kernels.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/precision_recall.py`` (552 LoC):
+``_precision_compute`` :23, ``precision`` :76, ``_recall_compute`` :209,
+``recall`` :262, ``precision_recall`` :397. Class-presence filtering is
+where-masked (jit-safe) instead of boolean-indexed.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _mask_absent_classes(
+    tp: Array, fp: Array, fn: Array, numerator: Array, denominator: Array, average: Optional[str], mdmc_average: Optional[str]
+) -> Tuple[Array, Array]:
+    """Exclude classes absent from preds AND target (no tp/fp/fn).
+
+    Jit-safe replacement for the reference's boolean-index dropping
+    (precision_recall.py:55-65): the ignore sentinel (-1) routes through
+    ``_reduce_stat_scores``'s ignore mask.
+    """
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        return numerator, denominator
+    if average == AverageMethod.MACRO:
+        absent = (tp + fp + fn) == 0
+        denominator = jnp.where(absent, -1, denominator)
+    elif average == AverageMethod.NONE:
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+    return numerator, denominator
+
+
+def _precision_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """precision = tp / (tp + fp), averaged (reference :23)."""
+    numerator, denominator = _mask_absent_classes(tp, fp, fn, tp, tp + fp, average, mdmc_average)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _recall_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """recall = tp / (tp + fn), averaged (reference :209)."""
+    numerator, denominator = _mask_absent_classes(tp, fp, fn, tp, tp + fn, average, mdmc_average)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != AverageMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _check_average_arg(average: Optional[str], mdmc_average: Optional[str], num_classes: Optional[int], ignore_index: Optional[int]) -> None:
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def precision(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Compute precision (reference :76).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> precision(preds, target, average='macro', num_classes=3)
+        Array(0.16666667, dtype=float32)
+        >>> precision(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _precision_compute(tp, fp, fn, average, mdmc_average)
+
+
+def recall(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """Compute recall (reference :262).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> preds  = jnp.asarray([2, 0, 2, 1])
+        >>> target = jnp.asarray([1, 1, 2, 0])
+        >>> recall(preds, target, average='macro', num_classes=3)
+        Array(0.33333334, dtype=float32)
+        >>> recall(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _recall_compute(tp, fp, fn, average, mdmc_average)
+
+
+def precision_recall(
+    preds: Array,
+    target: Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Compute precision and recall together (reference :397)."""
+    _check_average_arg(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return (
+        _precision_compute(tp, fp, fn, average, mdmc_average),
+        _recall_compute(tp, fp, fn, average, mdmc_average),
+    )

@@ -1,0 +1,138 @@
+"""Multilabel ranking kernels: coverage error, LRAP, label ranking loss.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/classification/ranking.py`` (242 LoC). The
+reference's per-sample Python loop for LRAP (:139-155) is vectorized into one
+(N, L, L) pairwise-rank computation — class-parallel, jit-friendly.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_ranking_input(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+    """Validate [N, C] ranking inputs (reference :30)."""
+    if preds.ndim != 2 or target.ndim != 2:
+        raise ValueError(
+            "Expected both predictions and target to matrices of shape `[N,C]`"
+            f" but got {preds.ndim} and {target.ndim}"
+        )
+    if preds.shape != target.shape:
+        raise ValueError("Expected both predictions and target to have same shape")
+    if sample_weight is not None:
+        if sample_weight.ndim != 1 or sample_weight.shape[0] != preds.shape[0]:
+            raise ValueError(
+                "Expected sample weights to be 1 dimensional and have same size"
+                f" as the first dimension of preds and target but got {sample_weight.shape}"
+            )
+
+
+def _coverage_error_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """How far down the ranking to go to cover all true labels (reference :48)."""
+    _check_ranking_input(preds, target, sample_weight)
+    offset = jnp.where(target == 0, jnp.abs(preds.min()) + 10, 0.0)
+    preds_mod = preds + offset
+    preds_min = preds_mod.min(axis=1)
+    coverage = (preds >= preds_min[:, None]).sum(axis=1).astype(jnp.float32)
+    if sample_weight is not None:
+        coverage = coverage * sample_weight
+        sample_weight = sample_weight.sum()
+    return coverage.sum(), coverage.size, sample_weight
+
+
+def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None and float(sample_weight) != 0.0:
+        return coverage / sample_weight
+    return coverage / n_elements
+
+
+def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Compute multilabel coverage error (reference ``coverage_error`` :77)."""
+    coverage, n_elements, sample_weight = _coverage_error_update(preds, target, sample_weight)
+    return _coverage_error_compute(coverage, n_elements, sample_weight)
+
+
+def _label_ranking_average_precision_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """LRAP accumulation, vectorized over samples (reference :108-131).
+
+    For each relevant label j of sample i the reference computes
+    (rank among relevant) / (rank among all), with max-rank tie handling —
+    equivalent to counting labels with score >= score_j.
+    """
+    _check_ranking_input(preds, target, sample_weight)
+    neg_preds = -preds
+    n_preds, n_labels = neg_preds.shape
+    relevant = target == 1
+    n_rel = relevant.sum(axis=1)
+
+    # pairwise[i, j, k] = neg_preds[i, k] <= neg_preds[i, j]
+    pairwise = neg_preds[:, None, :] <= neg_preds[:, :, None]
+    rank_all = pairwise.sum(axis=2).astype(jnp.float32)  # (N, L)
+    rank_rel = (pairwise & relevant[:, None, :]).sum(axis=2).astype(jnp.float32)
+
+    ratio = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    per_sample = jnp.where(
+        (n_rel > 0) & (n_rel < n_labels),
+        ratio.sum(axis=1) / jnp.maximum(n_rel, 1),
+        1.0,
+    )
+    if sample_weight is not None:
+        per_sample = per_sample * sample_weight
+        sample_weight = sample_weight.sum()
+    return per_sample.sum(), n_preds, sample_weight
+
+
+def _label_ranking_average_precision_compute(
+    score: Array, n_elements: int, sample_weight: Optional[Array] = None
+) -> Array:
+    if sample_weight is not None and float(sample_weight) != 0.0:
+        return score / sample_weight
+    return score / n_elements
+
+
+def label_ranking_average_precision(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Compute label ranking average precision (reference :160)."""
+    score, n_elements, sample_weight = _label_ranking_average_precision_update(preds, target, sample_weight)
+    return _label_ranking_average_precision_compute(score, n_elements, sample_weight)
+
+
+def _label_ranking_loss_update(
+    preds: Array, target: Array, sample_weight: Optional[Array] = None
+) -> Tuple[Array, int, Optional[Array]]:
+    """Average fraction of incorrectly ordered label pairs (reference :174-206)."""
+    _check_ranking_input(preds, target, sample_weight)
+    n_preds, n_labels = preds.shape
+    relevant = target == 1
+    n_rel = relevant.sum(axis=1)
+    mask = (n_rel > 0) & (n_rel < n_labels)
+
+    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
+    correction = 0.5 * n_rel * (n_rel + 1)
+    denom = n_rel * (n_labels - n_rel)
+    loss = jnp.where(mask, (per_label_loss.sum(axis=1) - correction) / jnp.maximum(denom, 1), 0.0)
+    if sample_weight is not None:
+        loss = loss * jnp.where(mask, sample_weight, 0.0)
+        sample_weight = sample_weight.sum()
+    if not bool(mask.any()):
+        return jnp.asarray(0.0), 1, sample_weight
+    return loss.sum(), n_preds, sample_weight
+
+
+def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
+    if sample_weight is not None and float(sample_weight) != 0.0:
+        return loss / sample_weight
+    return loss / n_elements
+
+
+def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
+    """Compute the label ranking loss (reference ``label_ranking_loss`` :216)."""
+    loss, n_elements, sample_weight = _label_ranking_loss_update(preds, target, sample_weight)
+    return _label_ranking_loss_compute(loss, n_elements, sample_weight)

@@ -1,0 +1,179 @@
+"""ConfusionMatrix / CohenKappa / MatthewsCorrCoef / JaccardIndex / Hamming / Dice vs sklearn."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+
+from metrics_tpu import CohenKappa, ConfusionMatrix, HammingDistance, JaccardIndex, MatthewsCorrCoef
+from metrics_tpu.functional import (
+    cohen_kappa,
+    confusion_matrix,
+    dice_score,
+    hamming_distance,
+    jaccard_index,
+    matthews_corrcoef,
+)
+from tests.classification.inputs import _multiclass_inputs, _multiclass_prob_inputs, _multilabel_prob_inputs
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _to_labels(preds):
+    p = np.asarray(preds)
+    return p.argmax(-1) if p.ndim > 1 and np.issubdtype(p.dtype, np.floating) else p
+
+
+def _sk_confmat(preds, target, normalize=None):
+    return sk_confusion_matrix(
+        np.asarray(target), _to_labels(preds), labels=list(range(NUM_CLASSES)), normalize=normalize
+    )
+
+
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs", [_multiclass_inputs, _multiclass_prob_inputs])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_confmat_class(self, inputs, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=ConfusionMatrix,
+            sk_metric=_sk_confmat,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_confmat_normalize(self, normalize):
+        preds, target = _multiclass_inputs.preds[0], _multiclass_inputs.target[0]
+        got = confusion_matrix(preds, target, num_classes=NUM_CLASSES, normalize=normalize)
+        expected = _sk_confmat(preds, target, normalize=normalize)
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-6)
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_cohen_kappa_class(self, ddp):
+        inputs = _multiclass_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=CohenKappa,
+            sk_metric=lambda p, t: sk_cohen_kappa(np.asarray(t), _to_labels(p)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_cohen_kappa_weighted(self, weights):
+        preds, target = _multiclass_inputs.preds[0], _multiclass_inputs.target[0]
+        got = cohen_kappa(preds, target, num_classes=NUM_CLASSES, weights=weights)
+        expected = sk_cohen_kappa(np.asarray(target), np.asarray(preds), weights=weights)
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-6)
+
+
+class TestMatthews(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_matthews_class(self, ddp):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=MatthewsCorrCoef,
+            sk_metric=lambda p, t: sk_matthews(np.asarray(t), _to_labels(p)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestJaccard(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_jaccard_class(self, ddp):
+        inputs = _multiclass_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=JaccardIndex,
+            sk_metric=lambda p, t: sk_jaccard(
+                np.asarray(t), _to_labels(p), average="macro", labels=list(range(NUM_CLASSES)), zero_division=0
+            ),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_jaccard_ignore_index(self):
+        # reference semantics: zero the ignored row of the confmat, then IoU
+        # over the remaining classes (jaccard.py:49-66)
+        preds, target = _multiclass_inputs.preds[0], _multiclass_inputs.target[0]
+        got = jaccard_index(preds, target, num_classes=NUM_CLASSES, ignore_index=0)
+        cm = sk_confusion_matrix(np.asarray(target), np.asarray(preds), labels=list(range(NUM_CLASSES))).astype(float)
+        cm[0] = 0.0
+        inter = np.diag(cm)
+        union = cm.sum(0) + cm.sum(1) - inter
+        scores = np.where(union == 0, 0.0, inter / np.where(union == 0, 1.0, union))
+        expected = scores[1:].mean()
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-6)
+
+
+class TestHamming(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("inputs", [_multilabel_prob_inputs, _multiclass_inputs])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_hamming_class(self, inputs, ddp):
+        def sk_hamming(p, t):
+            from metrics_tpu.utilities.checks import _input_format_classification
+
+            fp, ft, _ = _input_format_classification(p, t, threshold=THRESHOLD)
+            return sk_hamming_loss(np.asarray(ft), np.asarray(fp))
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=HammingDistance,
+            sk_metric=sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+    def test_hamming_fn(self):
+        target = jnp.asarray([[0, 1], [1, 1]])
+        preds = jnp.asarray([[0, 1], [0, 1]])
+        assert float(hamming_distance(preds, target)) == pytest.approx(0.25)
+
+
+def test_dice_score():
+    pred = jnp.asarray(
+        [
+            [0.85, 0.05, 0.05, 0.05],
+            [0.05, 0.85, 0.05, 0.05],
+            [0.05, 0.05, 0.85, 0.05],
+            [0.05, 0.05, 0.05, 0.85],
+        ]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    assert float(dice_score(pred, target)) == pytest.approx(1 / 3)
+    # perfect prediction
+    target2 = jnp.asarray([0, 1, 2, 3])
+    assert float(dice_score(pred, target2)) == pytest.approx(1.0)
+    # no_fg_score path: class absent in target
+    out = dice_score(pred[:2], jnp.asarray([0, 1]), no_fg_score=0.5)
+    assert np.isfinite(float(out))
+
+
+def test_multilabel_confmat():
+    target = jnp.asarray([[0, 1, 0], [1, 0, 1]])
+    preds = jnp.asarray([[0, 0, 1], [1, 0, 1]])
+    got = confusion_matrix(preds, target, num_classes=3, multilabel=True)
+    expected = np.asarray([[[1, 0], [0, 1]], [[1, 0], [1, 0]], [[0, 1], [0, 1]]])
+    np.testing.assert_array_equal(np.asarray(got), expected)

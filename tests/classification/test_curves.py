@@ -1,0 +1,189 @@
+"""ROC / PRC / AUROC / AveragePrecision / AUC / binned variants vs sklearn."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import auc as sk_auc
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import _binary_prob_inputs, _multiclass_prob_inputs, _multilabel_prob_inputs
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+class TestROCAndAUROC(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_auroc_class(self, ddp):
+        inputs = _binary_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(np.asarray(t), np.asarray(p)),
+            metric_args={},
+        )
+
+    def test_binary_roc_curve(self):
+        preds = _binary_prob_inputs.preds[0]
+        target = _binary_prob_inputs.target[0]
+        fpr, tpr, thr = roc(preds, target, pos_label=1)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(np.asarray(target), np.asarray(preds), drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass_auroc(self, average):
+        preds = _multiclass_prob_inputs.preds[0]
+        target = _multiclass_prob_inputs.target[0]
+        got = auroc(preds, target, num_classes=NUM_CLASSES, average=average)
+        expected = sk_roc_auc(np.asarray(target), np.asarray(preds), multi_class="ovr", average=average)
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
+
+    def test_multilabel_auroc(self):
+        preds = _multilabel_prob_inputs.preds[0]
+        target = _multilabel_prob_inputs.target[0]
+        got = auroc(preds, target, num_classes=NUM_CLASSES, average="macro")
+        expected = sk_roc_auc(np.asarray(target), np.asarray(preds), average="macro")
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
+
+    def test_max_fpr(self):
+        preds = _binary_prob_inputs.preds[0]
+        target = _binary_prob_inputs.target[0]
+        got = auroc(preds, target, max_fpr=0.5)
+        expected = sk_roc_auc(np.asarray(target), np.asarray(preds), max_fpr=0.5)
+        np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
+
+
+def _sk_prc_truncated(y_true, probas_pred):
+    """sklearn PRC truncated at first full-recall attainment (reference
+    torchmetrics stops the curve there, precision_recall_curve.py:144-146;
+    modern sklearn keeps the full curve)."""
+    sk_p, sk_r, sk_t = sk_precision_recall_curve(y_true, probas_pred)
+    k = int(np.sum(sk_r == 1.0)) - 1
+    return sk_p[k:], sk_r[k:], sk_t[k:]
+
+
+class TestPrecisionRecallCurve(MetricTester):
+    atol = 1e-6
+
+    def test_binary_prc(self):
+        preds = _binary_prob_inputs.preds[0]
+        target = _binary_prob_inputs.target[0]
+        p, r, t = precision_recall_curve(preds, target, pos_label=1)
+        sk_p, sk_r, sk_t = _sk_prc_truncated(np.asarray(target), np.asarray(preds))
+        np.testing.assert_allclose(np.asarray(p), sk_p, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r), sk_r, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(t), sk_t, atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_avg_precision_class(self, ddp):
+        inputs = _binary_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=AveragePrecision,
+            sk_metric=lambda p, t: sk_average_precision(np.asarray(t), np.asarray(p)),
+            metric_args={},
+        )
+
+    def test_multiclass_avg_precision(self):
+        preds = _multiclass_prob_inputs.preds[0]
+        target = _multiclass_prob_inputs.target[0]
+        got = average_precision(preds, target, num_classes=NUM_CLASSES, average=None)
+        target_oh = np.eye(NUM_CLASSES)[np.asarray(target)]
+        expected = [sk_average_precision(target_oh[:, i], np.asarray(preds)[:, i]) for i in range(NUM_CLASSES)]
+        np.testing.assert_allclose(np.asarray([float(g) for g in got]), expected, atol=1e-5)
+
+    def test_prc_class_streaming(self):
+        inputs = _binary_prob_inputs
+        prc = PrecisionRecallCurve(pos_label=1)
+        for i in range(4):
+            prc.update(inputs.preds[i], inputs.target[i])
+        p, r, t = prc.compute()
+        all_p = np.concatenate([np.asarray(x) for x in inputs.preds])
+        all_t = np.concatenate([np.asarray(x) for x in inputs.target])
+        sk_p, sk_r, _ = _sk_prc_truncated(all_t, all_p)
+        np.testing.assert_allclose(np.asarray(p), sk_p, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r), sk_r, atol=1e-6)
+
+    def test_roc_class_streaming(self):
+        inputs = _binary_prob_inputs
+        m = ROC(pos_label=1)
+        for i in range(4):
+            m.update(inputs.preds[i], inputs.target[i])
+        fpr, tpr, _ = m.compute()
+        all_p = np.concatenate([np.asarray(x) for x in inputs.preds])
+        all_t = np.concatenate([np.asarray(x) for x in inputs.target])
+        sk_fpr, sk_tpr, _ = sk_roc_curve(all_t, all_p, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_auc_trapezoid():
+    x = jnp.asarray([0, 1, 2, 3])
+    y = jnp.asarray([0, 1, 2, 2])
+    assert float(auc(x, y)) == pytest.approx(4.0)
+    m = AUC()
+    m.update(x[:2], y[:2])
+    m.update(x[2:], y[2:])
+    assert float(m.compute()) == pytest.approx(4.0)
+    expected = sk_auc(np.asarray(x), np.asarray(y))
+    assert float(auc(x, y)) == pytest.approx(float(expected))
+
+
+class TestBinned(MetricTester):
+    def test_binned_pr_curve_binary_docexample(self):
+        pred = jnp.asarray([0.0, 0.1, 0.8, 0.4])
+        target = jnp.asarray([0, 1, 1, 0])
+        pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        precision, recall, thresholds = pr_curve(pred, target)
+        np.testing.assert_allclose(np.asarray(recall), [1.0, 0.5, 0.5, 0.5, 0.0, 0.0], atol=1e-5)
+        np.testing.assert_allclose(np.asarray(thresholds), [0.0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+    def test_binned_avg_precision_close_to_exact(self):
+        """With dense thresholds, binned AP approaches exact sklearn AP."""
+        preds = _binary_prob_inputs.preds[0]
+        target = _binary_prob_inputs.target[0]
+        m = BinnedAveragePrecision(num_classes=1, thresholds=1001)
+        got = float(m(preds, target))
+        expected = sk_average_precision(np.asarray(target), np.asarray(preds))
+        assert got == pytest.approx(expected, abs=5e-3)
+
+    def test_binned_recall_at_fixed_precision_docexample(self):
+        pred = jnp.asarray([0.0, 0.2, 0.5, 0.8])
+        target = jnp.asarray([0, 1, 1, 0])
+        m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        recall_val, thr = m(pred, target)
+        assert float(recall_val) == pytest.approx(1.0)
+        assert float(thr) == pytest.approx(1 / 9, abs=1e-4)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binned_ap_ddp(self, ddp):
+        inputs = _binary_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=BinnedAveragePrecision,
+            sk_metric=lambda p, t: sk_average_precision(np.asarray(t), np.asarray(p)),
+            metric_args={"num_classes": 1, "thresholds": 2001},
+            check_batch=False,
+        )
+        # tolerance for binning
+    atol = 5e-3

@@ -1,0 +1,154 @@
+"""CalibrationError / HingeLoss / KLDivergence / ranking metrics vs sklearn/scipy."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import coverage_error as sk_coverage_error
+from sklearn.metrics import hinge_loss as sk_hinge
+from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+from sklearn.metrics import label_ranking_loss as sk_lrl
+
+from metrics_tpu import CalibrationError, CoverageError, HingeLoss, KLDivergence, LabelRankingAveragePrecision, LabelRankingLoss
+from metrics_tpu.functional import (
+    calibration_error,
+    coverage_error,
+    hinge_loss,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from tests.classification.inputs import _multilabel_prob_inputs
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(3)
+
+
+class TestRanking(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_fn",
+        [
+            (CoverageError, coverage_error, sk_coverage_error),
+            (LabelRankingAveragePrecision, label_ranking_average_precision, sk_lrap),
+            (LabelRankingLoss, label_ranking_loss, sk_lrl),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ranking_class(self, metric_class, metric_fn, sk_fn, ddp):
+        inputs = _multilabel_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(np.asarray(t), np.asarray(p)),
+            metric_args={},
+        )
+
+    @pytest.mark.parametrize(
+        "metric_fn, sk_fn",
+        [
+            (coverage_error, sk_coverage_error),
+            (label_ranking_average_precision, sk_lrap),
+            (label_ranking_loss, sk_lrl),
+        ],
+    )
+    def test_ranking_fn(self, metric_fn, sk_fn):
+        self.run_functional_metric_test(
+            preds=_multilabel_prob_inputs.preds,
+            target=_multilabel_prob_inputs.target,
+            metric_functional=metric_fn,
+            sk_metric=lambda p, t: sk_fn(np.asarray(t), np.asarray(p)),
+        )
+
+
+def test_hinge_binary():
+    target = np.asarray([0, 1, 1])
+    preds = np.asarray([-2.2, 2.4, 0.1])
+    got = hinge_loss(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_hinge(target, preds)
+    assert float(got) == pytest.approx(float(expected), abs=1e-6)
+
+
+def test_hinge_multiclass_crammer_singer():
+    target = np.asarray([0, 1, 2])
+    preds = np.asarray([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+    got = hinge_loss(jnp.asarray(preds), jnp.asarray(target))
+    assert float(got) == pytest.approx(2.9, abs=1e-6)  # reference docstring value
+
+
+def test_hinge_one_vs_all():
+    target = np.asarray([0, 1, 2])
+    preds = np.asarray([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+    got = hinge_loss(jnp.asarray(preds), jnp.asarray(target), multiclass_mode="one-vs-all")
+    assert np.asarray(got).shape == (3,)
+
+
+def test_hinge_class_streaming():
+    target = np.asarray([0, 1, 1, 0, 1])
+    preds = np.asarray([-2.2, 2.4, 0.1, -1.1, 0.9])
+    m = HingeLoss()
+    m.update(jnp.asarray(preds[:3]), jnp.asarray(target[:3]))
+    m.update(jnp.asarray(preds[3:]), jnp.asarray(target[3:]))
+    expected = sk_hinge(target, preds)
+    assert float(m.compute()) == pytest.approx(float(expected), abs=1e-6)
+
+
+def test_kl_divergence_vs_scipy():
+    from scipy.stats import entropy
+
+    p = _rng.random((8, 5)).astype(np.float32)
+    q = _rng.random((8, 5)).astype(np.float32)
+    p_n = p / p.sum(-1, keepdims=True)
+    q_n = q / q.sum(-1, keepdims=True)
+    got = kl_divergence(jnp.asarray(p), jnp.asarray(q))
+    expected = np.mean([entropy(p_n[i], q_n[i]) for i in range(8)])
+    assert float(got) == pytest.approx(float(expected), abs=1e-5)
+
+    m = KLDivergence()
+    m.update(jnp.asarray(p[:4]), jnp.asarray(q[:4]))
+    m.update(jnp.asarray(p[4:]), jnp.asarray(q[4:]))
+    assert float(m.compute()) == pytest.approx(float(expected), abs=1e-5)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_error_vs_manual(norm):
+    """Compare against a direct numpy binning implementation."""
+    preds = _rng.random(200).astype(np.float32)
+    target = (_rng.random(200) < preds).astype(np.int64)  # well-calibrated-ish
+    n_bins = 10
+    got = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), n_bins=n_bins, norm=norm))
+
+    conf, acc = preds, (preds >= 0.5).astype(float) == 0  # placeholder, recompute below
+    # binary mode: confidences are preds, accuracies are target
+    conf, acc = preds, target.astype(float)
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, n_bins - 1)
+    ce_terms = []
+    maxces = []
+    for b in range(n_bins):
+        m = idx == b
+        if m.sum() == 0:
+            continue
+        gap = abs(acc[m].mean() - conf[m].mean())
+        prop = m.mean()
+        ce_terms.append((gap, prop))
+        maxces.append(gap)
+    if norm == "l1":
+        expected = sum(g * p for g, p in ce_terms)
+    elif norm == "max":
+        expected = max(maxces)
+    else:
+        expected = np.sqrt(sum(g**2 * p for g, p in ce_terms))
+    assert got == pytest.approx(float(expected), abs=1e-5)
+
+
+def test_calibration_error_class_streaming():
+    preds = _rng.random(100).astype(np.float32)
+    target = _rng.integers(0, 2, 100)
+    m = CalibrationError(n_bins=10)
+    m.update(jnp.asarray(preds[:50]), jnp.asarray(target[:50]))
+    m.update(jnp.asarray(preds[50:]), jnp.asarray(target[50:]))
+    got_stream = float(m.compute())
+    got_once = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), n_bins=10))
+    assert got_stream == pytest.approx(got_once, abs=1e-6)

@@ -1,0 +1,140 @@
+"""Precision/Recall/FBeta/F1/Specificity vs sklearn (reference ``tests/classification/test_precision_recall.py`` + ``test_f_beta.py`` + ``test_specificity.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu import F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.functional import f1_score, fbeta_score, precision, precision_recall, recall, specificity
+from metrics_tpu.utilities.checks import _input_format_classification
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_wrapper(preds, target, sk_fn, average, num_classes=None):
+    """Run sklearn on inputs formatted through the shared gate."""
+    sk_preds, sk_target, mode = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    if sk_preds.ndim == 2 and sk_preds.shape[1] > 1:
+        # one-hot (N, C): sklearn takes labels for multiclass, indicator for multilabel
+        if mode == "multi-class":
+            sk_preds, sk_target = sk_preds.argmax(1), sk_target.argmax(1)
+            labels = list(range(num_classes)) if num_classes else None
+            return sk_fn(sk_target, sk_preds, average=average, labels=labels, zero_division=0)
+        return sk_fn(sk_target, sk_preds, average=average, zero_division=0)
+    return sk_fn(sk_target.reshape(-1), sk_preds.reshape(-1), average=average, zero_division=0)
+
+
+_metric_matrix = [
+    (Precision, precision, sk_precision, {}),
+    (Recall, recall, sk_recall, {}),
+    (F1Score, f1_score, partial(sk_fbeta, beta=1.0), {}),
+    (FBetaScore, fbeta_score, partial(sk_fbeta, beta=2.0), {"beta": 2.0}),
+]
+
+_input_matrix = [
+    pytest.param(_binary_prob_inputs, "micro", None, id="binary_prob-micro"),
+    pytest.param(_multilabel_prob_inputs, "micro", None, id="multilabel-micro"),
+    pytest.param(_multilabel_prob_inputs, "macro", NUM_CLASSES, id="multilabel-macro"),
+    pytest.param(_multiclass_prob_inputs, "micro", None, id="multiclass_prob-micro"),
+    pytest.param(_multiclass_prob_inputs, "macro", NUM_CLASSES, id="multiclass_prob-macro"),
+    pytest.param(_multiclass_inputs, "weighted", NUM_CLASSES, id="multiclass-weighted"),
+]
+
+
+class TestPrecisionRecallF1(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("metric_class, metric_fn, sk_fn, extra", _metric_matrix)
+    @pytest.mark.parametrize("inputs, average, num_classes", _input_matrix)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, sk_fn, extra, inputs, average, num_classes, ddp):
+        sk_average = "binary" if inputs is _binary_prob_inputs else average
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t: _sk_wrapper(p, t, partial(sk_fn, average=sk_average), sk_average, num_classes),
+            metric_args={"threshold": THRESHOLD, "average": average, "num_classes": num_classes, **extra},
+        )
+
+    @pytest.mark.parametrize("metric_class, metric_fn, sk_fn, extra", _metric_matrix)
+    @pytest.mark.parametrize("inputs, average, num_classes", _input_matrix)
+    def test_functional(self, metric_class, metric_fn, sk_fn, extra, inputs, average, num_classes):
+        sk_average = "binary" if inputs is _binary_prob_inputs else average
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=metric_fn,
+            sk_metric=lambda p, t: _sk_wrapper(p, t, partial(sk_fn, average=sk_average), sk_average, num_classes),
+            metric_args={"threshold": THRESHOLD, "average": average, "num_classes": num_classes, **extra},
+        )
+
+
+def test_specificity_vs_manual():
+    """Specificity micro/macro against a direct tn/(tn+fp) computation."""
+    preds = np.asarray([2, 0, 2, 1])
+    target = np.asarray([1, 1, 2, 0])
+    # per-class one-hot stats for 3 classes
+    tn = np.array([2, 1, 2])
+    fp = np.array([1, 1, 1])
+    expected_macro = np.mean(tn / (tn + fp))
+    got = specificity(jnp.asarray(preds), jnp.asarray(target), average="macro", num_classes=3)
+    assert float(got) == pytest.approx(float(expected_macro), abs=1e-6)
+
+    cls = Specificity(average="macro", num_classes=3)
+    assert float(cls(jnp.asarray(preds), jnp.asarray(target))) == pytest.approx(float(expected_macro), abs=1e-6)
+
+
+def test_precision_recall_joint():
+    preds = _multiclass_prob_inputs.preds[0]
+    target = _multiclass_prob_inputs.target[0]
+    p, r = precision_recall(preds, target, average="macro", num_classes=NUM_CLASSES)
+    p2 = precision(preds, target, average="macro", num_classes=NUM_CLASSES)
+    r2 = recall(preds, target, average="macro", num_classes=NUM_CLASSES)
+    assert float(p) == float(p2)
+    assert float(r) == float(r2)
+
+
+def test_per_class_none_average():
+    preds = _multiclass_inputs.preds[0]
+    target = _multiclass_inputs.target[0]
+    got = recall(preds, target, average="none", num_classes=NUM_CLASSES)
+    expected = sk_recall(np.asarray(target), np.asarray(preds), average=None, labels=list(range(NUM_CLASSES)), zero_division=0)
+    np.testing.assert_allclose(np.asarray(got), expected, atol=1e-6)
+
+
+def test_micro_fbeta_ignore_index_excludes_class():
+    """micro F-score with ignore_index drops the ignored class column
+    (regression: it was silently ignored before)."""
+    got = f1_score(jnp.asarray([0, 2, 1]), jnp.asarray([0, 1, 2]), average="micro", num_classes=3, ignore_index=0)
+    assert float(got) == 0.0
+
+
+def test_specificity_none_absent_class_nan():
+    got = specificity(jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 1, 1, 0]), average="none", num_classes=3)
+    assert np.isnan(np.asarray(got)[2])
+
+
+def test_specificity_macro_no_absent_filtering():
+    """Reference has no macro absent-class branch: all-tp classes score via
+    zero_division, not exclusion."""
+    got = specificity(jnp.asarray([1, 1, 1]), jnp.asarray([1, 1, 1]), average="macro", num_classes=2)
+    assert float(got) == pytest.approx(0.5)
+
+
+def test_negative_ignore_index_rejected():
+    with pytest.raises(ValueError, match="not valid"):
+        precision(jnp.asarray([0, 1]), jnp.asarray([0, 1]), average="macro", num_classes=3, ignore_index=-1)
