@@ -44,7 +44,15 @@ from metrics_tpu.classification import (  # noqa: E402, F401
     Specificity,
     StatScores,
 )
+from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
+from metrics_tpu.wrappers import (  # noqa: E402, F401
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
 
 __all__ = [
     "AUC",
@@ -72,8 +80,14 @@ __all__ = [
     "Precision",
     "Recall",
     "Specificity",
+    "BootStrapper",
     "CatMetric",
+    "ClasswiseWrapper",
     "CompositionalMetric",
+    "MetricCollection",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
     "MaxMetric",
     "MeanMetric",
     "Metric",

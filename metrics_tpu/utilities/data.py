@@ -129,6 +129,25 @@ def _bincount(x: Array, minlength: int) -> Array:
     return jnp.bincount(x.reshape(-1), length=minlength)
 
 
+def _flatten_dict(x: Mapping) -> dict:
+    """Flatten one level of dict nesting (dict-valued metric results inside a
+    collection get spliced into the top-level result namespace)."""
+    out: dict = {}
+    for key, value in x.items():
+        if isinstance(value, Mapping):
+            out.update(value)
+        else:
+            out[key] = value
+    return out
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    """Host-level allclose over two arrays (dtype-promoting, shape-strict)."""
+    if a.shape != b.shape:
+        return False
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))
+
+
 def _squeeze_scalar_element_tensor(x: Array) -> Array:
     return x.reshape(()) if x.size == 1 else x
 

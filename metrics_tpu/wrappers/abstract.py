@@ -1,0 +1,58 @@
+"""WrapperMetric — lifecycle-correct base for metrics that wrap metrics.
+
+The reference has no such base class; its wrappers inherit the plain
+``Metric.forward`` whose state cache covers only ``self._defaults``
+(reference ``metric.py:258``) — child-metric state (where wrappers actually
+accumulate) is reset and never restored, so a reference wrapper's ``forward``
+silently drops history. Here the snapshot/restore used by both ``forward``
+and ``sync_context`` recurses into wrapped child metrics, making the fused
+batch-value path safe for wrappers.
+"""
+from typing import Any, Dict, Iterator, List, Union
+
+import jax
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WrapperMetric(Metric):
+    """Base class for wrapper metrics; children join the lifecycle snapshot.
+
+    Child metrics are discovered on instance attributes: a ``Metric``, a
+    list/tuple of metrics, or a ``MetricCollection``.
+    """
+
+    full_state_update = True
+
+    def _wrapped_metrics(self) -> Iterator[Metric]:
+        for value in self.__dict__.values():
+            if isinstance(value, Metric):
+                yield value
+            elif isinstance(value, MetricCollection):
+                yield from value.values(copy_state=False)
+            elif isinstance(value, (list, tuple)):
+                yield from (m for m in value if isinstance(m, Metric))
+
+    def _snapshot_state(self) -> Dict[str, Union[Array, List]]:
+        snap = super()._snapshot_state()
+        snap["__children__"] = [(c._snapshot_state(), c._update_count) for c in self._wrapped_metrics()]
+        return snap
+
+    def _restore_state(self, cache: Dict[str, Union[Array, List]]) -> None:
+        super()._restore_state({k: v for k, v in cache.items() if k != "__children__"})
+        for child, (child_snap, child_count) in zip(self._wrapped_metrics(), cache.get("__children__", [])):
+            child._restore_state(child_snap)
+            child._update_count = child_count
+            child._computed = None
+
+    def reset(self) -> None:
+        super().reset()
+        for child in self._wrapped_metrics():
+            child.reset()
+
+    def _invalidate(self) -> None:
+        """Drop the cached compute value after an out-of-band state change."""
+        self._computed = None

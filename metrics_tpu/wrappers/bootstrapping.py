@@ -1,0 +1,127 @@
+"""BootStrapper — bootstrap confidence intervals around any metric.
+
+Behavioral equivalent of reference ``torchmetrics/wrappers/bootstrapping.py:48``
+(``BootStrapper``; sampler ``:25``): keeps ``num_bootstraps`` independent
+copies of a base metric; every ``update`` feeds each copy a resampled version
+of the batch (poisson or multinomial bootstrap); ``compute`` reports
+mean/std/quantile/raw over the copies' values.
+
+TPU notes: resample *indices* are drawn host-side with numpy (cheap, O(batch))
+so each copy's jitted ``update`` kernel still sees a static batch shape for
+the ``"multinomial"`` strategy. The ``"poisson"`` strategy produces a
+variable-size resample by construction (reference semantics); its gather is
+built host-side and the inner metric update remains jitted per unique shape.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.Generator) -> np.ndarray:
+    """Draw resample row indices (reference ``wrappers/bootstrapping.py:25``)."""
+    if sampling_strategy == "poisson":
+        p = rng.poisson(1, size)
+        return np.repeat(np.arange(size), p)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Compute bootstrapped statistics of a base metric.
+
+    Args:
+        base_metric: the metric to bootstrap.
+        num_bootstraps: number of independent resampled copies.
+        mean / std / raw: which statistics ``compute`` returns.
+        quantile: optional quantile(s) of the bootstrap distribution.
+        sampling_strategy: ``"poisson"`` (sample counts ~ Poisson(1)) or
+            ``"multinomial"`` (sample-with-replacement to the same size).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import BootStrapper
+        >>> boot = BootStrapper(Accuracy(), num_bootstraps=20, seed=123)
+        >>> boot.update(jnp.asarray([0, 1, 2, 3]), jnp.asarray([0, 1, 2, 3]))
+        >>> sorted(boot.compute())
+        ['mean', 'std']
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self.base_metric = base_metric
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch once per bootstrap copy and update it."""
+        args_sizes = apply_to_collection(args, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
+        kwargs_sizes = apply_to_collection(kwargs, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = next(iter(kwargs_sizes.values()))
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+
+        for idx in range(self.num_bootstraps):
+            sample_idx = jnp.asarray(_bootstrap_sampler(size, self.sampling_strategy, self._rng))
+            if sample_idx.size == 0:  # poisson can draw an empty resample
+                continue
+            new_args = apply_to_collection(args, (jnp.ndarray, jax.Array), jnp.take, sample_idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, (jnp.ndarray, jax.Array), jnp.take, sample_idx, axis=0)
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Statistics over the bootstrap copies' computed values."""
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output: Dict[str, Array] = {}
+        if self.mean:
+            output["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output

@@ -1,0 +1,49 @@
+"""ClasswiseWrapper — unroll per-class results into a flat dict.
+
+Behavioral equivalent of reference ``torchmetrics/wrappers/classwise.py:8``.
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(WrapperMetric):
+    """Wrap a per-class metric (``average=None``-style output) so ``compute``
+    returns ``{"metricname_label": scalar}`` entries.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        >>> metric.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        >>> sorted(metric.compute())
+        ['accuracy_0', 'accuracy_1', 'accuracy_2']
+    """
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())

@@ -1,0 +1,63 @@
+"""MinMaxMetric — track the running min/max of a base metric's value.
+
+Behavioral equivalent of reference ``torchmetrics/wrappers/minmax.py:23``;
+min/max are registered states (``dist_reduce_fx`` min/max) so they survive
+the forward snapshot/restore and sync correctly across processes — the
+reference keeps them as buffers outside its state registry.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MinMaxMetric(WrapperMetric):
+    """Report the base metric's value plus the min/max it has reached over
+    all ``compute`` calls.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import MinMaxMetric
+        >>> metric = MinMaxMetric(Accuracy())
+        >>> metric.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, 0]))
+        >>> result = metric.compute()
+        >>> sorted(result)
+        ['max', 'min', 'raw']
+    """
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Base value plus updated running min/max."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a scalar, but got {val}")
+        self.max_val = jnp.maximum(self.max_val, jnp.asarray(val, dtype=jnp.float32))
+        self.min_val = jnp.minimum(self.min_val, jnp.asarray(val, dtype=jnp.float32))
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jnp.ndarray, jax.Array)):
+            return val.size == 1
+        return False

@@ -1,0 +1,95 @@
+"""MultioutputWrapper — evaluate a base metric per output dimension.
+
+Behavioral equivalent of reference ``torchmetrics/wrappers/multioutput.py:23``
+(``MultioutputWrapper``; NaN-row removal helper ``:11``).
+"""
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where ANY input tensor has a NaN (reference ``multioutput.py:11``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel_nan_indices = None
+    for tensor in tensors:
+        permuted = tensor.reshape(tensor.shape[0], -1)
+        nan_indices = jnp.any(jnp.isnan(permuted), axis=1)
+        sentinel_nan_indices = nan_indices if sentinel_nan_indices is None else sentinel_nan_indices | nan_indices
+    return sentinel_nan_indices
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Clone a base metric per output along ``output_dim``; optionally drop
+    NaN rows per output before updating.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> from metrics_tpu.wrappers import MultioutputWrapper
+        >>> values = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
+        >>> mean_per_output = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        >>> mean_per_output.update(values)
+        >>> mean_per_output.compute().shape
+        (2,)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple]:
+        """Slice inputs along ``output_dim`` per output, with NaN-row removal."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = apply_to_collection(
+                args, (jnp.ndarray, jax.Array), jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
+            )
+            selected_kwargs = apply_to_collection(
+                kwargs, (jnp.ndarray, jax.Array), jnp.take, indices=jnp.asarray([i]), axis=self.output_dim
+            )
+            if self.remove_nans:
+                tensors = list(selected_args) + list(selected_kwargs.values())
+                if tensors:
+                    nan_idxs = np.asarray(_get_nan_indices(*tensors))
+                    keep = jnp.asarray(np.flatnonzero(~nan_idxs))
+                    selected_args = [jnp.take(arg, keep, axis=0) for arg in selected_args]
+                    selected_kwargs = {k: jnp.take(v, keep, axis=0) for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [arg.squeeze(self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: v.squeeze(self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stack per-output computed values."""
+        return jnp.stack([m.compute() for m in self.metrics], axis=0)

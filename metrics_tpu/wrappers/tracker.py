@@ -1,0 +1,142 @@
+"""MetricTracker — history of metric values over time (e.g. per epoch).
+
+Behavioral equivalent of reference ``torchmetrics/wrappers/tracker.py:25``:
+a list of snapshots of a base metric (or collection); ``increment`` starts a
+new timestep; ``compute_all``/``best_metric`` aggregate the history.
+"""
+from copy import deepcopy
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MetricTracker(WrapperMetric):
+    """Track a base metric over a sequence of timesteps.
+
+    Args:
+        metric: base ``Metric`` or ``MetricCollection`` to snapshot.
+        maximize: whether higher is better (bool, or list of bool per
+            collection member).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import MetricTracker
+        >>> tracker = MetricTracker(Accuracy())
+        >>> for epoch in range(3):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray([0, 1, 1]), jnp.asarray([0, 1, epoch % 2]))
+        >>> tracker.n_steps
+        3
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        super().__init__()
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a metrics_tpu `Metric` or `MetricCollection`"
+                f" but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of timesteps tracked."""
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Start a new timestep by snapshotting a fresh copy of the base."""
+        self._increment_called = True
+        self._invalidate()
+        self._metrics.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        self._invalidate()
+        self._update_count += 1
+        return self._metrics[-1](*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Value of the current (latest) timestep."""
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
+        """Values of every tracked timestep, stacked."""
+        self._check_for_increment("compute_all")
+        vals = [metric.compute() for metric in self._metrics]
+        if isinstance(vals[0], dict):  # MetricCollection or dict-returning base
+            keys = vals[0].keys()
+            return {k: jnp.stack([jnp.asarray(v[k]) for v in vals], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(v) for v in vals], axis=0)
+
+    def reset(self) -> None:
+        """Reset the CURRENT timestep's metric."""
+        self._invalidate()
+        if self._metrics:
+            self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset every tracked timestep."""
+        self._invalidate()
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Array, Tuple[Array, int], Dict[str, Array], Tuple[Dict[str, Array], Dict[str, int]]]:
+        """Best value over time (and optionally the step it occurred at)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            values: Dict[str, Array] = {}
+            steps: Dict[str, int] = {}
+            for (k, v), m in zip(res.items(), maximize):
+                try:
+                    arr = np.asarray(v)
+                    idx = int(np.argmax(arr) if m else np.argmin(arr))
+                    values[k], steps[k] = v[idx], idx
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f" {error}. Returning `None` instead.",
+                        UserWarning,
+                    )
+                    values[k], steps[k] = None, None  # type: ignore[assignment]
+            return (values, steps) if return_step else values
+        try:
+            arr = np.asarray(res)
+            idx = int(np.argmax(arr) if self.maximize else np.argmin(arr))
+            return (res[idx], idx) if return_step else res[idx]
+        except (ValueError, TypeError) as error:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {error}."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            return (None, None) if return_step else None  # type: ignore[return-value]
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

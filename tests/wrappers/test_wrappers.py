@@ -1,0 +1,254 @@
+"""Wrapper metric tests (BootStrapper, Classwise, MinMax, Multioutput, Tracker).
+
+Mirrors the semantics of reference ``tests/wrappers/test_{bootstrapping,
+classwise,minmax,multioutput,tracker}.py``.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanMetric,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Precision,
+    Recall,
+    SumMetric,
+)
+from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+
+class TestBootStrapper:
+    def test_sampler_poisson_and_multinomial(self):
+        rng = np.random.default_rng(0)
+        idx = _bootstrap_sampler(100, "multinomial", rng)
+        assert idx.shape == (100,)
+        assert idx.min() >= 0 and idx.max() < 100
+        idx = _bootstrap_sampler(100, "poisson", rng)
+        assert (np.diff(idx) >= 0).all()  # repeated arange is sorted
+
+    @pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+    def test_bootstrap_stats_close_to_true_value(self, sampling_strategy):
+        rng = np.random.default_rng(42)
+        n = 512
+        preds = jnp.asarray(rng.integers(0, 3, n))
+        target = jnp.asarray(np.where(rng.random(n) < 0.7, np.asarray(preds), rng.integers(0, 3, n)))
+        boot = BootStrapper(
+            Accuracy(), num_bootstraps=50, quantile=0.5, raw=True, sampling_strategy=sampling_strategy, seed=1
+        )
+        boot.update(preds, target)
+        out = boot.compute()
+        solo = Accuracy()
+        solo.update(preds, target)
+        true_val = float(solo.compute())
+        assert abs(float(out["mean"]) - true_val) < 0.05
+        assert float(out["std"]) < 0.1
+        assert out["raw"].shape == (50,)
+
+    def test_non_metric_raises(self):
+        with pytest.raises(ValueError):
+            BootStrapper(lambda x: x)
+
+    def test_bad_strategy_raises(self):
+        with pytest.raises(ValueError):
+            BootStrapper(Accuracy(), sampling_strategy="bogus")
+
+
+class TestClasswiseWrapper:
+    def test_keys_without_labels(self):
+        metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        metric.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        res = metric.compute()
+        assert set(res.keys()) == {"accuracy_0", "accuracy_1", "accuracy_2"}
+
+    def test_keys_with_labels(self):
+        metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=["horse", "fish", "dog"])
+        metric.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        res = metric.compute()
+        assert set(res.keys()) == {"accuracy_horse", "accuracy_fish", "accuracy_dog"}
+
+    def test_values_match_unwrapped(self):
+        rng = np.random.default_rng(0)
+        preds, target = jnp.asarray(rng.integers(0, 3, 40)), jnp.asarray(rng.integers(0, 3, 40))
+        wrapped = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        solo = Accuracy(num_classes=3, average=None)
+        wrapped.update(preds, target)
+        solo.update(preds, target)
+        res, ref = wrapped.compute(), solo.compute()
+        for i in range(3):
+            np.testing.assert_allclose(res[f"accuracy_{i}"], ref[i])
+
+    def test_bad_args_raise(self):
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(lambda x: x)
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(Accuracy(), labels="notalist")
+
+
+class TestMinMaxMetric:
+    def test_tracks_min_max(self):
+        metric = MinMaxMetric(MeanMetric())
+        metric.update(jnp.asarray([2.0]))
+        out = metric.compute()
+        np.testing.assert_allclose(out["raw"], 2.0)
+        np.testing.assert_allclose(out["min"], 2.0)
+        np.testing.assert_allclose(out["max"], 2.0)
+        metric.update(jnp.asarray([8.0]))  # mean now 5
+        out = metric.compute()
+        np.testing.assert_allclose(out["raw"], 5.0)
+        np.testing.assert_allclose(out["max"], 5.0)
+        np.testing.assert_allclose(out["min"], 2.0)
+        metric.update(jnp.asarray([-7.0]))  # mean now 1
+        out = metric.compute()
+        np.testing.assert_allclose(out["raw"], 1.0)
+        np.testing.assert_allclose(out["min"], 1.0)
+        np.testing.assert_allclose(out["max"], 5.0)
+
+    def test_reset(self):
+        metric = MinMaxMetric(MeanMetric())
+        metric.update(jnp.asarray([2.0]))
+        metric.compute()
+        metric.reset()
+        assert float(metric.min_val) == float(jnp.inf)
+
+    def test_scalar_check(self):
+        metric = MinMaxMetric(Accuracy(num_classes=3, average=None))  # vector result
+        metric.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        with pytest.raises(RuntimeError, match="should be a scalar"):
+            metric.compute()
+
+    def test_non_metric_raises(self):
+        with pytest.raises(ValueError):
+            MinMaxMetric(lambda x: x)
+
+
+class TestMultioutputWrapper:
+    def test_multioutput_mean(self):
+        preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
+        metric = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        metric.update(preds)
+        np.testing.assert_allclose(metric.compute(), [2.0, 20.0])
+
+    def test_remove_nans(self):
+        preds = jnp.asarray([[1.0, 10.0], [jnp.nan, 20.0], [3.0, jnp.nan]])
+        metric = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        metric.update(preds)
+        np.testing.assert_allclose(metric.compute(), [2.0, 15.0])
+
+    def test_forward(self):
+        preds = jnp.asarray([[1.0, 10.0], [3.0, 30.0]])
+        metric = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        out = metric(preds)
+        np.testing.assert_allclose(out, [2.0, 20.0])
+
+
+class TestMetricTracker:
+    def test_lifecycle_and_best(self):
+        tracker = MetricTracker(MeanMetric(), maximize=True)
+        for vals in ([1.0], [5.0], [3.0]):
+            tracker.increment()
+            tracker.update(jnp.asarray(vals))
+        assert tracker.n_steps == 3
+        np.testing.assert_allclose(tracker.compute(), 3.0)
+        np.testing.assert_allclose(tracker.compute_all(), [1.0, 5.0, 3.0])
+        best, step = tracker.best_metric(return_step=True)
+        np.testing.assert_allclose(best, 5.0)
+        assert step == 1
+
+    def test_minimize(self):
+        tracker = MetricTracker(MeanMetric(), maximize=False)
+        for vals in ([1.0], [5.0]):
+            tracker.increment()
+            tracker.update(jnp.asarray(vals))
+        np.testing.assert_allclose(tracker.best_metric(), 1.0)
+
+    def test_collection_tracking(self):
+        tracker = MetricTracker(MetricCollection([SumMetric(), MeanMetric()]), maximize=[True, True])
+        for vals in ([1.0, 3.0], [5.0, 7.0]):
+            tracker.increment()
+            tracker.update(jnp.asarray(vals))
+        all_res = tracker.compute_all()
+        np.testing.assert_allclose(all_res["SumMetric"], [4.0, 12.0])
+        np.testing.assert_allclose(all_res["MeanMetric"], [2.0, 6.0])
+        best, steps = tracker.best_metric(return_step=True)
+        np.testing.assert_allclose(best["SumMetric"], 12.0)
+        assert steps["MeanMetric"] == 1
+
+    def test_update_before_increment_raises(self):
+        tracker = MetricTracker(MeanMetric())
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.update(jnp.asarray([1.0]))
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.compute()
+
+    def test_reset_current_only(self):
+        tracker = MetricTracker(SumMetric())
+        tracker.increment()
+        tracker.update(jnp.asarray([1.0]))
+        tracker.increment()
+        tracker.update(jnp.asarray([2.0]))
+        tracker.reset()
+        np.testing.assert_allclose(tracker.compute_all(), [1.0, 0.0])
+        tracker.reset_all()
+        np.testing.assert_allclose(tracker.compute_all(), [0.0, 0.0])
+
+    def test_bad_args(self):
+        with pytest.raises(TypeError):
+            MetricTracker(lambda x: x)
+        with pytest.raises(ValueError, match="should match the length"):
+            MetricTracker(MetricCollection([SumMetric(), MeanMetric()]), maximize=[True])
+
+
+class TestWrapperForwardLifecycle:
+    """Wrapper forward must accumulate history, not destroy it (the reference's
+    own wrappers drop child state on forward; ours must not)."""
+
+    def test_bootstrapper_forward_accumulates(self):
+        boot = BootStrapper(Accuracy(), num_bootstraps=30, seed=7)
+        boot(jnp.asarray([1, 1, 1, 1]), jnp.asarray([0, 0, 0, 0]))  # acc 0
+        boot(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))  # acc 1
+        out = boot.compute()
+        assert abs(float(out["mean"]) - 0.5) < 0.1
+
+    def test_minmax_forward_accumulates(self):
+        mm = MinMaxMetric(MeanMetric())
+        mm(jnp.asarray([2.0]))
+        mm(jnp.asarray([8.0]))
+        out = mm.compute()
+        np.testing.assert_allclose(out["raw"], 5.0)
+
+    def test_tracker_forward_invalidates_cache(self):
+        tr = MetricTracker(MeanMetric())
+        tr.increment()
+        tr(jnp.asarray([1.0]))
+        np.testing.assert_allclose(tr.compute(), 1.0)
+        tr(jnp.asarray([5.0]))
+        np.testing.assert_allclose(tr.compute(), 3.0)
+        tr.increment()
+        tr.update(jnp.asarray([7.0]))
+        np.testing.assert_allclose(tr.compute(), 7.0)
+
+    def test_classwise_forward_invalidates_cache(self):
+        cw = ClasswiseWrapper(Accuracy(num_classes=3, average=None))
+        cw.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 2]))
+        np.testing.assert_allclose(cw.compute()["accuracy_0"], 1.0)
+        cw(jnp.asarray([1, 1]), jnp.asarray([0, 0]))
+        np.testing.assert_allclose(cw.compute()["accuracy_0"], 1.0 / 3.0)
+
+    def test_multioutput_forward_invalidates_cache(self):
+        mo = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        mo.update(jnp.asarray([[1.0, 10.0]]))
+        np.testing.assert_allclose(mo.compute(), [1.0, 10.0])
+        mo(jnp.asarray([[3.0, 30.0]]))
+        np.testing.assert_allclose(mo.compute(), [2.0, 20.0])
+
+    def test_multioutput_forward_batch_value(self):
+        mo = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        mo.update(jnp.asarray([[1.0, 10.0]]))
+        out = mo(jnp.asarray([[3.0, 30.0]]))  # batch-local value
+        np.testing.assert_allclose(out, [3.0, 30.0])
