@@ -46,6 +46,20 @@ from metrics_tpu.classification import (  # noqa: E402, F401
 )
 from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
+from metrics_tpu.regression import (  # noqa: E402, F401
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
     ClasswiseWrapper,
@@ -83,6 +97,18 @@ __all__ = [
     "BootStrapper",
     "CatMetric",
     "ClasswiseWrapper",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
     "CompositionalMetric",
     "MetricCollection",
     "MetricTracker",

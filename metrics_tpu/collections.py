@@ -99,13 +99,11 @@ class MetricCollection(dict):
             for m in additional_metrics:
                 (metrics if isinstance(m, Metric) else remain).append(m)
             if remain:
-                raise ValueError(
-                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
-                )
+                raise ValueError(f"You have passed extra arguments {remain} which are not `Metric` instances.")
         elif additional_metrics:
             raise ValueError(
-                f"You have passes extra arguments {additional_metrics} which are not compatible"
-                f" with first passed dictionary {metrics} so they will be ignored."
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with the first passed dictionary {metrics}."
             )
 
         if isinstance(metrics, dict):
@@ -149,13 +147,20 @@ class MetricCollection(dict):
         """Initialize every metric as its own group; user-specified groups are
         validated (reference ``collections.py:131-157``)."""
         if isinstance(self._enable_compute_groups, list):
-            self._groups = {i: group for i, group in enumerate(self._enable_compute_groups)}
+            self._groups = {i: list(group) for i, group in enumerate(self._enable_compute_groups)}
+            covered = set()
             for group in self._groups.values():
                 for name in group:
                     if name not in self:
                         raise ValueError(
                             f"Input {name} in `compute_groups` argument does not match a metric in the collection."
                         )
+                    covered.add(name)
+            # metrics absent from the user's groups still need updating:
+            # give each its own singleton group
+            for name in self.keys(keep_base=True):
+                if name not in covered:
+                    self._groups[len(self._groups)] = [name]
             self._groups_checked = True
         else:
             self._groups = {i: [name] for i, name in enumerate(self.keys(keep_base=True))}

@@ -22,8 +22,40 @@ from metrics_tpu.functional.classification.ranking import (  # noqa: F401
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance  # noqa: F401
+from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity  # noqa: F401
+from metrics_tpu.functional.pairwise.manhattan import pairwise_manhattan_distance  # noqa: F401
+from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity  # noqa: F401
+from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
+from metrics_tpu.functional.regression.log_mse import mean_squared_log_error  # noqa: F401
+from metrics_tpu.functional.regression.mae import mean_absolute_error  # noqa: F401
+from metrics_tpu.functional.regression.mape import mean_absolute_percentage_error  # noqa: F401
+from metrics_tpu.functional.regression.mse import mean_squared_error  # noqa: F401
+from metrics_tpu.functional.regression.pearson import pearson_corrcoef  # noqa: F401
+from metrics_tpu.functional.regression.r2 import r2_score  # noqa: F401
+from metrics_tpu.functional.regression.spearman import spearman_corrcoef  # noqa: F401
+from metrics_tpu.functional.regression.symmetric_mape import symmetric_mean_absolute_percentage_error  # noqa: F401
+from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score  # noqa: F401
+from metrics_tpu.functional.regression.wmape import weighted_mean_absolute_percentage_error  # noqa: F401
 
 __all__ = [
+    "cosine_similarity",
+    "explained_variance",
+    "mean_absolute_error",
+    "mean_absolute_percentage_error",
+    "mean_squared_error",
+    "mean_squared_log_error",
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pearson_corrcoef",
+    "r2_score",
+    "spearman_corrcoef",
+    "symmetric_mean_absolute_percentage_error",
+    "tweedie_deviance_score",
+    "weighted_mean_absolute_percentage_error",
     "accuracy",
     "auc",
     "auroc",

@@ -1,0 +1,51 @@
+"""Pairwise cosine similarity.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/pairwise/cosine.py`` (update :23, public :46). The
+core is one [N,d]x[d,M] matmul over row-normalized inputs — MXU-friendly.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = _to_float(x)
+    y = _to_float(y)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = x @ y.T
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity between rows of ``x`` and ``y`` (or ``x``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_cosine_similarity(x, y)
+        Array([[0.5547002 , 0.86824316],
+               [0.51449573, 0.8436959 ],
+               [0.5299989 , 0.85334015]], dtype=float32)
+    """
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,51 @@
+"""Pairwise euclidean distance.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/pairwise/euclidean.py`` (update :22, public :41)
+via the ||x||^2 + ||y||^2 - 2 x.y expansion (one matmul, MXU-friendly).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = _to_float(x)
+    y = _to_float(y)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    distance = x_norm + y_norm - 2 * (x @ y.T)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return jnp.sqrt(jnp.clip(distance, min=0.0))
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise euclidean distance between rows of ``x`` and ``y`` (or ``x``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_euclidean_distance(x, y)
+        Array([[3.1622777, 2.       ],
+               [5.3851647, 4.1231055],
+               [8.944272 , 7.615773 ]], dtype=float32)
+    """
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

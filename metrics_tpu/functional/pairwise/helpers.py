@@ -1,0 +1,50 @@
+"""Shared input validation / reduction for pairwise kernels.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/pairwise/helpers.py`` (``_check_input`` :19,
+``_reduce_distance_matrix`` :46).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None) -> Tuple[Array, Array, bool]:
+    """Validate [N,d]/[M,d] shapes; default ``zero_diagonal`` to the x-vs-x case."""
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _zero_diagonal(distance: Array) -> Array:
+    """Zero out the diagonal of a square distance matrix (functional form of
+    the reference's in-place ``fill_diagonal_``)."""
+    n, m = distance.shape
+    mask = jnp.eye(n, m, dtype=bool)
+    return jnp.where(mask, 0.0, distance)
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Reduce a [N,M] distance matrix along its last dimension."""
+    if reduction == "mean":
+        return distmat.mean(axis=-1)
+    if reduction == "sum":
+        return distmat.sum(axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")

@@ -1,0 +1,47 @@
+"""Pairwise linear (dot-product) similarity.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/pairwise/linear.py`` (update :22, public :40).
+"""
+from typing import Optional
+
+import jax
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = _to_float(x)
+    y = _to_float(y)
+    distance = x @ y.T
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise dot-product similarity between rows of ``x`` and ``y`` (or ``x``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_linear_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  7.],
+               [ 3., 11.],
+               [ 5., 18.]], dtype=float32)
+    """
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

@@ -1,0 +1,49 @@
+"""Pairwise manhattan (L1) distance.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/pairwise/manhattan.py`` (update :22, public :40).
+The |x_i - y_j| sum is computed via a broadcasted [N,1,d]-[M,d] difference.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = _to_float(x)
+    y = _to_float(y)
+    distance = jnp.sum(jnp.abs(x[:, None] - y[None, :]), axis=-1)
+    if zero_diagonal:
+        distance = _zero_diagonal(distance)
+    return distance
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise L1 distance between rows of ``x`` and ``y`` (or ``x``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_manhattan_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0], [5.0, 8.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[ 4.,  2.],
+               [ 7.,  5.],
+               [12., 10.]], dtype=float32)
+    """
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

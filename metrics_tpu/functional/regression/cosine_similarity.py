@@ -1,0 +1,51 @@
+"""Cosine similarity (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/cosine_similarity.py`` (update :22,
+compute :41).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate shapes and cast to float."""
+    _check_same_shape(preds, target)
+    return _to_float(preds), _to_float(target)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Row-wise cosine similarity with batch reduction."""
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {
+        "sum": jnp.sum,
+        "mean": jnp.mean,
+        "none": lambda x: x,
+        None: lambda x: x,
+    }
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Compute cosine similarity between row vectors of ``preds`` and ``target``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cosine_similarity
+        >>> target = jnp.asarray([[1.0, 2, 3, 4], [1, 2, 3, 4]])
+        >>> preds = jnp.asarray([[1.0, 2, 3, 4], [-1, -2, -3, -4]])
+        >>> cosine_similarity(preds, target, 'none')
+        Array([ 1., -1.], dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,84 @@
+"""Explained variance (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/explained_variance.py`` (update :22,
+compute :44). The compute re-expresses the reference's boolean-mask
+assignments as ``jnp.where`` selects so the kernel stays jittable.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Batch -> (n, sum error, sum sq error, sum target, sum sq target)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Sufficient stats -> explained variance score."""
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    # perfect predictions score 1; zero target variance with nonzero error
+    # scores 0 (sklearn convention, mirrored from the reference :83-86)
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    output_scores = jnp.where(
+        nonzero_numerator & nonzero_denominator,
+        1.0 - numerator / jnp.where(nonzero_denominator, denominator, 1.0),
+        jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, 1.0),
+    )
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+        f" Received {multioutput}."
+    )
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Compute explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> target = jnp.asarray([3.0, -0.5, 2, 7])
+        >>> preds = jnp.asarray([2.5, 0.0, 2, 8])
+        >>> explained_variance(preds, target)
+        Array(0.95717883, dtype=float32)
+    """
+    n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput
+    )

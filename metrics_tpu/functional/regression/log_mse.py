@@ -1,0 +1,43 @@
+"""Mean squared logarithmic error (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/log_mse.py`` (update :22, compute :38).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Batch -> (sum of squared log errors, observation count)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    diff = jnp.log1p(preds) - jnp.log1p(target)
+    sum_squared_log_error = jnp.sum(diff * diff)
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Compute mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> x = jnp.asarray([0.0, 1, 2, 3])
+        >>> y = jnp.asarray([0.0, 1, 2, 2])
+        >>> mean_squared_log_error(x, y)
+        Array(0.02069722, dtype=float32)
+    """
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,42 @@
+"""Mean absolute error (functional).
+
+Behavioral equivalent of reference ``torchmetrics/functional/regression/mae.py``
+(update :22, compute :40).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Batch -> (sum of absolute errors, observation count)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Compute mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_error
+        >>> x = jnp.asarray([0.0, 1, 2, 3])
+        >>> y = jnp.asarray([0.0, 1, 2, 1])
+        >>> mean_absolute_error(x, y)
+        Array(0.5, dtype=float32)
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

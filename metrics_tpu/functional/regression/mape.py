@@ -1,0 +1,45 @@
+"""Mean absolute percentage error (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/mape.py`` (update :22, compute :50;
+epsilon 1.17e-06 follows sklearn's MAPE).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """Batch -> (sum of absolute percentage errors, observation count)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, n_obs) -> Array:
+    return sum_abs_per_error / n_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> target = jnp.asarray([1.0, 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> mean_absolute_percentage_error(preds, target)
+        Array(0.26666668, dtype=float32)
+    """
+    sum_abs_per_error, n_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs)

@@ -1,0 +1,46 @@
+"""Mean squared error (functional).
+
+Behavioral equivalent of reference ``torchmetrics/functional/regression/mse.py``
+(update :22, compute :38). Pure ``(preds, target) -> sufficient stats`` kernels,
+fully jit-traceable.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Batch -> (sum of squared errors, observation count)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff)
+    return sum_squared_error, target.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool = True) -> Array:
+    """Sufficient stats -> MSE (or RMSE when ``squared=False``)."""
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Compute mean squared error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> x = jnp.asarray([0.0, 1, 2, 3])
+        >>> y = jnp.asarray([0.0, 1, 2, 2])
+        >>> mean_squared_error(x, y)
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

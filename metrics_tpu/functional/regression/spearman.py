@@ -1,0 +1,84 @@
+"""Spearman rank correlation (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/spearman.py`` (``_rank_data`` :35,
+update :56, compute :79). The reference averages tied ranks with a Python
+loop over repeated values (:48-51); here tie-averaging is a fully jittable
+sort + segment-sum kernel (O(n log n), no host round-trips) — the TPU-first
+reformulation called for in SURVEY.md §7.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data(data: Array) -> Array:
+    """Rank elements 1..n, ties receiving the mean of their ordinal ranks."""
+    n = data.size
+    order = jnp.argsort(data)
+    sorted_data = data[order]
+    ordinal = jnp.arange(1, n + 1, dtype=jnp.float32)
+
+    # Equal-value runs share one segment id; each tied element gets the mean
+    # ordinal rank of its run via two segment sums.
+    change = jnp.concatenate([jnp.asarray([True]), sorted_data[1:] != sorted_data[:-1]])
+    seg_id = jnp.cumsum(change) - 1
+    seg_sum = jax.ops.segment_sum(ordinal, seg_id, num_segments=n)
+    seg_cnt = jax.ops.segment_sum(jnp.ones_like(ordinal), seg_id, num_segments=n)
+    mean_rank = seg_sum / jnp.maximum(seg_cnt, 1.0)
+
+    ranks_sorted = mean_rank[seg_id]
+    return jnp.zeros(n, dtype=jnp.float32).at[order].set(ranks_sorted)
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate and flatten inputs."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    preds = preds.squeeze()
+    target = target.squeeze()
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    """Pearson correlation over the rank-transformed inputs."""
+    preds = _rank_data(preds)
+    target = _rank_data(target)
+
+    preds_diff = preds - preds.mean()
+    target_diff = target - target.mean()
+
+    cov = (preds_diff * target_diff).mean()
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean())
+    target_std = jnp.sqrt((target_diff * target_diff).mean())
+
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute Spearman's rank correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spearman_corrcoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> spearman_corrcoef(preds, target)
+        Array(1., dtype=float32)
+    """
+    preds, target = _spearman_corrcoef_update(preds, target)
+    return _spearman_corrcoef_compute(preds, target)

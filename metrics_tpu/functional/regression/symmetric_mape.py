@@ -1,0 +1,45 @@
+"""Symmetric mean absolute percentage error (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/symmetric_mape.py`` (update :22,
+compute :51).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """Batch -> (2 * sum of symmetric percentage errors, observation count)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, n_obs) -> Array:
+    return sum_abs_per_error / n_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute symmetric mean absolute percentage error (SMAPE).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> target = jnp.asarray([1.0, 10, 1e6])
+        >>> preds = jnp.asarray([0.9, 15, 1.2e6])
+        >>> symmetric_mean_absolute_percentage_error(preds, target)
+        Array(0.2290271, dtype=float32)
+    """
+    sum_abs_per_error, n_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, n_obs)

@@ -1,0 +1,45 @@
+"""Weighted mean absolute percentage error (functional).
+
+Behavioral equivalent of reference
+``torchmetrics/functional/regression/wmape.py`` (update :22, compute :43).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import _to_float
+
+Array = jax.Array
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Batch -> (sum of absolute errors, sum of absolute targets)."""
+    _check_same_shape(preds, target)
+    preds = _to_float(preds)
+    target = _to_float(target)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    sum_scale = jnp.sum(jnp.abs(target))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute weighted mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> target = jnp.asarray([1.0, 10.0, 1e6])
+        >>> preds = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> weighted_mean_absolute_percentage_error(preds, target)
+        Array(0.2000051, dtype=float32)
+    """
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

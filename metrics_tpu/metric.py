@@ -231,6 +231,14 @@ class Metric(ABC):
                 merged = (acc * n + new) / (n + 1) if n > 0 else new
             elif reduce_fx is None:
                 merged = new  # keep the newest value
+            elif reduce_fx == "sum":
+                # broadcasting binary ops: a scalar default merges cleanly
+                # with a vector batch state (e.g. multioutput sums)
+                merged = acc + new
+            elif reduce_fx == "max":
+                merged = jnp.maximum(acc, new)
+            elif reduce_fx == "min":
+                merged = jnp.minimum(acc, new)
             else:
                 merged = _apply_reduction(reduce_fx, [acc, new])
             setattr(self, name, merged)

@@ -1,0 +1,70 @@
+"""PearsonCorrCoef metric class.
+
+Behavioral equivalent of reference ``torchmetrics/regression/pearson.py:55``:
+six scalar moment states with ``dist_reduce_fx=None`` (sync stacks per-rank
+values) merged at compute by the parallel-variance formula
+(``_final_aggregation``, reference ``regression/pearson.py:23-54``) — the
+custom cross-device reduction pattern SURVEY.md §2.5 calls out.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation via streaming moments (O(1) state per device).
+
+    Update folds each batch into running mean/variance/covariance, so the
+    state is six scalars regardless of sample count; cross-device sync
+    gathers the per-device moment sets and merges them pairwise at compute.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> pearson = PearsonCorrCoef()
+        >>> pearson(preds, target)
+        Array(0.98488414, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None  # both -1 and 1 are optimal
+    # Running-moment updates consume the prior state, so the fused
+    # batch-stats forward path does not apply (reference runs the
+    # double-update, metric.py:248-264).
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("mean_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.asarray(0.0), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def compute(self) -> Array:
+        if jnp.asarray(self.mean_x).ndim > 0 and jnp.asarray(self.mean_x).size > 1:
+            # synced: leading dim is the device axis -> parallel moment merge
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -47,6 +47,12 @@ def _flatten(x: Sequence) -> list:
     return [item for sublist in x for item in sublist]
 
 
+def _to_float(x: Array) -> Array:
+    """Cast integer/bool arrays to float32, pass floats through unchanged."""
+    x = jnp.asarray(x)
+    return x if jnp.issubdtype(x.dtype, jnp.floating) else x.astype(jnp.float32)
+
+
 def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     """Convert a dense label tensor ``(N, ...)`` to one-hot ``(N, C, ...)``.
 

@@ -1,9 +1,12 @@
 """MinMaxMetric — track the running min/max of a base metric's value.
 
-Behavioral equivalent of reference ``torchmetrics/wrappers/minmax.py:23``;
-min/max are registered states (``dist_reduce_fx`` min/max) so they survive
-the forward snapshot/restore and sync correctly across processes — the
-reference keeps them as buffers outside its state registry.
+Behavioral equivalent of reference ``torchmetrics/wrappers/minmax.py:23``.
+``min_val``/``max_val`` are deliberately NOT registered states: they are
+derived from the base metric's ``compute()`` value, which is already
+cross-process synced, so every rank advances them identically — and keeping
+them outside the state registry means they survive both the ``forward``
+snapshot/restore cycle and ``reset`` (min/max track the whole experiment,
+like the reference's buffers, which its ``Metric.reset`` never restores).
 """
 from typing import Any, Dict
 
@@ -39,8 +42,8 @@ class MinMaxMetric(WrapperMetric):
                 f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
             )
         self._base_metric = base_metric
-        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
-        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
@@ -50,8 +53,9 @@ class MinMaxMetric(WrapperMetric):
         val = self._base_metric.compute()
         if not self._is_suitable_val(val):
             raise RuntimeError(f"Returned value from base metric should be a scalar, but got {val}")
-        self.max_val = jnp.maximum(self.max_val, jnp.asarray(val, dtype=jnp.float32))
-        self.min_val = jnp.minimum(self.min_val, jnp.asarray(val, dtype=jnp.float32))
+        val32 = jnp.asarray(val, dtype=jnp.float32)
+        self.max_val = jnp.maximum(self.max_val, val32)
+        self.min_val = jnp.minimum(self.min_val, val32)
         return {"raw": val, "max": self.max_val, "min": self.min_val}
 
     @staticmethod

@@ -50,8 +50,11 @@ class MetricTracker(WrapperMetric):
         self._base_metric = metric
         if not isinstance(maximize, (bool, list)):
             raise ValueError("Argument `maximize` should either be a single bool or list of bool")
-        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
-            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(maximize, list):
+            if not isinstance(metric, MetricCollection):
+                raise ValueError("Argument `maximize` can only be a list when `metric` is a `MetricCollection`")
+            if len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
         self.maximize = maximize
         self._metrics: List[Union[Metric, MetricCollection]] = []
         self._increment_called = False

@@ -203,6 +203,19 @@ class TestComputeGroups:
         with pytest.raises(ValueError, match="does not match a metric"):
             MetricCollection([Accuracy()], compute_groups=[["Nope"]])
 
+    def test_user_specified_groups_partial_coverage(self):
+        # metrics missing from the user's compute_groups must still update
+        # (as singleton groups), not be silently skipped
+        preds, target = _sample()
+        mc = MetricCollection(
+            [Accuracy(), Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")],
+            compute_groups=[["Precision", "Recall"]],
+        )
+        mc.update(preds, target)
+        solo = Accuracy()
+        solo.update(preds, target)
+        np.testing.assert_allclose(mc.compute()["Accuracy"], solo.compute())
+
     def test_confmat_family_grouped(self):
         preds, target = _sample()
         mc = MetricCollection([ConfusionMatrix(num_classes=3), CohenKappa(num_classes=3)])

@@ -1,0 +1,67 @@
+"""Pairwise kernels vs sklearn oracles
+(reference ``tests/pairwise/test_pairwise_distance.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+_rng = np.random.default_rng(17)
+_x = jnp.asarray(_rng.random((10, 6)), dtype=jnp.float32)
+_y = jnp.asarray(_rng.random((8, 6)), dtype=jnp.float32)
+
+_kernels = [
+    pytest.param(pairwise_cosine_similarity, sk_cosine, id="cosine"),
+    pytest.param(pairwise_euclidean_distance, sk_euclidean, id="euclidean"),
+    pytest.param(pairwise_linear_similarity, sk_linear, id="linear"),
+    pytest.param(pairwise_manhattan_distance, sk_manhattan, id="manhattan"),
+]
+
+
+@pytest.mark.parametrize("metric_fn, sk_fn", _kernels)
+@pytest.mark.parametrize("reduction", [None, "mean", "sum"])
+def test_pairwise_xy(metric_fn, sk_fn, reduction):
+    result = metric_fn(_x, _y, reduction=reduction)
+    expected = sk_fn(np.asarray(_x), np.asarray(_y))
+    if reduction == "mean":
+        expected = expected.mean(-1)
+    elif reduction == "sum":
+        expected = expected.sum(-1)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("metric_fn, sk_fn", _kernels)
+def test_pairwise_x_only_zero_diagonal(metric_fn, sk_fn):
+    result = metric_fn(_x)
+    expected = sk_fn(np.asarray(_x), np.asarray(_x))
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("metric_fn, sk_fn", _kernels)
+def test_pairwise_keep_diagonal(metric_fn, sk_fn):
+    result = metric_fn(_x, zero_diagonal=False)
+    expected = sk_fn(np.asarray(_x), np.asarray(_x))
+    # the ||x||^2+||y||^2-2xy expansion leaves sqrt(eps) on the self-distance
+    # diagonal in float32, so compare at a looser absolute tolerance
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-3, rtol=1e-4)
+
+
+def test_pairwise_input_errors():
+    with pytest.raises(ValueError, match="Expected argument `x`.*"):
+        pairwise_cosine_similarity(jnp.ones(5))
+    with pytest.raises(ValueError, match="Expected argument `y`.*"):
+        pairwise_cosine_similarity(jnp.ones((5, 2)), jnp.ones((5, 3)))
+    with pytest.raises(ValueError, match="Expected reduction.*"):
+        pairwise_cosine_similarity(jnp.ones((5, 2)), reduction="bad")

@@ -1,0 +1,91 @@
+"""Pearson / Spearman correlation vs scipy oracles
+(reference ``tests/regression/test_pearson.py`` / ``test_spearman.py``)."""
+from collections import namedtuple
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+
+from metrics_tpu.functional import pearson_corrcoef, spearman_corrcoef
+from metrics_tpu.regression import PearsonCorrCoef, SpearmanCorrCoef
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(11)
+
+_inputs_float = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+)
+
+# heavy ties to exercise the tie-averaged rank kernel
+_inputs_ties = Input(
+    preds=jnp.asarray(_rng.integers(0, 5, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)),
+    target=jnp.asarray(_rng.integers(0, 5, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)),
+)
+
+
+def _sk_pearson(preds, target):
+    return pearsonr(np.asarray(target).ravel(), np.asarray(preds).ravel())[0]
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(np.asarray(target).ravel(), np.asarray(preds).ravel())[0]
+
+
+@pytest.mark.parametrize("inputs", [_inputs_float, _inputs_ties], ids=["float", "ties"])
+class TestPearsonCorrCoef(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson_class(self, inputs, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=PearsonCorrCoef,
+            sk_metric=_sk_pearson,
+        )
+
+    def test_pearson_functional(self, inputs):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=pearson_corrcoef,
+            sk_metric=_sk_pearson,
+        )
+
+
+@pytest.mark.parametrize("inputs", [_inputs_float, _inputs_ties], ids=["float", "ties"])
+class TestSpearmanCorrCoef(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_spearman_class(self, inputs, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=SpearmanCorrCoef,
+            sk_metric=_sk_spearman,
+        )
+
+    def test_spearman_functional(self, inputs):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=spearman_corrcoef,
+            sk_metric=_sk_spearman,
+        )
+
+
+def test_spearman_dtype_mismatch_raises():
+    with pytest.raises(TypeError, match="Expected `preds` and `target` to have the same data type.*"):
+        spearman_corrcoef(jnp.ones(5, dtype=jnp.float32), jnp.ones(5, dtype=jnp.int32))
+
+
+def test_spearman_ndim_raises():
+    with pytest.raises(ValueError, match="Expected both predictions and target.*"):
+        spearman_corrcoef(jnp.ones((5, 2)), jnp.ones((5, 2)))

@@ -1,0 +1,108 @@
+"""CosineSimilarity / TweedieDevianceScore vs sklearn oracles
+(reference ``tests/regression/test_cosine_similarity.py`` /
+``test_tweedie_deviance.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import mean_tweedie_deviance as sk_tweedie
+
+from metrics_tpu.functional import cosine_similarity, tweedie_deviance_score
+from metrics_tpu.regression import CosineSimilarity, TweedieDevianceScore
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(13)
+
+_cosine_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 8)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 8)), dtype=jnp.float32),
+)
+
+# strictly positive values keep every tweedie power in-domain
+_tweedie_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)) + 0.1, dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)) + 0.1, dtype=jnp.float32),
+)
+
+
+def _sk_cosine(preds, target, reduction="sum"):
+    preds, target = np.asarray(preds, dtype=np.float64), np.asarray(target, dtype=np.float64)
+    sim = (preds * target).sum(-1) / (np.linalg.norm(preds, axis=-1) * np.linalg.norm(target, axis=-1))
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    return sim
+
+
+def _sk_tweedie_score(preds, target, power=0.0):
+    return sk_tweedie(np.asarray(target).ravel(), np.asarray(preds).ravel(), power=power)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean"])
+class TestCosineSimilarity(MetricTester):
+    atol = 1e-3  # sum over many float32 row-similarities
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_cosine_class(self, reduction, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_cosine_inputs.preds,
+            target=_cosine_inputs.target,
+            metric_class=CosineSimilarity,
+            sk_metric=partial(_sk_cosine, reduction=reduction),
+            metric_args={"reduction": reduction},
+        )
+
+    def test_cosine_functional(self, reduction):
+        self.run_functional_metric_test(
+            preds=_cosine_inputs.preds,
+            target=_cosine_inputs.target,
+            metric_functional=cosine_similarity,
+            sk_metric=partial(_sk_cosine, reduction=reduction),
+            metric_args={"reduction": reduction},
+        )
+
+
+@pytest.mark.parametrize("power", [-0.5, 0, 1, 1.5, 2, 3])
+class TestTweedieDevianceScore(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_tweedie_class(self, power, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_tweedie_inputs.preds,
+            target=_tweedie_inputs.target,
+            metric_class=TweedieDevianceScore,
+            sk_metric=partial(_sk_tweedie_score, power=power),
+            metric_args={"power": power},
+        )
+
+    def test_tweedie_functional(self, power):
+        self.run_functional_metric_test(
+            preds=_tweedie_inputs.preds,
+            target=_tweedie_inputs.target,
+            metric_functional=tweedie_deviance_score,
+            sk_metric=partial(_sk_tweedie_score, power=power),
+            metric_args={"power": power},
+        )
+
+
+def test_tweedie_invalid_power():
+    with pytest.raises(ValueError, match="Deviance Score is not defined for power=0.5."):
+        TweedieDevianceScore(power=0.5)
+
+
+def test_tweedie_domain_check():
+    with pytest.raises(ValueError, match="For power=1.*"):
+        tweedie_deviance_score(jnp.asarray([-1.0, 2.0]), jnp.asarray([1.0, 2.0]), power=1)
+
+
+def test_cosine_invalid_reduction():
+    with pytest.raises(ValueError, match="Expected argument `reduction`.*"):
+        CosineSimilarity(reduction="bad")

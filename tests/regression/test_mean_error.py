@@ -1,0 +1,134 @@
+"""Mean-error regression family vs sklearn/numpy oracles
+(reference ``tests/regression/test_mean_error.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    mean_absolute_error as sk_mean_absolute_error,
+    mean_absolute_percentage_error as sk_mean_abs_percentage_error,
+    mean_squared_error as sk_mean_squared_error,
+    mean_squared_log_error as sk_mean_squared_log_error,
+)
+
+from metrics_tpu.functional import (
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    symmetric_mean_absolute_percentage_error,
+    weighted_mean_absolute_percentage_error,
+)
+from metrics_tpu.regression import (
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(42)
+
+_single_target_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 5)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 5)), dtype=jnp.float32),
+)
+
+
+def _sk_symmetric_mape(preds, target, epsilon=1.17e-06):
+    preds, target = np.asarray(preds).ravel(), np.asarray(target).ravel()
+    return np.mean(2 * np.abs(preds - target) / np.maximum(np.abs(target) + np.abs(preds), epsilon))
+
+
+def _sk_wmape(preds, target):
+    preds, target = np.asarray(preds).ravel(), np.asarray(target).ravel()
+    return np.sum(np.abs(preds - target)) / np.sum(np.abs(target))
+
+
+def _flat(sk_fn, preds, target, **kw):
+    return sk_fn(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1), **kw)
+
+
+_metric_params = [
+    pytest.param(MeanSquaredError, mean_squared_error, partial(_flat, sk_mean_squared_error), {}, id="mse"),
+    pytest.param(
+        MeanSquaredError,
+        mean_squared_error,
+        lambda p, t: np.sqrt(_flat(sk_mean_squared_error, p, t)),
+        {"squared": False},
+        id="rmse",
+    ),
+    pytest.param(MeanAbsoluteError, mean_absolute_error, partial(_flat, sk_mean_absolute_error), {}, id="mae"),
+    pytest.param(
+        MeanSquaredLogError, mean_squared_log_error, partial(_flat, sk_mean_squared_log_error), {}, id="msle"
+    ),
+    pytest.param(
+        MeanAbsolutePercentageError,
+        mean_absolute_percentage_error,
+        partial(_flat, sk_mean_abs_percentage_error),
+        {},
+        id="mape",
+    ),
+    pytest.param(
+        SymmetricMeanAbsolutePercentageError,
+        symmetric_mean_absolute_percentage_error,
+        _sk_symmetric_mape,
+        {},
+        id="smape",
+    ),
+    pytest.param(
+        WeightedMeanAbsolutePercentageError,
+        weighted_mean_absolute_percentage_error,
+        _sk_wmape,
+        {},
+        id="wmape",
+    ),
+]
+
+
+@pytest.mark.parametrize("inputs", [_single_target_inputs, _multi_target_inputs], ids=["single", "multi"])
+class TestMeanError(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("metric_class, metric_fn, sk_metric, metric_args", _metric_params)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_mean_error_class(self, inputs, metric_class, metric_fn, sk_metric, metric_args, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=sk_metric,
+            metric_args=metric_args,
+        )
+
+    @pytest.mark.parametrize("metric_class, metric_fn, sk_metric, metric_args", _metric_params)
+    def test_mean_error_functional(self, inputs, metric_class, metric_fn, sk_metric, metric_args):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=metric_fn,
+            sk_metric=sk_metric,
+            metric_args=metric_args,
+        )
+
+
+def test_mse_squared_error():
+    with pytest.raises(ValueError, match="Expected argument `squared` to be a boolean.*"):
+        MeanSquaredError(squared=1)
+
+
+def test_shape_mismatch_raises():
+    with pytest.raises(RuntimeError):
+        mean_squared_error(jnp.ones(5), jnp.ones(6))

@@ -1,0 +1,109 @@
+"""R2Score / ExplainedVariance vs sklearn oracles
+(reference ``tests/regression/test_r2.py`` / ``test_explained_variance.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score as sk_explained_variance, r2_score as sk_r2_score
+
+from metrics_tpu.functional import explained_variance, r2_score
+from metrics_tpu.regression import ExplainedVariance, R2Score
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+Input = namedtuple("Input", ["preds", "target", "num_outputs"])
+
+_rng = np.random.default_rng(7)
+
+_single_target_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE)), dtype=jnp.float32),
+    num_outputs=1,
+)
+
+_multi_target_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 5)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, BATCH_SIZE, 5)), dtype=jnp.float32),
+    num_outputs=5,
+)
+
+
+def _sk_r2(preds, target, adjusted=0, multioutput="uniform_average"):
+    preds, target = np.asarray(preds), np.asarray(target)
+    r2 = sk_r2_score(target, preds, multioutput=multioutput)
+    if adjusted != 0:
+        n = target.shape[0]
+        r2 = 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+    return r2
+
+
+def _sk_ev(preds, target, multioutput="uniform_average"):
+    return sk_explained_variance(np.asarray(target), np.asarray(preds), multioutput=multioutput)
+
+
+@pytest.mark.parametrize("inputs", [_single_target_inputs, _multi_target_inputs], ids=["single", "multi"])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+class TestR2Score(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("adjusted", [0, 2])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_r2_class(self, inputs, multioutput, adjusted, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=R2Score,
+            sk_metric=partial(_sk_r2, adjusted=adjusted, multioutput=multioutput),
+            metric_args={"num_outputs": inputs.num_outputs, "adjusted": adjusted, "multioutput": multioutput},
+        )
+
+    def test_r2_functional(self, inputs, multioutput):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=r2_score,
+            sk_metric=partial(_sk_r2, multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+
+@pytest.mark.parametrize("inputs", [_single_target_inputs, _multi_target_inputs], ids=["single", "multi"])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+class TestExplainedVariance(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_explained_variance_class(self, inputs, multioutput, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=ExplainedVariance,
+            sk_metric=partial(_sk_ev, multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+    def test_explained_variance_functional(self, inputs, multioutput):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=explained_variance,
+            sk_metric=partial(_sk_ev, multioutput=multioutput),
+            metric_args={"multioutput": multioutput},
+        )
+
+
+def test_r2_raises():
+    with pytest.raises(ValueError, match="Needs at least two samples.*"):
+        r2_score(jnp.asarray([0.0]), jnp.asarray([1.0]))
+    with pytest.raises(ValueError, match="Argument `multioutput` must be.*"):
+        r2_score(jnp.ones(4), jnp.ones(4), multioutput="bad")
+    with pytest.raises(ValueError, match="`adjusted` parameter.*"):
+        r2_score(jnp.arange(4.0), jnp.arange(4.0) + 0.5, adjusted=-1)
+
+
+def test_explained_variance_raises():
+    with pytest.raises(ValueError, match="Invalid input to argument `multioutput`.*"):
+        ExplainedVariance(multioutput="bad")

@@ -114,7 +114,10 @@ class TestMinMaxMetric:
         metric.update(jnp.asarray([2.0]))
         metric.compute()
         metric.reset()
-        assert float(metric.min_val) == float(jnp.inf)
+        # min/max deliberately survive reset: they track the whole
+        # experiment across per-epoch resets (the base metric is cleared)
+        assert float(metric.min_val) == 2.0
+        assert metric._base_metric._update_count == 0
 
     def test_scalar_check(self):
         metric = MinMaxMetric(Accuracy(num_classes=3, average=None))  # vector result
@@ -202,6 +205,25 @@ class TestMetricTracker:
             MetricTracker(lambda x: x)
         with pytest.raises(ValueError, match="should match the length"):
             MetricTracker(MetricCollection([SumMetric(), MeanMetric()]), maximize=[True])
+        # a list maximize over a single metric would be interpreted as truthy
+        with pytest.raises(ValueError, match="can only be a list"):
+            MetricTracker(MeanMetric(), maximize=[False])
+
+    def test_minmax_advances_under_dist_sync(self):
+        # the running min/max must survive both the sync/unsync cycle of a
+        # distributed compute and reset between epochs
+        from tests.helpers.testers import _wire_virtual_ddp
+
+        mm = MinMaxMetric(MeanMetric())
+        _wire_virtual_ddp([mm])
+        mm.update(jnp.asarray([8.0]))
+        out1 = mm.compute()
+        np.testing.assert_allclose(out1["max"], 8.0)
+        mm.reset()
+        mm.update(jnp.asarray([2.0]))
+        out2 = mm.compute()
+        np.testing.assert_allclose(out2["min"], 2.0)
+        np.testing.assert_allclose(out2["max"], 8.0)  # advanced past epoch 1
 
 
 class TestWrapperForwardLifecycle:
