@@ -60,6 +60,16 @@ from metrics_tpu.regression import (  # noqa: E402, F401
     TweedieDevianceScore,
     WeightedMeanAbsolutePercentageError,
 )
+from metrics_tpu.retrieval import (  # noqa: E402, F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
     ClasswiseWrapper,
@@ -118,6 +128,14 @@ __all__ = [
     "MeanMetric",
     "Metric",
     "MinMetric",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
     "StatScores",
     "SumMetric",
     "functional",

@@ -38,6 +38,14 @@ from metrics_tpu.functional.regression.spearman import spearman_corrcoef  # noqa
 from metrics_tpu.functional.regression.symmetric_mape import symmetric_mean_absolute_percentage_error  # noqa: F401
 from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score  # noqa: F401
 from metrics_tpu.functional.regression.wmape import weighted_mean_absolute_percentage_error  # noqa: F401
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out  # noqa: F401
+from metrics_tpu.functional.retrieval.hit_rate import retrieval_hit_rate  # noqa: F401
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg  # noqa: F401
+from metrics_tpu.functional.retrieval.precision import retrieval_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.recall import retrieval_recall  # noqa: F401
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank  # noqa: F401
 
 __all__ = [
     "cosine_similarity",
@@ -78,6 +86,14 @@ __all__ = [
     "precision_recall",
     "precision_recall_curve",
     "recall",
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
     "roc",
     "specificity",
     "stat_scores",

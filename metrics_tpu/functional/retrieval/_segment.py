@@ -1,0 +1,152 @@
+"""Vectorized per-query retrieval kernels (lexsort + segment ops).
+
+TPU-native replacement for the reference's per-query Python loop
+(``torchmetrics/retrieval/base.py:114-143`` + ``get_group_indexes``,
+``torchmetrics/utilities/data.py:196-220``): ALL queries are scored in one
+fused XLA program — a single stable lexsort by ``(query, -score)`` followed by
+``jax.ops.segment_*`` reductions with ``num_segments = N`` (a static upper
+bound on the number of queries, so shapes stay static under jit). Empty
+segments are masked out at aggregation time.
+
+Every kernel returns a dense ``(N,)`` vector of per-group scores; entries for
+empty segments are meaningless and must be masked with ``ctx.nonempty``.
+"""
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class GroupContext(NamedTuple):
+    """Shared per-query machinery for all retrieval kernels.
+
+    All arrays are sorted by ``(group, -pred)`` (stable, so ties keep input
+    order). ``gid`` is a dense 0-based group id, ``rank`` the 0-based position
+    of each document within its group's score-descending ordering.
+    """
+
+    preds: Array  # (N,) sorted scores
+    target: Array  # (N,) targets in the same order
+    gid: Array  # (N,) dense group id, nondecreasing
+    rank: Array  # (N,) 0-based within-group rank
+    start: Array  # (N,) flat position of each group's first document
+    count: Array  # (N,) documents per group (dense over segments)
+    npos: Array  # (N,) positive-target total per group
+    nonempty: Array  # (N,) bool, segment is a real group
+    num_segments: int  # static segment count (== N)
+
+
+def make_group_context(preds: Array, target: Array, indexes: Array) -> GroupContext:
+    """Build the shared sorted/grouped view of a flat retrieval batch."""
+    n = preds.shape[0]
+    order = jnp.lexsort((-preds, indexes))
+    sidx = indexes[order]
+    spreds = preds[order]
+    starget = target[order]
+
+    first = jnp.concatenate([jnp.ones((1,), dtype=bool), sidx[1:] != sidx[:-1]])
+    gid = jnp.cumsum(first) - 1
+
+    pos = jnp.arange(n)
+    start = jax.ops.segment_min(pos, gid, num_segments=n)
+    rank = pos - start[gid]
+
+    ones = jnp.ones((n,), dtype=jnp.int32)
+    count = jax.ops.segment_sum(ones, gid, num_segments=n)
+    npos = jax.ops.segment_sum((starget > 0).astype(jnp.float32), gid, num_segments=n)
+    nonempty = count > 0
+    return GroupContext(spreds, starget, gid, rank, start, count, npos, nonempty, n)
+
+
+def _group_cumsum(x: Array, ctx: GroupContext) -> Array:
+    """Inclusive cumulative sum of ``x`` restarting at each group boundary."""
+    cs = jnp.cumsum(x)
+    before = jnp.where(ctx.start > 0, cs[jnp.maximum(ctx.start - 1, 0)], 0.0)
+    return cs - before[ctx.gid]
+
+
+def _topk_mask(ctx: GroupContext, k: Optional[int]) -> Array:
+    if k is None:
+        return jnp.ones_like(ctx.rank, dtype=bool)
+    return ctx.rank < k
+
+
+def average_precision_scores(ctx: GroupContext) -> Array:
+    """Per-group IR average precision (ref ``functional/retrieval/average_precision.py:20``)."""
+    t = (ctx.target > 0).astype(jnp.float32)
+    hits = _group_cumsum(t, ctx)  # relevant seen up to and incl. this rank
+    contrib = t * hits / (ctx.rank + 1.0)
+    total = jax.ops.segment_sum(contrib, ctx.gid, num_segments=ctx.num_segments)
+    return jnp.where(ctx.npos > 0, total / jnp.maximum(ctx.npos, 1.0), 0.0)
+
+
+def reciprocal_rank_scores(ctx: GroupContext) -> Array:
+    """Per-group reciprocal rank (ref ``functional/retrieval/reciprocal_rank.py:20``)."""
+    sentinel = ctx.num_segments
+    first_hit = jax.ops.segment_min(
+        jnp.where(ctx.target > 0, ctx.rank, sentinel), ctx.gid, num_segments=ctx.num_segments
+    )
+    return jnp.where(first_hit < sentinel, 1.0 / (first_hit + 1.0), 0.0)
+
+
+def precision_scores(ctx: GroupContext, k: Optional[int], adaptive_k: bool = False) -> Array:
+    """Per-group precision@k (ref ``functional/retrieval/precision.py:21``)."""
+    t = (ctx.target > 0).astype(jnp.float32)
+    if k is None:
+        k_g = ctx.count.astype(jnp.float32)
+        mask = jnp.ones_like(t, dtype=bool)
+    else:
+        k_g = jnp.where(adaptive_k, jnp.minimum(k, ctx.count), k).astype(jnp.float32)
+        mask = _topk_mask(ctx, k)
+    rel = jax.ops.segment_sum(t * mask, ctx.gid, num_segments=ctx.num_segments)
+    return jnp.where(ctx.npos > 0, rel / jnp.maximum(k_g, 1.0), 0.0)
+
+
+def r_precision_scores(ctx: GroupContext) -> Array:
+    """Per-group R-precision (ref ``functional/retrieval/r_precision.py:20``)."""
+    t = (ctx.target > 0).astype(jnp.float32)
+    in_top_r = ctx.rank < ctx.npos[ctx.gid]
+    rel = jax.ops.segment_sum(t * in_top_r, ctx.gid, num_segments=ctx.num_segments)
+    return jnp.where(ctx.npos > 0, rel / jnp.maximum(ctx.npos, 1.0), 0.0)
+
+
+def recall_scores(ctx: GroupContext, k: Optional[int]) -> Array:
+    """Per-group recall@k (ref ``functional/retrieval/recall.py:20``)."""
+    t = (ctx.target > 0).astype(jnp.float32)
+    rel = jax.ops.segment_sum(t * _topk_mask(ctx, k), ctx.gid, num_segments=ctx.num_segments)
+    return jnp.where(ctx.npos > 0, rel / jnp.maximum(ctx.npos, 1.0), 0.0)
+
+
+def fall_out_scores(ctx: GroupContext, k: Optional[int]) -> Array:
+    """Per-group fall-out@k over NEGATIVE documents (ref ``functional/retrieval/fall_out.py:21``)."""
+    neg = (ctx.target <= 0).astype(jnp.float32)
+    nneg = jax.ops.segment_sum(neg, ctx.gid, num_segments=ctx.num_segments)
+    ret_neg = jax.ops.segment_sum(neg * _topk_mask(ctx, k), ctx.gid, num_segments=ctx.num_segments)
+    return jnp.where(nneg > 0, ret_neg / jnp.maximum(nneg, 1.0), 0.0)
+
+
+def hit_rate_scores(ctx: GroupContext, k: Optional[int]) -> Array:
+    """Per-group hit rate@k (ref ``functional/retrieval/hit_rate.py:20``)."""
+    t = (ctx.target > 0).astype(jnp.float32)
+    rel = jax.ops.segment_sum(t * _topk_mask(ctx, k), ctx.gid, num_segments=ctx.num_segments)
+    return (rel > 0).astype(jnp.float32)
+
+
+def ndcg_scores(ctx: GroupContext, k: Optional[int]) -> Array:
+    """Per-group normalized DCG, non-binary targets allowed (ref
+    ``functional/retrieval/ndcg.py:29-74``)."""
+    t = ctx.target.astype(jnp.float32)
+    discount = 1.0 / jnp.log2(ctx.rank + 2.0)
+    mask = _topk_mask(ctx, k)
+    dcg = jax.ops.segment_sum(t * discount * mask, ctx.gid, num_segments=ctx.num_segments)
+
+    # ideal ordering: targets descending within each group; gid is already
+    # nondecreasing so one more stable lexsort preserves the group layout.
+    ideal_order = jnp.lexsort((-t, ctx.gid))
+    t_ideal = t[ideal_order]
+    ideal = jax.ops.segment_sum(t_ideal * discount * mask, ctx.gid, num_segments=ctx.num_segments)
+    # reference ndcg.py:70-72 zeroes only the ideal == 0 case; a negative
+    # ideal (negative relevances are legal non-binary targets) still divides.
+    return jnp.where(ideal != 0, dcg / jnp.where(ideal != 0, ideal, 1.0), 0.0)

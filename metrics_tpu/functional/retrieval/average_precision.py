@@ -1,0 +1,28 @@
+"""Functional IR average precision.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/average_precision.py:20``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import average_precision_scores, make_group_context
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """Average precision of a single query's ranked documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return average_precision_scores(ctx)[0].astype(preds.dtype)

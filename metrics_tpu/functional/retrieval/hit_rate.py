@@ -1,0 +1,32 @@
+"""Functional IR hit rate@k.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/hit_rate.py:20``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import hit_rate_scores, make_group_context
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if at least one relevant document is in the top ``k``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_hit_rate(preds, target, k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return hit_rate_scores(ctx, k=k)[0].astype(preds.dtype)

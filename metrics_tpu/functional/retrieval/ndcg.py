@@ -1,0 +1,32 @@
+"""Functional IR normalized discounted cumulative gain.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/ndcg.py:29``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import make_group_context, ndcg_scores
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Normalized DCG of a single query; non-binary targets allowed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(0.6956907, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return ndcg_scores(ctx, k=k)[0].astype(preds.dtype)

@@ -1,0 +1,34 @@
+"""Functional IR precision@k.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/precision.py:21``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import make_group_context, precision_scores
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Fraction of relevant documents among the top-``k`` retrieved.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_precision(preds, target, k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return precision_scores(ctx, k=k, adaptive_k=adaptive_k)[0].astype(preds.dtype)

@@ -1,0 +1,28 @@
+"""Functional IR R-precision.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/r_precision.py:20``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import make_group_context, r_precision_scores
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at ``k`` where ``k`` is the number of relevant documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> retrieval_r_precision(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return r_precision_scores(ctx)[0].astype(preds.dtype)

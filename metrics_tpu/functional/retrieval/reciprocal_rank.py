@@ -1,0 +1,28 @@
+"""Functional IR reciprocal rank.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/retrieval/reciprocal_rank.py:20``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import make_group_context, reciprocal_rank_scores
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """Reciprocal rank of the first relevant document.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
+    return reciprocal_rank_scores(ctx)[0].astype(preds.dtype)

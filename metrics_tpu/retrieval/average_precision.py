@@ -1,0 +1,28 @@
+"""RetrievalMAP metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/average_precision.py:22``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, average_precision_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(preds, target, indexes=indexes)
+        Array(0.7916667, dtype=float32)
+    """
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return average_precision_scores(ctx)

@@ -1,0 +1,109 @@
+"""RetrievalMetric base class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/base.py:27``, with
+a TPU-first compute: instead of the reference's per-query Python loop over
+``get_group_indexes`` (``utilities/data.py:196-220`` — a dict of ``.item()``
+calls), ALL queries are scored in one fused lexsort + segment-op XLA program
+(see ``metrics_tpu/functional/retrieval/_segment.py``). Queries with no
+positive (for fall-out: no negative) target follow ``empty_target_action``.
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, make_group_context
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for IR metrics over ``(preds, target, indexes)`` triplets.
+
+    ``indexes`` assigns each prediction to a query; the metric value is the
+    mean of the per-query score. States are cat-lists synced with
+    ``all_gather`` (``dist_reduce_fx=None`` → per-rank concat), mirroring the
+    reference's ``retrieval/base.py:97-99``.
+
+    Args:
+        empty_target_action: ``"neg"`` (score 0), ``"pos"`` (score 1),
+            ``"skip"`` (drop query), or ``"error"`` for queries with no
+            positive target.
+        ignore_index: drop samples whose target equals this value.
+    """
+
+    higher_is_better = True
+    is_differentiable = False
+    allow_non_binary_target = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes,
+            preds,
+            target,
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        ctx = make_group_context(preds, target, indexes)
+        scores = self._metric_vectorized(ctx)
+        valid = self._valid_groups(ctx)
+        nonempty = ctx.nonempty
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(nonempty & ~valid)):
+                raise ValueError(f"`compute` method was provided with a query with no {self._required_kind} target.")
+
+        if self.empty_target_action == "skip":
+            keep = nonempty & valid
+        else:
+            fill = 1.0 if self.empty_target_action == "pos" else 0.0
+            scores = jnp.where(valid, scores, fill)
+            keep = nonempty
+
+        n_keep = keep.sum()
+        total = jnp.where(keep, scores, 0.0).sum()
+        return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(preds.dtype)
+
+    # which groups produce a defined score (fall-out overrides to "negative")
+    _required_kind = "positive"
+
+    def _valid_groups(self, ctx: GroupContext) -> Array:
+        return ctx.npos > 0
+
+    @abstractmethod
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        """Dense (num_segments,) per-group scores."""

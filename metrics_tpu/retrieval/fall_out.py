@@ -1,0 +1,53 @@
+"""RetrievalFallOut metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/fall_out.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, fall_out_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k over queries; lower is better.
+
+    The empty-query policy is inverted relative to the other retrieval
+    metrics: a query with no NEGATIVE target is undefined (reference
+    ``retrieval/fall_out.py:89-140``), and ``empty_target_action`` defaults
+    to ``"pos"``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> fo = RetrievalFallOut(k=2)
+        >>> fo(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    higher_is_better = False
+    _required_kind = "negative"
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _valid_groups(self, ctx: GroupContext) -> Array:
+        return (ctx.count.astype(ctx.npos.dtype) - ctx.npos) > 0
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return fall_out_scores(ctx, k=self.k)

@@ -1,0 +1,52 @@
+"""RetrievalNormalizedDCG metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/ndcg.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, ndcg_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean normalized DCG over queries; non-binary targets allowed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> ndcg(preds, target, indexes=indexes)
+        Array(0.84670985, dtype=float32)
+    """
+
+    allow_non_binary_target = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _valid_groups(self, ctx: GroupContext) -> Array:
+        # float targets allowed: "no positive" means the target sum is zero
+        # (reference ndcg.py routes through base.compute's mini_target.sum()).
+        total = jax.ops.segment_sum(
+            ctx.target.astype(ctx.npos.dtype), ctx.gid, num_segments=ctx.num_segments
+        )
+        return total != 0
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return ndcg_scores(ctx, k=self.k)

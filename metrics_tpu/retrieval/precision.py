@@ -1,0 +1,50 @@
+"""RetrievalPrecision metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/precision.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, precision_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k over queries.
+
+    Args:
+        k: consider only the top ``k`` documents per query (default: all).
+        adaptive_k: adjust ``k`` to ``min(k, n_documents)`` per query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalPrecision(k=2)
+        >>> p2(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.k = k
+        self.adaptive_k = adaptive_k
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return precision_scores(ctx, k=self.k, adaptive_k=self.adaptive_k)

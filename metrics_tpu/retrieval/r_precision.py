@@ -1,0 +1,28 @@
+"""RetrievalRPrecision metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/r_precision.py:22``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, r_precision_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalRPrecision()
+        >>> p2(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return r_precision_scores(ctx)

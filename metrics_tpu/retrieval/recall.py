@@ -1,0 +1,42 @@
+"""RetrievalRecall metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/recall.py:22``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, recall_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(k=2)
+        >>> r2(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return recall_scores(ctx, k=self.k)

@@ -1,0 +1,28 @@
+"""RetrievalMRR metric class.
+
+Behavioral equivalent of reference ``torchmetrics/retrieval/reciprocal_rank.py:22``.
+"""
+import jax
+
+from metrics_tpu.functional.retrieval._segment import GroupContext, reciprocal_rank_scores
+from metrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr(preds, target, indexes=indexes)
+        Array(0.75, dtype=float32)
+    """
+
+    def _metric_vectorized(self, ctx: GroupContext) -> Array:
+        return reciprocal_rank_scores(ctx)
