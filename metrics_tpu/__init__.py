@@ -45,6 +45,19 @@ from metrics_tpu.classification import (  # noqa: E402, F401
     StatScores,
 )
 from metrics_tpu.collections import MetricCollection  # noqa: E402, F401
+from metrics_tpu.image import (  # noqa: E402, F401
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402, F401
 from metrics_tpu.regression import (  # noqa: E402, F401
     CosineSimilarity,
@@ -119,6 +132,17 @@ __all__ = [
     "SymmetricMeanAbsolutePercentageError",
     "TweedieDevianceScore",
     "WeightedMeanAbsolutePercentageError",
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex",
     "CompositionalMetric",
     "MetricCollection",
     "MetricTracker",

@@ -22,6 +22,16 @@ from metrics_tpu.functional.classification.ranking import (  # noqa: F401
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.image.d_lambda import spectral_distortion_index  # noqa: F401
+from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis  # noqa: F401
+from metrics_tpu.functional.image.gradients import image_gradients  # noqa: F401
+from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio  # noqa: F401
+from metrics_tpu.functional.image.sam import spectral_angle_mapper  # noqa: F401
+from metrics_tpu.functional.image.ssim import (  # noqa: F401
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from metrics_tpu.functional.image.uqi import universal_image_quality_index  # noqa: F401
 from metrics_tpu.functional.pairwise.cosine import pairwise_cosine_similarity  # noqa: F401
 from metrics_tpu.functional.pairwise.euclidean import pairwise_euclidean_distance  # noqa: F401
 from metrics_tpu.functional.pairwise.linear import pairwise_linear_similarity  # noqa: F401
@@ -49,6 +59,14 @@ from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciproca
 
 __all__ = [
     "cosine_similarity",
+    "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "structural_similarity_index_measure",
+    "universal_image_quality_index",
     "explained_variance",
     "mean_absolute_error",
     "mean_absolute_percentage_error",

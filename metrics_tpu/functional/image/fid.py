@@ -1,0 +1,52 @@
+"""Frechet distance math: on-device PSD matrix sqrt via eigendecomposition.
+
+TPU-native replacement for the reference's CPU round-trip
+(``torchmetrics/image/fid.py:60-94`` — ``MatrixSquareRoot`` dispatches to
+``scipy.linalg.sqrtm`` on host numpy). Here the whole FID formula runs in
+XLA: ``tr(sqrtm(S1 @ S2))`` for symmetric PSD ``S1, S2`` equals
+``sum(sqrt(eigvalsh(A @ S2 @ A)))`` with ``A = sqrtm(S1)`` — three matmuls
+and two ``eigh`` calls, no host transfer. Runs in f64 when
+``jax_enable_x64`` is set, f32 otherwise (documented tolerance).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _sqrtm_psd(mat: Array) -> Array:
+    """Matrix square root of a symmetric PSD matrix via ``eigh``."""
+    vals, vecs = jnp.linalg.eigh(mat)
+    vals = jnp.clip(vals, 0, None)
+    return (vecs * jnp.sqrt(vals)[None, :]) @ vecs.T
+
+
+def _trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
+    """``tr(sqrtm(sigma1 @ sigma2))`` for symmetric PSD inputs."""
+    a = _sqrtm_psd(sigma1)
+    inner = a @ sigma2 @ a
+    inner = (inner + inner.T) / 2  # re-symmetrize against fp error
+    vals = jnp.clip(jnp.linalg.eigvalsh(inner), 0, None)
+    return jnp.sum(jnp.sqrt(vals))
+
+
+def _mean_cov_from_moments(feat_sum: Array, outer_sum: Array, n: Array) -> Tuple[Array, Array]:
+    """Exact mean + unbiased covariance from streaming moments.
+
+    The reference accumulates full feature cat-lists and materializes them at
+    compute (``image/fid.py:270-287``); sum / outer-product-sum moments give
+    the identical mean/cov with O(D^2) state — mesh-reducible with plain
+    psum.
+    """
+    mean = feat_sum / n
+    cov = (outer_sum - n * jnp.outer(mean, mean)) / jnp.maximum(n - 1, 1)
+    return mean, cov
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    """FID formula (reference ``image/fid.py:97-124``)."""
+    diff = mu1 - mu2
+    tr_covmean = _trace_sqrtm_product(sigma1, sigma2)
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean

@@ -1,0 +1,42 @@
+"""Finite-difference image gradients.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/image/gradients.py`` (``image_gradients`` :48).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if not isinstance(img, (jnp.ndarray, jax.Array)):
+        raise TypeError(f"The `img` expects an array type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Compute ``(dy, dx)`` one-step finite differences (reference
+    ``gradients.py:48``; last row/column zero-padded).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :2, :2]
+        Array([[5., 5.],
+               [5., 5.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,82 @@
+"""Shared image-kernel helpers: separable gaussian kernels + grouped conv.
+
+Behavioral equivalent of reference ``torchmetrics/functional/image/helper.py``
+(``_gaussian`` :11, ``_gaussian_kernel_2d`` :29, ``_gaussian_kernel_3d`` :62,
+reflection padding :87-122). TPU-first differences: the depthwise convolution
+is expressed as ``lax.conv_general_dilated`` with
+``feature_group_count=channels`` so XLA lowers it straight onto the MXU, and
+reflection padding is a single fused ``jnp.pad(mode="reflect")``.
+"""
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype: jnp.dtype) -> Array:
+    """1D gaussian kernel, normalized to sum 1; shape ``(1, kernel_size)``."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.power(dist / sigma, 2) / 2)
+    return (gauss / gauss.sum())[None, :]
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype: jnp.dtype
+) -> Array:
+    """2D gaussian kernel of shape ``(channel, 1, kh, kw)`` (depthwise OIHW)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kernel_x.T @ kernel_y  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype: jnp.dtype
+) -> Array:
+    """3D gaussian kernel of shape ``(channel, 1, kh, kw, kd)``."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kernel_x.T @ kernel_y  # (kh, kw)
+    kernel = kernel_xy[:, :, None] * kernel_z[0][None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _uniform_kernel_2d(channel: int, kernel_size: Sequence[int], dtype: jnp.dtype) -> Array:
+    kernel = jnp.ones(tuple(kernel_size), dtype=dtype) / float(jnp.prod(jnp.asarray(kernel_size)))
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _uniform_kernel_3d(channel: int, kernel_size: Sequence[int], dtype: jnp.dtype) -> Array:
+    return _uniform_kernel_2d(channel, kernel_size, dtype)
+
+
+def _depthwise_conv(inputs: Array, kernel: Array) -> Array:
+    """Depthwise (grouped) VALID conv; NCHW/NCDHW inputs, (C,1,*k) kernel."""
+    spatial = inputs.ndim - 2
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    return lax.conv_general_dilated(
+        inputs,
+        kernel,
+        window_strides=(1,) * spatial,
+        padding="VALID",
+        dimension_numbers=dn,
+        feature_group_count=kernel.shape[0],
+    )
+
+
+def _reflection_pad(inputs: Array, pads: Sequence[int]) -> Array:
+    """Reflect-pad the trailing spatial dims by ``pads`` (one int per dim)."""
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(inputs, pad_width, mode="reflect")
+
+
+def _avg_pool(inputs: Array, window: int = 2) -> Array:
+    """Average-pool the trailing spatial dims by ``window`` (NCHW/NCDHW)."""
+    spatial = inputs.ndim - 2
+    dims = (1, 1) + (window,) * spatial
+    out = lax.reduce_window(inputs, 0.0, lax.add, dims, dims, "VALID")
+    return out / float(window**spatial)

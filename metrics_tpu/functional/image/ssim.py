@@ -1,0 +1,371 @@
+"""Structural Similarity Index Measure (SSIM) and Multi-Scale SSIM.
+
+Behavioral equivalent of reference ``torchmetrics/functional/image/ssim.py``
+(``_ssim_update`` :26, ``_ssim_compute`` :49, ``structural_similarity_index_
+measure`` :197, ``_multiscale_ssim_compute`` :303, ``multiscale_structural_
+similarity_index_measure`` :415). The five windowed moments are computed in
+ONE depthwise conv over a stacked ``(5B, C, ...)`` tensor so XLA sees a
+single big MXU-friendly convolution; downsampling between MS-SSIM scales is
+``lax.reduce_window``.
+"""
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import (
+    _avg_pool,
+    _depthwise_conv,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflection_pad,
+    _uniform_kernel_2d,
+    _uniform_kernel_3d,
+)
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Shape/type gate (reference ``_ssim_update`` :26)."""
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+# reference name parity
+_ssim_update = _ssim_check_inputs
+
+
+def _normalize_kernel_args(
+    is_3d: bool, kernel_size: Union[int, Sequence[int]], sigma: Union[float, Sequence[float]]
+) -> Tuple[Sequence[int], Sequence[float]]:
+    n = 3 if is_3d else 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = n * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = n * [sigma]
+    if len(kernel_size) not in (2, 3) or len(kernel_size) != n:
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less than target dimensionality"
+        )
+    if len(sigma) != n:
+        raise ValueError(f"`sigma` has dimension {len(sigma)}, but expected to be two less than target dimensionality")
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+    return list(kernel_size), list(sigma)
+
+
+def _ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Windowed-moment SSIM (reference ``_ssim_compute`` :49)."""
+    is_3d = preds.ndim == 5
+    kernel_size, sigma = _normalize_kernel_args(is_3d, kernel_size, sigma)
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    data_range = jnp.asarray(data_range, dtype=preds.dtype)
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    preds = preds.astype(dtype)
+    target = target.astype(dtype)
+    # the reference sizes the window from sigma when gaussian (ssim.py:136)
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    conv_kernel_size = gauss_kernel_size if gaussian_kernel else kernel_size
+
+    pads = [(k - 1) // 2 for k in conv_kernel_size]
+    preds = _reflection_pad(preds, pads)
+    target = _reflection_pad(target, pads)
+
+    if gaussian_kernel:
+        make = _gaussian_kernel_3d if is_3d else _gaussian_kernel_2d
+        kernel = make(channel, gauss_kernel_size, sigma, dtype)
+    else:
+        make_u = _uniform_kernel_3d if is_3d else _uniform_kernel_2d
+        kernel = make_u(channel, kernel_size, dtype)
+
+    # one conv over the 5 stacked moment inputs: mu_p, mu_t, E[p^2], E[t^2], E[pt]
+    input_list = jnp.concatenate([preds, target, preds * preds, target * target, preds * target])
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+    # the VALID conv already removed (k-1) border rows; with reflect padding of
+    # (k-1)//2 the output grid aligns with the unpadded image, and the
+    # reference then crops another pad from each side (ssim.py:180-183)
+    crop = tuple(slice(p, s - p) for p, s in zip(pads, ssim_full.shape[2:]))
+    ssim_idx = ssim_full[(...,) + crop]
+
+    per_image = ssim_idx.reshape(ssim_idx.shape[0], -1).mean(-1)
+
+    if return_contrast_sensitivity:
+        contrast = (upper / lower)[(...,) + crop]
+        return reduce(per_image, reduction), reduce(contrast.reshape(contrast.shape[0], -1).mean(-1), reduction)
+    if return_full_image:
+        return reduce(per_image, reduction), reduce(ssim_full, reduction)
+    return reduce(per_image, reduction)
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Compute SSIM (reference ``ssim.py:197``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    return _ssim_compute(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        reduction,
+        data_range,
+        k1,
+        k2,
+        return_full_image,
+        return_contrast_sensitivity,
+    )
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    normalize: Optional[str] = None,
+) -> Tuple[Array, Array]:
+    sim, cs = _ssim_compute(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        reduction,
+        data_range,
+        k1,
+        k2,
+        return_contrast_sensitivity=True,
+    )
+    if normalize == "relu":
+        sim = jax.nn.relu(sim)
+        cs = jax.nn.relu(cs)
+    return sim, cs
+
+
+def _multiscale_ssim_validate_size(
+    preds: Array, kernel_size: Union[int, Sequence[int]], sigma: Union[float, Sequence[float]], n_scales: int
+) -> None:
+    """Image-size preconditions for an n_scales pyramid (reference
+    ``ssim.py:364-382``); shared by the batch and streaming paths."""
+    kernel_size_l, _ = _normalize_kernel_args(preds.ndim == 5, kernel_size, sigma)
+    if preds.shape[-1] < 2**n_scales or preds.shape[-2] < 2**n_scales:
+        raise ValueError(
+            f"For a given number of `betas` parameters {n_scales}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** n_scales}."
+        )
+    _betas_div = max(1, (n_scales - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size_l[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {n_scales} and kernel size {kernel_size_l[0]},"
+            f" the image height must be larger than {(kernel_size_l[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size_l[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {n_scales} and kernel size {kernel_size_l[1]},"
+            f" the image width must be larger than {(kernel_size_l[1] - 1) * _betas_div}."
+        )
+
+
+def _multiscale_ssim_per_image(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    n_scales: int = 5,
+) -> Tuple[Array, Array]:
+    """Per-image, per-scale raw (sim, cs) values, each ``(n_scales, B)``.
+
+    Streaming building block: scale-wise sums of these across batches
+    reproduce the reference's reduce-then-combine MS-SSIM exactly
+    (``ssim.py:386-414`` reduces per scale BEFORE the beta-weighted product).
+    """
+    _multiscale_ssim_validate_size(preds, kernel_size, sigma, n_scales)
+    sims = []
+    css = []
+    for _ in range(n_scales):
+        sim, cs = _ssim_compute(
+            preds,
+            target,
+            gaussian_kernel,
+            sigma,
+            kernel_size,
+            "none",
+            data_range,
+            k1,
+            k2,
+            return_contrast_sensitivity=True,
+        )
+        sims.append(sim)
+        css.append(cs)
+        preds = _avg_pool(preds, 2)
+        target = _avg_pool(target, 2)
+    return jnp.stack(sims), jnp.stack(css)
+
+
+def _multiscale_ssim_from_scale_stats(
+    sim_stat: Array, cs_stat: Array, betas: Tuple[float, ...], normalize: Optional[str]
+) -> Array:
+    """Combine per-scale reduced (sim, cs) stats into the MS-SSIM scalar."""
+    if normalize == "relu":
+        sim_stat = jax.nn.relu(sim_stat)
+        cs_stat = jax.nn.relu(cs_stat)
+    if normalize == "simple":
+        sim_stat = (sim_stat + 1) / 2
+        cs_stat = (cs_stat + 1) / 2
+    betas_arr = jnp.asarray(betas, dtype=sim_stat.dtype)
+    sim_stat = sim_stat**betas_arr
+    cs_stat = cs_stat**betas_arr
+    return jnp.prod(cs_stat[:-1]) * sim_stat[-1]
+
+
+def _multiscale_ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Pyramid SSIM (reference ``_multiscale_ssim_compute`` :303)."""
+    _multiscale_ssim_validate_size(preds, kernel_size, sigma, len(betas))
+
+    sim_list = []
+    cs_list = []
+    for _ in range(len(betas)):
+        sim, cs = _get_normalized_sim_and_cs(
+            preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, normalize=normalize
+        )
+        sim_list.append(sim)
+        cs_list.append(cs)
+        preds = _avg_pool(preds, 2)
+        target = _avg_pool(target, 2)
+
+    sim_stack = jnp.stack(sim_list)
+    cs_stack = jnp.stack(cs_list)
+
+    if normalize == "simple":
+        sim_stack = (sim_stack + 1) / 2
+        cs_stack = (cs_stack + 1) / 2
+
+    betas_arr = jnp.asarray(betas, dtype=sim_stack.dtype)
+    if reduction is None or reduction == "none":
+        sim_stack = sim_stack ** betas_arr[:, None]
+        cs_stack = cs_stack ** betas_arr[:, None]
+        cs_and_sim = jnp.concatenate([cs_stack[:-1], sim_stack[-1:]], axis=0)
+        return jnp.prod(cs_and_sim, axis=0)
+    sim_stack = sim_stack**betas_arr
+    cs_stack = cs_stack**betas_arr
+    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Compute Multi-Scale SSIM (reference ``ssim.py:415``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> target = preds * 0.75
+        >>> float(multiscale_structural_similarity_index_measure(preds, target)) > 0.7
+        True
+    """
+    if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize is not None and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_check_inputs(preds, target)
+    return _multiscale_ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, betas, normalize
+    )

@@ -1,0 +1,112 @@
+"""Universal Image Quality Index.
+
+Behavioral equivalent of reference ``torchmetrics/functional/image/uqi.py``
+(``_uqi_update`` :26, ``_uqi_compute`` :49, ``universal_image_quality_index``
+:126). One stacked depthwise conv produces all five windowed moments.
+
+Intentional fix vs the reference for ANISOTROPIC kernels: the reference pads
+with ``F.pad(x, (pad_h, pad_h, pad_w, pad_w))`` (uqi.py:102-103), which puts
+the height-derived pad on the WIDTH axis (torch pads last-dim-first) while
+cropping in (H, W) order — inconsistent for ``kh != kw``. Here padding and
+cropping both use natural (H, W) order; identical for the (default) square
+kernel.
+"""
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflection_pad
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+def _uqi_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+_uqi_update = _uqi_check_inputs
+
+
+def _uqi_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    preds = preds.astype(dtype)
+    target = target.astype(dtype)
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    pads = [(k - 1) // 2 for k in kernel_size]
+
+    preds_p = _reflection_pad(preds, pads)
+    target_p = _reflection_pad(target, pads)
+
+    input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    crop = tuple(slice(p, s - p) for p, s in zip(pads, uqi_idx.shape[2:]))
+    uqi_idx = uqi_idx[(...,) + crop]
+    return reduce(uqi_idx, reduction)
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    """Compute UQI (reference ``uqi.py:126``; ``data_range`` kept for
+    signature parity — UQI has no stabilizing constants so it cancels out).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(universal_image_quality_index(preds, target)) > 0.9
+        True
+    """
+    preds, target = _uqi_check_inputs(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -1,0 +1,62 @@
+"""SpectralDistortionIndex metric class.
+
+Behavioral equivalent of reference ``torchmetrics/image/d_lambda.py:30``.
+D-lambda's UQI channel-pair matrices are computed over the ENTIRE accumulated
+batch (non-separable across batches), so the cat-list buffer semantics of the
+reference are kept (:79-80).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.image.d_lambda import (
+    _spectral_distortion_index_check_inputs,
+    _spectral_distortion_index_compute,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class SpectralDistortionIndex(Metric):
+    """Spectral Distortion Index / D-lambda (reference ``image/d_lambda.py:30``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import SpectralDistortionIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = jax.random.uniform(jax.random.PRNGKey(123), (4, 3, 16, 16))
+        >>> sdi = SpectralDistortionIndex()
+        >>> bool(sdi(preds, target) >= 0)
+        True
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+
+    def __init__(self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `SpectralDistortionIndex` will save all targets and predictions in buffer. For large datasets"
+            " this may lead to large memory footprint."
+        )
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        if reduction not in ("elementwise_mean", "sum", "none", None):
+            raise ValueError(f"Expected argument `reduction` be one of ['elementwise_mean', 'sum', 'none']")
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spectral_distortion_index_check_inputs(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)

@@ -1,0 +1,68 @@
+"""ErrorRelativeGlobalDimensionlessSynthesis metric class.
+
+Behavioral equivalent of reference ``torchmetrics/image/ergas.py:26`` (image
+cat-lists, :77-78). TPU-first: ERGAS is a per-image score, so mean/sum
+reductions stream a score-sum + count (O(1), psum-reducible) and ``none``
+keeps a per-image score buffer — scores, not raw images.
+"""
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.ergas import _ergas_check_inputs, _ergas_compute
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.distributed import reduce
+
+Array = jax.Array
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS (reference ``image/ergas.py:26``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (4, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> ergas = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> bool(ergas(preds, target) > 0)
+        True
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+
+    def __init__(
+        self,
+        ratio: Union[int, float] = 4,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+
+        self._streaming = reduction in ("elementwise_mean", "sum")
+        if self._streaming:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("scores", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ergas_check_inputs(preds, target)
+        scores = _ergas_compute(preds, target, self.ratio, reduction="none")
+        if self._streaming:
+            self.score_sum = self.score_sum + scores.sum()
+            self.total = self.total + scores.shape[0]
+        else:
+            self.scores.append(scores)
+
+    def compute(self) -> Array:
+        if self._streaming:
+            if self.reduction == "sum":
+                return self.score_sum
+            return self.score_sum / self.total
+        return reduce(dim_zero_cat(self.scores), self.reduction)

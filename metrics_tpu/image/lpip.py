@@ -1,0 +1,77 @@
+"""LearnedPerceptualImagePatchSimilarity metric class.
+
+Behavioral equivalent of reference ``torchmetrics/image/lpip.py:44``
+(``NoTrainLpips`` wrapper :33, sum/total states :79-80, [-1,1] input check
+:88-92). The perceptual network is injectable — any callable
+``(img1, img2) -> (N,) distances`` (a jitted Flax VGG/AlexNet feature
+distance in practice); selecting a pretrained backbone by name requires
+weights unavailable offline and raises with guidance, mirroring the
+reference's ``ModuleNotFoundError`` when the ``lpips`` package is missing.
+"""
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS (reference ``image/lpip.py:44``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import LearnedPerceptualImagePatchSimilarity
+        >>> dist = lambda a, b: jnp.abs(a - b).mean(axis=(1, 2, 3))
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net=dist)
+        >>> img1 = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16), minval=-1, maxval=1)
+        >>> img2 = jax.random.uniform(jax.random.PRNGKey(1), (8, 3, 16, 16), minval=-1, maxval=1)
+        >>> bool(lpips(img1, img2) >= 0)
+        True
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        net: Union[Callable, None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        if net is None:
+            raise ModuleNotFoundError(
+                "LearnedPerceptualImagePatchSimilarity with a pretrained backbone requires network weights that"
+                " are not available in this offline environment. Pass `net`, a callable"
+                " `(img1, img2) -> (N,) distances` (e.g. a jitted Flax feature-space distance)."
+            )
+        self.net = net
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("sum_scores", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        if img1.ndim != 4 or img2.ndim != 4 or img1.shape[1] != 3 or img2.shape[1] != 3:
+            raise ValueError("Expected both input arguments to be 4D tensors of shape (N, 3, H, W)")
+        if bool(jnp.abs(img1).max() > 1) or bool(jnp.abs(img2).max() > 1):
+            raise ValueError("Expected both input arguments to be normalized tensors (all values in range [-1,1])")
+        loss = jnp.asarray(self.net(img1, img2)).squeeze()
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + img1.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,222 @@
+"""SSIM / Multi-Scale SSIM metric classes.
+
+Behavioral equivalents of reference ``torchmetrics/image/ssim.py`` (``SSIM``
+:25 / ``MultiScaleSSIM`` :138; both keep full ``preds``/``target`` image
+cat-lists, :96-97/:219-220). TPU-first difference: when ``data_range`` is
+given and no per-image output is requested, per-batch scores are computable
+at ``update`` time, so the state collapses to two O(1) psum-reducible sums —
+no unbounded HBM growth. The reference's buffer semantics are kept only for
+the cases that truly need global data (``data_range=None`` or the
+full-image/``none``-reduction outputs).
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.ssim import (
+    _multiscale_ssim_compute,
+    _multiscale_ssim_from_scale_stats,
+    _multiscale_ssim_per_image,
+    _ssim_check_inputs,
+    _ssim_compute,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """Structural Similarity Index Measure (reference ``image/ssim.py:25``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import StructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> float(ssim(preds, target)) > 0.9
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+        self._streaming = (
+            data_range is not None
+            and reduction in ("elementwise_mean", "sum")
+            and not return_full_image
+            and not return_contrast_sensitivity
+        )
+        if self._streaming:
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        if self._streaming:
+            batch_scores = _ssim_compute(
+                preds,
+                target,
+                self.gaussian_kernel,
+                self.sigma,
+                self.kernel_size,
+                "none",
+                self.data_range,
+                self.k1,
+                self.k2,
+            )
+            self.similarity = self.similarity + batch_scores.sum()
+            self.total = self.total + batch_scores.shape[0]
+        else:
+            self.preds.append(preds)
+            self.target.append(target)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if self._streaming:
+            if self.reduction == "sum":
+                return self.similarity
+            return self.similarity / self.total
+        return _ssim_compute(
+            dim_zero_cat(self.preds),
+            dim_zero_cat(self.target),
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.reduction,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """Multi-Scale SSIM (reference ``image/ssim.py:138``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> target = preds * 0.75
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> float(ms_ssim(preds, target)) > 0.7
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
+        if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+        # The reference reduces (sim, cs) over the batch PER SCALE before the
+        # beta-weighted product (ssim.py:386-414), so the sufficient state is
+        # one per-scale (sim_sum, cs_sum) pair + a count — O(n_scales), not a
+        # growing image buffer, whenever data_range is fixed.
+        self._streaming = data_range is not None and reduction in ("elementwise_mean", "sum")
+        if self._streaming:
+            self.add_state("sim_sum", default=jnp.zeros(len(betas)), dist_reduce_fx="sum")
+            self.add_state("cs_sum", default=jnp.zeros(len(betas)), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        if self._streaming:
+            sim, cs = _multiscale_ssim_per_image(
+                preds,
+                target,
+                self.gaussian_kernel,
+                self.sigma,
+                self.kernel_size,
+                self.data_range,
+                self.k1,
+                self.k2,
+                n_scales=len(self.betas),
+            )
+            self.sim_sum = self.sim_sum + sim.sum(axis=1)
+            self.cs_sum = self.cs_sum + cs.sum(axis=1)
+            self.total = self.total + sim.shape[1]
+        else:
+            self.preds.append(preds)
+            self.target.append(target)
+
+    def compute(self) -> Array:
+        if self._streaming:
+            if self.reduction == "sum":
+                sim_stat, cs_stat = self.sim_sum, self.cs_sum
+            else:
+                sim_stat, cs_stat = self.sim_sum / self.total, self.cs_sum / self.total
+            return _multiscale_ssim_from_scale_stats(sim_stat, cs_stat, self.betas, self.normalize)
+        return _multiscale_ssim_compute(
+            dim_zero_cat(self.preds),
+            dim_zero_cat(self.target),
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.reduction,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.betas,
+            self.normalize,
+        )

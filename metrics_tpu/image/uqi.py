@@ -1,0 +1,76 @@
+"""UniversalImageQualityIndex metric class.
+
+Behavioral equivalent of reference ``torchmetrics/image/uqi.py:25`` (which
+keeps full image cat-lists, :80-81). TPU-first: UQI has no global-data
+dependence (per-window statistic, no data-range constants), so for mean/sum
+reductions the state is a running score-sum + element count — O(1), psum-
+reducible. ``none`` reduction keeps the reference's buffer semantics.
+"""
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.uqi import _uqi_check_inputs, _uqi_compute
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class UniversalImageQualityIndex(Metric):
+    """Universal Image Quality Index (reference ``image/uqi.py:25``).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu import UniversalImageQualityIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> uqi = UniversalImageQualityIndex()
+        >>> float(uqi(preds, target)) > 0.9
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.data_range = data_range
+
+        self._streaming = reduction in ("elementwise_mean", "sum")
+        if self._streaming:
+            self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _uqi_check_inputs(preds, target)
+        if self._streaming:
+            scores = _uqi_compute(preds, target, self.kernel_size, self.sigma, reduction="none")
+            self.score_sum = self.score_sum + scores.sum()
+            self.total = self.total + scores.size
+        else:
+            self.preds.append(preds)
+            self.target.append(target)
+
+    def compute(self) -> Array:
+        if self._streaming:
+            if self.reduction == "sum":
+                return self.score_sum
+            return self.score_sum / self.total
+        return _uqi_compute(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.kernel_size, self.sigma, self.reduction
+        )

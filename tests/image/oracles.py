@@ -1,0 +1,137 @@
+"""Hand-written numpy oracles for the image domain (the reference's tests use
+skimage/hand numpy the same way, ``tests/image/test_ssim.py``)."""
+import numpy as np
+from scipy.signal import convolve2d
+
+
+def np_gaussian_kernel(size: int, sigma: float) -> np.ndarray:
+    dist = np.arange((1 - size) / 2, (1 + size) / 2)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g = g / g.sum()
+    return np.outer(g, g)
+
+
+def _windowed_moments(p: np.ndarray, t: np.ndarray, kern: np.ndarray, pad: int):
+    p = np.pad(p, pad, mode="reflect")
+    t = np.pad(t, pad, mode="reflect")
+    conv = lambda x: convolve2d(x, kern, mode="valid")
+    mu_p, mu_t = conv(p), conv(t)
+    e_pp, e_tt, e_pt = conv(p * p), conv(t * t), conv(p * t)
+    return mu_p, mu_t, e_pp - mu_p**2, e_tt - mu_t**2, e_pt - mu_p * mu_t
+
+
+def np_ssim_per_image(
+    preds, target, data_range=None, sigma=1.5, k1=0.01, k2=0.03, return_cs=False
+):
+    """Per-image (channel-averaged) SSIM scores; mirrors the algorithm spec."""
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    size = int(3.5 * sigma + 0.5) * 2 + 1
+    pad = (size - 1) // 2
+    kern = np_gaussian_kernel(size, sigma)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    scores, cs_scores = [], []
+    for b in range(preds.shape[0]):
+        vals, cs_vals = [], []
+        for c in range(preds.shape[1]):
+            mu_p, mu_t, s_pp, s_tt, s_pt = _windowed_moments(preds[b, c], target[b, c], kern, pad)
+            upper = 2 * s_pt + c2
+            lower = s_pp + s_tt + c2
+            ssim_map = ((2 * mu_p * mu_t + c1) * upper) / ((mu_p**2 + mu_t**2 + c1) * lower)
+            vals.append(ssim_map[pad:-pad, pad:-pad])
+            cs_vals.append((upper / lower)[pad:-pad, pad:-pad])
+        scores.append(np.mean(vals))
+        cs_scores.append(np.mean(cs_vals))
+    if return_cs:
+        return np.asarray(scores), np.asarray(cs_scores)
+    return np.asarray(scores)
+
+
+def np_ssim(preds, target, data_range=None, sigma=1.5):
+    return np_ssim_per_image(preds, target, data_range=data_range, sigma=sigma).mean()
+
+
+def _np_avg_pool2(x):
+    b, c, h, w = x.shape
+    x = x[:, :, : h // 2 * 2, : w // 2 * 2]
+    return x.reshape(b, c, h // 2, 2, w // 2, 2).mean(axis=(3, 5))
+
+
+def np_ms_ssim(preds, target, betas, data_range=1.0, sigma=1.5, normalize="relu"):
+    """Batch-level MS-SSIM: per-scale batch means combined by beta powers."""
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    sims, css = [], []
+    for _ in betas:
+        s, cs = np_ssim_per_image(preds, target, data_range=data_range, sigma=sigma, return_cs=True)
+        sims.append(s.mean())
+        css.append(cs.mean())
+        preds, target = _np_avg_pool2(preds), _np_avg_pool2(target)
+    sims, css = np.asarray(sims), np.asarray(css)
+    if normalize == "relu":
+        sims, css = np.maximum(sims, 0), np.maximum(css, 0)
+    if normalize == "simple":
+        sims, css = (sims + 1) / 2, (css + 1) / 2
+    b = np.asarray(betas)
+    return np.prod(css[:-1] ** b[:-1]) * sims[-1] ** b[-1]
+
+
+def np_uqi(preds, target, sigma=1.5, size=11):
+    """Mean-over-all-pixels UQI."""
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    pad = (size - 1) // 2
+    kern = np_gaussian_kernel(size, sigma)
+    maps = []
+    for b in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            mu_p, mu_t, s_pp, s_tt, s_pt = _windowed_moments(preds[b, c], target[b, c], kern, pad)
+            uqi_map = (2 * mu_p * mu_t * 2 * s_pt) / ((mu_p**2 + mu_t**2) * (s_pp + s_tt))
+            maps.append(uqi_map[pad:-pad, pad:-pad])
+    return np.mean(maps)
+
+
+def np_ergas(preds, target, ratio=4):
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, -1)
+    target = target.reshape(b, c, -1)
+    rmse = np.sqrt(((preds - target) ** 2).sum(-1) / (h * w))
+    mean_t = target.mean(-1)
+    return (100 * ratio * np.sqrt(((rmse / mean_t) ** 2).sum(-1) / c)).mean()
+
+
+def np_sam(preds, target):
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    dot = (preds * target).sum(1)
+    denom = np.linalg.norm(preds, axis=1) * np.linalg.norm(target, axis=1)
+    return np.arccos(np.clip(dot / denom, -1, 1)).mean()
+
+
+def np_psnr(preds, target, data_range=None, base=10.0):
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    if data_range is None:
+        data_range = target.max() - target.min()
+    mse = ((preds - target) ** 2).mean()
+    return (2 * np.log(data_range) - np.log(mse)) * 10 / np.log(base)
+
+
+def np_d_lambda(preds, target, p=1):
+    preds = np.asarray(preds, np.float64)
+    target = np.asarray(target, np.float64)
+    length = preds.shape[1]
+    m1 = np.zeros((length, length))
+    m2 = np.zeros((length, length))
+    for k in range(length):
+        for r in range(length):
+            m1[k, r] = np_uqi(target[:, k : k + 1], target[:, r : r + 1])
+            m2[k, r] = np_uqi(preds[:, k : k + 1], preds[:, r : r + 1])
+    diff = np.abs(m1 - m2) ** p
+    if length == 1:
+        return diff[0, 0] ** (1.0 / p)
+    return (diff.sum() / (length * (length - 1))) ** (1.0 / p)

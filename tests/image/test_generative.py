@@ -1,0 +1,187 @@
+"""FID / KID / InceptionScore / LPIPS with injected extractors, vs scipy/
+numpy oracles (reference ``tests/image/test_{fid,kid,inception,lpips}.py``,
+which use torch-fidelity as oracle; here the oracle is the published formula
+on the extracted features)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.linalg
+
+from metrics_tpu import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+)
+
+D = 8
+_extract = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :D]
+
+
+def _np_fid(feat1, feat2):
+    mu1, mu2 = feat1.mean(0), feat2.mean(0)
+    s1 = np.cov(feat1, rowvar=False)
+    s2 = np.cov(feat2, rowvar=False)
+    diff = mu1 - mu2
+    covmean = scipy.linalg.sqrtm(s1 @ s2)
+    return float(diff @ diff + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean.real))
+
+
+def _np_poly_mmd(f1, f2, degree=3, coef=1.0):
+    gamma = 1.0 / f1.shape[1]
+    k11 = (f1 @ f1.T * gamma + coef) ** degree
+    k22 = (f2 @ f2.T * gamma + coef) ** degree
+    k12 = (f1 @ f2.T * gamma + coef) ** degree
+    m = k11.shape[0]
+    val = ((k11.sum() - np.trace(k11)) + (k22.sum() - np.trace(k22))) / (m * (m - 1))
+    return val - 2 * k12.sum() / (m * m)
+
+
+class TestFID:
+    def test_fid_matches_scipy(self):
+        rng = np.random.default_rng(0)
+        real = rng.normal(0, 1, (200, 3, 4, 4)).astype(np.float32)
+        fake = rng.normal(0.3, 1.2, (200, 3, 4, 4)).astype(np.float32)
+        fid = FrechetInceptionDistance(feature=_extract, feature_dim=D)
+        for chunk in np.split(real, 4):
+            fid.update(jnp.asarray(chunk), real=True)
+        for chunk in np.split(fake, 4):
+            fid.update(jnp.asarray(chunk), real=False)
+        oracle = _np_fid(
+            real.reshape(200, -1)[:, :D].astype(np.float64), fake.reshape(200, -1)[:, :D].astype(np.float64)
+        )
+        np.testing.assert_allclose(float(fid.compute()), oracle, rtol=1e-3, atol=1e-3)
+
+    def test_fid_zero_for_identical(self):
+        rng = np.random.default_rng(1)
+        imgs = jnp.asarray(rng.normal(0, 1, (100, 3, 4, 4)), dtype=jnp.float32)
+        fid = FrechetInceptionDistance(feature=_extract, feature_dim=D)
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        assert abs(float(fid.compute())) < 1e-2
+
+    def test_fid_reset_real_features(self):
+        rng = np.random.default_rng(2)
+        imgs = jnp.asarray(rng.normal(0, 1, (50, 3, 4, 4)), dtype=jnp.float32)
+        fid = FrechetInceptionDistance(feature=_extract, feature_dim=D, reset_real_features=False)
+        fid.update(imgs, real=True)
+        fid.reset()
+        assert int(fid.real_features_num_samples) == 50
+        fid2 = FrechetInceptionDistance(feature=_extract, feature_dim=D)
+        fid2.update(imgs, real=True)
+        fid2.reset()
+        assert int(fid2.real_features_num_samples) == 0
+
+    def test_fid_int_feature_raises(self):
+        with pytest.raises(ModuleNotFoundError):
+            FrechetInceptionDistance(feature=2048)
+
+    def test_fid_streaming_equals_single_shot(self):
+        """Chunked updates give the identical moments as one update."""
+        rng = np.random.default_rng(3)
+        real = jnp.asarray(rng.normal(0, 1, (64, 3, 4, 4)), dtype=jnp.float32)
+        fake = jnp.asarray(rng.normal(0.5, 1, (64, 3, 4, 4)), dtype=jnp.float32)
+        a = FrechetInceptionDistance(feature=_extract, feature_dim=D)
+        a.update(real, real=True)
+        a.update(fake, real=False)
+        b = FrechetInceptionDistance(feature=_extract, feature_dim=D)
+        for i in range(0, 64, 16):
+            b.update(real[i : i + 16], real=True)
+            b.update(fake[i : i + 16], real=False)
+        np.testing.assert_allclose(float(a.compute()), float(b.compute()), rtol=1e-5, atol=1e-5)
+
+
+class TestKID:
+    def test_kid_full_subset_matches_numpy(self):
+        """subset_size == n makes sampling irrelevant (MMD is permutation
+        invariant), so the value must equal the numpy full-set MMD."""
+        rng = np.random.default_rng(0)
+        real = rng.normal(0, 1, (64, 3, 4, 4)).astype(np.float32)
+        fake = rng.normal(0.3, 1.2, (64, 3, 4, 4)).astype(np.float32)
+        kid = KernelInceptionDistance(feature=_extract, subsets=5, subset_size=64)
+        kid.update(jnp.asarray(real), real=True)
+        kid.update(jnp.asarray(fake), real=False)
+        mean, std = kid.compute()
+        oracle = _np_poly_mmd(
+            real.reshape(64, -1)[:, :D].astype(np.float64), fake.reshape(64, -1)[:, :D].astype(np.float64)
+        )
+        np.testing.assert_allclose(float(mean), oracle, rtol=1e-4, atol=1e-5)
+        assert float(std) < 1e-6
+
+    def test_kid_subsets_sane(self):
+        rng = np.random.default_rng(1)
+        real = jnp.asarray(rng.normal(0, 1, (64, 3, 4, 4)), dtype=jnp.float32)
+        fake = jnp.asarray(rng.normal(1.0, 1, (64, 3, 4, 4)), dtype=jnp.float32)
+        kid = KernelInceptionDistance(feature=_extract, subsets=8, subset_size=32)
+        kid.update(real, real=True)
+        kid.update(fake, real=False)
+        mean, std = kid.compute()
+        assert float(mean) > 0 and float(std) >= 0
+
+    def test_kid_too_few_samples_raises(self):
+        kid = KernelInceptionDistance(feature=_extract, subsets=2, subset_size=100)
+        kid.update(jnp.zeros((10, 3, 4, 4)), real=True)
+        kid.update(jnp.zeros((10, 3, 4, 4)), real=False)
+        with pytest.raises(ValueError):
+            kid.compute()
+
+    def test_kid_arg_validation(self):
+        with pytest.raises(ModuleNotFoundError):
+            KernelInceptionDistance(feature=2048)
+        with pytest.raises(ValueError):
+            KernelInceptionDistance(feature=_extract, subsets=0)
+        with pytest.raises(ValueError):
+            KernelInceptionDistance(feature=_extract, coef=-1.0)
+
+
+class TestInceptionScore:
+    def test_is_matches_numpy_single_split(self):
+        rng = np.random.default_rng(0)
+        imgs = rng.normal(0, 3, (64, 10, 1, 1)).astype(np.float32)
+        logits_fn = lambda x: x.reshape(x.shape[0], -1)
+        inception = InceptionScore(feature=logits_fn, splits=1)
+        inception.update(jnp.asarray(imgs))
+        mean, std = inception.compute()
+        logits = imgs.reshape(64, -1).astype(np.float64)
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p = p / p.sum(1, keepdims=True)
+        mean_p = p.mean(0, keepdims=True)
+        kl = (p * (np.log(p) - np.log(mean_p))).sum(1).mean()
+        np.testing.assert_allclose(float(mean), np.exp(kl), rtol=1e-4)
+        assert float(std) == 0.0
+
+    def test_is_uniform_logits_give_one(self):
+        inception = InceptionScore(feature=lambda x: x.reshape(x.shape[0], -1), splits=2)
+        inception.update(jnp.zeros((32, 10, 1, 1)))
+        mean, _ = inception.compute()
+        np.testing.assert_allclose(float(mean), 1.0, rtol=1e-5)
+
+    def test_is_pretrained_raises(self):
+        with pytest.raises(ModuleNotFoundError):
+            InceptionScore()
+
+
+class TestLPIPS:
+    def test_lpips_mean_reduction(self):
+        dist = lambda a, b: jnp.abs(a - b).mean(axis=(1, 2, 3))
+        lpips = LearnedPerceptualImagePatchSimilarity(net=dist)
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.uniform(-1, 1, (8, 3, 8, 8)), dtype=jnp.float32)
+        b = jnp.asarray(rng.uniform(-1, 1, (8, 3, 8, 8)), dtype=jnp.float32)
+        lpips.update(a, b)
+        lpips.update(a, b)
+        oracle = np.abs(np.asarray(a) - np.asarray(b)).mean(axis=(1, 2, 3)).mean()
+        np.testing.assert_allclose(float(lpips.compute()), oracle, rtol=1e-5)
+
+    def test_lpips_validation(self):
+        dist = lambda a, b: jnp.abs(a - b).mean(axis=(1, 2, 3))
+        lpips = LearnedPerceptualImagePatchSimilarity(net=dist)
+        with pytest.raises(ValueError):
+            lpips.update(jnp.zeros((2, 3, 8)), jnp.zeros((2, 3, 8)))
+        with pytest.raises(ValueError):
+            lpips.update(jnp.full((2, 3, 8, 8), 2.0), jnp.zeros((2, 3, 8, 8)))
+        with pytest.raises(ModuleNotFoundError):
+            LearnedPerceptualImagePatchSimilarity()
+        with pytest.raises(ValueError):
+            LearnedPerceptualImagePatchSimilarity(net=dist, net_type="bad")

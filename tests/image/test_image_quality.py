@@ -1,0 +1,189 @@
+"""PSNR / UQI / ERGAS / SAM / D-lambda / image_gradients vs numpy oracles
+(reference ``tests/image/test_{psnr,uqi,ergas,sam,d_lambda}.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.functional import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    universal_image_quality_index,
+)
+from tests.helpers.testers import MetricTester
+from tests.image.oracles import np_d_lambda, np_ergas, np_psnr, np_sam, np_uqi
+
+Input = namedtuple("Input", ["preds", "target"])
+
+NUM_BATCHES = 4
+_rng = np.random.default_rng(7)
+
+_img_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, 4, 3, 24, 24)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, 4, 3, 24, 24)) * 0.8 + 0.1, dtype=jnp.float32),
+)
+
+
+class TestPSNR(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("data_range", [None, 1.0])
+    def test_psnr_class(self, ddp, data_range):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_class=PeakSignalNoiseRatio,
+            sk_metric=partial(np_psnr, data_range=data_range),
+            metric_args={"data_range": data_range},
+            check_batch=data_range is not None,  # batch-local range differs
+        )
+
+    def test_psnr_functional(self):
+        self.run_functional_metric_test(
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_functional=peak_signal_noise_ratio,
+            sk_metric=np_psnr,
+        )
+
+    def test_psnr_dim(self):
+        p, t = _img_inputs.preds[0], _img_inputs.target[0]
+        res = peak_signal_noise_ratio(p, t, data_range=1.0, dim=(1, 2, 3), reduction="none")
+        assert res.shape == (p.shape[0],)
+        oracle = [np_psnr(p[i : i + 1], t[i : i + 1], data_range=1.0) for i in range(p.shape[0])]
+        np.testing.assert_allclose(np.asarray(res), oracle, atol=1e-4)
+        # class path with dim: per-batch partial cat-states
+        m = PeakSignalNoiseRatio(data_range=1.0, dim=(1, 2, 3), reduction="elementwise_mean")
+        m.update(p, t)
+        m.update(_img_inputs.preds[1], _img_inputs.target[1])
+        all_p = jnp.concatenate([p, _img_inputs.preds[1]])
+        all_t = jnp.concatenate([t, _img_inputs.target[1]])
+        oracle_all = np.mean(
+            [np_psnr(all_p[i : i + 1], all_t[i : i + 1], data_range=1.0) for i in range(all_p.shape[0])]
+        )
+        np.testing.assert_allclose(np.asarray(m.compute()), oracle_all, atol=1e-4)
+
+    def test_psnr_errors(self):
+        with pytest.raises(ValueError):
+            PeakSignalNoiseRatio(data_range=None, dim=1)
+
+
+class TestUQI(MetricTester):
+    atol = 2e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_uqi_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_class=UniversalImageQualityIndex,
+            sk_metric=np_uqi,
+        )
+
+    def test_uqi_functional(self):
+        self.run_functional_metric_test(
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_functional=universal_image_quality_index,
+            sk_metric=np_uqi,
+        )
+
+
+class TestERGAS(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ergas_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_class=ErrorRelativeGlobalDimensionlessSynthesis,
+            sk_metric=np_ergas,
+        )
+
+    def test_ergas_functional(self):
+        self.run_functional_metric_test(
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_functional=error_relative_global_dimensionless_synthesis,
+            sk_metric=np_ergas,
+        )
+
+
+class TestSAM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_sam_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_class=SpectralAngleMapper,
+            sk_metric=np_sam,
+        )
+
+    def test_sam_functional(self):
+        self.run_functional_metric_test(
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_functional=spectral_angle_mapper,
+            sk_metric=np_sam,
+        )
+
+    def test_sam_single_channel_raises(self):
+        with pytest.raises(ValueError):
+            spectral_angle_mapper(jnp.zeros((2, 1, 8, 8)), jnp.zeros((2, 1, 8, 8)))
+
+
+class TestDLambda(MetricTester):
+    atol = 2e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_d_lambda_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_class=SpectralDistortionIndex,
+            sk_metric=np_d_lambda,
+        )
+
+    def test_d_lambda_functional(self):
+        self.run_functional_metric_test(
+            preds=_img_inputs.preds,
+            target=_img_inputs.target,
+            metric_functional=spectral_distortion_index,
+            sk_metric=np_d_lambda,
+        )
+
+    def test_d_lambda_invalid_p(self):
+        with pytest.raises(ValueError):
+            spectral_distortion_index(_img_inputs.preds[0], _img_inputs.target[0], p=0)
+
+
+def test_image_gradients():
+    image = jnp.arange(0, 25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(image)
+    assert dy.shape == image.shape and dx.shape == image.shape
+    np.testing.assert_allclose(np.asarray(dy[0, 0, :4]), np.full((4, 5), 5.0))
+    np.testing.assert_allclose(np.asarray(dy[0, 0, 4]), np.zeros(5))
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, :4]), np.full((5, 4), 1.0))
+    with pytest.raises(RuntimeError):
+        image_gradients(jnp.zeros((5, 5)))

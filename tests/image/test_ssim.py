@@ -1,0 +1,141 @@
+"""SSIM / MS-SSIM vs hand-written numpy oracles
+(reference ``tests/image/test_ssim.py``, skimage oracle)."""
+from collections import namedtuple
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure, StructuralSimilarityIndexMeasure
+from metrics_tpu.functional import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from tests.helpers.testers import MetricTester
+from tests.image.oracles import np_ms_ssim, np_ssim
+
+Input = namedtuple("Input", ["preds", "target"])
+
+NUM_BATCHES = 4
+_rng = np.random.default_rng(42)
+
+_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, 4, 2, 24, 24)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, 4, 2, 24, 24)) * 0.8 + 0.1, dtype=jnp.float32),
+)
+
+
+def _sk_ssim(preds, target, data_range=1.0):
+    return np_ssim(preds, target, data_range=data_range)
+
+
+class TestSSIM(MetricTester):
+    atol = 2e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ssim_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_inputs.preds,
+            target=_inputs.target,
+            metric_class=StructuralSimilarityIndexMeasure,
+            sk_metric=_sk_ssim,
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_ssim_functional(self):
+        self.run_functional_metric_test(
+            preds=_inputs.preds,
+            target=_inputs.target,
+            metric_functional=structural_similarity_index_measure,
+            sk_metric=lambda p, t: np_ssim(p, t, data_range=None),
+        )
+
+    def test_ssim_buffer_path_matches_streaming(self):
+        """data_range=None (buffered) on one batch == oracle w/ batch range."""
+        m = StructuralSimilarityIndexMeasure()
+        m.update(_inputs.preds[0], _inputs.target[0])
+        res = m.compute()
+        np.testing.assert_allclose(
+            np.asarray(res), np_ssim(_inputs.preds[0], _inputs.target[0], data_range=None), atol=self.atol
+        )
+
+    def test_ssim_reduction_none(self):
+        res = structural_similarity_index_measure(
+            _inputs.preds[0], _inputs.target[0], data_range=1.0, reduction="none"
+        )
+        assert res.shape == (_inputs.preds.shape[1],)
+
+    def test_ssim_3d(self):
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.random((2, 1, 12, 12, 12)), dtype=jnp.float32)
+        t = p * 0.9
+        res = structural_similarity_index_measure(p, t, data_range=1.0)
+        assert 0.5 < float(res) <= 1.0
+
+    def test_ssim_invalid(self):
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(jnp.zeros((2, 3, 8)), jnp.zeros((2, 3, 8)))
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(
+                jnp.zeros((2, 1, 8, 8)), jnp.zeros((2, 1, 8, 8)), kernel_size=4, gaussian_kernel=False
+            )
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(jnp.zeros((2, 1, 8, 8)), jnp.zeros((2, 1, 8, 8)), sigma=-1.0)
+
+
+_BETAS3 = (0.2, 0.3, 0.5)
+
+_ms_inputs = Input(
+    preds=jnp.asarray(_rng.random((NUM_BATCHES, 2, 1, 48, 48)), dtype=jnp.float32),
+    target=jnp.asarray(_rng.random((NUM_BATCHES, 2, 1, 48, 48)) * 0.8 + 0.1, dtype=jnp.float32),
+)
+
+
+def _sk_ms_ssim(preds, target):
+    return np_ms_ssim(preds, target, betas=_BETAS3, data_range=1.0, normalize=None)
+
+
+class TestMSSSIM(MetricTester):
+    atol = 5e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ms_ssim_class(self, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_ms_inputs.preds,
+            target=_ms_inputs.target,
+            metric_class=MultiScaleStructuralSimilarityIndexMeasure,
+            sk_metric=_sk_ms_ssim,
+            metric_args={"data_range": 1.0, "betas": _BETAS3},
+            check_batch=False,  # per-batch value is prod-of-batch-means, not per-image
+        )
+
+    def test_ms_ssim_functional(self):
+        res = multiscale_structural_similarity_index_measure(
+            _ms_inputs.preds[0], _ms_inputs.target[0], data_range=1.0, betas=_BETAS3
+        )
+        np.testing.assert_allclose(
+            np.asarray(res),
+            np_ms_ssim(_ms_inputs.preds[0], _ms_inputs.target[0], betas=_BETAS3, data_range=1.0, normalize=None),
+            atol=self.atol,
+        )
+
+    def test_ms_ssim_normalize_simple(self):
+        res = multiscale_structural_similarity_index_measure(
+            _ms_inputs.preds[0], _ms_inputs.target[0], data_range=1.0, betas=_BETAS3, normalize="simple"
+        )
+        oracle = np_ms_ssim(
+            _ms_inputs.preds[0], _ms_inputs.target[0], betas=_BETAS3, data_range=1.0, normalize="simple"
+        )
+        np.testing.assert_allclose(np.asarray(res), oracle, atol=self.atol)
+
+    def test_ms_ssim_invalid(self):
+        with pytest.raises(ValueError):
+            multiscale_structural_similarity_index_measure(
+                jnp.zeros((1, 1, 4, 4)), jnp.zeros((1, 1, 4, 4)), betas=_BETAS3
+            )
+        with pytest.raises(ValueError):
+            multiscale_structural_similarity_index_measure(
+                _ms_inputs.preds[0], _ms_inputs.target[0], betas=(0.5, "a")
+            )
