@@ -56,6 +56,19 @@ from metrics_tpu.functional.retrieval.precision import retrieval_precision  # no
 from metrics_tpu.functional.retrieval.r_precision import retrieval_r_precision  # noqa: F401
 from metrics_tpu.functional.retrieval.recall import retrieval_recall  # noqa: F401
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank  # noqa: F401
+from metrics_tpu.functional.text.bert import bert_score  # noqa: F401
+from metrics_tpu.functional.text.bleu import bleu_score  # noqa: F401
+from metrics_tpu.functional.text.cer import char_error_rate  # noqa: F401
+from metrics_tpu.functional.text.chrf import chrf_score  # noqa: F401
+from metrics_tpu.functional.text.eed import extended_edit_distance  # noqa: F401
+from metrics_tpu.functional.text.mer import match_error_rate  # noqa: F401
+from metrics_tpu.functional.text.rouge import rouge_score  # noqa: F401
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score  # noqa: F401
+from metrics_tpu.functional.text.squad import squad  # noqa: F401
+from metrics_tpu.functional.text.ter import translation_edit_rate  # noqa: F401
+from metrics_tpu.functional.text.wer import word_error_rate  # noqa: F401
+from metrics_tpu.functional.text.wil import word_information_lost  # noqa: F401
+from metrics_tpu.functional.text.wip import word_information_preserved  # noqa: F401
 
 __all__ = [
     "cosine_similarity",
@@ -115,4 +128,17 @@ __all__ = [
     "roc",
     "specificity",
     "stat_scores",
+    "bert_score",
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "extended_edit_distance",
+    "match_error_rate",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
 ]

@@ -1,0 +1,21 @@
+"""Functional text metrics.
+
+Text metrics split host/device work the same way the reference does
+implicitly (``torchmetrics/functional/text/``): tokenization and
+string-matching run host-side (strings are not XLA types), and only the
+sufficient statistics live on device as jnp scalars/vectors, so the
+accumulate + distributed-sync path is identical to every other domain.
+"""
+from metrics_tpu.functional.text.bert import bert_score  # noqa: F401
+from metrics_tpu.functional.text.bleu import bleu_score  # noqa: F401
+from metrics_tpu.functional.text.cer import char_error_rate  # noqa: F401
+from metrics_tpu.functional.text.chrf import chrf_score  # noqa: F401
+from metrics_tpu.functional.text.eed import extended_edit_distance  # noqa: F401
+from metrics_tpu.functional.text.mer import match_error_rate  # noqa: F401
+from metrics_tpu.functional.text.rouge import rouge_score  # noqa: F401
+from metrics_tpu.functional.text.sacre_bleu import sacre_bleu_score  # noqa: F401
+from metrics_tpu.functional.text.squad import squad  # noqa: F401
+from metrics_tpu.functional.text.ter import translation_edit_rate  # noqa: F401
+from metrics_tpu.functional.text.wer import word_error_rate  # noqa: F401
+from metrics_tpu.functional.text.wil import word_information_lost  # noqa: F401
+from metrics_tpu.functional.text.wip import word_information_preserved  # noqa: F401

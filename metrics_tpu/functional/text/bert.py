@@ -1,0 +1,264 @@
+"""BERTScore.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/bert.py``
+(``TextDataset`` :136 incl. IDF weighting :178, embedding loop
+``_get_embeddings_and_idf_scale`` :248, greedy matching
+``_get_precision_recall_f1`` :337, baseline rescale :369+, ``bert_score``
+:437): contextual token embeddings are greedily matched by cosine
+similarity; precision averages over hypothesis tokens, recall over reference
+tokens, optionally IDF-weighted and baseline-rescaled.
+
+TPU redesign:
+
+* The model is a **Flax/JAX** encoder — either ``transformers``
+  ``FlaxAutoModel`` (from ``model_name_or_path``) or a user-supplied model +
+  ``user_forward_fn`` returning ``(batch, seq_len, dim)`` jnp arrays — so the
+  forward runs jitted on device (ref runs a torch model inside ``update``).
+* The whole scoring half (normalize -> mask special tokens -> cosine matrix
+  -> idf-weighted greedy match -> P/R/F1) is one jitted kernel over
+  statically-padded ``(B, L)`` token buffers.
+"""
+import csv
+import math
+from collections import Counter, defaultdict
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_DEFAULT_MODEL = "roberta-large"
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
+    """Zero out [CLS] (first) and [SEP] (last non-pad) positions."""
+    mask = attention_mask.at[:, 0].set(0)
+    sep_pos = jnp.argmax(jnp.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
+    return mask.at[jnp.arange(mask.shape[0]), sep_pos].set(0)
+
+
+def _compute_tokens_idf(input_ids: np.ndarray) -> Dict[int, float]:
+    """Token IDF over a corpus: log((N+1) / (df+1)); default log(N+1)."""
+    num_sentences = len(input_ids)
+    counter: Counter = Counter()
+    for row in input_ids:
+        counter.update(set(row.tolist()))
+    idf: Dict[int, float] = defaultdict(lambda: math.log(num_sentences + 1))
+    idf.update({tok: math.log((num_sentences + 1) / (df + 1)) for tok, df in counter.items()})
+    return idf
+
+
+def _idf_matrix(input_ids: np.ndarray, tokens_idf: Dict[int, float]) -> np.ndarray:
+    lookup = np.vectorize(lambda t: tokens_idf[int(t)])
+    return lookup(input_ids).astype(np.float32)
+
+
+@partial(jax.jit, static_argnames=("idf",))
+def _bert_score_kernel(
+    preds_emb: Array,
+    preds_mask: Array,
+    preds_idf: Array,
+    target_emb: Array,
+    target_mask: Array,
+    target_idf: Array,
+    idf: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Greedy cosine matching -> per-sentence (precision, recall, f1).
+
+    Shapes: ``*_emb (B, S, D)``, ``*_mask/(idf) (B, S)``. Embeddings at
+    masked positions are zeroed so they never win a max.
+    """
+    preds_mask = _process_attention_mask_for_special_tokens(preds_mask)
+    target_mask = _process_attention_mask_for_special_tokens(target_mask)
+
+    def _prep(emb, mask, idf_w):
+        emb = emb / jnp.maximum(jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+        emb = emb * mask[..., None]
+        weight = idf_w * mask if idf else mask.astype(emb.dtype)
+        weight = weight / jnp.maximum(weight.sum(-1, keepdims=True), 1e-12)
+        return emb, weight
+
+    preds_emb, preds_w = _prep(preds_emb, preds_mask, preds_idf)
+    target_emb, target_w = _prep(target_emb, target_mask, target_idf)
+
+    cos_sim = jnp.einsum("bpd, brd -> bpr", preds_emb, target_emb)
+    precision = (cos_sim.max(axis=2) * preds_w).sum(-1)
+    recall = (cos_sim.max(axis=1) * target_w).sum(-1)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    f1 = jnp.where(precision + recall > 0, f1, 0.0)
+    return precision, recall, f1
+
+
+def _default_forward(model: Any, input_ids: Array, attention_mask: Array, num_layers: Optional[int]) -> Array:
+    """Forward through a transformers Flax model, picking one hidden layer."""
+    out = model(input_ids=input_ids, attention_mask=attention_mask, output_hidden_states=True)
+    return jnp.asarray(out.hidden_states[num_layers if num_layers is not None else -1])
+
+
+def _get_embeddings(
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    model: Any,
+    batch_size: int,
+    num_layers: Optional[int],
+    user_forward_fn: Optional[Callable],
+) -> Array:
+    """Host batching loop around the (jitted) encoder forward."""
+    chunks = []
+    for start in range(0, len(input_ids), batch_size):
+        ids = jnp.asarray(input_ids[start : start + batch_size])
+        mask = jnp.asarray(attention_mask[start : start + batch_size])
+        if user_forward_fn is not None:
+            out = user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
+            if out.ndim != 3 or out.shape[:2] != ids.shape[:2]:
+                raise ValueError(
+                    "The model output must be a jnp array of shape [batch_size, seq_len, model_dim], "
+                    f"i.e. [{ids.shape[0]}, {ids.shape[1]}, model_dim], but got {out.shape}."
+                )
+        else:
+            out = _default_forward(model, ids, mask, num_layers)
+        chunks.append(out)
+    return jnp.concatenate(chunks) if chunks else jnp.zeros((0, 0, 0))
+
+
+def _load_tokenizer_and_model(model_name_or_path: str) -> Tuple[Any, Any]:
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` with default models requires the `transformers` package; "
+            "otherwise pass your own `model`, `user_tokenizer` and `user_forward_fn`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    return tokenizer, model
+
+
+def _tokenize(tokenizer: Any, text: List[str], max_length: int, own_tokenizer: bool) -> Dict[str, np.ndarray]:
+    if own_tokenizer:
+        data = tokenizer(text, max_length)
+    else:
+        data = tokenizer(text, padding="max_length", max_length=max_length, truncation=True, return_tensors="np")
+    return {"input_ids": np.asarray(data["input_ids"]), "attention_mask": np.asarray(data["attention_mask"])}
+
+
+def _read_csv_baseline(baseline_path: str) -> Array:
+    with open(baseline_path) as fname:
+        rows = [[float(x) for x in row] for i, row in enumerate(csv.reader(fname)) if i > 0]
+    return jnp.asarray(rows)[:, 1:]
+
+
+def _rescale_with_baseline(
+    precision: Array, recall: Array, f1: Array, baseline: Array, num_layers: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    """(x - b) / (1 - b) per metric, using the requested layer's baseline row."""
+    scale = baseline[num_layers if num_layers is not None else -1]
+    stack = jnp.stack([precision, recall, f1], axis=-1)
+    stack = (stack - scale) / (1 - scale)
+    return stack[..., 0], stack[..., 1], stack[..., 2]
+
+
+def bert_score(
+    preds: Union[List[str], Dict[str, np.ndarray]],
+    target: Union[List[str], Dict[str, np.ndarray]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    model: Optional[Any] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    idf: bool = False,
+    max_length: int = 512,
+    batch_size: int = 64,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore: greedy contextual-embedding matching by cosine similarity.
+
+    ``preds``/``target`` are raw sentences (tokenized here) or pre-tokenized
+    ``{"input_ids", "attention_mask"}`` dicts. Returns per-sentence
+    precision/recall/f1 lists (API parity with the reference).
+    """
+    if model is None and model_name_or_path is None:
+        rank_zero_warn(
+            f"The argument `model_name_or_path` was not specified while it is required when the default "
+            f"`transformers` model is used. It will use the default recommended model - {_DEFAULT_MODEL!r}."
+        )
+        model_name_or_path = _DEFAULT_MODEL
+    if model is None:
+        tokenizer, model = _load_tokenizer_and_model(model_name_or_path)
+    else:
+        tokenizer = user_tokenizer
+        if tokenizer is None and not isinstance(preds, dict):
+            raise ValueError("A `user_tokenizer` must be provided with a user `model` and raw-text inputs.")
+
+    own_tokenizer = user_tokenizer is not None
+    if isinstance(preds, dict):
+        preds_tok = {"input_ids": np.asarray(preds["input_ids"]), "attention_mask": np.asarray(preds["attention_mask"])}
+    else:
+        preds_tok = _tokenize(tokenizer, list(preds), max_length, own_tokenizer)
+    if isinstance(target, dict):
+        target_tok = {
+            "input_ids": np.asarray(target["input_ids"]),
+            "attention_mask": np.asarray(target["attention_mask"]),
+        }
+    else:
+        target_tok = _tokenize(tokenizer, list(target), max_length, own_tokenizer)
+
+    if len(preds_tok["input_ids"]) != len(target_tok["input_ids"]):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    # IDF weights are computed on the reference corpus (bert_score convention)
+    if idf:
+        tokens_idf = _compute_tokens_idf(target_tok["input_ids"])
+        preds_idf = _idf_matrix(preds_tok["input_ids"], tokens_idf)
+        target_idf = _idf_matrix(target_tok["input_ids"], tokens_idf)
+    else:
+        preds_idf = np.ones_like(preds_tok["input_ids"], dtype=np.float32)
+        target_idf = np.ones_like(target_tok["input_ids"], dtype=np.float32)
+
+    preds_emb = _get_embeddings(
+        preds_tok["input_ids"], preds_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn
+    )
+    target_emb = _get_embeddings(
+        target_tok["input_ids"], target_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn
+    )
+
+    precision, recall, f1 = _bert_score_kernel(
+        preds_emb,
+        jnp.asarray(preds_tok["attention_mask"], dtype=jnp.float32),
+        jnp.asarray(preds_idf),
+        target_emb,
+        jnp.asarray(target_tok["attention_mask"], dtype=jnp.float32),
+        jnp.asarray(target_idf),
+        idf=idf,
+    )
+
+    if rescale_with_baseline:
+        if baseline_path is None:
+            # The reference resolves a baseline from (lang, model_name_or_path)
+            # by downloading it; this build is offline-only, so an explicit
+            # local csv is required for rescaling to take effect.
+            rank_zero_warn(
+                f"`rescale_with_baseline` requires a local `baseline_path` (remote baseline lookup by "
+                f"lang={lang!r}/model is not supported); returning unrescaled scores."
+            )
+        else:
+            baseline = _read_csv_baseline(baseline_path)
+            precision, recall, f1 = _rescale_with_baseline(precision, recall, f1, baseline, num_layers)
+
+    output: Dict[str, Union[List[float], str]] = {
+        "precision": [float(x) for x in precision],
+        "recall": [float(x) for x in recall],
+        "f1": [float(x) for x in f1],
+    }
+    if return_hash:
+        output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+    return output

@@ -1,0 +1,44 @@
+"""Char error rate.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/cer.py``
+(``_cer_update`` :23, ``_cer_compute`` :51, ``char_error_rate`` :63).
+Characters (including spaces) are the edit-distance alphabet, matching the
+reference's ``list(pred)`` tokenization (``cer.py:43-47``).
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Host-side: corpus -> (total char edit operations, total reference chars)."""
+    preds, target = _normalize_corpus(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate of transcriptions; 0 is a perfect score.
+
+    Example:
+        >>> from metrics_tpu.functional import char_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> char_error_rate(preds=preds, target=target)
+        Array(0.34146342, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

@@ -1,0 +1,45 @@
+"""Match error rate.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/mer.py``
+(``_mer_update`` :23, ``_mer_compute`` :53, ``match_error_rate`` :65).
+Denominator is ``max(len(target), len(pred))`` per sample.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Host-side: corpus -> (total edit operations, total max-length words)."""
+    preds, target = _normalize_corpus(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate of transcriptions; 0 is a perfect score.
+
+    Example:
+        >>> from metrics_tpu.functional import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds=preds, target=target)
+        Array(0.44444445, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

@@ -1,0 +1,291 @@
+"""ROUGE score.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/rouge.py``
+(``_rouge_score_update`` :260, ``_rouge_score_compute`` :373, ``rouge_score``
+:390), following the official ROUGE definitions (Lin 2004) and the
+google-research ``rouge_scorer`` behavior it mirrors: rouge1..9 n-gram F,
+rougeL (sentence LCS), rougeLsum (summary-level union-LCS over sentences,
+nltk sentence splitting).
+
+Redesign notes: the LCS DP rows are numpy-vectorized via the running-max
+identity ``cur = maximum.accumulate(max(prev, shift(prev) + match))`` (valid
+because LCS tables are monotone, so the dropped candidates are dominated).
+Unlike the reference, nltk sentence-splitting is only invoked when a
+``Lsum`` key is actually requested.
+"""
+import re
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.helper import _encode_tokens
+from metrics_tpu.utilities.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
+    "rouge1": 1,
+    "rouge2": 2,
+    "rouge3": 3,
+    "rouge4": 4,
+    "rouge5": 5,
+    "rouge6": 6,
+    "rouge7": 7,
+    "rouge8": 8,
+    "rouge9": 9,
+    "rougeL": "L",
+    "rougeLsum": "Lsum",
+}
+ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
+
+
+def _split_sentence(x: str) -> Sequence[str]:
+    """Sentence split for summary-level rougeLsum.
+
+    Uses nltk's punkt tokenizer when its data is available; otherwise falls
+    back to newline + sentence-punctuation boundaries (the newline split is
+    what the google ``rouge_scorer`` package uses for rougeLsum).
+    """
+    x = re.sub("<n>", "", x)  # remove pegasus newline char
+    if _NLTK_AVAILABLE:
+        import nltk
+
+        try:
+            return nltk.sent_tokenize(x)
+        except LookupError:
+            pass
+    return [s for s in re.split(r"(?<=[.!?])\s+|\n", x) if s.strip()]
+
+
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    """(hits, |pred|, |target|) -> precision/recall/fmeasure dict."""
+    precision = hits_or_lcs / pred_len
+    recall = hits_or_lcs / target_len
+    if precision == recall == 0.0:
+        return dict(precision=0.0, recall=0.0, fmeasure=0.0)
+    return dict(precision=precision, recall=recall, fmeasure=2 * precision * recall / (precision + recall))
+
+
+def _lcs_table(pred: Sequence[str], target: Sequence[str]) -> np.ndarray:
+    """Full LCS DP table, rows vectorized; shape (|target|+1, |pred|+1)."""
+    p, t = _encode_tokens(pred, target)
+    table = np.zeros((len(t) + 1, len(p) + 1), dtype=np.int64)
+    for i in range(1, len(t) + 1):
+        prev = table[i - 1]
+        diag = prev[:-1] + (p == t[i - 1])
+        table[i, 1:] = np.maximum.accumulate(np.maximum(prev[1:], diag))
+    return table
+
+
+def _lcs_length(pred: Sequence[str], target: Sequence[str]) -> int:
+    return int(_lcs_table(pred, target)[-1, -1])
+
+
+def _backtracked_lcs(lcs_table: np.ndarray, pred: Sequence[str], target: Sequence[str]) -> List[int]:
+    """Indices (into target) of one longest common subsequence."""
+    i, j = len(pred), len(target)
+    out: List[int] = []
+    while i > 0 and j > 0:
+        if pred[i - 1] == target[j - 1]:
+            out.append(j - 1)
+            i -= 1
+            j -= 1
+        elif lcs_table[j][i - 1] > lcs_table[j - 1][i]:
+            i -= 1
+        else:
+            j -= 1
+    return out[::-1]
+
+
+def _union_lcs(pred_sentences: Sequence[Sequence[str]], target_sentence: Sequence[str]) -> List[str]:
+    """Union of per-pred-sentence LCS hits against one target sentence."""
+    indices = set()
+    for pred in pred_sentences:
+        indices.update(_backtracked_lcs(_lcs_table(pred, target_sentence), pred, target_sentence))
+    return [target_sentence[i] for i in sorted(indices)]
+
+
+def _normalize_and_tokenize_text(
+    text: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Sequence[str]:
+    """Lowercase-alnum normalize (or custom), split, optionally Porter-stem."""
+    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
+    if stemmer:
+        # only stem words longer than 3 chars (rouge_scorer convention)
+        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
+    return [x for x in tokens if isinstance(x, str) and len(x) > 0]
+
+
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
+    """N-gram overlap precision/recall/F."""
+
+    def _ngrams(tokens: Sequence[str], n: int) -> Counter:
+        return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+    pred_ngrams, target_ngrams = _ngrams(pred, n_gram), _ngrams(target, n_gram)
+    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
+    if 0 in (pred_len, target_len):
+        return dict(precision=0.0, recall=0.0, fmeasure=0.0)
+    hits = sum((pred_ngrams & target_ngrams).values())
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
+    """Sentence-level LCS precision/recall/F."""
+    if 0 in (len(pred), len(target)):
+        return dict(precision=0.0, recall=0.0, fmeasure=0.0)
+    return _compute_metrics(_lcs_length(pred, target), len(pred), len(target))
+
+
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
+    """Summary-level union-LCS precision/recall/F (google rouge_scorer semantics)."""
+    pred_len = sum(map(len, pred))
+    target_len = sum(map(len, target))
+    if 0 in (pred_len, target_len):
+        return dict(precision=0.0, recall=0.0, fmeasure=0.0)
+
+    pred_counts: Counter = Counter()
+    target_counts: Counter = Counter()
+    for sentence in pred:
+        pred_counts.update(sentence)
+    for sentence in target:
+        target_counts.update(sentence)
+
+    hits = 0
+    for tgt in target:
+        for token in _union_lcs(pred, tgt):
+            if pred_counts[token] > 0 and target_counts[token] > 0:
+                hits += 1
+                pred_counts[token] -= 1
+                target_counts[token] -= 1
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    rouge_keys_values: List[Union[int, str]],
+    accumulate: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
+    """Per-sample scores for every requested key.
+
+    Multi-reference policy: ``best`` keeps the reference with the highest
+    first-key fmeasure; ``avg`` averages each stat over references.
+    """
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
+    want_lsum = "Lsum" in rouge_keys_values
+
+    for pred_raw, targets_raw in zip(preds, target):
+        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        pred_lsum = (
+            [
+                _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer)
+                for s in _split_sentence(pred_raw)
+            ]
+            if want_lsum
+            else []
+        )
+
+        per_ref: List[Dict[Union[int, str], Dict[str, float]]] = []
+        for target_raw in targets_raw:
+            tgt = _normalize_and_tokenize_text(target_raw, stemmer, normalizer, tokenizer)
+            scores: Dict[Union[int, str], Dict[str, float]] = {}
+            for key in rouge_keys_values:
+                if isinstance(key, int):
+                    scores[key] = _rouge_n_score(pred, tgt, key)
+                elif key == "L":
+                    scores[key] = _rouge_l_score(pred, tgt)
+                else:  # Lsum
+                    tgt_lsum = [
+                        _normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer)
+                        for s in _split_sentence(target_raw)
+                    ]
+                    scores[key] = _rouge_lsum_score(pred_lsum, tgt_lsum)
+            per_ref.append(scores)
+
+        if accumulate == "best":
+            first_key = rouge_keys_values[0]
+            best_idx = int(np.argmax([s[first_key]["fmeasure"] for s in per_ref]))
+            for key in rouge_keys_values:
+                results[key].append(per_ref[best_idx][key])
+        else:  # avg
+            for key in rouge_keys_values:
+                results[key].append(
+                    {
+                        stat: float(np.mean([s[key][stat] for s in per_ref]))
+                        for stat in ("precision", "recall", "fmeasure")
+                    }
+                )
+    return results
+
+
+def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+    """Mean over accumulated per-sample stats."""
+    return {key: jnp.mean(jnp.concatenate(scores)) for key, scores in sentence_results.items() if scores}
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    accumulate: str = "best",
+    use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+) -> Dict[str, Array]:
+    """ROUGE score (rouge1..9, rougeL, rougeLsum).
+
+    Example:
+        >>> from metrics_tpu.functional import rouge_score
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> rouge = rouge_score(preds, target, rouge_keys="rouge1")
+        >>> round(float(rouge["rouge1_fmeasure"]), 4)
+        0.75
+    """
+    if use_stemmer and not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+    stemmer = None
+    if use_stemmer:
+        import nltk
+
+        stemmer = nltk.stem.porter.PorterStemmer()
+
+    if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+        raise ValueError(
+            f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+        )
+    if isinstance(rouge_keys, str):
+        rouge_keys = (rouge_keys,)
+    for key in rouge_keys:
+        if key not in ALLOWED_ROUGE_KEYS:
+            raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+    rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+
+    if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+        target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [[target]]
+
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate, stemmer, normalizer, tokenizer
+    )
+    output: Dict[str, Array] = {}
+    for key, scores in sentence_results.items():
+        for stat in ("precision", "recall", "fmeasure"):
+            output[f"rouge{key}_{stat}"] = jnp.asarray(
+                np.mean([s[stat] for s in scores]) if scores else 0.0, dtype=jnp.float32
+            )
+    return output

@@ -1,0 +1,160 @@
+"""SacreBLEU score: BLEU with canonical sacrebleu tokenizers.
+
+Behavioral equivalent of reference
+``torchmetrics/functional/text/sacre_bleu.py`` (``_SacreBLEUTokenizer`` :82,
+``sacre_bleu_score`` :262). The tokenizers follow the published sacrebleu
+spec (mteval-v13a, international, char, none, zh); shares the BLEU
+statistics/compute kernels.
+"""
+import re
+import string
+from functools import lru_cache
+from typing import Sequence, Union
+
+import jax
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from metrics_tpu.utilities.imports import _REGEX_AVAILABLE
+
+Array = jax.Array
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+# CJK ranges used by the sacrebleu `zh` tokenizer to isolate Chinese
+# characters before the western-language regex pass. Kept as STRING pairs
+# compared lexicographically — including the astral-plane entries written as
+# surrogate-free 2-char strings (" 0" == " " + "0") — because
+# sacrebleu's published tokenizer compares this way, and the comparison quirk
+# (e.g. U+201C/U+2026 punctuation matching the " 0" entry) is part of
+# its observable tokenization behavior.
+_CJK_RANGES = (
+    ("\u3400", "\u4db5"),  # CJK Unified Ideographs Extension A
+    ("\u4e00", "\u9fa5"),  # CJK Unified Ideographs
+    ("\u9fa6", "\u9fbb"),
+    ("\uf900", "\ufa2d"),  # CJK Compatibility Ideographs
+    ("\ufa30", "\ufa6a"),
+    ("\ufa70", "\ufad9"),
+    ("\u20000", "\u2a6d6"),  # Extension B as 2-char strings (see note above)
+    ("\u2f800", "\u2fa1d"),
+    ("\uff00", "\uffef"),  # full-width ASCII + half-width kana
+    ("\u2e80", "\u2eff"),  # CJK Radicals Supplement
+    ("\u3000", "\u303f"),  # CJK punctuation
+    ("\u31c0", "\u31ef"),  # CJK strokes
+    ("\u2f00", "\u2fdf"),  # Kangxi Radicals
+    ("\u2ff0", "\u2fff"),
+    ("\u3100", "\u312f"),  # phonetic symbols
+    ("\u31a0", "\u31bf"),
+    ("\ufe10", "\ufe1f"),
+    ("\ufe30", "\ufe4f"),
+    ("\u2600", "\u26ff"),
+    ("\u2700", "\u27bf"),
+    ("\u3200", "\u32ff"),
+    ("\u3300", "\u33ff"),
+)
+
+_13A_REGEXES = (
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+)
+
+if _REGEX_AVAILABLE:
+    import regex
+
+    _INTL_REGEXES = (
+        (regex.compile(r"(\P{N})(\p{P})"), r"\1 \2 "),
+        (regex.compile(r"(\p{P})(\P{N})"), r" \1 \2"),
+        (regex.compile(r"(\p{S})"), r" \1 "),
+    )
+
+
+def _apply_regexes(line: str, regexes) -> str:
+    for pattern, repl in regexes:
+        line = pattern.sub(repl, line)
+    return " ".join(line.split())
+
+
+def _is_chinese_char(char: str) -> bool:
+    return any(lo <= char <= hi for lo, hi in _CJK_RANGES)
+
+
+class _SacreBLEUTokenizer:
+    """Canonical sacrebleu tokenizers (13a/intl/char/none/zh)."""
+
+    def __init__(self, tokenize: str = "13a", lowercase: bool = False) -> None:
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Unsupported tokenizer selected. Please, choose one of {AVAILABLE_TOKENIZERS}")
+        if tokenize == "intl" and not _REGEX_AVAILABLE:
+            raise ModuleNotFoundError(
+                "`'intl'` tokenization requires the `regex` package; install it with `pip install regex`."
+            )
+        self.tokenize = tokenize
+        self.lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        tokenized = getattr(self, f"_tokenize_{self.tokenize}")(line)
+        if self.lowercase:
+            tokenized = tokenized.lower()
+        return tokenized.split()
+
+    @staticmethod
+    def _tokenize_none(line: str) -> str:
+        return line
+
+    @staticmethod
+    def _tokenize_char(line: str) -> str:
+        return " ".join(char for char in line)
+
+    @classmethod
+    @lru_cache(maxsize=2**16)
+    def _tokenize_13a(cls, line: str) -> str:
+        # mteval-v13a: unescape entities, drop skipped markers, then the
+        # language-dependent regex pass
+        line = line.replace("<skipped>", "").replace("-\n", "").replace("\n", " ")
+        if "&" in line:
+            line = (
+                line.replace("&quot;", '"').replace("&amp;", "&").replace("&lt;", "<").replace("&gt;", ">")
+            )
+        return _apply_regexes(line, _13A_REGEXES)
+
+    @classmethod
+    @lru_cache(maxsize=2**16)
+    def _tokenize_intl(cls, line: str) -> str:
+        return _apply_regexes(line, _INTL_REGEXES)
+
+    @classmethod
+    @lru_cache(maxsize=2**16)
+    def _tokenize_zh(cls, line: str) -> str:
+        line = line.strip()
+        out = []
+        for char in line:
+            if _is_chinese_char(char):
+                out.append(f" {char} ")
+            else:
+                out.append(char)
+        return _apply_regexes("".join(out), _13A_REGEXES)
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+) -> Array:
+    """BLEU with sacrebleu-canonical tokenization.
+
+    Example:
+        >>> from metrics_tpu.functional import sacre_bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu_score(preds, target)
+        Array(0.7598357, dtype=float32)
+    """
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds, target, n_gram, tokenizer)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)

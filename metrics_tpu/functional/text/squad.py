@@ -1,0 +1,139 @@
+"""SQuAD exact-match / F1.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/squad.py``
+(``_normalize_text`` :41, ``_compute_f1_score`` :66, ``_squad_update`` :131,
+``squad`` :197), following the official SQuAD v1.1 evaluation script
+semantics (lowercase, strip punctuation/articles, token-level F1, max over
+ground truths).
+"""
+import re
+import string
+from collections import Counter
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+SINGLE_PRED_TYPE = Dict[str, str]
+PREDS_TYPE = Union[SINGLE_PRED_TYPE, List[SINGLE_PRED_TYPE]]
+SINGLE_TARGET_TYPE = Dict[str, Any]
+TARGETS_TYPE = Union[SINGLE_TARGET_TYPE, List[SINGLE_TARGET_TYPE]]
+
+_ARTICLES_RE = re.compile(r"\b(a|an|the)\b")
+_PUNCT_TABLE = str.maketrans("", "", string.punctuation)
+
+
+def _normalize_text(s: str) -> str:
+    """Lowercase, remove punctuation/articles, collapse whitespace."""
+    return " ".join(_ARTICLES_RE.sub(" ", s.lower().translate(_PUNCT_TABLE)).split())
+
+
+def _get_tokens(s: str) -> List[str]:
+    return _normalize_text(s).split() if s else []
+
+
+def _f1_score(prediction: str, ground_truth: str) -> float:
+    """Token-overlap F1 between one prediction and one answer."""
+    pred_tokens = _get_tokens(prediction)
+    target_tokens = _get_tokens(ground_truth)
+    if len(target_tokens) == 0 or len(pred_tokens) == 0:
+        # a no-answer scores 1 only if both are no-answers
+        return float(target_tokens == pred_tokens)
+    num_same = sum((Counter(target_tokens) & Counter(pred_tokens)).values())
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(pred_tokens)
+    recall = num_same / len(target_tokens)
+    return 2 * precision * recall / (precision + recall)
+
+
+def _exact_match_score(prediction: str, ground_truth: str) -> float:
+    return float(_normalize_text(prediction) == _normalize_text(ground_truth))
+
+
+def _squad_input_check(
+    preds: PREDS_TYPE, targets: TARGETS_TYPE
+) -> Tuple[Dict[str, str], List[Dict[str, Any]]]:
+    """Validate + convert inputs to {id: prediction} and SQuAD-format dataset."""
+    if isinstance(preds, dict):
+        preds = [preds]
+    if isinstance(targets, dict):
+        targets = [targets]
+    for pred in preds:
+        keys = pred.keys()
+        if "prediction_text" not in keys or "id" not in keys:
+            raise KeyError(
+                "Expected keys in a single prediction are 'prediction_text' and 'id'. "
+                "Please make sure that 'prediction' maps to both 'prediction_text' and 'id'."
+            )
+    for target in targets:
+        keys = target.keys()
+        if "answers" not in keys or "id" not in keys:
+            raise KeyError(
+                "Expected keys in a single target are 'answers' and 'id'. "
+                "Please make sure that 'target' maps to both 'answers' and 'id'."
+            )
+        answers_keys = target["answers"].keys()
+        if "text" not in answers_keys:
+            raise KeyError(
+                "Expected keys in a 'answers' are 'text'. "
+                "Please make sure that 'answer' maps to 'text'."
+            )
+
+    preds_dict = {p["id"]: p["prediction_text"] for p in preds}
+    _fn_answer = lambda tgt: {"answers": [{"text": txt} for txt in tgt["answers"]["text"]], "id": tgt["id"]}  # noqa: E731
+    targets_dict = [{"paragraphs": [{"qas": [_fn_answer(t) for t in targets]}]}]
+    return preds_dict, targets_dict
+
+
+def _squad_update(
+    preds: Dict[str, str],
+    target: List[Dict[str, Any]],
+) -> Tuple[Array, Array, Array]:
+    """Host-side: (f1 sum, exact-match sum, count) over all questions."""
+    f1 = 0.0
+    exact_match = 0.0
+    total = 0
+    for article in target:
+        for paragraph in article["paragraphs"]:
+            for qa in paragraph["qas"]:
+                total += 1
+                if qa["id"] not in preds:
+                    rank_zero_warn(f"Unanswered question {qa['id']} will receive score 0.")
+                    continue
+                ground_truths = [x["text"] for x in qa["answers"]]
+                pred = preds[qa["id"]]
+                exact_match += max(_exact_match_score(pred, t) for t in ground_truths)
+                f1 += max(_f1_score(pred, t) for t in ground_truths)
+    return (
+        jnp.asarray(f1, dtype=jnp.float32),
+        jnp.asarray(exact_match, dtype=jnp.float32),
+        jnp.asarray(total, dtype=jnp.int32),
+    )
+
+
+def _squad_compute(f1_score: Array, exact_match: Array, total: Array) -> Dict[str, Array]:
+    """Scale sums to percentages."""
+    return {
+        "exact_match": 100.0 * exact_match / total,
+        "f1": 100.0 * f1_score / total,
+    }
+
+
+def squad(preds: PREDS_TYPE, target: TARGETS_TYPE) -> Dict[str, Array]:
+    """SQuAD v1.1 exact-match and F1.
+
+    Example:
+        >>> from metrics_tpu.functional import squad
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> squad(preds, target)
+        {'exact_match': Array(100., dtype=float32), 'f1': Array(100., dtype=float32)}
+    """
+    preds_dict, target_dict = _squad_input_check(preds, target)
+    f1, exact_match, total = _squad_update(preds_dict, target_dict)
+    return _squad_compute(f1, exact_match, total)

@@ -1,0 +1,47 @@
+"""Word error rate.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/wer.py``
+(``_wer_update`` :23, ``_wer_compute`` :51, ``word_error_rate`` :63).
+Tokenization + Levenshtein run host-side; the sufficient statistics
+(edit-op count, reference word count) are returned as jnp scalars so the
+stateful class accumulates and psum-syncs them like any other sum state.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Host-side: corpus -> (total edit operations, total reference words)."""
+    preds, target = _normalize_corpus(preds, target)
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate of ASR transcriptions; 0 is a perfect score.
+
+    Example:
+        >>> from metrics_tpu.functional import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_error_rate(preds=preds, target=target)
+        Array(0.5, dtype=float32)
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

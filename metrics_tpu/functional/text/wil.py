@@ -1,0 +1,62 @@
+"""Word information lost.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/wil.py``
+(``_wil_update`` :23, ``_wil_compute`` :56, ``word_information_lost`` :70).
+
+Redesign note: the reference accumulates ``edit_distance - max_total`` — the
+*negative* hit count — and relies on the sign cancelling when squared in
+compute (``wil.py:66``: ``1 - (E/tt)*(E/pt)``). Here the state is the
+positive hit count ``H = sum_i max(|t_i|, |p_i|) - d_i`` and
+
+    WIP = (H / target_total) * (H / preds_total),   WIL = 1 - WIP
+
+which is the same value with a sum-reducible, sign-honest state.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+
+Array = jax.Array
+
+
+def _word_info_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Host-side: corpus -> (hits, total target words, total pred words)."""
+    preds, target = _normalize_corpus(preds, target)
+    hits = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors = _edit_distance(pred_tokens, tgt_tokens)
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+        hits += max(len(tgt_tokens), len(pred_tokens)) - errors
+    return (
+        jnp.asarray(hits, dtype=jnp.float32),
+        jnp.asarray(target_total, dtype=jnp.float32),
+        jnp.asarray(preds_total, dtype=jnp.float32),
+    )
+
+
+def _wil_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - (hits / target_total) * (hits / preds_total)
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost; 0 is a perfect score.
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_lost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_lost(preds, target)
+        Array(0.6527778, dtype=float32)
+    """
+    hits, target_total, preds_total = _word_info_update(preds, target)
+    return _wil_compute(hits, target_total, preds_total)

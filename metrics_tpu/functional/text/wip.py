@@ -1,0 +1,32 @@
+"""Word information preserved.
+
+Behavioral equivalent of reference ``torchmetrics/functional/text/wip.py``
+(``_wip_update`` :22, ``_wip_compute`` :55, ``word_information_preserved``
+:69). Shares the hit-count update with WIL; see ``wil.py`` for the
+sign-honest state redesign.
+"""
+from typing import List, Union
+
+import jax
+
+from metrics_tpu.functional.text.wil import _word_info_update
+
+Array = jax.Array
+
+
+def _wip_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:
+    return (hits / target_total) * (hits / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved; 1 is a perfect score.
+
+    Example:
+        >>> from metrics_tpu.functional import word_information_preserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_preserved(preds, target)
+        Array(0.34722224, dtype=float32)
+    """
+    hits, target_total, preds_total = _word_info_update(preds, target)
+    return _wip_compute(hits, target_total, preds_total)

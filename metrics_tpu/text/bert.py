@@ -1,0 +1,120 @@
+"""BERTScore metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/bert.py:40``: states
+are the tokenized input buffers (``input_ids``/``attention_mask`` cat
+states, statically padded to ``max_length`` so the distributed all-gather is
+shape-stable), and the encoder forward + matching kernel run in ``compute``.
+"""
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.bert import _DEFAULT_MODEL, _load_tokenizer_and_model, _tokenize, bert_score
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore with a Flax/JAX encoder.
+
+    Args:
+        model_name_or_path: transformers model id (loaded as ``FlaxAutoModel``).
+        num_layers: hidden layer to take embeddings from (default: last).
+        model: a user's own (Flax) model; combine with ``user_tokenizer`` and
+            ``user_forward_fn``.
+        user_tokenizer: callable ``(List[str], max_length) -> {"input_ids",
+            "attention_mask"}`` of numpy/jnp arrays, padded to max_length.
+        user_forward_fn: callable ``(model, batch_dict) -> (B, S, D)`` jnp array.
+        idf: weight token matches by inverse document frequency.
+        max_length: static pad length for the token buffers.
+        batch_size: encoder forward batch size inside ``compute``.
+        rescale_with_baseline: rescale with a precomputed baseline csv.
+        baseline_path: local path of the baseline csv.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        model: Optional[Any] = None,
+        user_tokenizer: Any = None,
+        user_forward_fn: Optional[Callable] = None,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if model is None and model_name_or_path is None:
+            rank_zero_warn(
+                f"The argument `model_name_or_path` was not specified while it is required when the default "
+                f"`transformers` model is used. It will use the default recommended model - {_DEFAULT_MODEL!r}."
+            )
+            model_name_or_path = _DEFAULT_MODEL
+        if model is None:
+            self.tokenizer, self.model = _load_tokenizer_and_model(model_name_or_path)
+        else:
+            self.tokenizer = user_tokenizer
+            self.model = model
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: List[str], target: List[str]) -> None:
+        """Tokenize and buffer the sentences (model forward is deferred to compute)."""
+        own_tokenizer = self.user_tokenizer is not None
+        preds_tok = _tokenize(self.tokenizer, list(preds), self.max_length, own_tokenizer)
+        target_tok = _tokenize(self.tokenizer, list(target), self.max_length, own_tokenizer)
+        self.preds_input_ids.append(jnp.asarray(preds_tok["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(preds_tok["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(target_tok["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(target_tok["attention_mask"]))
+
+    def compute(self) -> Dict[str, Union[List[float], str]]:
+        return bert_score(
+            preds={
+                "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.preds_attention_mask)),
+            },
+            target={
+                "input_ids": np.asarray(dim_zero_cat(self.target_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.target_attention_mask)),
+            },
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            model=self.model,
+            user_forward_fn=self.user_forward_fn,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+        )

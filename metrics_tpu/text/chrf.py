@@ -1,0 +1,96 @@
+"""CHRFScore metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/chrf.py:46``; the
+per-order scalar-dict states become six sum-reduced count vectors (see
+``functional/text/chrf.py`` redesign note).
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """chrF / chrF++ score; six per-order count-vector sum states.
+
+    Args:
+        n_char_order: character n-gram order (6 = official chrF/chrF++).
+        n_word_order: word n-gram order (2 = chrF++, 0 = chrF).
+        beta: recall weight in the F-score.
+        lowercase: case-insensitive matching.
+        whitespace: keep whitespace in char n-grams.
+        return_sentence_level_score: also return per-sentence scores.
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> chrf = CHRFScore()
+        >>> chrf(preds, target)
+        Array(0.8640465, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("matching_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("matching_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("hyp_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("hyp_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("ref_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("ref_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        scores: Optional[list] = [] if self.return_sentence_level_score else None
+        m_char, m_word, h_char, h_word, r_char, r_word = _chrf_score_update(
+            preds, target, self.n_char_order, self.n_word_order, self.beta, self.lowercase, self.whitespace, scores
+        )
+        self.matching_char = self.matching_char + m_char
+        self.matching_word = self.matching_word + m_word
+        self.hyp_char = self.hyp_char + h_char
+        self.hyp_word = self.hyp_word + h_word
+        self.ref_char = self.ref_char + r_char
+        self.ref_word = self.ref_word + r_word
+        if scores is not None:
+            self.sentence_chrf_score = self.sentence_chrf_score + scores
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _chrf_score_compute(
+            self.matching_char, self.matching_word, self.hyp_char, self.hyp_word, self.ref_char, self.ref_word, self.beta
+        )
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_chrf_score)
+        return score

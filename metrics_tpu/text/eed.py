@@ -1,0 +1,76 @@
+"""ExtendedEditDistance metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/eed.py:24``.
+"""
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """Extended edit distance; per-sentence scores as a cat state.
+
+    Args:
+        language: 'en' or 'ja'.
+        return_sentence_level_score: also return per-sentence EED.
+        alpha: jump penalty.
+        rho: coverage (repetition) penalty.
+        deletion: deletion penalty.
+        insertion: insertion/substitution penalty.
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> eed(preds=preds, target=target)
+        Array(0.30776307, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for name, param in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        self.sentence_eed = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, self.sentence_eed
+        )
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        average = _eed_compute(self.sentence_eed)
+        if self.return_sentence_level_score:
+            return average, dim_zero_cat(self.sentence_eed)
+        return average

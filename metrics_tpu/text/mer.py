@@ -1,0 +1,43 @@
+"""MatchErrorRate metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/mer.py:24``.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.mer import _mer_compute, _mer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MatchErrorRate(Metric):
+    """Match error rate; O(1) sum states, psum-synced over the mesh.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> metric = MatchErrorRate()
+        >>> metric(preds, target)
+        Array(0.44444445, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _mer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _mer_compute(self.errors, self.total)

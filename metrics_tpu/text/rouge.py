@@ -1,0 +1,113 @@
+"""ROUGEScore metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/rouge.py:31``; per-key
+per-sample (precision, recall, fmeasure) rows accumulate in cat states.
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.imports import _NLTK_AVAILABLE
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """ROUGE (rouge1..9 / rougeL / rougeLsum).
+
+    Each requested key keeps three cat states (``<key>_precision`` etc.) of
+    per-sample scores; compute averages them. ``dist_reduce_fx="cat"`` makes
+    the distributed path an all-gather of score vectors.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> result = rouge(preds, target)
+        >>> round(float(result["rouge1_fmeasure"]), 4)
+        0.75
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer and not _NLTK_AVAILABLE:
+            raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(
+                    f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}"
+                )
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.stemmer = None
+        if use_stemmer:
+            import nltk
+
+            self.stemmer = nltk.stem.porter.PorterStemmer()
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+
+        for rouge_key in self.rouge_keys:
+            for stat in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{stat}", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str], Sequence[Sequence[str]]]
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+
+        output = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            self.accumulate,
+            self.stemmer,
+            self.normalizer,
+            self.tokenizer,
+        )
+        for key, key_value in zip(self.rouge_keys, self.rouge_keys_values):
+            for score in output[key_value]:
+                for stat in ("fmeasure", "precision", "recall"):
+                    state = getattr(self, f"{key}_{stat}")
+                    state.append(jnp.asarray([score[stat]], dtype=jnp.float32))
+
+    def compute(self) -> Dict[str, Array]:
+        stats = {
+            f"{key}_{stat}": getattr(self, f"{key}_{stat}")
+            for key in self.rouge_keys
+            for stat in ("fmeasure", "precision", "recall")
+        }
+        return _rouge_score_compute(stats)

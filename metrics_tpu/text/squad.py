@@ -1,0 +1,52 @@
+"""SQuAD metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/squad.py:29``.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SQuAD(Metric):
+    """SQuAD v1.1 exact-match / F1; O(1) sum states, psum-synced over the mesh.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> squad = SQuAD()
+        >>> squad(preds, target)
+        {'exact_match': Array(100., dtype=float32), 'f1': Array(100., dtype=float32)}
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
+        preds_dict, targets_dict = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, targets_dict)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact_match
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

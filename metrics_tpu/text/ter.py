@@ -1,0 +1,80 @@
+"""TranslationEditRate metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/ter.py:24``.
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TranslationEditRate(Metric):
+    """Translation edit rate; scalar sum states + optional per-sentence cat state.
+
+    Args:
+        normalize: apply general Tercom tokenization.
+        no_punctuation: strip punctuation before scoring.
+        lowercase: case-insensitive matching.
+        asian_support: split CJK characters during tokenization.
+        return_sentence_level_score: also return per-sentence TER.
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> ter = TranslationEditRate()
+        >>> ter(preds, target)
+        Array(0.15384616, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        for name, value in (
+            ("normalize", normalize),
+            ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase),
+            ("asian_support", asian_support),
+        ):
+            if not isinstance(value, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {value}")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]
+    ) -> None:
+        scores: Optional[list] = [] if self.return_sentence_level_score else None
+        num_edits, tgt_length = _ter_update(preds, target, self.tokenizer, scores)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_length = self.total_tgt_length + tgt_length
+        if scores is not None:
+            self.sentence_ter = self.sentence_ter + scores
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_length)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_ter)
+        return score

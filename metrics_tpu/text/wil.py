@@ -1,0 +1,46 @@
+"""WordInfoLost metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/wil.py:23``; state is
+the positive hit count (see ``functional/text/wil.py`` redesign note).
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wil import _wil_compute, _word_info_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordInfoLost(Metric):
+    """Word information lost; O(1) sum states, psum-synced over the mesh.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> metric = WordInfoLost()
+        >>> metric(preds, target)
+        Array(0.6527778, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("hits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        hits, target_total, preds_total = _word_info_update(preds, target)
+        self.hits = self.hits + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wil_compute(self.hits, self.target_total, self.preds_total)

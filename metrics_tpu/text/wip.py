@@ -1,0 +1,47 @@
+"""WordInfoPreserved metric class.
+
+Behavioral equivalent of reference ``torchmetrics/text/wip.py:23``; state is
+the positive hit count (see ``functional/text/wil.py`` redesign note).
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wil import _word_info_update
+from metrics_tpu.functional.text.wip import _wip_compute
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved; O(1) sum states, psum-synced over the mesh.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> metric = WordInfoPreserved()
+        >>> metric(preds, target)
+        Array(0.34722224, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("hits", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        hits, target_total, preds_total = _word_info_update(preds, target)
+        self.hits = self.hits + hits
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.hits, self.target_total, self.preds_total)

@@ -1,0 +1,82 @@
+"""TextTester — oracle-comparison runners for string-input metrics.
+
+TPU-native analogue of the reference's ``tests/text/helpers.py:226``
+(``TextTester``): same lifecycle coverage as ``MetricTester`` but batches are
+lists of strings (concatenation = list concat) instead of stacked tensors.
+"""
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+from tests.helpers.testers import NUM_PROCESSES, _assert_allclose, _wire_virtual_ddp
+
+
+def _concat(batches: Sequence[Any]) -> list:
+    out: list = []
+    for b in batches:
+        out.extend(b)
+    return out
+
+
+class TextTester:
+    """Single-process, virtual-DDP, and functional runners for text metrics."""
+
+    atol: float = 1e-6
+
+    def run_functional_metric_test(
+        self,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence[Any]],
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        metric_args = metric_args or {}
+        metric = partial(metric_functional, **metric_args)
+        for pred_batch, target_batch in zip(preds, targets):
+            tpu_result = metric(pred_batch, target_batch)
+            sk_result = sk_metric(pred_batch, target_batch)
+            _assert_allclose(tpu_result, sk_result, atol=self.atol)
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds: Sequence[Sequence[str]],
+        targets: Sequence[Sequence[Any]],
+        metric_class: type,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+    ) -> None:
+        """Batch-strided forward across W virtual ranks; compute vs oracle on all data."""
+        metric_args = metric_args or {}
+        world_size = NUM_PROCESSES if ddp else 1
+        num_batches = len(preds)
+
+        metrics = [metric_class(**metric_args) for _ in range(world_size)]
+        import pickle
+
+        pickle.loads(pickle.dumps(metrics[0]))
+        if ddp:
+            _wire_virtual_ddp(metrics)
+
+        for i in range(0, num_batches, world_size):
+            batch_indices = list(range(i, min(i + world_size, num_batches)))
+            for rank, bi in enumerate(batch_indices):
+                batch_result = metrics[rank].forward(preds[bi], targets[bi])
+                if check_batch:
+                    sk_batch = sk_metric(preds[bi], targets[bi])
+                    _assert_allclose(batch_result, sk_batch, atol=self.atol)
+
+        result = metrics[0].compute()
+        gather_order = [i for rank in range(world_size) for i in range(rank, num_batches, world_size)]
+        all_preds = _concat([preds[i] for i in gather_order])
+        all_targets = _concat([targets[i] for i in gather_order])
+        sk_result = sk_metric(all_preds, all_targets)
+        _assert_allclose(result, sk_result, atol=self.atol)
+
+        if ddp:
+            for m in metrics[1:]:
+                _assert_allclose(m.compute(), sk_result, atol=self.atol)
+
+        metrics[0].reset()
+        assert metrics[0]._update_count == 0

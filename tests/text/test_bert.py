@@ -1,0 +1,171 @@
+"""BERTScore with a user-supplied model vs a hand-computed numpy oracle
+(reference ``tests/text/test_bertscore.py`` + the
+``tm_examples/bert_score-own_model.py`` own-model pattern; no pretrained
+weights are downloadable here, so a deterministic embedding model stands in
+for the encoder)."""
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import bert_score
+from metrics_tpu.text import BERTScore
+
+MAX_LENGTH = 8
+DIM = 16
+_CLS, _SEP, _PAD = 0, 1, 2
+_VOCAB_OFFSET = 3
+
+_rng = np.random.default_rng(123)
+_EMBED_TABLE = _rng.normal(size=(64, DIM)).astype(np.float32)
+
+
+def _tokenize(texts: List[str], max_length: int) -> Dict[str, np.ndarray]:
+    """[CLS] w1 w2 ... [SEP] padded with [PAD]; word ids are hash-bucketed."""
+    input_ids = np.full((len(texts), max_length), _PAD, dtype=np.int32)
+    attention_mask = np.zeros((len(texts), max_length), dtype=np.int32)
+    for row, text in enumerate(texts):
+        ids = [_CLS] + [
+            _VOCAB_OFFSET + (hash(w) % (len(_EMBED_TABLE) - _VOCAB_OFFSET)) for w in text.split()
+        ]
+        ids = ids[: max_length - 1] + [_SEP]
+        input_ids[row, : len(ids)] = ids
+        attention_mask[row, : len(ids)] = 1
+    return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _forward(model, batch):
+    """Deterministic 'encoder': embedding lookup (model is the table)."""
+    return jnp.asarray(model[np.asarray(batch["input_ids"])])
+
+
+def _np_bert_score(preds: List[str], target: List[str], idf: bool = False):
+    """Independent numpy implementation of greedy cosine matching."""
+    p_tok = _tokenize(preds, MAX_LENGTH)
+    t_tok = _tokenize(target, MAX_LENGTH)
+
+    def _special_mask(tok):
+        mask = tok["attention_mask"].astype(np.float64).copy()
+        for r in range(mask.shape[0]):
+            mask[r, 0] = 0  # CLS
+            sep = int(tok["attention_mask"][r].sum()) - 1
+            mask[r, sep] = 0  # SEP
+        return mask
+
+    if idf:
+        n = len(target)
+        df: Dict[int, int] = {}
+        for row in t_tok["input_ids"]:
+            for t in set(row.tolist()):
+                df[t] = df.get(t, 0) + 1
+        idf_fn = lambda t: np.log((n + 1) / (df.get(t, 0) + 1))  # noqa: E731
+    else:
+        idf_fn = lambda t: 1.0  # noqa: E731
+
+    precisions, recalls, f1s = [], [], []
+    for r in range(len(preds)):
+        p_mask = _special_mask(p_tok)[r]
+        t_mask = _special_mask(t_tok)[r]
+        p_emb = _EMBED_TABLE[p_tok["input_ids"][r]].astype(np.float64)
+        t_emb = _EMBED_TABLE[t_tok["input_ids"][r]].astype(np.float64)
+        p_emb /= np.linalg.norm(p_emb, axis=-1, keepdims=True)
+        t_emb /= np.linalg.norm(t_emb, axis=-1, keepdims=True)
+        p_emb *= p_mask[:, None]
+        t_emb *= t_mask[:, None]
+        sim = p_emb @ t_emb.T
+        p_w = np.array([idf_fn(t) for t in p_tok["input_ids"][r]]) * p_mask
+        t_w = np.array([idf_fn(t) for t in t_tok["input_ids"][r]]) * t_mask
+        p_w /= p_w.sum()
+        t_w /= t_w.sum()
+        precision = float((sim.max(axis=1) * p_w).sum())
+        recall = float((sim.max(axis=0) * t_w).sum())
+        f1 = 2 * precision * recall / (precision + recall) if precision + recall else 0.0
+        precisions.append(precision)
+        recalls.append(recall)
+        f1s.append(f1)
+    return {"precision": precisions, "recall": recalls, "f1": f1s}
+
+
+_PREDS = [
+    ["hello there friend", "the cat sat on the mat"],
+    ["a completely different sentence", "hello there friend"],
+]
+_TARGET = [
+    ["hi there buddy", "a cat was on the mat"],
+    ["nothing in common here", "hello there friend"],
+]
+
+
+@pytest.mark.parametrize("idf", [False, True])
+def test_functional_own_model(idf):
+    for preds, target in zip(_PREDS, _TARGET):
+        got = bert_score(
+            preds,
+            target,
+            model=_EMBED_TABLE,
+            user_tokenizer=_tokenize,
+            user_forward_fn=_forward,
+            idf=idf,
+            max_length=MAX_LENGTH,
+        )
+        want = _np_bert_score(preds, target, idf=idf)
+        for key in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+
+
+def test_identical_sentences_score_one():
+    got = bert_score(
+        ["same sentence here"],
+        ["same sentence here"],
+        model=_EMBED_TABLE,
+        user_tokenizer=_tokenize,
+        user_forward_fn=_forward,
+        max_length=MAX_LENGTH,
+    )
+    np.testing.assert_allclose(got["f1"], [1.0], atol=1e-5)
+
+
+@pytest.mark.parametrize("idf", [False, True])
+def test_class_accumulates(idf):
+    metric = BERTScore(
+        model=_EMBED_TABLE,
+        user_tokenizer=_tokenize,
+        user_forward_fn=_forward,
+        idf=idf,
+        max_length=MAX_LENGTH,
+    )
+    for preds, target in zip(_PREDS, _TARGET):
+        metric.update(preds, target)
+    got = metric.compute()
+    all_preds = _PREDS[0] + _PREDS[1]
+    all_target = _TARGET[0] + _TARGET[1]
+    want = _np_bert_score(all_preds, all_target, idf=idf)
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(got[key], want[key], atol=1e-5, err_msg=key)
+
+
+def test_return_hash():
+    got = bert_score(
+        ["a"],
+        ["a"],
+        model=_EMBED_TABLE,
+        user_tokenizer=_tokenize,
+        user_forward_fn=_forward,
+        max_length=MAX_LENGTH,
+        return_hash=True,
+        model_name_or_path="own-model",
+    )
+    assert got["hash"] == "own-model_LNone_no-idf"
+
+
+def test_mismatched_corpus_sizes():
+    with pytest.raises(ValueError, match="Number of predicted and reference"):
+        bert_score(
+            ["a", "b"],
+            ["a"],
+            model=_EMBED_TABLE,
+            user_tokenizer=_tokenize,
+            user_forward_fn=_forward,
+            max_length=MAX_LENGTH,
+        )

@@ -1,0 +1,100 @@
+"""ROUGE vs the rouge-score package oracle
+(reference ``tests/text/test_rouge.py``)."""
+import numpy as np
+import pytest
+from rouge_score.rouge_scorer import RougeScorer
+
+from metrics_tpu.functional import rouge_score
+from metrics_tpu.text import ROUGEScore
+from tests.text.helpers import TextTester
+
+ROUGE_KEYS = ("rouge1", "rouge2", "rougeL", "rougeLsum")
+
+_preds_b1 = [
+    "My name is John",
+    "The quick brown fox jumps over the lazy dog .\nIt was a sunny day today .",
+]
+_targets_b1 = [
+    ["Is your name John", "My name is indeed John"],
+    ["A quick brown fox jumped over a lazy dog .\nToday was a sunny day .", "The dog was lazy ."],
+]
+_preds_b2 = [
+    "the cat was found under the bed",
+    "global warming affects the entire planet .\nWe must act now .",
+]
+_targets_b2 = [
+    ["the cat was hiding under the bed", "the tiny cat hid under the bed"],
+    ["climate change affects the whole planet .\nAction must happen now .", "the planet is warming ."],
+]
+BATCHES_PREDS = [_preds_b1, _preds_b2]
+BATCHES_TARGET = [_targets_b1, _targets_b2]
+
+
+def _oracle(preds, targets, use_stemmer=False, accumulate="best"):
+    """Per-sample rouge-score results averaged with a plain mean.
+
+    (The package's BootstrapAggregator ``mid`` is a stochastic bootstrap
+    percentile, so the mean is taken directly instead.)
+    """
+    scorer = RougeScorer(ROUGE_KEYS, use_stemmer=use_stemmer)
+    per_sample = {f"{k}_{s}": [] for k in ROUGE_KEYS for s in ("precision", "recall", "fmeasure")}
+    for pred, refs in zip(preds, targets):
+        refs = [refs] if isinstance(refs, str) else refs
+        results = [scorer.score(ref, pred) for ref in refs]
+        if accumulate == "best":
+            key0 = ROUGE_KEYS[0]
+            best = int(np.argmax([r[key0].fmeasure for r in results]))
+            chosen = {
+                f"{k}_{s}": getattr(results[best][k], s)
+                for k in ROUGE_KEYS
+                for s in ("precision", "recall", "fmeasure")
+            }
+        else:
+            chosen = {
+                f"{k}_{s}": float(np.mean([getattr(r[k], s) for r in results]))
+                for k in ROUGE_KEYS
+                for s in ("precision", "recall", "fmeasure")
+            }
+        for k, v in chosen.items():
+            per_sample[k].append(v)
+    return {k: float(np.mean(v)) for k, v in per_sample.items()}
+
+
+class TestROUGE(TextTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    @pytest.mark.parametrize("accumulate", ["best", "avg"])
+    def test_functional_vs_rouge_score(self, use_stemmer, accumulate):
+        for preds, targets in zip(BATCHES_PREDS, BATCHES_TARGET):
+            got = rouge_score(preds, targets, use_stemmer=use_stemmer, accumulate=accumulate)
+            want = _oracle(preds, targets, use_stemmer=use_stemmer, accumulate=accumulate)
+            for key, value in want.items():
+                np.testing.assert_allclose(float(got[key]), value, atol=1e-5, err_msg=key)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(ddp, BATCHES_PREDS, BATCHES_TARGET, ROUGEScore, _oracle)
+
+    def test_single_string_inputs(self):
+        got = rouge_score("My name is John", "Is your name John", rouge_keys="rouge1")
+        np.testing.assert_allclose(float(got["rouge1_fmeasure"]), 0.75, atol=1e-6)
+
+    def test_custom_normalizer_tokenizer(self):
+        """tm_examples/rouge_score-own_normalizer_and_tokenizer.py pattern."""
+        got = rouge_score(
+            "ABC def",
+            "abc DEF",
+            rouge_keys="rouge1",
+            normalizer=lambda s: s.upper(),
+            tokenizer=lambda s: s.split(),
+        )
+        np.testing.assert_allclose(float(got["rouge1_fmeasure"]), 1.0, atol=1e-6)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            rouge_score(["a"], ["b"], rouge_keys="rouge42")
+        with pytest.raises(ValueError):
+            rouge_score(["a"], ["b"], accumulate="bestest")
+        with pytest.raises(ValueError):
+            ROUGEScore(rouge_keys="rouge42")

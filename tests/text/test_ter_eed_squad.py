@@ -1,0 +1,204 @@
+"""TER vs sacrebleu oracle, EED vs an independent cell-loop DP oracle,
+SQuAD vs hand-computed values
+(reference ``tests/text/test_{ter,eed,squad}.py``)."""
+from math import inf
+
+import numpy as np
+import pytest
+from sacrebleu.metrics import TER
+
+from metrics_tpu.functional import extended_edit_distance, squad, translation_edit_rate
+from metrics_tpu.functional.text.eed import _preprocess_en
+from metrics_tpu.text import SQuAD, ExtendedEditDistance, TranslationEditRate
+from tests.text.helpers import TextTester
+
+_preds_b1 = ["the cat is on the mat", "There is a big tree near the house."]
+_targets_b1 = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["A big tree is growing near the house.", "There is a tree close to the building."],
+]
+_preds_b2 = ["hello there general kenobi", "the fast brown fox jumped over the lazy dog"]
+_targets_b2 = [
+    ["hello there general kenobi", "hello there!"],
+    ["the quick brown fox jumped over the lazy dog", "the fast brown fox leaps over a dog"],
+]
+BATCHES_PREDS = [_preds_b1, _preds_b2]
+BATCHES_TARGET = [_targets_b1, _targets_b2]
+
+
+def _to_sacre_refs(targets):
+    n_refs = max(len(t) for t in targets)
+    return [[t[i] if i < len(t) else t[-1] for t in targets] for i in range(n_refs)]
+
+
+def _make_ter_oracle(normalized=False, no_punct=False, case_sensitive=False, asian_support=False):
+    def oracle(preds, targets):
+        ter = TER(
+            normalized=normalized,
+            no_punct=no_punct,
+            case_sensitive=case_sensitive,
+            asian_support=asian_support,
+        )
+        return ter.corpus_score(list(preds), _to_sacre_refs(targets)).score / 100
+
+    return oracle
+
+
+class TestTER(TextTester):
+    @pytest.mark.parametrize(
+        "normalize, no_punctuation, lowercase",
+        [(False, False, True), (True, False, True), (False, True, True), (False, False, False)],
+    )
+    def test_functional_vs_sacrebleu(self, normalize, no_punctuation, lowercase):
+        oracle = _make_ter_oracle(normalized=normalize, no_punct=no_punctuation, case_sensitive=not lowercase)
+        for preds, targets in zip(BATCHES_PREDS, BATCHES_TARGET):
+            got = float(
+                translation_edit_rate(
+                    preds, targets, normalize=normalize, no_punctuation=no_punctuation, lowercase=lowercase
+                )
+            )
+            np.testing.assert_allclose(got, oracle(preds, targets), atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(ddp, BATCHES_PREDS, BATCHES_TARGET, TranslationEditRate, _make_ter_oracle())
+
+    def test_shift_reduces_edits(self):
+        """A pure phrase move costs 1 shift, not per-word edits."""
+        # "d e a b c" -> shift "a b c" to front = 1 shift + 2 edits? vs plain lev 4
+        got = float(translation_edit_rate(["d e a b c"], [["a b c d e"]]))
+        ter = TER()
+        want = ter.corpus_score(["d e a b c"], [["a b c d e"]]).score / 100
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_sentence_level(self):
+        score, sentences = translation_edit_rate(_preds_b1, _targets_b1, return_sentence_level_score=True)
+        assert sentences.shape == (2,)
+        ter = TER()
+        for i, (pred, refs) in enumerate(zip(_preds_b1, _targets_b1)):
+            want = ter.sentence_score(pred, refs).score / 100
+            np.testing.assert_allclose(float(sentences[i]), want, atol=1e-6)
+
+
+def _ref_eed_function(hyp, ref, alpha=2.0, rho=0.3, deletion=0.2, insertion=1.0):
+    """Independent plain-Python EED DP (published RWTH algorithm, cell loop)."""
+    number_of_visits = [-1] * (len(hyp) + 1)
+    row = [1.0] * (len(hyp) + 1)
+    row[0] = 0.0
+    next_row = [inf] * (len(hyp) + 1)
+    for w in range(1, len(ref) + 1):
+        for i in range(0, len(hyp) + 1):
+            if i > 0:
+                next_row[i] = min(
+                    next_row[i - 1] + deletion,
+                    row[i - 1] + (0 if hyp[i - 1] == ref[w - 1] else 1),
+                    row[i] + insertion,
+                )
+            else:
+                next_row[i] = row[i] + 1.0
+        min_index = next_row.index(min(next_row))
+        number_of_visits[min_index] += 1
+        if ref[w - 1] == " ":
+            jump = alpha + next_row[min_index]
+            next_row = [min(x, jump) for x in next_row]
+        row = next_row
+        next_row = [inf] * (len(hyp) + 1)
+    coverage = rho * sum(x if x >= 0 else 1 for x in number_of_visits)
+    return min(1, (row[-1] + coverage) / (float(len(ref)) + coverage))
+
+
+def _ref_eed(preds, targets):
+    scores = []
+    for pred, refs in zip(preds, targets):
+        refs = [refs] if isinstance(refs, str) else refs
+        scores.append(min(_ref_eed_function(_preprocess_en(pred), _preprocess_en(r)) for r in refs))
+    return float(np.mean(scores))
+
+
+class TestEED(TextTester):
+    def test_functional_vs_cell_loop_oracle(self):
+        for preds, targets in zip(BATCHES_PREDS, BATCHES_TARGET):
+            got = float(extended_edit_distance(preds, targets))
+            np.testing.assert_allclose(got, _ref_eed(preds, targets), atol=1e-6)
+
+    def test_random_strings_vs_oracle(self):
+        """Fuzz the vectorized DP against the cell loop.
+
+        Costs are dyadic (0.25/1.0/2.0) so both arithmetics are exact: with
+        the default 0.2 costs the reference's chained additions accumulate
+        float noise that breaks coverage-argmin ties arbitrarily, which is
+        tie-break noise, not an algorithmic difference.
+        """
+        rng = np.random.default_rng(7)
+        letters = list("ab c")
+        kw = dict(alpha=2.0, rho=0.25, deletion=0.25, insertion=1.0)
+        for _ in range(50):
+            hyp = "".join(rng.choice(letters, size=rng.integers(0, 15)))
+            ref = "".join(rng.choice(letters, size=rng.integers(1, 15)))
+            got = float(extended_edit_distance([hyp], [[ref]], **kw))
+            want = np.mean([min(_ref_eed_function(_preprocess_en(hyp), _preprocess_en(ref), **kw), 1)])
+            np.testing.assert_allclose(got, want, atol=1e-6)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(ddp, BATCHES_PREDS, BATCHES_TARGET, ExtendedEditDistance, _ref_eed)
+
+    def test_reference_doctest_value(self):
+        preds = ["this is the prediction", "here is an other sample"]
+        target = ["this is the reference", "here is another one"]
+        np.testing.assert_allclose(float(extended_edit_distance(preds, target)), 0.3078, atol=1e-4)
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            extended_edit_distance(["a"], [["b"]], alpha=-1.0)
+        with pytest.raises(ValueError):
+            ExtendedEditDistance(language="fr")
+
+
+_squad_preds = [
+    {"prediction_text": "1976", "id": "id1"},
+    {"prediction_text": "Hello World", "id": "id2"},
+    {"prediction_text": "totally wrong", "id": "id3"},
+]
+_squad_target = [
+    {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"},
+    {"answers": {"answer_start": [0], "text": ["hello world!", "Hi World"]}, "id": "id2"},
+    {"answers": {"answer_start": [0], "text": ["right answer"]}, "id": "id3"},
+]
+
+
+class TestSQuAD:
+    def test_functional_values(self):
+        result = squad(_squad_preds, _squad_target)
+        # EM: id1 exact, id2 exact after normalization (case/punct), id3 wrong
+        np.testing.assert_allclose(float(result["exact_match"]), 100 * 2 / 3, atol=1e-4)
+        # F1: id1=1, id2=1 (best gt), id3=0
+        np.testing.assert_allclose(float(result["f1"]), 100 * 2 / 3, atol=1e-4)
+
+    def test_partial_f1(self):
+        preds = [{"prediction_text": "the quick brown fox", "id": "a"}]
+        target = [{"answers": {"answer_start": [0], "text": ["quick brown dog"]}, "id": "a"}]
+        result = squad(preds, target)
+        assert float(result["exact_match"]) == 0.0
+        # "the" is stripped as an article: p = r = 2/3 -> f1 = 2/3
+        np.testing.assert_allclose(float(result["f1"]), 100 * 2 / 3, atol=1e-4)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        from tests.helpers.testers import _wire_virtual_ddp
+
+        world = 2 if ddp else 1
+        metrics = [SQuAD() for _ in range(world)]
+        if ddp:
+            _wire_virtual_ddp(metrics)
+        for i, (p, t) in enumerate(zip(_squad_preds, _squad_target)):
+            metrics[i % world].update([p], [t])
+        result = metrics[0].compute()
+        np.testing.assert_allclose(float(result["exact_match"]), 100 * 2 / 3, atol=1e-4)
+        np.testing.assert_allclose(float(result["f1"]), 100 * 2 / 3, atol=1e-4)
+
+    def test_missing_keys_raise(self):
+        with pytest.raises(KeyError):
+            squad([{"id": "x"}], _squad_target[:1])
+        with pytest.raises(KeyError):
+            squad(_squad_preds[:1], [{"id": "x"}])

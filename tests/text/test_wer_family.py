@@ -1,0 +1,108 @@
+"""WER/CER/MER/WIL/WIP vs an independent numpy oracle
+(reference ``tests/text/test_{wer,cer,mer,wil,wip}.py``; jiwer is unavailable
+offline, so the oracle is a straightforward hand-written Levenshtein DP like
+the reference's ``tests/helpers/reference_metrics.py`` gap-fillers)."""
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.text import CharErrorRate, MatchErrorRate, WordErrorRate, WordInfoLost, WordInfoPreserved
+from tests.text.helpers import TextTester
+
+_preds_b1 = ["hello world", "the quick brown fox jumps over the lazy dog", "exact match here"]
+_target_b1 = ["hello beautiful world", "the quick brown fox jumped over a lazy dog", "exact match here"]
+_preds_b2 = ["one two three", "completely different words entirely", ""]
+_target_b2 = ["one three two", "nothing in common at all today", "non empty reference"]
+
+BATCHES_PREDS = [_preds_b1, _preds_b2]
+BATCHES_TARGET = [_target_b1, _target_b2]
+
+
+def _np_edit_distance(a, b):
+    """Plain O(mn) cell-by-cell Levenshtein (independent of the package impl)."""
+    dp = np.zeros((len(a) + 1, len(b) + 1), dtype=np.int64)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(
+                dp[i - 1, j] + 1,
+                dp[i, j - 1] + 1,
+                dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]),
+            )
+    return int(dp[-1, -1])
+
+
+def _ref_wer(preds, target):
+    errs = sum(_np_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    total = sum(len(t.split()) for t in target)
+    return errs / total
+
+
+def _ref_cer(preds, target):
+    errs = sum(_np_edit_distance(list(p), list(t)) for p, t in zip(preds, target))
+    total = sum(len(t) for t in target)
+    return errs / total
+
+
+def _ref_mer(preds, target):
+    errs = sum(_np_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    total = sum(max(len(t.split()), len(p.split())) for p, t in zip(preds, target))
+    return errs / total
+
+
+def _ref_wip(preds, target):
+    hits = sum(
+        max(len(t.split()), len(p.split())) - _np_edit_distance(p.split(), t.split())
+        for p, t in zip(preds, target)
+    )
+    tt = sum(len(t.split()) for t in target)
+    pt = sum(len(p.split()) for p in preds)
+    return (hits / tt) * (hits / pt)
+
+
+def _ref_wil(preds, target):
+    return 1 - _ref_wip(preds, target)
+
+
+_CASES = [
+    pytest.param(WordErrorRate, word_error_rate, _ref_wer, id="wer"),
+    pytest.param(CharErrorRate, char_error_rate, _ref_cer, id="cer"),
+    pytest.param(MatchErrorRate, match_error_rate, _ref_mer, id="mer"),
+    pytest.param(WordInfoLost, word_information_lost, _ref_wil, id="wil"),
+    pytest.param(WordInfoPreserved, word_information_preserved, _ref_wip, id="wip"),
+]
+
+
+class TestWERFamily(TextTester):
+    @pytest.mark.parametrize("metric_class, metric_fn, ref_fn", _CASES)
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, ref_fn, ddp):
+        self.run_class_metric_test(ddp, BATCHES_PREDS, BATCHES_TARGET, metric_class, ref_fn)
+
+    @pytest.mark.parametrize("metric_class, metric_fn, ref_fn", _CASES)
+    def test_functional(self, metric_class, metric_fn, ref_fn):
+        self.run_functional_metric_test(BATCHES_PREDS, BATCHES_TARGET, metric_fn, ref_fn)
+
+    @pytest.mark.parametrize("metric_class, metric_fn, ref_fn", _CASES)
+    def test_single_string(self, metric_class, metric_fn, ref_fn):
+        """Single strings are promoted to one-element corpora."""
+        v = metric_fn("hello world", "hello there world")
+        ref = ref_fn(["hello world"], ["hello there world"])
+        np.testing.assert_allclose(np.asarray(v), ref, atol=1e-6)
+
+
+def test_wer_reference_doctest_values():
+    """Values published in the reference docstrings (wer.py:77-80 etc.)."""
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    np.testing.assert_allclose(float(word_error_rate(preds, target)), 0.5, atol=1e-6)
+    np.testing.assert_allclose(float(match_error_rate(preds, target)), 0.4444, atol=1e-4)
+    np.testing.assert_allclose(float(word_information_lost(preds, target)), 0.6528, atol=1e-4)
+    np.testing.assert_allclose(float(word_information_preserved(preds, target)), 0.3472, atol=1e-4)
