@@ -1,0 +1,5 @@
+from metrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality  # noqa: F401
+from metrics_tpu.audio.pit import PermutationInvariantTraining  # noqa: F401
+from metrics_tpu.audio.sdr import ScaleInvariantSignalDistortionRatio, SignalDistortionRatio  # noqa: F401
+from metrics_tpu.audio.snr import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio  # noqa: F401
+from metrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility  # noqa: F401

@@ -1,0 +1,68 @@
+"""PermutationInvariantTraining metric class.
+
+Behavioral equivalent of reference ``torchmetrics/audio/pit.py:22``.
+"""
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean best-permutation metric value over all evaluated batches.
+
+    Args:
+        metric_func: batched pairwise metric ``(preds, target) -> [batch]``.
+        eval_func: ``'max'`` or ``'min'``.
+        kwargs: metric_func kwargs are forwarded; Metric kwargs consumed here.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PermutationInvariantTraining
+        >>> from metrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> preds = jnp.asarray([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, 'max')
+        >>> pit(preds, target)
+        Array(-2.1065865, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in (
+                "compute_on_cpu",
+                "dist_sync_on_step",
+                "process_group",
+                "dist_sync_fn",
+                "sync_on_compute",
+                "distributed_available_fn",
+            )
+            if k in kwargs
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,48 @@
+"""ShortTimeObjectiveIntelligibility metric class.
+
+Behavioral equivalent of reference ``torchmetrics/audio/stoi.py:25``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Mean STOI (host-side pystoi) over evaluated signals.
+
+    Args:
+        fs: sampling frequency.
+        extended: use the extended STOI variant.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed. Either install as "
+                "`pip install metrics-tpu[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+        self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
+        self.total = self.total + stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

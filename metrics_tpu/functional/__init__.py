@@ -1,3 +1,14 @@
+from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality  # noqa: F401
+from metrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate  # noqa: F401
+from metrics_tpu.functional.audio.sdr import (  # noqa: F401
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+from metrics_tpu.functional.audio.snr import (  # noqa: F401
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility  # noqa: F401
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
 from metrics_tpu.functional.classification.auc import auc  # noqa: F401
 from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
@@ -141,4 +152,12 @@ __all__ = [
     "word_error_rate",
     "word_information_lost",
     "word_information_preserved",
+    "perceptual_evaluation_speech_quality",
+    "permutation_invariant_training",
+    "pit_permutate",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "short_time_objective_intelligibility",
+    "signal_distortion_ratio",
+    "signal_noise_ratio",
 ]

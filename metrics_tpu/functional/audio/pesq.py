@@ -1,0 +1,64 @@
+"""Perceptual evaluation of speech quality (PESQ).
+
+Behavioral equivalent of reference ``torchmetrics/functional/audio/pesq.py``:
+a thin wrapper over the ``pesq`` C library via a host callback (the metric
+is defined by that ITU-T P.862 implementation; there is no tensor math to
+port). Gated on the optional dependency exactly like the reference.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["perceptual_evaluation_speech_quality"]
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array, target: Array, fs: int, mode: str, keep_same_device: bool = False, **kwargs: Any
+) -> Array:
+    """PESQ via the reference ITU-T P.862 implementation (host-side).
+
+    Args:
+        preds: shape ``[..., time]``.
+        target: shape ``[..., time]``.
+        fs: sampling frequency (8000 or 16000).
+        mode: ``'wb'`` (wide-band) or ``'nb'`` (narrow-band).
+        keep_same_device: kept for API parity (XLA manages placement).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import perceptual_evaluation_speech_quality
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> perceptual_evaluation_speech_quality(preds, target, 8000, 'nb')
+        Array(1.15, dtype=float32)
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install metrics-tpu[audio]` "
+            "or `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    import pesq as pesq_backend
+
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        score = pesq_backend.pesq(fs, target_np, preds_np, mode)
+        return jnp.asarray(score, dtype=jnp.float32)
+
+    flat_preds = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_target = target_np.reshape(-1, target_np.shape[-1])
+    scores = [pesq_backend.pesq(fs, t, p, mode) for t, p in zip(flat_target, flat_preds)]
+    return jnp.asarray(scores, dtype=jnp.float32).reshape(preds_np.shape[:-1])

@@ -1,0 +1,116 @@
+"""Permutation invariant training (PIT).
+
+Behavioral equivalent of reference ``torchmetrics/functional/audio/pit.py``
+(``permutation_invariant_training`` :96, ``pit_permutate`` :180, best-perm
+search :29/:52). The pairwise metric matrix is built with a double ``vmap``
+over speaker pairs (one fused batched call instead of the reference's
+Python double loop), and the exhaustive permutation search is a jnp gather
+over the precomputed permutation table — jit-friendly for the practical
+speaker counts. For many speakers, scipy's Hungarian solver is used
+host-side (same cutoff the reference applies via ``linear_sum_assignment``).
+"""
+from functools import lru_cache
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.imports import _SCIPY_AVAILABLE
+
+Array = jax.Array
+
+# beyond this speaker count, the factorial table is larger than the
+# Hungarian-solver overhead is worth
+_EXHAUSTIVE_MAX_SPK = 6
+
+
+@lru_cache(maxsize=32)
+def _perm_table(spk_num: int) -> np.ndarray:
+    """All permutations, shape (perm_num, spk_num)."""
+    return np.asarray(list(permutations(range(spk_num))), dtype=np.int32)
+
+
+def _find_best_perm_exhaustive(metric_mtx: Array, eval_op: str) -> Tuple[Array, Array]:
+    """Score every permutation with a gather; reduce with min/max."""
+    spk_num = metric_mtx.shape[-1]
+    ps = jnp.asarray(_perm_table(spk_num))  # (perm, spk)
+    # metric_of_ps[b, p] = mean_i metric_mtx[b, i, ps[p, i]]
+    metric_of_ps = jnp.mean(metric_mtx[..., jnp.arange(spk_num)[None, :], ps], axis=-1)
+    if eval_op == "max":
+        best_idx = jnp.argmax(metric_of_ps, axis=-1)
+        best_metric = jnp.max(metric_of_ps, axis=-1)
+    else:
+        best_idx = jnp.argmin(metric_of_ps, axis=-1)
+        best_metric = jnp.min(metric_of_ps, axis=-1)
+    return best_metric, ps[best_idx]
+
+
+def _find_best_perm_hungarian(metric_mtx: Array, eval_op: str) -> Tuple[Array, Array]:
+    """Hungarian assignment per batch element (host-side scipy)."""
+    from scipy.optimize import linear_sum_assignment
+
+    mtx = np.asarray(metric_mtx)
+    best_perm = np.stack([linear_sum_assignment(m, eval_op == "max")[1] for m in mtx])
+    best_perm_j = jnp.asarray(best_perm)
+    best_metric = jnp.take_along_axis(metric_mtx, best_perm_j[:, :, None], axis=2).mean(axis=(-1, -2))
+    return best_metric, best_perm_j
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """Best metric value over speaker permutations.
+
+    Args:
+        preds: ``[batch, spk, ...]`` estimates.
+        target: ``[batch, spk, ...]`` references.
+        metric_func: batched pairwise metric ``(preds, target) -> [batch]``.
+        eval_func: ``'max'`` (higher better) or ``'min'``.
+        kwargs: forwarded to ``metric_func``.
+
+    Returns:
+        (best_metric ``[batch]``, best_perm ``[batch, spk]``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import (
+        ...     permutation_invariant_training, scale_invariant_signal_distortion_ratio)
+        >>> preds = jnp.asarray([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> best_metric, best_perm = permutation_invariant_training(
+        ...     preds, target, scale_invariant_signal_distortion_ratio, 'max')
+        >>> best_perm
+        Array([[0, 1]], dtype=int32)
+    """
+    if eval_func not in ("max", "min"):
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if preds.ndim < 2 or target.ndim < 2 or preds.shape[:2] != target.shape[:2] or target.shape[0] < 1:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    spk_num = target.shape[1]
+
+    # pairwise metric matrix [batch, target_spk, pred_spk] via nested vmap
+    def pair_metric(t_i, p_j):
+        return metric_func(p_j, t_i, **kwargs)
+
+    # map over target speakers (axis 1 of target), then pred speakers
+    metric_mtx = jax.vmap(
+        lambda t_i: jax.vmap(lambda p_j: pair_metric(t_i, p_j), in_axes=1, out_axes=-1)(preds),
+        in_axes=1,
+        out_axes=1,
+    )(target)  # [batch, target_spk, pred_spk]
+
+    if spk_num <= _EXHAUSTIVE_MAX_SPK or not _SCIPY_AVAILABLE:
+        return _find_best_perm_exhaustive(metric_mtx, eval_func)
+    return _find_best_perm_hungarian(metric_mtx, eval_func)
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder ``preds`` ``[batch, spk, ...]`` by the PIT permutation ``[batch, spk]``."""
+    return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)

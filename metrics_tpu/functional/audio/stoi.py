@@ -1,0 +1,57 @@
+"""Short-time objective intelligibility (STOI).
+
+Behavioral equivalent of reference ``torchmetrics/functional/audio/stoi.py``:
+a host callback into the ``pystoi`` implementation, gated on the optional
+dependency exactly like the reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["short_time_objective_intelligibility"]
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI (0..1, higher is more intelligible), computed host-side by pystoi.
+
+    Args:
+        preds: shape ``[..., time]``.
+        target: shape ``[..., time]``.
+        fs: sampling frequency.
+        extended: use the extended STOI variant.
+        keep_same_device: kept for API parity (XLA manages placement).
+
+    Example:
+        >>> import jax
+        >>> from metrics_tpu.functional import short_time_objective_intelligibility
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> short_time_objective_intelligibility(preds, target, 8000)
+        Array(-0.0842, dtype=float32)
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that `pystoi` is installed. Either install as `pip install metrics-tpu[audio]` "
+            "or `pip install pystoi`."
+        )
+    import pystoi
+
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float64)
+    target_np = np.asarray(target, dtype=np.float64)
+    if preds_np.ndim == 1:
+        score = pystoi.stoi(target_np, preds_np, fs, extended=extended)
+        return jnp.asarray(score, dtype=jnp.float32)
+
+    flat_preds = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_target = target_np.reshape(-1, target_np.shape[-1])
+    scores = [pystoi.stoi(t, p, fs, extended=extended) for t, p in zip(flat_target, flat_preds)]
+    return jnp.asarray(scores, dtype=jnp.float32).reshape(preds_np.shape[:-1])

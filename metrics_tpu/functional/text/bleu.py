@@ -108,6 +108,7 @@ def bleu_score(
     target: Sequence[Union[str, Sequence[str]]],
     n_gram: int = 4,
     smooth: bool = False,
+    weights: Sequence[float] = None,
 ) -> Array:
     """BLEU score of machine-translated text against one or more references.
 
@@ -122,5 +123,7 @@ def bleu_score(
     target_ = [[t] if isinstance(t, str) else t for t in target]
     if len(preds_) != len(target_):
         raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
     numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram)
-    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth, weights)

@@ -143,6 +143,7 @@ def sacre_bleu_score(
     smooth: bool = False,
     tokenize: str = "13a",
     lowercase: bool = False,
+    weights: Sequence[float] = None,
 ) -> Array:
     """BLEU with sacrebleu-canonical tokenization.
 
@@ -155,6 +156,8 @@ def sacre_bleu_score(
     """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
     tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
     numerator, denominator, preds_len, target_len = _bleu_score_update(preds, target, n_gram, tokenizer)
-    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, smooth, weights)

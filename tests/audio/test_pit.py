@@ -1,0 +1,142 @@
+"""PIT vs a brute-force numpy permutation search
+(reference ``tests/audio/test_pit.py``)."""
+from itertools import permutations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.audio import PermutationInvariantTraining
+from metrics_tpu.functional import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import NUM_BATCHES, MetricTester
+
+BATCH = 8
+SPK = 3
+TIME = 50
+
+_rng = np.random.default_rng(1414)
+_preds = _rng.normal(size=(NUM_BATCHES, BATCH, SPK, TIME)).astype(np.float32)
+_target = _rng.normal(size=(NUM_BATCHES, BATCH, SPK, TIME)).astype(np.float32)
+
+
+def _np_si_sdr(preds, target):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    alpha = np.sum(preds * target, -1, keepdims=True) / np.sum(target**2, -1, keepdims=True)
+    scaled = alpha * target
+    noise = scaled - preds
+    return 10 * np.log10(np.sum(scaled**2, -1) / np.sum(noise**2, -1))
+
+
+def _np_snr(preds, target):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    noise = target - preds
+    return 10 * np.log10(np.sum(target**2, -1) / np.sum(noise**2, -1))
+
+
+def _brute_force_pit(preds, target, np_metric, eval_func="max"):
+    """Best mean pairwise metric over all speaker permutations, per batch item."""
+    batch, spk = preds.shape[:2]
+    best_metric = np.empty(batch)
+    best_perm = np.empty((batch, spk), dtype=np.int64)
+    for b in range(batch):
+        best = None
+        for perm in permutations(range(spk)):
+            val = np.mean([np_metric(preds[b, perm[i]], target[b, i]) for i in range(spk)])
+            if best is None or (val > best[0]) == (eval_func == "max"):
+                best = (val, perm)
+        best_metric[b] = best[0]
+        best_perm[b] = best[1]
+    return best_metric, best_perm
+
+
+@pytest.mark.parametrize(
+    "metric_fn, np_metric, eval_func",
+    [
+        pytest.param(scale_invariant_signal_distortion_ratio, _np_si_sdr, "max", id="si-sdr-max"),
+        pytest.param(signal_noise_ratio, _np_snr, "max", id="snr-max"),
+        pytest.param(signal_noise_ratio, _np_snr, "min", id="snr-min"),
+    ],
+)
+def test_functional_vs_brute_force(metric_fn, np_metric, eval_func):
+    for i in range(NUM_BATCHES):
+        best_metric, best_perm = permutation_invariant_training(
+            jnp.asarray(_preds[i]), jnp.asarray(_target[i]), metric_fn, eval_func
+        )
+        want_metric, want_perm = _brute_force_pit(_preds[i], _target[i], np_metric, eval_func)
+        np.testing.assert_allclose(np.asarray(best_metric), want_metric, atol=1e-3)
+        # permutation row i gives the pred index for target i; metric equality
+        # already pins it unless two perms tie, so compare values not indices
+        gathered = pit_permutate(jnp.asarray(_preds[i]), best_perm)
+        regather_metric = np.mean(
+            [[np_metric(np.asarray(gathered)[b, s], _target[i][b, s]) for s in range(SPK)] for b in range(BATCH)],
+            axis=1,
+        )
+        np.testing.assert_allclose(regather_metric, want_metric, atol=1e-3)
+
+
+def test_hungarian_matches_exhaustive():
+    from metrics_tpu.functional.audio.pit import (
+        _find_best_perm_exhaustive,
+        _find_best_perm_hungarian,
+    )
+
+    mtx = jnp.asarray(_rng.normal(size=(6, 4, 4)).astype(np.float32))
+    for op in ("max", "min"):
+        m1, p1 = _find_best_perm_exhaustive(mtx, op)
+        m2, p2 = _find_best_perm_hungarian(mtx, op)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), atol=1e-6)
+
+
+class TestPITClass(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        def sk_metric(preds, target):
+            return np.mean(_brute_force_pit(np.asarray(preds), np.asarray(target), _np_si_sdr, "max")[0])
+
+        self.run_class_metric_test(
+            ddp,
+            jnp.asarray(_preds),
+            jnp.asarray(_target),
+            PermutationInvariantTraining,
+            sk_metric,
+            metric_args={"metric_func": scale_invariant_signal_distortion_ratio, "eval_func": "max"},
+        )
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError, match="eval_func"):
+        permutation_invariant_training(
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), scale_invariant_signal_distortion_ratio, "best"
+        )
+    with pytest.raises(ValueError, match="shape"):
+        permutation_invariant_training(
+            jnp.zeros((10,)), jnp.zeros((10,)), scale_invariant_signal_distortion_ratio, "max"
+        )
+    with pytest.raises(ValueError, match="shape"):
+        # mismatched speaker counts must raise, not silently gather OOB
+        permutation_invariant_training(
+            jnp.zeros((1, 3, 16)), jnp.zeros((1, 2, 16)), scale_invariant_signal_distortion_ratio, "max"
+        )
+
+
+def test_pesq_stoi_gated():
+    """PESQ/STOI raise a clear error when their host libraries are absent."""
+    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        from metrics_tpu.functional import perceptual_evaluation_speech_quality
+
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            perceptual_evaluation_speech_quality(jnp.zeros(8000), jnp.zeros(8000), 8000, "nb")
+    if not _PYSTOI_AVAILABLE:
+        from metrics_tpu.functional import short_time_objective_intelligibility
+
+        with pytest.raises(ModuleNotFoundError, match="pystoi"):
+            short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(8000), 8000)

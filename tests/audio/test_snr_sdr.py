@@ -1,0 +1,188 @@
+"""SNR / SI-SNR / SI-SDR / SDR vs independent numpy oracles
+(reference ``tests/audio/test_{snr,si_sdr,sdr}.py``; fast_bss_eval is
+unavailable offline, so the SDR oracle is a float64 scipy Toeplitz solve of
+the same published definition)."""
+import numpy as np
+import pytest
+import scipy.linalg
+
+from metrics_tpu.audio import (
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional import (
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+TIME = 200
+
+_rng = np.random.default_rng(2718)
+_preds = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, TIME)).astype(np.float32)
+_target = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, TIME)).astype(np.float32)
+# correlated variant so values aren't all strongly negative
+_preds_corr = (_target + 0.3 * _preds).astype(np.float32)
+
+
+def _ref_snr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * np.log10(np.sum(target**2, -1) / np.sum(noise**2, -1))
+
+
+def _ref_si_sdr(preds, target, zero_mean=False):
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = np.sum(preds * target, -1, keepdims=True) / np.sum(target**2, -1, keepdims=True)
+    scaled = alpha * target
+    noise = scaled - preds
+    return 10 * np.log10(np.sum(scaled**2, -1) / np.sum(noise**2, -1))
+
+
+def _ref_sdr(preds, target, filter_length=512, zero_mean=False, load_diag=None):
+    """BSS-eval SDR: optimal FIR distortion filter via dense Toeplitz solve."""
+    preds, target = np.asarray(preds, np.float64), np.asarray(target, np.float64)
+    out = np.empty(preds.shape[:-1])
+    flat_p = preds.reshape(-1, preds.shape[-1])
+    flat_t = target.reshape(-1, target.shape[-1])
+    res = []
+    for p, t in zip(flat_p, flat_t):
+        if zero_mean:
+            p, t = p - p.mean(), t - t.mean()
+        p = p / np.linalg.norm(p)
+        t = t / np.linalg.norm(t)
+        n_fft = 1 << int(len(t) + filter_length - 1).bit_length()
+        t_f = np.fft.rfft(t, n_fft)
+        p_f = np.fft.rfft(p, n_fft)
+        acf = np.fft.irfft(t_f * np.conj(t_f), n_fft)[:filter_length]
+        xcorr = np.fft.irfft(np.conj(t_f) * p_f, n_fft)[:filter_length]
+        if load_diag is not None:
+            acf = acf.copy()
+            acf[0] += load_diag
+        sol = np.linalg.solve(scipy.linalg.toeplitz(acf), xcorr)
+        coh = xcorr @ sol
+        res.append(10 * np.log10(coh / (1 - coh)))
+    out.flat = res
+    return out
+
+
+def _mean_fn(fn):
+    return lambda preds, target, **kw: np.mean(fn(preds, target, **kw))
+
+
+class TestSNRFamily(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, ref_fn, args",
+        [
+            pytest.param(SignalNoiseRatio, signal_noise_ratio, _ref_snr, {}, id="snr"),
+            pytest.param(SignalNoiseRatio, signal_noise_ratio, _ref_snr, {"zero_mean": True}, id="snr-zm"),
+            pytest.param(
+                ScaleInvariantSignalNoiseRatio,
+                scale_invariant_signal_noise_ratio,
+                lambda p, t: _ref_si_sdr(p, t, zero_mean=True),
+                {},
+                id="si-snr",
+            ),
+            pytest.param(
+                ScaleInvariantSignalDistortionRatio,
+                scale_invariant_signal_distortion_ratio,
+                _ref_si_sdr,
+                {},
+                id="si-sdr",
+            ),
+            pytest.param(
+                ScaleInvariantSignalDistortionRatio,
+                scale_invariant_signal_distortion_ratio,
+                _ref_si_sdr,
+                {"zero_mean": True},
+                id="si-sdr-zm",
+            ),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, metric_fn, ref_fn, args, ddp):
+        self.run_class_metric_test(
+            ddp,
+            _preds_corr,
+            _target,
+            metric_class,
+            _mean_fn(lambda p, t: ref_fn(p, t, **args)),
+            metric_args=args,
+        )
+
+    @pytest.mark.parametrize(
+        "metric_fn, ref_fn",
+        [
+            pytest.param(signal_noise_ratio, _ref_snr, id="snr"),
+            pytest.param(scale_invariant_signal_distortion_ratio, _ref_si_sdr, id="si-sdr"),
+        ],
+    )
+    def test_functional(self, metric_fn, ref_fn):
+        for i in range(NUM_BATCHES):
+            got = metric_fn(_preds_corr[i], _target[i])
+            np.testing.assert_allclose(np.asarray(got), ref_fn(_preds_corr[i], _target[i]), atol=1e-3)
+
+
+class TestSDR(MetricTester):
+    atol = 1e-2
+
+    @pytest.mark.parametrize("filter_length", [32, 64])
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_functional_vs_toeplitz_oracle(self, filter_length, zero_mean):
+        got = signal_distortion_ratio(
+            _preds_corr[0][:4], _target[0][:4], filter_length=filter_length, zero_mean=zero_mean
+        )
+        want = _ref_sdr(_preds_corr[0][:4], _target[0][:4], filter_length=filter_length, zero_mean=zero_mean)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-2)
+
+    def test_cg_close_to_dense(self):
+        dense = signal_distortion_ratio(_preds_corr[0][:4], _target[0][:4], filter_length=64)
+        cg = signal_distortion_ratio(_preds_corr[0][:4], _target[0][:4], filter_length=64, use_cg_iter=50)
+        np.testing.assert_allclose(np.asarray(cg), np.asarray(dense), atol=5e-2)
+
+    def test_load_diag(self):
+        got = signal_distortion_ratio(_preds_corr[0][:2], _target[0][:2], filter_length=32, load_diag=1e-4)
+        want = _ref_sdr(_preds_corr[0][:2], _target[0][:2], filter_length=32, load_diag=1e-4)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-2)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        self.run_class_metric_test(
+            ddp,
+            _preds_corr[:, :8],
+            _target[:, :8],
+            SignalDistortionRatio,
+            _mean_fn(lambda p, t: _ref_sdr(p, t, filter_length=64)),
+            metric_args={"filter_length": 64},
+        )
+
+    def test_reference_doctest_value(self):
+        """Reference sdr.py doctest: torch.manual_seed(1) randn(8000) pair -> -12.0589."""
+        torch = pytest.importorskip("torch")
+        torch.manual_seed(1)
+        preds = torch.randn(8000).numpy()
+        target = torch.randn(8000).numpy()
+        got = float(signal_distortion_ratio(preds, target))
+        np.testing.assert_allclose(got, -12.0589, atol=5e-3)
+
+
+def test_si_sdr_reference_doctest_value():
+    import jax.numpy as jnp
+
+    target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    np.testing.assert_allclose(float(scale_invariant_signal_distortion_ratio(preds, target)), 18.4030, atol=1e-3)
+    np.testing.assert_allclose(float(signal_noise_ratio(preds, target)), 16.1805, atol=1e-3)
+    np.testing.assert_allclose(float(scale_invariant_signal_noise_ratio(preds, target)), 15.0918, atol=1e-3)

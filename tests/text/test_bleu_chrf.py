@@ -123,6 +123,23 @@ class TestBLEU(TextTester):
         with pytest.raises(ValueError, match="Corpus has different size"):
             bleu_score(["a", "b"], [["a"]])
 
+    def test_weights(self):
+        """Functional weights match the class API and reject bad lengths."""
+        preds = ["the cat is on the mat"]
+        target = [["a cat is on the mat"]]
+        uniform = float(bleu_score(preds, target, n_gram=2))
+        weighted = float(bleu_score(preds, target, n_gram=2, weights=[0.9, 0.1]))
+        assert uniform != weighted
+        from metrics_tpu.text import BLEUScore
+
+        m = BLEUScore(n_gram=2, weights=[0.9, 0.1])
+        m.update(preds, target)
+        np.testing.assert_allclose(float(m.compute()), weighted, atol=1e-6)
+        with pytest.raises(ValueError, match="weights"):
+            bleu_score(preds, target, n_gram=2, weights=[1.0])
+        with pytest.raises(ValueError, match="weights"):
+            sacre_bleu_score(preds, target, n_gram=2, weights=[1.0])
+
 
 class TestCHRF(TextTester):
     @pytest.mark.parametrize("word_order", [0, 2])
