@@ -1,0 +1,1 @@
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision  # noqa: F401

@@ -33,6 +33,7 @@ from metrics_tpu.functional.classification.ranking import (  # noqa: F401
 from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou  # noqa: F401
 from metrics_tpu.functional.image.d_lambda import spectral_distortion_index  # noqa: F401
 from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis  # noqa: F401
 from metrics_tpu.functional.image.gradients import image_gradients  # noqa: F401
@@ -110,6 +111,9 @@ __all__ = [
     "auc",
     "auroc",
     "average_precision",
+    "box_area",
+    "box_convert",
+    "box_iou",
     "calibration_error",
     "cohen_kappa",
     "confusion_matrix",

@@ -1,0 +1,1 @@
+from metrics_tpu.functional.detection.box_ops import box_area, box_convert, box_iou  # noqa: F401

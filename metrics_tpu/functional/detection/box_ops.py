@@ -1,0 +1,55 @@
+"""Bounding-box primitives in jnp.
+
+The reference delegates these to ``torchvision.ops`` (``box_convert``,
+``box_area``, ``box_iou`` — see reference ``detection/mean_ap.py:23-27``);
+here they are native jnp so the detection pipeline has no torch dependency.
+``box_convert`` is used on-device in ``MeanAveragePrecision.update``;
+``box_area``/``box_iou`` are the public on-device primitives (the mAP
+evaluation itself runs host-side on numpy twins — ``_np_box_iou`` in
+``metrics_tpu/detection/mean_ap.py`` — kept consistent by a cross-check
+test in ``tests/detection/test_map.py``).
+"""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_ALLOWED_FORMATS = ("xyxy", "xywh", "cxcywh")
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert ``(N, 4)`` boxes between xyxy / xywh / cxcywh formats."""
+    if in_fmt not in _ALLOWED_FORMATS or out_fmt not in _ALLOWED_FORMATS:
+        raise ValueError(f"Supported box formats are {_ALLOWED_FORMATS}, got {in_fmt} -> {out_fmt}")
+    if in_fmt == out_fmt:
+        return boxes
+    # normalize to xyxy first
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    if out_fmt == "xyxy":
+        return boxes
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """Area of ``(N, 4)`` xyxy boxes."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU matrix ``(N, M)`` for xyxy boxes."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)

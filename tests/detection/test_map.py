@@ -1,0 +1,340 @@
+"""MeanAveragePrecision vs an independent per-cell-loop COCO evaluator
+(reference ``tests/detection/test_map.py`` uses pycocotools as oracle;
+that package is unavailable offline, so the oracle here is a from-scratch
+plain-loop implementation of the same protocol, fuzzed against the
+vectorized implementation)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanAveragePrecision
+from tests.helpers.testers import _wire_virtual_ddp
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0, int(1e10)),
+    "small": (0, 32**2),
+    "medium": (32**2, 96**2),
+    "large": (96**2, int(1e10)),
+}
+MAX_DETS = [1, 10, 100]
+
+
+def _iou(d, g):
+    lt = np.maximum(d[:, None, :2], g[None, :, :2])
+    rb = np.minimum(d[:, None, 2:], g[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    a_d = (d[:, 2] - d[:, 0]) * (d[:, 3] - d[:, 1])
+    a_g = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1])
+    union = a_d[:, None] + a_g[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1), 0.0)
+
+
+def _oracle_eval_img(det, scores, gt, area_range, max_det):
+    """Plain-loop per-image, per-class evaluation (thresholds x dets loops)."""
+    if len(gt) == 0 and len(det) == 0:
+        return None
+    areas = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    ignore = (areas < area_range[0]) | (areas > area_range[1])
+    gtind = np.argsort(ignore, kind="stable")
+    gt, gt_ignore = gt[gtind], ignore[gtind]
+    order = np.argsort(-scores, kind="stable")[:max_det]
+    det, scores = det[order], scores[order]
+    ious = _iou(det, gt)
+
+    T, D, G = len(IOU_THRS), len(det), len(gt)
+    dtm = np.zeros((T, D), bool)
+    gtm = np.zeros((T, G), bool)
+    dti = np.zeros((T, D), bool)
+    for ti, thr in enumerate(IOU_THRS):
+        for di in range(D):
+            vals = ious[di] * ~(gtm[ti] | gt_ignore)
+            if G == 0:
+                continue
+            m = int(vals.argmax())
+            if vals[m] > thr:
+                dtm[ti, di] = True
+                gtm[ti, m] = True
+                dti[ti, di] = gt_ignore[m]
+    if D:
+        det_areas = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+        out = (det_areas < area_range[0]) | (det_areas > area_range[1])
+        dti = dti | (~dtm & out[None, :])
+    return dict(dtm=dtm, gtm=gtm, scores=scores, gti=gt_ignore, dti=dti)
+
+
+def _oracle_map(preds, targets, class_metrics=False):
+    """Full plain-loop COCO evaluation over a corpus of per-image dicts."""
+    classes = sorted(
+        set(np.concatenate([np.asarray(p["labels"]).reshape(-1) for p in preds] +
+                           [np.asarray(t["labels"]).reshape(-1) for t in targets]).astype(int).tolist())
+        if preds or targets else []
+    )
+    n_imgs = len(preds)
+    K, A, M, T, R = len(classes), len(AREA_RANGES), len(MAX_DETS), len(IOU_THRS), len(REC_THRS)
+    precision = -np.ones((T, R, K, A, M))
+    recall = -np.ones((T, K, A, M))
+
+    for ki, cls in enumerate(classes):
+        for ai, area_range in enumerate(AREA_RANGES.values()):
+            evals = []
+            for i in range(n_imgs):
+                d_lab = np.asarray(preds[i]["labels"]).reshape(-1)
+                g_lab = np.asarray(targets[i]["labels"]).reshape(-1)
+                d_m, g_m = d_lab == cls, g_lab == cls
+                if not d_m.any() and not g_m.any():
+                    evals.append(None)
+                    continue
+                det = np.asarray(preds[i]["boxes"], float).reshape(-1, 4)[d_m]
+                sc = np.asarray(preds[i]["scores"], float).reshape(-1)[d_m]
+                gt = np.asarray(targets[i]["boxes"], float).reshape(-1, 4)[g_m]
+                evals.append(_oracle_eval_img(det, sc, gt, area_range, MAX_DETS[-1]))
+            evals = [e for e in evals if e is not None]
+            if not evals:
+                continue
+            for mi, max_det in enumerate(MAX_DETS):
+                scores = np.concatenate([e["scores"][:max_det] for e in evals])
+                inds = np.argsort(-scores, kind="mergesort")
+                dtm = np.concatenate([e["dtm"][:, :max_det] for e in evals], 1)[:, inds]
+                dti = np.concatenate([e["dti"][:, :max_det] for e in evals], 1)[:, inds]
+                gti = np.concatenate([e["gti"] for e in evals])
+                npig = int((~gti).sum())
+                if npig == 0:
+                    continue
+                tps = np.cumsum(dtm & ~dti, 1, dtype=float)
+                fps = np.cumsum(~dtm & ~dti, 1, dtype=float)
+                for ti in range(T):
+                    tp, fp = tps[ti], fps[ti]
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + np.finfo(float).eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if nd else 0
+                    # right-max envelope via the reference's iterative lift
+                    pr = pr.copy()
+                    while True:
+                        diff = np.clip(np.concatenate([pr[1:] - pr[:-1], [0.0]]), 0, None)
+                        if np.all(diff == 0):
+                            break
+                        pr += diff
+                    idxs = np.searchsorted(rc, REC_THRS, side="left")
+                    num = int(idxs.argmax()) if idxs.max() >= nd else R
+                    row = np.zeros(R)
+                    row[:num] = pr[idxs[:num]]
+                    precision[ti, :, ki, ai, mi] = row
+
+    def summ(arr, avg_prec, thr=None, area="all", max_det=100):
+        ai = list(AREA_RANGES).index(area)
+        mi = MAX_DETS.index(max_det)
+        x = arr[..., ai, mi]
+        if thr is not None:
+            x = x[list(IOU_THRS).index(thr)]
+        v = x[x > -1]
+        return float(v.mean()) if v.size else -1.0
+
+    out = {
+        "map": summ(precision, True),
+        "map_50": summ(precision, True, 0.5),
+        "map_75": summ(precision, True, 0.75),
+        "map_small": summ(precision, True, area="small"),
+        "map_medium": summ(precision, True, area="medium"),
+        "map_large": summ(precision, True, area="large"),
+        "mar_1": summ(recall, False, max_det=1),
+        "mar_10": summ(recall, False, max_det=10),
+        "mar_100": summ(recall, False, max_det=100),
+        "mar_small": summ(recall, False, area="small"),
+        "mar_medium": summ(recall, False, area="medium"),
+        "mar_large": summ(recall, False, area="large"),
+    }
+    if class_metrics:
+        out["map_per_class"] = [
+            summ(precision[:, :, k : k + 1], True) for k in range(K)
+        ]
+        out["mar_100_per_class"] = [summ(recall[:, k : k + 1], False) for k in range(K)]
+    return out
+
+
+def _rand_corpus(rng, n_imgs, n_classes=3, max_boxes=8):
+    preds, targets = [], []
+    for _ in range(n_imgs):
+        n_d = int(rng.integers(0, max_boxes))
+        n_g = int(rng.integers(0, max_boxes))
+        def boxes(n):
+            xy = rng.uniform(0, 80, size=(n, 2))
+            wh = rng.uniform(2, 60, size=(n, 2))
+            return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        preds.append(dict(
+            boxes=jnp.asarray(boxes(n_d)),
+            scores=jnp.asarray(rng.uniform(0, 1, n_d).astype(np.float32)),
+            labels=jnp.asarray(rng.integers(0, n_classes, n_d)),
+        ))
+        targets.append(dict(
+            boxes=jnp.asarray(boxes(n_g)),
+            labels=jnp.asarray(rng.integers(0, n_classes, n_g)),
+        ))
+    return preds, targets
+
+
+def _compare(result, want, keys=None):
+    for k in keys or want:
+        got = result[k]
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=float), np.asarray(want[k], dtype=float), atol=1e-6, err_msg=k
+        )
+
+
+def test_reference_doctest_example():
+    preds = [dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))]
+    target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.asarray([0]))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    r = m.compute()
+    np.testing.assert_allclose(float(r["map"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(r["map_50"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(r["map_75"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(r["mar_100"]), 0.6, atol=1e-4)
+    assert float(r["map_medium"]) == -1.0
+
+
+def test_perfect_predictions():
+    rng = np.random.default_rng(3)
+    _, targets = _rand_corpus(rng, 4)
+    preds = [
+        dict(boxes=t["boxes"], scores=jnp.ones(t["boxes"].shape[0]), labels=t["labels"]) for t in targets
+    ]
+    m = MeanAveragePrecision()
+    m.update(preds, targets)
+    r = m.compute()
+    np.testing.assert_allclose(float(r["map"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(r["mar_100"]), 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_vs_loop_oracle(seed):
+    rng = np.random.default_rng(seed)
+    preds, targets = _rand_corpus(rng, 6)
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds, targets)
+    result = m.compute()
+    want = _oracle_map(preds, targets, class_metrics=True)
+    _compare(result, want)
+
+
+def test_multiple_updates_match_single():
+    rng = np.random.default_rng(9)
+    preds, targets = _rand_corpus(rng, 6)
+    m1 = MeanAveragePrecision()
+    m1.update(preds[:3], targets[:3])
+    m1.update(preds[3:], targets[3:])
+    m2 = MeanAveragePrecision()
+    m2.update(preds, targets)
+    r1, r2 = m1.compute(), m2.compute()
+    for k in r2:
+        np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]), atol=1e-8, err_msg=k)
+
+
+def test_virtual_ddp_matches_global():
+    rng = np.random.default_rng(17)
+    preds, targets = _rand_corpus(rng, 6)
+    ranks = [MeanAveragePrecision() for _ in range(2)]
+    _wire_virtual_ddp(ranks)
+    ranks[0].update(preds[:3], targets[:3])
+    ranks[1].update(preds[3:], targets[3:])
+    synced = ranks[0].compute()
+    want = _oracle_map(preds, targets)
+    _compare(synced, want)
+
+
+@pytest.mark.parametrize("box_format", ["xywh", "cxcywh"])
+def test_box_formats(box_format):
+    xyxy = np.asarray([[10.0, 20.0, 50.0, 80.0]], dtype=np.float32)
+    if box_format == "xywh":
+        conv = np.asarray([[10.0, 20.0, 40.0, 60.0]], dtype=np.float32)
+    else:
+        conv = np.asarray([[30.0, 50.0, 40.0, 60.0]], dtype=np.float32)
+    m_ref = MeanAveragePrecision()
+    m_ref.update(
+        [dict(boxes=jnp.asarray(xyxy), scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+        [dict(boxes=jnp.asarray(xyxy), labels=jnp.asarray([0]))],
+    )
+    m_fmt = MeanAveragePrecision(box_format=box_format)
+    m_fmt.update(
+        [dict(boxes=jnp.asarray(conv), scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+        [dict(boxes=jnp.asarray(conv), labels=jnp.asarray([0]))],
+    )
+    np.testing.assert_allclose(float(m_ref.compute()["map"]), float(m_fmt.compute()["map"]), atol=1e-6)
+
+
+def test_empty_preds_and_gt():
+    m = MeanAveragePrecision()
+    m.update(
+        [dict(boxes=jnp.zeros((0, 4)), scores=jnp.zeros(0), labels=jnp.zeros(0, dtype=jnp.int32))],
+        [dict(boxes=jnp.asarray([[10.0, 10.0, 20.0, 20.0]]), labels=jnp.asarray([1]))],
+    )
+    r = m.compute()
+    np.testing.assert_allclose(float(r["map"]), 0.0, atol=1e-6)
+
+    m2 = MeanAveragePrecision()
+    m2.update(
+        [dict(boxes=jnp.asarray([[10.0, 10.0, 20.0, 20.0]]), scores=jnp.asarray([0.5]), labels=jnp.asarray([1]))],
+        [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0, dtype=jnp.int32))],
+    )
+    r2 = m2.compute()
+    # no positives anywhere -> everything stays -1
+    assert float(r2["map"]) == -1.0
+
+
+def test_invalid_inputs():
+    with pytest.raises(ValueError, match="box_format"):
+        MeanAveragePrecision(box_format="bad")
+    with pytest.raises(ValueError, match="class_metrics"):
+        MeanAveragePrecision(class_metrics="yes")
+    m = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="same length"):
+        m.update([], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))])
+    with pytest.raises(ValueError, match="`scores`"):
+        m.update([dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))])
+
+
+def test_box_ops_match_host_twins():
+    """jnp box_iou/box_area must stay consistent with the host-side numpy
+    implementations used inside MeanAveragePrecision.compute."""
+    from metrics_tpu.detection.mean_ap import _np_box_area, _np_box_iou
+    from metrics_tpu.functional.detection import box_area, box_iou
+
+    rng = np.random.default_rng(3)
+    a = rng.uniform(0, 100, size=(7, 2))
+    b = rng.uniform(0, 100, size=(5, 2))
+    boxes_a = np.concatenate([a, a + rng.uniform(0, 50, size=(7, 2))], axis=1)
+    boxes_b = np.concatenate([b, b + rng.uniform(0, 50, size=(5, 2))], axis=1)
+    # include a degenerate zero-area box
+    boxes_a[0, 2:] = boxes_a[0, :2]
+    np.testing.assert_allclose(np.asarray(box_area(jnp.asarray(boxes_a))), _np_box_area(boxes_a), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(box_iou(jnp.asarray(boxes_a), jnp.asarray(boxes_b))),
+        _np_box_iou(boxes_a, boxes_b),
+        rtol=1e-5,
+        atol=1e-7,
+    )
+
+
+def test_empty_rank_sync_dtypes():
+    """A rank that never saw data must gather empty buffers with the same
+    dtypes as populated ranks (int32 labels/img_idx, float32 boxes/scores)."""
+    from metrics_tpu.detection.mean_ap import _cat_or_empty
+
+    assert _cat_or_empty([], "det_labels").dtype == jnp.int32
+    assert _cat_or_empty([], "det_img_idx").dtype == jnp.int32
+    assert _cat_or_empty([], "det_scores").dtype == jnp.float32
+    assert _cat_or_empty([], "det_boxes").shape == (0, 4)
+
+    rng = np.random.default_rng(5)
+    preds, targets = _rand_corpus(rng, 4)
+    ranks = [MeanAveragePrecision() for _ in range(2)]
+    _wire_virtual_ddp(ranks)
+    ranks[0].update(preds, targets)  # rank 1 gets nothing
+    synced = ranks[0].compute()
+    want = _oracle_map(preds, targets)
+    _compare(synced, want)

@@ -55,16 +55,27 @@ def _wire_virtual_ddp(metrics: Sequence[Metric]) -> None:
         def gather(x, group=None):
             q = queues[id(m_self)]
             if not q:
-                q.extend(
-                    n
-                    for n in m_self._reductions
-                    if not (isinstance(getattr(m_self, n), list) and not getattr(m_self, n))
-                )
+                if type(m_self)._sync_dist is Metric._sync_dist:
+                    # base _sync_dist gathers only non-empty-list states
+                    q.extend(
+                        n
+                        for n in m_self._reductions
+                        if not (isinstance(getattr(m_self, n), list) and not getattr(m_self, n))
+                    )
+                else:
+                    # custom _sync_dist overrides gather every state unconditionally
+                    q.extend(m_self._reductions)
             name = q.popleft()
             out = []
             for m in metrics:
                 v = getattr(m, name)
-                out.append(dim_zero_cat(v) if isinstance(v, list) else v)
+                if isinstance(v, list) and not v:
+                    # peer rank saw no data: contribute an empty, dtype-matched chunk
+                    out.append(jnp.zeros((0,) + tuple(x.shape[1:]), dtype=x.dtype))
+                elif isinstance(v, list):
+                    out.append(dim_zero_cat(v))
+                else:
+                    out.append(v)
             return out
 
         return gather
