@@ -1,0 +1,9 @@
+"""Shim for legacy ``pip install -e .`` / ``python setup.py`` workflows.
+
+All metadata lives in ``pyproject.toml`` (the reference carries its
+metadata in ``setup.py`` + ``torchmetrics/setup_tools.py``; here the
+modern single-source layout replaces both).
+"""
+from setuptools import setup
+
+setup()
