@@ -1,0 +1,68 @@
+"""BERTScore with a user-supplied model, tokenizer and forward function.
+
+Equivalent of the reference example ``tm_examples/bert_score-own_model.py``:
+instead of a ``transformers`` checkpoint, a toy character-level "encoder"
+(here a fixed random embedding table + mixing matrix in jnp) is plugged in
+via the ``model`` / ``user_tokenizer`` / ``user_forward_fn`` hooks, showing
+the contract each hook must satisfy:
+
+* tokenizer: ``(List[str], max_length) -> {"input_ids", "attention_mask"}``
+  (numpy/jnp int arrays, padded to a common length)
+* forward_fn: ``(model, batch_dict) -> [batch, seq_len, model_dim]`` array
+
+Run: ``python examples/bert_score-own_model.py``
+"""
+from pprint import pprint
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional import bert_score
+
+_MAX_LEN = 32
+_VOCAB = 128
+_DIM = 16
+
+
+class CharTokenizer:
+    """Byte-level tokenizer: one token per character, padded to max length."""
+
+    def __call__(self, sentences: List[str], max_length: int = _MAX_LEN) -> Dict[str, np.ndarray]:
+        ids = np.zeros((len(sentences), max_length), dtype=np.int32)
+        mask = np.zeros((len(sentences), max_length), dtype=np.int32)
+        for i, sentence in enumerate(sentences):
+            tokens = [min(ord(c), _VOCAB - 1) for c in sentence[:max_length]]
+            ids[i, : len(tokens)] = tokens
+            mask[i, : len(tokens)] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+class ToyEncoder:
+    """Embedding table + one dense mixing layer; stands in for a Flax encoder."""
+
+    def __init__(self) -> None:
+        k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        self.embed = jax.random.normal(k1, (_VOCAB, _DIM))
+        self.mix = jax.random.normal(k2, (_DIM, _DIM)) / jnp.sqrt(_DIM)
+
+
+def forward_fn(model: ToyEncoder, batch: Dict[str, np.ndarray]) -> jnp.ndarray:
+    ids = jnp.asarray(batch["input_ids"])
+    mask = jnp.asarray(batch["attention_mask"])[..., None]
+    return (model.embed[ids] @ model.mix) * mask
+
+
+if __name__ == "__main__":
+    preds = ["hello there", "general kenobi"]
+    target = ["hello there", "master kenobi"]
+    score = bert_score(
+        preds,
+        target,
+        model=ToyEncoder(),
+        user_tokenizer=CharTokenizer(),
+        user_forward_fn=forward_fn,
+        max_length=_MAX_LEN,
+    )
+    pprint({k: [round(float(x), 4) for x in v] for k, v in score.items()})

@@ -1,0 +1,32 @@
+"""COCO mAP on a small hand-built scene.
+
+Equivalent of the reference example ``tm_examples/detection_map.py``: one
+image, several predicted boxes with scores/labels vs ground-truth boxes,
+printing the full COCO summary dict.
+
+Run: ``python examples/detection_map.py``
+"""
+from pprint import pprint
+
+import jax.numpy as jnp
+
+from metrics_tpu import MeanAveragePrecision
+
+if __name__ == "__main__":
+    preds = [
+        dict(
+            boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0], [20.0, 30.0, 80.0, 90.0]]),
+            scores=jnp.asarray([0.536, 0.71]),
+            labels=jnp.asarray([0, 1]),
+        )
+    ]
+    target = [
+        dict(
+            boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0], [25.0, 35.0, 85.0, 95.0]]),
+            labels=jnp.asarray([0, 1]),
+        )
+    ]
+
+    metric = MeanAveragePrecision(class_metrics=True)
+    metric.update(preds, target)
+    pprint({k: (v.tolist() if v.ndim else float(v)) for k, v in metric.compute().items()})

@@ -1,0 +1,44 @@
+"""ROUGE with a user-supplied normalizer and tokenizer.
+
+Equivalent of the reference example
+``tm_examples/rouge_score-own_normalizer_and_tokenizer.py``: shows how the
+``normalizer``/``tokenizer`` hooks of :class:`metrics_tpu.ROUGEScore` replace
+the built-in lowercase/alphanumeric normalization and whitespace split —
+e.g. for languages or domains where the defaults are wrong.
+
+Run: ``python examples/rouge_score-own_normalizer_and_tokenizer.py``
+"""
+import re
+from pprint import pprint
+from typing import Sequence
+
+from metrics_tpu import ROUGEScore
+
+
+class UserNormalizer:
+    """Keep digits as words too (the default normalizer strips punctuation only)."""
+
+    def __init__(self) -> None:
+        self.pattern = re.compile(r"[^a-z0-9]+")
+
+    def __call__(self, text: str) -> str:
+        return self.pattern.sub(" ", text.lower())
+
+
+class UserTokenizer:
+    """Split on whitespace; a real use-case would plug a subword/char tokenizer."""
+
+    pattern = re.compile(r"\s+")
+
+    def __call__(self, text: str) -> Sequence[str]:
+        return self.pattern.split(text)
+
+
+if __name__ == "__main__":
+    preds = "My name is John".split(". ")
+    target = "Is your name John".split(". ")
+
+    rouge = ROUGEScore(normalizer=UserNormalizer(), tokenizer=UserTokenizer())
+    for p, t in zip(preds, target):
+        rouge.update(p, t)
+    pprint({k: float(v) for k, v in rouge.compute().items()})

@@ -1,0 +1,62 @@
+"""Streaming metrics inside a jitted JAX/optax training loop.
+
+Equivalent of the reference's Lightning integration
+(``integrations/lightning.py`` + ``integrations/test_lightning.py``): the
+reference logs ``metric.forward`` per step and ``metric.compute`` per epoch
+from ``LightningModule`` hooks. The idiomatic JAX version shown here keeps
+the *gradient step* jitted and pure, then drives a ``MetricCollection``
+with the step's outputs — ``collection(preds, target)`` returns batch-local
+values (step logging), ``collection.compute()`` the epoch aggregate, and
+``collection.reset()`` starts the next epoch.
+
+Run: ``python examples/train_loop_metrics.py``
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+
+
+def make_data(n: int = 512, d: int = 16, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d,))
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@jax.jit
+def loss_fn(w, x, y):
+    logits = x @ w
+    return optax.sigmoid_binary_cross_entropy(logits, y.astype(jnp.float32)).mean()
+
+
+@jax.jit
+def train_step(w, opt_state, x, y):
+    loss, grads = jax.value_and_grad(loss_fn)(w, x, y)
+    updates, opt_state = optimizer.update(grads, opt_state)
+    w = optax.apply_updates(w, updates)
+    return w, opt_state, loss, jax.nn.sigmoid(x @ w)
+
+
+if __name__ == "__main__":
+    x, y = make_data()
+    w = jnp.zeros((x.shape[1],))
+    optimizer = optax.adam(1e-1)
+    opt_state = optimizer.init(w)
+
+    metrics = MetricCollection(
+        [Accuracy(), Precision(), Recall(), F1Score()], prefix="train/"
+    )
+
+    batch = 64
+    for epoch in range(3):
+        for i in range(0, len(x), batch):
+            xb, yb = x[i : i + batch], y[i : i + batch]
+            w, opt_state, loss, probs = train_step(w, opt_state, xb, yb)
+            step_values = metrics(probs, yb)  # batch-local, Lightning on_step logging
+        epoch_values = metrics.compute()  # epoch aggregate, on_epoch logging
+        print(f"epoch {epoch}: " + ", ".join(f"{k}={float(v):.3f}" for k, v in epoch_values.items()))
+        metrics.reset()

@@ -1,0 +1,66 @@
+"""Metric lifecycle inside a training loop (reference
+``integrations/test_lightning.py``): per-step forward values, per-epoch
+compute/reset, and accumulation-matches-oracle over the epoch — without the
+Lightning dependency, driving the same log/accumulate/reset semantics from a
+plain jitted loop."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, MeanMetric, MetricCollection, SumMetric
+
+
+def test_epoch_accumulate_reset_semantics():
+    rng = np.random.default_rng(0)
+    acc = Accuracy()
+    n_batches, batch = 4, 32
+    for epoch in range(2):
+        all_p, all_t = [], []
+        for _ in range(n_batches):
+            p = jnp.asarray(rng.uniform(0, 1, batch))
+            t = jnp.asarray(rng.integers(0, 2, batch))
+            step_val = acc(p, t)
+            # step value is batch-local
+            ref_step = ((np.asarray(p) >= 0.5).astype(int) == np.asarray(t)).mean()
+            np.testing.assert_allclose(float(step_val), ref_step, atol=1e-6)
+            all_p.append(np.asarray(p))
+            all_t.append(np.asarray(t))
+        epoch_val = acc.compute()
+        ref_epoch = ((np.concatenate(all_p) >= 0.5).astype(int) == np.concatenate(all_t)).mean()
+        np.testing.assert_allclose(float(epoch_val), ref_epoch, atol=1e-6)
+        acc.reset()
+        # state is cleared between epochs
+        assert int(acc.tp) == 0 and int(acc.fn) == 0
+
+
+def test_collection_in_jitted_loop():
+    """Metrics consume outputs of a jitted step without retracing per batch."""
+    trace_count = 0
+
+    @jax.jit
+    def step(w, x):
+        nonlocal trace_count
+        trace_count += 1
+        return jax.nn.sigmoid(x @ w)
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(8,)), dtype=jnp.float32)
+    metrics = MetricCollection([Accuracy()], prefix="train/")
+    for _ in range(5):
+        x = jnp.asarray(rng.normal(size=(16, 8)), dtype=jnp.float32)
+        t = jnp.asarray(rng.integers(0, 2, 16))
+        metrics(step(w, x), t)
+    assert trace_count == 1, "jitted step must not retrace across batches"
+    out = metrics.compute()
+    assert set(out) == {"train/Accuracy"}
+
+
+def test_logged_aggregators_track_loss():
+    """MeanMetric/SumMetric as loss trackers (Lightning's self.log analogue)."""
+    mean_loss, total_seen = MeanMetric(), SumMetric()
+    losses = [0.9, 0.7, 0.5, 0.3]
+    for loss in losses:
+        mean_loss.update(loss)
+        total_seen.update(1)
+    np.testing.assert_allclose(float(mean_loss.compute()), np.mean(losses), atol=1e-6)
+    assert int(total_seen.compute()) == len(losses)
