@@ -36,6 +36,7 @@ class Accuracy(StatScores):
         Array(0.5, dtype=float32)
     """
 
+    _aux_attrs = ('mode',)
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

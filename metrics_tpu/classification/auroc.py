@@ -24,6 +24,7 @@ class AUROC(Metric):
         Array(0.5, dtype=float32)
     """
 
+    _aux_attrs = ('mode', 'num_classes')
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -26,6 +26,7 @@ class AveragePrecision(Metric):
         Array(1., dtype=float32)
     """
 
+    _aux_attrs = ('num_classes', 'pos_label')
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -32,6 +32,7 @@ class PrecisionRecallCurve(Metric):
         Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
     """
 
+    _aux_attrs = ('num_classes', 'pos_label')
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

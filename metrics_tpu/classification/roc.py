@@ -24,6 +24,7 @@ class ROC(Metric):
         Array([0., 0., 0., 0., 1.], dtype=float32)
     """
 
+    _aux_attrs = ('num_classes', 'pos_label')
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

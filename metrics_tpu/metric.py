@@ -75,6 +75,9 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
+    # extra update-derived Python attrs (e.g. detected input mode) that must
+    # survive a checkpoint round-trip alongside the array states
+    _aux_attrs: tuple = ()
 
     def __init__(
         self,
@@ -584,7 +587,8 @@ def _wrap_update(update: Callable) -> Callable:
             )
         self._computed = None
         self._update_count += 1
-        update(self, *args, **kwargs)
+        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+            update(self, *args, **kwargs)
         if self._dtype_forced:
             # jnp ops promote dtypes (no in-place torch semantics); pin
             # non-list float states back to the forced dtype.
@@ -615,7 +619,8 @@ def _wrap_compute(compute: Callable) -> Callable:
             should_sync=self._to_sync,
             should_unsync=self._should_unsync,
         ):
-            value = compute(self)
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                value = compute(self)
             self._computed = _squeeze_if_scalar(value)
         return self._computed
 
