@@ -100,17 +100,19 @@ class BinnedPrecisionRecallCurve(Metric):
             )
 
     def update(self, preds: Array, target: Array) -> None:
-        """Vectorized over all thresholds: one (N, C, T) comparison."""
+        """Vectorized over all thresholds via the fused binning kernel
+        (pallas on TPU, one (N, C, T) XLA comparison elsewhere)."""
+        from metrics_tpu.ops import binned_counts
+
         if preds.ndim == target.ndim == 1:
             preds = preds.reshape(-1, 1)
             target = target.reshape(-1, 1)
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
-        target = (target == 1)[:, :, None]  # (N, C, 1)
-        predictions = preds[:, :, None] >= self.thresholds[None, None, :]  # (N, C, T)
-        self.TPs = self.TPs + (target & predictions).sum(axis=0)
-        self.FPs = self.FPs + ((~target) & predictions).sum(axis=0)
-        self.FNs = self.FNs + (target & (~predictions)).sum(axis=0)
+        tps, fps, fns = binned_counts(preds, (target == 1), self.thresholds)
+        self.TPs = self.TPs + tps
+        self.FPs = self.FPs + fps
+        self.FNs = self.FNs + fns
 
     def _compute_curve(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)

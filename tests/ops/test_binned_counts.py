@@ -1,0 +1,77 @@
+"""Pallas binning kernel vs numpy oracle (interpret mode on CPU) and the
+XLA fallback; plus the bincount fast paths."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops.binned_counts import _binned_counts_pallas, _binned_counts_xla, binned_counts
+from metrics_tpu.utilities.data import _bincount
+
+
+def _oracle(preds, target, thr):
+    mask = preds[:, :, None] >= thr[None, None, :]
+    tgt = target[:, :, None].astype(bool)
+    return (
+        (mask & tgt).sum(0).astype(np.float32),
+        (mask & ~tgt).sum(0).astype(np.float32),
+        (~mask & tgt).sum(0).astype(np.float32),
+    )
+
+
+@pytest.mark.parametrize("n,c,t", [(100, 1, 5), (1000, 3, 100), (8192, 2, 7)])
+def test_xla_matches_oracle(n, c, t):
+    rng = np.random.default_rng(0)
+    preds = rng.uniform(0, 1, (n, c)).astype(np.float32)
+    target = (rng.uniform(0, 1, (n, c)) > 0.7).astype(np.int32)
+    thr = np.linspace(0, 1.0, t).astype(np.float32)
+    got = _binned_counts_xla(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thr))
+    want = _oracle(preds, target, thr)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=0.5)
+
+
+@pytest.mark.parametrize("n,c,t", [(100, 1, 5), (10000, 3, 33)])
+def test_pallas_interpret_matches_oracle(n, c, t):
+    rng = np.random.default_rng(1)
+    preds = rng.uniform(0, 1, (n, c)).astype(np.float32)
+    target = (rng.uniform(0, 1, (n, c)) > 0.7).astype(np.int32)
+    thr = np.linspace(0, 1.0, t).astype(np.float32)
+    got = _binned_counts_pallas(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thr), interpret=True)
+    want = _oracle(preds, target, thr)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, atol=0.5)
+
+
+def test_dispatch_runs():
+    preds = jnp.asarray([[0.1], [0.6], [0.9]])
+    target = jnp.asarray([[0], [1], [1]])
+    thr = jnp.asarray([0.0, 0.5, 1.0])
+    tps, fps, fns = binned_counts(preds, target, thr)
+    np.testing.assert_allclose(np.asarray(tps), [[2.0, 2.0, 0.0]], atol=0.5)
+    np.testing.assert_allclose(np.asarray(fps), [[1.0, 0.0, 0.0]], atol=0.5)
+    np.testing.assert_allclose(np.asarray(fns), [[0.0, 0.0, 2.0]], atol=0.5)
+
+
+@pytest.mark.parametrize("minlength", [5, 100, 5000])
+def test_bincount_paths(minlength):
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.integers(0, minlength, 10000))
+    got = np.asarray(_bincount(x, minlength))
+    want = np.bincount(np.asarray(x), minlength=minlength)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_confmat_large_c_matmul_path():
+    from metrics_tpu.functional import confusion_matrix
+
+    rng = np.random.default_rng(3)
+    c = 100  # > 64 -> MXU dot path
+    preds = jnp.asarray(rng.integers(0, c, 5000))
+    target = jnp.asarray(rng.integers(0, c, 5000))
+    got = np.asarray(confusion_matrix(preds, target, num_classes=c))
+    want = np.zeros((c, c), dtype=np.int64)
+    np.fill_diagonal(want, 0)
+    for t, p in zip(np.asarray(target), np.asarray(preds)):
+        want[t, p] += 1
+    np.testing.assert_array_equal(got, want)
