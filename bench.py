@@ -22,7 +22,7 @@ N_SAMPLES = 1_000_000
 N_BATCHES = 16
 N_CLASSES = 10
 BATCH = N_SAMPLES // N_BATCHES
-K_REPEATS = 10
+K_REPEATS = 50
 
 
 def bench_tpu() -> float:

@@ -29,7 +29,7 @@ class PermutationInvariantTraining(Metric):
         >>> target = jnp.asarray([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
         >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, 'max')
         >>> pit(preds, target)
-        Array(-2.1065865, dtype=float32)
+        Array(3.2220826, dtype=float32)
     """
 
     is_differentiable = True

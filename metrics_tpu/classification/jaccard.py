@@ -19,7 +19,7 @@ class JaccardIndex(ConfusionMatrix):
         >>> preds = jnp.asarray([[0, 1, 0], [1, 1, 1]])
         >>> jaccard = JaccardIndex(num_classes=2)
         >>> jaccard(preds, target)
-        Array(0.58333334, dtype=float32)
+        Array(0.4666667, dtype=float32)
     """
 
     is_differentiable = False

@@ -131,7 +131,7 @@ def signal_distortion_ratio(
     else:
         sol = jnp.linalg.solve(_toeplitz_dense(acf), xcorr[..., None])[..., 0]
 
-    coh = jnp.einsum("...l,...l->...", xcorr, sol)
+    coh = jnp.einsum("...l,...l->...", xcorr, sol, precision="float32")
     ratio = coh / (1 - coh)
     return 10.0 * jnp.log10(ratio)
 

@@ -24,7 +24,7 @@ def _cohen_kappa_compute(confmat: Array, weights: Optional[str] = None) -> Array
     n_classes = confmat.shape[0]
     sum0 = confmat.sum(axis=0, keepdims=True)
     sum1 = confmat.sum(axis=1, keepdims=True)
-    expected = sum1 @ sum0 / sum0.sum()
+    expected = jnp.matmul(sum1, sum0, precision="float32") / sum0.sum()
 
     if weights is None:
         w_mat = jnp.ones((n_classes, n_classes), dtype=confmat.dtype)

@@ -80,6 +80,30 @@ def _stat_scores(
     return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
 
 
+def _micro_fast_path_eligible(
+    preds, target, reduce, mdmc_reduce, num_classes, top_k, multiclass, ignore_index, mode, validate_args
+) -> bool:
+    """True when the micro-multiclass shortcut in ``_stat_scores_update``
+    applies (validate_args=False, plain (N, C) float preds vs (N,) labels,
+    top-1, no ignore_index)."""
+    return (
+        not validate_args
+        and reduce == "micro"
+        and mdmc_reduce is None
+        and ignore_index is None
+        and (top_k is None or top_k == 1)
+        and multiclass is not False
+        and mode is None
+        and hasattr(preds, "ndim")
+        and hasattr(target, "ndim")
+        and preds.ndim == 2
+        and target.ndim == 1
+        and jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating)
+        and preds.shape[1] > 1
+        and (num_classes is None or num_classes == preds.shape[1])
+    )
+
+
 def _stat_scores_update(
     preds: Array,
     target: Array,
@@ -94,6 +118,18 @@ def _stat_scores_update(
     validate_args: bool = True,
 ) -> Tuple[Array, Array, Array, Array]:
     """Normalize inputs and count tp/fp/tn/fn (reference :110-193)."""
+    if _micro_fast_path_eligible(
+        preds, target, reduce, mdmc_reduce, num_classes, top_k, multiclass, ignore_index, mode, validate_args
+    ):
+        # micro multiclass fast path: the one-hot binarization cancels out —
+        # per sample, a correct argmax gives (tp=1, tn=C-1) and an incorrect
+        # one (fp=1, fn=1, tn=C-2), so four sums collapse to one compare.
+        # Only taken with validate_args=False (skips the gate's value checks).
+        n, c = preds.shape
+        correct = jnp.sum(jnp.argmax(preds, axis=1) == target).astype(jnp.int32)
+        n_arr = jnp.asarray(n, dtype=jnp.int32)
+        return correct, n_arr - correct, n_arr * (c - 2) + correct, n_arr - correct
+
     _negative_index_dropped = False
     if ignore_index is not None and ignore_index < 0 and mode is not None:
         preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)

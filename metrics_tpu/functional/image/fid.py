@@ -20,13 +20,13 @@ def _sqrtm_psd(mat: Array) -> Array:
     """Matrix square root of a symmetric PSD matrix via ``eigh``."""
     vals, vecs = jnp.linalg.eigh(mat)
     vals = jnp.clip(vals, 0, None)
-    return (vecs * jnp.sqrt(vals)[None, :]) @ vecs.T
+    return jnp.matmul(vecs * jnp.sqrt(vals)[None, :], vecs.T, precision="float32")
 
 
 def _trace_sqrtm_product(sigma1: Array, sigma2: Array) -> Array:
     """``tr(sqrtm(sigma1 @ sigma2))`` for symmetric PSD inputs."""
     a = _sqrtm_psd(sigma1)
-    inner = a @ sigma2 @ a
+    inner = jnp.matmul(jnp.matmul(a, sigma2, precision="float32"), a, precision="float32")
     inner = (inner + inner.T) / 2  # re-symmetrize against fp error
     vals = jnp.clip(jnp.linalg.eigvalsh(inner), 0, None)
     return jnp.sum(jnp.sqrt(vals))
@@ -49,4 +49,4 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
     """FID formula (reference ``image/fid.py:97-124``)."""
     diff = mu1 - mu2
     tr_covmean = _trace_sqrtm_product(sigma1, sigma2)
-    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+    return jnp.dot(diff, diff, precision="float32") + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean

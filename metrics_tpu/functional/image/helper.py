@@ -65,6 +65,7 @@ def _depthwise_conv(inputs: Array, kernel: Array) -> Array:
         padding="VALID",
         dimension_numbers=dn,
         feature_group_count=kernel.shape[0],
+        precision="float32",  # default precision truncates to bf16 on TPU
     )
 
 

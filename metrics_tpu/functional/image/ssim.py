@@ -356,7 +356,7 @@ def multiscale_structural_similarity_index_measure(
 
     Example:
         >>> import jax, jax.numpy as jnp
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 180, 180))
         >>> target = preds * 0.75
         >>> float(multiscale_structural_similarity_index_measure(preds, target)) > 0.7
         True

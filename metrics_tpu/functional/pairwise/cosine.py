@@ -23,7 +23,7 @@ def _pairwise_cosine_similarity_update(
     y = _to_float(y)
     x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
     y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
-    distance = x @ y.T
+    distance = jnp.matmul(x, y.T, precision="float32")
     if zero_diagonal:
         distance = _zero_diagonal(distance)
     return distance

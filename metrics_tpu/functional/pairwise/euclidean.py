@@ -23,7 +23,7 @@ def _pairwise_euclidean_distance_update(
     y = _to_float(y)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
-    distance = x_norm + y_norm - 2 * (x @ y.T)
+    distance = x_norm + y_norm - 2 * jnp.matmul(x, y.T, precision="float32")
     if zero_diagonal:
         distance = _zero_diagonal(distance)
     return jnp.sqrt(jnp.clip(distance, min=0.0))

@@ -6,6 +6,7 @@ Behavioral equivalent of reference
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
 from metrics_tpu.utilities.data import _to_float
@@ -19,7 +20,7 @@ def _pairwise_linear_similarity_update(
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x = _to_float(x)
     y = _to_float(y)
-    distance = x @ y.T
+    distance = jnp.matmul(x, y.T, precision="float32")
     if zero_diagonal:
         distance = _zero_diagonal(distance)
     return distance

@@ -87,7 +87,7 @@ def _bert_score_kernel(
     preds_emb, preds_w = _prep(preds_emb, preds_mask, preds_idf)
     target_emb, target_w = _prep(target_emb, target_mask, target_idf)
 
-    cos_sim = jnp.einsum("bpd, brd -> bpr", preds_emb, target_emb)
+    cos_sim = jnp.einsum("bpd, brd -> bpr", preds_emb, target_emb, precision="float32")
     precision = (cos_sim.max(axis=2) * preds_w).sum(-1)
     recall = (cos_sim.max(axis=1) * target_w).sum(-1)
     f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)

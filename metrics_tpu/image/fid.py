@@ -99,7 +99,7 @@ class FrechetInceptionDistance(Metric):
                 f"Expected extractor output of shape (N, {self.feature_dim}), got {features.shape}"
             )
         feat_sum = features.sum(axis=0)
-        outer_sum = features.T @ features
+        outer_sum = jnp.matmul(features.T, features, precision="float32")
         n = features.shape[0]
         if real:
             self.real_features_sum = self.real_features_sum + feat_sum

@@ -25,7 +25,7 @@ def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = 
     """Polynomial kernel matrix (reference ``kid.py:50``)."""
     if gamma is None:
         gamma = 1.0 / f1.shape[1]
-    return (f1 @ f2.T * gamma + coef) ** degree
+    return (jnp.matmul(f1, f2.T, precision="float32") * gamma + coef) ** degree
 
 
 def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:

@@ -126,7 +126,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
     Example:
         >>> import jax
         >>> from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 180, 180))
         >>> target = preds * 0.75
         >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
         >>> float(ms_ssim(preds, target)) > 0.7

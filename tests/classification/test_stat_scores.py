@@ -130,3 +130,26 @@ def test_stat_scores_doctest_values():
 def test_stat_scores_invalid_args(kwargs):
     with pytest.raises(ValueError):
         StatScores(**kwargs)
+
+
+def test_micro_fast_path_matches_general():
+    """The validate_args=False micro-multiclass shortcut must agree with the
+    full input-gate pipeline."""
+    import numpy as np
+    from metrics_tpu.functional.classification.stat_scores import (
+        _micro_fast_path_eligible,
+        _stat_scores_update,
+    )
+
+    rng = np.random.default_rng(11)
+    for c in (2, 3, 10):
+        preds = jnp.asarray(rng.uniform(0, 1, (257, c)), dtype=jnp.float32)
+        target = jnp.asarray(rng.integers(0, c, 257))
+        # guard against the gate silently going dead: the shortcut must fire
+        # for validate_args=False and not for validate_args=True
+        assert _micro_fast_path_eligible(preds, target, "micro", None, None, None, None, None, None, False)
+        assert not _micro_fast_path_eligible(preds, target, "micro", None, None, None, None, None, None, True)
+        fast = _stat_scores_update(preds, target, reduce="micro", validate_args=False)
+        slow = _stat_scores_update(preds, target, reduce="micro", validate_args=True)
+        for f, s in zip(fast, slow):
+            assert int(f) == int(s), (c, fast, slow)
