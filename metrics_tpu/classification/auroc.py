@@ -5,6 +5,7 @@ import jax
 
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.enums import AverageMethod, DataType
 
@@ -13,6 +14,11 @@ Array = jax.Array
 
 class AUROC(Metric):
     """Streaming area under the ROC curve.
+
+    ``sample_capacity`` switches the unbounded cat-list states to a
+    pre-allocated fixed-capacity HBM buffer of that many samples (static
+    shapes, jit-friendly streaming). Overflow raises eagerly; inside a
+    traced update excess samples silently clamp into the buffer tail.
 
     Example:
         >>> import jax.numpy as jnp
@@ -35,6 +41,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        sample_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -52,8 +59,8 @@ class AUROC(Metric):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
         self.mode: Optional[DataType] = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, mode = _auroc_update(preds, target)

@@ -8,6 +8,7 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_update,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
@@ -15,6 +16,11 @@ Array = jax.Array
 
 class AveragePrecision(Metric):
     """Streaming average precision.
+
+    ``sample_capacity`` switches the unbounded cat-list states to a
+    pre-allocated fixed-capacity HBM buffer of that many samples (static
+    shapes, jit-friendly streaming). Overflow raises eagerly; inside a
+    traced update excess samples silently clamp into the buffer tail.
 
     Example:
         >>> import jax.numpy as jnp
@@ -36,6 +42,7 @@ class AveragePrecision(Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
+        sample_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -45,8 +52,8 @@ class AveragePrecision(Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _average_precision_update(

@@ -1,11 +1,12 @@
 """CalibrationError metric class (reference ``torchmetrics/classification/calibration_error.py``, 111 LoC)."""
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
@@ -13,6 +14,11 @@ Array = jax.Array
 
 class CalibrationError(Metric):
     """Top-label calibration error with l1 (ECE), l2 (RMSCE) or max (MCE) norm.
+
+    ``sample_capacity`` switches the unbounded cat-list states to a
+    pre-allocated fixed-capacity HBM buffer of that many samples (static
+    shapes, jit-friendly streaming). Overflow raises eagerly; inside a
+    traced update excess samples silently clamp into the buffer tail.
 
     Example:
         >>> import jax.numpy as jnp
@@ -30,7 +36,7 @@ class CalibrationError(Metric):
 
     DISTANCES = {"l1", "l2", "max"}
 
-    def __init__(self, n_bins: int = 15, norm: str = "l1", **kwargs: Any) -> None:
+    def __init__(self, n_bins: int = 15, norm: str = "l1", sample_capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if norm not in self.DISTANCES:
             raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
@@ -39,8 +45,8 @@ class CalibrationError(Metric):
         self.n_bins = n_bins
         self.norm = norm
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        self.add_state("confidences", _cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("accuracies", _cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         confidences, accuracies = _ce_update(preds, target)

@@ -13,6 +13,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
@@ -20,6 +21,11 @@ Array = jax.Array
 
 class PrecisionRecallCurve(Metric):
     """Streaming precision-recall curve.
+
+    ``sample_capacity`` switches the unbounded cat-list states to a
+    pre-allocated fixed-capacity HBM buffer of that many samples (static
+    shapes, jit-friendly streaming). Overflow raises eagerly; inside a
+    traced update excess samples silently clamp into the buffer tail.
 
     Example:
         >>> import jax.numpy as jnp
@@ -41,13 +47,14 @@ class PrecisionRecallCurve(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        sample_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _precision_recall_curve_update(

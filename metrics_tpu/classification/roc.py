@@ -5,6 +5,7 @@ import jax
 
 from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
@@ -12,6 +13,11 @@ Array = jax.Array
 
 class ROC(Metric):
     """Streaming receiver operating characteristic curve.
+
+    ``sample_capacity`` switches the unbounded cat-list states to a
+    pre-allocated fixed-capacity HBM buffer of that many samples (static
+    shapes, jit-friendly streaming). Overflow raises eagerly; inside a
+    traced update excess samples silently clamp into the buffer tail.
 
     Example:
         >>> import jax.numpy as jnp
@@ -33,13 +39,14 @@ class ROC(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        sample_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)

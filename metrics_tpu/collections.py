@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import jax
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import _flatten_dict, allclose
 
 Array = jax.Array
@@ -234,6 +235,11 @@ class MetricCollection(dict):
                 if len(state1) != len(state2):
                     return False
                 if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif isinstance(state1, CapacityBuffer):
+                if len(state1) != len(state2):
+                    return False
+                if len(state1) and not allclose(state1.materialize(), state2.materialize()):
                     return False
             elif not allclose(state1, state2):
                 return False

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, Generator, List, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, apply_to_collection, dim_zero_cat
 from metrics_tpu.utilities.distributed import distributed_available, gather_all_tensors
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
@@ -137,7 +138,12 @@ class Metric(ABC):
         "cat", "min", "max", None, callable}`` declares how the state
         synchronizes across devices/processes.
         """
-        if not isinstance(default, list) and not isinstance(default, (jnp.ndarray, jax.Array)):
+        if isinstance(default, CapacityBuffer):
+            if default:
+                raise ValueError("`default` CapacityBuffer state must be initially empty")
+            if dist_reduce_fx not in ("cat", None):
+                raise ValueError("CapacityBuffer states require dist_reduce_fx='cat' or None")
+        elif not isinstance(default, list) and not isinstance(default, (jnp.ndarray, jax.Array)):
             default = jnp.asarray(default)
         if isinstance(default, list) and default:
             raise ValueError("`default` list state must be initially empty")
@@ -224,6 +230,11 @@ class Metric(ABC):
         for name, reduce_fx in self._reductions.items():
             acc = getattr(self, name)
             new = incoming[name]
+            if isinstance(acc, CapacityBuffer):
+                if isinstance(new, CapacityBuffer) and new:
+                    acc.append(new.materialize())
+                setattr(self, name, acc)
+                continue
             if isinstance(acc, list):
                 setattr(self, name, acc + list(new))
                 continue
@@ -250,7 +261,10 @@ class Metric(ABC):
         out: Dict[str, Union[Array, List]] = {}
         for name in self._defaults:
             value = getattr(self, name)
-            out[name] = list(value) if isinstance(value, list) else value
+            if isinstance(value, CapacityBuffer):
+                out[name] = deepcopy(value)
+            else:
+                out[name] = list(value) if isinstance(value, list) else value
         return out
 
     def _restore_state(self, cache: Dict[str, Union[Array, List]]) -> None:
@@ -263,7 +277,7 @@ class Metric(ABC):
         self._forward_cache = None
         self._computed = None
         for name, default in self._defaults.items():
-            setattr(self, name, deepcopy(default) if isinstance(default, list) else default)
+            setattr(self, name, deepcopy(default) if isinstance(default, (list, CapacityBuffer)) else default)
         self._cache = None
         self._is_synced = False
 
@@ -285,6 +299,8 @@ class Metric(ABC):
         for name, value in input_dict.items():
             if isinstance(value, list) and value:
                 input_dict[name] = [dim_zero_cat(value)]
+            elif isinstance(value, CapacityBuffer):
+                input_dict[name] = [value.materialize()] if value else []
 
         output_dict = apply_to_collection(
             input_dict,
@@ -294,7 +310,7 @@ class Metric(ABC):
         )
 
         for name, outputs in output_dict.items():
-            if isinstance(getattr(self, name), list):
+            if isinstance(getattr(self, name), (list, CapacityBuffer)):
                 # outputs is a list-of-lists: one gathered list per original
                 # (pre-concatenated) element — flatten to per-rank tensors.
                 if outputs and isinstance(outputs[0], list):
@@ -370,7 +386,10 @@ class Metric(ABC):
         for name in self._defaults:
             if name in state:
                 v = state[name]
-                setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
+                if isinstance(v, CapacityBuffer):
+                    setattr(self, name, deepcopy(v))
+                else:
+                    setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
         """Persistent-state snapshot (reference ``metric.py:571``)."""
@@ -378,7 +397,7 @@ class Metric(ABC):
         for name in self._defaults:
             if self._persistent[name]:
                 value = getattr(self, name)
-                out[prefix + name] = deepcopy(value) if isinstance(value, list) else value
+                out[prefix + name] = deepcopy(value) if isinstance(value, (list, CapacityBuffer)) else value
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
@@ -386,7 +405,10 @@ class Metric(ABC):
             key = prefix + name
             if key in state_dict:
                 v = state_dict[key]
-                setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
+                if isinstance(v, CapacityBuffer):
+                    setattr(self, name, deepcopy(v))
+                else:
+                    setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
 
     def persistent(self, mode: bool = False) -> None:
         """Toggle persistence of all states (reference ``metric.py:566``)."""
@@ -412,10 +434,14 @@ class Metric(ABC):
             value = getattr(self, name)
             if isinstance(value, list):
                 setattr(self, name, [_cast(v) for v in value])
+            elif isinstance(value, CapacityBuffer):
+                if value.data is not None and jnp.issubdtype(value.data.dtype, jnp.floating):
+                    value.data = value.data.astype(dst_type)
+                    value.dtype = jnp.dtype(dst_type)  # future appends cast too
             else:
                 setattr(self, name, _cast(value))
             default = self._defaults[name]
-            if not isinstance(default, list):
+            if not isinstance(default, (list, CapacityBuffer)):
                 self._defaults[name] = _cast(default)
         return self
 
@@ -594,7 +620,7 @@ def _wrap_update(update: Callable) -> Callable:
             # non-list float states back to the forced dtype.
             for name in self._defaults:
                 value = getattr(self, name)
-                if not isinstance(value, list) and jnp.issubdtype(value.dtype, jnp.floating):
+                if isinstance(value, (jnp.ndarray, jax.Array)) and jnp.issubdtype(value.dtype, jnp.floating):
                     setattr(self, name, value.astype(self._dtype))
         if self.compute_on_cpu:
             self._move_list_states_to_cpu()

@@ -1,0 +1,121 @@
+"""Fixed-capacity HBM sample buffers for ``cat`` states.
+
+The reference's unbounded-memory answer to cat-list states is host offload
+(``compute_on_cpu``, reference ``metric.py:313-323``). The TPU-native
+answer (SURVEY.md §7 hard part 1): a **pre-allocated device buffer plus a
+fill counter**, so streamed samples stay HBM-resident with a static shape —
+the state pytree never changes structure, `jit`-compiled accumulation
+doesn't retrace, and the distributed gather sees one contiguous array.
+
+:class:`CapacityBuffer` is list-API-compatible (mutating ``append``, same
+``dim_zero_cat`` consumption), so curve metrics switch between unbounded
+Python lists and bounded device buffers with a single ``sample_capacity``
+constructor argument. The item shape is discovered on first append, since
+metrics like AUROC only learn the class count from data.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["CapacityBuffer", "_cat_state_default"]
+
+
+def _cat_state_default(sample_capacity: Optional[int]):
+    """Default for a ``cat`` state: unbounded Python list, or an HBM-resident
+    fixed-capacity buffer when ``sample_capacity`` is given."""
+    return [] if sample_capacity is None else CapacityBuffer(sample_capacity)
+
+
+@jax.tree_util.register_pytree_node_class
+class CapacityBuffer:
+    """A ``(capacity, *item)`` device array with a fill counter.
+
+    ``append`` writes at the current count via ``lax.dynamic_update_slice``
+    (jit-safe, static shapes). The fill count is mirrored as a plain Python
+    int on the eager path, so appends never block on a device round-trip;
+    eager overflow raises. Inside a trace the mirror is unavailable and the
+    caller owns the capacity contract — ``dynamic_update_slice`` clamps the
+    start index, so excess samples silently overwrite the buffer tail
+    (a linear buffer, not ring wraparound).
+    """
+
+    def __init__(self, capacity: int, dtype: Any = None) -> None:
+        if capacity <= 0:
+            raise ValueError(f"`capacity` must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self.dtype = dtype
+        self.data: Optional[Array] = None  # allocated on first append
+        self.count: Array = jnp.asarray(0, dtype=jnp.int32)
+        self._host_count: Optional[int] = 0  # None when count came from a trace
+
+    # -- list-compatible mutating API -----------------------------------
+
+    def append(self, batch: Array) -> None:
+        batch = jnp.atleast_1d(jnp.asarray(batch))
+        if self.dtype is not None:
+            batch = batch.astype(self.dtype)
+        if self.data is None:
+            self.data = jnp.zeros((self.capacity,) + batch.shape[1:], dtype=batch.dtype)
+        n = batch.shape[0]
+        if self._host_count is not None:
+            if self._host_count + n > self.capacity:
+                raise ValueError(
+                    f"CapacityBuffer overflow: {self._host_count} + {n} > capacity {self.capacity}."
+                    " Raise `sample_capacity` or switch to unbounded list states."
+                )
+            self._host_count += n
+        start = (self.count,) + (jnp.asarray(0, jnp.int32),) * (batch.ndim - 1)
+        self.data = jax.lax.dynamic_update_slice(self.data, batch, start)
+        self.count = self.count + n
+
+    def _concrete_count(self) -> int:
+        if self._host_count is None:
+            self._host_count = int(self.count)  # one sync, then cached
+        return self._host_count
+
+    def materialize(self) -> Array:
+        """The filled prefix ``data[:count]`` (eager; count must be concrete)."""
+        if self.data is None:
+            raise ValueError("No samples to concatenate")
+        return self.data[: self._concrete_count()]
+
+    def __len__(self) -> int:
+        return self._concrete_count()
+
+    def __bool__(self) -> bool:
+        return self._concrete_count() > 0
+
+    def copy_empty(self) -> "CapacityBuffer":
+        return CapacityBuffer(self.capacity, self.dtype)
+
+    def __deepcopy__(self, memo: dict) -> "CapacityBuffer":
+        new = CapacityBuffer(self.capacity, self.dtype)
+        new.data = self.data  # jnp arrays are immutable
+        new.count = self.count
+        new._host_count = self._host_count
+        return new
+
+    def __repr__(self) -> str:
+        shape = None if self.data is None else tuple(self.data.shape)
+        return f"CapacityBuffer(capacity={self.capacity}, count={self.count}, data_shape={shape})"
+
+    # -- pytree protocol -------------------------------------------------
+
+    def tree_flatten(self) -> Tuple[tuple, tuple]:
+        if self.data is None:
+            return (self.count,), (self.capacity, self.dtype, False)
+        return (self.count, self.data), (self.capacity, self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux: tuple, children: tuple) -> "CapacityBuffer":
+        capacity, dtype, allocated = aux
+        new = cls.__new__(cls)
+        new.capacity = capacity
+        new.dtype = dtype
+        new.count = children[0]
+        new.data = children[1] if allocated else None
+        new._host_count = None  # unknown until concretized
+        return new

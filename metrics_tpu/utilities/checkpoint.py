@@ -24,13 +24,30 @@ __all__ = ["save_state", "restore_state", "metric_state_to_tree", "load_metric_s
 
 
 def _pack(value: Any) -> Any:
-    """Lists become index-keyed dicts (orbax trees need stable structure)."""
+    """Lists/buffers become plain dicts (orbax trees need stable structure
+    built from standard containers)."""
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+
+    if isinstance(value, CapacityBuffer):
+        packed = {"__capbuf_capacity": jnp.asarray(value.capacity, jnp.int32), "__capbuf_count": value.count}
+        if value.data is not None:
+            packed["__capbuf_data"] = value.data
+        return packed
     if isinstance(value, list):
         return {f"__list_{i}": v for i, v in enumerate(value)}
     return value
 
 
 def _unpack(value: Any) -> Any:
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+
+    if isinstance(value, dict) and "__capbuf_capacity" in value:
+        buf = CapacityBuffer(int(value["__capbuf_capacity"]))
+        if "__capbuf_data" in value:
+            buf.data = jnp.asarray(value["__capbuf_data"])
+        buf.count = jnp.asarray(value["__capbuf_count"], jnp.int32)
+        buf._host_count = None  # concretized lazily on first use
+        return buf
     if isinstance(value, dict) and all(k.startswith("__list_") for k in value):
         return [value[f"__list_{i}"] for i in range(len(value))]
     return value

@@ -17,9 +17,11 @@ Array = jax.Array
 
 
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
-    """Concatenate a (possibly list-valued) state along dim 0."""
+    """Concatenate a (possibly list- or buffer-valued) state along dim 0."""
     if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
         return x
+    if hasattr(x, "materialize"):  # CapacityBuffer
+        return x.materialize()
     x = [jnp.atleast_1d(y) for y in x]
     if not x:
         raise ValueError("No samples to concatenate")

@@ -1,0 +1,152 @@
+"""Fixed-capacity HBM buffers for cat states (SURVEY §7: pre-allocated
+buffers + fill counters replacing unbounded cat-lists)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import AUROC, PrecisionRecallCurve
+from metrics_tpu.utilities.buffers import CapacityBuffer
+from metrics_tpu.utilities.checkpoint import load_metric_state_tree, metric_state_to_tree
+from tests.helpers.testers import _wire_virtual_ddp
+
+
+def test_append_and_materialize():
+    buf = CapacityBuffer(10)
+    buf.append(jnp.asarray([1.0, 2.0]))
+    buf.append(jnp.asarray([3.0]))
+    assert len(buf) == 3
+    np.testing.assert_allclose(np.asarray(buf.materialize()), [1.0, 2.0, 3.0])
+    assert buf.data.shape == (10,)  # pre-allocated, static
+
+
+def test_2d_items_and_dtype():
+    buf = CapacityBuffer(8)
+    buf.append(jnp.ones((2, 3), dtype=jnp.float32))
+    buf.append(jnp.zeros((1, 3), dtype=jnp.float32))
+    assert buf.data.shape == (8, 3)
+    np.testing.assert_allclose(np.asarray(buf.materialize()), [[1, 1, 1], [1, 1, 1], [0, 0, 0]])
+
+
+def test_overflow_raises_eagerly():
+    buf = CapacityBuffer(3)
+    buf.append(jnp.asarray([1.0, 2.0]))
+    with pytest.raises(ValueError, match="overflow"):
+        buf.append(jnp.asarray([3.0, 4.0]))
+
+
+def test_jit_append_no_retrace():
+    """Appends inside jit: static shapes, one trace for a fixed batch size."""
+    traces = 0
+
+    @jax.jit
+    def step(data, count, batch):
+        nonlocal traces
+        traces += 1
+        data = jax.lax.dynamic_update_slice(data, batch, (count,))
+        return data, count + batch.shape[0]
+
+    data = jnp.zeros(64)
+    count = jnp.asarray(0, jnp.int32)
+    for i in range(4):
+        data, count = step(data, count, jnp.full((8,), float(i)))
+    assert traces == 1
+    assert int(count) == 32
+    np.testing.assert_allclose(np.asarray(data[:32]).reshape(4, 8).mean(1), [0, 1, 2, 3])
+
+
+def test_auroc_capacity_matches_list_mode():
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.uniform(0, 1, 300))
+    target = jnp.asarray(rng.integers(0, 2, 300))
+    m_list = AUROC()
+    m_buf = AUROC(sample_capacity=512)
+    for i in range(0, 300, 100):
+        m_list.update(preds[i : i + 100], target[i : i + 100])
+        m_buf.update(preds[i : i + 100], target[i : i + 100])
+    np.testing.assert_allclose(float(m_buf.compute()), float(m_list.compute()), atol=1e-7)
+    assert isinstance(m_buf.preds, CapacityBuffer)
+    # reset returns to an empty buffer, same capacity
+    m_buf.reset()
+    assert isinstance(m_buf.preds, CapacityBuffer) and len(m_buf.preds) == 0
+
+
+def test_forward_returns_batch_value_with_buffer():
+    rng = np.random.default_rng(1)
+    m = PrecisionRecallCurve(sample_capacity=256)
+    p1, t1 = jnp.asarray(rng.uniform(0, 1, 64)), jnp.asarray(rng.integers(0, 2, 64))
+    p2, t2 = jnp.asarray(rng.uniform(0, 1, 64)), jnp.asarray(rng.integers(0, 2, 64))
+    m(p1, t1)
+    m(p2, t2)
+    assert len(m.preds) == 128  # both batches accumulated
+    ref = PrecisionRecallCurve()
+    ref.update(jnp.concatenate([p1, p2]), jnp.concatenate([t1, t2]))
+    for a, b in zip(m.compute(), ref.compute()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_virtual_ddp_sync_with_buffers():
+    rng = np.random.default_rng(2)
+    preds = jnp.asarray(rng.uniform(0, 1, 200))
+    target = jnp.asarray(rng.integers(0, 2, 200))
+    ranks = [AUROC(sample_capacity=256) for _ in range(2)]
+    _wire_virtual_ddp(ranks)
+    ranks[0].update(preds[:100], target[:100])
+    ranks[1].update(preds[100:], target[100:])
+    synced = float(ranks[0].compute())
+    ref = AUROC()
+    ref.update(preds, target)
+    np.testing.assert_allclose(synced, float(ref.compute()), atol=1e-7)
+    # unsync restored the local buffer
+    assert isinstance(ranks[0].preds, CapacityBuffer) and len(ranks[0].preds) == 100
+
+
+def test_checkpoint_roundtrip_with_buffer():
+    rng = np.random.default_rng(3)
+    m = AUROC(sample_capacity=128)
+    m.update(jnp.asarray(rng.uniform(0, 1, 50)), jnp.asarray(rng.integers(0, 2, 50)))
+    m2 = AUROC(sample_capacity=128)
+    load_metric_state_tree(m2, metric_state_to_tree(m))
+    np.testing.assert_allclose(float(m2.compute()), float(m.compute()), atol=1e-7)
+    # restored metric keeps streaming
+    m2.update(jnp.asarray(rng.uniform(0, 1, 30)), jnp.asarray(rng.integers(0, 2, 30)))
+    assert len(m2.preds) == 80
+
+
+def test_collection_compute_groups_with_buffers():
+    """Compute-group detection must handle buffer states (ROC/AUROC sharing
+    cat states is the flagship compute-group case)."""
+    from metrics_tpu import MetricCollection, ROC
+
+    rng = np.random.default_rng(4)
+    coll = MetricCollection({"auroc": AUROC(sample_capacity=128), "roc": ROC(sample_capacity=128)})
+    p = jnp.asarray(rng.uniform(0, 1, 60))
+    t = jnp.asarray(rng.integers(0, 2, 60))
+    coll.update(p, t)
+    coll.update(p, t)
+    out = coll.compute()
+    ref = AUROC()
+    ref.update(jnp.concatenate([p, p]), jnp.concatenate([t, t]))
+    np.testing.assert_allclose(float(out["auroc"]), float(ref.compute()), atol=1e-7)
+
+
+def test_set_dtype_with_buffer():
+    m = AUROC(sample_capacity=64)
+    m.update(jnp.asarray([0.2, 0.8, 0.5]), jnp.asarray([0, 1, 1]))
+    m.set_dtype(jnp.bfloat16)
+    assert m.preds.data.dtype == jnp.bfloat16
+    m.update(jnp.asarray([0.4], dtype=jnp.float32), jnp.asarray([0]))  # future appends cast
+    assert len(m.preds) == 4
+
+
+def test_load_state_dict_copies_buffer():
+    src = AUROC(sample_capacity=64)
+    src.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    tree = metric_state_to_tree(src)
+    m2, m3 = AUROC(sample_capacity=64), AUROC(sample_capacity=64)
+    load_metric_state_tree(m2, tree)
+    load_metric_state_tree(m3, tree)
+    m2.update(jnp.asarray([0.5] * 5), jnp.asarray([1] * 5))
+    assert len(m2.preds) == 7
+    assert len(m3.preds) == 2  # not aliased to m2's buffer

@@ -69,10 +69,11 @@ def _wire_virtual_ddp(metrics: Sequence[Metric]) -> None:
             out = []
             for m in metrics:
                 v = getattr(m, name)
-                if isinstance(v, list) and not v:
+                is_catlike = isinstance(v, list) or hasattr(v, "materialize")
+                if is_catlike and not v:
                     # peer rank saw no data: contribute an empty, dtype-matched chunk
                     out.append(jnp.zeros((0,) + tuple(x.shape[1:]), dtype=x.dtype))
-                elif isinstance(v, list):
+                elif is_catlike:
                     out.append(dim_zero_cat(v))
                 else:
                     out.append(v)
