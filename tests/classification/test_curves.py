@@ -187,3 +187,41 @@ class TestBinned(MetricTester):
         )
         # tolerance for binning
     atol = 5e-3
+
+
+def test_auroc_static_path_jittable_and_tie_exact():
+    """Exact AUROC must compile under jit (static tie collapsing) and match
+    sklearn when scores contain heavy ties."""
+    import jax
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu.functional.classification.auroc import _auroc_compute
+    from metrics_tpu.utilities.enums import DataType
+
+    rng = np.random.default_rng(7)
+    p = jnp.asarray(np.round(rng.uniform(0, 1, 2000), 1))  # 11 distinct values
+    t = jnp.asarray(rng.integers(0, 2, 2000))
+    f = jax.jit(lambda p, t: _auroc_compute(p, t, DataType.BINARY, pos_label=1))
+    np.testing.assert_allclose(float(f(p, t)), roc_auc_score(np.asarray(t), np.asarray(p)), atol=1e-6)
+
+    c = 4
+    pm = jnp.asarray(rng.dirichlet(np.ones(c), 1500))
+    tm = jnp.asarray(rng.integers(0, c, 1500))
+    g = jax.jit(lambda p, t: _auroc_compute(p, t, DataType.MULTICLASS, num_classes=c, average="macro"))
+    sk = roc_auc_score(np.asarray(tm), np.asarray(pm), multi_class="ovr", average="macro")
+    np.testing.assert_allclose(float(g(pm, tm)), sk, atol=1e-6)
+
+
+def test_auroc_pos_label_zero():
+    """pos_label=0 must flip the positive class, not silently coerce to 1."""
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu.functional.classification.auroc import _auroc_compute
+    from metrics_tpu.utilities.enums import DataType
+
+    rng = np.random.default_rng(9)
+    p = jnp.asarray(rng.uniform(0, 1, 500))
+    t = jnp.asarray(rng.integers(0, 2, 500))
+    got = float(_auroc_compute(p, t, DataType.BINARY, pos_label=0))
+    want = roc_auc_score(1 - np.asarray(t), np.asarray(p))
+    np.testing.assert_allclose(got, want, atol=1e-6)
