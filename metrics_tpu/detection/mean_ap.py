@@ -118,42 +118,6 @@ def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
 
 
-def _greedy_match(
-    ious: np.ndarray, iou_thresholds: np.ndarray, gt_ignore: np.ndarray
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """COCO greedy matching, vectorized over the threshold axis.
-
-    Args:
-        ious: (n_det, n_gt) IoU matrix, detections in descending-score order,
-            ground truths with ignored ones sorted last.
-        iou_thresholds: (T,) thresholds.
-        gt_ignore: (n_gt,) ignore flags.
-
-    Returns:
-        (det_matches (T, n_det) bool, gt_matches (T, n_gt) bool,
-        det_ignore (T, n_det) bool from matched-ignored-gt propagation).
-
-    Follows reference ``_find_best_gt_match`` (mean_ap.py:513): previously
-    matched and ignored gts are masked out entirely before the argmax.
-    """
-    n_det, n_gt = ious.shape
-    n_thrs = len(iou_thresholds)
-    gt_matches = np.zeros((n_thrs, n_gt), dtype=bool)
-    det_matches = np.zeros((n_thrs, n_det), dtype=bool)
-    det_ignore = np.zeros((n_thrs, n_det), dtype=bool)
-    if n_gt == 0 or n_det == 0:
-        return det_matches, gt_matches, det_ignore
-    thr_idx = np.arange(n_thrs)
-    for idx_det in range(n_det):
-        masked = ious[idx_det][None, :] * ~(gt_matches | gt_ignore[None, :])  # (T, n_gt)
-        m = masked.argmax(axis=1)
-        ok = masked[thr_idx, m] > iou_thresholds
-        det_matches[ok, idx_det] = True
-        det_ignore[ok, idx_det] = gt_ignore[m[ok]]
-        gt_matches[ok[:, None] & (np.arange(n_gt)[None, :] == m[:, None])] = True
-    return det_matches, gt_matches, det_ignore
-
-
 class MeanAveragePrecision(Metric):
     r"""COCO mAP / mAR over object-detection predictions.
 
@@ -222,23 +186,52 @@ class MeanAveragePrecision(Metric):
         self.add_state("n_images", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
-        """Buffer one batch of per-image predictions/ground truths (flattened)."""
+        """Buffer one batch of per-image predictions/ground truths (flattened).
+
+        The whole batch is concatenated host-side first so the device sees
+        ONE chunk per state per call — per-image eager device ops would pay
+        a dispatch (and on tunneled TPUs a round trip) per image.
+        """
+        # pull everything to host in ONE batched transfer (per-array eager
+        # fetches pay a round trip each — fatal on tunneled TPUs), then
+        # normalize; absent keys stay absent so the validator reports them
+        preds, target = jax.device_get((list(preds), list(target)))
+        def _normalize(item: Dict[str, Any], float_keys: Tuple[str, ...]) -> Dict[str, Any]:
+            out = dict(item)
+            if "boxes" in out:
+                out["boxes"] = np.asarray(out["boxes"], dtype=np.float32).reshape(-1, 4)
+            for key in float_keys:
+                if key in out:
+                    out[key] = np.asarray(out[key], dtype=np.float32).reshape(-1)
+            if "labels" in out:
+                out["labels"] = np.asarray(out["labels"], dtype=np.int64).reshape(-1)
+            return out
+
+        preds = [_normalize(p, ("scores",)) for p in preds]
+        target = [_normalize(t, ()) for t in target]
         _input_validator(preds, target)
         start = int(self.n_images)
-        for offset, (pred, tgt) in enumerate(zip(preds, target)):
-            img_id = start + offset
-            boxes = jnp.asarray(pred["boxes"], dtype=jnp.float32).reshape(-1, 4)
-            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-            self.det_boxes.append(boxes)
-            self.det_scores.append(jnp.asarray(pred["scores"], dtype=jnp.float32).reshape(-1))
-            self.det_labels.append(jnp.asarray(pred["labels"]).reshape(-1).astype(jnp.int32))
-            self.det_img_idx.append(jnp.full((boxes.shape[0],), img_id, dtype=jnp.int32))
 
-            g_boxes = jnp.asarray(tgt["boxes"], dtype=jnp.float32).reshape(-1, 4)
-            g_boxes = box_convert(g_boxes, in_fmt=self.box_format, out_fmt="xyxy")
-            self.gt_boxes.append(g_boxes)
-            self.gt_labels.append(jnp.asarray(tgt["labels"]).reshape(-1).astype(jnp.int32))
-            self.gt_img_idx.append(jnp.full((g_boxes.shape[0],), img_id, dtype=jnp.int32))
+        def _cat(arrays, empty_shape, dtype):
+            arrays = list(arrays)
+            return np.concatenate(arrays) if arrays else np.zeros(empty_shape, dtype)
+
+        d_boxes = [p["boxes"] for p in preds]
+        d_counts = [b.shape[0] for b in d_boxes]
+        g_boxes = [t["boxes"] for t in target]
+        g_counts = [b.shape[0] for b in g_boxes]
+        img_ids = np.arange(start, start + len(preds), dtype=np.int32)
+
+        boxes = jnp.asarray(_cat(d_boxes, (0, 4), np.float32))
+        self.det_boxes.append(box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy"))
+        self.det_scores.append(jnp.asarray(_cat((p["scores"] for p in preds), (0,), np.float32)))
+        self.det_labels.append(jnp.asarray(_cat((p["labels"] for p in preds), (0,), np.int64).astype(np.int32)))
+        self.det_img_idx.append(jnp.asarray(np.repeat(img_ids, d_counts)))
+
+        gboxes = jnp.asarray(_cat(g_boxes, (0, 4), np.float32))
+        self.gt_boxes.append(box_convert(gboxes, in_fmt=self.box_format, out_fmt="xyxy"))
+        self.gt_labels.append(jnp.asarray(_cat((t["labels"] for t in target), (0,), np.int64).astype(np.int32)))
+        self.gt_img_idx.append(jnp.asarray(np.repeat(img_ids, g_counts)))
         self.n_images = self.n_images + len(preds)
 
     def _sync_dist(self, dist_sync_fn=gather_all_tensors, process_group=None) -> None:
@@ -267,65 +260,20 @@ class MeanAveragePrecision(Metric):
     # Evaluation (host side)
     # ------------------------------------------------------------------
 
-    def _evaluate_image(
+    def _accumulate_flat(
         self,
-        det: np.ndarray,
         scores: np.ndarray,
-        gt: np.ndarray,
-        area_range: Tuple[int, int],
-        max_det: int,
-        ious: np.ndarray,
-    ) -> Optional[Dict[str, np.ndarray]]:
-        """Per-(image, class, area-range) match statistics (ref :421)."""
-        if len(gt) == 0 and len(det) == 0:
-            return None
-        areas = _np_box_area(gt)
-        ignore_area = (areas < area_range[0]) | (areas > area_range[1])
-        gtind = np.argsort(ignore_area, kind="stable")  # non-ignored first
-        gt = gt[gtind]
-        gt_ignore = ignore_area[gtind]
-
-        det = det[:max_det]
-        scores = scores[:max_det]
-        ious_sorted = ious[:max_det][:, gtind] if ious.size else ious
-
-        det_matches, gt_matches, det_ignore = _greedy_match(
-            ious_sorted, np.asarray(self.iou_thresholds), gt_ignore
-        )
-
-        # unmatched detections outside the area range are ignored too
-        if len(det):
-            det_areas = _np_box_area(det)
-            det_out = (det_areas < area_range[0]) | (det_areas > area_range[1])
-            det_ignore = det_ignore | (~det_matches & det_out[None, :])
-        return {
-            "dtMatches": det_matches,
-            "gtMatches": gt_matches,
-            "dtScores": scores,
-            "gtIgnore": gt_ignore,
-            "dtIgnore": det_ignore,
-        }
-
-    def _accumulate(
-        self, evals: List[Optional[Dict[str, np.ndarray]]], max_det: int
+        matches: np.ndarray,
+        ignore: np.ndarray,
+        npig: int,
     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """Merge per-image evals into (recall (T,), precision (T, R)) (ref :672)."""
-        evals = [e for e in evals if e is not None]
-        if not evals:
-            return None
-        n_rec_thrs = len(self.rec_thresholds)
-        det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
-        # mergesort for Matlab/pycocotools-consistent tie order (ref :694)
-        inds = np.argsort(-det_scores, kind="mergesort")
-        det_scores_sorted = det_scores[inds]
-        det_matches = np.concatenate([e["dtMatches"][:, :max_det] for e in evals], axis=1)[:, inds]
-        det_ignore = np.concatenate([e["dtIgnore"][:, :max_det] for e in evals], axis=1)[:, inds]
-        gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
-        npig = int(np.count_nonzero(~gt_ignore))
+        """(recall (T,), precision (T, R)) from flat score-sorted det stats
+        (ref :672). ``scores`` (D,), ``matches``/``ignore`` (T, D)."""
         if npig == 0:
             return None
-        tps = det_matches & ~det_ignore
-        fps = ~det_matches & ~det_ignore
+        n_rec_thrs = len(self.rec_thresholds)
+        tps = matches & ~ignore
+        fps = ~matches & ~ignore
         tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
         fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
 
@@ -372,7 +320,7 @@ class MeanAveragePrecision(Metric):
 
         d_order, d_keys, d_bounds = _runs(det_img, det_labels)
         g_order, g_keys, g_bounds = _runs(gt_img, gt_labels)
-        per_img_cls: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = {}
+        per_img_cls: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
         d_slices = {tuple(k): d_order[d_bounds[i] : d_bounds[i + 1]] for i, k in enumerate(d_keys)}
         g_slices = {tuple(k): g_order[g_bounds[i] : g_bounds[i + 1]] for i, k in enumerate(g_keys)}
         for key in set(d_slices) | set(g_slices):
@@ -382,27 +330,107 @@ class MeanAveragePrecision(Metric):
             order = np.argsort(-d_s, kind="stable")[:max_det_global]
             d_b, d_s = d_b[order], d_s[order]
             g_b = gt_boxes[g_sel]
-            ious = _np_box_iou(d_b, g_b) if len(d_b) and len(g_b) else np.zeros((len(d_b), len(g_b)))
-            per_img_cls[(int(key[0]), int(key[1]))] = (d_b, d_s, g_b, ious)
+            per_img_cls[(int(key[0]), int(key[1]))] = (d_b, d_s, g_b)
 
         n_thrs = len(self.iou_thresholds)
         n_rec = len(self.rec_thresholds)
-        shape = (n_thrs, n_rec, len(class_ids), len(self.bbox_area_ranges), len(self.max_detection_thresholds))
-        precision = -np.ones(shape)
-        recall = -np.ones((n_thrs, len(class_ids), len(self.bbox_area_ranges), len(self.max_detection_thresholds)))
+        n_areas = len(self.bbox_area_ranges)
+        n_mdets = len(self.max_detection_thresholds)
+        precision = -np.ones((n_thrs, n_rec, len(class_ids), n_areas, n_mdets))
+        recall = -np.ones((n_thrs, len(class_ids), n_areas, n_mdets))
 
-        by_class: Dict[int, List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]] = {}
-        for (img, cls), entry in sorted(per_img_cls.items()):
-            by_class.setdefault(cls, []).append(entry)
+        # ---- pad all (image, class) cells into one batch ----------------
+        # Greedy matching is sequential over score-ranked detections, but
+        # only within a cell: one loop over detection RANK with every cell
+        # and IoU threshold vectorized turns ~n_cells * max_det tiny numpy
+        # calls into max_det array ops (the pycocotools/reference layout is
+        # a Python loop per (image, class, area); ref :421/:672).
+        cells = sorted(per_img_cls.items())  # (img, cls) order fixes tie-breaks
+        n_cells = len(cells)
+        if n_cells == 0:
+            return precision, recall
+        md = max(1, min(max_det_global, max(len(e[1][1]) for e in cells)))
+        cell_cls = np.asarray([cls for (_, cls), _ in cells])
+        cell_ng = np.asarray([len(value[2]) for _, value in cells])
+        scores_p = np.full((n_cells, md), -np.inf, dtype=np.float32)
+        det_valid = np.zeros((n_cells, md), dtype=bool)
+        det_areas = np.zeros((n_cells, md), dtype=np.float32)
+        for i, (_, (d_b, d_s, _)) in enumerate(cells):
+            nd = len(d_s)
+            scores_p[i, :nd] = d_s
+            det_valid[i, :nd] = True
+            if nd:
+                det_areas[i, :nd] = _np_box_area(d_b)
 
-        for idx_cls, cls in enumerate(class_ids):
+        # bucket cells by gt count so one crowded cell doesn't inflate the
+        # (n_cells, md, mg) padding for everyone (f32; buckets are powers of 4)
+        bucket_caps = [c for c in (4, 16, 64, 256) if c < max(1, int(cell_ng.max()))]
+        bucket_caps.append(max(1, int(cell_ng.max())))
+        det_matches_all = {}  # area_idx -> (n_cells, T, md)
+        gt_ignore_counts = np.zeros((n_areas, n_cells))
+        iou_thrs = np.asarray(self.iou_thresholds)
+        for idx_area in range(n_areas):
+            det_matches_all[idx_area] = np.zeros((n_cells, n_thrs, md), dtype=bool)
+
+        prev_cap = -1
+        for cap in bucket_caps:
+            bucket = np.nonzero((cell_ng > prev_cap) & (cell_ng <= cap))[0]
+            prev_cap = cap
+            if bucket.size == 0:
+                continue
+            nb, mg = bucket.size, max(1, cap)
+            gt_valid = np.zeros((nb, mg), dtype=bool)
+            gt_areas = np.zeros((nb, mg), dtype=np.float32)
+            ious_p = np.zeros((nb, md, mg), dtype=np.float32)
+            for j, i in enumerate(bucket):
+                _, (d_b, d_s, g_b) = cells[i]
+                nd, ng = len(d_s), len(g_b)
+                gt_valid[j, :ng] = True
+                if ng:
+                    gt_areas[j, :ng] = _np_box_area(g_b)
+                if nd and ng:
+                    ious_p[j, :nd, :ng] = _np_box_iou(d_b, g_b)
+            rows = np.arange(nb)
             for idx_area, area_range in enumerate(self.bbox_area_ranges.values()):
-                evals = [
-                    self._evaluate_image(d_b, d_s, g_b, area_range, max_det_global, ious)
-                    for d_b, d_s, g_b, ious in by_class.get(cls, [])
-                ]
+                gt_out = (gt_areas < area_range[0]) | (gt_areas > area_range[1])
+                gt_ignore = gt_out | ~gt_valid  # padding never matches
+                gt_ignore_counts[idx_area, bucket] = (~gt_ignore & gt_valid).sum(axis=1)
+
+                # vectorized greedy matching (ref :421/:513 semantics: matched
+                # and ignored gts are masked out entirely before the argmax)
+                gt_matched = np.zeros((nb, n_thrs, mg), dtype=bool)
+                for d in range(md):
+                    masked = ious_p[:, d, None, :] * ~(gt_matched | gt_ignore[:, None, :])
+                    m = masked.argmax(axis=2)  # (nb, T)
+                    ok = np.take_along_axis(masked, m[:, :, None], axis=2)[:, :, 0] > iou_thrs[None, :]
+                    ok &= det_valid[bucket, d][:, None]
+                    det_matches_all[idx_area][bucket, :, d] = ok
+                    gt_matched[rows[:, None], np.arange(n_thrs)[None, :], m] |= ok
+
+        for idx_area, area_range in enumerate(self.bbox_area_ranges.values()):
+            det_out = (det_areas < area_range[0]) | (det_areas > area_range[1])
+            det_matches = det_matches_all[idx_area]
+            det_ignore_base = ~det_matches & (det_out[:, None, :] | ~det_valid[:, None, :])
+
+            npig_cell = gt_ignore_counts[idx_area]
+            for idx_cls, cls in enumerate(class_ids):
+                sel = cell_cls == cls
+                if not sel.any():
+                    continue
+                npig = int(npig_cell[sel].sum())
+                cls_scores = scores_p[sel]  # (nc, md)
+                cls_matches = det_matches[sel]
+                cls_ignore = det_ignore_base[sel]
+                cls_dvalid = det_valid[sel]
                 for idx_md, max_det in enumerate(self.max_detection_thresholds):
-                    acc = self._accumulate(evals, max_det)
+                    keep = cls_dvalid & (np.arange(md)[None, :] < max_det)
+                    flat_scores = np.where(keep, cls_scores, -np.inf).reshape(-1)
+                    order = np.argsort(-flat_scores, kind="mergesort")  # ref :694 tie order
+                    n_keep = int(keep.sum())
+                    order = order[:n_keep]
+                    flat_m = cls_matches.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
+                    flat_i = cls_ignore.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
+                    acc = self._accumulate_flat(flat_scores[order], flat_m, flat_i, npig)
                     if acc is None:
                         continue
                     rec, prec = acc

@@ -3,7 +3,7 @@
 Behavioral equivalent of reference
 ``torchmetrics/regression/cosine_similarity.py:24`` (cat-list states).
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
 
@@ -12,6 +12,7 @@ from metrics_tpu.functional.regression.cosine_similarity import (
     _cosine_similarity_update,
 )
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
@@ -34,14 +35,14 @@ class CosineSimilarity(Metric):
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+    def __init__(self, reduction: str = "sum", sample_capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         allowed_reduction = ("sum", "mean", "none", None)
         if reduction not in allowed_reduction:
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.reduction = reduction
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _cosine_similarity_update(preds, target)

@@ -3,12 +3,13 @@
 Behavioral equivalent of reference ``torchmetrics/regression/spearman.py:23``
 (cat-list states; rank transform at compute).
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
 
 from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -32,14 +33,14 @@ class SpearmanCorrCoef(Metric):
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, **kwargs: Any) -> None:
+    def __init__(self, sample_capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         rank_zero_warn(
             "Metric `SpearmanCorrcoef` will save all targets and predictions in the buffer."
             " For large datasets, this may lead to a large memory footprint."
         )
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _spearman_corrcoef_update(preds, target)

@@ -338,3 +338,28 @@ def test_empty_rank_sync_dtypes():
     synced = ranks[0].compute()
     want = _oracle_map(preds, targets)
     _compare(synced, want)
+
+
+def test_empty_update_noop():
+    """update([], []) must be a no-op (a rank can receive zero images)."""
+    m = MeanAveragePrecision()
+    m.update([], [])
+    box = jnp.asarray([[10.0, 10.0, 50.0, 60.0]])
+    m.update([dict(boxes=box, scores=jnp.asarray([0.9]), labels=jnp.asarray([0]))],
+             [dict(boxes=box, labels=jnp.asarray([0]))])
+    np.testing.assert_allclose(float(m.compute()["map"]), 1.0, atol=1e-6)
+
+
+def test_crowded_cell_bucketing():
+    """A single crowded (image, class) cell must not change results (it only
+    changes the padding bucket it lands in)."""
+    rng = np.random.default_rng(21)
+    preds, targets = _rand_corpus(rng, 6)
+    # one image with many same-class gts
+    gxy = rng.uniform(0, 100, (40, 2))
+    targets[0] = dict(boxes=jnp.asarray(np.concatenate([gxy, gxy + 20], 1), dtype=jnp.float32),
+                      labels=jnp.zeros(40, dtype=jnp.int32))
+    m = MeanAveragePrecision()
+    m.update(preds, targets)
+    want = _oracle_map(preds, targets)
+    _compare(m.compute(), want)

@@ -1,0 +1,88 @@
+"""Real multi-process DCN sync: 2 jax.distributed processes on localhost.
+
+The analogue of the reference's gloo-pool DDP tests
+(``tests/bases/test_ddp.py`` via ``torch.multiprocessing`` spawn): two OS
+processes join a JAX coordinator, each accumulates a disjoint data shard,
+and ``compute()`` must equal the single-process result on the concatenated
+data — exercising the actual ``multihost_utils.process_allgather`` path of
+``gather_all_tensors`` (incl. uneven shard sizes), not the in-process
+virtual harness."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address={coord!r},
+        num_processes=2,
+        process_id=int(sys.argv[1]),
+    )
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import Accuracy, AUROC
+
+    rank = jax.process_index()
+    rng = np.random.default_rng(0)
+    preds = rng.uniform(0, 1, 200)
+    target = rng.integers(0, 2, 200)
+    # uneven shards: rank 0 gets 120 samples, rank 1 gets 80
+    lo, hi = (0, 120) if rank == 0 else (120, 200)
+
+    acc = Accuracy()
+    acc.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    total = float(acc.compute())
+    ref = ((preds >= 0.5).astype(int) == target).mean()
+    np.testing.assert_allclose(total, ref, atol=1e-6)
+
+    auroc = AUROC()   # cat-list state -> uneven all-gather path
+    auroc.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    from sklearn.metrics import roc_auc_score
+    np.testing.assert_allclose(float(auroc.compute()), roc_auc_score(target, preds), atol=1e-6)
+    print(f"rank {{rank}} OK", flush=True)
+    """
+)
+
+
+def test_two_process_dcn_sync(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.format(repo=repo, coord=f"127.0.0.1:{port}"))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.skip("jax.distributed coordinator timed out in this environment")
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {i} failed:\n{out}"
+        assert f"rank {i} OK" in out
