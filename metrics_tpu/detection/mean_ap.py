@@ -192,6 +192,11 @@ class MeanAveragePrecision(Metric):
         ONE chunk per state per call — per-image eager device ops would pay
         a dispatch (and on tunneled TPUs a round trip) per image.
         """
+        # container-type errors must surface before normalization touches items
+        if not isinstance(preds, Sequence) or isinstance(preds, (str, dict)):
+            raise ValueError("Expected argument `preds` to be of type Sequence")
+        if not isinstance(target, Sequence) or isinstance(target, (str, dict)):
+            raise ValueError("Expected argument `target` to be of type Sequence")
         # pull everything to host in ONE batched transfer (per-array eager
         # fetches pay a round trip each — fatal on tunneled TPUs), then
         # normalize; absent keys stay absent so the validator reports them
@@ -210,6 +215,8 @@ class MeanAveragePrecision(Metric):
         preds = [_normalize(p, ("scores",)) for p in preds]
         target = [_normalize(t, ()) for t in target]
         _input_validator(preds, target)
+        if not preds:  # empty shard: avoid growing the state lists with 0-size chunks
+            return
         start = int(self.n_images)
 
         def _cat(arrays, empty_shape, dtype):

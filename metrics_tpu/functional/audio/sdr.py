@@ -148,6 +148,11 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         Array(18.403925, dtype=float32)
     """
     _check_same_shape(preds, target)
+    # as in signal_noise_ratio: half floats are storage types here, the
+    # scale/energy sums must accumulate in f32
+    if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
+        preds = preds.astype(jnp.float32)
+    target = target.astype(preds.dtype)
     eps = jnp.finfo(preds.dtype).eps
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)

@@ -29,6 +29,13 @@ class CosineSimilarity(Metric):
         >>> cosine_similarity = CosineSimilarity(reduction='mean')
         >>> cosine_similarity(preds, target)
         Array(0.8535534, dtype=float32)
+
+    Args:
+        reduction: how to reduce over samples — ``"sum"``, ``"mean"`` or
+            ``"none"``/``None``.
+        sample_capacity: switches the unbounded cat-list states to a
+            fixed-capacity HBM buffer holding at most this many samples
+            (static shapes under jit; overflow raises at compute).
     """
 
     is_differentiable = True

@@ -27,6 +27,12 @@ class SpearmanCorrCoef(Metric):
         >>> spearman = SpearmanCorrCoef()
         >>> spearman(preds, target)
         Array(1., dtype=float32)
+
+    Args:
+        sample_capacity: switches the unbounded cat-list states to a
+            fixed-capacity HBM buffer holding at most this many samples
+            (static shapes under jit; overflow raises at compute) —
+            bounding the memory footprint the warning below refers to.
     """
 
     is_differentiable = False

@@ -1,0 +1,122 @@
+"""Cross-domain differentiability and half-precision batteries.
+
+Mirrors the reference MetricTester's ``run_differentiability_test``
+(``tests/helpers/testers.py:530-564`` — ``torch.autograd.gradcheck`` when
+``is_differentiable``, no-grad assertion otherwise) and
+``run_precision_test_{cpu,gpu}`` (``:297-326``), as one parametrized sweep:
+for every case the declared ``is_differentiable`` flag must match whether
+``jax.grad`` of the functional form w.r.t. ``preds`` is somewhere nonzero,
+and bf16 inputs must give finite results close to the fp32 value.
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+import metrics_tpu.functional as F
+from tests.helpers.testers import MetricTester
+
+_rng = np.random.default_rng(7)
+
+N, C, T = 32, 5, 128
+
+_reg_preds = jnp.asarray(_rng.standard_normal((2, N)), jnp.float32)
+_reg_target = jnp.asarray(_rng.standard_normal((2, N)), jnp.float32)
+_pos_preds = jnp.asarray(_rng.random((2, N)) + 0.1, jnp.float32)
+_pos_target = jnp.asarray(_rng.random((2, N)) + 0.1, jnp.float32)
+_vec_preds = jnp.asarray(_rng.standard_normal((2, N, 8)), jnp.float32)
+_vec_target = jnp.asarray(_rng.standard_normal((2, N, 8)), jnp.float32)
+_prob_preds = jnp.asarray(_rng.random((2, N, C)), jnp.float32)
+_int_target = jnp.asarray(_rng.integers(0, C, (2, N)), jnp.int32)
+_dist_p = jnp.asarray(_rng.random((2, N, C)) + 0.05, jnp.float32)
+_dist_p = _dist_p / _dist_p.sum(-1, keepdims=True)
+_dist_q = jnp.asarray(_rng.random((2, N, C)) + 0.05, jnp.float32)
+_dist_q = _dist_q / _dist_q.sum(-1, keepdims=True)
+_audio_preds = jnp.asarray(_rng.standard_normal((2, 4, T)), jnp.float32)
+_audio_target = jnp.asarray(_rng.standard_normal((2, 4, T)), jnp.float32)
+_spk_preds = jnp.asarray(_rng.standard_normal((2, 3, 2, 64)), jnp.float32)
+_spk_target = jnp.asarray(_rng.standard_normal((2, 3, 2, 64)), jnp.float32)
+_img_preds = jnp.asarray(_rng.random((2, 2, 3, 32, 32)), jnp.float32)
+_img_target = jnp.asarray(_rng.random((2, 2, 3, 32, 32)), jnp.float32)
+
+Case = namedtuple("Case", ["name", "module", "functional", "preds", "target", "args", "strict"])
+
+CASES = [
+    Case("mse", mt.MeanSquaredError, F.mean_squared_error, _reg_preds, _reg_target, {}, True),
+    Case("mae", mt.MeanAbsoluteError, F.mean_absolute_error, _reg_preds, _reg_target, {}, True),
+    Case("msle", mt.MeanSquaredLogError, F.mean_squared_log_error, _pos_preds, _pos_target, {}, True),
+    Case("mape", mt.MeanAbsolutePercentageError, F.mean_absolute_percentage_error, _pos_preds, _pos_target, {}, True),
+    Case("smape", mt.SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, _pos_preds, _pos_target, {}, True),
+    Case("wmape", mt.WeightedMeanAbsolutePercentageError, F.weighted_mean_absolute_percentage_error, _pos_preds, _pos_target, {}, True),
+    Case("cosine", mt.CosineSimilarity, F.cosine_similarity, _vec_preds, _vec_target, {}, True),
+    Case("explained_variance", mt.ExplainedVariance, F.explained_variance, _reg_preds, _reg_target, {}, True),
+    Case("r2", mt.R2Score, F.r2_score, _reg_preds, _reg_target, {}, True),
+    Case("pearson", mt.PearsonCorrCoef, F.pearson_corrcoef, _reg_preds, _reg_target, {}, True),
+    Case("spearman", mt.SpearmanCorrCoef, F.spearman_corrcoef, _reg_preds, _reg_target, {}, True),
+    Case("tweedie", mt.TweedieDevianceScore, F.tweedie_deviance_score, _pos_preds, _pos_target, {"power": 1.5}, True),
+    Case("hinge", mt.HingeLoss, F.hinge_loss, _prob_preds, _int_target, {}, True),
+    Case("kld", mt.KLDivergence, F.kl_divergence, _dist_p, _dist_q, {}, True),
+    Case("accuracy", mt.Accuracy, F.accuracy, _prob_preds, _int_target, {}, True),
+    Case("precision", mt.Precision, F.precision, _prob_preds, _int_target, {}, True),
+    Case("f1", mt.F1Score, F.f1_score, _prob_preds, _int_target, {}, True),
+    Case("specificity", mt.Specificity, F.specificity, _prob_preds, _int_target, {}, True),
+    Case("hamming", mt.HammingDistance, F.hamming_distance, _prob_preds, _int_target, {}, True),
+    Case("stat_scores", mt.StatScores, F.stat_scores, _prob_preds, _int_target, {}, True),
+    Case("confmat", mt.ConfusionMatrix, F.confusion_matrix, _prob_preds, _int_target, {"num_classes": C}, True),
+    Case("cohen_kappa", mt.CohenKappa, F.cohen_kappa, _prob_preds, _int_target, {"num_classes": C}, True),
+    Case("matthews", mt.MatthewsCorrCoef, F.matthews_corrcoef, _prob_preds, _int_target, {"num_classes": C}, True),
+    Case("jaccard", mt.JaccardIndex, F.jaccard_index, _prob_preds, _int_target, {"num_classes": C}, True),
+    Case("auroc", mt.AUROC, F.auroc, _prob_preds, _int_target, {"num_classes": C}, True),
+    # binning is discontinuous but the ECE value still varies with the raw
+    # confidences, so only finiteness is asserted (strict=False)
+    Case("calibration", mt.CalibrationError, F.calibration_error, _prob_preds / _prob_preds.sum(-1, keepdims=True), _int_target, {}, False),
+    Case("snr", mt.SignalNoiseRatio, F.signal_noise_ratio, _audio_preds, _audio_target, {}, True),
+    Case("si_snr", mt.ScaleInvariantSignalNoiseRatio, F.scale_invariant_signal_noise_ratio, _audio_preds, _audio_target, {}, True),
+    Case("sdr", mt.SignalDistortionRatio, F.signal_distortion_ratio, _audio_preds, _audio_target, {"filter_length": 32}, True),
+    Case("pit", mt.PermutationInvariantTraining, F.permutation_invariant_training, _spk_preds, _spk_target, {"metric_func": F.scale_invariant_signal_noise_ratio}, True),
+    Case("psnr", mt.PeakSignalNoiseRatio, F.peak_signal_noise_ratio, _img_preds, _img_target, {"data_range": 1.0}, True),
+    Case("ssim", mt.StructuralSimilarityIndexMeasure, F.structural_similarity_index_measure, _img_preds, _img_target, {"data_range": 1.0}, True),
+    Case("uqi", mt.UniversalImageQualityIndex, F.universal_image_quality_index, _img_preds, _img_target, {}, True),
+    Case("ergas", mt.ErrorRelativeGlobalDimensionlessSynthesis, F.error_relative_global_dimensionless_synthesis, _img_preds, _img_target, {}, True),
+    Case("sam", mt.SpectralAngleMapper, F.spectral_angle_mapper, _img_preds, _img_target, {}, True),
+]
+
+
+class _Tester(MetricTester):
+    pass
+
+
+@pytest.mark.parametrize("case", CASES, ids=[c.name for c in CASES])
+def test_differentiability_contract(case):
+    tester = _Tester()
+    if not case.strict:
+        import jax
+
+        grads = jax.grad(
+            lambda p: sum(
+                jnp.sum(jnp.asarray(leaf, jnp.float32))
+                for leaf in jax.tree_util.tree_leaves(case.functional(p, case.target[0], **case.args))
+            )
+        )(case.preds[0])
+        assert bool(jnp.all(jnp.isfinite(grads)))
+        return
+    tester.run_differentiability_test(case.preds, case.target, case.module, case.functional, metric_args=case.args)
+
+
+_HALF_CASES = {
+    "mse": 1e-2, "mae": 1e-2, "cosine": 5e-2, "accuracy": 1e-2, "f1": 1e-2,
+    "hamming": 1e-2, "snr": 1e-1, "si_snr": 1e-1, "psnr": 1e-1, "ssim": 5e-2,
+    "kld": 5e-2, "hinge": 5e-2,
+}
+
+
+@pytest.mark.parametrize("case", [c for c in CASES if c.name in _HALF_CASES], ids=[c.name for c in CASES if c.name in _HALF_CASES])
+def test_bfloat16_support(case):
+    tester = _Tester()
+    tol = _HALF_CASES[case.name]
+    tester.run_precision_test(
+        case.preds, case.target, case.module, case.functional, metric_args=case.args, atol=tol, rtol=tol
+    )

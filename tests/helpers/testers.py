@@ -193,6 +193,95 @@ class MetricTester:
         metrics[0].reset()
         assert metrics[0]._update_count == 0
 
+    def run_differentiability_test(
+        self,
+        preds: jnp.ndarray,
+        target: jnp.ndarray,
+        metric_module: type,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """Check the ``is_differentiable`` contract against real gradients.
+
+        JAX analogue of the reference's ``run_differentiability_test``
+        (``tests/helpers/testers.py:530-564``, which runs
+        ``torch.autograd.gradcheck`` when ``is_differentiable`` and asserts
+        ``requires_grad is False`` otherwise): here we take ``jax.grad`` of
+        the (sum-reduced) functional form w.r.t. ``preds`` and require
+
+        * gradients always exist and are finite (no NaN from the kernel), and
+        * they are somewhere nonzero iff the class declares
+          ``is_differentiable=True`` — threshold/argmax/rank-based metrics
+          must be locally constant in ``preds``.
+        """
+        import jax
+
+        metric_args = metric_args or {}
+        metric = metric_module(**metric_args)
+        assert metric.is_differentiable is not None, (
+            f"{metric_module.__name__} must declare is_differentiable"
+        )
+
+        p0 = jnp.asarray(preds[0], dtype=jnp.float32)
+        t0 = target[0]
+
+        def scalar_fn(p):
+            out = metric_functional(p, t0, **metric_args)
+            leaves = jax.tree_util.tree_leaves(out)
+            tot = jnp.zeros((), dtype=jnp.float32)
+            for leaf in leaves:
+                tot = tot + jnp.sum(jnp.asarray(leaf, dtype=jnp.float32))
+            return tot
+
+        grads = jax.grad(scalar_fn)(p0)
+        assert bool(jnp.all(jnp.isfinite(grads))), "non-finite gradient"
+        has_grad = bool(jnp.any(grads != 0))
+        assert has_grad == bool(metric.is_differentiable), (
+            f"{metric_module.__name__}: is_differentiable={metric.is_differentiable} "
+            f"but grad nonzero={has_grad}"
+        )
+
+    def run_precision_test(
+        self,
+        preds: jnp.ndarray,
+        target: jnp.ndarray,
+        metric_module: type,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+        dtype: Any = jnp.bfloat16,
+        atol: float = 1e-2,
+        rtol: float = 1e-2,
+    ) -> None:
+        """Half-precision support check (reference ``testers.py:297-326``).
+
+        Stronger than the reference's run-and-assert-tensor: the class and
+        functional forms are fed ``dtype`` (bf16 by default — the TPU native
+        half type) inputs and the results must stay finite AND within a loose
+        tolerance of the fp32 functional result.
+        """
+        metric_args = metric_args or {}
+        p_half = jnp.asarray(preds[0], dtype=dtype)
+        t0 = target[0]
+        if jnp.issubdtype(jnp.asarray(t0).dtype, jnp.floating):
+            t_half = jnp.asarray(t0, dtype=dtype)
+        else:
+            t_half = t0
+
+        import jax
+
+        p32 = jnp.asarray(preds[0], jnp.float32)
+        fn_ref32 = metric_functional(p32, t0, **metric_args)
+        cls_ref32 = metric_module(**metric_args)(p32, t0)
+
+        fn_half = metric_functional(p_half, t_half, **metric_args)
+        cls_half = metric_module(**metric_args)(p_half, t_half)
+
+        for res, ref32 in ((fn_half, fn_ref32), (cls_half, cls_ref32)):
+            for got, want in zip(jax.tree_util.tree_leaves(res), jax.tree_util.tree_leaves(ref32)):
+                got = np.asarray(got, dtype=np.float32)
+                assert np.all(np.isfinite(got)), "non-finite half-precision result"
+                np.testing.assert_allclose(got, np.asarray(want, np.float32), atol=atol, rtol=rtol)
+
 
 class DummyMetric(Metric):
     """Minimal metric for protocol tests."""

@@ -12,6 +12,7 @@ import socket
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -27,6 +28,7 @@ _WORKER = textwrap.dedent(
         num_processes=2,
         process_id=int(sys.argv[1]),
     )
+    print(f"rank {{int(sys.argv[1])}} init", flush=True)
     import jax.numpy as jnp
     import numpy as np
 
@@ -75,12 +77,20 @@ def test_two_process_dcn_sync(tmp_path):
         for i in range(2)
     ]
     outs = []
+    deadline = time.monotonic() + 150  # one shared budget for both ranks
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=max(1.0, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
+            # keep outputs already drained from finished ranks; only the
+            # not-yet-communicated procs still have pipes to read
+            outs = outs + [q.communicate()[0] or "" for q in procs[len(outs):]]
+            if any("init" in o for o in outs):
+                # coordinator handshake succeeded: a hang past this point is
+                # a real deadlock in the gather path, not an env problem
+                pytest.fail(f"workers hung after jax.distributed init:\n{outs}")
             pytest.skip("jax.distributed coordinator timed out in this environment")
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
