@@ -72,12 +72,17 @@ class COCOMetricResults(BaseMetricResults):
     )
 
 
+def _validate_container_types(preds: Any, targets: Any) -> None:
+    """Reject non-Sequence containers (str iterates as characters, so exclude it)."""
+    if not isinstance(preds, Sequence) or isinstance(preds, str):
+        raise ValueError("Expected argument `preds` to be of type Sequence")
+    if not isinstance(targets, Sequence) or isinstance(targets, str):
+        raise ValueError("Expected argument `target` to be of type Sequence")
+
+
 def _input_validator(preds: Sequence[Dict[str, Any]], targets: Sequence[Dict[str, Any]]) -> None:
     """Shape/key checks (reference ``mean_ap.py:83``)."""
-    if not isinstance(preds, Sequence):
-        raise ValueError("Expected argument `preds` to be of type Sequence")
-    if not isinstance(targets, Sequence):
-        raise ValueError("Expected argument `target` to be of type Sequence")
+    _validate_container_types(preds, targets)
     if len(preds) != len(targets):
         raise ValueError("Expected argument `preds` and `target` to have the same length")
     for k in ("boxes", "scores", "labels"):
@@ -193,10 +198,7 @@ class MeanAveragePrecision(Metric):
         a dispatch (and on tunneled TPUs a round trip) per image.
         """
         # container-type errors must surface before normalization touches items
-        if not isinstance(preds, Sequence) or isinstance(preds, (str, dict)):
-            raise ValueError("Expected argument `preds` to be of type Sequence")
-        if not isinstance(target, Sequence) or isinstance(target, (str, dict)):
-            raise ValueError("Expected argument `target` to be of type Sequence")
+        _validate_container_types(preds, target)
         # pull everything to host in ONE batched transfer (per-array eager
         # fetches pay a round trip each — fatal on tunneled TPUs), then
         # normalize; absent keys stay absent so the validator reports them

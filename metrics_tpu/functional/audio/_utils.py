@@ -1,0 +1,19 @@
+"""Shared audio-kernel helpers."""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def upcast_half_precision(preds: Array, target: Array) -> tuple:
+    """Promote sub-f32 float inputs to f32 for energy accumulations.
+
+    bf16/f16 are storage types for audio metrics: the noise/scale terms are
+    near-cancellations, and half-precision sums of squares lose several dB on
+    noise-like signals. Elementwise work may stay half, but every energy
+    reduction must accumulate in f32.
+    """
+    if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
+        preds = preds.astype(jnp.float32)
+    target = target.astype(preds.dtype)
+    return preds, target

@@ -22,6 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.audio._utils import upcast_half_precision
 from metrics_tpu.utilities.checks import _check_same_shape
 
 Array = jax.Array
@@ -148,11 +149,7 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         Array(18.403925, dtype=float32)
     """
     _check_same_shape(preds, target)
-    # as in signal_noise_ratio: half floats are storage types here, the
-    # scale/energy sums must accumulate in f32
-    if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
-        preds = preds.astype(jnp.float32)
-    target = target.astype(preds.dtype)
+    preds, target = upcast_half_precision(preds, target)
     eps = jnp.finfo(preds.dtype).eps
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)

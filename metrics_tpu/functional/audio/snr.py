@@ -8,6 +8,7 @@ friendly.
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.audio._utils import upcast_half_precision
 from metrics_tpu.utilities.checks import _check_same_shape
 
 Array = jax.Array
@@ -25,12 +26,7 @@ def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> 
         Array(16.180521, dtype=float32)
     """
     _check_same_shape(preds, target)
-    # bf16/f16 storage is fine but the energy ratio needs f32 accumulation:
-    # the noise term is a near-cancellation, and half-precision sums of
-    # squares lose several dB on noise-like signals.
-    if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
-        preds = preds.astype(jnp.float32)
-    target = target.astype(preds.dtype)
+    preds, target = upcast_half_precision(preds, target)
     eps = jnp.finfo(preds.dtype).eps
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)

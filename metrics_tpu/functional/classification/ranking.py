@@ -30,6 +30,15 @@ def _check_ranking_input(preds: Array, target: Array, sample_weight: Optional[Ar
             )
 
 
+def _weighted_mean(value, n_elements, sample_weight):
+    """value / sum(weights), falling back to / n_elements when the weight sum
+    is zero (or no weights were given) — trace-safe, no host pull."""
+    if sample_weight is None:
+        return value / n_elements
+    safe = jnp.where(sample_weight != 0.0, sample_weight, 1.0)
+    return jnp.where(sample_weight != 0.0, value / safe, value / n_elements)
+
+
 def _coverage_error_update(
     preds: Array, target: Array, sample_weight: Optional[Array] = None
 ) -> Tuple[Array, int, Optional[Array]]:
@@ -46,9 +55,7 @@ def _coverage_error_update(
 
 
 def _coverage_error_compute(coverage: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
-    if sample_weight is not None and float(sample_weight) != 0.0:
-        return coverage / sample_weight
-    return coverage / n_elements
+    return _weighted_mean(coverage, n_elements, sample_weight)
 
 
 def coverage_error(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
@@ -92,9 +99,7 @@ def _label_ranking_average_precision_update(
 def _label_ranking_average_precision_compute(
     score: Array, n_elements: int, sample_weight: Optional[Array] = None
 ) -> Array:
-    if sample_weight is not None and float(sample_weight) != 0.0:
-        return score / sample_weight
-    return score / n_elements
+    return _weighted_mean(score, n_elements, sample_weight)
 
 
 def label_ranking_average_precision(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:
@@ -121,15 +126,13 @@ def _label_ranking_loss_update(
     if sample_weight is not None:
         loss = loss * jnp.where(mask, sample_weight, 0.0)
         sample_weight = sample_weight.sum()
-    if not bool(mask.any()):
-        return jnp.asarray(0.0), 1, sample_weight
+    # no early-out for an all-false mask: loss is already zero there, and
+    # 0 / n_preds == 0 / 1 — keeping it branch-free is trace-safe
     return loss.sum(), n_preds, sample_weight
 
 
 def _label_ranking_loss_compute(loss: Array, n_elements: int, sample_weight: Optional[Array] = None) -> Array:
-    if sample_weight is not None and float(sample_weight) != 0.0:
-        return loss / sample_weight
-    return loss / n_elements
+    return _weighted_mean(loss, n_elements, sample_weight)
 
 
 def label_ranking_loss(preds: Array, target: Array, sample_weight: Optional[Array] = None) -> Array:

@@ -33,6 +33,18 @@ def _is_floating(x: Array) -> bool:
     return jnp.issubdtype(x.dtype, jnp.floating)
 
 
+def _concrete(*arrays: Array) -> bool:
+    """True when every array holds concrete values (eager mode).
+
+    Value-level validation (range/label checks that pull scalars to host)
+    only runs eagerly; while tracing under ``jit`` these checks are skipped
+    and only the static shape/dtype checks apply — the trace-time analogue of
+    the reference resolving input cases from tensor values at runtime
+    (``utilities/checks.py:65-119``).
+    """
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
 def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
     return preds.size == 0 and target.size == 0
 
@@ -53,15 +65,17 @@ def _basic_input_validation(
         return
     if _is_floating(target):
         raise ValueError("The `target` has to be an integer tensor.")
+    preds_float = _is_floating(preds)
+    if preds.shape[0] != target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if not _concrete(preds, target):
+        return  # tracing: value-level checks below are eager-only
     # A negative ignore_index legitimizes negative padding labels (dropped
     # upstream by _drop_negative_ignored_indices); mirror reference :46-49.
     if (ignore_index is None or ignore_index >= 0) and target.min() < 0:
         raise ValueError("The `target` has to be a non-negative tensor.")
-    preds_float = _is_floating(preds)
     if not preds_float and preds.min() < 0:
         raise ValueError("If `preds` are integers, they have to be non-negative.")
-    if preds.shape[0] != target.shape[0]:
-        raise ValueError("The `preds` and `target` should have the same first dimension.")
     if multiclass is False and target.max() > 1:
         raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
     if multiclass is False and not preds_float and preds.max() > 1:
@@ -136,7 +150,7 @@ def _check_num_classes_mc(
                 "You have set `multiclass=False`, but the implied number of classes"
                 " (from shape of inputs) does not match `num_classes`."
             )
-        if target.size > 0 and num_classes <= int(target.max()):
+        if target.size > 0 and _concrete(target) and num_classes <= int(target.max()):
             raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
         if preds.shape != target.shape and num_classes != implied_classes:
             raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
@@ -193,6 +207,7 @@ def _check_classification_inputs(
         preds.ndim == target.ndim
         and _is_floating(preds)
         and target.size > 0
+        and _concrete(target)
         and int(target.max()) > 1
     ):
         raise ValueError(
@@ -205,7 +220,7 @@ def _check_classification_inputs(
                 "You have set `multiclass=False`, but have more than 2 classes in your data,"
                 " based on the C dimension of `preds`."
             )
-        if target.size > 0 and int(target.max()) >= implied_classes:
+        if target.size > 0 and _concrete(target) and int(target.max()) >= implied_classes:
             raise ValueError(
                 "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
             )
@@ -280,6 +295,11 @@ def _input_format_classification(
             preds = select_topk(preds, top_k or 1)
         else:
             if not num_classes:
+                if not _concrete(preds, target):
+                    raise ValueError(
+                        "`num_classes` must be given explicitly when tracing under `jit`:"
+                        " inferring it from the label values is a data-dependent shape."
+                    )
                 # Value-dependent inference — eager host peek, mirrors reference :429.
                 num_classes = int(max(int(preds.max()), int(target.max()))) + 1
             preds = to_onehot(preds, max(2, num_classes))
@@ -313,7 +333,7 @@ def _check_retrieval_target_and_prediction_types(
         raise ValueError("`target` must be a tensor of booleans, integers or floats")
     if not _is_floating(preds):
         raise ValueError("`preds` must be a tensor of floats")
-    if not allow_non_binary_target and (target.max() > 1 or target.min() < 0):
+    if not allow_non_binary_target and _concrete(target) and (target.max() > 1 or target.min() < 0):
         raise ValueError("`target` must contain `binary` values")
     target = target.astype(jnp.float32) if _is_floating(target) else target.astype(jnp.int32)
     preds = preds.astype(jnp.float32)

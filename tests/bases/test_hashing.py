@@ -1,0 +1,25 @@
+"""Instance-identity hashing (reference ``tests/bases/test_hashing.py``).
+
+Two metric instances constructed with identical arguments must hash
+differently: hashes include instance identity (reference ``metric.py:633``
+hashes the ids of list states for the same reason) so metrics can key dicts
+and sets without colliding across replicas.
+"""
+import pytest
+
+from tests.helpers.testers import DummyListMetric, DummyMetric
+
+
+@pytest.mark.parametrize("metric_cls", [DummyMetric, DummyListMetric])
+def test_metric_hashing(metric_cls):
+    instance_1 = metric_cls()
+    instance_2 = metric_cls()
+
+    assert hash(instance_1) != hash(instance_2)
+    assert id(instance_1) != id(instance_2)
+
+    # hash is stable across state mutation (usable as a dict key for a
+    # metric's whole lifetime)
+    h = hash(instance_1)
+    instance_1.update(1.0)
+    assert hash(instance_1) == h

@@ -87,7 +87,7 @@ def test_two_process_dcn_sync(tmp_path):
             # keep outputs already drained from finished ranks; only the
             # not-yet-communicated procs still have pipes to read
             outs = outs + [q.communicate()[0] or "" for q in procs[len(outs):]]
-            if any("init" in o for o in outs):
+            if any(f"rank {i} init" in o for i, o in enumerate(outs)):
                 # coordinator handshake succeeded: a hang past this point is
                 # a real deadlock in the gather path, not an env problem
                 pytest.fail(f"workers hung after jax.distributed init:\n{outs}")
