@@ -1,0 +1,4 @@
+"""Framework integrations (reference ``integrations/``)."""
+from metrics_tpu.integrations.logger import MetricLogger
+
+__all__ = ["MetricLogger"]

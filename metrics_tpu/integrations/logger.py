@@ -1,0 +1,93 @@
+"""Lightning-style metric logging lifecycle.
+
+The reference's Lightning integration (``integrations/test_lightning.py``)
+rests on ``LightningModule.log(name, metric)``: metrics logged with
+``on_step=True`` report their batch-local forward value every step, metrics
+with ``on_epoch=True`` are computed and reset at epoch end by the trainer.
+``MetricLogger`` reproduces that lifecycle for plain JAX training loops —
+the trainer-side bookkeeping without the trainer:
+
+    logger = MetricLogger()
+    for epoch in range(E):
+        for xb, yb in batches:
+            probs = train_step(...)
+            logger.log("train/acc", acc_metric, probs, yb)
+            logger.log("train/loss", loss)              # plain scalars too
+            step_vals = logger.step_values()            # on_step logging
+        epoch_vals = logger.epoch_values()              # compute + reset
+
+Metrics are identified by name: logging the same name again with a Metric
+object drives ``forward`` on that object; `epoch_values()` computes every
+logged metric (triggering its distributed sync), resets it, and archives the
+values in ``history``.
+"""
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.metric import Metric
+
+__all__ = ["MetricLogger"]
+
+
+class MetricLogger:
+    """Drives ``forward``-per-step / ``compute``+``reset``-per-epoch logging."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Metric] = {}
+        self._scalars: Dict[str, List[Any]] = {}
+        self._step_values: Dict[str, Any] = {}
+        self.history: List[Dict[str, Any]] = []
+
+    def log(self, name: str, value: Any, *update_args: Any, on_step: bool = True, **update_kwargs: Any) -> Optional[Any]:
+        """Log a metric (with its update args) or a plain scalar under ``name``.
+
+        With a :class:`Metric`, calls ``value.forward(*update_args)`` —
+        accumulating state AND producing the batch-local value (recorded when
+        ``on_step``). Plain scalars are buffered and mean-reduced at epoch
+        end (Lightning's default scalar aggregation).
+        """
+        if isinstance(value, Metric):
+            if name in self._scalars:
+                raise ValueError(f"`{name}` is already logged as a scalar; pick a distinct name")
+            self._metrics[name] = value
+            if not on_step:
+                # no batch value needed: plain update skips forward's
+                # snapshot/compute machinery
+                value.update(*update_args, **update_kwargs)
+                return None
+            batch_value = value.forward(*update_args, **update_kwargs)
+            self._step_values[name] = batch_value
+            return batch_value
+        if update_args or update_kwargs:
+            raise ValueError("update args are only valid when logging a Metric")
+        if name in self._metrics:
+            raise ValueError(f"`{name}` is already logged as a Metric; pick a distinct name")
+        self._scalars.setdefault(name, []).append(value)
+        if on_step:
+            self._step_values[name] = value
+        return value
+
+    def step_values(self) -> Dict[str, Any]:
+        """Batch-local values of everything logged since the last call."""
+        out, self._step_values = self._step_values, {}
+        return out
+
+    def epoch_values(self, reset: bool = True) -> Dict[str, Any]:
+        """Epoch aggregates: ``compute()`` (with dist sync) for metrics, mean
+        for scalars. With ``reset`` (default), metrics are reset and scalar
+        buffers cleared — the trainer's end-of-epoch behavior — and the
+        values are appended to ``history``."""
+        out: Dict[str, Any] = {}
+        for name, metric in self._metrics.items():
+            if metric._effective_update_count():
+                out[name] = metric.compute()
+                if reset:
+                    metric.reset()
+        for name, vals in self._scalars.items():
+            if vals:
+                out[name] = sum(float(v) for v in vals) / len(vals)
+        if reset:
+            self._scalars = {k: [] for k in self._scalars}
+            # _step_values is left alone: step_values() drains itself, and a
+            # loop may flush the final batch's step values after epoch close
+            self.history.append(out)
+        return out

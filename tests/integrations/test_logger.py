@@ -1,0 +1,100 @@
+"""MetricLogger lifecycle (reference ``integrations/test_lightning.py``).
+
+The reference asserts Lightning's log/accumulate/reset semantics per epoch:
+on_step values are batch-local, on_epoch values aggregate the whole epoch,
+and epoch boundaries reset accumulation. Same contract here, without the
+trainer.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, MeanSquaredError
+from metrics_tpu.integrations import MetricLogger
+
+
+def test_logger_epoch_lifecycle():
+    rng = np.random.default_rng(0)
+    logger = MetricLogger()
+    acc = Accuracy()
+
+    for epoch in range(2):
+        epoch_preds, epoch_target = [], []
+        for _ in range(3):
+            p = rng.uniform(0, 1, 32)
+            t = rng.integers(0, 2, 32)
+            epoch_preds.append(p)
+            epoch_target.append(t)
+            logger.log("train/acc", acc, jnp.asarray(p), jnp.asarray(t))
+            logger.log("train/loss", float(p.mean()))
+            step = logger.step_values()
+            # on_step value is batch-local
+            np.testing.assert_allclose(
+                float(step["train/acc"]), ((p >= 0.5).astype(int) == t).mean(), atol=1e-6
+            )
+        vals = logger.epoch_values()
+        P, T = np.concatenate(epoch_preds), np.concatenate(epoch_target)
+        # on_epoch value aggregates exactly this epoch (reset isolates epochs)
+        np.testing.assert_allclose(float(vals["train/acc"]), ((P >= 0.5).astype(int) == T).mean(), atol=1e-6)
+        np.testing.assert_allclose(vals["train/loss"], np.mean([p.mean() for p in epoch_preds]), atol=1e-6)
+
+    assert len(logger.history) == 2
+    # reset cleared state: next epoch starts fresh
+    assert acc._update_count == 0
+
+
+def test_logger_multiple_metrics_and_no_update():
+    logger = MetricLogger()
+    mse = MeanSquaredError()
+    acc = Accuracy()
+    logger.log("mse", mse, jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))
+    # acc never updated: epoch_values must skip it, not warn/compute garbage
+    logger._metrics["acc"] = acc
+    vals = logger.epoch_values()
+    assert "acc" not in vals
+    assert float(vals["mse"]) == 0.0
+
+
+def test_logger_scalar_args_rejected():
+    logger = MetricLogger()
+    try:
+        logger.log("x", 1.0, jnp.asarray([1.0]))
+    except ValueError:
+        return
+    raise AssertionError("expected ValueError")
+
+
+def test_logger_name_collision_rejected():
+    import pytest
+
+    logger = MetricLogger()
+    logger.log("acc", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
+    with pytest.raises(ValueError, match="already logged as a Metric"):
+        logger.log("acc", 0.97)
+    logger.log("loss", 0.5)
+    with pytest.raises(ValueError, match="already logged as a scalar"):
+        logger.log("loss", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
+
+
+def test_logger_on_step_false_accumulates_without_step_value():
+    rng = np.random.default_rng(2)
+    logger = MetricLogger()
+    acc = Accuracy()
+    allp, allt = [], []
+    for _ in range(3):
+        p, t = rng.uniform(0, 1, 16), rng.integers(0, 2, 16)
+        allp.append(p), allt.append(t)
+        out = logger.log("val/acc", acc, jnp.asarray(p), jnp.asarray(t), on_step=False)
+        assert out is None
+        assert "val/acc" not in logger.step_values()
+    P, T = np.concatenate(allp), np.concatenate(allt)
+    vals = logger.epoch_values()
+    np.testing.assert_allclose(float(vals["val/acc"]), ((P >= 0.5).astype(int) == T).mean(), atol=1e-6)
+
+
+def test_logger_step_values_survive_epoch_close():
+    logger = MetricLogger()
+    acc = Accuracy()
+    logger.log("acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    logger.epoch_values()  # close epoch first ...
+    step = logger.step_values()  # ... final batch's step values still there
+    assert "acc" in step
