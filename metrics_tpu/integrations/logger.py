@@ -48,13 +48,21 @@ class MetricLogger:
         if isinstance(value, Metric):
             if name in self._scalars:
                 raise ValueError(f"`{name}` is already logged as a scalar; pick a distinct name")
-            self._metrics[name] = value
+            if self._metrics.get(name, value) is not value:
+                # a fresh Metric per step would silently report only the last
+                # batch as the epoch aggregate — construct it once outside
+                raise ValueError(
+                    f"`{name}` is already bound to a different Metric object;"
+                    " construct the metric once and log the same object every step"
+                )
             if not on_step:
                 # no batch value needed: plain update skips forward's
                 # snapshot/compute machinery
                 value.update(*update_args, **update_kwargs)
+                self._metrics[name] = value  # register only after success
                 return None
             batch_value = value.forward(*update_args, **update_kwargs)
+            self._metrics[name] = value
             self._step_values[name] = batch_value
             return batch_value
         if update_args or update_kwargs:

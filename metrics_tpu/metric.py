@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, Generator, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, apply_to_collection, dim_zero_cat
@@ -92,6 +93,19 @@ class Metric(ABC):
     ) -> None:
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {', '.join(sorted(kwargs))}")
+        # kwarg type validation, mirroring reference metric.py:125-143
+        if not isinstance(compute_on_cpu, bool):
+            raise ValueError(f"Expected keyword argument `compute_on_cpu` to be a `bool` but got {compute_on_cpu}")
+        if not isinstance(dist_sync_on_step, bool):
+            raise ValueError(f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {dist_sync_on_step}")
+        if not isinstance(sync_on_compute, bool):
+            raise ValueError(f"Expected keyword argument `sync_on_compute` to be a `bool` but got {sync_on_compute}")
+        if dist_sync_fn is not None and not callable(dist_sync_fn):
+            raise ValueError(f"Expected keyword argument `dist_sync_fn` to be a callable function but got {dist_sync_fn}")
+        if distributed_available_fn is not None and not callable(distributed_available_fn):
+            raise ValueError(
+                f"Expected keyword argument `distributed_available_fn` to be a callable function but got {distributed_available_fn}"
+            )
         self.compute_on_cpu = compute_on_cpu
         self.dist_sync_on_step = dist_sync_on_step
         self.process_group = process_group
@@ -143,11 +157,15 @@ class Metric(ABC):
                 raise ValueError("`default` CapacityBuffer state must be initially empty")
             if dist_reduce_fx not in ("cat", None):
                 raise ValueError("CapacityBuffer states require dist_reduce_fx='cat' or None")
-        elif not isinstance(default, list) and not isinstance(default, (jnp.ndarray, jax.Array)):
+        elif isinstance(default, (np.ndarray, np.generic)):
             default = jnp.asarray(default)
+        # python scalars/other types are rejected like the reference
+        # (metric.py:188-191)
+        if not isinstance(default, (list, jnp.ndarray, jax.Array, CapacityBuffer)):
+            raise ValueError("Invalid `default`: state must be a jax array or an empty list")
         if isinstance(default, list) and default:
             raise ValueError("`default` list state must be initially empty")
-        if isinstance(dist_reduce_fx, str) and dist_reduce_fx not in _VALID_REDUCTIONS:
+        if dist_reduce_fx is not None and not callable(dist_reduce_fx) and dist_reduce_fx not in _VALID_REDUCTIONS:
             raise ValueError(f"`dist_reduce_fx` must be callable or one of {_VALID_REDUCTIONS + (None,)}")
 
         self._defaults[name] = deepcopy(default)
@@ -580,7 +598,8 @@ class Metric(ABC):
         return CompositionalMetric(jnp.abs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.logical_not, self, None)
+        # bitwise (not logical) negation, matching reference metric.py:742-746
+        return CompositionalMetric(jnp.invert, self, None)
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
         return CompositionalMetric(lambda x: x[idx], self, None)

@@ -63,6 +63,75 @@ def test_add_state_registry():
         m.add_state("z", [1.0], dist_reduce_fx="cat")
 
 
+def test_error_on_wrong_input():
+    """Ctor kwarg type validation (reference ``test_metric.py:32-41``)."""
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_on_step` to be a `bool`"):
+        DummySum(dist_sync_on_step=None)
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_fn` to be a callable"):
+        DummySum(dist_sync_fn=[2, 3])
+    with pytest.raises(ValueError, match="Expected keyword argument `compute_on_cpu` to be a `bool`"):
+        DummySum(compute_on_cpu=None)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments"):
+        DummySum(bogus=1)
+
+
+def test_add_state_invalid_inputs():
+    """Invalid reduce fx / defaults raise (reference ``test_metric.py:62-72``)."""
+    m = DummySum()
+    with pytest.raises(ValueError):
+        m.add_state("d1", jnp.asarray(0), "xyz")
+    with pytest.raises(ValueError):
+        m.add_state("d2", jnp.asarray(0), 42)
+    with pytest.raises(ValueError):
+        m.add_state("d3", [jnp.asarray(0)], "sum")
+    with pytest.raises(ValueError):
+        m.add_state("d4", 42, "sum")
+    # numpy values coerce, custom callables accepted
+    m.add_state("ok_np", np.zeros(2), "sum")
+    m.add_state("ok_fx", jnp.asarray(0), lambda xs: -1)
+    assert m._reductions["ok_fx"](jnp.asarray([1, 1])) == -1
+
+
+def test_add_state_persistent():
+    m = DummySum()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in m.state_dict()
+    m.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in m.state_dict()
+
+
+def test_reset_clears_compute_cache():
+    """Reset must invalidate the cached compute value (reference
+    ``test_reset_compute``, ``test_metric.py:113-120``)."""
+    m = DummySum()
+    m.update(jnp.asarray(2.0))
+    assert float(m.compute()) == 2.0
+    m.reset()
+    assert m._computed is None
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 1.0
+
+
+def test_forward_cache_reset():
+    """Reset clears the forward cache (reference ``test_metric.py:316-324``)."""
+    m = DummySum()
+    m(jnp.asarray(5.0))
+    assert m._forward_cache is not None
+    m.reset()
+    assert m._forward_cache is None
+
+
+def test_constant_memory_sum_state():
+    """Sum-state shapes do not grow with updates (the reference checks GPU
+    memory, ``test_metric.py:374``; the XLA analogue is shape constancy)."""
+    m = DummyMeanPair()
+    m.update(jnp.ones(8))
+    shapes = {k: jnp.shape(getattr(m, k)) for k in m._defaults}
+    for _ in range(10):
+        m.update(jnp.ones(8))
+    assert shapes == {k: jnp.shape(getattr(m, k)) for k in m._defaults}
+
+
 def test_update_accumulates():
     m = DummySum()
     m.update(jnp.asarray([1.0, 2.0]))

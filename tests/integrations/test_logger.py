@@ -7,6 +7,7 @@ trainer.
 """
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from metrics_tpu import Accuracy, MeanSquaredError
 from metrics_tpu.integrations import MetricLogger
@@ -56,16 +57,26 @@ def test_logger_multiple_metrics_and_no_update():
 
 def test_logger_scalar_args_rejected():
     logger = MetricLogger()
-    try:
+    with pytest.raises(ValueError, match="only valid when logging a Metric"):
         logger.log("x", 1.0, jnp.asarray([1.0]))
-    except ValueError:
-        return
-    raise AssertionError("expected ValueError")
+
+
+def test_logger_rebind_rejected():
+    logger = MetricLogger()
+    logger.log("acc", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
+    with pytest.raises(ValueError, match="different Metric object"):
+        logger.log("acc", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
+
+
+def test_logger_failed_first_log_leaves_no_registration():
+    logger = MetricLogger()
+    with pytest.raises(Exception):
+        logger.log("acc", Accuracy(), jnp.asarray([[0.9]]), jnp.asarray([1, 0, 1]))
+    assert "acc" not in logger._metrics
+    logger.log("acc", 0.5)  # name is free for a scalar now
 
 
 def test_logger_name_collision_rejected():
-    import pytest
-
     logger = MetricLogger()
     logger.log("acc", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
     with pytest.raises(ValueError, match="already logged as a Metric"):
