@@ -48,12 +48,16 @@ class MetricLogger:
         if isinstance(value, Metric):
             if name in self._scalars:
                 raise ValueError(f"`{name}` is already logged as a scalar; pick a distinct name")
-            if self._metrics.get(name, value) is not value:
+            bound = self._metrics.get(name, value)
+            if bound is not value and bound._effective_update_count():
                 # a fresh Metric per step would silently report only the last
-                # batch as the epoch aggregate — construct it once outside
+                # batch as the epoch aggregate — construct it once outside.
+                # (Rebinding a fully-reset metric — e.g. one built per epoch —
+                # is harmless and stays allowed.)
                 raise ValueError(
-                    f"`{name}` is already bound to a different Metric object;"
-                    " construct the metric once and log the same object every step"
+                    f"`{name}` is already bound to a different Metric object with"
+                    " pending updates; construct the metric once and log the same"
+                    " object every step"
                 )
             if not on_step:
                 # no batch value needed: plain update skips forward's

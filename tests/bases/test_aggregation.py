@@ -65,3 +65,58 @@ def test_aggregation_forward():
     assert float(v) == pytest.approx(3.0)
     m(jnp.asarray([4.0]))
     assert float(m.compute()) == pytest.approx(7.0)
+
+
+@pytest.mark.parametrize(
+    "metric_cls, fn",
+    [
+        (MaxMetric, np.max),
+        (MinMetric, np.min),
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+        (CatMetric, lambda v: v.reshape(-1)),
+    ],
+)
+def test_aggregation_virtual_ddp(metric_cls, fn):
+    """Cross-rank sync parity (reference ``test_aggregation.py:83-100``):
+    two ranks accumulate disjoint shards; compute equals the oracle on all
+    data through the real ``_sync_dist`` gather/reduce path."""
+    from tests.helpers.testers import _wire_virtual_ddp
+
+    rng = np.random.default_rng(7)
+    values = rng.normal(size=(4, 16)).astype(np.float32)
+    ranks = [metric_cls() for _ in range(2)]
+    _wire_virtual_ddp(ranks)
+    for i, batch in enumerate(values):
+        ranks[i % 2].update(jnp.asarray(batch))
+    # gather order: rank 0's batches (0, 2) then rank 1's (1, 3)
+    gathered = values[[0, 2, 1, 3]]
+    np.testing.assert_allclose(np.asarray(ranks[0].compute()), fn(gathered), rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "weight",
+    [
+        pytest.param(jnp.asarray([1.0, 2.0, 3.0]), id="vector"),
+        pytest.param(2.5, id="scalar-broadcast"),
+        pytest.param(None, id="default-ones"),
+    ],
+)
+def test_mean_metric_weight_broadcasting(weight):
+    """Weight broadcast semantics (reference ``aggregation.py:328`` MeanMetric)."""
+    values = np.asarray([1.0, 2.0, 3.0], dtype=np.float32)
+    m = MeanMetric()
+    if weight is None:
+        m.update(jnp.asarray(values))
+        expected = values.mean()
+    else:
+        m.update(jnp.asarray(values), weight=weight)
+        w = np.broadcast_to(np.asarray(weight, dtype=np.float32), values.shape)
+        expected = (values * w).sum() / w.sum()
+    assert float(m.compute()) == pytest.approx(float(expected), rel=1e-5)
+
+
+def test_nan_strategy_impute_value():
+    m = MeanMetric(nan_strategy=10.0)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    assert float(m.compute()) == pytest.approx((1.0 + 10.0) / 2)

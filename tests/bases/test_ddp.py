@@ -129,3 +129,92 @@ def test_sync_context_roundtrip():
     with m.sync_context(distributed_available_fn=lambda: True):
         assert float(m.x) == 4.0
     assert float(m.x) == 2.0
+
+
+@pytest.mark.parametrize(
+    "rank_shapes",
+    [
+        pytest.param([(3,), (5,)], id="uneven-1d"),
+        pytest.param([(2, 4), (5, 4)], id="uneven-multidim"),
+        pytest.param([(4,), (4,)], id="even-fastpath"),
+    ],
+)
+def test_gather_all_tensors_uneven(monkeypatch, rank_shapes):
+    """Pad-to-max/trim gather parity (reference ``test_ddp.py:63-81``).
+
+    The multi-process backend is mocked: process_allgather stacks the
+    per-rank arrays exactly as the DCN collective would, so the pad/trim
+    logic in gather_all_tensors runs for real on uneven dim-0 shapes.
+    """
+    import metrics_tpu.utilities.distributed as dist_mod
+
+    rng = np.random.default_rng(0)
+    rank_arrays = [jnp.asarray(rng.normal(size=s).astype(np.float32)) for s in rank_shapes]
+    world = len(rank_arrays)
+
+    def fake_allgather(x):
+        # emulate the DCN collective: stack what each rank would contribute
+        vals = []
+        for r in range(world):
+            if x.ndim == 1 and x.dtype == jnp.int32:  # the shape gather
+                vals.append(jnp.asarray(rank_arrays[r].shape, dtype=jnp.int32))
+            else:  # the padded-data gather: pad rank r's array like the caller did
+                max_shape = np.max([a.shape for a in rank_arrays], axis=0)
+                pad = [(0, int(m - s)) for m, s in zip(max_shape, rank_arrays[r].shape)]
+                vals.append(jnp.pad(rank_arrays[r], pad))
+        return jnp.stack(vals)
+
+    class FakeMHU:
+        process_allgather = staticmethod(fake_allgather)
+
+    monkeypatch.setattr(jax, "process_count", lambda: world)
+    monkeypatch.setattr("jax.experimental.multihost_utils", FakeMHU)
+    out = dist_mod.gather_all_tensors(rank_arrays[0])
+    assert len(out) == world
+    for got, want in zip(out, rank_arrays):
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_compositional_metric_syncs_children():
+    """Compositional sync parity (reference ``test_ddp.py:84-103``): each
+    child syncs through its own dist_sync_fn when the composition computes."""
+    from tests.bases.test_metric import DummySum
+
+    a = DummySum(dist_sync_fn=lambda x, group=None: [x, x + 1])
+    b = DummySum(dist_sync_fn=lambda x, group=None: [x, x * 3])
+    a.distributed_available_fn = lambda: True
+    b.distributed_available_fn = lambda: True
+    a.update(jnp.asarray(3.0))
+    b.update(jnp.asarray(2.0))
+    comp = a + b
+    # children gather-reduce: a -> 3 + 4 = 7, b -> 2 + 6 = 8
+    assert float(comp.compute()) == 15.0
+    # children restored to local state after the synced compute
+    assert float(a.x) == 3.0 and float(b.x) == 2.0
+
+
+def test_state_dict_is_synced_inside_context():
+    """Saving inside sync_context captures the reduced state and restores
+    local accumulation afterwards (reference ``test_ddp.py:135-238``)."""
+    from tests.bases.test_metric import DummyCat, DummySum
+
+    m = DummySum(dist_sync_fn=lambda x, group=None: [x, x + 10.0])
+    m.persistent(True)  # as in the reference test (metric.persistent(True))
+    m.update(jnp.asarray(1.0))
+    with m.sync_context(distributed_available_fn=lambda: True):
+        synced_sd = m.state_dict()
+    local_sd = m.state_dict()
+    assert float(synced_sd["x"]) == 12.0
+    assert float(local_sd["x"]) == 1.0
+    # continuing accumulation after the sync context stays local
+    m.update(jnp.asarray(2.0))
+    assert float(m.x) == 3.0
+
+    c = DummyCat(dist_sync_fn=lambda x, group=None: [x, x * 2])
+    c.persistent(True)
+    c.update(jnp.asarray([1.0, 2.0]))
+    with c.sync_context(distributed_available_fn=lambda: True):
+        synced = np.concatenate([np.asarray(v) for v in c.state_dict()["x"]])
+    np.testing.assert_allclose(synced, [1.0, 2.0, 2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(jnp.concatenate(c.state_dict()["x"])), [1.0, 2.0])

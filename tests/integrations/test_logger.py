@@ -68,6 +68,16 @@ def test_logger_rebind_rejected():
         logger.log("acc", Accuracy(), jnp.asarray([0.9]), jnp.asarray([1]))
 
 
+def test_logger_rebind_after_epoch_reset_allowed():
+    """A metric constructed per epoch is fine: the old one was reset."""
+    logger = MetricLogger()
+    for _ in range(2):
+        acc = Accuracy()
+        logger.log("acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        vals = logger.epoch_values()
+        assert float(vals["acc"]) == 1.0
+
+
 def test_logger_failed_first_log_leaves_no_registration():
     logger = MetricLogger()
     with pytest.raises(Exception):
