@@ -12,6 +12,11 @@ the contract each hook must satisfy:
 
 Run: ``python examples/bert_score-own_model.py``
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo-root run without install
+
 from pprint import pprint
 from typing import Dict, List
 

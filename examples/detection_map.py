@@ -6,6 +6,11 @@ printing the full COCO summary dict.
 
 Run: ``python examples/detection_map.py``
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo-root run without install
+
 from pprint import pprint
 
 import jax.numpy as jnp

@@ -8,6 +8,11 @@ e.g. for languages or domains where the defaults are wrong.
 
 Run: ``python examples/rouge_score-own_normalizer_and_tokenizer.py``
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo-root run without install
+
 import re
 from pprint import pprint
 from typing import Sequence

@@ -11,6 +11,11 @@ values (step logging), ``collection.compute()`` the epoch aggregate, and
 
 Run: ``python examples/train_loop_metrics.py``
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo-root run without install
+
 import jax
 import jax.numpy as jnp
 import numpy as np

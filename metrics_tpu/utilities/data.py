@@ -63,6 +63,10 @@ def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     """
     if num_classes is None:
         num_classes = int(label_tensor.max()) + 1
+    if not jnp.issubdtype(label_tensor.dtype, jnp.integer):
+        # bool / float labels are valid in the reference (tensor.scatter on
+        # a long cast); one_hot requires an integer index tensor
+        label_tensor = label_tensor.astype(jnp.int32)
     onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
     # Move the new class axis to dim 1: (N, ..., C) -> (N, C, ...)
     return jnp.moveaxis(onehot, -1, 1)

@@ -152,11 +152,16 @@ def test_gather_all_tensors_uneven(monkeypatch, rank_shapes):
     rank_arrays = [jnp.asarray(rng.normal(size=s).astype(np.float32)) for s in rank_shapes]
     world = len(rank_arrays)
 
+    calls = {"n": 0}
+
     def fake_allgather(x):
-        # emulate the DCN collective: stack what each rank would contribute
+        # emulate the DCN collective: stack what each rank would contribute.
+        # gather_all_tensors gathers shapes first, then (if uneven) padded
+        # data — dispatch on call order, not on dtype heuristics
+        calls["n"] += 1
         vals = []
         for r in range(world):
-            if x.ndim == 1 and x.dtype == jnp.int32:  # the shape gather
+            if calls["n"] == 1:  # the shape gather
                 vals.append(jnp.asarray(rank_arrays[r].shape, dtype=jnp.int32))
             else:  # the padded-data gather: pad rank r's array like the caller did
                 max_shape = np.max([a.shape for a in rank_arrays], axis=0)

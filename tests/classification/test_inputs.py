@@ -1,0 +1,284 @@
+"""Input-format gate tests (reference ``tests/classification/test_inputs.py``).
+
+``_input_format_classification`` is the single entry for every
+classification metric; these tests pin its full contract: case resolution,
+the normalized output tensors for every usual and special input case, the
+threshold boundary, and every rejected input combination.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import (
+    Input,
+    _binary_inputs as _bin,
+    _binary_prob_inputs as _bin_prob,
+    _multiclass_inputs as _mc,
+    _multiclass_prob_inputs as _mc_prob,
+    _multidim_multiclass_inputs as _mdmc,
+    _multidim_multiclass_prob_inputs as _mdmc_prob,
+    _multilabel_inputs as _ml,
+    _multilabel_prob_inputs as _ml_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+
+_rng = np.random.default_rng(42)
+
+
+def _rand(*shape):
+    return jnp.asarray(_rng.random(shape, dtype=np.float32))
+
+
+def _randint(low, high, shape):
+    return jnp.asarray(_rng.integers(low, high, shape))
+
+
+# additional inputs, mirroring the reference's extras
+_ml_prob_half = Input(_ml_prob.preds.astype(jnp.float16), _ml_prob.target)
+
+_p = _rng.random((NUM_BATCHES, BATCH_SIZE, 2), dtype=np.float32)
+_mc_prob_2cls = Input(jnp.asarray(_p / _p.sum(2, keepdims=True)), _randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+
+_p = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM), dtype=np.float32)
+_mdmc_prob_many_dims = Input(
+    jnp.asarray(_p / _p.sum(2, keepdims=True)),
+    _randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+_p = _rng.random((NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM), dtype=np.float32)
+_mdmc_prob_2cls = Input(jnp.asarray(_p / _p.sum(2, keepdims=True)), _randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+_mlmd = Input(
+    _randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    _randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+_mlmd_prob = Input(
+    _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM),
+    _randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+
+
+# transform helpers (reference test_inputs.py:60-121)
+def _idn(x):
+    return x
+
+
+def _usq(x):
+    return jnp.expand_dims(x, -1)
+
+
+def _thrs(x):
+    return x >= THRESHOLD
+
+
+def _rshp1(x):
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(x, NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(x, 2)
+
+
+def _top1(x):
+    return select_topk(x, 1)
+
+
+def _top2(x):
+    return select_topk(x, 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (reference test_inputs.py:129-156)
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # special cases (reference test_inputs.py:147-168)
+        # half precision is upcast before thresholding
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(x.astype(jnp.float32)), _rshp1),
+        # binary as multiclass
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        # binary probs as multiclass
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        # multilabel as multiclass
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        # multilabel probs as multiclass
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        # multidim multilabel as multiclass
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        # multidim multilabel probs as multiclass
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        # multiclass probs with 2 classes as binary
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        # multidim multiclass probs with 2 classes as multilabel
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    def run(preds_in, target_in):
+        preds_out, target_out, mode = _input_format_classification(
+            preds=preds_in,
+            target=target_in,
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )
+        assert mode == exp_mode
+        assert mode == DataType(exp_mode)
+        np.testing.assert_array_equal(
+            np.asarray(preds_out), np.asarray(post_preds(preds_in)).astype(np.int32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(target_out), np.asarray(post_target(target_in)).astype(np.int32)
+        )
+
+    run(inputs.preds[0], inputs.target[0])
+    # batch_size = 1 keeps the batch dim
+    run(inputs.preds[0][[0], ...], inputs.target[0][[0], ...])
+
+
+def test_threshold():
+    target = jnp.asarray([1, 1, 1], dtype=jnp.int32)
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_out).squeeze(), [0, 1, 1])
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        # target not integer
+        (_randint(0, 2, (7,)), _randint(0, 2, (7,)).astype(jnp.float32), None, None),
+        # target negative
+        (_randint(0, 2, (7,)), -_randint(1, 2, (7,)), None, None),
+        # preds negative integers
+        (-_randint(1, 2, (7,)), _randint(0, 2, (7,)), None, None),
+        # multiclass=False and target > 1
+        (_rand(7), _randint(2, 4, (7,)), None, False),
+        # multiclass=False and integer preds > 1
+        (_randint(2, 4, (7,)), _randint(0, 2, (7,)), None, False),
+        # wrong batch size
+        (_randint(0, 2, (8,)), _randint(0, 2, (7,)), None, None),
+        # completely wrong shape
+        (_randint(0, 2, (7,)), _randint(0, 2, (7, 4)), None, None),
+        # same #dims, different shape
+        (_randint(0, 2, (7, 3)), _randint(0, 2, (7, 4)), None, None),
+        # same shape, float preds, target not binary
+        (_rand(7, 3), _randint(2, 4, (7, 3)), None, None),
+        # #dims preds = 1 + #dims target, C not in position 1
+        (_rand(7, 3, 4, 3), _randint(0, 4, (7, 3, 3)), None, None),
+        # #dims preds = 1 + #dims target, preds not float
+        (_randint(0, 2, (7, 3, 3, 4)), _randint(0, 4, (7, 3, 3)), None, None),
+        # multiclass=False with C dimension > 2
+        (_mc_prob.preds[0], _randint(0, 2, (BATCH_SIZE,)), None, False),
+        # max target >= C dimension
+        (_mc_prob.preds[0], _randint(NUM_CLASSES + 1, 100, (BATCH_SIZE,)), None, None),
+        # C dimension != num_classes
+        (_mc_prob.preds[0], _mc_prob.target[0], NUM_CLASSES + 1, None),
+        # max target > num_classes (#dims preds = #dims target)
+        (_randint(0, 4, (7, 3)), _randint(5, 7, (7, 3)), 4, None),
+        # num_classes=1 without multiclass=False
+        (_randint(0, 2, (7,)), _randint(0, 2, (7,)), 1, None),
+        # multiclass=False but implied classes != num_classes
+        (_randint(0, 2, (7, 3, 3)), _randint(0, 2, (7, 3, 3)), 4, False),
+        # multilabel with implied classes != num_classes
+        (_rand(7, 3, 3), _randint(0, 2, (7, 3, 3)), 4, False),
+        # multilabel with multiclass=True but num_classes != 2
+        (_rand(7, 3), _randint(0, 2, (7, 3)), 4, True),
+        # binary with num_classes > 2
+        (_rand(7), _randint(0, 2, (7,)), 4, None),
+        # binary with num_classes == 2 and multiclass not True
+        (_rand(7), _randint(0, 2, (7,)), 2, None),
+        (_rand(7), _randint(0, 2, (7,)), 2, False),
+        # binary with num_classes == 1 and multiclass=True
+        (_rand(7), _randint(0, 2, (7,)), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds, target=target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        # top_k with non-prob or binary data
+        (_bin.preds[0], _bin.target[0], None, None, 2),
+        (_bin_prob.preds[0], _bin_prob.target[0], None, None, 2),
+        (_mc.preds[0], _mc.target[0], None, None, 2),
+        (_ml.preds[0], _ml.target[0], None, None, 2),
+        (_mlmd.preds[0], _mlmd.target[0], None, None, 2),
+        (_mdmc.preds[0], _mdmc.target[0], None, None, 2),
+        # top_k = 0 / float
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0),
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0.123),
+        # top_k with multiclass=False
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, False, 2),
+        # top_k >= C
+        (_mc_prob.preds[0], _mc_prob.target[0], None, None, NUM_CLASSES),
+        # multiclass=True multilabel probs with top_k
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, 2),
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds, target=target, threshold=THRESHOLD,
+            num_classes=num_classes, multiclass=multiclass, top_k=top_k,
+        )
