@@ -10,7 +10,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
 
 Array = jax.Array
 
@@ -18,11 +18,8 @@ Array = jax.Array
 def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Host-side: corpus -> (total char edit operations, total reference chars)."""
     preds, target = _normalize_corpus(preds, target)
-    errors = 0
-    total = 0
-    for pred, tgt in zip(preds, target):
-        errors += _edit_distance(list(pred), list(tgt))
-        total += len(tgt)
+    errors = sum(_edit_distance_corpus([list(p) for p in preds], [list(t) for t in target]))
+    total = sum(len(t) for t in target)
     return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
 
 

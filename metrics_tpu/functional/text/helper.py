@@ -27,18 +27,9 @@ def _encode_tokens(*token_lists: Sequence[str]) -> Tuple[np.ndarray, ...]:
     )
 
 
-def _edit_distance(prediction_tokens: List[str], reference_tokens: List[str]) -> int:
-    """Word/char-level Levenshtein distance (unit costs).
-
-    Behavioral equivalent of reference ``functional/text/helper.py:333-355``.
-    """
-    n_pred, n_ref = len(prediction_tokens), len(reference_tokens)
-    if n_ref == 0:
-        return n_pred
-    if n_pred == 0:
-        return n_ref
-    pred, ref = _encode_tokens(prediction_tokens, reference_tokens)
-
+def _edit_distance_numpy(pred: np.ndarray, ref: np.ndarray) -> int:
+    """Vectorized-row DP fallback over integer-encoded sequences."""
+    n_pred, n_ref = len(pred), len(ref)
     idx = np.arange(n_ref + 1)
     prev = idx.copy()  # dist(0, j) = j
     for i in range(1, n_pred + 1):
@@ -48,6 +39,50 @@ def _edit_distance(prediction_tokens: List[str], reference_tokens: List[str]) ->
         full = np.concatenate(([i], cand))  # dist(i, 0) = i seeds the prefix min
         prev = np.minimum.accumulate(full - idx) + idx
     return int(prev[-1])
+
+
+def _edit_distance(prediction_tokens: List[str], reference_tokens: List[str]) -> int:
+    """Word/char-level Levenshtein distance (unit costs).
+
+    Behavioral equivalent of reference ``functional/text/helper.py:333-355``.
+    Dispatches to the native C kernel (``metrics_tpu/native``) when it is
+    available; the numpy row DP is the fallback.
+    """
+    n_pred, n_ref = len(prediction_tokens), len(reference_tokens)
+    if n_ref == 0:
+        return n_pred
+    if n_pred == 0:
+        return n_ref
+    pred, ref = _encode_tokens(prediction_tokens, reference_tokens)
+
+    from metrics_tpu import native
+
+    out = native.edit_distance(pred, ref)
+    if out is not None:
+        return out
+    return _edit_distance_numpy(pred, ref)
+
+
+def _edit_distance_corpus(
+    preds_tokens: List[List[str]], refs_tokens: List[List[str]]
+) -> List[int]:
+    """Per-pair Levenshtein over a whole corpus — ONE native call.
+
+    The WER-family updates call this instead of ``_edit_distance`` per pair:
+    the C batch kernel amortizes the FFI crossing and the encoding pass over
+    the full batch.
+    """
+    encoded = []
+    for p, r in zip(preds_tokens, refs_tokens):
+        encoded.append(_encode_tokens(p, r))
+    from metrics_tpu import native
+
+    out = native.edit_distance_batch([e[0] for e in encoded], [e[1] for e in encoded])
+    if out is not None:
+        return [int(x) for x in out]
+    # _edit_distance_numpy handles empty sequences (the DP degenerates to
+    # the remaining length), so no special-casing is needed here
+    return [_edit_distance_numpy(p, r) for p, r in encoded]
 
 
 def _normalize_corpus(

@@ -9,7 +9,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
 
 Array = jax.Array
 
@@ -17,13 +17,10 @@ Array = jax.Array
 def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Host-side: corpus -> (total edit operations, total max-length words)."""
     preds, target = _normalize_corpus(preds, target)
-    errors = 0
-    total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += max(len(tgt_tokens), len(pred_tokens))
+    preds_tok = [p.split() for p in preds]
+    tgt_tok = [t.split() for t in target]
+    errors = sum(_edit_distance_corpus(preds_tok, tgt_tok))
+    total = sum(max(len(t), len(p)) for p, t in zip(preds_tok, tgt_tok))
     return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
 
 

@@ -17,7 +17,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
 
 Array = jax.Array
 
@@ -27,16 +27,12 @@ def _word_info_update(
 ) -> Tuple[Array, Array, Array]:
     """Host-side: corpus -> (hits, total target words, total pred words)."""
     preds, target = _normalize_corpus(preds, target)
-    hits = 0
-    target_total = 0
-    preds_total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors = _edit_distance(pred_tokens, tgt_tokens)
-        target_total += len(tgt_tokens)
-        preds_total += len(pred_tokens)
-        hits += max(len(tgt_tokens), len(pred_tokens)) - errors
+    preds_tok = [p.split() for p in preds]
+    tgt_tok = [t.split() for t in target]
+    dists = _edit_distance_corpus(preds_tok, tgt_tok)
+    target_total = sum(len(t) for t in tgt_tok)
+    preds_total = sum(len(p) for p in preds_tok)
+    hits = sum(max(len(t), len(p)) - d for p, t, d in zip(preds_tok, tgt_tok, dists))
     return (
         jnp.asarray(hits, dtype=jnp.float32),
         jnp.asarray(target_total, dtype=jnp.float32),

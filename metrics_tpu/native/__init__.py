@@ -1,0 +1,146 @@
+"""Native (C) runtime kernels with transparent Python fallbacks.
+
+The compute path of this framework is JAX/XLA; the runtime *around* it —
+here, the host-side string kernels of the text domain — is native where it
+pays. The C sources ship with the package and are compiled lazily on first
+use (cc -O2 -shared), cached next to the source; if no compiler is
+available the callers fall back to their numpy implementations, so the
+package never hard-depends on a toolchain.
+
+Set ``METRICS_TPU_NO_NATIVE=1`` to force the Python fallbacks.
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _cache_dirs():
+    """Candidate output dirs: package dir, then a per-user cache.
+
+    Never a world-writable shared dir — a predictable .so name in /tmp could
+    be pre-planted by another local user and dlopened into this process.
+    """
+    yield _HERE
+    xdg = os.environ.get("XDG_CACHE_HOME")
+    home_cache = Path(xdg) if xdg else Path.home() / ".cache"
+    yield home_cache / "metrics_tpu"
+
+
+def _safe_to_load(path: Path) -> bool:
+    """Only load libraries this user owns (best effort on non-POSIX)."""
+    try:
+        st = path.stat()
+        return st.st_uid == os.getuid()
+    except (OSError, AttributeError):
+        return True
+
+
+def _compile(src: Path) -> Optional[Path]:
+    """cc -O2 -shared -fPIC src -> content-addressed .so, atomically."""
+    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    name = f"{src.stem}-{tag}.so"
+    for out_dir in _cache_dirs():
+        so = out_dir / name
+        if so.exists() and _safe_to_load(so):
+            return so
+        try:
+            out_dir.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            continue
+        for cc in ("cc", "gcc", "clang"):
+            # build under a unique temp name, then rename into place so a
+            # concurrent importer never dlopens a half-written file
+            try:
+                fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out_dir))
+            except OSError:
+                break  # dir not writable: try the next cache dir
+            os.close(fd)
+            try:
+                res = subprocess.run(
+                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp, str(src)],
+                    capture_output=True,
+                    timeout=120,
+                )
+                if res.returncode == 0:
+                    os.replace(tmp, so)
+                    return so
+            except (FileNotFoundError, subprocess.TimeoutExpired):
+                pass
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        # compiler exists but this dir may be read-only: try the next dir
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("METRICS_TPU_NO_NATIVE"):
+        return None
+    so = _compile(_HERE / "levenshtein.c")
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+        lib.mtpu_edit_distance.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64]
+        lib.mtpu_edit_distance.restype = ctypes.c_int64
+        lib.mtpu_edit_distance_batch.argtypes = [i64p, i64p, i64p, i64p, ctypes.c_int64, i64p]
+        lib.mtpu_edit_distance_batch.restype = None
+    except (OSError, AttributeError):
+        # unreadable or stale library (missing symbol): fall back to numpy
+        return None
+    _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def edit_distance(a: np.ndarray, b: np.ndarray) -> Optional[int]:
+    """Native unit-cost Levenshtein; None when no native library."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.int64)
+    b = np.ascontiguousarray(b, dtype=np.int64)
+    out = int(lib.mtpu_edit_distance(a, len(a), b, len(b)))
+    return None if out < 0 else out
+
+
+def edit_distance_batch(seqs_a: List[np.ndarray], seqs_b: List[np.ndarray]) -> Optional[np.ndarray]:
+    """Batched native Levenshtein over a corpus; None when no native library.
+
+    One FFI crossing for the whole batch: sequences are flattened CSR-style.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(seqs_a)
+    off_a = np.zeros(n + 1, dtype=np.int64)
+    off_b = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(s) for s in seqs_a], out=off_a[1:])
+    np.cumsum([len(s) for s in seqs_b], out=off_b[1:])
+    flat_a = np.concatenate(seqs_a) if n else np.zeros(0, dtype=np.int64)
+    flat_b = np.concatenate(seqs_b) if n else np.zeros(0, dtype=np.int64)
+    flat_a = np.ascontiguousarray(flat_a, dtype=np.int64)
+    flat_b = np.ascontiguousarray(flat_b, dtype=np.int64)
+    out = np.empty(n, dtype=np.int64)
+    lib.mtpu_edit_distance_batch(flat_a, off_a, flat_b, off_b, n, out)
+    if (out < 0).any():  # allocation failure inside the kernel
+        return None
+    return out

@@ -1,0 +1,44 @@
+/* Unit-cost Levenshtein distance over integer-encoded token sequences.
+ *
+ * Native counterpart of the numpy row-DP in functional/text/helper.py
+ * (reference algorithm: torchmetrics functional/text/helper.py:333-355).
+ * One rolling row, O(min-row) memory, branch-light inner loop. The batch
+ * entry point amortizes the FFI crossing over a whole corpus: sequences are
+ * passed flattened with an offsets array (CSR-style), one call per update.
+ */
+#include <stdint.h>
+#include <stdlib.h>
+
+int64_t mtpu_edit_distance(const int64_t *a, int64_t n,
+                           const int64_t *b, int64_t m) {
+    if (m == 0) return n;
+    if (n == 0) return m;
+    int64_t *row = (int64_t *)malloc((size_t)(m + 1) * sizeof(int64_t));
+    if (!row) return -1;
+    for (int64_t j = 0; j <= m; j++) row[j] = j;
+    for (int64_t i = 1; i <= n; i++) {
+        int64_t diag = row[0];
+        int64_t ai = a[i - 1];
+        row[0] = i;
+        for (int64_t j = 1; j <= m; j++) {
+            int64_t sub = diag + (ai != b[j - 1]);
+            int64_t del = row[j] + 1;
+            int64_t ins = row[j - 1] + 1;
+            diag = row[j];
+            int64_t best = sub < del ? sub : del;
+            row[j] = best < ins ? best : ins;
+        }
+    }
+    int64_t out = row[m];
+    free(row);
+    return out;
+}
+
+void mtpu_edit_distance_batch(const int64_t *flat_a, const int64_t *off_a,
+                              const int64_t *flat_b, const int64_t *off_b,
+                              int64_t n_pairs, int64_t *out) {
+    for (int64_t p = 0; p < n_pairs; p++) {
+        out[p] = mtpu_edit_distance(flat_a + off_a[p], off_a[p + 1] - off_a[p],
+                                    flat_b + off_b[p], off_b[p + 1] - off_b[p]);
+    }
+}

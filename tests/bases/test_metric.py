@@ -121,6 +121,23 @@ def test_forward_cache_reset():
     assert m._forward_cache is None
 
 
+def test_compute_on_cpu_offloads_list_states():
+    """List states move to host after each update; compute still correct
+    (reference ``metric.py:125,313-323``)."""
+    import jax
+
+    m = DummyCat(compute_on_cpu=True)
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    cpu = jax.devices("cpu")[0]
+    assert all(chunk.device == cpu for chunk in m.x)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+    # sum states are untouched by the offload
+    s = DummySum(compute_on_cpu=True)
+    s.update(jnp.asarray(2.0))
+    assert float(s.compute()) == 2.0
+
+
 def test_constant_memory_sum_state():
     """Sum-state shapes do not grow with updates (the reference checks GPU
     memory, ``test_metric.py:374``; the XLA analogue is shape constancy)."""

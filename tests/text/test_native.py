@@ -1,0 +1,67 @@
+"""Native Levenshtein kernel: parity with the numpy fallback and dispatch.
+
+The C kernel (``metrics_tpu/native/levenshtein.c``) and the numpy row DP
+(``functional/text/helper.py``) must agree exactly on random corpora, the
+batch entry must equal per-pair calls, and the WER family must produce
+identical values whichever backend is active.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import native
+from metrics_tpu.functional.text.helper import (
+    _edit_distance,
+    _edit_distance_corpus,
+    _edit_distance_numpy,
+)
+
+_rng = np.random.default_rng(11)
+
+
+def _rand_tokens(n, vocab=20):
+    return [f"w{i}" for i in _rng.integers(0, vocab, n)]
+
+
+@pytest.mark.skipif(not native.native_available(), reason="no C toolchain")
+@pytest.mark.parametrize("trial", range(20))
+def test_native_matches_numpy(trial):
+    a = _rand_tokens(int(_rng.integers(0, 40)))
+    b = _rand_tokens(int(_rng.integers(0, 40)))
+    got = _edit_distance(a, b)  # dispatches native
+    vocab = {}
+    enc = lambda ts: np.asarray([vocab.setdefault(t, len(vocab)) for t in ts], dtype=np.int64)
+    ea, eb = enc(a), enc(b)
+    if len(a) and len(b):
+        assert got == _edit_distance_numpy(ea, eb)
+    else:
+        assert got == max(len(a), len(b))
+
+
+@pytest.mark.skipif(not native.native_available(), reason="no C toolchain")
+def test_batch_equals_singles():
+    pairs = [(_rand_tokens(int(_rng.integers(0, 30))), _rand_tokens(int(_rng.integers(0, 30)))) for _ in range(32)]
+    batch = _edit_distance_corpus([p for p, _ in pairs], [r for _, r in pairs])
+    singles = [_edit_distance(p, r) for p, r in pairs]
+    assert batch == singles
+
+
+def test_corpus_fallback_matches(monkeypatch):
+    """With the native library forced off, the corpus path uses numpy and
+    agrees with the per-pair computation."""
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    pairs = [(_rand_tokens(10), _rand_tokens(12)), ([], _rand_tokens(3)), (_rand_tokens(4), [])]
+    batch = _edit_distance_corpus([p for p, _ in pairs], [r for _, r in pairs])
+    assert batch == [_edit_distance(p, r) for p, r in pairs]
+
+
+def test_wer_same_value_both_backends(monkeypatch):
+    from metrics_tpu.functional import word_error_rate
+
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    with_native = float(word_error_rate(preds, target))
+    monkeypatch.setattr(native, "_LIB", None)
+    monkeypatch.setattr(native, "_TRIED", True)
+    without = float(word_error_rate(preds, target))
+    assert with_native == without == 0.5
