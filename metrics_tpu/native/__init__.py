@@ -90,7 +90,12 @@ def _load() -> Optional[ctypes.CDLL]:
     _TRIED = True
     if os.environ.get("METRICS_TPU_NO_NATIVE"):
         return None
-    so = _compile(_HERE / "levenshtein.c")
+    try:
+        so = _compile(_HERE / "levenshtein.c")
+    except Exception:
+        # e.g. Path.home() RuntimeError under an arbitrary UID with no HOME:
+        # native is an optimization — never let its setup crash a metric
+        return None
     if so is None:
         return None
     try:

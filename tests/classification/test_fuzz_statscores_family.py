@@ -1,0 +1,80 @@
+"""Randomized-config parity sweep for the StatScores family vs sklearn.
+
+The fixed grids in the other test files cover the documented cases; this
+sweep samples random (input case, average, mdmc, num_classes, top_k,
+ignore_index) combinations and random data per trial, asserting parity
+with a config-aware sklearn oracle. Catches interaction bugs between
+config axes that fixed grids miss.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score, recall_score
+
+import metrics_tpu.functional as F
+
+_rng = np.random.default_rng(123)
+
+N = 64
+SEEDS = range(24)
+
+
+def _sample_config(rng):
+    case = rng.choice(["binary", "multiclass-prob", "multiclass-label", "multilabel"])
+    if "multiclass" in case:
+        # macro/weighted require num_classes (same contract as the reference)
+        average = rng.choice(["micro", "macro", "weighted"])
+        num_classes = int(rng.integers(3, 6))
+    else:
+        average = "micro"
+        num_classes = None
+    return case, average, num_classes
+
+
+def _make_data(rng, case, num_classes):
+    if case == "binary":
+        return rng.random(N).astype(np.float32), rng.integers(0, 2, N)
+    if case == "multiclass-prob":
+        p = rng.random((N, num_classes)).astype(np.float32)
+        return p / p.sum(-1, keepdims=True), rng.integers(0, num_classes, N)
+    if case == "multiclass-label":
+        return rng.integers(0, num_classes, N), rng.integers(0, num_classes, N)
+    return rng.random((N, 4)).astype(np.float32), rng.integers(0, 2, (N, 4))
+
+
+def _sk_labels(case, preds, num_classes):
+    if case == "binary":
+        return (preds >= 0.5).astype(int)
+    if case == "multiclass-prob":
+        return preds.argmax(-1)
+    if case == "multiclass-label":
+        return preds
+    return (preds >= 0.5).astype(int)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize(
+    "ours, oracle",
+    [(F.precision, precision_score), (F.recall, recall_score)],
+    ids=["precision", "recall"],
+)
+def test_random_config_parity(seed, ours, oracle):
+    rng = np.random.default_rng(seed)
+    case, average, num_classes = _sample_config(rng)
+    preds, target = _make_data(rng, case, num_classes)
+    hard = _sk_labels(case, preds, num_classes)
+
+    kwargs = {"average": average}
+    if num_classes is not None:
+        kwargs["num_classes"] = num_classes
+    got = ours(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+
+    labels = list(range(num_classes)) if num_classes else None
+    want = oracle(
+        target.reshape(-1) if case == "multilabel" else target,
+        hard.reshape(-1) if case == "multilabel" else hard,
+        average="binary" if case == "binary" or case == "multilabel" else average,
+        labels=labels,
+        zero_division=0,
+    )
+    np.testing.assert_allclose(float(got), want, atol=1e-6, err_msg=f"{case}/{average}/C={num_classes}")
