@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.utilities.imports import _TRANSFORMERS_AVAILABLE
-from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.prints import rank_zero_info, rank_zero_warn
 
 Array = jax.Array
 
@@ -72,7 +72,9 @@ def _bert_score_kernel(
     """Greedy cosine matching -> per-sentence (precision, recall, f1).
 
     Shapes: ``*_emb (B, S, D)``, ``*_mask/(idf) (B, S)``. Embeddings at
-    masked positions are zeroed so they never win a max.
+    masked positions are zeroed so they never win a max. (The ``all_layers``
+    path loops this kernel per layer — one layer's ``(B, S, S)`` similarity
+    on device at a time, never an ``L``-fold blowup.)
     """
     preds_mask = _process_attention_mask_for_special_tokens(preds_mask)
     target_mask = _process_attention_mask_for_special_tokens(target_mask)
@@ -95,9 +97,15 @@ def _bert_score_kernel(
     return precision, recall, f1
 
 
-def _default_forward(model: Any, input_ids: Array, attention_mask: Array, num_layers: Optional[int]) -> Array:
-    """Forward through a transformers Flax model, picking one hidden layer."""
+def _default_forward(
+    model: Any, input_ids: Array, attention_mask: Array, num_layers: Optional[int], all_layers: bool = False
+) -> Array:
+    """Forward through a transformers Flax model, picking hidden layer(s)."""
     out = model(input_ids=input_ids, attention_mask=attention_mask, output_hidden_states=True)
+    if all_layers:
+        # every hidden state incl. the embedding layer, on a layer axis
+        # (reference functional/text/bert.py:304-305)
+        return jnp.stack([jnp.asarray(h) for h in out.hidden_states], axis=1)
     return jnp.asarray(out.hidden_states[num_layers if num_layers is not None else -1])
 
 
@@ -108,10 +116,17 @@ def _get_embeddings(
     batch_size: int,
     num_layers: Optional[int],
     user_forward_fn: Optional[Callable],
+    all_layers: bool = False,
+    verbose: bool = False,
 ) -> Array:
     """Host batching loop around the (jitted) encoder forward."""
+    if all_layers and user_forward_fn is not None:
+        raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
     chunks = []
-    for start in range(0, len(input_ids), batch_size):
+    n_batches = -(-len(input_ids) // batch_size) if len(input_ids) else 0
+    for bi, start in enumerate(range(0, len(input_ids), batch_size)):
+        if verbose:
+            rank_zero_info(f"bert_score embeddings: batch {bi + 1}/{n_batches}")
         ids = jnp.asarray(input_ids[start : start + batch_size])
         mask = jnp.asarray(attention_mask[start : start + batch_size])
         if user_forward_fn is not None:
@@ -122,9 +137,14 @@ def _get_embeddings(
                     f"i.e. [{ids.shape[0]}, {ids.shape[1]}, model_dim], but got {out.shape}."
                 )
         else:
-            out = _default_forward(model, ids, mask, num_layers)
-        chunks.append(out)
-    return jnp.concatenate(chunks) if chunks else jnp.zeros((0, 0, 0))
+            out = _default_forward(model, ids, mask, num_layers, all_layers)
+        # all_layers: stash each (b, L, S, D) chunk in HOST memory — the
+        # reference does the same (embeddings_list.append(out.cpu()),
+        # bert.py:312) — so device memory never holds the L-fold corpus
+        chunks.append(np.asarray(out) if all_layers else out)
+    if not chunks:
+        return jnp.zeros((0, 0, 0))
+    return np.concatenate(chunks) if all_layers else jnp.concatenate(chunks)
 
 
 def _load_tokenizer_and_model(model_name_or_path: str) -> Tuple[Any, Any]:
@@ -155,9 +175,25 @@ def _read_csv_baseline(baseline_path: str) -> Array:
 
 
 def _rescale_with_baseline(
-    precision: Array, recall: Array, f1: Array, baseline: Array, num_layers: Optional[int]
+    precision: Array, recall: Array, f1: Array, baseline: Array, num_layers: Optional[int], all_layers: bool = False
 ) -> Tuple[Array, Array, Array]:
-    """(x - b) / (1 - b) per metric, using the requested layer's baseline row."""
+    """(x - b) / (1 - b) per metric, using the requested layer's baseline row.
+
+    With ``all_layers`` the scores carry a leading layer axis and each layer
+    rescales against its own baseline row (reference ``bert.py:425-431``).
+    """
+    if all_layers:
+        n_layers = precision.shape[0]
+        if baseline.shape[0] < n_layers:
+            raise ValueError(
+                f"The baseline csv has {baseline.shape[0]} rows but the model produced "
+                f"{n_layers} hidden layers; an `all_layers` rescale needs one row per layer."
+            )
+        rows = baseline[:n_layers]  # (L, 3)
+        p = (precision - rows[:, 0:1]) / (1 - rows[:, 0:1])
+        r = (recall - rows[:, 1:2]) / (1 - rows[:, 1:2])
+        f = (f1 - rows[:, 2:3]) / (1 - rows[:, 2:3])
+        return p, r, f
     scale = baseline[num_layers if num_layers is not None else -1]
     stack = jnp.stack([precision, recall, f1], axis=-1)
     stack = (stack - scale) / (1 - scale)
@@ -172,20 +208,31 @@ def bert_score(
     model: Optional[Any] = None,
     user_tokenizer: Any = None,
     user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
     idf: bool = False,
+    device: Optional[Any] = None,
     max_length: int = 512,
     batch_size: int = 64,
+    num_threads: int = 4,
     return_hash: bool = False,
     lang: str = "en",
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+    all_layers: bool = False,
 ) -> Dict[str, Union[List[float], str]]:
     """BERTScore: greedy contextual-embedding matching by cosine similarity.
 
     ``preds``/``target`` are raw sentences (tokenized here) or pre-tokenized
     ``{"input_ids", "attention_mask"}`` dicts. Returns per-sentence
-    precision/recall/f1 lists (API parity with the reference).
+    precision/recall/f1 lists (API parity with the reference); with
+    ``all_layers`` each entry is the per-layer list of scores.
+
+    ``device`` and ``num_threads`` are accepted for API parity and ignored:
+    JAX owns device placement, and there is no dataloader thread pool.
     """
+    if device is not None:
+        rank_zero_warn("`device` is ignored: JAX places the encoder on the default device.")
     if model is None and model_name_or_path is None:
         rank_zero_warn(
             f"The argument `model_name_or_path` was not specified while it is required when the default "
@@ -225,39 +272,56 @@ def bert_score(
         target_idf = np.ones_like(target_tok["input_ids"], dtype=np.float32)
 
     preds_emb = _get_embeddings(
-        preds_tok["input_ids"], preds_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn
+        preds_tok["input_ids"], preds_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn,
+        all_layers=all_layers, verbose=verbose,
     )
     target_emb = _get_embeddings(
-        target_tok["input_ids"], target_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn
+        target_tok["input_ids"], target_tok["attention_mask"], model, batch_size, num_layers, user_forward_fn,
+        all_layers=all_layers, verbose=verbose,
     )
 
-    precision, recall, f1 = _bert_score_kernel(
-        preds_emb,
+    kernel_args = (
         jnp.asarray(preds_tok["attention_mask"], dtype=jnp.float32),
         jnp.asarray(preds_idf),
-        target_emb,
         jnp.asarray(target_tok["attention_mask"], dtype=jnp.float32),
         jnp.asarray(target_idf),
-        idf=idf,
     )
+    if all_layers:
+        # one layer on device at a time; outputs (L, B) like the reference's
+        # transpose (functional/text/bert.py:330)
+        per_layer = [
+            _bert_score_kernel(
+                jnp.asarray(preds_emb[:, l]), kernel_args[0], kernel_args[1],
+                jnp.asarray(target_emb[:, l]), kernel_args[2], kernel_args[3], idf=idf,
+            )
+            for l in range(preds_emb.shape[1])
+        ]
+        precision = jnp.stack([p for p, _, _ in per_layer])
+        recall = jnp.stack([r for _, r, _ in per_layer])
+        f1 = jnp.stack([f for _, _, f in per_layer])
+    else:
+        precision, recall, f1 = _bert_score_kernel(
+            preds_emb, kernel_args[0], kernel_args[1], target_emb, kernel_args[2], kernel_args[3], idf=idf
+        )
 
     if rescale_with_baseline:
         if baseline_path is None:
             # The reference resolves a baseline from (lang, model_name_or_path)
-            # by downloading it; this build is offline-only, so an explicit
-            # local csv is required for rescaling to take effect.
+            # or `baseline_url` by downloading it; this build is offline-only,
+            # so an explicit local csv is required for rescaling to take effect.
             rank_zero_warn(
                 f"`rescale_with_baseline` requires a local `baseline_path` (remote baseline lookup by "
-                f"lang={lang!r}/model is not supported); returning unrescaled scores."
+                f"lang={lang!r}/model{'/baseline_url' if baseline_url else ''} is not supported); "
+                "returning unrescaled scores."
             )
         else:
             baseline = _read_csv_baseline(baseline_path)
-            precision, recall, f1 = _rescale_with_baseline(precision, recall, f1, baseline, num_layers)
+            precision, recall, f1 = _rescale_with_baseline(precision, recall, f1, baseline, num_layers, all_layers)
 
     output: Dict[str, Union[List[float], str]] = {
-        "precision": [float(x) for x in precision],
-        "recall": [float(x) for x in recall],
-        "f1": [float(x) for x in f1],
+        "precision": np.asarray(precision).tolist(),
+        "recall": np.asarray(recall).tolist(),
+        "f1": np.asarray(f1).tolist(),
     }
     if return_hash:
         output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"

@@ -48,13 +48,18 @@ class BERTScore(Metric):
         model: Optional[Any] = None,
         user_tokenizer: Any = None,
         user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
         idf: bool = False,
+        device: Optional[Any] = None,
         max_length: int = 512,
         batch_size: int = 64,
+        num_threads: int = 4,
         return_hash: bool = False,
         lang: str = "en",
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        all_layers: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -73,13 +78,18 @@ class BERTScore(Metric):
         self.num_layers = num_layers
         self.user_tokenizer = user_tokenizer
         self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
         self.idf = idf
+        self.device = device  # accepted for API parity; JAX owns placement
+        self.num_threads = num_threads  # idem: no dataloader thread pool
         self.max_length = max_length
         self.batch_size = batch_size
         self.return_hash = return_hash
         self.lang = lang
         self.rescale_with_baseline = rescale_with_baseline
         self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
+        self.all_layers = all_layers
 
         self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
@@ -110,11 +120,15 @@ class BERTScore(Metric):
             num_layers=self.num_layers,
             model=self.model,
             user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
             idf=self.idf,
+            device=self.device,
             max_length=self.max_length,
             batch_size=self.batch_size,
             return_hash=self.return_hash,
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
+            all_layers=self.all_layers,
         )

@@ -169,3 +169,57 @@ def test_mismatched_corpus_sizes():
             user_forward_fn=_forward,
             max_length=MAX_LENGTH,
         )
+
+
+class _ToyHFOutput:
+    def __init__(self, hidden_states):
+        self.hidden_states = hidden_states
+
+
+class _ToyHFModel:
+    """Transformers-like callable: returns all hidden states."""
+
+    def __init__(self, tables):
+        self.tables = tables  # one embedding table per layer
+
+    def __call__(self, input_ids, attention_mask, output_hidden_states=True):
+        ids = np.asarray(input_ids)
+        return _ToyHFOutput(tuple(jnp.asarray(t[ids]) for t in self.tables))
+
+
+def test_all_layers_per_layer_scores():
+    """all_layers returns (num_layers, N) scores; each layer matches a
+    single-layer run with num_layers=i (reference bert.py all_layers)."""
+    tables = [
+        _rng.normal(size=(64, DIM)).astype(np.float32),
+        _rng.normal(size=(64, DIM)).astype(np.float32),
+    ]
+    model = _ToyHFModel(tables)
+    preds = ["hello there", "general kenobi you are bold"]
+    target = ["hello here", "general kenobi you are"]
+    p_tok = _tokenize(preds, MAX_LENGTH)
+    t_tok = _tokenize(target, MAX_LENGTH)
+
+    out_all = bert_score(p_tok, t_tok, model=model, user_tokenizer=object(), all_layers=True)
+    assert np.asarray(out_all["f1"]).shape == (2, len(preds))
+    for layer in range(2):
+        out_one = bert_score(p_tok, t_tok, model=model, user_tokenizer=object(), num_layers=layer)
+        np.testing.assert_allclose(np.asarray(out_all["f1"])[layer], out_one["f1"], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out_all["precision"])[layer], out_one["precision"], rtol=1e-5)
+
+
+def test_all_layers_rejected_with_user_forward_fn():
+    p_tok = _tokenize(["a b"], MAX_LENGTH)
+    with pytest.raises(ValueError, match="all_layers"):
+        bert_score(
+            p_tok, p_tok, model=_EMBED_TABLE, user_tokenizer=object(),
+            user_forward_fn=_forward, all_layers=True,
+        )
+
+
+def test_device_kwarg_warns_and_is_ignored():
+    p_tok = _tokenize(["a b"], MAX_LENGTH)
+    with pytest.warns(UserWarning, match="device"):
+        out = bert_score(p_tok, p_tok, model=_EMBED_TABLE, user_tokenizer=object(),
+                         user_forward_fn=_forward, device="cuda:0")
+    np.testing.assert_allclose(out["f1"], [1.0], atol=1e-5)
