@@ -116,6 +116,7 @@ def fbeta_score(
 def f1_score(
     preds: Array,
     target: Array,
+    beta: float = 1.0,
     average: str = "micro",
     mdmc_average: Optional[str] = None,
     ignore_index: Optional[int] = None,
@@ -124,7 +125,8 @@ def f1_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1 (reference :225).
+    """F1 = F-beta with beta=1 (reference :225; the overridable ``beta``
+    default mirrors the reference signature at ``f_beta.py:247-250``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -134,4 +136,4 @@ def f1_score(
         >>> f1_score(preds, target, num_classes=3)
         Array(0.33333334, dtype=float32)
     """
-    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+    return fbeta_score(preds, target, beta, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

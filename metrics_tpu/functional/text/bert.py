@@ -280,19 +280,17 @@ def bert_score(
         all_layers=all_layers, verbose=verbose,
     )
 
-    kernel_args = (
-        jnp.asarray(preds_tok["attention_mask"], dtype=jnp.float32),
-        jnp.asarray(preds_idf),
-        jnp.asarray(target_tok["attention_mask"], dtype=jnp.float32),
-        jnp.asarray(target_idf),
-    )
+    preds_mask_j = jnp.asarray(preds_tok["attention_mask"], dtype=jnp.float32)
+    preds_idf_j = jnp.asarray(preds_idf)
+    target_mask_j = jnp.asarray(target_tok["attention_mask"], dtype=jnp.float32)
+    target_idf_j = jnp.asarray(target_idf)
     if all_layers:
         # one layer on device at a time; outputs (L, B) like the reference's
         # transpose (functional/text/bert.py:330)
         per_layer = [
             _bert_score_kernel(
-                jnp.asarray(preds_emb[:, l]), kernel_args[0], kernel_args[1],
-                jnp.asarray(target_emb[:, l]), kernel_args[2], kernel_args[3], idf=idf,
+                jnp.asarray(preds_emb[:, l]), preds_mask_j, preds_idf_j,
+                jnp.asarray(target_emb[:, l]), target_mask_j, target_idf_j, idf=idf,
             )
             for l in range(preds_emb.shape[1])
         ]
@@ -301,7 +299,7 @@ def bert_score(
         f1 = jnp.stack([f for _, _, f in per_layer])
     else:
         precision, recall, f1 = _bert_score_kernel(
-            preds_emb, kernel_args[0], kernel_args[1], target_emb, kernel_args[2], kernel_args[3], idf=idf
+            preds_emb, preds_mask_j, preds_idf_j, target_emb, target_mask_j, target_idf_j, idf=idf
         )
 
     if rescale_with_baseline:

@@ -30,11 +30,21 @@ class BERTScore(Metric):
         user_tokenizer: callable ``(List[str], max_length) -> {"input_ids",
             "attention_mask"}`` of numpy/jnp arrays, padded to max_length.
         user_forward_fn: callable ``(model, batch_dict) -> (B, S, D)`` jnp array.
+        verbose: log a progress line per embedding batch.
         idf: weight token matches by inverse document frequency.
+        device: accepted for reference API parity and ignored — JAX places
+            the encoder on the default device.
         max_length: static pad length for the token buffers.
         batch_size: encoder forward batch size inside ``compute``.
+        num_threads: accepted for reference API parity and ignored — there
+            is no dataloader thread pool here.
         rescale_with_baseline: rescale with a precomputed baseline csv.
         baseline_path: local path of the baseline csv.
+        baseline_url: accepted for API parity; remote baselines are not
+            fetched — pass ``baseline_path`` instead.
+        all_layers: score every hidden layer (incl. the embedding layer);
+            results gain a leading ``num_layers`` axis. Only valid with
+            default ``transformers`` models.
     """
 
     is_differentiable = False
@@ -125,6 +135,7 @@ class BERTScore(Metric):
             device=self.device,
             max_length=self.max_length,
             batch_size=self.batch_size,
+            num_threads=self.num_threads,
             return_hash=self.return_hash,
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
