@@ -125,8 +125,11 @@ def f1_score(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1 (reference :225; the overridable ``beta``
-    default mirrors the reference signature at ``f_beta.py:247-250``).
+    """F1 = F-beta with beta=1 (reference :225).
+
+    ``beta`` is accepted and IGNORED, exactly like the reference
+    (``f_beta.py:250`` documents "It is ignored" and ``:354`` hardcodes
+    1.0) — use :func:`fbeta_score` for a real beta.
 
     Example:
         >>> import jax.numpy as jnp
@@ -136,4 +139,4 @@ def f1_score(
         >>> f1_score(preds, target, num_classes=3)
         Array(0.33333334, dtype=float32)
     """
-    return fbeta_score(preds, target, beta, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

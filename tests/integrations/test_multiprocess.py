@@ -51,6 +51,13 @@ _WORKER = textwrap.dedent(
     auroc.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
     from sklearn.metrics import roc_auc_score
     np.testing.assert_allclose(float(auroc.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+    # dist_sync_on_step: the step value returned by forward must be the
+    # GLOBAL batch value (sync happens inside forward, both ranks in the
+    # collective simultaneously)
+    acc_step = Accuracy(dist_sync_on_step=True)
+    step_val = acc_step.forward(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    np.testing.assert_allclose(float(step_val), ref, atol=1e-6)
     print(f"rank {{rank}} OK", flush=True)
     """
 )
