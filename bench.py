@@ -22,7 +22,7 @@ N_SAMPLES = 1_000_000
 N_BATCHES = 16
 N_CLASSES = 10
 BATCH = N_SAMPLES // N_BATCHES
-K_REPEATS = 50
+K_REPEATS = 200  # ~20 ms device time per trial (K x ~0.1 ms/epoch): swamps tunnel jitter
 
 
 def bench_tpu() -> float:
@@ -60,28 +60,11 @@ def bench_tpu() -> float:
     target = jax.random.randint(jax.random.PRNGKey(1), (N_BATCHES, BATCH), 0, N_CLASSES)
     preds.block_until_ready()
 
-    float(run(preds, target))  # warmup + compile (float() forces full sync)
-    times = []
-    # the tunneled-TPU dispatch jitter spans an order of magnitude between
-    # runs; a dozen trials (~100 ms each) makes the min stable
-    for _ in range(12):
-        t0 = time.perf_counter()
-        float(run(preds, target))
-        times.append(time.perf_counter() - t0)
-    # subtract the measured null-dispatch round-trip (dominant on tunneled
-    # TPU setups) so the number reflects device throughput
-    null = jax.jit(lambda x: x + 1.0)
-    float(null(jnp.zeros(())))
-    null_times = []
-    for _ in range(12):
-        t0 = time.perf_counter()
-        float(null(jnp.zeros(())))
-        null_times.append(time.perf_counter() - t0)
-    rtt = min(null_times)
-    best = min(times)
-    if rtt >= best:  # dispatch overhead unmeasurable against this run: don't subtract
-        rtt = 0.0
-    return (best - rtt) / K_REPEATS * 1000.0  # ms per 1M-sample epoch
+    # shared harness: min over 12 trials, null-dispatch RTT subtracted —
+    # the same jitter defense every benchmarks/bench_*.py uses
+    from benchmarks._timing import measure_ms
+
+    return measure_ms(lambda: run(preds, target), K_REPEATS)  # ms per 1M-sample epoch
 
 
 def bench_torch_eager() -> float:

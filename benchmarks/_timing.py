@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 
-def measure_ms(run: Callable[[], jax.Array], k_repeats: int, n_timing: int = 5) -> float:
+def measure_ms(run: Callable[[], jax.Array], k_repeats: int, n_timing: int = 12) -> float:
     """Wall-clock ms per repeat for ``run`` (a jitted thunk doing K repeats)."""
     float(run())  # warmup + compile
     times = []
