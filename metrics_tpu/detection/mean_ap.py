@@ -231,16 +231,26 @@ class MeanAveragePrecision(Metric):
         g_counts = [b.shape[0] for b in g_boxes]
         img_ids = np.arange(start, start + len(preds), dtype=np.int32)
 
-        boxes = jnp.asarray(_cat(d_boxes, (0, 4), np.float32))
+        # ONE batched host->device transfer for all seven state chunks — a
+        # put per array would pay one tunnel round trip each
+        boxes, scores, labels, det_idx, gboxes, glabels, gt_idx = jax.device_put(
+            (
+                _cat(d_boxes, (0, 4), np.float32),
+                _cat((p["scores"] for p in preds), (0,), np.float32),
+                _cat((p["labels"] for p in preds), (0,), np.int64).astype(np.int32),
+                np.repeat(img_ids, d_counts),
+                _cat(g_boxes, (0, 4), np.float32),
+                _cat((t["labels"] for t in target), (0,), np.int64).astype(np.int32),
+                np.repeat(img_ids, g_counts),
+            )
+        )
         self.det_boxes.append(box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy"))
-        self.det_scores.append(jnp.asarray(_cat((p["scores"] for p in preds), (0,), np.float32)))
-        self.det_labels.append(jnp.asarray(_cat((p["labels"] for p in preds), (0,), np.int64).astype(np.int32)))
-        self.det_img_idx.append(jnp.asarray(np.repeat(img_ids, d_counts)))
-
-        gboxes = jnp.asarray(_cat(g_boxes, (0, 4), np.float32))
+        self.det_scores.append(scores)
+        self.det_labels.append(labels)
+        self.det_img_idx.append(det_idx)
         self.gt_boxes.append(box_convert(gboxes, in_fmt=self.box_format, out_fmt="xyxy"))
-        self.gt_labels.append(jnp.asarray(_cat((t["labels"] for t in target), (0,), np.int64).astype(np.int32)))
-        self.gt_img_idx.append(jnp.asarray(np.repeat(img_ids, g_counts)))
+        self.gt_labels.append(glabels)
+        self.gt_img_idx.append(gt_idx)
         self.n_images = self.n_images + len(preds)
 
     def _sync_dist(self, dist_sync_fn=gather_all_tensors, process_group=None) -> None:
