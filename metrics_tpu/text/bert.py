@@ -111,10 +111,16 @@ class BERTScore(Metric):
         own_tokenizer = self.user_tokenizer is not None
         preds_tok = _tokenize(self.tokenizer, list(preds), self.max_length, own_tokenizer)
         target_tok = _tokenize(self.tokenizer, list(target), self.max_length, own_tokenizer)
-        self.preds_input_ids.append(jnp.asarray(preds_tok["input_ids"]))
-        self.preds_attention_mask.append(jnp.asarray(preds_tok["attention_mask"]))
-        self.target_input_ids.append(jnp.asarray(target_tok["input_ids"]))
-        self.target_attention_mask.append(jnp.asarray(target_tok["attention_mask"]))
+        # one batched transfer for all four state chunks (a put per array
+        # costs a dispatch round trip each on tunneled TPUs)
+        p_ids, p_mask, t_ids, t_mask = jax.device_put(
+            (preds_tok["input_ids"], preds_tok["attention_mask"],
+             target_tok["input_ids"], target_tok["attention_mask"])
+        )
+        self.preds_input_ids.append(p_ids)
+        self.preds_attention_mask.append(p_mask)
+        self.target_input_ids.append(t_ids)
+        self.target_attention_mask.append(t_mask)
 
     def compute(self) -> Dict[str, Union[List[float], str]]:
         return bert_score(
