@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.functional.text.helper import _put_all
+
 Array = jax.Array
 
 
@@ -66,11 +68,11 @@ def _bleu_score_update(
         for ngram, count in pred_counter.items():
             denominator[len(ngram) - 1] += count
 
-    return (
-        jnp.asarray(numerator, dtype=jnp.float32),
-        jnp.asarray(denominator, dtype=jnp.float32),
-        jnp.asarray(preds_len, dtype=jnp.float32),
-        jnp.asarray(target_len, dtype=jnp.float32),
+    return _put_all(
+        np.asarray(numerator, dtype=np.float32),
+        np.asarray(denominator, dtype=np.float32),
+        np.float32(preds_len),
+        np.float32(target_len),
     )
 
 

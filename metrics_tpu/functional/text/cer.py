@@ -8,9 +8,8 @@ reference's ``list(pred)`` tokenization (``cer.py:43-47``).
 from typing import List, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -20,7 +19,7 @@ def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
     preds, target = _normalize_corpus(preds, target)
     errors = sum(_edit_distance_corpus([list(p) for p in preds], [list(t) for t in target]))
     total = sum(len(t) for t in target)
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return _put_scalars(errors, total)
 
 
 def _cer_compute(errors: Array, total: Array) -> Array:

@@ -14,7 +14,20 @@ iterations, a large constant-factor win on long transcripts.
 """
 from typing import List, Sequence, Tuple, Union
 
+import jax
 import numpy as np
+
+
+def _put_all(*values) -> Tuple[jax.Array, ...]:
+    """Ship host values (numpy arrays/scalars, dtypes preserved) as ONE
+    device transfer — a put per value pays a dispatch round trip each on
+    tunneled TPUs."""
+    return tuple(jax.device_put(tuple(values)))
+
+
+def _put_scalars(*values) -> Tuple[jax.Array, ...]:
+    """`_put_all` with everything cast to f32 scalars."""
+    return _put_all(*(np.float32(v) for v in values))
 
 
 def _encode_tokens(*token_lists: Sequence[str]) -> Tuple[np.ndarray, ...]:

@@ -7,9 +7,8 @@ Denominator is ``max(len(target), len(pred))`` per sample.
 from typing import List, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -21,7 +20,7 @@ def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
     tgt_tok = [t.split() for t in target]
     errors = sum(_edit_distance_corpus(preds_tok, tgt_tok))
     total = sum(max(len(t), len(p)) for p, t in zip(preds_tok, tgt_tok))
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return _put_scalars(errors, total)
 
 
 def _mer_compute(errors: Array, total: Array) -> Array:

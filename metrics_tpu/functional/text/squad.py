@@ -12,7 +12,9 @@ from collections import Counter
 from typing import Any, Dict, List, Tuple, Union
 
 import jax
-import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.helper import _put_all
 
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -109,11 +111,7 @@ def _squad_update(
                 pred = preds[qa["id"]]
                 exact_match += max(_exact_match_score(pred, t) for t in ground_truths)
                 f1 += max(_f1_score(pred, t) for t in ground_truths)
-    return (
-        jnp.asarray(f1, dtype=jnp.float32),
-        jnp.asarray(exact_match, dtype=jnp.float32),
-        jnp.asarray(total, dtype=jnp.int32),
-    )
+    return _put_all(np.float32(f1), np.float32(exact_match), np.int32(total))
 
 
 def _squad_compute(f1_score: Array, exact_match: Array, total: Array) -> Dict[str, Array]:

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.functional.text.helper import _encode_tokens, _validate_inputs
+from metrics_tpu.functional.text.helper import _encode_tokens, _validate_inputs, _put_scalars, _put_all
 
 Array = jax.Array
 
@@ -305,6 +305,7 @@ def _ter_update(
     preds, target = _validate_inputs(preds, target)
     total_num_edits = 0.0
     total_tgt_length = 0.0
+    host_sentence_scores: List[float] = []
     for pred, tgt in zip(preds, target):
         tgt_words_ = [tokenizer(_t.rstrip()).split() for _t in tgt]
         pred_words_ = tokenizer(pred.rstrip()).split()
@@ -312,10 +313,11 @@ def _ter_update(
         total_num_edits += num_edits
         total_tgt_length += tgt_length
         if sentence_ter is not None:
-            sentence_ter.append(
-                jnp.asarray([_score_from_statistics(num_edits, tgt_length)], dtype=jnp.float32)
-            )
-    return jnp.asarray(total_num_edits, dtype=jnp.float32), jnp.asarray(total_tgt_length, dtype=jnp.float32)
+            host_sentence_scores.append(_score_from_statistics(num_edits, tgt_length))
+    if sentence_ter is not None and host_sentence_scores:
+        # one batched transfer for all sentence scores, not one per sentence
+        sentence_ter.extend(_put_all(*(np.asarray([s], dtype=np.float32) for s in host_sentence_scores)))
+    return _put_scalars(total_num_edits, total_tgt_length)
 
 
 def _score_from_statistics(num_edits: float, tgt_length: float) -> float:

@@ -9,9 +9,8 @@ stateful class accumulates and psum-syncs them like any other sum state.
 from typing import List, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -23,7 +22,7 @@ def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
     tgt_tok = [t.split() for t in target]
     errors = sum(_edit_distance_corpus(preds_tok, tgt_tok))
     total = sum(len(t) for t in tgt_tok)
-    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+    return _put_scalars(errors, total)
 
 
 def _wer_compute(errors: Array, total: Array) -> Array:

@@ -15,9 +15,8 @@ which is the same value with a sum-reducible, sign-honest state.
 from typing import List, Tuple, Union
 
 import jax
-import jax.numpy as jnp
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus
+from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -33,11 +32,7 @@ def _word_info_update(
     target_total = sum(len(t) for t in tgt_tok)
     preds_total = sum(len(p) for p in preds_tok)
     hits = sum(max(len(t), len(p)) - d for p, t, d in zip(preds_tok, tgt_tok, dists))
-    return (
-        jnp.asarray(hits, dtype=jnp.float32),
-        jnp.asarray(target_total, dtype=jnp.float32),
-        jnp.asarray(preds_total, dtype=jnp.float32),
-    )
+    return _put_scalars(hits, target_total, preds_total)
 
 
 def _wil_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:

@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.functional.text.helper import _put_all
 from metrics_tpu.functional.text.bert import _DEFAULT_MODEL, _load_tokenizer_and_model, _tokenize, bert_score
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
@@ -111,11 +112,9 @@ class BERTScore(Metric):
         own_tokenizer = self.user_tokenizer is not None
         preds_tok = _tokenize(self.tokenizer, list(preds), self.max_length, own_tokenizer)
         target_tok = _tokenize(self.tokenizer, list(target), self.max_length, own_tokenizer)
-        # one batched transfer for all four state chunks (a put per array
-        # costs a dispatch round trip each on tunneled TPUs)
-        p_ids, p_mask, t_ids, t_mask = jax.device_put(
-            (preds_tok["input_ids"], preds_tok["attention_mask"],
-             target_tok["input_ids"], target_tok["attention_mask"])
+        p_ids, p_mask, t_ids, t_mask = _put_all(
+            preds_tok["input_ids"], preds_tok["attention_mask"],
+            target_tok["input_ids"], target_tok["attention_mask"],
         )
         self.preds_input_ids.append(p_ids)
         self.preds_attention_mask.append(p_mask)
