@@ -15,5 +15,12 @@ def upcast_half_precision(preds: Array, target: Array) -> tuple:
     """
     if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
         preds = preds.astype(jnp.float32)
-    target = target.astype(preds.dtype)
-    return preds, target
+    if jnp.issubdtype(target.dtype, jnp.floating) and jnp.finfo(target.dtype).bits < 32:
+        target = target.astype(jnp.float32)
+    # unify on the promoted dtype; f64 targets stay f64 rather than being
+    # silently truncated, and integer inputs are lifted to f32 so the energy
+    # math (and downstream finfo()) is well-defined
+    common = jnp.promote_types(preds.dtype, target.dtype)
+    if not jnp.issubdtype(common, jnp.floating):
+        common = jnp.float32
+    return preds.astype(common), target.astype(common)

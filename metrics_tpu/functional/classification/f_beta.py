@@ -56,11 +56,11 @@ def _fbeta_compute(
 
     if ignore_index is not None:
         if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES) and mdmc_average == MDMCAverageMethod.SAMPLEWISE:
-            num = num.at[..., ignore_index].set(-1.0)
-            denom = denom.at[..., ignore_index].set(-1.0)
+            num = num.at[..., ignore_index].set(jnp.asarray(-1, num.dtype))
+            denom = denom.at[..., ignore_index].set(jnp.asarray(-1, denom.dtype))
         elif average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
-            num = num.at[ignore_index, ...].set(-1.0)
-            denom = denom.at[ignore_index, ...].set(-1.0)
+            num = num.at[ignore_index, ...].set(jnp.asarray(-1, num.dtype))
+            denom = denom.at[ignore_index, ...].set(jnp.asarray(-1, denom.dtype))
 
     if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
         absent = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)

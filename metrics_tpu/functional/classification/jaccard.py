@@ -24,7 +24,10 @@ def _jaccard_from_confmat(
 ) -> Array:
     """Per-class intersection-over-union from a confusion matrix (reference :25)."""
     if ignore_index is not None and 0 <= ignore_index < num_classes:
-        confmat = confmat.at[ignore_index].set(0.0)
+        # scatter value must match the confmat dtype (int counts unless the
+        # caller normalized) — a float literal here becomes a hard error on
+        # future JAX under standard dtype promotion
+        confmat = confmat.at[ignore_index].set(jnp.zeros((), dtype=confmat.dtype))
 
     intersection = jnp.diag(confmat)
     union = confmat.sum(axis=0) + confmat.sum(axis=1) - intersection

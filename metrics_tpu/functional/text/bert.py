@@ -184,12 +184,14 @@ def _rescale_with_baseline(
     """
     if all_layers:
         n_layers = precision.shape[0]
-        if baseline.shape[0] < n_layers:
+        if baseline.shape[0] != n_layers:
+            # a row-count mismatch in either direction means the csv belongs
+            # to a different model — rescaling against it is silently wrong
             raise ValueError(
                 f"The baseline csv has {baseline.shape[0]} rows but the model produced "
-                f"{n_layers} hidden layers; an `all_layers` rescale needs one row per layer."
+                f"{n_layers} hidden layers; an `all_layers` rescale needs exactly one row per layer."
             )
-        rows = baseline[:n_layers]  # (L, 3)
+        rows = baseline  # (L, 3)
         p = (precision - rows[:, 0:1]) / (1 - rows[:, 0:1])
         r = (recall - rows[:, 1:2]) / (1 - rows[:, 1:2])
         f = (f1 - rows[:, 2:3]) / (1 - rows[:, 2:3])
@@ -245,6 +247,20 @@ def bert_score(
         tokenizer = user_tokenizer
         if tokenizer is None and not isinstance(preds, dict):
             raise ValueError("A `user_tokenizer` must be provided with a user `model` and raw-text inputs.")
+
+    # empty corpus: nothing to tokenize or embed (HF fast tokenizers raise on
+    # an empty batch, and the all_layers stack would trip on a 0-width axis);
+    # the count check must come first so a one-sided empty input gets the
+    # real error, not an opaque tokenizer crash
+    n_preds = len(preds["input_ids"]) if isinstance(preds, dict) else len(preds)
+    n_target = len(target["input_ids"]) if isinstance(target, dict) else len(target)
+    if n_preds != n_target:
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+    if n_preds == 0 and n_target == 0:
+        output: Dict[str, Union[List[float], str]] = {"precision": [], "recall": [], "f1": []}
+        if return_hash:
+            output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+        return output
 
     own_tokenizer = user_tokenizer is not None
     if isinstance(preds, dict):

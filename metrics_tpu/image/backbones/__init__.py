@@ -1,0 +1,17 @@
+"""In-repo Flax feature-extractor backbones for model-in-the-metric metrics.
+
+The reference ships frozen torch backbones (``torchmetrics/image/fid.py:40-57``
+``NoTrainInceptionV3`` via torch-fidelity; ``torchmetrics/image/lpip.py:33-42``
+``NoTrainLpips`` via the ``lpips`` package). Here the equivalents are Flax
+``linen`` modules compiled by XLA for the TPU MXU: NHWC layout internally,
+conv+batchnorm+relu fused by XLA, optional bfloat16 compute.
+
+Pretrained weight *files* cannot be downloaded in this environment, so every
+backbone constructs with random initialization (architecture and shapes are
+exact) and documents a ``weights_path=`` hook that loads a locally converted
+checkpoint (``.npz`` flat dict or flax ``.msgpack``).
+"""
+from metrics_tpu.image.backbones.inception import FIDInceptionV3, NoTrainInceptionV3
+from metrics_tpu.image.backbones.lpips_nets import LPIPSNetwork, NoTrainLpips
+
+__all__ = ["FIDInceptionV3", "NoTrainInceptionV3", "LPIPSNetwork", "NoTrainLpips"]

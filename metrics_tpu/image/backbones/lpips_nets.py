@@ -1,0 +1,190 @@
+"""Flax LPIPS perceptual-similarity networks (VGG16 / AlexNet / SqueezeNet).
+
+Behavioral equivalent of the reference's ``NoTrainLpips``
+(``torchmetrics/image/lpip.py:33-42``), which wraps the ``lpips`` package:
+an ImageNet feature stack sliced at the canonical relu taps, unit-normalized
+per channel, squared-differenced, passed through per-layer 1x1 linear heads,
+and spatially averaged (Zhang et al. 2018).
+
+TPU-first: NHWC layout, the full two-tower forward + heads in one jitted XLA
+program, optional bfloat16 conv compute. Weights are random-initialized by
+default (pretrained checkpoints cannot be downloaded here; exact
+architecture + documented warning); ``weights_path=`` loads a locally
+converted ``.npz``/``.msgpack`` checkpoint in the same format as
+``inception.save_variables_npz``.
+"""
+import functools
+from typing import Any, List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.image.backbones.inception import _fast_init_variables, _load_variables
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+# ImageNet scaling layer constants (lpips.LPIPS.ScalingLayer).
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+_N_CHANNELS = {
+    "vgg": (64, 128, 256, 512, 512),
+    "alex": (64, 192, 384, 256, 256),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
+
+
+def _conv(features: int, kernel: int, stride: int = 1, pad: int = None, name: str = None) -> nn.Conv:
+    if pad is None:
+        pad = kernel // 2
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride), padding=((pad, pad), (pad, pad)), name=name)
+
+
+def _max_pool(x: Array, kernel: int = 2, stride: int = 2) -> Array:
+    return nn.max_pool(x, (kernel, kernel), strides=(stride, stride))
+
+
+class _VGG16Slices(nn.Module):
+    """VGG16 conv stack, returning (relu1_2, relu2_2, relu3_3, relu4_3, relu5_3)."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps: List[Array] = []
+        plan = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+        for block, (width, n_convs) in enumerate(plan):
+            if block > 0:
+                x = _max_pool(x)
+            for i in range(n_convs):
+                x = nn.relu(_conv(width, 3, name=f"conv{block + 1}_{i + 1}")(x))
+            taps.append(x)
+        return tuple(taps)
+
+
+class _AlexNetSlices(nn.Module):
+    """AlexNet conv stack, returning the 5 relu taps used by LPIPS."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        r1 = nn.relu(_conv(64, 11, stride=4, pad=2, name="conv1")(x))
+        r2 = nn.relu(_conv(192, 5, name="conv2")(_max_pool(r1, 3, 2)))
+        r3 = nn.relu(_conv(384, 3, name="conv3")(_max_pool(r2, 3, 2)))
+        r4 = nn.relu(_conv(256, 3, name="conv4")(r3))
+        r5 = nn.relu(_conv(256, 3, name="conv5")(r4))
+        return (r1, r2, r3, r4, r5)
+
+
+class _Fire(nn.Module):
+    squeeze: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = nn.relu(_conv(self.squeeze, 1, name="squeeze")(x))
+        e1 = nn.relu(_conv(self.expand, 1, name="expand1x1")(s))
+        e3 = nn.relu(_conv(self.expand, 3, name="expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class _SqueezeNetSlices(nn.Module):
+    """SqueezeNet 1.1 conv stack, returning the 7 taps used by LPIPS."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        r1 = nn.relu(_conv(64, 3, stride=2, pad=0, name="conv1")(x))
+        x = _max_pool(r1, 3, 2)
+        x = _Fire(16, 64, name="fire2")(x)
+        r2 = _Fire(16, 64, name="fire3")(x)
+        x = _max_pool(r2, 3, 2)
+        x = _Fire(32, 128, name="fire4")(x)
+        r3 = _Fire(32, 128, name="fire5")(x)
+        x = _max_pool(r3, 3, 2)
+        r4 = _Fire(48, 192, name="fire6")(x)
+        r5 = _Fire(48, 192, name="fire7")(r4)
+        r6 = _Fire(64, 256, name="fire8")(r5)
+        r7 = _Fire(64, 256, name="fire9")(r6)
+        return (r1, r2, r3, r4, r5, r6, r7)
+
+
+_BACKBONES = {"vgg": _VGG16Slices, "alex": _AlexNetSlices, "squeeze": _SqueezeNetSlices}
+
+
+class LPIPSNetwork(nn.Module):
+    """Full LPIPS: scaling layer -> two-tower feature stack -> unit-normalize
+    -> squared diff -> per-layer 1x1 linear head -> spatial mean -> sum."""
+
+    net_type: str = "alex"
+
+    @nn.compact
+    def __call__(self, img0: Array, img1: Array) -> Array:  # NHWC in [-1, 1]
+        shift = jnp.asarray(_SHIFT)
+        scale = jnp.asarray(_SCALE)
+        backbone = _BACKBONES[self.net_type](name="net")
+        feats0 = backbone((img0 - shift) / scale)
+        feats1 = backbone((img1 - shift) / scale)
+
+        def unit_normalize(v: Array) -> Array:
+            return v / (jnp.linalg.norm(v, axis=-1, keepdims=True) + 1e-10)
+
+        total = 0.0
+        for k, (f0, f1) in enumerate(zip(feats0, feats1)):
+            diff = (unit_normalize(f0) - unit_normalize(f1)) ** 2
+            head = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{k}")
+            total = total + head(diff).mean(axis=(1, 2))  # spatial average, (N, 1)
+        return total.squeeze(-1)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _lpips_forward(module: LPIPSNetwork, variables: Any, img0: Array, img1: Array) -> Array:
+    # Module-level + static module arg: all instances of the same net_type
+    # share one compiled executable per input shape.
+    return module.apply(variables, jnp.transpose(img0, (0, 2, 3, 1)), jnp.transpose(img1, (0, 2, 3, 1)))
+
+
+class NoTrainLpips:
+    """Frozen LPIPS distance — the default ``net`` backend for
+    ``LearnedPerceptualImagePatchSimilarity`` (reference ``image/lpip.py:33-42``).
+
+    Callable ``(img0, img1) -> (N,)`` with ``(N, 3, H, W)`` float inputs in
+    [-1, 1]; transposes to NHWC and runs both towers + heads in one jitted
+    program.
+
+    Args:
+        net_type: ``"vgg" | "alex" | "squeeze"``.
+        weights_path: optional local checkpoint (``.npz``/``.msgpack``);
+            random initialization with a warning otherwise. The LPIPS linear
+            heads are non-negative in the pretrained nets, so random heads are
+            clamped to their absolute value to keep distances >= 0.
+        rng_seed: seed for random initialization.
+    """
+
+    def __init__(self, net_type: str = "alex", weights_path: str = None, rng_seed: int = 0) -> None:
+        if net_type not in _BACKBONES:
+            raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
+        self.net_type = net_type
+        self.module = LPIPSNetwork(net_type=net_type)
+        dummy = jnp.zeros((1, 16, 16, 3), jnp.float32)
+        if weights_path is not None:
+            template = jax.eval_shape(self.module.init, jax.random.PRNGKey(0), dummy, dummy)
+            self.variables = _load_variables(template, weights_path)
+        else:
+            rank_zero_warn(
+                "NoTrainLpips is running with RANDOM weights (pretrained checkpoints cannot be downloaded"
+                " in this environment). Architecture is exact but distances are not comparable to the"
+                " pretrained LPIPS; pass `weights_path=` with a locally converted checkpoint.",
+                UserWarning,
+            )
+            variables = _fast_init_variables(self.module, (dummy, dummy), rng_seed)
+            variables = jax.tree_util.tree_map_with_path(
+                lambda path, v: jnp.abs(v)
+                if any(str(getattr(p, "key", "")).startswith("lin") for p in path)
+                else v,
+                variables,
+            )
+            self.variables = variables
+
+    def __call__(self, img0: Array, img1: Array) -> Array:
+        return _lpips_forward(
+            self.module, self.variables, jnp.asarray(img0, jnp.float32), jnp.asarray(img1, jnp.float32)
+        )

@@ -12,14 +12,13 @@ TPU-first differences:
   psum-reducible over the mesh, vs the reference's unbounded cat-lists.
 * **On-device sqrtm.** ``tr(sqrtm(S1 S2))`` via two ``eigh`` calls in XLA
   (``functional/image/fid.py``), replacing the scipy CPU round-trip.
-* **Injectable extractor.** The feature extractor is any callable
-  ``images -> (N, D)`` (a jitted Flax/HF-flax encoder in practice — the
-  reference's "model in the metric" pattern with a user-supplied model,
-  ``tm_examples/bert_score-own_model.py`` style). Passing an int (the
-  reference's pretrained-InceptionV3 layer selector) requires pretrained
-  weights and raises with guidance when unavailable, mirroring the
-  reference's ``ModuleNotFoundError`` when torch-fidelity is missing
-  (``image/fid.py:190-195``).
+* **In-repo Flax InceptionV3 default.** Passing an int (the reference's
+  pretrained-InceptionV3 layer selector, ``image/fid.py:228-250``) builds the
+  in-repo ``NoTrainInceptionV3`` (``image/backbones/inception.py``) at that
+  feature tap — random-initialized unless ``weights_path=`` points at a
+  locally converted checkpoint (downloads are unavailable here). A callable
+  ``images -> (N, D)`` extractor stays injectable (the reference's
+  user-supplied ``torch.nn.Module`` path).
 """
 from typing import Any, Callable, Tuple, Union
 
@@ -36,20 +35,23 @@ class FrechetInceptionDistance(Metric):
     """Frechet Inception Distance (reference ``image/fid.py:127``).
 
     Args:
-        feature: callable ``images -> (N, D)`` feature extractor, or an int
-            selecting a pretrained-InceptionV3 layer (needs weights;
-            unavailable offline).
+        feature: int in ``(64, 192, 768, 2048)`` selecting an in-repo Flax
+            InceptionV3 feature tap (uint8 image inputs), or a callable
+            ``images -> (N, D)`` feature extractor.
         feature_dim: dimensionality D of the extractor output (required when
             ``feature`` is a callable, to pre-allocate moment states).
         reset_real_features: whether ``reset()`` clears the real-set moments.
+        weights_path: optional local InceptionV3 checkpoint for the int
+            ``feature`` path (``.npz`` flat dict or flax ``.msgpack``);
+            random initialization with a warning otherwise.
 
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu import FrechetInceptionDistance
-        >>> extract = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8]
-        >>> fid = FrechetInceptionDistance(feature=extract, feature_dim=8)
-        >>> real = jax.random.uniform(jax.random.PRNGKey(0), (32, 3, 4, 4))
-        >>> fake = jax.random.uniform(jax.random.PRNGKey(1), (32, 3, 4, 4))
+        >>> fid = FrechetInceptionDistance(feature=64)
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> real = jax.random.randint(key1, (8, 3, 32, 32), 0, 200, dtype=jnp.uint8)
+        >>> fake = jax.random.randint(key2, (8, 3, 32, 32), 100, 255, dtype=jnp.uint8)
         >>> fid.update(real, real=True)
         >>> fid.update(fake, real=False)
         >>> bool(fid.compute() >= 0)
@@ -64,20 +66,26 @@ class FrechetInceptionDistance(Metric):
         feature: Union[int, Callable] = 2048,
         reset_real_features: bool = True,
         feature_dim: int = None,
+        weights_path: str = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, int):
-            raise ModuleNotFoundError(
-                "FrechetInceptionDistance with an integer `feature` requires pretrained InceptionV3 weights, which"
-                " are not available in this offline environment. Pass a callable `feature` (e.g. a jitted Flax"
-                " encoder `images -> (N, D)` features) together with `feature_dim` instead."
-            )
-        if not callable(feature):
+            valid_int_input = (64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from metrics_tpu.image.backbones import NoTrainInceptionV3
+
+            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+            feature_dim = feature
+        elif callable(feature):
+            if feature_dim is None:
+                raise ValueError("`feature_dim` (the extractor output dimensionality) must be given")
+            self.inception = feature
+        else:
             raise TypeError(f"Got unknown input to argument `feature`: {feature}")
-        if feature_dim is None:
-            raise ValueError("`feature_dim` (the extractor output dimensionality) must be given")
-        self.inception = feature
         self.feature_dim = int(feature_dim)
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")

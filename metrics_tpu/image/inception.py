@@ -21,11 +21,14 @@ class InceptionScore(Metric):
     """Inception Score (reference ``image/inception.py:28``).
 
     Args:
-        feature: callable ``images -> (N, num_classes)`` logits extractor
-            (string/int pretrained-InceptionV3 selection needs weights;
-            unavailable offline).
+        feature: int/str in ``("logits_unbiased", 64, 192, 768, 2048)``
+            selecting an in-repo Flax InceptionV3 tap (uint8 image inputs;
+            random-init unless ``weights_path=`` is given), or a callable
+            ``images -> (N, num_classes)`` logits extractor.
         splits: number of splits for the mean/std estimate.
         rng_seed: seed for the pre-split shuffle.
+        weights_path: optional local InceptionV3 checkpoint for the str/int
+            ``feature`` path.
 
     Example:
         >>> import jax
@@ -47,6 +50,7 @@ class InceptionScore(Metric):
         feature: Union[str, int, Callable] = "logits_unbiased",
         splits: int = 10,
         rng_seed: int = 42,
+        weights_path: str = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -56,13 +60,18 @@ class InceptionScore(Metric):
             UserWarning,
         )
         if isinstance(feature, (str, int)):
-            raise ModuleNotFoundError(
-                "InceptionScore with a string/int `feature` requires pretrained InceptionV3 weights, which are"
-                " not available in this offline environment. Pass a callable `feature` returning class logits."
-            )
-        if not callable(feature):
+            valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from metrics_tpu.image.backbones import NoTrainInceptionV3
+
+            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
             raise TypeError(f"Got unknown input to argument `feature`: {feature}")
-        self.inception = feature
         self.splits = splits
         self.rng_seed = rng_seed
         self.add_state("features", default=[], dist_reduce_fx=None)

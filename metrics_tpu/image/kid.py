@@ -52,8 +52,10 @@ class KernelInceptionDistance(Metric):
     """Kernel Inception Distance (reference ``image/kid.py:67``).
 
     Args:
-        feature: callable ``images -> (N, D)`` feature extractor (int layer
-            selection needs pretrained weights; unavailable offline).
+        feature: int/str in ``("logits_unbiased", 64, 192, 768, 2048)``
+            selecting an in-repo Flax InceptionV3 tap (uint8 image inputs;
+            random-init unless ``weights_path=`` is given), or a callable
+            ``images -> (N, D)`` feature extractor.
         subsets: number of random feature subsets per compute.
         subset_size: samples per subset.
         degree / gamma / coef: polynomial-kernel parameters.
@@ -79,7 +81,7 @@ class KernelInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable] = 2048,
+        feature: Union[str, int, Callable] = 2048,
         subsets: int = 100,
         subset_size: int = 1000,
         degree: int = 3,
@@ -87,6 +89,7 @@ class KernelInceptionDistance(Metric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         rng_seed: int = 42,
+        weights_path: str = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -95,14 +98,19 @@ class KernelInceptionDistance(Metric):
             " For large datasets this may lead to large memory footprint.",
             UserWarning,
         )
-        if isinstance(feature, int):
-            raise ModuleNotFoundError(
-                "KernelInceptionDistance with an integer `feature` requires pretrained InceptionV3 weights, which"
-                " are not available in this offline environment. Pass a callable `feature` instead."
-            )
-        if not callable(feature):
+        if isinstance(feature, (str, int)):
+            valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from metrics_tpu.image.backbones import NoTrainInceptionV3
+
+            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
             raise TypeError(f"Got unknown input to argument `feature`: {feature}")
-        self.inception = feature
 
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")

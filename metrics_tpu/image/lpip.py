@@ -2,11 +2,12 @@
 
 Behavioral equivalent of reference ``torchmetrics/image/lpip.py:44``
 (``NoTrainLpips`` wrapper :33, sum/total states :79-80, [-1,1] input check
-:88-92). The perceptual network is injectable — any callable
-``(img1, img2) -> (N,) distances`` (a jitted Flax VGG/AlexNet feature
-distance in practice); selecting a pretrained backbone by name requires
-weights unavailable offline and raises with guidance, mirroring the
-reference's ``ModuleNotFoundError`` when the ``lpips`` package is missing.
+:88-92). ``net_type`` selects the in-repo Flax LPIPS network
+(``image/backbones/lpips_nets.py``: VGG16 / AlexNet / SqueezeNet feature
+stacks + per-layer linear heads, one jitted two-tower XLA program) —
+random-initialized unless ``weights_path=`` points at a locally converted
+checkpoint. A callable ``net`` ``(img1, img2) -> (N,) distances`` stays
+injectable.
 """
 from typing import Any, Callable, Union
 
@@ -40,6 +41,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         net_type: str = "alex",
         reduction: str = "mean",
         net: Union[Callable, None] = None,
+        weights_path: str = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -47,11 +49,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if net_type not in valid_net_type:
             raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
         if net is None:
-            raise ModuleNotFoundError(
-                "LearnedPerceptualImagePatchSimilarity with a pretrained backbone requires network weights that"
-                " are not available in this offline environment. Pass `net`, a callable"
-                " `(img1, img2) -> (N,) distances` (e.g. a jitted Flax feature-space distance)."
-            )
+            from metrics_tpu.image.backbones import NoTrainLpips
+
+            net = NoTrainLpips(net_type=net_type, weights_path=weights_path)
         self.net = net
 
         valid_reduction = ("mean", "sum")

@@ -24,6 +24,13 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+def _info(msg: str) -> None:
+    # local import: utilities.prints -> jax, keep the native loader lean
+    from metrics_tpu.utilities.prints import rank_zero_info
+
+    rank_zero_info(f"metrics_tpu.native: {msg}")
+
+
 def _cache_dirs():
     """Candidate output dirs: package dir, then a per-user cache.
 
@@ -66,16 +73,22 @@ def _compile(src: Path) -> Optional[Path]:
                 break  # dir not writable: try the next cache dir
             os.close(fd)
             try:
+                # announce the build so a hung compiler/NFS cache stall is
+                # attributable; a 44-line TU compiles in well under 20 s
+                _info(f"compiling native kernel {src.name} with {cc} -> {so}")
                 res = subprocess.run(
                     [cc, "-O2", "-shared", "-fPIC", "-o", tmp, str(src)],
                     capture_output=True,
-                    timeout=120,
+                    timeout=20,
                 )
                 if res.returncode == 0:
                     os.replace(tmp, so)
                     return so
-            except (FileNotFoundError, subprocess.TimeoutExpired):
+                _info(f"native kernel build failed ({cc} rc={res.returncode}); trying next compiler")
+            except FileNotFoundError:
                 pass
+            except subprocess.TimeoutExpired:
+                _info(f"native kernel build with {cc} timed out after 20s; trying next compiler")
             finally:
                 if os.path.exists(tmp):
                     os.unlink(tmp)

@@ -73,9 +73,12 @@ class TestFID:
         fid2.reset()
         assert int(fid2.real_features_num_samples) == 0
 
-    def test_fid_int_feature_raises(self):
-        with pytest.raises(ModuleNotFoundError):
-            FrechetInceptionDistance(feature=2048)
+    def test_fid_int_feature_constructs_default_backbone(self):
+        # int feature now builds the in-repo Flax InceptionV3 (random-init)
+        fid = FrechetInceptionDistance(feature=64)
+        assert fid.feature_dim == 64
+        with pytest.raises(ValueError):
+            FrechetInceptionDistance(feature=100)
 
     def test_fid_streaming_equals_single_shot(self):
         """Chunked updates give the identical moments as one update."""
@@ -127,8 +130,8 @@ class TestKID:
             kid.compute()
 
     def test_kid_arg_validation(self):
-        with pytest.raises(ModuleNotFoundError):
-            KernelInceptionDistance(feature=2048)
+        with pytest.raises(ValueError):
+            KernelInceptionDistance(feature=100)
         with pytest.raises(ValueError):
             KernelInceptionDistance(feature=_extract, subsets=0)
         with pytest.raises(ValueError):
@@ -157,9 +160,9 @@ class TestInceptionScore:
         mean, _ = inception.compute()
         np.testing.assert_allclose(float(mean), 1.0, rtol=1e-5)
 
-    def test_is_pretrained_raises(self):
-        with pytest.raises(ModuleNotFoundError):
-            InceptionScore()
+    def test_is_invalid_feature_raises(self):
+        with pytest.raises(ValueError):
+            InceptionScore(feature=17)
 
 
 class TestLPIPS:
@@ -181,7 +184,5 @@ class TestLPIPS:
             lpips.update(jnp.zeros((2, 3, 8)), jnp.zeros((2, 3, 8)))
         with pytest.raises(ValueError):
             lpips.update(jnp.full((2, 3, 8, 8), 2.0), jnp.zeros((2, 3, 8, 8)))
-        with pytest.raises(ModuleNotFoundError):
-            LearnedPerceptualImagePatchSimilarity()
         with pytest.raises(ValueError):
             LearnedPerceptualImagePatchSimilarity(net=dist, net_type="bad")
