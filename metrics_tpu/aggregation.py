@@ -43,6 +43,9 @@ class BaseAggregator(Metric):
                 f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
             )
         self.nan_strategy = nan_strategy
+        # identity of the aggregation, for exact NaN-dropping under jit:
+        # imputing it makes a NaN row a no-op for max/min/sum
+        self._nan_identity = {"max": -jnp.inf, "min": jnp.inf, "sum": 0.0}.get(fn)
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
 
     def _cast_and_nan_check_input(self, x: Union[float, Array]) -> Array:
@@ -52,6 +55,19 @@ class BaseAggregator(Metric):
             x = jnp.asarray(x, dtype=jnp.float32)
         x = x.astype(jnp.float32) if not jnp.issubdtype(x.dtype, jnp.floating) else x
         nans = jnp.isnan(x)
+        if isinstance(x, jax.core.Tracer):
+            # inside jit/scan/shard_map the host-side branch below cannot run
+            # (data-dependent bool + dynamic-shape filtering). Float
+            # imputation stays exact via `where`; warn/ignore impute the
+            # aggregation identity, which is exactly "drop the row" for
+            # max/min/sum (MeanMetric overrides update with the weighted
+            # equivalent; CatMetric cannot drop rows under a trace and
+            # "error" cannot raise on data — those pass NaNs through).
+            if isinstance(self.nan_strategy, float):
+                x = jnp.where(nans, jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
+            elif self.nan_strategy in ("warn", "ignore") and self._nan_identity is not None:
+                x = jnp.where(nans, jnp.asarray(self._nan_identity, dtype=x.dtype), x)
+            return x.astype(jnp.float32)
         if bool(nans.any()):
             if self.nan_strategy == "error":
                 raise RuntimeError("Encountered `nan` values in tensor")
@@ -142,7 +158,19 @@ class MeanMetric(BaseAggregator):
         weight = jnp.asarray(weight, dtype=jnp.float32) if not isinstance(weight, (jnp.ndarray, jax.Array)) else weight
         weight = jnp.broadcast_to(weight, value.shape)
         nans = jnp.isnan(value) | jnp.isnan(weight.astype(jnp.float32))
-        if bool(nans.any()):
+        if isinstance(value, jax.core.Tracer) or isinstance(weight, jax.core.Tracer):
+            # trace-safe path (see _cast_and_nan_check_input): float
+            # imputation via where; warn/ignore zero out both value and
+            # weight on NaN rows — the exact weighted-mean equivalent of
+            # dropping them; "error" cannot raise on data under a trace
+            if isinstance(self.nan_strategy, float):
+                fill = jnp.asarray(self.nan_strategy, dtype=jnp.float32)
+                value = jnp.where(jnp.isnan(value), fill, value)
+                weight = jnp.where(jnp.isnan(weight.astype(jnp.float32)), fill, weight)
+            elif self.nan_strategy in ("warn", "ignore"):
+                value = jnp.where(nans, 0.0, value)
+                weight = jnp.where(nans, 0.0, weight.astype(jnp.float32))
+        elif bool(nans.any()):
             if self.nan_strategy == "error":
                 raise RuntimeError("Encountered `nan` values in tensor")
             if self.nan_strategy in ("warn", "ignore"):

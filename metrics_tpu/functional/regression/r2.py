@@ -41,7 +41,7 @@ def _r2_score_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Sufficient stats -> R2 (optionally adjusted / multioutput-reduced)."""
-    if n_obs < 2:
+    if not isinstance(n_obs, jax.core.Tracer) and n_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
     mean_obs = sum_obs / n_obs

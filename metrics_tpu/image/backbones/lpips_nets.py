@@ -29,13 +29,6 @@ Array = jax.Array
 _SHIFT = (-0.030, -0.088, -0.188)
 _SCALE = (0.458, 0.448, 0.450)
 
-_N_CHANNELS = {
-    "vgg": (64, 128, 256, 512, 512),
-    "alex": (64, 192, 384, 256, 256),
-    "squeeze": (64, 128, 256, 384, 384, 512, 512),
-}
-
-
 def _conv(features: int, kernel: int, stride: int = 1, pad: int = None, name: str = None) -> nn.Conv:
     if pad is None:
         pad = kernel // 2

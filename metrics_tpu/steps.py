@@ -1,0 +1,182 @@
+"""First-class pure-functional metric steps for jit / scan / shard_map.
+
+SURVEY §7's design stance is ``state = init(); state = update(state, batch)
+[jit, donated]; value = compute(state)``. The :class:`~metrics_tpu.metric.Metric`
+class realizes that contract statefully (``state_pytree`` /
+``load_state_pytree``); this module exposes it as pure functions so a metric
+drops directly into ``jax.jit``, ``jax.lax.scan`` epochs, and
+``jax.shard_map`` mesh programs:
+
+    init, step, compute = make_step(Accuracy, num_classes=5)
+    state = init()
+    state, batch_value = jax.jit(step, donate_argnums=0)(state, preds, target)
+    state, values = jax.lax.scan(lambda s, b: step(s, *b), state, batches)
+    value = compute(state)
+
+Under ``shard_map``, pass ``axis_name=`` and ``compute`` lowers each state's
+declared ``dist_reduce_fx`` through
+:func:`~metrics_tpu.utilities.distributed.sync_reduce_in_context`
+(psum/pmin/pmax/replicated-gather over ICI) before the final math — the
+mesh-collective analogue of the reference's gather-then-reduce sync
+(``torchmetrics/metric.py:279-304``), with the ``process_group`` kwarg
+(reference ``metric.py:137``) becoming the axis-name set.
+
+Replacing the reference's double-update ``forward`` (``metric.py:248-264``):
+``step`` returns ``(state', batch_value)`` from ONE traced program — XLA
+shares the per-batch statistics between the accumulation and the
+batch-local value, so nothing is computed twice.
+
+Static-shape contract: every state must be an array or a fixed-capacity
+buffer. Metrics whose states are unbounded Python lists (exact curve
+metrics without ``sample_capacity``) are rejected with guidance, since a
+growing pytree cannot be a ``scan`` carry.
+"""
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import CapacityBuffer
+from metrics_tpu.utilities.distributed import sync_reduce_in_context
+
+Array = jax.Array
+State = Dict[str, Any]
+
+__all__ = ["make_step"]
+
+
+def make_step(
+    metric: Union[Metric, Type[Metric]],
+    *init_args: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    with_value: bool = True,
+    **init_kwargs: Any,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Build pure ``(init, step, compute)`` functions from a metric.
+
+    Args:
+        metric: a :class:`Metric` subclass (constructed with
+            ``*init_args, **init_kwargs``) or an existing instance (cloned;
+            its accumulated state is not carried over).
+        axis_name: mesh axis name(s) the state is sharded over. When given,
+            ``compute`` reduces every state with its declared
+            ``dist_reduce_fx`` via in-jit collectives before the final math —
+            call it inside ``shard_map``/``pmap`` over that axis.
+        with_value: when True (default), ``step`` also returns the
+            batch-local metric value (the reference's ``forward`` result);
+            when False, ``step`` returns ``(state', None)`` and skips that
+            work.
+
+    Returns:
+        ``init() -> state``, ``step(state, *batch) -> (state', value)``,
+        ``compute(state) -> value`` — all pure and trace-safe.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.steps import make_step
+        >>> init, step, compute = make_step(Accuracy, num_classes=3)
+        >>> state = init()
+        >>> preds = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]])
+        >>> target = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]])
+        >>> state, values = jax.lax.scan(lambda s, b: step(s, *b), state, (preds, target))
+        >>> values  # per-batch accuracies, one fused program per step
+        Array([0.75, 0.75], dtype=float32)
+        >>> compute(state)
+        Array(0.75, dtype=float32)
+    """
+    if isinstance(metric, Metric):
+        template = metric.clone()
+        template.reset()
+    else:
+        template = metric(*init_args, **init_kwargs)
+
+    for name, default in template._defaults.items():
+        if isinstance(default, list):
+            raise ValueError(
+                f"State {name!r} of {type(template).__name__} is an unbounded list; a growing pytree cannot"
+                " be a jitted-step carry. Construct the metric with `sample_capacity=` (fixed-capacity HBM"
+                " buffer) or use the eager class API."
+            )
+
+    # one reusable worker (instead of a deepcopy per call): each use begins
+    # with reset + load, so calls stay pure; only trace-time Python state is
+    # shared, which is exactly what _capture_static wants propagated
+    worker = deepcopy(template)
+
+    def init() -> State:
+        worker.reset()
+        state = worker.state_pytree()
+        # Eager calls get fresh buffers, never the worker's canonical
+        # defaults: the returned state may be donated (jit(donate_argnums=0))
+        # and donating an aliased default would delete arrays later traces
+        # embed as constants. Inside a trace, skip the copy — jnp.array on a
+        # concrete value would needlessly turn it into a tracer (losing e.g.
+        # CapacityBuffer's host-count mirror), and donation cannot reach
+        # trace-internal values.
+        if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
+            state = jax.tree_util.tree_map(jnp.array, state)
+        return state
+
+    def _load(state: State) -> Metric:
+        worker.reset()
+        worker.load_state_pytree(state)
+        worker._to_sync = False  # reductions, if any, happen in compute() below
+        worker._computed = None
+        return worker
+
+    # A state is merge-combinable when its batch contribution (accumulated
+    # from the default) folds into the carry with its own declared
+    # reduction — the exact property the DDP gather-reduce sync relies on
+    # (per-rank states accumulated from zero, merged by dist_reduce_fx).
+    # sum/max/min qualify; cat buffers, None and custom reductions don't.
+    _MERGE_OPS = {"sum": lambda a, b: a + b, "max": jnp.maximum, "min": jnp.minimum}
+    mergeable = all(
+        r in _MERGE_OPS and not isinstance(d, CapacityBuffer)
+        for r, d in zip(template._reductions.values(), template._defaults.values())
+    )
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        if mergeable:
+            # ONE update on a fresh state; the carry merge is elementwise and
+            # the batch-local value reuses the same batch statistics — no
+            # double update even eagerly
+            b = _load(init())
+            b.update(*args, **kwargs)
+            batch_state = b.state_pytree()
+            new_state = {
+                name: _MERGE_OPS[template._reductions[name]](state[name], batch_state[name])
+                for name in batch_state
+            }
+            if not with_value:
+                return new_state, None
+            b._update_count = 1
+            return new_state, b.compute()
+        m = _load(state)
+        m.update(*args, **kwargs)
+        new_state = m.state_pytree()
+        if not with_value:
+            return new_state, None
+        b = _load(init())
+        b.update(*args, **kwargs)
+        b._update_count = 1
+        return new_state, b.compute()
+
+    def compute(state: State) -> Any:
+        if axis_name is not None:
+            reduced: State = {}
+            for name, value in state.items():
+                if isinstance(value, CapacityBuffer):
+                    raise ValueError(
+                        f"State {name!r} is a CapacityBuffer; in-jit mesh reduction of sample buffers is"
+                        " not supported — gather on host (metric.sync()) or shard the compute itself."
+                    )
+                reduced[name] = sync_reduce_in_context(value, template._reductions[name], axis_name)
+            state = reduced
+        m = _load(state)
+        m._update_count = 1  # state arrived from outside; silence the unused-metric warning
+        return m.compute()
+
+    return init, step, compute

@@ -117,5 +117,9 @@ class CapacityBuffer:
         new.dtype = dtype
         new.count = children[0]
         new.data = children[1] if allocated else None
-        new._host_count = None  # unknown until concretized
+        # keep the host mirror alive through flatten/unflatten round-trips
+        # (tree_map copies, scan carries): a concrete count can be read
+        # without a device sync being observable inside a trace; only a
+        # traced count is truly unknown
+        new._host_count = None if isinstance(new.count, jax.core.Tracer) else int(new.count)
         return new

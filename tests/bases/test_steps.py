@@ -1,0 +1,167 @@
+"""Tests for the pure-functional step API (``metrics_tpu.make_step``).
+
+SURVEY §7's design contract: ``state = init(); state = update(state, batch)
+[jit, donated]; value = compute(state)``. These tests pin that the exported
+step is jit/scan/shard_map-safe, equals the eager class API, and lowers each
+state's ``dist_reduce_fx`` through mesh collectives (the reference's
+gather-then-reduce sync, ``torchmetrics/metric.py:279-304``, as axis-name
+collectives).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    MaxMetric,
+    MeanMetric,
+    MeanSquaredError,
+    Precision,
+    R2Score,
+    make_step,
+)
+
+from tests.conftest import NUM_CLASSES
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+
+class TestScanEpoch:
+    def test_scan_epoch_matches_eager(self):
+        """A lax.scan over batches == the eager update loop == numpy."""
+        rng = np.random.default_rng(0)
+        preds = jnp.asarray(rng.integers(0, NUM_CLASSES, (6, 32)))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, (6, 32)))
+
+        init, step, compute = make_step(Accuracy, num_classes=NUM_CLASSES)
+        state, values = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+        final = compute(state)
+
+        eager = Accuracy(num_classes=NUM_CLASSES)
+        for p, t in zip(preds, target):
+            batch_val = eager(p, t)  # forward: batch-local value
+        np.testing.assert_allclose(float(values[-1]), float(batch_val), atol=1e-6)
+        np.testing.assert_allclose(float(final), float(eager.compute()), atol=1e-6)
+        np.testing.assert_allclose(
+            float(final), (np.asarray(preds) == np.asarray(target)).mean(), atol=1e-6
+        )
+
+    def test_scan_epoch_moment_merge_metric(self):
+        """Running-moment states (R2Score) survive a scan carry."""
+        rng = np.random.default_rng(1)
+        preds = jnp.asarray(rng.normal(0, 1, (5, 16)).astype(np.float32))
+        target = jnp.asarray((rng.normal(0, 1, (5, 16)) * 0.1).astype(np.float32) + preds)
+
+        init, step, compute = make_step(R2Score)
+        state, _ = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+
+        eager = R2Score()
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-5)
+
+    def test_jit_with_donation(self):
+        init, step, compute = make_step(MeanSquaredError)
+        jstep = jax.jit(step, donate_argnums=0)
+        state = init()
+        state, value = jstep(state, jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
+        np.testing.assert_allclose(float(value), 0.5, atol=1e-6)
+        state, _ = jstep(state, jnp.asarray([3.0]), jnp.asarray([1.0]))
+        np.testing.assert_allclose(float(compute(state)), (0.0 + 1.0 + 4.0) / 3, atol=1e-6)
+
+    def test_with_value_false(self):
+        init, step, compute = make_step(MeanSquaredError, with_value=False)
+        state, value = step(init(), jnp.asarray([2.0]), jnp.asarray([0.0]))
+        assert value is None
+        np.testing.assert_allclose(float(compute(state)), 4.0, atol=1e-6)
+
+    def test_instance_template(self):
+        """An existing instance works as template; its state is not inherited."""
+        m = MeanMetric()
+        m.update(jnp.asarray([100.0]))
+        init, step, compute = make_step(m)
+        state, _ = step(init(), jnp.asarray([2.0, 4.0]))
+        np.testing.assert_allclose(float(compute(state)), 3.0, atol=1e-6)
+
+
+class TestShardMap:
+    @pytest.mark.parametrize(
+        "cls,kwargs,reduction_kind",
+        [
+            (Accuracy, {"num_classes": NUM_CLASSES}, "sum"),
+            (Precision, {"num_classes": NUM_CLASSES, "average": "macro"}, "sum"),
+            (MaxMetric, {}, "max"),
+        ],
+    )
+    def test_mesh_parity(self, cls, kwargs, reduction_kind):
+        """Sharded step + axis-reduced compute == global eager compute."""
+        rng = np.random.default_rng(2)
+        if cls is MaxMetric:
+            batch = (jnp.asarray(rng.normal(0, 5, (64,)).astype(np.float32)),)
+            specs = (P("dp"),)
+        else:
+            batch = (
+                jnp.asarray(rng.integers(0, NUM_CLASSES, (64,))),
+                jnp.asarray(rng.integers(0, NUM_CLASSES, (64,))),
+            )
+            specs = (P("dp"), P("dp"))
+
+        init, step, compute = make_step(cls, axis_name="dp", **kwargs)
+
+        def prog(*args):
+            state, _ = step(init(), *args)
+            return compute(state)
+
+        out = jax.jit(jax.shard_map(prog, mesh=_mesh(), in_specs=specs, out_specs=P()))(*batch)
+
+        eager = cls(**kwargs)
+        eager.update(*batch)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(eager.compute()), atol=1e-6)
+
+    def test_mean_metric_weighted_mesh_parity(self):
+        """MeanMetric's (sum, weight) pair reduces correctly over the mesh."""
+        rng = np.random.default_rng(3)
+        values = jnp.asarray(rng.normal(0, 1, (64,)).astype(np.float32))
+        init, step, compute = make_step(MeanMetric, axis_name="dp")
+
+        def prog(v):
+            state, _ = step(init(), v)
+            return compute(state)
+
+        out = jax.jit(jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"),), out_specs=P()))(values)
+        np.testing.assert_allclose(float(out), np.asarray(values).mean(), atol=1e-6)
+
+
+class TestStaticShapeContract:
+    def test_unbounded_list_state_rejected(self):
+        with pytest.raises(ValueError, match="sample_capacity"):
+            make_step(AUROC)
+
+    def test_capacity_buffer_carry(self):
+        rng = np.random.default_rng(4)
+        init, step, compute = make_step(AUROC, sample_capacity=256)
+        jstep = jax.jit(step)
+        state = init()
+        all_p, all_t = [], []
+        for i in range(3):
+            p = jnp.asarray(rng.random(32).astype(np.float32))
+            t = jnp.asarray(rng.integers(0, 2, (32,)))
+            all_p.append(np.asarray(p))
+            all_t.append(np.asarray(t))
+            state, _ = jstep(state, p, t)
+        assert int(state["preds"].count) == 96
+        eager = AUROC()
+        eager.update(jnp.asarray(np.concatenate(all_p)), jnp.asarray(np.concatenate(all_t)))
+        np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-6)
+
+    def test_capacity_buffer_mesh_reduce_rejected(self):
+        init, step, compute = make_step(AUROC, sample_capacity=64, axis_name="dp")
+        state, _ = step(init(), jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+        with pytest.raises(ValueError, match="CapacityBuffer"):
+            compute(state)
