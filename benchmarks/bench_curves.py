@@ -13,7 +13,7 @@ from metrics_tpu.ops import binned_counts
 N, T, K = 1_000_000, 100, 10
 
 
-def main() -> None:
+def measure() -> dict:
     preds = jax.random.uniform(jax.random.PRNGKey(0), (N,))
     target = (jax.random.uniform(jax.random.PRNGKey(1), (N,)) > 0.5).astype(jnp.int32)
 
@@ -27,8 +27,8 @@ def main() -> None:
             return acc + exact(preds + 0.0001 * i, target)
         return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
 
-    ms = measure_ms(run_exact, K)
-    print(json.dumps({"metric": "auroc_exact_1M_compute", "value": round(ms, 3), "unit": "ms"}))
+    out = {}
+    out["auroc_exact_1M_compute"] = measure_ms(run_exact, K)
 
     thresholds = jnp.linspace(0, 1.0, T)
 
@@ -41,8 +41,13 @@ def main() -> None:
             return acc + tps.sum()
         return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
 
-    ms = measure_ms(run_binned, K)
-    print(json.dumps({"metric": "binned_counts_1M_T100_update", "value": round(ms, 3), "unit": "ms"}))
+    out["binned_counts_1M_T100_update"] = measure_ms(run_binned, K)
+    return out
+
+
+def main() -> None:
+    for name, ms in measure().items():
+        print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
 
 
 if __name__ == "__main__":

@@ -15,7 +15,7 @@ from metrics_tpu.functional.image.fid import _compute_fid
 N, D, K = 10_000, 2048, 10
 
 
-def main() -> None:
+def measure() -> dict:
     feats_r = jax.random.normal(jax.random.PRNGKey(0), (N, D)) * 0.5
     feats_f = jax.random.normal(jax.random.PRNGKey(1), (N, D)) * 0.55 + 0.05
 
@@ -31,8 +31,12 @@ def main() -> None:
             return acc + fid_from_feats(fr * (1.0 + 0.0001 * i), ff)
         return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
 
-    ms = measure_ms(run, K)
-    print(json.dumps({"metric": "fid_10k_2048d_compute", "value": round(ms, 3), "unit": "ms"}))
+    return {"fid_10k_2048d_compute": measure_ms(run, K)}
+
+
+def main() -> None:
+    for name, ms in measure().items():
+        print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
 
 
 if __name__ == "__main__":

@@ -235,6 +235,13 @@ def bert_score(
     """
     if device is not None:
         rank_zero_warn("`device` is ignored: JAX places the encoder on the default device.")
+    if model is None and model_name_or_path is None:
+        rank_zero_warn(
+            f"The argument `model_name_or_path` was not specified while it is required when the default "
+            f"`transformers` model is used. It will use the default recommended model - {_DEFAULT_MODEL!r}."
+        )
+        model_name_or_path = _DEFAULT_MODEL
+
     # empty corpus: nothing to tokenize or embed (HF fast tokenizers raise on
     # an empty batch, and the all_layers stack would trip on a 0-width axis);
     # the count check must come first so a one-sided empty input gets the
@@ -249,12 +256,6 @@ def bert_score(
             output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
         return output
 
-    if model is None and model_name_or_path is None:
-        rank_zero_warn(
-            f"The argument `model_name_or_path` was not specified while it is required when the default "
-            f"`transformers` model is used. It will use the default recommended model - {_DEFAULT_MODEL!r}."
-        )
-        model_name_or_path = _DEFAULT_MODEL
     if model is None:
         tokenizer, model = _load_tokenizer_and_model(model_name_or_path)
     else:

@@ -117,9 +117,14 @@ class CapacityBuffer:
         new.dtype = dtype
         new.count = children[0]
         new.data = children[1] if allocated else None
-        # keep the host mirror alive through flatten/unflatten round-trips
-        # (tree_map copies, scan carries): a concrete count can be read
-        # without a device sync being observable inside a trace; only a
-        # traced count is truly unknown
-        new._host_count = None if isinstance(new.count, jax.core.Tracer) else int(new.count)
+        # Only adopt a host mirror from leaves that are free to read: a plain
+        # Python/numpy int. int() on a tracer raises, on a ShapeDtypeStruct
+        # (eval_shape / orbax restore targets) is a TypeError, and on a live
+        # device array it BLOCKS until the dispatch finishes — which would
+        # kill async dispatch on every jitted-step output. Those recover
+        # lazily through _concrete_count() when first needed.
+        if isinstance(new.count, int) or type(new.count).__module__ == "numpy":
+            new._host_count = int(new.count)
+        else:
+            new._host_count = None
         return new
