@@ -109,7 +109,7 @@ def _input_validator(preds: Sequence[Dict[str, Any]], targets: Sequence[Dict[str
 
 
 def _np_box_area(boxes: np.ndarray) -> np.ndarray:
-    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
 
 
 def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
@@ -325,32 +325,11 @@ class MeanAveragePrecision(Metric):
         gt_img = _to_np_cat(self.gt_img_idx, (0,), dtype=np.int64)
         max_det_global = self.max_detection_thresholds[-1]
 
-        # group per (image, class) with one lexsort + contiguous-run slicing —
-        # O(N log N) over the flat buffers instead of an O(n_images * N)
-        # boolean-mask scan (same sort+segment trick as the retrieval domain)
-        def _runs(img: np.ndarray, labels: np.ndarray):
-            order = np.lexsort((labels, img))
-            keys = np.stack([img[order], labels[order]], axis=1)
-            if len(order) == 0:
-                return order, np.zeros((0, 2), dtype=np.int64), np.zeros((0,), dtype=np.int64)
-            change = np.nonzero(np.any(keys[1:] != keys[:-1], axis=1))[0] + 1
-            starts = np.concatenate([[0], change])
-            return order, keys[starts], np.concatenate([starts, [len(order)]])
-
-        d_order, d_keys, d_bounds = _runs(det_img, det_labels)
-        g_order, g_keys, g_bounds = _runs(gt_img, gt_labels)
-        per_img_cls: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
-        d_slices = {tuple(k): d_order[d_bounds[i] : d_bounds[i + 1]] for i, k in enumerate(d_keys)}
-        g_slices = {tuple(k): g_order[g_bounds[i] : g_bounds[i + 1]] for i, k in enumerate(g_keys)}
-        for key in set(d_slices) | set(g_slices):
-            d_sel = d_slices.get(key, np.zeros((0,), dtype=np.int64))
-            g_sel = g_slices.get(key, np.zeros((0,), dtype=np.int64))
-            d_b, d_s = det_boxes[d_sel], det_scores[d_sel]
-            order = np.argsort(-d_s, kind="stable")[:max_det_global]
-            d_b, d_s = d_b[order], d_s[order]
-            g_b = gt_boxes[g_sel]
-            per_img_cls[(int(key[0]), int(key[1]))] = (d_b, d_s, g_b)
-
+        # group per (image, class) WITHOUT any per-cell Python work: encode
+        # (img, label) into one int64 key, lexsort once, derive within-run
+        # ranks arithmetically, and scatter straight into the padded batch
+        # (same sort+segment trick as the retrieval domain; profiling showed
+        # ~15k tiny per-cell numpy calls dominating the old layout)
         n_thrs = len(self.iou_thresholds)
         n_rec = len(self.rec_thresholds)
         n_areas = len(self.bbox_area_ranges)
@@ -358,38 +337,72 @@ class MeanAveragePrecision(Metric):
         precision = -np.ones((n_thrs, n_rec, len(class_ids), n_areas, n_mdets))
         recall = -np.ones((n_thrs, len(class_ids), n_areas, n_mdets))
 
-        # ---- pad all (image, class) cells into one batch ----------------
-        # Greedy matching is sequential over score-ranked detections, but
-        # only within a cell: one loop over detection RANK with every cell
-        # and IoU threshold vectorized turns ~n_cells * max_det tiny numpy
-        # calls into max_det array ops (the pycocotools/reference layout is
-        # a Python loop per (image, class, area); ref :421/:672).
-        cells = sorted(per_img_cls.items())  # (img, cls) order fixes tie-breaks
-        n_cells = len(cells)
+        # labels may be arbitrary ints (incl. negative), so encode via their
+        # DENSE index in the sorted unique-label set — keys stay collision-
+        # free and ordered by (img, label) like the old dict grouping
+        uniq_labels = np.unique(np.concatenate([det_labels, gt_labels]))
+        enc_base = max(1, len(uniq_labels))
+        enc_d = det_img * enc_base + np.searchsorted(uniq_labels, det_labels)
+        enc_g = gt_img * enc_base + np.searchsorted(uniq_labels, gt_labels)
+
+        # cells sorted by (img, cls) — the ascending encoded key order —
+        # which fixes cross-cell score tie-breaks exactly like the old
+        # sorted(dict.items()) layout
+        cells_enc = np.unique(np.concatenate([enc_d, enc_g]))
+        n_cells = len(cells_enc)
         if n_cells == 0:
             return precision, recall
-        md = max(1, min(max_det_global, max(len(e[1][1]) for e in cells)))
-        cell_cls = np.asarray([cls for (_, cls), _ in cells])
-        cell_ng = np.asarray([len(value[2]) for _, value in cells])
+        cell_cls = uniq_labels[(cells_enc % enc_base).astype(np.int64)]
+
+        def _ranks(enc_sorted: np.ndarray) -> np.ndarray:
+            """Position of each element within its contiguous key run."""
+            n = len(enc_sorted)
+            if n == 0:
+                return np.zeros((0,), dtype=np.int64)
+            new_run = np.empty(n, dtype=bool)
+            new_run[0] = True
+            np.not_equal(enc_sorted[1:], enc_sorted[:-1], out=new_run[1:])
+            starts = np.flatnonzero(new_run)
+            run_id = np.cumsum(new_run) - 1
+            return np.arange(n, dtype=np.int64) - starts[run_id]
+
+        # detections: one lexsort puts each cell's dets contiguous AND
+        # descending by score (stable, so equal scores keep input order —
+        # the same tie-break as the old per-cell stable argsort)
+        d_ord = np.lexsort((-det_scores, enc_d))
+        enc_d_sorted = enc_d[d_ord]
+        d_rank = _ranks(enc_d_sorted)
+        d_cell = np.searchsorted(cells_enc, enc_d_sorted)
+        d_counts = np.bincount(d_cell, minlength=n_cells)
+        md = max(1, min(max_det_global, int(d_counts.max()) if d_counts.size else 1))
+        d_keep = d_rank < md
+
         scores_p = np.full((n_cells, md), -np.inf, dtype=np.float32)
         det_valid = np.zeros((n_cells, md), dtype=bool)
-        det_areas = np.zeros((n_cells, md), dtype=np.float32)
-        for i, (_, (d_b, d_s, _)) in enumerate(cells):
-            nd = len(d_s)
-            scores_p[i, :nd] = d_s
-            det_valid[i, :nd] = True
-            if nd:
-                det_areas[i, :nd] = _np_box_area(d_b)
+        det_boxes_p = np.zeros((n_cells, md, 4), dtype=np.float32)
+        dk_cell, dk_rank = d_cell[d_keep], d_rank[d_keep]
+        scores_p[dk_cell, dk_rank] = det_scores[d_ord][d_keep]
+        det_valid[dk_cell, dk_rank] = True
+        det_boxes_p[dk_cell, dk_rank] = det_boxes[d_ord][d_keep]
+        det_areas = np.where(det_valid, _np_box_area(det_boxes_p), 0.0).astype(np.float32)
+
+        # ground truths: stable sort by key, rank within run
+        g_ord = np.argsort(enc_g, kind="stable")
+        enc_g_sorted = enc_g[g_ord]
+        g_rank = _ranks(enc_g_sorted)
+        g_cell = np.searchsorted(cells_enc, enc_g_sorted)
+        cell_ng = np.bincount(g_cell, minlength=n_cells)
+        gt_boxes_sorted = gt_boxes[g_ord]
 
         # bucket cells by gt count so one crowded cell doesn't inflate the
         # (n_cells, md, mg) padding for everyone (f32; buckets are powers of 4)
         bucket_caps = [c for c in (4, 16, 64, 256) if c < max(1, int(cell_ng.max()))]
         bucket_caps.append(max(1, int(cell_ng.max())))
-        det_matches_all = {}  # area_idx -> (n_cells, T, md)
+        det_matches_all = np.zeros((n_areas, n_cells, n_thrs, md), dtype=bool)
         gt_ignore_counts = np.zeros((n_areas, n_cells))
         iou_thrs = np.asarray(self.iou_thresholds)
-        for idx_area in range(n_areas):
-            det_matches_all[idx_area] = np.zeros((n_cells, n_thrs, md), dtype=bool)
+        area_lo = np.asarray([r[0] for r in self.bbox_area_ranges.values()], dtype=np.float32)
+        area_hi = np.asarray([r[1] for r in self.bbox_area_ranges.values()], dtype=np.float32)
 
         prev_cap = -1
         for cap in bucket_caps:
@@ -398,58 +411,76 @@ class MeanAveragePrecision(Metric):
             if bucket.size == 0:
                 continue
             nb, mg = bucket.size, max(1, cap)
+            # scatter this bucket's gts into (nb, mg) padded arrays
+            bucket_pos = np.full(n_cells, -1, dtype=np.int64)
+            bucket_pos[bucket] = np.arange(nb)
+            g_in = bucket_pos[g_cell] >= 0
+            gb_row, gb_rank = bucket_pos[g_cell[g_in]], g_rank[g_in]
             gt_valid = np.zeros((nb, mg), dtype=bool)
-            gt_areas = np.zeros((nb, mg), dtype=np.float32)
-            ious_p = np.zeros((nb, md, mg), dtype=np.float32)
-            for j, i in enumerate(bucket):
-                _, (d_b, d_s, g_b) = cells[i]
-                nd, ng = len(d_s), len(g_b)
-                gt_valid[j, :ng] = True
-                if ng:
-                    gt_areas[j, :ng] = _np_box_area(g_b)
-                if nd and ng:
-                    ious_p[j, :nd, :ng] = _np_box_iou(d_b, g_b)
+            gt_boxes_b = np.zeros((nb, mg, 4), dtype=np.float32)
+            gt_valid[gb_row, gb_rank] = True
+            gt_boxes_b[gb_row, gb_rank] = gt_boxes_sorted[g_in]
+            gt_areas = np.where(gt_valid, _np_box_area(gt_boxes_b), 0.0).astype(np.float32)
+            # one batched IoU for the whole bucket: (nb, md, mg)
+            db = det_boxes_p[bucket]
+            lt = np.maximum(db[:, :, None, :2], gt_boxes_b[:, None, :, :2])
+            rb = np.minimum(db[:, :, None, 2:], gt_boxes_b[:, None, :, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            union = det_areas[bucket][:, :, None] + gt_areas[:, None, :] - inter
+            pair_valid = det_valid[bucket][:, :, None] & gt_valid[:, None, :]
+            ious_p = np.where(pair_valid & (union > 0), inter / np.where(union > 0, union, 1.0), 0.0)
             rows = np.arange(nb)
-            for idx_area, area_range in enumerate(self.bbox_area_ranges.values()):
-                gt_out = (gt_areas < area_range[0]) | (gt_areas > area_range[1])
-                gt_ignore = gt_out | ~gt_valid  # padding never matches
-                gt_ignore_counts[idx_area, bucket] = (~gt_ignore & gt_valid).sum(axis=1)
+            # area axis folded into the batch: the four area regimes differ
+            # only in which gts are ignored, so one rank loop serves all of
+            # them — 4x fewer Python iterations, 4x larger array ops
+            gt_out = (gt_areas[None] < area_lo[:, None, None]) | (gt_areas[None] > area_hi[:, None, None])
+            gt_ignore = gt_out | ~gt_valid[None]  # (A, nb, mg); padding never matches
+            gt_ignore_counts[:, bucket] = (~gt_ignore & gt_valid[None]).sum(axis=2)
 
-                # vectorized greedy matching (ref :421/:513 semantics: matched
-                # and ignored gts are masked out entirely before the argmax)
-                gt_matched = np.zeros((nb, n_thrs, mg), dtype=bool)
-                for d in range(md):
-                    masked = ious_p[:, d, None, :] * ~(gt_matched | gt_ignore[:, None, :])
-                    m = masked.argmax(axis=2)  # (nb, T)
-                    ok = np.take_along_axis(masked, m[:, :, None], axis=2)[:, :, 0] > iou_thrs[None, :]
-                    ok &= det_valid[bucket, d][:, None]
-                    det_matches_all[idx_area][bucket, :, d] = ok
-                    gt_matched[rows[:, None], np.arange(n_thrs)[None, :], m] |= ok
+            # vectorized greedy matching (ref :421/:513 semantics: matched
+            # and ignored gts are masked out entirely before the argmax)
+            gt_matched = np.zeros((n_areas, nb, n_thrs, mg), dtype=bool)
+            a_idx = np.arange(n_areas)[:, None, None]
+            r_idx = rows[None, :, None]
+            t_idx = np.arange(n_thrs)[None, None, :]
+            dv = det_valid[bucket]
+            for d in range(md):
+                masked = ious_p[None, :, d, None, :] * ~(gt_matched | gt_ignore[:, :, None, :])
+                m = masked.argmax(axis=3)  # (A, nb, T)
+                val = np.take_along_axis(masked, m[..., None], axis=3)[..., 0]
+                ok = (val > iou_thrs[None, None, :]) & dv[None, :, d, None]
+                # mixed advanced/basic indexing puts the `bucket` axis first
+                det_matches_all[:, bucket, :, d] = ok.transpose(1, 0, 2)
+                gt_matched[a_idx, r_idx, t_idx, m] |= ok
 
-        for idx_area, area_range in enumerate(self.bbox_area_ranges.values()):
-            det_out = (det_areas < area_range[0]) | (det_areas > area_range[1])
-            det_matches = det_matches_all[idx_area]
-            det_ignore_base = ~det_matches & (det_out[:, None, :] | ~det_valid[:, None, :])
-
-            npig_cell = gt_ignore_counts[idx_area]
-            for idx_cls, cls in enumerate(class_ids):
-                sel = cell_cls == cls
-                if not sel.any():
-                    continue
-                npig = int(npig_cell[sel].sum())
-                cls_scores = scores_p[sel]  # (nc, md)
-                cls_matches = det_matches[sel]
-                cls_ignore = det_ignore_base[sel]
-                cls_dvalid = det_valid[sel]
+        det_out_all = (det_areas[None] < area_lo[:, None, None]) | (det_areas[None] > area_hi[:, None, None])
+        arange_md = np.arange(md)
+        for idx_cls, cls in enumerate(class_ids):
+            sel = cell_cls == cls
+            if not sel.any():
+                continue
+            cls_dvalid = det_valid[sel]
+            nc = int(sel.sum())
+            # ONE sort per class (ref :694 tie order): the md-threshold
+            # subsets are rank-filters of the same descending-score order,
+            # so restricting the sorted sequence to rank < t reproduces the
+            # order a fresh masked sort would give
+            flat_scores = np.where(cls_dvalid, scores_p[sel], -np.inf).reshape(-1)
+            order = np.argsort(-flat_scores, kind="mergesort")[: int(cls_dvalid.sum())]
+            sorted_scores = flat_scores[order]
+            sorted_rank = np.broadcast_to(arange_md, (nc, md)).reshape(-1)[order]
+            for idx_area in range(n_areas):
+                cls_matches = det_matches_all[idx_area][sel]
+                cls_ignore = ~cls_matches & (det_out_all[idx_area][sel][:, None, :] | ~cls_dvalid[:, None, :])
+                flat_m = cls_matches.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
+                flat_i = cls_ignore.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
+                npig = int(gt_ignore_counts[idx_area][sel].sum())
                 for idx_md, max_det in enumerate(self.max_detection_thresholds):
-                    keep = cls_dvalid & (np.arange(md)[None, :] < max_det)
-                    flat_scores = np.where(keep, cls_scores, -np.inf).reshape(-1)
-                    order = np.argsort(-flat_scores, kind="mergesort")  # ref :694 tie order
-                    n_keep = int(keep.sum())
-                    order = order[:n_keep]
-                    flat_m = cls_matches.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
-                    flat_i = cls_ignore.transpose(1, 0, 2).reshape(n_thrs, -1)[:, order]
-                    acc = self._accumulate_flat(flat_scores[order], flat_m, flat_i, npig)
+                    keep_t = sorted_rank < max_det
+                    acc = self._accumulate_flat(
+                        sorted_scores[keep_t], flat_m[:, keep_t], flat_i[:, keep_t], npig
+                    )
                     if acc is None:
                         continue
                     rec, prec = acc

@@ -1,0 +1,58 @@
+"""Align jax-ecosystem pins for a target jax release.
+
+TPU analogue of the reference's ``requirements/adjust-versions.py`` (which
+aligns torch/torchvision/torchtext triplets): given a jax version, rewrite
+the requirements files so jaxlib/flax/optax/orbax pins match the validated
+row. Usage::
+
+    python requirements/adjust-versions.py requirements/base.txt [jax_version]
+
+With no explicit version, the latest validated row applies.
+"""
+import re
+import sys
+from pathlib import Path
+
+# validated (jax, jaxlib, flax, optax, orbax-checkpoint) rows, newest first
+VERSIONS = [
+    dict(jax="0.8.0", jaxlib="0.8.0", flax="0.12.0", optax="0.2.6", orbax="0.11.0"),
+    dict(jax="0.7.0", jaxlib="0.7.0", flax="0.11.0", optax="0.2.5", orbax="0.11.0"),
+    dict(jax="0.6.0", jaxlib="0.6.0", flax="0.10.6", optax="0.2.4", orbax="0.11.0"),
+]
+PACKAGE_KEY = {"jax": "jax", "jaxlib": "jaxlib", "flax": "flax", "optax": "optax", "orbax-checkpoint": "orbax"}
+
+
+def find_row(jax_version: str | None) -> dict:
+    if jax_version is None:
+        return VERSIONS[0]
+    for row in VERSIONS:
+        if jax_version.startswith(row["jax"].rsplit(".", 1)[0]):
+            return row
+    return VERSIONS[0]
+
+
+def adjust(text: str, row: dict) -> str:
+    out = []
+    for line in text.splitlines():
+        m = re.match(r"^([A-Za-z0-9_.-]+)\s*([<>=!~].*)?$", line.split("#")[0].strip())
+        name = m.group(1).lower() if m and m.group(1) else None
+        if name in PACKAGE_KEY:
+            pin = row[PACKAGE_KEY[name]]
+            comment = "" if "#" not in line else "  #" + line.split("#", 1)[1]
+            out.append(f"{name}>={pin}{comment}")
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n"
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    path = Path(sys.argv[1])
+    row = find_row(sys.argv[2] if len(sys.argv) > 2 else None)
+    path.write_text(adjust(path.read_text(), row))
+    print(f"{path}: aligned to jax {row['jax']} row")
+
+
+if __name__ == "__main__":
+    main()

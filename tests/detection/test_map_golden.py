@@ -143,3 +143,17 @@ def test_issue_943_degenerate_pair():
     np.testing.assert_allclose(float(res["map"]), 0.6, atol=ATOL)
     np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=ATOL)
     np.testing.assert_allclose(float(res["mar_1"]), 0.6, atol=ATOL)
+
+
+def test_negative_labels():
+    """Labels are arbitrary ints (the dict grouping of the reference accepts
+    them); the encoded-key grouping must not collide or divide by zero."""
+    metric = MeanAveragePrecision(class_metrics=True)
+    metric.update(
+        [_d([[258.0, 41.0, 606.0, 285.0], [10.0, 10.0, 50.0, 50.0]], [0.536, 0.9], [-1, 3])],
+        [_g([[214.0, 41.0, 562.0, 285.0], [10.0, 10.0, 50.0, 50.0]], [-1, 3])],
+    )
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=ATOL)
+    assert np.asarray(res["map_per_class"]).shape == (2,)  # classes -1 and 3 kept distinct
+    np.testing.assert_allclose(float(np.asarray(res["map_per_class"])[1]), 1.0, atol=ATOL)
