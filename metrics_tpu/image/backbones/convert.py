@@ -1,0 +1,216 @@
+"""Convert pretrained torch checkpoints into ``weights_path`` format.
+
+Pretrained backbone weights cannot be downloaded in this environment, but
+users migrating from the reference already have them on disk: torch-fidelity
+caches its FID InceptionV3 (``pt_inception-2015-12-05``-style state dicts,
+the torchvision naming convention) and the ``lpips`` package ships
+VGG16/AlexNet/SqueezeNet towers + linear heads. This module maps those
+state dicts onto the Flax trees of :mod:`.inception` / :mod:`.lpips_nets`:
+
+* conv ``weight (O, I, kH, kW)`` -> ``kernel (kH, kW, I, O)``
+* batchnorm ``weight/bias`` -> ``scale/bias`` (params),
+  ``running_mean/running_var`` -> ``mean/var`` (batch_stats)
+* final fc ``weight (num_classes, 2048)`` -> ``fc_kernel (2048, num_classes)``
+* LPIPS ``lin{k}`` 1x1 heads ``(1, C, 1, 1)`` -> ``kernel (1, 1, C, 1)``
+
+Output is the flat ``{"/".join(path): array}`` dict that
+``save_variables_npz`` writes and ``weights_path=`` loads. CLI::
+
+    python -m metrics_tpu.image.backbones.convert inception weights.pth out.npz
+    python -m metrics_tpu.image.backbones.convert lpips-alex lpips.pth out.npz
+
+Conversion itself is pure numpy — torch is only needed to ``torch.load``
+a ``.pt``/``.pth`` input file.
+"""
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+__all__ = ["convert_inception_state_dict", "convert_lpips_state_dict", "save_flat_npz"]
+
+
+def _np(t: Any) -> np.ndarray:
+    # torch tensor or array-like -> numpy without importing torch
+    return np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+
+
+def _conv_kernel(w: Any) -> np.ndarray:
+    return _np(w).transpose(2, 3, 1, 0)  # (O, I, H, W) -> (H, W, I, O)
+
+
+def convert_inception_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """torch(-fidelity/vision) InceptionV3 state dict -> flat flax dict.
+
+    Handles the standard names (``Conv2d_1a_3x3.conv.weight``,
+    ``Mixed_5b.branch1x1.bn.running_mean``, ``fc.weight``, ...); torchvision's
+    ``AuxLogits`` head and bookkeeping buffers are skipped.
+    """
+    flat: Dict[str, np.ndarray] = {}
+    for key, value in state_dict.items():
+        if key.startswith("AuxLogits") or key.endswith("num_batches_tracked"):
+            continue
+        if key == "fc.weight":
+            flat["params/fc_kernel"] = _np(value).T
+            continue
+        if key == "fc.bias":
+            flat["params/fc_bias"] = _np(value)
+            continue
+        parts = key.split(".")
+        module_path, layer, param = parts[:-2], parts[-2], parts[-1]
+        prefix = "/".join(module_path)
+        if layer == "conv" and param == "weight":
+            flat[f"params/{prefix}/conv/kernel"] = _conv_kernel(value)
+        elif layer == "bn":
+            dest = {
+                "weight": "params/{}/bn/scale",
+                "bias": "params/{}/bn/bias",
+                "running_mean": "batch_stats/{}/bn/mean",
+                "running_var": "batch_stats/{}/bn/var",
+            }.get(param)
+            if dest is None:
+                raise KeyError(f"Unrecognized batchnorm entry {key!r}")
+            flat[dest.format(prefix)] = _np(value)
+        else:
+            raise KeyError(f"Unrecognized InceptionV3 entry {key!r}")
+    return flat
+
+
+# absolute torchvision `features` indices -> our layer names (the lpips
+# package keeps absolute indices when slicing the towers)
+_LPIPS_LAYER_MAPS = {
+    "vgg": {
+        0: "conv1_1", 2: "conv1_2", 5: "conv2_1", 7: "conv2_2",
+        10: "conv3_1", 12: "conv3_2", 14: "conv3_3",
+        17: "conv4_1", 19: "conv4_2", 21: "conv4_3",
+        24: "conv5_1", 26: "conv5_2", 28: "conv5_3",
+    },
+    "alex": {0: "conv1", 3: "conv2", 6: "conv3", 8: "conv4", 10: "conv5"},
+    "squeeze": {
+        0: "conv1", 3: "fire2", 4: "fire3", 6: "fire4", 7: "fire5",
+        9: "fire6", 10: "fire7", 11: "fire8", 12: "fire9",
+    },
+}
+
+
+def convert_lpips_state_dict(net_type: str, state_dict: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """``lpips.LPIPS`` state dict (tower + ``lin`` heads) -> flat flax dict.
+
+    Accepts the full LPIPS module state dict: ``net.slice{S}.{idx}...``
+    tower entries (absolute torchvision indices) and ``lin{k}.model.1.weight``
+    / ``lins.{k}.model.1.weight`` heads. A bare torchvision backbone state
+    dict (``features.{idx}...``) also converts — heads are then absent.
+    """
+    if net_type not in _LPIPS_LAYER_MAPS:
+        raise ValueError(f"net_type must be one of {tuple(_LPIPS_LAYER_MAPS)}, got {net_type!r}")
+    layer_map = _LPIPS_LAYER_MAPS[net_type]
+    flat: Dict[str, np.ndarray] = {}
+    for key, value in state_dict.items():
+        if key.startswith("scaling_layer"):
+            continue  # constants, baked into LPIPSNetwork
+        if key.startswith("classifier.") or key.endswith("num_batches_tracked"):
+            continue  # torchvision hub files ship the unused classifier head
+        parts = key.split(".")
+        if parts[0].startswith("lin") or parts[0] == "lins":
+            k = int(parts[1]) if parts[0] == "lins" else int(parts[0][3:])
+            if parts[-1] == "weight":
+                flat[f"params/lin{k}/kernel"] = _conv_kernel(value)
+            continue
+        if parts[0] == "net" or parts[0] == "features":
+            idx_pos = 2 if parts[0] == "net" else 1  # net.sliceS.<idx> / features.<idx>
+            idx = int(parts[idx_pos])
+            name = layer_map.get(idx)
+            if name is None:
+                raise KeyError(f"{key!r}: torchvision index {idx} is not a parametrized layer of {net_type}")
+            rest = parts[idx_pos + 1 : -1]  # e.g. [] for plain convs, ['squeeze'] for fire
+            param = parts[-1]
+            prefix = "/".join(["params", "net", name] + rest)
+            if param == "weight":
+                flat[f"{prefix}/kernel"] = _conv_kernel(value)
+            elif param == "bias":
+                flat[f"{prefix}/bias"] = _np(value)
+            else:
+                raise KeyError(f"Unrecognized tower entry {key!r}")
+            continue
+        raise KeyError(f"Unrecognized LPIPS entry {key!r}")
+    return flat
+
+
+def expected_lpips_keys(net_type: str) -> set:
+    """Every flat key a loadable LPIPS checkpoint must contain."""
+    keys = set()
+    for name in _LPIPS_LAYER_MAPS[net_type].values():
+        subs = ("squeeze", "expand1x1", "expand3x3") if name.startswith("fire") else ("",)
+        for sub in subs:
+            prefix = f"params/net/{name}" + (f"/{sub}" if sub else "")
+            keys.add(f"{prefix}/kernel")
+            keys.add(f"{prefix}/bias")
+    n_heads = 7 if net_type == "squeeze" else 5
+    keys.update(f"params/lin{k}/kernel" for k in range(n_heads))
+    return keys
+
+
+def validate_lpips_flat(net_type: str, flat: Dict[str, np.ndarray]) -> None:
+    """Fail fast (with the fix) instead of at load time.
+
+    No single cached artifact has everything: the ``lpips`` package's
+    ``weights/v0.1/{net}.pth`` holds only the lin heads, while torchvision
+    hub files hold only the tower — the CLI merges multiple inputs for
+    exactly this reason.
+    """
+    missing = expected_lpips_keys(net_type) - set(flat)
+    if missing:
+        tower_missing = sorted(k for k in missing if "/net/" in k)
+        head_missing = sorted(k for k in missing if "/lin" in k)
+        hint = []
+        if tower_missing:
+            hint.append(
+                f"{len(tower_missing)} tower entries (e.g. {tower_missing[0]}) — also pass the torchvision"
+                f" backbone checkpoint ({net_type} features)"
+            )
+        if head_missing:
+            hint.append(
+                f"{len(head_missing)} linear-head entries (e.g. {head_missing[0]}) — also pass the lpips"
+                f" package's weights/v0.1/{net_type}.pth"
+            )
+        raise ValueError("Converted LPIPS checkpoint is incomplete: missing " + "; ".join(hint))
+
+
+def save_flat_npz(flat: Dict[str, np.ndarray], path: str) -> None:
+    np.savez(path, **flat)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("kind", choices=["inception", "lpips-vgg", "lpips-alex", "lpips-squeeze"])
+    parser.add_argument(
+        "torch_checkpoints",
+        nargs="+",
+        help=".pt/.pth state-dict file(s); LPIPS usually needs TWO — the torchvision tower"
+        " checkpoint plus the lpips package's lin-head file — merged here",
+    )
+    parser.add_argument("out_npz", help="output .npz usable as weights_path=")
+    parser.add_argument(
+        "--allow-partial", action="store_true", help="skip the completeness check (LPIPS kinds only)"
+    )
+    args = parser.parse_args(argv)
+
+    import torch
+
+    flat: Dict[str, np.ndarray] = {}
+    for ckpt in args.torch_checkpoints:
+        sd = torch.load(ckpt, map_location="cpu", weights_only=True)
+        sd = sd.get("state_dict", sd) if isinstance(sd, dict) else sd
+        if args.kind == "inception":
+            flat.update(convert_inception_state_dict(sd))
+        else:
+            flat.update(convert_lpips_state_dict(args.kind.split("-")[1], sd))
+    if args.kind != "inception" and not args.allow_partial:
+        validate_lpips_flat(args.kind.split("-")[1], flat)
+    save_flat_npz(flat, args.out_npz)
+    print(f"wrote {len(flat)} arrays to {args.out_npz}")
+
+
+if __name__ == "__main__":
+    main()
