@@ -5,22 +5,15 @@ every benchmark therefore repeats its workload K times inside one jit and
 subtracts the measured null-dispatch round-trip (same approach as the
 top-level bench.py).
 """
-import os
 import time
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
 
-# Persistent XLA compilation cache: the big sort/segment kernels at 1M
-# samples cost minutes of compile on a cold process; cached executables cut
-# repeat bench runs to the actual device time.
-_CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu_xla")
-try:
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:  # older jax without the knob: cold compiles only
-    pass
+from metrics_tpu.utilities.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
 
 
 def measure_ms(run: Callable[[], jax.Array], k_repeats: int, n_timing: int = 12) -> float:

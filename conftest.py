@@ -13,6 +13,10 @@ the regular suite under ``tests/`` is unaffected.
 import doctest
 import re
 
+from metrics_tpu.utilities.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 _FLOAT_RE = re.compile(r"-?\d+\.\d*(?:[eE][+-]?\d+)?")
 
 

@@ -73,6 +73,13 @@ def make_step(
         ``init() -> state``, ``step(state, *batch) -> (state', value)``,
         ``compute(state) -> value`` — all pure and trace-safe.
 
+    Note:
+        For a ``lax.scan`` INSIDE ``shard_map``, cast the initial carry to
+        the sharded axis first — ``jax.lax.pcast(init(), ("dp",),
+        to="varying")`` — since the scanned updates are device-varying while
+        the fresh state is a replicated constant (``examples/sharded_eval.py``
+        shows the pattern).
+
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu import Accuracy

@@ -1,0 +1,20 @@
+"""Shared persistent-XLA-compile-cache setup.
+
+Benches, tests and doctests compile hundreds of programs — many small, a
+few (retrieval sort/segment at 1M docs, InceptionV3) taking minutes on a
+cold process. One cache dir serves them all; the threshold is low enough
+that the small doctest programs are cached too.
+"""
+import os
+
+CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu_xla")
+
+
+def enable_persistent_cache() -> None:
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:  # older jax without the knob: cold compiles only
+        pass

@@ -1,0 +1,142 @@
+"""Programmatic API-parity sweep against the reference source tree.
+
+AST-parses the reference (TorchMetrics v0.9.0dev) — it cannot be imported
+here (py3.12-incompatible deps) — and asserts that:
+
+* every symbol in its package ``__all__`` and ``functional.__all__`` exists
+  here (name-for-name),
+* every constructor keyword of every reference metric class exists on the
+  same-named class here (ours may add kwargs; dropping one fails),
+* every parameter of every public reference functional exists on ours.
+
+Skipped automatically when the reference tree is absent (CI); in the build
+environment it keeps the parity map honest after every change.
+"""
+import ast
+import inspect
+from pathlib import Path
+
+import pytest
+
+REF = Path("/root/reference")
+
+pytestmark = pytest.mark.skipif(not REF.exists(), reason="reference tree not available")
+
+# reference-only torch-isms with no TPU counterpart, plus symbols whose
+# kwargs are intentionally remapped (documented in docs/migration.md)
+_SKIP_KWARGS = {
+    "compute_on_step",  # deprecated no-op in the reference 0.9 line (accepted via **kwargs)
+}
+
+
+def _ref_all(path: Path):
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if getattr(tgt, "id", None) == "__all__":
+                    return [ast.literal_eval(elt) for elt in node.value.elts]
+    return []
+
+
+def _class_init_kwargs(tree: ast.Module, cls_name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+                    args = item.args
+                    names = [a.arg for a in args.args[1:] + args.kwonlyargs]
+                    return set(names)
+    return None
+
+
+def _function_params(tree: ast.Module, fn_name: str):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+            args = node.args
+            return set(a.arg for a in args.args + args.kwonlyargs)
+    return None
+
+
+@pytest.fixture(scope="module")
+def ref_sources():
+    sources = {}
+    for path in (REF / "torchmetrics").rglob("*.py"):
+        try:
+            sources[path] = ast.parse(path.read_text())
+        except SyntaxError:
+            pass
+    return sources
+
+
+def test_module_all_symbols_exist():
+    import metrics_tpu
+
+    ref_symbols = set(_ref_all(REF / "torchmetrics" / "__init__.py"))
+    ours = set(metrics_tpu.__all__)
+    missing = ref_symbols - ours
+    assert not missing, f"missing public symbols: {sorted(missing)}"
+
+
+def test_functional_all_symbols_exist():
+    import metrics_tpu.functional as F
+
+    ref_symbols = set(_ref_all(REF / "torchmetrics" / "functional" / "__init__.py"))
+    ours = set(F.__all__)
+    missing = ref_symbols - ours
+    assert not missing, f"missing functional symbols: {sorted(missing)}"
+
+
+def test_class_constructor_kwargs_superset(ref_sources):
+    import metrics_tpu
+
+    failures = []
+    for name in _ref_all(REF / "torchmetrics" / "__init__.py"):
+        ours = getattr(metrics_tpu, name, None)
+        if ours is None or not inspect.isclass(ours):
+            continue
+        ref_kwargs = None
+        for tree in ref_sources.values():
+            ref_kwargs = _class_init_kwargs(tree, name)
+            if ref_kwargs is not None:
+                break
+        if ref_kwargs is None:
+            continue
+        try:
+            sig = inspect.signature(ours.__init__)
+        except (TypeError, ValueError):
+            continue
+        # documented reference keywords must be explicit parameters here —
+        # a bare **kwargs swallowing them at call time doesn't count
+        our_params = set(sig.parameters)
+        missing = ref_kwargs - our_params - _SKIP_KWARGS
+        if missing:
+            failures.append(f"{name}: missing ctor kwargs {sorted(missing)}")
+    assert not failures, "\n".join(failures)
+
+
+def test_functional_params_superset(ref_sources):
+    import metrics_tpu.functional as F
+
+    failures = []
+    for name in _ref_all(REF / "torchmetrics" / "functional" / "__init__.py"):
+        ours = getattr(F, name, None)
+        if ours is None or not callable(ours):
+            continue
+        ref_params = None
+        for path, tree in ref_sources.items():
+            if "functional" not in str(path):
+                continue
+            ref_params = _function_params(tree, name)
+            if ref_params is not None:
+                break
+        if ref_params is None:
+            continue
+        try:
+            our_params = set(inspect.signature(ours).parameters)
+        except (TypeError, ValueError):
+            continue
+        missing = ref_params - our_params - _SKIP_KWARGS
+        if missing:
+            failures.append(f"{name}: missing params {sorted(missing)}")
+    assert not failures, "\n".join(failures)
