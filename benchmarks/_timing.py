@@ -1,38 +1,55 @@
-"""Shared timing for benchmarks: in-jit repetition + RTT subtraction.
+"""Shared timing for benchmarks: in-jit repetition + paired-K differencing.
 
-Tunneled TPU setups add ~65 ms of host<->device round-trip per dispatch;
-every benchmark therefore repeats its workload K times inside one jit and
-subtracts the measured null-dispatch round-trip (same approach as the
-top-level bench.py).
+Tunneled TPU setups add a host<->device round-trip per dispatch whose
+latency swings between ~20 us and ~90 ms phases (sometimes seconds). Every
+benchmark repeats its workload K times inside one jit and again at 2K; the
+estimator INTERLEAVES the K and 2K trials and differences each adjacent
+pair, so both sides of every difference see the same RTT phase and the
+dispatch cost cancels per pair. The smallest non-negative pair difference
+is the per-K estimate; if every pair is negative (phase noise exceeded the
+workload entirely), the measurement is reported as NaN rather than a
+fabricated number.
 """
+import math
 import time
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 
 from metrics_tpu.utilities.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
 
 
-def measure_ms(run: Callable[[], jax.Array], k_repeats: int, n_timing: int = 12) -> float:
-    """Wall-clock ms per repeat for ``run`` (a jitted thunk doing K repeats)."""
+def measure_ms(
+    run: Callable[[], jax.Array],
+    k_repeats: int,
+    n_timing: int = 8,
+    run_double: Callable[[], jax.Array] = None,
+) -> float:
+    """Wall-clock ms per repeat: interleaved ``(T(2K) - T(K)) / K`` pairs.
+
+    ``run`` executes the workload K times inside one jit, ``run_double`` the
+    same workload 2K times. Returns NaN when no pair produced a usable
+    difference (dispatch-phase noise larger than the whole workload).
+    """
+    if run_double is None:
+        raise TypeError("measure_ms requires run_double (the 2K-repeat thunk)")
     float(run())  # warmup + compile
-    times = []
+    float(run_double())
+    diffs = []
     for _ in range(n_timing):
         t0 = time.perf_counter()
         float(run())
-        times.append(time.perf_counter() - t0)
-    null = jax.jit(lambda x: x + 1.0)
-    float(null(jnp.zeros(())))
-    null_times = []
-    for _ in range(n_timing):
-        t0 = time.perf_counter()
-        float(null(jnp.zeros(())))
-        null_times.append(time.perf_counter() - t0)
-    rtt = min(null_times)
-    best = min(times)
-    if rtt >= best:
-        rtt = 0.0
-    return (best - rtt) / k_repeats * 1000.0
+        t1 = time.perf_counter()
+        float(run_double())
+        t2 = time.perf_counter()
+        diffs.append((t2 - t1) - (t1 - t0))
+    usable = sorted(d for d in diffs if d > 0)
+    # consistency gate: trust the estimate only when the two smallest
+    # positive pairs agree within 2x — random noise differences are
+    # continuous and almost never produce two small near-equal positives,
+    # while genuine workload differences cluster tightly
+    if len(usable) < 2 or usable[1] > 2.0 * usable[0]:
+        return math.nan
+    return usable[0] / k_repeats * 1000.0

@@ -10,7 +10,7 @@ from metrics_tpu.functional.classification.auroc import _auroc_compute
 from metrics_tpu.utilities.enums import DataType
 from metrics_tpu.ops import binned_counts
 
-N, T, K = 1_000_000, 100, 10
+N, T, K = 1_000_000, 100, 100  # K large enough that K epochs >> one dispatch RTT
 
 
 def measure() -> dict:
@@ -21,27 +21,31 @@ def measure() -> dict:
     # sort-based compute kernel itself
     exact = jax.jit(lambda p, t: _auroc_compute(p, t, DataType.BINARY, pos_label=1))
 
-    @jax.jit
-    def run_exact(preds=preds, target=target):
-        def body(i, acc):
-            return acc + exact(preds + 0.0001 * i, target)
-        return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+    def make_exact(k):
+        @jax.jit
+        def run(preds=preds, target=target):
+            def body(i, acc):
+                return acc + exact(preds + 0.0001 * i, target)
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
 
     out = {}
-    out["auroc_exact_1M_compute"] = measure_ms(run_exact, K)
+    out["auroc_exact_1M_compute"] = measure_ms(make_exact(K), K, run_double=make_exact(2 * K))
 
     thresholds = jnp.linspace(0, 1.0, T)
 
-    @jax.jit
-    def run_binned(preds=preds, target=target):
-        def body(i, acc):
-            tps, fps, fns = binned_counts(
-                (preds + 0.0001 * i).reshape(-1, 1), target.reshape(-1, 1), thresholds
-            )
-            return acc + tps.sum()
-        return jax.lax.fori_loop(0, K, body, jnp.zeros(()))
+    def make_binned(k):
+        @jax.jit
+        def run(preds=preds, target=target):
+            def body(i, acc):
+                tps, fps, fns = binned_counts(
+                    (preds + 0.0001 * i).reshape(-1, 1), target.reshape(-1, 1), thresholds
+                )
+                return acc + tps.sum()
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
 
-    out["binned_counts_1M_T100_update"] = measure_ms(run_binned, K)
+    out["binned_counts_1M_T100_update"] = measure_ms(make_binned(K), K, run_double=make_binned(2 * K))
     return out
 
 

@@ -1,0 +1,84 @@
+"""LPIPS forward and BERTScore greedy-matching benches (BASELINE.md configs).
+
+LPIPS: the in-repo Flax AlexNet tower + heads, one jitted two-tower
+program on (32, 3, 64, 64) image pairs. BERTScore: the device-side scoring
+kernel (`_bert_score_kernel`: normalize -> mask -> (B, S, S) cosine matrix
+-> greedy match -> P/R/F1) on (256, 128, 256) padded embeddings — the part
+of the metric the reference runs as eager torch ops
+(``functional/text/bert.py:327-360``); the encoder forward is model-bound
+and benched separately by its owner.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._timing import measure_ms
+
+LPIPS_SHAPE = (32, 3, 64, 64)
+BS_B, BS_S, BS_D = 256, 128, 256
+K_LPIPS = 100  # ~3 ms/forward: K must swamp even second-scale RTT spikes
+K_BS = 200
+
+
+def measure_lpips() -> float:
+    from metrics_tpu.image.backbones import NoTrainLpips
+
+    net = NoTrainLpips("alex", rng_seed=0)
+    a = jax.random.uniform(jax.random.PRNGKey(0), LPIPS_SHAPE, minval=-1, maxval=1)
+    b = jax.random.uniform(jax.random.PRNGKey(1), LPIPS_SHAPE, minval=-1, maxval=1)
+
+    from metrics_tpu.image.backbones.lpips_nets import _lpips_forward
+
+    def make_run(k):
+        @jax.jit
+        def run(a=a, b=b):
+            def body(i, acc):
+                # scale BOTH inputs so neither tower is loop-invariant
+                # (XLA would hoist a constant tower out of the loop)
+                scale = 1.0 - 0.0001 * i.astype(jnp.float32)
+                return acc + _lpips_forward(net.module, net.variables, a * scale, b * scale).sum()
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
+
+    return measure_ms(make_run(K_LPIPS), K_LPIPS, run_double=make_run(2 * K_LPIPS))
+
+
+def measure_bertscore() -> float:
+    from metrics_tpu.functional.text.bert import _bert_score_kernel
+
+    emb_p = jax.random.normal(jax.random.PRNGKey(0), (BS_B, BS_S, BS_D))
+    emb_t = jax.random.normal(jax.random.PRNGKey(1), (BS_B, BS_S, BS_D))
+    mask = jnp.ones((BS_B, BS_S), jnp.float32)
+    idf_w = jnp.ones((BS_B, BS_S), jnp.float32)
+
+    def make_run(k):
+        @jax.jit
+        def run(emb_p=emb_p, emb_t=emb_t):
+            def body(i, acc):
+                p, r, f1 = _bert_score_kernel(
+                    emb_p * (1.0 + 0.0001 * i), mask, idf_w, emb_t, mask, idf_w, idf=True
+                )
+                return acc + f1.sum()
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
+
+    return measure_ms(make_run(K_BS), K_BS, run_double=make_run(2 * K_BS))
+
+
+def measure() -> dict:
+    return {
+        "lpips_alex_32x64x64_forward": measure_lpips(),
+        "bertscore_match_256x128x256": measure_bertscore(),
+    }
+
+
+def main() -> None:
+    for name, ms in measure().items():
+        print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
+
+
+if __name__ == "__main__":
+    main()
