@@ -39,7 +39,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.distributed import sync_reduce_in_context
+from metrics_tpu.utilities.distributed import sync_buffer_in_context, sync_reduce_in_context
 
 Array = jax.Array
 State = Dict[str, Any]
@@ -176,11 +176,12 @@ def make_step(
             reduced: State = {}
             for name, value in state.items():
                 if isinstance(value, CapacityBuffer):
-                    raise ValueError(
-                        f"State {name!r} is a CapacityBuffer; in-jit mesh reduction of sample buffers is"
-                        " not supported — gather on host (metric.sync()) or shard the compute itself."
-                    )
-                reduced[name] = sync_reduce_in_context(value, template._reductions[name], axis_name)
+                    # in-graph uneven cat-state gather (reference
+                    # utilities/distributed.py:128-151): gather data + count
+                    # per device, concat the filled prefixes
+                    reduced[name] = sync_buffer_in_context(value, axis_name)
+                else:
+                    reduced[name] = sync_reduce_in_context(value, template._reductions[name], axis_name)
             state = reduced
         m = _load(state)
         m._update_count = 1  # state arrived from outside; silence the unused-metric warning

@@ -73,8 +73,35 @@ class CapacityBuffer:
 
     def _concrete_count(self) -> int:
         if self._host_count is None:
+            if isinstance(self.count, jax.core.Tracer):
+                raise ValueError(
+                    "CapacityBuffer fill count is a tracer (the state crossed a lax.scan carry or jit"
+                    " boundary), so the filled prefix has no static shape. Either keep init/step/compute"
+                    " in one traced program with unrolled steps, or restore the known total with"
+                    " `buffer.declare_count(n)` after the scan."
+                )
             self._host_count = int(self.count)  # one sync, then cached
         return self._host_count
+
+    def declare_count(self, n: int) -> "CapacityBuffer":
+        """Assert the fill count after it was lost to a scan/jit boundary.
+
+        A ``lax.scan`` carry re-enters as tracers, dropping the trace-time
+        host mirror even though the caller usually knows the exact fill of
+        THIS buffer (``n_batches * batch_size``; under ``shard_map`` that is
+        the PER-DEVICE count — the per-shard batch size times steps — since
+        the mesh sync multiplies by the axis size when merging). Declaring
+        it restores the static filled-prefix shape so ``materialize`` (and
+        any downstream exact compute) works inside the same traced program.
+        The caller owns the assertion's correctness.
+        """
+        n = int(n)
+        if not 0 <= n <= self.capacity:
+            raise ValueError(f"declared count {n} outside [0, capacity={self.capacity}]")
+        self._host_count = n
+        if not isinstance(self.count, jax.core.Tracer):
+            self.count = jnp.asarray(n, dtype=jnp.int32)
+        return self
 
     def materialize(self) -> Array:
         """The filled prefix ``data[:count]`` (eager; count must be concrete)."""

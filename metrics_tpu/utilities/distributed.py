@@ -101,6 +101,62 @@ def _all_gather_replicated(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> 
     return lax.psum(padded, axis_name)
 
 
+def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]]) -> Any:
+    """Merge per-device :class:`CapacityBuffer` sample states inside shard_map.
+
+    The in-graph analogue of the reference's uneven cat-state gather
+    (``torchmetrics/utilities/distributed.py:128-151``): all-gather each
+    device's ``(capacity, *item)`` buffer plus its fill count, then
+    concatenate the filled prefixes into one merged buffer of capacity
+    ``n_devices * capacity``.
+
+    Two regimes:
+
+    * **static counts** (the fill count's trace-time host mirror survived —
+      true whenever ``init``/``step``/``compute`` run in ONE traced program
+      with unrolled steps, since SPMD gives every device the same static
+      count): the filled prefixes are sliced and reshaped directly; the
+      merged buffer keeps a static count, so any downstream ``compute``
+      (exact AUROC sort, retrieval segmentation) runs unmodified.
+    * **traced counts** (state crossed a ``lax.scan`` carry or jit boundary):
+      a masked scatter-concat — slot ``j`` of device ``d`` lands at
+      ``cumsum(counts)[d-1] + j`` when ``j < counts[d]``, out-of-bounds
+      (dropped) otherwise. The merged count is traced; consumers either need
+      a mask-aware compute or must restore the known total via
+      ``CapacityBuffer.declare_count``.
+    """
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+
+    n = lax.axis_size(axis_name)
+    cap = buf.capacity
+    merged = CapacityBuffer(n * cap, buf.dtype)
+    if buf.data is None:  # SPMD symmetry: no device appended anything
+        return merged
+    item_shape = buf.data.shape[1:]
+    if buf._host_count is not None:
+        # static count: gather only the filled prefix — the collective moves
+        # n*c rows, not n*capacity
+        c = buf._host_count
+        filled = _all_gather_replicated(buf.data[:c], axis_name).reshape((n * c,) + item_shape)
+        merged.data = jnp.zeros((n * cap,) + item_shape, buf.data.dtype).at[: n * c].set(filled)
+        merged.count = jnp.asarray(n * c, jnp.int32)
+        merged._host_count = n * c
+        return merged
+    data = _all_gather_replicated(buf.data, axis_name)  # (n, cap, *item)
+    counts = _all_gather_replicated(buf.count, axis_name)  # (n,)
+    offsets = jnp.cumsum(counts) - counts
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    pos = jnp.where(slot[None, :] < counts[:, None], offsets[:, None] + slot[None, :], n * cap)
+    merged.data = (
+        jnp.zeros((n * cap,) + item_shape, buf.data.dtype)
+        .at[pos.reshape(-1)]
+        .set(data.reshape((n * cap,) + item_shape), mode="drop")
+    )
+    merged.count = counts.sum().astype(jnp.int32)
+    merged._host_count = None
+    return merged
+
+
 # ---------------------------------------------------------------------------
 # Eager cross-process gather (DCN / multi-host, host-side states)
 # ---------------------------------------------------------------------------

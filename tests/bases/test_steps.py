@@ -160,8 +160,82 @@ class TestStaticShapeContract:
         eager.update(jnp.asarray(np.concatenate(all_p)), jnp.asarray(np.concatenate(all_t)))
         np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-6)
 
-    def test_capacity_buffer_mesh_reduce_rejected(self):
+    def test_capacity_buffer_mesh_parity(self):
+        """Exact AUROC with sample buffers inside ONE shard_map program.
+
+        The in-graph analogue of the reference's uneven cat-state gather
+        (``torchmetrics/utilities/distributed.py:128-151``): each device
+        fills a local CapacityBuffer, compute gathers data + counts over the
+        mesh and concatenates the filled prefixes, then runs the exact sort
+        on the merged samples. Parity target: the eager class on the full
+        unsharded data.
+        """
+        rng = np.random.default_rng(5)
+        preds = jnp.asarray(rng.random(256).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, (256,)))
         init, step, compute = make_step(AUROC, sample_capacity=64, axis_name="dp")
-        state, _ = step(init(), jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
-        with pytest.raises(ValueError, match="CapacityBuffer"):
-            compute(state)
+
+        def prog(p, t):
+            # two unrolled steps: trace-time fill counts stay static
+            state, _ = step(init(), p[: p.shape[0] // 2], t[: t.shape[0] // 2])
+            state, _ = step(state, p[p.shape[0] // 2 :], t[t.shape[0] // 2 :])
+            return compute(state)
+
+        out = jax.jit(jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P()))(
+            preds, target
+        )
+        eager = AUROC()
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(out), float(eager.compute()), atol=1e-6)
+
+    def test_capacity_buffer_scan_declare_count(self):
+        """lax.scan epoch over sample buffers: declare_count restores the
+        static filled-prefix shape the scan carry erased, so the exact
+        compute still runs inside the same program."""
+        rng = np.random.default_rng(6)
+        n_batches, per_dev = 4, 16
+        preds = jnp.asarray(rng.random((n_batches, 8 * per_dev)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, (n_batches, 8 * per_dev)))
+        init, step, compute = make_step(AUROC, sample_capacity=n_batches * per_dev, axis_name="dp")
+
+        def prog(p, t):
+            # first step unrolled (allocates the buffers, fixing the carry
+            # pytree structure), remaining batches scanned
+            state, _ = step(init(), p[0], t[0])  # state is dp-varying from the sharded batch
+            state, _ = jax.lax.scan(lambda s, b: step(s, *b), state, (p[1:], t[1:]))
+            for buf in state.values():
+                buf.declare_count(n_batches * per_dev)
+            return compute(state)
+
+        out = jax.jit(
+            jax.shard_map(prog, mesh=_mesh(), in_specs=(P(None, "dp"), P(None, "dp")), out_specs=P())
+        )(preds, target)
+        eager = AUROC()
+        eager.update(preds.reshape(-1), target.reshape(-1))
+        np.testing.assert_allclose(float(out), float(eager.compute()), atol=1e-6)
+
+    def test_sync_buffer_uneven_traced_counts(self):
+        """The masked scatter-concat handles traced, uneven per-device counts
+        (the general regime after a jit/scan boundary)."""
+        from metrics_tpu.utilities.buffers import CapacityBuffer
+        from metrics_tpu.utilities.distributed import sync_buffer_in_context
+
+        cap = 8
+        counts = jnp.asarray([3, 0, 8, 1, 5, 2, 7, 4], dtype=jnp.int32)
+        values = jnp.arange(8 * cap, dtype=jnp.float32).reshape(8, cap)
+
+        def prog(count, vals):
+            buf = CapacityBuffer(cap)
+            buf.append(vals.reshape(cap))
+            buf.count = count.reshape(())  # simulate a post-boundary traced count
+            buf._host_count = None
+            merged = sync_buffer_in_context(buf, "dp")
+            return merged.data, merged.count
+
+        data, total = jax.jit(
+            jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=(P(), P()))
+        )(counts, values)
+        expected = np.concatenate([np.asarray(values)[d, : int(counts[d])] for d in range(8)])
+        assert int(total) == int(counts.sum())
+        np.testing.assert_allclose(np.asarray(data)[: int(total)], expected)
+        np.testing.assert_allclose(np.asarray(data)[int(total) :], 0.0)
