@@ -144,6 +144,10 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]]) -> 
         return merged
     data = _all_gather_replicated(buf.data, axis_name)  # (n, cap, *item)
     counts = _all_gather_replicated(buf.count, axis_name)  # (n,)
+    # a traced overflow (append past capacity inside a scan) leaves count >
+    # capacity while the data writes were clamped; clamp here too so the
+    # merge stays dense (no phantom zero rows) and the total stays honest
+    counts = jnp.minimum(counts, cap)
     offsets = jnp.cumsum(counts) - counts
     slot = jnp.arange(cap, dtype=jnp.int32)
     pos = jnp.where(slot[None, :] < counts[:, None], offsets[:, None] + slot[None, :], n * cap)

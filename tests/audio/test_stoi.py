@@ -1,0 +1,113 @@
+"""STOI wrapper tests.
+
+Mirrors reference ``tests/audio/test_stoi.py`` (pinned against ``pystoi``,
+skipped when absent) plus an offline mock-backend battery for the
+batching/reshape/accumulation wrapper logic this repo owns.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.audio.stoi as stoi_class_mod
+import metrics_tpu.functional.audio.stoi as stoi_fn_mod
+from metrics_tpu import ShortTimeObjectiveIntelligibility
+from metrics_tpu.functional import short_time_objective_intelligibility
+
+_PYSTOI_INSTALLED = stoi_fn_mod._PYSTOI_AVAILABLE
+
+
+def _fake_stoi_score(ref, deg, fs, extended=False):
+    """Deterministic stand-in: a smooth function of both signals in [-1, 1]."""
+    ref = np.asarray(ref, dtype=np.float64)
+    deg = np.asarray(deg, dtype=np.float64)
+    return float(np.tanh((ref * deg).mean() + (0.1 if extended else 0.0) + 1e-5 * fs))
+
+
+@pytest.fixture()
+def mock_stoi(monkeypatch):
+    fake = types.ModuleType("pystoi")
+    fake.stoi = _fake_stoi_score
+    monkeypatch.setitem(sys.modules, "pystoi", fake)
+    monkeypatch.setattr(stoi_fn_mod, "_PYSTOI_AVAILABLE", True)
+    monkeypatch.setattr(stoi_class_mod, "_PYSTOI_AVAILABLE", True)
+    return fake
+
+
+class TestStoiWrapperMocked:
+    def test_single_signal_returns_scalar(self, mock_stoi):
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.normal(0, 1, 8000).astype(np.float32))
+        t = jnp.asarray(rng.normal(0, 1, 8000).astype(np.float32))
+        out = short_time_objective_intelligibility(p, t, 8000)
+        assert out.shape == ()
+        expected = _fake_stoi_score(np.asarray(t, np.float64), np.asarray(p, np.float64), 8000)
+        np.testing.assert_allclose(float(out), expected, rtol=1e-6)
+
+    @pytest.mark.parametrize("shape", [(3, 8000), (2, 3, 8000)])
+    @pytest.mark.parametrize("extended", [False, True])
+    def test_batch_reshape(self, mock_stoi, shape, extended):
+        rng = np.random.default_rng(1)
+        p = rng.normal(0, 1, shape).astype(np.float32)
+        t = rng.normal(0, 1, shape).astype(np.float32)
+        out = short_time_objective_intelligibility(
+            jnp.asarray(p), jnp.asarray(t), 16000, extended=extended
+        )
+        assert out.shape == shape[:-1]
+        flat_p = p.astype(np.float64).reshape(-1, shape[-1])
+        flat_t = t.astype(np.float64).reshape(-1, shape[-1])
+        expected = np.asarray(
+            [_fake_stoi_score(ft, fp, 16000, extended) for ft, fp in zip(flat_t, flat_p)]
+        ).reshape(shape[:-1])
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+
+    def test_class_accumulates_mean(self, mock_stoi):
+        rng = np.random.default_rng(2)
+        metric = ShortTimeObjectiveIntelligibility(8000)
+        all_scores = []
+        for _ in range(3):
+            p = rng.normal(0, 1, (2, 8000)).astype(np.float32)
+            t = rng.normal(0, 1, (2, 8000)).astype(np.float32)
+            metric.update(jnp.asarray(p), jnp.asarray(t))
+            all_scores += [
+                _fake_stoi_score(tt.astype(np.float64), pp.astype(np.float64), 8000)
+                for tt, pp in zip(t, p)
+            ]
+        np.testing.assert_allclose(float(metric.compute()), np.mean(all_scores), rtol=1e-6)
+
+    def test_shape_mismatch_raises(self, mock_stoi):
+        with pytest.raises(RuntimeError, match="same shape"):
+            short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(4000), 8000)
+
+
+def test_missing_backend_error_message():
+    """The install hint must name a real extra (pyproject declares [audio])."""
+    if _PYSTOI_INSTALLED:
+        pytest.skip("pystoi installed; error path unreachable")
+    with pytest.raises(ModuleNotFoundError, match=r"metrics-tpu\[audio\]"):
+        short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(8000), 8000)
+    with pytest.raises(ModuleNotFoundError, match=r"metrics-tpu\[audio\]"):
+        ShortTimeObjectiveIntelligibility(8000)
+
+
+@pytest.mark.skipif(not _PYSTOI_INSTALLED, reason="pystoi package not installed")
+class TestStoiRealBackend:
+    """Reference-style pinning against the real pystoi implementation
+    (``/root/reference/tests/audio/test_stoi.py``)."""
+
+    @pytest.mark.parametrize("extended", [False, True])
+    def test_matches_backend_directly(self, extended):
+        import pystoi
+
+        rng = np.random.default_rng(3)
+        p = rng.normal(0, 1, (2, 8000)).astype(np.float32)
+        t = rng.normal(0, 1, (2, 8000)).astype(np.float32)
+        out = short_time_objective_intelligibility(jnp.asarray(p), jnp.asarray(t), 8000, extended)
+        expected = [
+            pystoi.stoi(tt.astype(np.float64), pp.astype(np.float64), 8000, extended=extended)
+            for tt, pp in zip(t, p)
+        ]
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4)
