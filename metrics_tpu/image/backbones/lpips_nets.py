@@ -35,7 +35,14 @@ def _conv(features: int, kernel: int, stride: int = 1, pad: int = None, name: st
     return nn.Conv(features, (kernel, kernel), strides=(stride, stride), padding=((pad, pad), (pad, pad)), name=name)
 
 
-def _max_pool(x: Array, kernel: int = 2, stride: int = 2) -> Array:
+def _max_pool(x: Array, kernel: int = 2, stride: int = 2, ceil_mode: bool = False) -> Array:
+    if ceil_mode:
+        # torch MaxPool2d(ceil_mode=True) semantics: when (dim - kernel) is
+        # not a stride multiple, one extra window starting inside the input
+        # is emitted; high-side -inf padding (nn.max_pool's pad value)
+        # reproduces it exactly since max ignores the padded cells
+        pads = tuple((0, (stride - (size - kernel) % stride) % stride) for size in x.shape[1:3])
+        return nn.max_pool(x, (kernel, kernel), strides=(stride, stride), padding=pads)
     return nn.max_pool(x, (kernel, kernel), strides=(stride, stride))
 
 
@@ -81,18 +88,22 @@ class _Fire(nn.Module):
 
 
 class _SqueezeNetSlices(nn.Module):
-    """SqueezeNet 1.1 conv stack, returning the 7 taps used by LPIPS."""
+    """SqueezeNet 1.1 conv stack, returning the 7 taps used by LPIPS.
+
+    torchvision's SqueezeNet 1.1 pools with ``MaxPool2d(3, 2,
+    ceil_mode=True)``, so odd-sized feature maps keep the extra edge window.
+    """
 
     @nn.compact
     def __call__(self, x: Array) -> Tuple[Array, ...]:
         r1 = nn.relu(_conv(64, 3, stride=2, pad=0, name="conv1")(x))
-        x = _max_pool(r1, 3, 2)
+        x = _max_pool(r1, 3, 2, ceil_mode=True)
         x = _Fire(16, 64, name="fire2")(x)
         r2 = _Fire(16, 64, name="fire3")(x)
-        x = _max_pool(r2, 3, 2)
+        x = _max_pool(r2, 3, 2, ceil_mode=True)
         x = _Fire(32, 128, name="fire4")(x)
         r3 = _Fire(32, 128, name="fire5")(x)
-        x = _max_pool(r3, 3, 2)
+        x = _max_pool(r3, 3, 2, ceil_mode=True)
         r4 = _Fire(48, 192, name="fire6")(x)
         r5 = _Fire(48, 192, name="fire7")(r4)
         r6 = _Fire(64, 256, name="fire8")(r5)

@@ -52,10 +52,27 @@ def _safe_to_load(path: Path) -> bool:
         return True
 
 
+def _build_timeout() -> float:
+    """Compile timeout: a 44-line TU builds in seconds, but a loaded host or
+    cold NFS cache can stall a legitimate gcc run far longer — default
+    generous, overridable via METRICS_TPU_NATIVE_BUILD_TIMEOUT."""
+    raw = os.environ.get("METRICS_TPU_NATIVE_BUILD_TIMEOUT", "")
+    try:
+        value = float(raw)
+        if value > 0:
+            return value
+        _info(f"ignoring non-positive METRICS_TPU_NATIVE_BUILD_TIMEOUT={raw!r}; using 60s")
+    except ValueError:
+        if raw:
+            _info(f"ignoring malformed METRICS_TPU_NATIVE_BUILD_TIMEOUT={raw!r}; using 60s")
+    return 60.0
+
+
 def _compile(src: Path) -> Optional[Path]:
     """cc -O2 -shared -fPIC src -> content-addressed .so, atomically."""
     tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
     name = f"{src.stem}-{tag}.so"
+    timeout_s = _build_timeout()
     for out_dir in _cache_dirs():
         so = out_dir / name
         if so.exists() and _safe_to_load(so):
@@ -74,12 +91,12 @@ def _compile(src: Path) -> Optional[Path]:
             os.close(fd)
             try:
                 # announce the build so a hung compiler/NFS cache stall is
-                # attributable; a 44-line TU compiles in well under 20 s
+                # attributable
                 _info(f"compiling native kernel {src.name} with {cc} -> {so}")
                 res = subprocess.run(
                     [cc, "-O2", "-shared", "-fPIC", "-o", tmp, str(src)],
                     capture_output=True,
-                    timeout=20,
+                    timeout=timeout_s,
                 )
                 if res.returncode == 0:
                     os.replace(tmp, so)
@@ -88,7 +105,7 @@ def _compile(src: Path) -> Optional[Path]:
             except FileNotFoundError:
                 pass
             except subprocess.TimeoutExpired:
-                _info(f"native kernel build with {cc} timed out after 20s; trying next compiler")
+                _info(f"native kernel build with {cc} timed out after {timeout_s:g}s; trying next compiler")
             finally:
                 if os.path.exists(tmp):
                     os.unlink(tmp)

@@ -83,7 +83,7 @@ class TestPesqWrapperMocked:
                 jnp.zeros(8000), jnp.zeros(4000), 8000, "nb"
             )
 
-    @pytest.mark.parametrize("fs,mode", [(441000, "nb"), (8000, "xb")])
+    @pytest.mark.parametrize("fs,mode", [(44100, "nb"), (8000, "xb")])
     def test_bad_arguments(self, mock_pesq, fs, mode):
         with pytest.raises(ValueError, match="Expected argument"):
             perceptual_evaluation_speech_quality(jnp.zeros(8000), jnp.zeros(8000), fs, mode)

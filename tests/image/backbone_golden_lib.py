@@ -1,0 +1,141 @@
+"""Deterministic weight/input builders for the backbone golden fixtures.
+
+The pretrained Inception/LPIPS checkpoints cannot be downloaded in every
+environment, so the end-to-end pin works like the mAP goldens
+(``test_map_golden.py``): fixed, reproducible inputs go through an
+INDEPENDENT torch replica of the published pipeline once
+(``generate_backbone_goldens.py``), and the committed outputs become the
+oracle the Flax backbones must reproduce — through the real
+``weights_path`` converter path, so layout transposition, padding/pooling
+semantics (incl. SqueezeNet's ceil_mode), BN epsilon and tap plumbing are
+all pinned cross-framework.
+
+Weights are derived per-parameter from ``crc32(name)``-seeded numpy RNGs:
+both sides rebuild bit-identical torch-layout state dicts with no torch /
+jax dependency in this module.
+"""
+import zlib
+from typing import Dict
+
+import numpy as np
+
+GOLDEN_PATH = "backbone_goldens.npz"  # relative to tests/image/
+
+# fixed input sizes; 35 is odd on purpose (exercises ceil_mode pooling)
+INCEPTION_INPUT_SHAPE = (2, 3, 75, 75)
+LPIPS_INPUT_SHAPE = (2, 3, 35, 35)
+
+# (torch state-dict key prefix, (out, in, kh, kw)) per LPIPS tower, in
+# forward order; torchvision `features.{idx}` naming (the converter's
+# bare-backbone form)
+_VGG_WIDTHS = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+_VGG_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_ALEX_SHAPES = ((64, 3, 11, 11), (192, 64, 5, 5), (384, 192, 3, 3), (256, 384, 3, 3), (256, 256, 3, 3))
+_ALEX_IDX = (0, 3, 6, 8, 10)
+# squeeze 1.1: (features idx, squeeze planes, expand planes, input channels)
+_SQUEEZE_FIRES = ((3, 16, 64, 64), (4, 16, 64, 128), (6, 32, 128, 128), (7, 32, 128, 256),
+                  (9, 48, 192, 256), (10, 48, 192, 384), (11, 64, 256, 384), (12, 64, 256, 512))
+
+LPIPS_HEAD_CHANNELS = {
+    "vgg": (64, 128, 256, 512, 512),
+    "alex": (64, 192, 384, 256, 256),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
+
+
+def _arr(name: str, shape, kind: str) -> np.ndarray:
+    """Deterministic values per parameter name (order-independent)."""
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    if kind in ("var", "scale"):
+        return (rng.random(shape) * 0.5 + 0.75).astype(np.float32)
+    if kind in ("mean", "bias"):
+        return (rng.standard_normal(shape) * 0.1).astype(np.float32)
+    if kind == "head":  # LPIPS lin heads are non-negative in the pretrained nets
+        return rng.random(shape).astype(np.float32)
+    fan_in = int(np.prod(shape[1:])) or 1
+    return (rng.standard_normal(shape) * np.sqrt(2.0 / fan_in)).astype(np.float32)
+
+
+def golden_input(shape) -> np.ndarray:
+    """Smooth deterministic image batch in [-1, 1] (NCHW float32)."""
+    n, c, h, w = shape
+    ii = np.arange(h, dtype=np.float64)[:, None]
+    jj = np.arange(w, dtype=np.float64)[None, :]
+    imgs = [
+        np.sin(0.37 * ii * (k + 1) / c + 0.23 * jj + 1.7 * b) * np.cos(0.11 * jj * (k + 1) - 0.5 * b)
+        for b in range(n)
+        for k in range(c)
+    ]
+    return np.stack(imgs).reshape(n, c, h, w).astype(np.float32)
+
+
+def lpips_torch_state_dict(net_type: str) -> Dict[str, np.ndarray]:
+    """torch-layout LPIPS state dict (tower features.* + lin heads)."""
+    sd: Dict[str, np.ndarray] = {}
+    if net_type == "vgg":
+        shapes = []
+        cin = 3
+        for width, n_convs in _VGG_WIDTHS:
+            for _ in range(n_convs):
+                shapes.append((width, cin, 3, 3))
+                cin = width
+        for idx, shp in zip(_VGG_IDX, shapes):
+            sd[f"features.{idx}.weight"] = _arr(f"vgg/{idx}/w", shp, "conv")
+            sd[f"features.{idx}.bias"] = _arr(f"vgg/{idx}/b", (shp[0],), "bias")
+    elif net_type == "alex":
+        for idx, shp in zip(_ALEX_IDX, _ALEX_SHAPES):
+            sd[f"features.{idx}.weight"] = _arr(f"alex/{idx}/w", shp, "conv")
+            sd[f"features.{idx}.bias"] = _arr(f"alex/{idx}/b", (shp[0],), "bias")
+    elif net_type == "squeeze":
+        sd["features.0.weight"] = _arr("squeeze/0/w", (64, 3, 3, 3), "conv")
+        sd["features.0.bias"] = _arr("squeeze/0/b", (64,), "bias")
+        for idx, s, e, cin in _SQUEEZE_FIRES:
+            sd[f"features.{idx}.squeeze.weight"] = _arr(f"squeeze/{idx}/s/w", (s, cin, 1, 1), "conv")
+            sd[f"features.{idx}.squeeze.bias"] = _arr(f"squeeze/{idx}/s/b", (s,), "bias")
+            sd[f"features.{idx}.expand1x1.weight"] = _arr(f"squeeze/{idx}/e1/w", (e, s, 1, 1), "conv")
+            sd[f"features.{idx}.expand1x1.bias"] = _arr(f"squeeze/{idx}/e1/b", (e,), "bias")
+            sd[f"features.{idx}.expand3x3.weight"] = _arr(f"squeeze/{idx}/e3/w", (e, s, 3, 3), "conv")
+            sd[f"features.{idx}.expand3x3.bias"] = _arr(f"squeeze/{idx}/e3/b", (e,), "bias")
+    else:
+        raise ValueError(net_type)
+    for k, c in enumerate(LPIPS_HEAD_CHANNELS[net_type]):
+        sd[f"lin{k}.model.1.weight"] = _arr(f"{net_type}/lin{k}", (1, c, 1, 1), "head")
+    return sd
+
+
+def inception_torch_state_dict() -> Dict[str, np.ndarray]:
+    """torch-fidelity-layout FID InceptionV3 state dict.
+
+    Shapes come from the Flax tree (a wrong shape cannot pass silently —
+    the torch conv in the generator would reject it); values are pure
+    numpy, keyed by the torch parameter name.
+    """
+    import jax
+
+    from metrics_tpu.image.backbones.inception import FIDInceptionV3
+
+    module = FIDInceptionV3(features_list=("64", "192", "768", "2048", "logits"))
+    shapes = jax.eval_shape(
+        module.init, jax.random.PRNGKey(0), jax.ShapeDtypeStruct((1, 75, 75, 3), np.float32)
+    )
+    sd: Dict[str, np.ndarray] = {}
+    for pathkey, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
+        parts = [str(getattr(p, "key", p)) for p in pathkey]
+        if parts[-1] == "fc_kernel":
+            sd["fc.weight"] = _arr("fc.weight", (leaf.shape[1], leaf.shape[0]), "conv")
+        elif parts[-1] == "fc_bias":
+            sd["fc.bias"] = _arr("fc.bias", leaf.shape, "bias")
+        elif parts[-2] == "conv":  # kernel (kh, kw, I, O) -> torch (O, I, kh, kw)
+            name = ".".join(parts[1:-1]) + ".weight"
+            kh, kw, ci, co = leaf.shape
+            sd[name] = _arr(name, (co, ci, kh, kw), "conv")
+        elif parts[-2] == "bn":
+            kind = {"scale": "scale", "bias": "bias", "mean": "mean", "var": "var"}[parts[-1]]
+            torch_param = {"scale": "weight", "bias": "bias", "mean": "running_mean", "var": "running_var"}[
+                parts[-1]
+            ]
+            name = ".".join(parts[1:-1]) + "." + torch_param
+            sd[name] = _arr(name, leaf.shape, kind)
+        else:
+            raise AssertionError(parts)
+    return sd

@@ -1,0 +1,246 @@
+"""Generate the committed backbone golden fixtures (run once, offline).
+
+Forwards the deterministic state dicts from ``backbone_golden_lib`` through
+an independent TORCH replica of the published pipelines — torch-fidelity's
+FID InceptionV3 (conv+BN(eps=1e-3)+relu blocks, count_include_pad=False avg
+pools, the Mixed block topology) and ``lpips.LPIPS`` (scaling layer,
+torchvision towers incl. SqueezeNet 1.1's ceil_mode pooling, unit-normalize,
+1x1 heads) — and writes the tap outputs / distances to
+``backbone_goldens.npz``. ``test_backbone_golden.py`` then requires the Flax
+backbones, loaded through the real ``weights_path`` converter, to reproduce
+these numbers.
+
+Usage: ``python tests/image/generate_backbone_goldens.py``
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from tests.image.backbone_golden_lib import (
+    GOLDEN_PATH,
+    INCEPTION_INPUT_SHAPE,
+    LPIPS_INPUT_SHAPE,
+    LPIPS_HEAD_CHANNELS,
+    golden_input,
+    inception_torch_state_dict,
+    lpips_torch_state_dict,
+)
+
+# --------------------------------------------------------------------------
+# FID InceptionV3 torch replica (torch-fidelity semantics)
+# --------------------------------------------------------------------------
+
+
+def _bconv(x, sd, name, stride=1, pad=0):
+    x = F.conv2d(x, sd[f"{name}.conv.weight"], None, stride=stride, padding=pad)
+    x = F.batch_norm(
+        x,
+        sd[f"{name}.bn.running_mean"],
+        sd[f"{name}.bn.running_var"],
+        sd[f"{name}.bn.weight"],
+        sd[f"{name}.bn.bias"],
+        training=False,
+        eps=1e-3,
+    )
+    return F.relu(x)
+
+
+def _avg_pool_same(x):
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+def _inception_a(x, sd, name, pool_features):
+    b1 = _bconv(x, sd, f"{name}.branch1x1")
+    b5 = _bconv(_bconv(x, sd, f"{name}.branch5x5_1"), sd, f"{name}.branch5x5_2", pad=2)
+    bd = _bconv(x, sd, f"{name}.branch3x3dbl_1")
+    bd = _bconv(bd, sd, f"{name}.branch3x3dbl_2", pad=1)
+    bd = _bconv(bd, sd, f"{name}.branch3x3dbl_3", pad=1)
+    bp = _bconv(_avg_pool_same(x), sd, f"{name}.branch_pool")
+    return torch.cat([b1, b5, bd, bp], dim=1)
+
+
+def _inception_b(x, sd, name):
+    b3 = _bconv(x, sd, f"{name}.branch3x3", stride=2)
+    bd = _bconv(x, sd, f"{name}.branch3x3dbl_1")
+    bd = _bconv(bd, sd, f"{name}.branch3x3dbl_2", pad=1)
+    bd = _bconv(bd, sd, f"{name}.branch3x3dbl_3", stride=2)
+    bp = F.max_pool2d(x, 3, 2)
+    return torch.cat([b3, bd, bp], dim=1)
+
+
+def _inception_c(x, sd, name):
+    b1 = _bconv(x, sd, f"{name}.branch1x1")
+    b7 = _bconv(x, sd, f"{name}.branch7x7_1")
+    b7 = _bconv(b7, sd, f"{name}.branch7x7_2", pad=(0, 3))
+    b7 = _bconv(b7, sd, f"{name}.branch7x7_3", pad=(3, 0))
+    bd = _bconv(x, sd, f"{name}.branch7x7dbl_1")
+    bd = _bconv(bd, sd, f"{name}.branch7x7dbl_2", pad=(3, 0))
+    bd = _bconv(bd, sd, f"{name}.branch7x7dbl_3", pad=(0, 3))
+    bd = _bconv(bd, sd, f"{name}.branch7x7dbl_4", pad=(3, 0))
+    bd = _bconv(bd, sd, f"{name}.branch7x7dbl_5", pad=(0, 3))
+    bp = _bconv(_avg_pool_same(x), sd, f"{name}.branch_pool")
+    return torch.cat([b1, b7, bd, bp], dim=1)
+
+
+def _inception_d(x, sd, name):
+    b3 = _bconv(x, sd, f"{name}.branch3x3_1")
+    b3 = _bconv(b3, sd, f"{name}.branch3x3_2", stride=2)
+    b7 = _bconv(x, sd, f"{name}.branch7x7x3_1")
+    b7 = _bconv(b7, sd, f"{name}.branch7x7x3_2", pad=(0, 3))
+    b7 = _bconv(b7, sd, f"{name}.branch7x7x3_3", pad=(3, 0))
+    b7 = _bconv(b7, sd, f"{name}.branch7x7x3_4", stride=2)
+    bp = F.max_pool2d(x, 3, 2)
+    return torch.cat([b3, b7, bp], dim=1)
+
+
+def _inception_e(x, sd, name, pool):
+    b1 = _bconv(x, sd, f"{name}.branch1x1")
+    b3 = _bconv(x, sd, f"{name}.branch3x3_1")
+    b3 = torch.cat(
+        [
+            _bconv(b3, sd, f"{name}.branch3x3_2a", pad=(0, 1)),
+            _bconv(b3, sd, f"{name}.branch3x3_2b", pad=(1, 0)),
+        ],
+        dim=1,
+    )
+    bd = _bconv(x, sd, f"{name}.branch3x3dbl_1")
+    bd = _bconv(bd, sd, f"{name}.branch3x3dbl_2", pad=1)
+    bd = torch.cat(
+        [
+            _bconv(bd, sd, f"{name}.branch3x3dbl_3a", pad=(0, 1)),
+            _bconv(bd, sd, f"{name}.branch3x3dbl_3b", pad=(1, 0)),
+        ],
+        dim=1,
+    )
+    pooled = _avg_pool_same(x) if pool == "avg" else F.max_pool2d(x, 3, 1, padding=1)
+    bp = _bconv(pooled, sd, f"{name}.branch_pool")
+    return torch.cat([b1, b3, bd, bp], dim=1)
+
+
+def inception_forward_torch(sd, x):
+    """Taps 64/192/768/2048/logits on NCHW input in [-1, 1]."""
+    taps = {}
+    x = _bconv(x, sd, "Conv2d_1a_3x3", stride=2)
+    x = _bconv(x, sd, "Conv2d_2a_3x3")
+    x = _bconv(x, sd, "Conv2d_2b_3x3", pad=1)
+    x = F.max_pool2d(x, 3, 2)
+    taps["64"] = x.mean(dim=(2, 3))
+    x = _bconv(x, sd, "Conv2d_3b_1x1")
+    x = _bconv(x, sd, "Conv2d_4a_3x3")
+    x = F.max_pool2d(x, 3, 2)
+    taps["192"] = x.mean(dim=(2, 3))
+    x = _inception_a(x, sd, "Mixed_5b", 32)
+    x = _inception_a(x, sd, "Mixed_5c", 64)
+    x = _inception_a(x, sd, "Mixed_5d", 64)
+    x = _inception_b(x, sd, "Mixed_6a")
+    x = _inception_c(x, sd, "Mixed_6b")
+    x = _inception_c(x, sd, "Mixed_6c")
+    x = _inception_c(x, sd, "Mixed_6d")
+    x = _inception_c(x, sd, "Mixed_6e")
+    taps["768"] = x.mean(dim=(2, 3))
+    x = _inception_d(x, sd, "Mixed_7a")
+    x = _inception_e(x, sd, "Mixed_7b", "avg")
+    x = _inception_e(x, sd, "Mixed_7c", "max")
+    pooled = x.mean(dim=(2, 3))
+    taps["2048"] = pooled
+    taps["logits"] = pooled @ sd["fc.weight"].T + sd["fc.bias"]
+    return taps
+
+
+# --------------------------------------------------------------------------
+# LPIPS torch replica (lpips.LPIPS semantics incl. torchvision towers)
+# --------------------------------------------------------------------------
+
+_SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+_SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+
+def _fire(x, sd, idx):
+    s = F.relu(F.conv2d(x, sd[f"features.{idx}.squeeze.weight"], sd[f"features.{idx}.squeeze.bias"]))
+    e1 = F.relu(F.conv2d(s, sd[f"features.{idx}.expand1x1.weight"], sd[f"features.{idx}.expand1x1.bias"]))
+    e3 = F.relu(
+        F.conv2d(s, sd[f"features.{idx}.expand3x3.weight"], sd[f"features.{idx}.expand3x3.bias"], padding=1)
+    )
+    return torch.cat([e1, e3], dim=1)
+
+
+def _tower_taps(net_type, sd, x):
+    def conv(x, idx, stride=1, pad=0):
+        return F.relu(
+            F.conv2d(x, sd[f"features.{idx}.weight"], sd[f"features.{idx}.bias"], stride=stride, padding=pad)
+        )
+
+    if net_type == "vgg":
+        taps = []
+        idx_iter = iter((0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28))
+        for block, n_convs in enumerate((2, 2, 3, 3, 3)):
+            if block > 0:
+                x = F.max_pool2d(x, 2, 2)
+            for _ in range(n_convs):
+                x = conv(x, next(idx_iter), pad=1)
+            taps.append(x)
+        return taps
+    if net_type == "alex":
+        r1 = conv(x, 0, stride=4, pad=2)
+        r2 = conv(F.max_pool2d(r1, 3, 2), 3, pad=2)
+        r3 = conv(F.max_pool2d(r2, 3, 2), 6, pad=1)
+        r4 = conv(r3, 8, pad=1)
+        r5 = conv(r4, 10, pad=1)
+        return [r1, r2, r3, r4, r5]
+    if net_type == "squeeze":
+        r1 = conv(x, 0, stride=2, pad=0)
+        x = F.max_pool2d(r1, 3, 2, ceil_mode=True)
+        x = _fire(x, sd, 3)
+        r2 = _fire(x, sd, 4)
+        x = F.max_pool2d(r2, 3, 2, ceil_mode=True)
+        x = _fire(x, sd, 6)
+        r3 = _fire(x, sd, 7)
+        x = F.max_pool2d(r3, 3, 2, ceil_mode=True)
+        r4 = _fire(x, sd, 9)
+        r5 = _fire(r4, sd, 10)
+        r6 = _fire(r5, sd, 11)
+        r7 = _fire(r6, sd, 12)
+        return [r1, r2, r3, r4, r5, r6, r7]
+    raise ValueError(net_type)
+
+
+def lpips_forward_torch(net_type, sd, x0, x1):
+    f0 = _tower_taps(net_type, sd, (x0 - _SHIFT) / _SCALE)
+    f1 = _tower_taps(net_type, sd, (x1 - _SHIFT) / _SCALE)
+    total = torch.zeros(x0.shape[0])
+    for k, (a, b) in enumerate(zip(f0, f1)):
+        a = a / (a.norm(dim=1, keepdim=True) + 1e-10)
+        b = b / (b.norm(dim=1, keepdim=True) + 1e-10)
+        total = total + F.conv2d((a - b) ** 2, sd[f"lin{k}.model.1.weight"]).mean(dim=(2, 3)).squeeze(1)
+    return total
+
+
+def main():
+    out = {}
+    with torch.no_grad():
+        sd = {k: torch.from_numpy(v) for k, v in inception_torch_state_dict().items()}
+        x = torch.from_numpy(golden_input(INCEPTION_INPUT_SHAPE))
+        for tap, val in inception_forward_torch(sd, x).items():
+            out[f"inception/{tap}"] = val.numpy()
+
+        x0 = torch.from_numpy(golden_input(LPIPS_INPUT_SHAPE))
+        x1 = torch.from_numpy(-0.7 * golden_input(LPIPS_INPUT_SHAPE)[:, :, ::-1].copy())
+        for net_type in ("vgg", "alex", "squeeze"):
+            sd = {k: torch.from_numpy(v) for k, v in lpips_torch_state_dict(net_type).items()}
+            assert len(LPIPS_HEAD_CHANNELS[net_type]) == len(_tower_taps(net_type, sd, x0))
+            out[f"lpips/{net_type}"] = lpips_forward_torch(net_type, sd, x0, x1).numpy()
+
+    path = Path(__file__).parent / GOLDEN_PATH
+    np.savez(path, **out)
+    print(f"wrote {len(out)} golden arrays to {path}")
+    for k, v in out.items():
+        print(f"  {k}: shape {v.shape}, first values {np.asarray(v).reshape(-1)[:3]}")
+
+
+if __name__ == "__main__":
+    main()
