@@ -82,11 +82,10 @@ def bench_accuracy_tpu() -> float:
     target = jax.random.randint(jax.random.PRNGKey(1), (N_BATCHES, BATCH), 0, N_CLASSES)
     preds.block_until_ready()
 
-    from benchmarks._timing import measure_ms
+    from benchmarks._timing import measure_ms_scaled
 
-    run_k, run_2k = make_run(K_REPEATS), make_run(2 * K_REPEATS)
-    return measure_ms(
-        lambda: run_k(preds, target), K_REPEATS, run_double=lambda: run_2k(preds, target)
+    return measure_ms_scaled(
+        lambda k: (lambda run=make_run(k): run(preds, target)), K_REPEATS
     )
 
 
@@ -246,7 +245,7 @@ def base_map(n_images: int) -> float:
     # with per-threshold greedy matching loops (the tests' independent
     # oracle implements exactly this protocol)
     from benchmarks.bench_detection import make_inputs
-    from tests.detection.test_map import _oracle_map
+    from benchmarks.map_oracle import _oracle_map
 
     preds, targets = make_inputs(n_images)
     t0 = time.perf_counter()

@@ -5,10 +5,12 @@ latency swings between ~20 us and ~90 ms phases (sometimes seconds). Every
 benchmark repeats its workload K times inside one jit and again at 2K; the
 estimator INTERLEAVES the K and 2K trials and differences each adjacent
 pair, so both sides of every difference see the same RTT phase and the
-dispatch cost cancels per pair. The smallest non-negative pair difference
-is the per-K estimate; if every pair is negative (phase noise exceeded the
-workload entirely), the measurement is reported as NaN rather than a
-fabricated number.
+dispatch cost cancels per pair. The estimate is the MEDIAN of the positive
+pair differences that pass a consistency gate (min-selection over noisy
+differences is biased low — it would flatter vs_baseline ratios); if no
+consistent pair cluster exists (phase noise exceeded the workload
+entirely), the measurement is NaN rather than a fabricated number, and
+``measure_ms_scaled`` doubles K until the workload swamps the noise.
 """
 import math
 import time
@@ -52,4 +54,31 @@ def measure_ms(
     # while genuine workload differences cluster tightly
     if len(usable) < 2 or usable[1] > 2.0 * usable[0]:
         return math.nan
-    return usable[0] / k_repeats * 1000.0
+    # median of the gated cluster (pairs within 2x of the smallest), not the
+    # raw min: min-selection over noisy differences is biased low
+    cluster = [d for d in usable if d <= 2.0 * usable[0]]
+    mid = len(cluster) // 2
+    median = cluster[mid] if len(cluster) % 2 else 0.5 * (cluster[mid - 1] + cluster[mid])
+    return median / k_repeats * 1000.0
+
+
+def measure_ms_scaled(
+    make_run: Callable[[int], Callable[[], jax.Array]],
+    k_repeats: int,
+    n_timing: int = 8,
+    max_doublings: int = 3,
+) -> float:
+    """``measure_ms`` with automatic K escalation.
+
+    ``make_run(k)`` builds the K-repeat thunk. When the consistency gate
+    rejects a measurement (RTT phase noise bigger than the whole K-repeat
+    workload), K doubles — growing the workload until it swamps the noise —
+    up to ``max_doublings`` times before conceding NaN.
+    """
+    k = k_repeats
+    for _ in range(max_doublings + 1):
+        ms = measure_ms(make_run(k), k, n_timing=n_timing, run_double=make_run(2 * k))
+        if not math.isnan(ms):
+            return ms
+        k *= 2
+    return math.nan

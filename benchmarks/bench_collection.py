@@ -9,7 +9,7 @@ import json
 import jax
 import jax.numpy as jnp
 
-from benchmarks._timing import measure_ms
+from benchmarks._timing import measure_ms_scaled
 from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
 
 N, C, K = 1_000_000, 10, 5000  # the binary micro update is ~13 us; K must swamp dispatch RTT
@@ -36,7 +36,7 @@ def measure() -> dict:
                 return jax.lax.fori_loop(0, k, body, jnp.zeros((), jnp.int32))
             return run
 
-        out[f"collection_statscores_{mode}_1M_update"] = measure_ms(make_run(K), K, run_double=make_run(2 * K))
+        out[f"collection_statscores_{mode}_1M_update"] = measure_ms_scaled(make_run, K)
     return out
 
 

@@ -5,7 +5,7 @@ import json
 import jax
 import jax.numpy as jnp
 
-from benchmarks._timing import measure_ms
+from benchmarks._timing import measure_ms_scaled
 from metrics_tpu.functional.classification.auroc import _auroc_compute
 from metrics_tpu.utilities.enums import DataType
 from metrics_tpu.ops import binned_counts
@@ -30,7 +30,7 @@ def measure() -> dict:
         return run
 
     out = {}
-    out["auroc_exact_1M_compute"] = measure_ms(make_exact(K), K, run_double=make_exact(2 * K))
+    out["auroc_exact_1M_compute"] = measure_ms_scaled(make_exact, K)
 
     thresholds = jnp.linspace(0, 1.0, T)
 
@@ -45,7 +45,7 @@ def measure() -> dict:
             return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
         return run
 
-    out["binned_counts_1M_T100_update"] = measure_ms(make_binned(K), K, run_double=make_binned(2 * K))
+    out["binned_counts_1M_T100_update"] = measure_ms_scaled(make_binned, K)
     return out
 
 

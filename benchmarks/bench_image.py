@@ -9,7 +9,7 @@ import json
 import jax
 import jax.numpy as jnp
 
-from benchmarks._timing import measure_ms
+from benchmarks._timing import measure_ms_scaled
 from metrics_tpu.functional.image.fid import _compute_fid
 
 N, D, K = 10_000, 2048, 10
@@ -33,7 +33,7 @@ def measure() -> dict:
             return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
         return run
 
-    return {"fid_10k_2048d_compute": measure_ms(make_run(K), K, run_double=make_run(2 * K))}
+    return {"fid_10k_2048d_compute": measure_ms_scaled(make_run, K)}
 
 
 def main() -> None:

@@ -10,7 +10,7 @@ import json
 import jax
 import jax.numpy as jnp
 
-from benchmarks._timing import measure_ms
+from benchmarks._timing import measure_ms_scaled
 from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
 
 N_QUERIES, DOCS, K = 10_000, 100, 10
@@ -39,7 +39,7 @@ def measure() -> dict:
                 return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
             return run
 
-        out[f"{name}_1M_docs_compute"] = measure_ms(make_run(K), K, run_double=make_run(2 * K))
+        out[f"{name}_1M_docs_compute"] = measure_ms_scaled(make_run, K)
     return out
 
 
