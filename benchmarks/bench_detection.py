@@ -54,9 +54,58 @@ def measure(n_images: int = N_IMAGES, n_trials: int = 3) -> float:
     return min(times) * 1000
 
 
+def measure_pycocotools(n_images: int = N_IMAGES) -> float:
+    """Optional honest baseline: pycocotools' C `accumulate` on the same corpus.
+
+    The plain-loop oracle (benchmarks/map_oracle.py) is a Python COCO
+    protocol loop; pycocotools runs its accumulate in C, so it is the
+    fair reference-speed target. Returns NaN when not installed.
+    """
+    try:
+        from pycocotools.coco import COCO
+        from pycocotools.cocoeval import COCOeval
+    except ImportError:
+        return float("nan")
+    preds, targets = make_inputs(n_images)
+    images, anns, dets = [], [], []
+    ann_id = 1
+    for i, (p, t) in enumerate(zip(preds, targets)):
+        images.append(dict(id=i))
+        for b, l in zip(t["boxes"], t["labels"]):
+            anns.append(
+                dict(id=ann_id, image_id=i, category_id=int(l), iscrowd=0,
+                     area=float((b[2] - b[0]) * (b[3] - b[1])),
+                     bbox=[float(b[0]), float(b[1]), float(b[2] - b[0]), float(b[3] - b[1])])
+            )
+            ann_id += 1
+        for b, s, l in zip(p["boxes"], p["scores"], p["labels"]):
+            dets.append(
+                dict(image_id=i, category_id=int(l), score=float(s),
+                     bbox=[float(b[0]), float(b[1]), float(b[2] - b[0]), float(b[3] - b[1])])
+            )
+    gt = COCO()
+    gt.dataset = dict(images=images, annotations=anns,
+                      categories=[dict(id=c) for c in range(N_CLASSES)])
+    gt.createIndex()
+    dt = gt.loadRes(dets)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ev = COCOeval(gt, dt, iouType="bbox")
+        ev.evaluate()
+        ev.accumulate()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000
+
+
 def main() -> None:
     ms = measure()
     print(json.dumps({"metric": "detection_map_2k_images_compute", "value": round(ms, 1), "unit": "ms"}))
+    pyc = measure_pycocotools()
+    if pyc == pyc:  # not NaN
+        print(json.dumps({"metric": "detection_map_2k_images_pycocotools_baseline", "value": round(pyc, 1), "unit": "ms"}))
+    else:
+        print(json.dumps({"metric": "detection_map_2k_images_pycocotools_baseline", "value": None, "unit": "ms", "note": "pycocotools not installed"}))
 
 
 if __name__ == "__main__":

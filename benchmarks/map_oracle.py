@@ -1,0 +1,156 @@
+"""Independent plain-loop COCO mAP evaluator.
+
+The honest baseline for the detection benchmark and the fuzz oracle for
+``tests/detection/test_map.py`` (the reference pins against pycocotools,
+``/root/reference/tests/detection/test_map.py``; that package is often
+unavailable offline, so this is a from-scratch implementation of the same
+protocol). Lives in benchmarks/ so ``bench.py`` does not depend on the test
+tree's module layout.
+"""
+import numpy as np
+
+IOU_THRS = np.linspace(0.5, 0.95, 10)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+AREA_RANGES = {
+    "all": (0, int(1e10)),
+    "small": (0, 32**2),
+    "medium": (32**2, 96**2),
+    "large": (96**2, int(1e10)),
+}
+MAX_DETS = [1, 10, 100]
+
+
+def _iou(d, g):
+    lt = np.maximum(d[:, None, :2], g[None, :, :2])
+    rb = np.minimum(d[:, None, 2:], g[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    a_d = (d[:, 2] - d[:, 0]) * (d[:, 3] - d[:, 1])
+    a_g = (g[:, 2] - g[:, 0]) * (g[:, 3] - g[:, 1])
+    union = a_d[:, None] + a_g[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1), 0.0)
+
+
+def _oracle_eval_img(det, scores, gt, area_range, max_det):
+    """Plain-loop per-image, per-class evaluation (thresholds x dets loops)."""
+    if len(gt) == 0 and len(det) == 0:
+        return None
+    areas = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    ignore = (areas < area_range[0]) | (areas > area_range[1])
+    gtind = np.argsort(ignore, kind="stable")
+    gt, gt_ignore = gt[gtind], ignore[gtind]
+    order = np.argsort(-scores, kind="stable")[:max_det]
+    det, scores = det[order], scores[order]
+    ious = _iou(det, gt)
+
+    T, D, G = len(IOU_THRS), len(det), len(gt)
+    dtm = np.zeros((T, D), bool)
+    gtm = np.zeros((T, G), bool)
+    dti = np.zeros((T, D), bool)
+    for ti, thr in enumerate(IOU_THRS):
+        for di in range(D):
+            vals = ious[di] * ~(gtm[ti] | gt_ignore)
+            if G == 0:
+                continue
+            m = int(vals.argmax())
+            if vals[m] > thr:
+                dtm[ti, di] = True
+                gtm[ti, m] = True
+                dti[ti, di] = gt_ignore[m]
+    if D:
+        det_areas = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+        out = (det_areas < area_range[0]) | (det_areas > area_range[1])
+        dti = dti | (~dtm & out[None, :])
+    return dict(dtm=dtm, gtm=gtm, scores=scores, gti=gt_ignore, dti=dti)
+
+
+def _oracle_map(preds, targets, class_metrics=False):
+    """Full plain-loop COCO evaluation over a corpus of per-image dicts."""
+    classes = sorted(
+        set(np.concatenate([np.asarray(p["labels"]).reshape(-1) for p in preds] +
+                           [np.asarray(t["labels"]).reshape(-1) for t in targets]).astype(int).tolist())
+        if preds or targets else []
+    )
+    n_imgs = len(preds)
+    K, A, M, T, R = len(classes), len(AREA_RANGES), len(MAX_DETS), len(IOU_THRS), len(REC_THRS)
+    precision = -np.ones((T, R, K, A, M))
+    recall = -np.ones((T, K, A, M))
+
+    for ki, cls in enumerate(classes):
+        for ai, area_range in enumerate(AREA_RANGES.values()):
+            evals = []
+            for i in range(n_imgs):
+                d_lab = np.asarray(preds[i]["labels"]).reshape(-1)
+                g_lab = np.asarray(targets[i]["labels"]).reshape(-1)
+                d_m, g_m = d_lab == cls, g_lab == cls
+                if not d_m.any() and not g_m.any():
+                    evals.append(None)
+                    continue
+                det = np.asarray(preds[i]["boxes"], float).reshape(-1, 4)[d_m]
+                sc = np.asarray(preds[i]["scores"], float).reshape(-1)[d_m]
+                gt = np.asarray(targets[i]["boxes"], float).reshape(-1, 4)[g_m]
+                evals.append(_oracle_eval_img(det, sc, gt, area_range, MAX_DETS[-1]))
+            evals = [e for e in evals if e is not None]
+            if not evals:
+                continue
+            for mi, max_det in enumerate(MAX_DETS):
+                scores = np.concatenate([e["scores"][:max_det] for e in evals])
+                inds = np.argsort(-scores, kind="mergesort")
+                dtm = np.concatenate([e["dtm"][:, :max_det] for e in evals], 1)[:, inds]
+                dti = np.concatenate([e["dti"][:, :max_det] for e in evals], 1)[:, inds]
+                gti = np.concatenate([e["gti"] for e in evals])
+                npig = int((~gti).sum())
+                if npig == 0:
+                    continue
+                tps = np.cumsum(dtm & ~dti, 1, dtype=float)
+                fps = np.cumsum(~dtm & ~dti, 1, dtype=float)
+                for ti in range(T):
+                    tp, fp = tps[ti], fps[ti]
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + np.finfo(float).eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if nd else 0
+                    # right-max envelope via the reference's iterative lift
+                    pr = pr.copy()
+                    while True:
+                        diff = np.clip(np.concatenate([pr[1:] - pr[:-1], [0.0]]), 0, None)
+                        if np.all(diff == 0):
+                            break
+                        pr += diff
+                    idxs = np.searchsorted(rc, REC_THRS, side="left")
+                    num = int(idxs.argmax()) if idxs.max() >= nd else R
+                    row = np.zeros(R)
+                    row[:num] = pr[idxs[:num]]
+                    precision[ti, :, ki, ai, mi] = row
+
+    def summ(arr, avg_prec, thr=None, area="all", max_det=100):
+        ai = list(AREA_RANGES).index(area)
+        mi = MAX_DETS.index(max_det)
+        x = arr[..., ai, mi]
+        if thr is not None:
+            x = x[list(IOU_THRS).index(thr)]
+        v = x[x > -1]
+        return float(v.mean()) if v.size else -1.0
+
+    out = {
+        "map": summ(precision, True),
+        "map_50": summ(precision, True, 0.5),
+        "map_75": summ(precision, True, 0.75),
+        "map_small": summ(precision, True, area="small"),
+        "map_medium": summ(precision, True, area="medium"),
+        "map_large": summ(precision, True, area="large"),
+        "mar_1": summ(recall, False, max_det=1),
+        "mar_10": summ(recall, False, max_det=10),
+        "mar_100": summ(recall, False, max_det=100),
+        "mar_small": summ(recall, False, area="small"),
+        "mar_medium": summ(recall, False, area="medium"),
+        "mar_large": summ(recall, False, area="large"),
+    }
+    if class_metrics:
+        out["map_per_class"] = [
+            summ(precision[:, :, k : k + 1], True) for k in range(K)
+        ]
+        out["mar_100_per_class"] = [summ(recall[:, k : k + 1], False) for k in range(K)]
+    return out
+
+

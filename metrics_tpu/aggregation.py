@@ -15,6 +15,23 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
 
+_ERROR_INERT_WARNED = False
+
+
+def _warn_error_inert_under_trace() -> None:
+    """One-time trace-time heads-up: ``nan_strategy='error'`` cannot raise on
+    traced data, so jitted updates silently pass NaNs through. Armed as a real
+    checkify guard by ``metrics_tpu.debug_checks(True)``."""
+    global _ERROR_INERT_WARNED
+    if not _ERROR_INERT_WARNED:
+        _ERROR_INERT_WARNED = True
+        rank_zero_warn(
+            "nan_strategy='error' is inert under jit/scan/shard_map: a traced update cannot raise on"
+            " data, so NaNs pass through silently. Enable metrics_tpu.debug_checks(True) and run the"
+            " step under jax.experimental.checkify to surface them.",
+            UserWarning,
+        )
+
 
 class BaseAggregator(Metric):
     """Base for aggregation metrics: one state, a NaN strategy, scalar-or-array input.
@@ -67,6 +84,15 @@ class BaseAggregator(Metric):
                 x = jnp.where(nans, jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
             elif self.nan_strategy in ("warn", "ignore") and self._nan_identity is not None:
                 x = jnp.where(nans, jnp.asarray(self._nan_identity, dtype=x.dtype), x)
+            elif self.nan_strategy == "error":
+                from metrics_tpu.utilities.debug import debug_checks_enabled
+
+                if debug_checks_enabled():
+                    from jax.experimental import checkify
+
+                    checkify.check(~jnp.any(nans), "Encountered `nan` values in tensor")
+                else:
+                    _warn_error_inert_under_trace()
             return x.astype(jnp.float32)
         if bool(nans.any()):
             if self.nan_strategy == "error":
@@ -147,6 +173,8 @@ class CatMetric(BaseAggregator):
 class MeanMetric(BaseAggregator):
     """Weighted running mean (reference ``aggregation.py:328``)."""
 
+    supports_sample_weights = True  # update(value, weight): weight==c equals c repeats
+
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0), nan_strategy, **kwargs)
         self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
@@ -170,6 +198,15 @@ class MeanMetric(BaseAggregator):
             elif self.nan_strategy in ("warn", "ignore"):
                 value = jnp.where(nans, 0.0, value)
                 weight = jnp.where(nans, 0.0, weight.astype(jnp.float32))
+            elif self.nan_strategy == "error":
+                from metrics_tpu.utilities.debug import debug_checks_enabled
+
+                if debug_checks_enabled():
+                    from jax.experimental import checkify
+
+                    checkify.check(~jnp.any(nans), "Encountered `nan` values in tensor")
+                else:
+                    _warn_error_inert_under_trace()
         elif bool(nans.any()):
             if self.nan_strategy == "error":
                 raise RuntimeError("Encountered `nan` values in tensor")

@@ -77,6 +77,11 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
+    # update accepts a per-sample weight vector whose semantics equal sample
+    # repetition (update(value, weight) with weight=c == c repeats) — lets
+    # BootStrapper express the poisson bootstrap as one vmapped weighted
+    # update instead of N variable-size resamples
+    supports_sample_weights: bool = False
     # extra update-derived Python attrs (e.g. detected input mode) that must
     # survive a checkpoint round-trip alongside the array states
     _aux_attrs: tuple = ()

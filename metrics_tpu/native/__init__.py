@@ -68,10 +68,10 @@ def _build_timeout() -> float:
     return 60.0
 
 
-def _compile(src: Path) -> Optional[Path]:
-    """cc -O2 -shared -fPIC src -> content-addressed .so, atomically."""
-    tag = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
-    name = f"{src.stem}-{tag}.so"
+def _compile(sources: List[Path]) -> Optional[Path]:
+    """cc -O2 -shared -fPIC srcs -> one content-addressed .so, atomically."""
+    tag = hashlib.sha256(b"".join(s.read_bytes() for s in sources)).hexdigest()[:16]
+    name = f"{sources[0].stem}-{tag}.so"
     timeout_s = _build_timeout()
     for out_dir in _cache_dirs():
         so = out_dir / name
@@ -92,9 +92,9 @@ def _compile(src: Path) -> Optional[Path]:
             try:
                 # announce the build so a hung compiler/NFS cache stall is
                 # attributable
-                _info(f"compiling native kernel {src.name} with {cc} -> {so}")
+                _info(f"compiling native kernels {[s.name for s in sources]} with {cc} -> {so}")
                 res = subprocess.run(
-                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp, str(src)],
+                    [cc, "-O2", "-shared", "-fPIC", "-o", tmp] + [str(s) for s in sources],
                     capture_output=True,
                     timeout=timeout_s,
                 )
@@ -121,7 +121,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("METRICS_TPU_NO_NATIVE"):
         return None
     try:
-        so = _compile(_HERE / "levenshtein.c")
+        so = _compile([_HERE / "levenshtein.c", _HERE / "coco_match.c"])
     except Exception:
         # e.g. Path.home() RuntimeError under an arbitrary UID with no HOME:
         # native is an optimization — never let its setup crash a metric
@@ -131,10 +131,19 @@ def _load() -> Optional[ctypes.CDLL]:
     try:
         lib = ctypes.CDLL(str(so))
         i64p = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(dtype=np.float32, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
         lib.mtpu_edit_distance.argtypes = [i64p, ctypes.c_int64, i64p, ctypes.c_int64]
         lib.mtpu_edit_distance.restype = ctypes.c_int64
         lib.mtpu_edit_distance_batch.argtypes = [i64p, i64p, i64p, i64p, ctypes.c_int64, i64p]
         lib.mtpu_edit_distance_batch.restype = None
+        lib.mtpu_coco_match.argtypes = [
+            f32p, i64p, i64p, i64p, i64p, i64p, u8p, f64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            u8p, u8p,
+        ]
+        lib.mtpu_coco_match.restype = None
     except (OSError, AttributeError):
         # unreadable or stale library (missing symbol): fall back to numpy
         return None
@@ -179,3 +188,46 @@ def edit_distance_batch(seqs_a: List[np.ndarray], seqs_b: List[np.ndarray]) -> O
     if (out < 0).any():  # allocation failure inside the kernel
         return None
     return out
+
+
+def coco_match(
+    pair_ious: np.ndarray,
+    iou_off: np.ndarray,
+    nd: np.ndarray,
+    ng: np.ndarray,
+    det_off: np.ndarray,
+    gt_off: np.ndarray,
+    gt_ignore: np.ndarray,
+    iou_thresholds: np.ndarray,
+) -> Optional[np.ndarray]:
+    """Native greedy COCO matching over ragged cells; None when unavailable.
+
+    Args are the CSR cell layout documented in ``coco_match.c``; returns
+    ``det_matches`` of shape ``(A, T, total_det)`` (bool).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "mtpu_coco_match"):
+        return None
+    A, total_gt = gt_ignore.shape
+    T = len(iou_thresholds)
+    total_det = int(nd.sum())
+    out = np.zeros((A, T, total_det), dtype=np.uint8)
+    scratch = np.empty(max(1, total_gt), dtype=np.uint8)
+    lib.mtpu_coco_match(
+        np.ascontiguousarray(pair_ious, dtype=np.float32),
+        np.ascontiguousarray(iou_off, dtype=np.int64),
+        np.ascontiguousarray(nd, dtype=np.int64),
+        np.ascontiguousarray(ng, dtype=np.int64),
+        np.ascontiguousarray(det_off, dtype=np.int64),
+        np.ascontiguousarray(gt_off, dtype=np.int64),
+        np.ascontiguousarray(gt_ignore, dtype=np.uint8),
+        np.ascontiguousarray(iou_thresholds, dtype=np.float64),
+        T,
+        A,
+        len(nd),
+        total_det,
+        total_gt,
+        out,
+        scratch,
+    )
+    return out.astype(bool)

@@ -1,0 +1,72 @@
+/* Greedy COCO detection-to-ground-truth matching over ragged cells.
+ *
+ * Equivalent of the matching step of the COCO evaluation protocol
+ * (reference torchmetrics/detection/mean_ap.py:421/:513, itself following
+ * pycocotools): per (area-range, IoU-threshold, image-class cell), walk
+ * detections in descending score order and greedily claim the unmatched,
+ * unignored ground truth with the highest IoU; the claim stands when that
+ * IoU strictly exceeds the threshold.
+ *
+ * Layout is CSR over cells: cell c owns dets [det_off[c], det_off[c]+nd[c])
+ * and gts [gt_off[c], gt_off[c]+ng[c]); its IoU block is row-major
+ * (nd[c] x ng[c]) at ious + iou_off[c]. Complexity is
+ * A * T * sum_c(nd_c * ng_c) — the count of REAL pairs, where the padded
+ * dense formulation pays for max_nd * max_ng in every cell.
+ */
+#include <stdint.h>
+#include <string.h>
+
+void mtpu_coco_match(
+    const float *ious,           /* sum(nd*ng) pair IoUs, cell-major */
+    const int64_t *iou_off,      /* n_cells: start of each cell's IoU block */
+    const int64_t *nd,           /* n_cells: detections per cell (score-desc) */
+    const int64_t *ng,           /* n_cells: ground truths per cell */
+    const int64_t *det_off,      /* n_cells: global det start per cell */
+    const int64_t *gt_off,       /* n_cells: global gt start per cell */
+    const uint8_t *gt_ignore,    /* A x total_gt: area-ignored gts */
+    const double *thrs,          /* T IoU thresholds */
+    int64_t T,
+    int64_t A,
+    int64_t n_cells,
+    int64_t total_det,
+    int64_t total_gt,
+    uint8_t *det_matches,        /* out: A x T x total_det, caller-zeroed */
+    uint8_t *gt_matched_scratch) /* total_gt bytes of scratch */
+{
+    for (int64_t a = 0; a < A; ++a) {
+        const uint8_t *ign = gt_ignore + a * total_gt;
+        for (int64_t t = 0; t < T; ++t) {
+            const double thr = thrs[t];
+            uint8_t *outm = det_matches + (a * T + t) * total_det;
+            memset(gt_matched_scratch, 0, (size_t)total_gt);
+            for (int64_t c = 0; c < n_cells; ++c) {
+                const int64_t ndc = nd[c], ngc = ng[c];
+                if (!ndc || !ngc)
+                    continue;
+                const float *M = ious + iou_off[c];
+                const uint8_t *gi = ign + gt_off[c];
+                uint8_t *gm = gt_matched_scratch + gt_off[c];
+                uint8_t *od = outm + det_off[c];
+                for (int64_t d = 0; d < ndc; ++d) {
+                    const float *row = M + d * ngc;
+                    float best = 0.0f;
+                    int64_t best_g = -1;
+                    for (int64_t g = 0; g < ngc; ++g) {
+                        if (gm[g] || gi[g])
+                            continue;
+                        /* strict > keeps the FIRST maximum, matching
+                         * numpy argmax tie-breaking */
+                        if (row[g] > best) {
+                            best = row[g];
+                            best_g = g;
+                        }
+                    }
+                    if (best_g >= 0 && best > thr) {
+                        od[d] = 1;
+                        gm[best_g] = 1;
+                    }
+                }
+            }
+        }
+    }
+}

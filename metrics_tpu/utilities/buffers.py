@@ -67,6 +67,21 @@ class CapacityBuffer:
                     " Raise `sample_capacity` or switch to unbounded list states."
                 )
             self._host_count += n
+        else:
+            # post-boundary traced count: overflow silently clamps to the
+            # tail. debug_checks arms a checkify guard for exactly this
+            # (SURVEY §7 hard part 4) — surfaced by checkify.checkify(step).
+            from metrics_tpu.utilities.debug import debug_checks_enabled
+
+            if debug_checks_enabled():
+                from jax.experimental import checkify
+
+                checkify.check(
+                    self.count + n <= self.capacity,
+                    "CapacityBuffer overflow under trace: count {c} + "
+                    f"{n} > capacity {self.capacity} (excess samples would overwrite the buffer tail)",
+                    c=self.count,
+                )
         start = (self.count,) + (jnp.asarray(0, jnp.int32),) * (batch.ndim - 1)
         self.data = jax.lax.dynamic_update_slice(self.data, batch, start)
         self.count = self.count + n

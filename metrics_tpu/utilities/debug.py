@@ -1,0 +1,39 @@
+"""Opt-in traced value checks (SURVEY §7 hard part 4).
+
+The reference resolves input/state validity from tensor *values* at runtime;
+under ``jit`` those checks cannot raise on data, so a handful of guarded
+conditions become silent (a ``CapacityBuffer`` overflowing inside a scan
+clamps to the tail; ``nan_strategy="error"`` cannot error on traced NaNs).
+``debug_checks(True)`` arms :func:`jax.experimental.checkify.check` guards
+at exactly those points — run the jitted step under
+``checkify.checkify(...)`` and call ``err.throw()`` to surface them. When
+off (the default) no check is emitted into the trace: the compiled program
+is bit-identical to the unguarded one, so the debug mode is cost-free in
+production.
+
+    import metrics_tpu
+    from jax.experimental import checkify
+
+    metrics_tpu.debug_checks(True)
+    err, (state, value) = checkify.checkify(jax.jit(step))(state, preds, target)
+    err.throw()  # raises on traced CapacityBuffer overflow / NaN-on-error
+
+Also togglable via ``METRICS_TPU_DEBUG_CHECKS=1`` in the environment.
+"""
+import os
+
+__all__ = ["debug_checks", "debug_checks_enabled"]
+
+_ENABLED = os.environ.get("METRICS_TPU_DEBUG_CHECKS", "").strip().lower() not in ("", "0", "false", "no", "off")
+
+
+def debug_checks(enable: bool = True) -> bool:
+    """Arm (or disarm) traced checkify guards; returns the previous state."""
+    global _ENABLED
+    previous = _ENABLED
+    _ENABLED = bool(enable)
+    return previous
+
+
+def debug_checks_enabled() -> bool:
+    return _ENABLED

@@ -1,29 +1,45 @@
 """BootStrapper — bootstrap confidence intervals around any metric.
 
 Behavioral equivalent of reference ``torchmetrics/wrappers/bootstrapping.py:48``
-(``BootStrapper``; sampler ``:25``): keeps ``num_bootstraps`` independent
-copies of a base metric; every ``update`` feeds each copy a resampled version
-of the batch (poisson or multinomial bootstrap); ``compute`` reports
-mean/std/quantile/raw over the copies' values.
+(``BootStrapper``; sampler ``:25``): ``num_bootstraps`` independent bootstrap
+replicates of a base metric; every ``update`` feeds each replicate a
+resampled version of the batch (poisson or multinomial bootstrap);
+``compute`` reports mean/std/quantile/raw over the replicates' values.
 
-TPU notes: resample *indices* are drawn host-side with numpy (cheap, O(batch))
-so each copy's jitted ``update`` kernel still sees a static batch shape for
-the ``"multinomial"`` strategy. The ``"poisson"`` strategy produces a
-variable-size resample by construction (reference semantics); its gather is
-built host-side and the inner metric update remains jitted per unique shape.
+TPU-first design (SURVEY §7.4): instead of the reference's N deep copies
+dispatching N updates per batch, replicate STATES are one stacked pytree
+with a leading bootstrap axis and every update is ONE jitted
+``jax.vmap``-ed program — a single dispatch resamples (gather) and updates
+all replicates on device:
+
+* ``"multinomial"``: a ``(B, N)`` index matrix gathers each replicate's
+  resample; works for any metric whose states are fixed-shape arrays with
+  sum/min/max reductions (the ``make_step`` merge contract).
+* ``"poisson"``: resample sizes vary per replicate (reference semantics),
+  which breaks static shapes — UNLESS the base metric supports per-sample
+  weights (``supports_sample_weights``, e.g. ``MeanMetric``): a sample
+  drawn ``c ~ Poisson(1)`` times is exactly a weight multiplier of ``c``,
+  so the vmapped update passes poisson count vectors as weights.
+
+Metrics outside those contracts (sample-buffer states, host-side text
+metrics, poisson without weight support) fall back to the reference's
+deep-copy loop with host-side index resampling.
 """
 from copy import deepcopy
-from typing import Any, Dict, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import apply_to_collection
 from metrics_tpu.wrappers.abstract import WrapperMetric
 
 Array = jax.Array
+
+_STATE_PREFIX = "_boot_"
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.Generator) -> np.ndarray:
@@ -41,7 +57,7 @@ class BootStrapper(WrapperMetric):
 
     Args:
         base_metric: the metric to bootstrap.
-        num_bootstraps: number of independent resampled copies.
+        num_bootstraps: number of independent bootstrap replicates.
         mean / std / raw: which statistics ``compute`` returns.
         quantile: optional quantile(s) of the bootstrap distribution.
         sampling_strategy: ``"poisson"`` (sample counts ~ Poisson(1)) or
@@ -76,7 +92,6 @@ class BootStrapper(WrapperMetric):
                 f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
             )
         self.base_metric = base_metric
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
         self.num_bootstraps = num_bootstraps
 
         self.mean = mean
@@ -93,8 +108,116 @@ class BootStrapper(WrapperMetric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.default_rng(seed)
 
+        self._vmap = self._try_build_vmap_path()
+        if self._vmap:
+            self.metrics: list = []  # replicate state lives in the stacked pytree
+        else:
+            self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+
+    # ------------------------------------------------------------------
+    # vmapped fast path: stacked replicate states, one dispatch per update
+    # ------------------------------------------------------------------
+
+    def _try_build_vmap_path(self) -> bool:
+        poisson = self.sampling_strategy == "poisson"
+        if poisson and not getattr(self.base_metric, "supports_sample_weights", False):
+            return False
+        try:
+            from metrics_tpu.steps import make_step
+
+            self._init, self._step, self._compute_one = make_step(self.base_metric, with_value=False)
+        except ValueError:  # unbounded list states
+            return False
+        template = self._init()
+        base = self.base_metric
+        if any(isinstance(v, CapacityBuffer) for v in template.values()) or not all(
+            base._reductions.get(n) in ("sum", "max", "min") for n in template
+        ):
+            return False
+        # each leaf becomes a registered state with a leading bootstrap axis
+        # and the base metric's own reduction — reset/serialization/DDP sync
+        # come from the normal Metric machinery
+        for name, value in template.items():
+            stacked = jnp.broadcast_to(value[None], (self.num_bootstraps,) + value.shape)
+            self.add_state(_STATE_PREFIX + name, default=jnp.array(stacked), dist_reduce_fx=base._reductions[name])
+        self._state_names = list(template)
+        return True
+
+    def _stacked_state(self) -> Dict[str, Array]:
+        return {n: getattr(self, _STATE_PREFIX + n) for n in self._state_names}
+
+    def _set_stacked_state(self, state: Dict[str, Array]) -> None:
+        for n in self._state_names:
+            setattr(self, _STATE_PREFIX + n, state[n])
+
+    def _vmap_update(self, size: int, args: tuple, kwargs: dict) -> bool:
+        """One vmapped dispatch for all replicates; False -> use fallback.
+
+        Array leaves whose leading dim is the batch size are resampled;
+        everything else (scalars, config values) passes through unchanged —
+        the same split the eager loop's ``apply_to_collection`` resample
+        makes.
+        """
+        keys = sorted(kwargs)
+        n_pos = len(args)
+        leaves = list(args) + [kwargs[k] for k in keys]
+
+        def _is_batch(a: Any) -> bool:
+            return isinstance(a, (jnp.ndarray, jax.Array, np.ndarray)) and getattr(a, "ndim", 0) >= 1 and a.shape[0] == size
+
+        batch_mask = [_is_batch(a) for a in leaves]
+        if not any(batch_mask):
+            return False
+        step = self._step
+
+        try:
+            if self.sampling_strategy == "multinomial":
+                idx = jnp.asarray(self._rng.integers(0, size, (self.num_bootstraps, size)))
+
+                def one(state, index, *flat):
+                    resampled = [a[index] if m else a for a, m in zip(flat, batch_mask)]
+                    new_state, _ = step(state, *resampled[:n_pos], **dict(zip(keys, resampled[n_pos:])))
+                    return new_state
+
+                new = jax.vmap(one, in_axes=(0, 0) + (None,) * len(leaves))(self._stacked_state(), idx, *leaves)
+            else:  # poisson via per-sample weights: update(value, weight)
+                counts = jnp.asarray(self._rng.poisson(1, (self.num_bootstraps, size)), dtype=jnp.float32)
+                value = leaves[0]
+                weight = kwargs.get("weight", args[1] if len(args) > 1 else jnp.ones(size, jnp.float32))
+                weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), (size,))
+
+                def one(state, c):
+                    new_state, _ = step(state, value, weight * c)
+                    return new_state
+
+                new = jax.vmap(one, in_axes=(0, 0))(self._stacked_state(), counts)
+        except (TypeError, ValueError):
+            # metric not trace-ready (e.g. a bare Accuracy() inferring
+            # num_classes from label values) or untraceable passthrough
+            # args: use the per-copy eager loop
+            return False
+        self._set_stacked_state(new)
+        return True
+
+    def _materialize_copies(self) -> List[Metric]:
+        """Per-replicate metric copies loaded from the stacked states, so a
+        mid-stream fallback keeps everything accumulated so far."""
+        copies = []
+        for b in range(self.num_bootstraps):
+            copy = deepcopy(self.base_metric)
+            copy.reset()
+            copy.load_state_pytree({n: getattr(self, _STATE_PREFIX + n)[b] for n in self._state_names})
+            copy._update_count = 1
+            copies.append(copy)
+        return copies
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample the batch once per bootstrap copy and update it."""
+        """Resample the batch once per replicate and update it (one vmapped
+        dispatch on the fast path; a per-copy loop otherwise)."""
         args_sizes = apply_to_collection(args, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
         kwargs_sizes = apply_to_collection(kwargs, (jnp.ndarray, jax.Array), lambda x: x.shape[0])
         if len(args_sizes) > 0:
@@ -104,6 +227,13 @@ class BootStrapper(WrapperMetric):
         else:
             raise ValueError("None of the input contained tensors, so could not determine the sampling size")
 
+        if self._vmap and self._vmap_update(size, args, kwargs):
+            return
+        if not self.metrics:
+            # vmap path rejected this batch: materialize per-replicate copies
+            # FROM the stacked states so prior vmapped updates are kept
+            self.metrics = self._materialize_copies()
+            self._vmap = False
         for idx in range(self.num_bootstraps):
             sample_idx = jnp.asarray(_bootstrap_sampler(size, self.sampling_strategy, self._rng))
             if sample_idx.size == 0:  # poisson can draw an empty resample
@@ -113,8 +243,11 @@ class BootStrapper(WrapperMetric):
             self.metrics[idx].update(*new_args, **new_kwargs)
 
     def compute(self) -> Dict[str, Array]:
-        """Statistics over the bootstrap copies' computed values."""
-        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        """Statistics over the bootstrap replicates' computed values."""
+        if self._vmap:
+            computed_vals = jax.vmap(self._compute_one)(self._stacked_state())
+        else:
+            computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
         output: Dict[str, Array] = {}
         if self.mean:
             output["mean"] = computed_vals.mean(axis=0)

@@ -1,0 +1,107 @@
+"""Opt-in checkify guards for silent traced value errors (SURVEY §7 hard part 4).
+
+Two conditions the eager API raises on become silent under a trace: a
+CapacityBuffer overflowing (clamps to the tail) and ``nan_strategy='error'``
+(cannot raise on data). ``metrics_tpu.debug_checks(True)`` arms checkify
+guards at both points; off (the default), the traced program must carry no
+check at all.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import checkify
+
+import metrics_tpu
+from metrics_tpu import AUROC, SumMetric, make_step
+
+
+@pytest.fixture()
+def debug_on():
+    prev = metrics_tpu.debug_checks(True)
+    yield
+    metrics_tpu.debug_checks(prev)
+
+
+def _filled_state(step):
+    """A step state whose buffer count is traced (crossed a jit boundary).
+
+    With the debug flag armed, every staged call must be functionalized
+    through checkify (jax raises a loud ValueError otherwise), so the fill
+    step goes through checkify too.
+    """
+    init, _, _ = make_step(AUROC, sample_capacity=8)
+    checked = checkify.checkify(jax.jit(step))
+    err, (state, _) = checked(init(), jnp.asarray([0.1] * 6), jnp.asarray([0, 1] * 3))
+    err.throw()
+    return state
+
+
+class TestBufferOverflowGuard:
+    def test_traced_overflow_caught_under_flag(self, debug_on):
+        _, step, _ = make_step(AUROC, sample_capacity=8)
+        state = _filled_state(step)
+        checked = checkify.checkify(jax.jit(step))
+        # 6 + 4 > 8: the guard must fire
+        err, _ = checked(state, jnp.asarray([0.5] * 4), jnp.asarray([1, 0, 1, 0]))
+        with pytest.raises(Exception, match="CapacityBuffer overflow under trace"):
+            err.throw()
+
+    def test_no_false_positive_under_flag(self, debug_on):
+        _, step, _ = make_step(AUROC, sample_capacity=8)
+        state = _filled_state(step)
+        checked = checkify.checkify(jax.jit(step))
+        err, (state2, _) = checked(state, jnp.asarray([0.5, 0.6]), jnp.asarray([1, 0]))
+        err.throw()  # 6 + 2 == 8: in bounds
+        assert int(state2["preds"].count) == 8
+
+    def test_unfunctionalized_staging_fails_loud_under_flag(self, debug_on):
+        """Armed but not checkify-wrapped: jax itself rejects the staged
+        check — a loud error, never a silently missing guard."""
+        init, step, _ = make_step(AUROC, sample_capacity=8)
+        state = _filled_state(step)
+        with pytest.raises(ValueError, match="checkify"):
+            jax.jit(step)(state, jnp.asarray([0.5]), jnp.asarray([1]))
+
+    def test_cost_free_when_off(self):
+        """With the flag off the trace carries no checkify effect: a plain
+        jit works and overflow keeps the documented silent-clamp behavior."""
+        init, step, _ = make_step(AUROC, sample_capacity=8)
+        jstep = jax.jit(step)
+        state, _ = jstep(init(), jnp.asarray([0.1] * 6), jnp.asarray([0, 1] * 3))
+        state, _ = jstep(state, jnp.asarray([0.5] * 4), jnp.asarray([1, 0, 1, 0]))
+        assert int(state["preds"].count) == 10  # clamped write, honest count
+
+    def test_eager_overflow_still_raises_plainly(self, debug_on):
+        m = AUROC(sample_capacity=4)
+        m.update(jnp.asarray([0.1, 0.9]), jnp.asarray([0, 1]))
+        with pytest.raises(ValueError, match="CapacityBuffer overflow"):
+            m.update(jnp.asarray([0.2] * 3), jnp.asarray([1, 0, 1]))
+
+
+class TestNanErrorGuard:
+    def test_traced_nan_caught_under_flag(self, debug_on):
+        init, step, compute = make_step(SumMetric, nan_strategy="error")
+        checked = checkify.checkify(jax.jit(step))
+        err, _ = checked(init(), jnp.asarray([1.0, jnp.nan]))
+        with pytest.raises(Exception, match="nan"):
+            err.throw()
+        err, (state, _) = checked(init(), jnp.asarray([1.0, 2.0]))
+        err.throw()
+        np.testing.assert_allclose(float(compute(state)), 3.0)
+
+    def test_off_warns_once_and_passes_nan(self):
+        import metrics_tpu.aggregation as agg
+
+        agg._ERROR_INERT_WARNED = False
+        init, step, compute = make_step(SumMetric, nan_strategy="error")
+        with pytest.warns(UserWarning, match="inert under jit"):
+            state, _ = jax.jit(step)(init(), jnp.asarray([1.0, jnp.nan]))
+        assert np.isnan(float(compute(state)))
+        # second trace: silent (one-time warning)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            jax.jit(lambda s, v: step(s, v))(init(), jnp.asarray([2.0, jnp.nan]))

@@ -50,6 +50,84 @@ class TestBootStrapper:
         assert float(out["std"]) < 0.1
         assert out["raw"].shape == (50,)
 
+    def test_vmap_fast_path_engages_and_matches_oracle(self):
+        """Trace-ready base metric + multinomial: replicate states live in a
+        stacked pytree, one vmapped dispatch per update (SURVEY §7.4)."""
+        rng = np.random.default_rng(11)
+        n = 512
+        preds = jnp.asarray(rng.integers(0, 3, n))
+        target = jnp.asarray(np.where(rng.random(n) < 0.7, np.asarray(preds), rng.integers(0, 3, n)))
+        boot = BootStrapper(
+            Accuracy(num_classes=3), num_bootstraps=50, sampling_strategy="multinomial", seed=1, raw=True
+        )
+        assert boot._vmap and boot.metrics == []  # no deep copies exist
+        boot.update(preds, target)
+        out = boot.compute()
+        solo = Accuracy(num_classes=3)
+        solo.update(preds, target)
+        assert abs(float(out["mean"]) - float(solo.compute())) < 0.05
+        assert out["raw"].shape == (50,)
+        # the replicates really differ (resampling happened per replicate)
+        assert float(out["std"]) > 0
+
+    def test_vmap_poisson_weights_exact_vs_counts(self):
+        """Poisson fast path: weight vectors ARE the resample counts — each
+        replicate's weighted mean must equal the count-weighted oracle."""
+        seed, B, n = 9, 16, 200
+        vals = np.random.default_rng(0).normal(3.0, 1.0, n).astype(np.float32)
+        boot = BootStrapper(MeanMetric(), num_bootstraps=B, sampling_strategy="poisson", seed=seed, raw=True)
+        assert boot._vmap
+        boot.update(jnp.asarray(vals))
+        raw = np.asarray(boot.compute()["raw"])
+        counts = np.random.default_rng(seed).poisson(1, (B, n))  # the same draw
+        expected = (counts * vals).sum(1) / np.maximum(counts.sum(1), 1)
+        np.testing.assert_allclose(raw, expected, rtol=1e-5)
+
+    def test_vmap_path_multi_batch_and_reset(self):
+        boot = BootStrapper(MeanMetric(), num_bootstraps=8, sampling_strategy="poisson", seed=0)
+        boot.update(jnp.asarray([1.0, 2.0, 3.0]))
+        boot.update(jnp.asarray([4.0, 5.0]))
+        first = float(boot.compute()["mean"])
+        assert 1.0 < first < 5.0
+        boot.reset()
+        # batch large enough that no replicate plausibly draws all-zero
+        # counts (an all-zero replicate is NaN by poisson-bootstrap
+        # semantics, same as the reference's skipped empty resample)
+        boot.update(jnp.full((64,), 10.0))
+        np.testing.assert_allclose(float(boot.compute()["mean"]), 10.0, atol=1e-6)
+
+    def test_vmap_forward_accumulates(self):
+        boot = BootStrapper(Accuracy(num_classes=2), num_bootstraps=20, sampling_strategy="multinomial", seed=2)
+        assert boot._vmap
+        boot(jnp.asarray([1, 1, 1, 1]), jnp.asarray([0, 0, 0, 0]))
+        boot(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+        assert abs(float(boot.compute()["mean"]) - 0.5) < 0.15
+
+    def test_poisson_without_weight_support_falls_back(self):
+        boot = BootStrapper(Accuracy(num_classes=3), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+        assert not boot._vmap and len(boot.metrics) == 4
+
+    def test_scalar_kwarg_passes_through_vmap_path(self):
+        """Non-batch leaves (a python-float weight) ride along unsampled
+        instead of knocking the update off the fast path."""
+        boot = BootStrapper(MeanMetric(), num_bootstraps=8, sampling_strategy="multinomial", seed=0)
+        boot.update(jnp.full((32,), 100.0))
+        boot.update(jnp.full((16,), 100.0), weight=0.5)
+        assert boot._vmap  # still on the fast path
+        np.testing.assert_allclose(float(boot.compute()["mean"]), 100.0, atol=1e-5)
+
+    def test_midstream_fallback_keeps_accumulated_state(self):
+        """If a later batch genuinely cannot go through vmap, the replicate
+        copies are materialized FROM the stacked states — prior vmapped
+        updates are never dropped."""
+        boot = BootStrapper(MeanMetric(), num_bootstraps=8, sampling_strategy="multinomial", seed=0)
+        boot.update(jnp.full((64,), 100.0))  # vmapped
+        assert boot._vmap
+        boot._vmap_update = lambda *a, **k: False  # force the fallback switch
+        boot.update(jnp.full((64,), 50.0))  # eager per-copy loop
+        assert not boot._vmap and len(boot.metrics) == 8
+        np.testing.assert_allclose(float(boot.compute()["mean"]), 75.0, atol=1e-5)
+
     def test_non_metric_raises(self):
         with pytest.raises(ValueError):
             BootStrapper(lambda x: x)
