@@ -37,6 +37,38 @@ def _average_precision_update(
     return preds, target, num_classes, pos_label
 
 
+def _binary_average_precision_static(preds: Array, target: Array, pos_label: int = 1) -> Array:
+    """Exact binary AP with static shapes (jit/vmap/shard_map-safe).
+
+    The curve form dedups thresholds with ``jnp.nonzero`` (a dynamic shape).
+    The step integral doesn't need the materialized curve: sort descending
+    once, locate tie-block ends, and sum ``(R_end - R_prev_end) * P_end``
+    over the block ends — exactly the deduped curve's
+    ``-sum((recall[1:]-recall[:-1]) * precision[:-1])`` (each unique
+    threshold contributes its END-of-block cumulative tp/fp, which is what
+    the dedup keeps). Same trick as ``_binary_roc_auc_static``.
+    """
+    p = preds.reshape(-1)
+    t = (target.reshape(-1) == pos_label).astype(jnp.int32)
+    n = p.shape[0]
+    neg_sorted, t_sorted = jax.lax.sort((-p, t), num_keys=1)  # descending by score
+    # exact integer counts (float32 cumsum silently plateaus past 2^24)
+    tp = jnp.cumsum(t_sorted).astype(jnp.float32)
+    fp = jnp.cumsum(1 - t_sorted).astype(jnp.float32)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    boundary = neg_sorted[1:] != neg_sorted[:-1]
+    is_end = jnp.concatenate([boundary, jnp.ones(1, dtype=bool)])
+    npos = tp[-1]
+    precision_i = tp / jnp.maximum(tp + fp, 1.0)
+    recall_i = tp / jnp.maximum(npos, 1.0)
+    prev_end = jax.lax.cummax(
+        jnp.concatenate([jnp.full((1,), -1, jnp.int32), jnp.where(is_end, idx, -1)[:-1]])
+    )
+    r_prev = jnp.where(prev_end >= 0, recall_i[jnp.clip(prev_end, 0)], 0.0)
+    ap = jnp.sum(jnp.where(is_end, (recall_i - r_prev) * precision_i, 0.0))
+    return jnp.where(npos > 0, ap, jnp.nan)
+
+
 def _average_precision_compute(
     preds: Array,
     target: Array,
@@ -46,7 +78,10 @@ def _average_precision_compute(
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
     """AP from the precision-recall curve (reference :59)."""
-    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label)
+    if num_classes == 1 and sample_weights is None:
+        # static-shape fast path (fully jittable, exactly the curve integral)
+        return _binary_average_precision_static(preds, target, 1 if pos_label is None else pos_label)
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
     if average == "weighted":
         if preds.ndim == target.ndim and target.ndim > 1:
             weights = target.sum(axis=0).astype(jnp.float32)

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval._segment import GroupContext, make_group_context
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 from metrics_tpu.utilities.data import dim_zero_cat
 
@@ -34,6 +35,11 @@ class RetrievalMetric(Metric, ABC):
             ``"skip"`` (drop query), or ``"error"`` for queries with no
             positive target.
         ignore_index: drop samples whose target equals this value.
+        sample_capacity: switch the unbounded cat-list states to
+            fixed-capacity HBM buffers (static shapes: jit/scan/shard_map
+            carries and in-graph mesh sync work; see
+            ``utilities/buffers.CapacityBuffer``). Incompatible with
+            ``ignore_index`` (row-dropping is a dynamic shape).
     """
 
     higher_is_better = True
@@ -44,6 +50,7 @@ class RetrievalMetric(Metric, ABC):
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        sample_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -53,11 +60,16 @@ class RetrievalMetric(Metric, ABC):
         self.empty_target_action = empty_target_action
         if ignore_index is not None and not isinstance(ignore_index, int):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
+        if sample_capacity is not None and ignore_index is not None:
+            raise ValueError(
+                "`sample_capacity` cannot be combined with `ignore_index`: dropping ignored rows is a"
+                " dynamic shape, which fixed-capacity buffer states cannot hold."
+            )
         self.ignore_index = ignore_index
 
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        self.add_state("indexes", default=_cat_state_default(sample_capacity), dist_reduce_fx=None)
+        self.add_state("preds", default=_cat_state_default(sample_capacity), dist_reduce_fx=None)
+        self.add_state("target", default=_cat_state_default(sample_capacity), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:

@@ -1,0 +1,206 @@
+"""Denser grids for the confusion-matrix-derived family and curve classes.
+
+Extends ``test_confmat_family.py`` / ``test_curves.py`` toward reference
+parametrization breadth (``tests/classification/test_cohen_kappa.py``,
+``test_jaccard.py``, ``test_auroc.py``, ``test_average_precision.py``):
+kappa weights x ddp, jaccard average/ignore_index/threshold combos,
+binary + multilabel confusion matrices, and class-API lifecycle + ddp for
+multiclass AUROC / AveragePrecision (the curve tests previously ran those
+only functionally).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+
+from metrics_tpu import AUROC, AveragePrecision, CohenKappa, ConfusionMatrix, JaccardIndex
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index
+from tests.classification.inputs import (
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _labels(x):
+    x = np.asarray(x)
+    return x.argmax(-1) if x.ndim > 1 and np.issubdtype(x.dtype, np.floating) else x
+
+
+class TestCohenKappaGrid(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    @pytest.mark.parametrize(
+        "inputs", [_multiclass_inputs, _multiclass_prob_inputs], ids=["labels", "probs"]
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_grid(self, weights, inputs, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=CohenKappa,
+            sk_metric=lambda p, t: sk_cohen_kappa(np.asarray(t), _labels(p), weights=weights),
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+            check_batch=False,
+        )
+
+
+class TestJaccardGrid(MetricTester):
+    """The reference's 0.9 Jaccard API reduces with `reduction`
+    (elementwise_mean == sklearn macro, none == per-class IoU); there is no
+    micro/weighted average kwarg."""
+
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "reduction, sk_average",
+        [("elementwise_mean", "macro"), ("none", None)],
+        ids=["mean", "none"],
+    )
+    @pytest.mark.parametrize(
+        "inputs", [_multiclass_inputs, _multiclass_prob_inputs], ids=["labels", "probs"]
+    )
+    def test_multiclass_reductions(self, reduction, sk_average, inputs):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=jaccard_index,
+            sk_metric=lambda p, t: sk_jaccard(
+                np.asarray(t), _labels(p), average=sk_average, labels=list(range(NUM_CLASSES)), zero_division=0
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "reduction": reduction},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_ddp_mean(self, ddp):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=JaccardIndex,
+            sk_metric=lambda p, t: sk_jaccard(
+                np.asarray(t), _labels(p), average="macro", labels=list(range(NUM_CLASSES)), zero_division=0
+            ),
+            metric_args={"num_classes": NUM_CLASSES},
+            check_batch=False,
+        )
+
+    def test_ignore_index_and_absent_score(self):
+        preds = jnp.asarray([0, 1, 1, 1])
+        target = jnp.asarray([0, 1, 1, 1])
+        # class 2 absent everywhere: absent_score fills its slot
+        out = jaccard_index(preds, target, num_classes=3, absent_score=0.5, reduction="none")
+        np.testing.assert_allclose(np.asarray(out), [1.0, 1.0, 0.5], atol=1e-6)
+        # ignore_index drops class 0 from the reduction
+        out = jaccard_index(preds, target, num_classes=3, ignore_index=0, absent_score=0.25, reduction="none")
+        np.testing.assert_allclose(np.asarray(out), [1.0, 0.25], atol=1e-6)
+
+
+class TestConfusionMatrixGrid(MetricTester):
+    atol = 1e-6
+
+    def test_binary_prob_confmat(self):
+        inputs = _binary_prob_inputs
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=confusion_matrix,
+            sk_metric=lambda p, t: sk_confusion_matrix(
+                np.asarray(t), (np.asarray(p) >= THRESHOLD).astype(int), labels=[0, 1]
+            ),
+            metric_args={"num_classes": 2, "threshold": THRESHOLD},
+        )
+
+    def test_multilabel_confmat_grid(self):
+        inputs = _multilabel_prob_inputs
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=confusion_matrix,
+            sk_metric=lambda p, t: sk_multilabel_confusion_matrix(
+                np.asarray(t), (np.asarray(p) >= THRESHOLD).astype(int)
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "threshold": THRESHOLD, "multilabel": True},
+        )
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_normalized_class_ddp(self, normalize, ddp):
+        """Normalization must happen on the SYNCED counts (a per-rank
+        normalize-then-sum would give a different matrix)."""
+        inputs = _multiclass_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=ConfusionMatrix,
+            sk_metric=lambda p, t: sk_confusion_matrix(
+                np.asarray(t), _labels(p), labels=list(range(NUM_CLASSES)), normalize=normalize
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+            check_batch=False,
+        )
+
+
+class TestCurveClassGrid(MetricTester):
+    """Class-API lifecycle + ddp for multiclass AUROC / AveragePrecision
+    (previously only covered functionally)."""
+
+    atol = 1e-5
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_auroc_class(self, average, ddp):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(
+                np.asarray(t), np.asarray(p), multi_class="ovr", average=average, labels=list(range(NUM_CLASSES))
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_average_precision_class(self, ddp):
+        inputs = _multiclass_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=AveragePrecision,
+            sk_metric=lambda p, t: np.mean(
+                [
+                    sk_average_precision((np.asarray(t) == c).astype(int), np.asarray(p)[:, c])
+                    for c in range(NUM_CLASSES)
+                ]
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            check_batch=False,
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_average_precision_class(self, ddp):
+        inputs = _binary_prob_inputs
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=AveragePrecision,
+            sk_metric=lambda p, t: sk_average_precision(np.asarray(t), np.asarray(p)),
+            metric_args={},
+            check_batch=False,
+        )

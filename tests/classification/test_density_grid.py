@@ -1,0 +1,299 @@
+"""Dense oracle grid for the StatScores-derived metric family.
+
+Reference-parity parametrization breadth (``tests/classification/
+test_precision_recall.py``, ``test_specificity.py``, ``test_f_beta.py``,
+``test_accuracy.py``): every input case x average (micro/macro/weighted/
+none/samples) x mdmc_average (global/samplewise) x ignore_index
+combination hits an independent numpy oracle derived from per-class
+tp/fp/tn/fn counts on the gate-formatted inputs — precision, recall,
+specificity, F-beta and (non-subset) accuracy are pure arithmetic on the
+same stat scores, so the oracle shares no code with the implementations'
+compute paths.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.functional import accuracy, f1_score, fbeta_score, precision, recall, specificity
+from metrics_tpu.utilities.checks import _input_format_classification
+from tests.classification.inputs import (
+    _binary_inputs,
+    _binary_prob_inputs,
+    _multiclass_inputs,
+    _multiclass_prob_inputs,
+    _multidim_multiclass_inputs,
+    _multidim_multiclass_prob_inputs,
+    _multilabel_inputs,
+    _multilabel_prob_inputs,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _class_stats(p, t):
+    """(tp, fp, tn, fn) per class: p/t are (N, C) one-hot/indicator."""
+    tp = np.logical_and(p == 1, t == 1).sum(0).astype(np.float64)
+    fp = np.logical_and(p == 1, t == 0).sum(0).astype(np.float64)
+    tn = np.logical_and(p == 0, t == 0).sum(0).astype(np.float64)
+    fn = np.logical_and(p == 0, t == 1).sum(0).astype(np.float64)
+    return tp, fp, tn, fn
+
+
+def _safe_div(num, den):
+    den = np.asarray(den, dtype=np.float64)
+    return np.where(den == 0, 0.0, np.asarray(num, np.float64) / np.where(den == 0, 1.0, den))
+
+
+def _score_from_stats(tp, fp, tn, fn, metric, beta, mode=None, average=None):
+    if metric == "precision":
+        return _safe_div(tp, tp + fp)
+    if metric == "recall":
+        return _safe_div(tp, tp + fn)
+    if metric == "specificity":
+        return _safe_div(tn, tn + fp)
+    if metric == "fbeta":
+        p = _safe_div(tp, tp + fp)
+        r = _safe_div(tp, tp + fn)
+        return _safe_div((1 + beta**2) * p * r, beta**2 * p + r)
+    if metric == "accuracy":
+        # reference accuracy.py:122-202: binary-micro/samples and multilabel
+        # count true negatives; every other mode is tp/(tp+fn)
+        if (mode == "binary" and average in ("micro", "samples")) or mode == "multi-label":
+            return _safe_div(tp + tn, tp + fp + tn + fn)
+        return _safe_div(tp, tp + fn)
+    raise AssertionError(metric)
+
+
+def _np_oracle(
+    preds,
+    target,
+    metric,
+    average,
+    mdmc_average=None,
+    num_classes=None,
+    ignore_index=None,
+    top_k=None,
+    beta=1.0,
+    multiclass=None,
+):
+    p, t, mode = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, top_k=top_k, multiclass=multiclass
+    )
+    p, t = np.asarray(p), np.asarray(t)
+    if p.ndim == 3 and mdmc_average == "global":
+        p = np.transpose(p, (0, 2, 1)).reshape(-1, p.shape[1])
+        t = np.transpose(t, (0, 2, 1)).reshape(-1, t.shape[1])
+
+    def one_slab(ps, ts):
+        """Score for one (N, C) slab under `average` + `ignore_index`."""
+        if ignore_index is not None and average == "micro":
+            ps = np.delete(ps, ignore_index, axis=1)
+            ts = np.delete(ts, ignore_index, axis=1)
+        if average == "micro":
+            tp, fp, tn, fn = _class_stats(ps.reshape(-1, 1), ts.reshape(-1, 1))
+            return float(_score_from_stats(tp, fp, tn, fn, metric, beta, mode, average)[0])
+        if average == "samples":
+            tp, fp, tn, fn = _class_stats(ps.T, ts.T)  # per-sample stats
+            return float(_score_from_stats(tp, fp, tn, fn, metric, beta, mode, average).mean())
+        tp, fp, tn, fn = _class_stats(ps, ts)
+        scores = _score_from_stats(tp, fp, tn, fn, metric, beta, mode, average)
+        keep = np.ones(len(scores), dtype=bool)
+        if ignore_index is not None:
+            keep[ignore_index] = False
+        if metric == "accuracy" and average == "macro" and mdmc_average != "samplewise":
+            # reference :186-188: absent classes drop out of the macro mean
+            keep &= np.asarray(tp + fp + fn) != 0
+        if average == "macro":
+            return float(scores[keep].mean())
+        if average == "weighted":
+            # specificity weights by the negative-class support (reference
+            # functional/classification/specificity.py), others by positives
+            support = ((tn + fp) if metric == "specificity" else (tp + fn))[keep]
+            return float((scores[keep] * support / support.sum()).sum())
+        if average in ("none", None):
+            return scores  # per-class vector (no ignore_index in grid)
+        raise AssertionError(average)
+
+    if p.ndim == 3:  # mdmc samplewise: score per sample, then mean
+        return float(np.mean([one_slab(p[i].T, t[i].T) for i in range(p.shape[0])]))
+    return one_slab(p, t)
+
+
+_METRICS = [
+    pytest.param("precision", Precision, precision, {}, id="precision"),
+    pytest.param("recall", Recall, recall, {}, id="recall"),
+    pytest.param("specificity", Specificity, specificity, {}, id="specificity"),
+    pytest.param("fbeta", FBetaScore, fbeta_score, {"beta": 2.0}, id="fbeta2"),
+    pytest.param("fbeta", F1Score, f1_score, {"_beta": 1.0}, id="f1"),
+    pytest.param("accuracy", Accuracy, accuracy, {}, id="accuracy"),
+]
+
+# (inputs, num_classes, mdmc, gate) rows; `gate` carries case-resolution
+# args (multiclass=False for ambiguous 0/1-int inputs); averages vary below
+_FLAT_CASES = [
+    pytest.param(_binary_prob_inputs, 1, None, {}, id="binary_prob"),
+    # integer 0/1 labels resolve to 2-class multiclass (the gate's documented
+    # behavior; num_classes=1 with int preds is an explicit error)
+    pytest.param(_binary_inputs, None, None, {}, id="binary"),
+    pytest.param(_multilabel_prob_inputs, NUM_CLASSES, None, {}, id="multilabel_prob"),
+    pytest.param(_multilabel_inputs, NUM_CLASSES, None, {"multiclass": False}, id="multilabel"),
+    pytest.param(_multiclass_prob_inputs, NUM_CLASSES, None, {}, id="multiclass_prob"),
+    pytest.param(_multiclass_inputs, NUM_CLASSES, None, {}, id="multiclass"),
+    pytest.param(_multidim_multiclass_prob_inputs, NUM_CLASSES, "global", {}, id="mdmc_prob-global"),
+    pytest.param(_multidim_multiclass_inputs, NUM_CLASSES, "global", {}, id="mdmc-global"),
+    pytest.param(_multidim_multiclass_prob_inputs, NUM_CLASSES, "samplewise", {}, id="mdmc_prob-samplewise"),
+    pytest.param(_multidim_multiclass_inputs, NUM_CLASSES, "samplewise", {}, id="mdmc-samplewise"),
+]
+
+
+def _args(metric_extra, average, num_classes, mdmc, gate=None):
+    extra = {k: v for k, v in metric_extra.items() if not k.startswith("_")}
+    return {
+        "threshold": THRESHOLD,
+        "average": average,
+        "num_classes": num_classes,
+        "mdmc_average": mdmc,
+        **(gate or {}),
+        **extra,
+    }
+
+
+class TestDenseGridFunctional(MetricTester):
+    """Every metric x input case x average through the functional form."""
+
+    atol = 1e-6
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize("inputs, num_classes, mdmc, gate", _FLAT_CASES)
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_averages(self, metric, cls, fn, extra, inputs, num_classes, mdmc, gate, average):
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        if num_classes in (1, None) and average != "micro":
+            pytest.skip("binary averaging is micro by construction")
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=fn,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, average, mdmc_average=mdmc, num_classes=num_classes, beta=beta, **gate
+            ),
+            metric_args=_args(extra, average, num_classes, mdmc, gate),
+        )
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize(
+        "inputs, num_classes, mdmc",
+        [
+            pytest.param(_multiclass_prob_inputs, NUM_CLASSES, None, id="multiclass_prob"),
+            pytest.param(_multilabel_prob_inputs, NUM_CLASSES, None, id="multilabel_prob"),
+            pytest.param(_multidim_multiclass_inputs, NUM_CLASSES, "global", id="mdmc-global"),
+        ],
+    )
+    def test_none_average_per_class(self, metric, cls, fn, extra, inputs, num_classes, mdmc):
+        if metric == "accuracy":
+            pytest.skip("accuracy's none-average absent-class sentinel is pinned in test_accuracy.py")
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=fn,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, "none", mdmc_average=mdmc, num_classes=num_classes, beta=beta
+            ),
+            metric_args=_args(extra, "none", num_classes, mdmc),
+        )
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize(
+        "inputs, gate",
+        [
+            pytest.param(_multilabel_prob_inputs, {}, id="multilabel_prob"),
+            pytest.param(_multilabel_inputs, {"multiclass": False}, id="multilabel"),
+        ],
+    )
+    def test_samples_average(self, metric, cls, fn, extra, inputs, gate):
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=fn,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, "samples", num_classes=NUM_CLASSES, beta=beta, **gate
+            ),
+            metric_args=_args(extra, "samples", NUM_CLASSES, None, gate),
+        )
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    @pytest.mark.parametrize("ignore_index", [0, 2])
+    @pytest.mark.parametrize(
+        "inputs, mdmc",
+        [
+            pytest.param(_multiclass_prob_inputs, None, id="multiclass_prob"),
+            pytest.param(_multiclass_inputs, None, id="multiclass"),
+            pytest.param(_multidim_multiclass_inputs, "global", id="mdmc-global"),
+        ],
+    )
+    def test_ignore_index(self, metric, cls, fn, extra, average, ignore_index, inputs, mdmc):
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=fn,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, average, mdmc_average=mdmc, num_classes=NUM_CLASSES,
+                ignore_index=ignore_index, beta=beta,
+            ),
+            metric_args={**_args(extra, average, NUM_CLASSES, mdmc), "ignore_index": ignore_index},
+        )
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize("top_k", [2, 3])
+    def test_top_k(self, metric, cls, fn, extra, top_k):
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        inputs = _multiclass_prob_inputs
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=fn,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, "macro", num_classes=NUM_CLASSES, top_k=top_k, beta=beta
+            ),
+            metric_args={**_args(extra, "macro", NUM_CLASSES, None), "top_k": top_k},
+        )
+
+
+class TestDenseGridClassDDP(MetricTester):
+    """Class-API lifecycle + virtual-DDP sync over a diagonal of the grid
+    (the functional grid above covers the math; this pins the stateful
+    accumulate/sync path for every metric and average kind)."""
+
+    atol = 1e-6
+
+    @pytest.mark.parametrize("metric, cls, fn, extra", _METRICS)
+    @pytest.mark.parametrize(
+        "inputs, num_classes, mdmc, average",
+        [
+            pytest.param(_binary_prob_inputs, 1, None, "micro", id="binary_prob-micro"),
+            pytest.param(_multiclass_prob_inputs, NUM_CLASSES, None, "macro", id="multiclass_prob-macro"),
+            pytest.param(_multilabel_prob_inputs, NUM_CLASSES, None, "weighted", id="multilabel-weighted"),
+            pytest.param(_multidim_multiclass_inputs, NUM_CLASSES, "samplewise", "micro", id="mdmc-samplewise"),
+        ],
+    )
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_class_ddp(self, metric, cls, fn, extra, inputs, num_classes, mdmc, average, dist_sync_on_step):
+        beta = extra.get("beta", extra.get("_beta", 1.0))
+        self.run_class_metric_test(
+            ddp=True,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=cls,
+            sk_metric=lambda p, t: _np_oracle(
+                p, t, metric, average, mdmc_average=mdmc, num_classes=num_classes, beta=beta
+            ),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={**_args(extra, average, num_classes, mdmc), "dist_sync_on_step": dist_sync_on_step},
+            check_batch=False,
+        )
