@@ -1,0 +1,23 @@
+# Developer entry points (the reference drives test/docs the same way,
+# /root/reference/Makefile).
+
+.PHONY: test docs doctest api clean-docs
+
+test:
+	python -m pytest tests/ -q
+
+# executable docstring examples (CI runs this as its own job)
+doctest:
+	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu -q
+
+# regenerate the per-symbol API pages that the sphinx site includes
+api:
+	JAX_PLATFORMS=cpu python docs/generate_api.py
+
+# build the documentation site (pip install -e ".[docs]" first)
+docs:
+	sphinx-build -W --keep-going -b html docs docs/_build/html
+	@echo "site at docs/_build/html/index.html"
+
+clean-docs:
+	rm -rf docs/_build

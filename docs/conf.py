@@ -1,0 +1,49 @@
+"""Sphinx configuration for the metrics-tpu documentation site.
+
+The equivalent of the reference's ``docs/source/conf.py`` (sphinx +
+readthedocs): the existing markdown guides and the generated per-symbol API
+pages (``docs/generate_api.py``) are built into one site via MyST. Build
+with ``make docs`` from the repo root (installs come from the ``[docs]``
+extra); doctests in the package run separately in CI via
+``pytest --doctest-modules``.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(".."))
+
+project = "metrics-tpu"
+copyright = "2026, metrics-tpu contributors"
+author = "metrics-tpu contributors"
+
+try:
+    from metrics_tpu import __version__ as release
+except Exception:  # building docs without the package importable
+    release = "0.0"
+
+extensions = [
+    "myst_parser",
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+myst_enable_extensions = ["colon_fence", "deflist"]
+source_suffix = {".md": "markdown", ".rst": "restructuredtext"}
+
+master_doc = "index"
+exclude_patterns = ["_build", "Thumbs.db", ".DS_Store"]
+
+html_theme = "furo"
+html_title = f"metrics-tpu {release}"
+
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3", None),
+    "jax": ("https://docs.jax.dev/en/latest", None),
+}
+
+# the generated API pages document every symbol already; autodoc is only
+# used opportunistically, so missing optional deps must not fail the build
+autodoc_mock_imports = ["flax", "transformers", "orbax", "optax", "torch"]
+nitpicky = False

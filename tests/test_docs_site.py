@@ -1,0 +1,41 @@
+"""Docs-site structure checks (runnable without sphinx).
+
+CI builds the real site (``make docs``); these assertions catch the
+failure modes that would break that build — dangling toctree entries,
+an uncompilable conf.py, API pages missing from the index — in every
+environment.
+"""
+import re
+from pathlib import Path
+
+DOCS = Path(__file__).resolve().parents[1] / "docs"
+
+
+def test_conf_compiles():
+    compile((DOCS / "conf.py").read_text(), "conf.py", "exec")
+
+
+def test_toctree_targets_exist():
+    index = (DOCS / "index.md").read_text()
+    entries = [
+        line.strip()
+        for block in re.findall(r"```\{toctree\}(.*?)```", index, re.S)
+        for line in block.splitlines()
+        if line.strip() and not line.strip().startswith(":")
+    ]
+    assert entries, "index.md must declare toctree entries"
+    for entry in entries:
+        assert (DOCS / f"{entry}.md").exists(), f"toctree entry {entry!r} has no source file"
+
+
+def test_api_index_lists_every_generated_page():
+    api = DOCS / "api"
+    readme = (api / "README.md").read_text()
+    pages = {p.stem for p in api.glob("*.md")} - {"README"}
+    for page in pages:
+        assert f"({page}.md)" in readme, f"api/README.md does not link {page}.md"
+
+
+def test_makefile_docs_target():
+    mk = (DOCS.parent / "Makefile").read_text()
+    assert "sphinx-build" in mk and "docs:" in mk
