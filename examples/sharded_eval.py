@@ -31,35 +31,53 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from metrics_tpu import Accuracy, MeanSquaredError, make_step
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, make_step
 
 N_DEV = min(8, jax.device_count())
 N_BATCHES, BATCH, N_CLASSES = 10, 64 * N_DEV, 5
+PER_DEV = BATCH // N_DEV
 
 mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
 
 acc_init, acc_step, acc_compute = make_step(Accuracy, num_classes=N_CLASSES, axis_name="dp")
 mse_init, mse_step, mse_compute = make_step(MeanSquaredError, axis_name="dp")
+# sample-state metric: per-device CapacityBuffers fill locally; compute
+# gathers data + fill counts across dp and runs the exact sort in-graph
+auc_init, auc_step, auc_compute = make_step(
+    AUROC, sample_capacity=N_BATCHES * PER_DEV, axis_name="dp", with_value=False
+)
 
 
 @jax.jit
-@partial(jax.shard_map, mesh=mesh, in_specs=(P(None, "dp"), P(None, "dp")), out_specs=(P(), P()))
+@partial(jax.shard_map, mesh=mesh, in_specs=(P(None, "dp"), P(None, "dp")), out_specs=(P(), P(), P()))
 def eval_epoch(preds, target):
     """(n_batches, BATCH/dp, C) shard -> globally reduced metric values."""
 
     def body(carry, batch):
-        acc_state, mse_state = carry
+        acc_state, mse_state, auc_state = carry
         p, t = batch
         acc_state, _ = acc_step(acc_state, p, t)
         mse_state, _ = mse_step(mse_state, p.max(axis=-1), t.astype(p.dtype) / N_CLASSES)
-        return (acc_state, mse_state), None
+        auc_state, _ = auc_step(auc_state, p[:, 1], (t == 1).astype(jnp.int32))
+        return (acc_state, mse_state, auc_state), None
 
     # the initial states are replicated constants while the scanned updates
     # are dp-varying; pcast once so the carry types line up (see the
-    # shard_map varying-axes docs)
-    init_carry = jax.lax.pcast((acc_init(), mse_init()), ("dp",), to="varying")
-    (acc_state, mse_state), _ = jax.lax.scan(body, init_carry, (preds, target))
-    return acc_compute(acc_state), mse_compute(mse_state)
+    # shard_map varying-axes docs). The AUROC buffers must be ALLOCATED
+    # before the scan fixes the carry structure: one unrolled step does it.
+    (acc0, mse0, auc0) = (acc_init(), mse_init(), auc_init())
+    p0, t0 = preds[0], target[0]
+    acc0, _ = acc_step(acc0, p0, t0)
+    mse0, _ = mse_step(mse0, p0.max(axis=-1), t0.astype(p0.dtype) / N_CLASSES)
+    auc0, _ = auc_step(auc0, p0[:, 1], (t0 == 1).astype(jnp.int32))
+    (acc_state, mse_state, auc_state), _ = jax.lax.scan(
+        body, (acc0, mse0, auc0), (preds[1:], target[1:])
+    )
+    # the scan carry re-enters as tracers, erasing the buffers' trace-time
+    # fill counts; the epoch length is static, so declare them back
+    for buf in auc_state.values():
+        buf.declare_count(N_BATCHES * PER_DEV)
+    return acc_compute(acc_state), mse_compute(mse_state), auc_compute(auc_state)
 
 
 def main() -> None:
@@ -67,17 +85,26 @@ def main() -> None:
     preds = jnp.asarray(rng.random((N_BATCHES, BATCH, N_CLASSES)), jnp.float32)
     target = jnp.asarray(rng.integers(0, N_CLASSES, (N_BATCHES, BATCH)))
 
-    accuracy, mse = eval_epoch(preds, target)
+    accuracy, mse, auc = eval_epoch(preds, target)
 
     # parity with the eager class API on the unsharded data
     eager_acc = Accuracy(num_classes=N_CLASSES)
     eager_mse = MeanSquaredError()
+    eager_auc = AUROC()
     for p, t in zip(preds, target):
         eager_acc.update(p, t)
         eager_mse.update(p.max(axis=-1), t.astype(p.dtype) / N_CLASSES)
+    # the sharded AUROC consumed samples in device-major order; order does
+    # not matter for the exact sort, so feed the eager oracle all data
+    eager_auc.update(preds[:, :, 1].reshape(-1), (target.reshape(-1) == 1).astype(jnp.int32))
     np.testing.assert_allclose(float(accuracy), float(eager_acc.compute()), atol=1e-6)
     np.testing.assert_allclose(float(mse), float(eager_mse.compute()), atol=1e-6)
-    print(f"devices={N_DEV} accuracy={float(accuracy):.4f} mse={float(mse):.4f} (both match eager)")
+    np.testing.assert_allclose(float(auc), float(eager_auc.compute()), atol=1e-6)
+    print(
+        f"devices={N_DEV} accuracy={float(accuracy):.4f} mse={float(mse):.4f}"
+        f" auroc={float(auc):.4f} (all match eager; AUROC's sample buffers"
+        " gathered in-graph)"
+    )
 
 
 if __name__ == "__main__":

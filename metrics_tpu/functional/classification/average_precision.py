@@ -81,6 +81,26 @@ def _average_precision_compute(
     if num_classes == 1 and sample_weights is None:
         # static-shape fast path (fully jittable, exactly the curve integral)
         return _binary_average_precision_static(preds, target, 1 if pos_label is None else pos_label)
+    if (
+        sample_weights is None
+        and average == "macro"
+        and num_classes is not None
+        and num_classes > 1
+        and preds.ndim == 2
+    ):
+        # per-class one-vs-rest static AP, vmapped over the class axis (the
+        # AUROC static multiclass pattern); classes with no positives are
+        # NaN and drop out of the mean, matching the curve path's exclusion
+        if target.ndim == 1:  # multiclass labels
+            per_class = jax.vmap(
+                lambda c: _binary_average_precision_static(preds[:, c], (target == c).astype(jnp.int32), 1)
+            )(jnp.arange(num_classes))
+        else:  # multilabel indicator
+            per_class = jax.vmap(
+                lambda c: _binary_average_precision_static(preds[:, c], target[:, c], 1)
+            )(jnp.arange(num_classes))
+        n_valid = jnp.sum(~jnp.isnan(per_class))
+        return jnp.where(n_valid > 0, jnp.nansum(per_class) / jnp.maximum(n_valid, 1), jnp.nan)
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
     if average == "weighted":
         if preds.ndim == target.ndim and target.ndim > 1:

@@ -225,3 +225,44 @@ def test_auroc_pos_label_zero():
     got = float(_auroc_compute(p, t, DataType.BINARY, pos_label=0))
     want = roc_auc_score(1 - np.asarray(t), np.asarray(p))
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_multiclass_macro_ap_static_jit():
+    """The vmapped per-class static AP path: sklearn parity, jit-stable,
+    absent classes excluded from the macro mean (curve-path semantics)."""
+    import jax
+    from sklearn.metrics import average_precision_score as sk_ap
+
+    rng = np.random.default_rng(3)
+    p = rng.random((200, NUM_CLASSES)).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    t = rng.integers(0, NUM_CLASSES, 200)
+    got = float(average_precision(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="macro"))
+    want = np.mean([sk_ap((t == c).astype(int), p[:, c]) for c in range(NUM_CLASSES)])
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    jitted = jax.jit(lambda a, b: average_precision(a, b, num_classes=NUM_CLASSES, average="macro"))
+    np.testing.assert_allclose(float(jitted(jnp.asarray(p), jnp.asarray(t))), got, atol=1e-6)
+    # absent class drops out of the mean
+    t2 = np.where(t == NUM_CLASSES - 1, 0, t)
+    got2 = float(average_precision(jnp.asarray(p), jnp.asarray(t2), num_classes=NUM_CLASSES, average="macro"))
+    want2 = np.mean([sk_ap((t2 == c).astype(int), p[:, c]) for c in range(NUM_CLASSES - 1)])
+    np.testing.assert_allclose(got2, want2, atol=1e-5)
+
+
+def test_multilabel_macro_ap_static_with_ties():
+    """The multilabel branch of the static macro-AP path, on tie-heavy
+    scores (quantized to 4 levels) — the regime where tie-block handling
+    matters."""
+    from sklearn.metrics import average_precision_score as sk_ap
+
+    rng = np.random.default_rng(4)
+    p = (rng.integers(0, 4, (150, NUM_CLASSES)) / 4.0).astype(np.float32)
+    t = rng.integers(0, 2, (150, NUM_CLASSES))
+    got = float(average_precision(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="macro"))
+    want = sk_ap(t, p, average="macro")
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # tie-heavy multiclass labels as well
+    tm = rng.integers(0, NUM_CLASSES, 150)
+    got = float(average_precision(jnp.asarray(p), jnp.asarray(tm), num_classes=NUM_CLASSES, average="macro"))
+    want = np.mean([sk_ap((tm == c).astype(int), p[:, c]) for c in range(NUM_CLASSES)])
+    np.testing.assert_allclose(got, want, atol=1e-5)

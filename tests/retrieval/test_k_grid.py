@@ -1,0 +1,141 @@
+"""Retrieval k-parameter and policy grids.
+
+Reference-breadth parametrization (``tests/retrieval/helpers.py:522-560``
+runs every metric through k grids, empty-target policies and argument
+validation): every k-accepting metric runs k in {1, 2, 5, None} through
+class + functional forms against the per-query numpy oracles, every metric
+runs all four empty_target_action policies, and constructor/functional
+argument validation is pinned per metric.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+)
+from tests.retrieval.test_retrieval import (
+    _grouped_oracle,
+    _np_fall_out,
+    _np_hit_rate,
+    _np_ndcg,
+    _np_precision,
+    _np_recall,
+    _indexes,
+    _preds,
+    _target,
+    _target_nonbinary,
+)
+
+_K_CASES = [
+    pytest.param(RetrievalPrecision, retrieval_precision, _np_precision, "pos", id="precision"),
+    pytest.param(RetrievalRecall, retrieval_recall, _np_recall, "pos", id="recall"),
+    pytest.param(RetrievalFallOut, retrieval_fall_out, _np_fall_out, "neg", id="fall_out"),
+    pytest.param(RetrievalHitRate, retrieval_hit_rate, _np_hit_rate, "pos", id="hit_rate"),
+    pytest.param(RetrievalNormalizedDCG, retrieval_normalized_dcg, _np_ndcg, "sum", id="ndcg"),
+]
+
+
+class TestKGrid:
+    @pytest.mark.parametrize("metric_class, fn, np_fn, needs", _K_CASES)
+    @pytest.mark.parametrize("k", [1, 2, 5, None])
+    def test_class_k(self, metric_class, fn, np_fn, needs, k):
+        target = _target_nonbinary if metric_class is RetrievalNormalizedDCG else _target
+        empty = "pos" if metric_class is RetrievalFallOut else "neg"
+        m = metric_class(k=k, empty_target_action=empty)
+        for b in range(_preds.shape[0]):
+            m.update(_preds[b], target[b], indexes=_indexes[b])
+        oracle = _grouped_oracle(partial(np_fn, k=k), needs=needs, empty_target_action=empty)
+        want = oracle(_preds.reshape(-1), target.reshape(-1), indexes=_indexes.reshape(-1))
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+
+    @pytest.mark.parametrize("metric_class, fn, np_fn, needs", _K_CASES)
+    @pytest.mark.parametrize("k", [1, 2, 5, None])
+    def test_functional_k(self, metric_class, fn, np_fn, needs, k):
+        target = _target_nonbinary if metric_class is RetrievalNormalizedDCG else _target
+        for b in range(2):
+            got = fn(_preds[b], target[b], k=k)
+            want = np_fn(np.asarray(_preds[b]), np.asarray(target[b]), k=k)
+            np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    @pytest.mark.parametrize("metric_class, fn, np_fn, needs", _K_CASES)
+    def test_invalid_k_rejected(self, metric_class, fn, np_fn, needs):
+        with pytest.raises(ValueError, match="`k`"):
+            metric_class(k=0)
+        with pytest.raises(ValueError, match="`k`"):
+            metric_class(k=-2)
+
+
+_ALL_METRICS = [
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalNormalizedDCG,
+]
+
+
+class TestPolicyGrid:
+    @pytest.mark.parametrize("metric_class", _ALL_METRICS)
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_empty_policy_every_metric(self, metric_class, action):
+        """A query whose targets are all-empty follows the policy; a defined
+        query contributes its real score."""
+        indexes = jnp.asarray([0, 0, 0, 1, 1, 1], dtype=jnp.int32)
+        preds = jnp.asarray([0.9, 0.6, 0.3, 0.8, 0.5, 0.2])
+        if metric_class is RetrievalFallOut:  # "empty" means no NEGATIVES
+            target = jnp.asarray([0, 1, 0, 1, 1, 1])
+        else:
+            target = jnp.asarray([1, 0, 1, 0, 0, 0])
+        m = metric_class(empty_target_action=action)
+        m.update(preds, target, indexes=indexes)
+        out = float(m.compute())
+        m_skip = metric_class(empty_target_action="skip")
+        m_skip.update(preds[:3], target[:3], indexes=indexes[:3])
+        defined_score = float(m_skip.compute())
+        if action == "skip":
+            np.testing.assert_allclose(out, defined_score, atol=1e-6)
+        else:
+            fill = 1.0 if action == "pos" else 0.0
+            np.testing.assert_allclose(out, (defined_score + fill) / 2, atol=1e-6)
+
+    @pytest.mark.parametrize("metric_class", _ALL_METRICS)
+    def test_error_policy_raises(self, metric_class):
+        indexes = jnp.asarray([0, 0], dtype=jnp.int32)
+        preds = jnp.asarray([0.9, 0.1])
+        target = (
+            jnp.asarray([1, 1]) if metric_class is RetrievalFallOut else jnp.asarray([0, 0])
+        )
+        m = metric_class(empty_target_action="error")
+        m.update(preds, target, indexes=indexes)
+        with pytest.raises(ValueError, match="no"):
+            m.compute()
+
+    @pytest.mark.parametrize("metric_class", _ALL_METRICS)
+    def test_bad_policy_rejected(self, metric_class):
+        with pytest.raises(ValueError, match="empty_target_action"):
+            metric_class(empty_target_action="bogus")
+
+    @pytest.mark.parametrize("metric_class", _ALL_METRICS)
+    def test_bad_ignore_index_rejected(self, metric_class):
+        with pytest.raises(ValueError, match="ignore_index"):
+            metric_class(ignore_index="nope")
