@@ -100,6 +100,13 @@ def _average_precision_compute(
                 lambda c: _binary_average_precision_static(preds[:, c], target[:, c], 1)
             )(jnp.arange(num_classes))
         n_valid = jnp.sum(~jnp.isnan(per_class))
+        if not isinstance(per_class, jax.core.Tracer) and bool(jnp.isnan(per_class).any()):
+            # eager parity with the curve path (reference :121): absent
+            # classes are excluded from the mean WITH a signal to the user
+            warnings.warn(
+                "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+                UserWarning,
+            )
         return jnp.where(n_valid > 0, jnp.nansum(per_class) / jnp.maximum(n_valid, 1), jnp.nan)
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
     if average == "weighted":
