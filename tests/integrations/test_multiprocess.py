@@ -52,6 +52,13 @@ _WORKER = textwrap.dedent(
     from sklearn.metrics import roc_auc_score
     np.testing.assert_allclose(float(auroc.compute()), roc_auc_score(target, preds), atol=1e-6)
 
+    # CapacityBuffer states across processes: per-rank buffers hold UNEVEN
+    # fill counts (120 vs 80); _sync_dist materializes the filled prefixes
+    # and gathers through the same uneven pad/trim path
+    auroc_buf = AUROC(sample_capacity=256)
+    auroc_buf.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    np.testing.assert_allclose(float(auroc_buf.compute()), roc_auc_score(target, preds), atol=1e-6)
+
     # dist_sync_on_step: the step value returned by forward must be the
     # GLOBAL batch value (sync happens inside forward, both ranks in the
     # collective simultaneously)
