@@ -48,7 +48,7 @@ __all__ = ["make_step"]
 
 
 def make_step(
-    metric: Union[Metric, Type[Metric]],
+    metric: Union[Metric, Type[Metric], "MetricCollection"],  # noqa: F821
     *init_args: Any,
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     with_value: bool = True,
@@ -59,7 +59,11 @@ def make_step(
     Args:
         metric: a :class:`Metric` subclass (constructed with
             ``*init_args, **init_kwargs``) or an existing instance (cloned;
-            its accumulated state is not carried over).
+            its accumulated state is not carried over). A
+            :class:`MetricCollection` instance also works: the state becomes
+            ``{metric_name: child_state}``, one traced program updates every
+            member, and ``init_args``/``init_kwargs`` are not accepted
+            (configure the collection before passing it).
         axis_name: mesh axis name(s) the state is sharded over. When given,
             ``compute`` reduces every state with its declared
             ``dist_reduce_fx`` via in-jit collectives before the final math —
@@ -94,11 +98,27 @@ def make_step(
         >>> compute(state)
         Array(0.75, dtype=float32)
     """
+    from metrics_tpu.collections import MetricCollection
+
+    if isinstance(metric, MetricCollection):
+        if init_args or init_kwargs:
+            raise TypeError("make_step(collection) takes no extra args; configure the collection itself")
+        return _make_collection_step(metric, axis_name=axis_name, with_value=with_value)
+
     if isinstance(metric, Metric):
         template = metric.clone()
         template.reset()
     else:
         template = metric(*init_args, **init_kwargs)
+
+    from metrics_tpu.wrappers.abstract import WrapperMetric
+
+    if isinstance(template, WrapperMetric):
+        raise ValueError(
+            f"{type(template).__name__} is a wrapper metric; its state lives in wrapped children whose"
+            " snapshots are not valid jitted-step carries. Build the step from the base metric and apply"
+            " the wrapper semantics outside the step, or use the eager class API."
+        )
 
     for name, default in template._defaults.items():
         if isinstance(default, list):
@@ -186,5 +206,52 @@ def make_step(
         m = _load(state)
         m._update_count = 1  # state arrived from outside; silence the unused-metric warning
         return m.compute()
+
+    return init, step, compute
+
+
+def _make_collection_step(
+    collection: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Pure step functions over a whole :class:`MetricCollection`.
+
+    The state is ``{metric_name: child_state}``; one ``step`` updates every
+    member inside the same traced program. The eager API's compute-group
+    dedup (update only the group representative, reference
+    ``collections.py:138-157``) is unnecessary here: members with identical
+    update math produce identical subexpressions that XLA's CSE folds into
+    one computation, so the collection pays for each distinct kernel once
+    per program regardless of how many metrics share it.
+    """
+    from metrics_tpu.utilities.data import _flatten_dict
+
+    template = collection.clone()
+    template.reset()
+    # base (un-prefixed) names key the state; outputs go through the same
+    # flatten + prefix/postfix naming as the eager collection's compute
+    # (collections.py:260-267), so dict-valued members splice identically
+    children = {name: m for name, m in template.items(keep_base=True, copy_state=False)}
+    subs = {
+        name: (make_step(m, axis_name=axis_name, with_value=with_value), m)
+        for name, m in children.items()
+    }
+
+    def _named(res: Dict[str, Any]) -> Dict[str, Any]:
+        return {template._set_name(k): v for k, v in _flatten_dict(res).items()}
+
+    def init() -> State:
+        return {name: sub_init() for name, ((sub_init, _, _), _) in subs.items()}
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        new_state: State = {}
+        values: Dict[str, Any] = {}
+        for name, ((_, sub_step, _), child) in subs.items():
+            new_state[name], values[name] = sub_step(state[name], *args, **child._filter_kwargs(**kwargs))
+        return new_state, (_named(values) if with_value else None)
+
+    def compute(state: State) -> Dict[str, Any]:
+        return _named({name: sub_compute(state[name]) for name, ((_, _, sub_compute), _) in subs.items()})
 
     return init, step, compute

@@ -90,6 +90,87 @@ class TestScanEpoch:
         np.testing.assert_allclose(float(compute(state)), 3.0, atol=1e-6)
 
 
+class TestCollectionStep:
+    def _collection(self):
+        from metrics_tpu import F1Score, MetricCollection, Precision, Recall
+
+        return MetricCollection(
+            [
+                Accuracy(num_classes=NUM_CLASSES),
+                Precision(num_classes=NUM_CLASSES, average="macro"),
+                Recall(num_classes=NUM_CLASSES, average="macro"),
+                F1Score(num_classes=NUM_CLASSES, average="macro"),
+            ]
+        )
+
+    def test_scan_epoch_matches_eager_collection(self):
+        """One jitted scan updates the whole collection; values match the
+        eager collection (whose compute groups dedup at dispatch level —
+        in-program, XLA CSE does the same folding)."""
+        rng = np.random.default_rng(10)
+        preds = jnp.asarray(rng.integers(0, NUM_CLASSES, (5, 32)))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, (5, 32)))
+        init, step, compute = make_step(self._collection())
+        state, _ = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+        out = compute(state)
+
+        eager = self._collection()
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        want = eager.compute()
+        assert set(out) == set(want)
+        for k in want:
+            np.testing.assert_allclose(float(out[k]), float(want[k]), atol=1e-6)
+
+    def test_collection_step_batch_values(self):
+        rng = np.random.default_rng(11)
+        p = jnp.asarray(rng.integers(0, NUM_CLASSES, (32,)))
+        t = jnp.asarray(rng.integers(0, NUM_CLASSES, (32,)))
+        init, step, compute = make_step(self._collection())
+        _, values = jax.jit(step)(init(), p, t)
+        eager = self._collection()
+        want = eager(p, t)  # forward: batch-local dict
+        for k in want:
+            np.testing.assert_allclose(float(values[k]), float(want[k]), atol=1e-6)
+
+    def test_collection_prefix_naming_matches_eager(self):
+        from metrics_tpu import MetricCollection
+
+        coll = MetricCollection([Accuracy(num_classes=3)], prefix="val_")
+        init, step, compute = make_step(coll)
+        state, vals = step(init(), jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        assert set(vals) == {"val_Accuracy"}
+        assert set(compute(state)) == {"val_Accuracy"}
+
+    def test_wrapper_members_rejected_with_guidance(self):
+        from metrics_tpu import MetricCollection
+        from metrics_tpu.wrappers import ClasswiseWrapper
+
+        with pytest.raises(ValueError, match="wrapper"):
+            make_step(ClasswiseWrapper(Accuracy(num_classes=3, average="none")))
+        with pytest.raises(ValueError, match="wrapper"):
+            make_step(MetricCollection({"cw": ClasswiseWrapper(Accuracy(num_classes=3, average="none"))}))
+
+    def test_collection_mesh_parity(self):
+        rng = np.random.default_rng(12)
+        preds = jnp.asarray(rng.integers(0, NUM_CLASSES, (64,)))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, (64,)))
+        init, step, compute = make_step(self._collection(), axis_name="dp")
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        out = jax.jit(jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P()))(
+            preds, target
+        )
+        eager = self._collection()
+        eager.update(preds, target)
+        want = eager.compute()
+        for k in want:
+            np.testing.assert_allclose(float(out[k]), float(want[k]), atol=1e-6)
+
+
 class TestShardMap:
     @pytest.mark.parametrize(
         "cls,kwargs,reduction_kind",
