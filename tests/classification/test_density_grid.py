@@ -10,7 +10,6 @@ specificity, F-beta and (non-subset) accuracy are pure arithmetic on the
 same stat scores, so the oracle shares no code with the implementations'
 compute paths.
 """
-from functools import partial
 
 import jax.numpy as jnp
 import numpy as np
