@@ -106,3 +106,63 @@ def test_wer_reference_doctest_values():
     np.testing.assert_allclose(float(match_error_rate(preds, target)), 0.4444, atol=1e-4)
     np.testing.assert_allclose(float(word_information_lost(preds, target)), 0.6528, atol=1e-4)
     np.testing.assert_allclose(float(word_information_preserved(preds, target)), 0.3472, atol=1e-4)
+
+
+class TestWERFamilyFuzz:
+    """Randomized corpora vs the numpy oracle — exercises the native C
+    Levenshtein across varied lengths (incl. empty and unicode hypotheses)
+    well beyond the fixed fixtures."""
+
+    @pytest.mark.parametrize("metric_class, metric_fn, ref_fn", _CASES)
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_corpora(self, metric_class, metric_fn, ref_fn, seed):
+        rng = np.random.default_rng(seed)
+        vocab = ["alpha", "beta", "gamma", "delta", "épsilon", "中文", "zeta-9", "x"]
+        preds, target = [], []
+        for _ in range(12):
+            nt = int(rng.integers(1, 9))
+            np_ = int(rng.integers(0, 9))
+            target.append(" ".join(rng.choice(vocab, nt)))
+            preds.append(" ".join(rng.choice(vocab, np_)) if np_ else "")
+        got = metric_fn(preds, target)
+        want = ref_fn(preds, target)
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-6)
+
+    @pytest.mark.parametrize("metric_class, metric_fn, ref_fn", _CASES)
+    def test_streaming_equals_single_shot(self, metric_class, metric_fn, ref_fn):
+        rng = np.random.default_rng(5)
+        vocab = ["a", "bb", "ccc", "dddd"]
+        preds = [" ".join(rng.choice(vocab, int(rng.integers(1, 6)))) for _ in range(9)]
+        target = [" ".join(rng.choice(vocab, int(rng.integers(1, 6)))) for _ in range(9)]
+        m = metric_class()
+        for s in range(0, 9, 3):
+            m.update(preds[s : s + 3], target[s : s + 3])
+        np.testing.assert_allclose(float(m.compute()), ref_fn(preds, target), atol=1e-6)
+
+
+_JIWER_INSTALLED = True
+try:
+    import jiwer  # noqa: F401
+except ImportError:
+    _JIWER_INSTALLED = False
+
+
+@pytest.mark.skipif(not _JIWER_INSTALLED, reason="jiwer package not installed")
+class TestWERFamilyJiwer:
+    """Reference-style pinning against jiwer (the reference's WER-family
+    oracle, ``/root/reference/tests/text/test_wer.py``), active whenever the
+    package is present."""
+
+    def test_wer_cer_mer_match_jiwer(self):
+        import jiwer
+
+        preds = ["hello duck", "fly over the lazy dog", ""]
+        target = ["hello world", "fly over the crazy dog", "empty hypothesis"]
+        out = jiwer.compute_measures(target, preds)
+        np.testing.assert_allclose(float(word_error_rate(preds, target)), out["wer"], atol=1e-6)
+        np.testing.assert_allclose(float(match_error_rate(preds, target)), out["mer"], atol=1e-6)
+        np.testing.assert_allclose(float(word_information_lost(preds, target)), out["wil"], atol=1e-6)
+        np.testing.assert_allclose(float(word_information_preserved(preds, target)), out["wip"], atol=1e-6)
+        np.testing.assert_allclose(
+            float(char_error_rate(preds, target)), jiwer.cer(target, preds), atol=1e-6
+        )
