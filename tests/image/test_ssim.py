@@ -139,3 +139,78 @@ class TestMSSSIM(MetricTester):
             multiscale_structural_similarity_index_measure(
                 _ms_inputs.preds[0], _ms_inputs.target[0], betas=(0.5, "a")
             )
+
+
+class TestSSIMGrid:
+    """Reference-breadth sigma/kernel/k-constant grid
+    (``/root/reference/tests/image/test_ssim.py`` parametrizes sigma and
+    invalid kernel combos)."""
+
+    @pytest.mark.parametrize("sigma", [0.5, 1.0, 1.5, 2.0])
+    def test_sigma_kernel_grid(self, sigma):
+        from tests.image.oracles import np_ssim_per_image
+
+        kernel_size = int(3.5 * sigma + 0.5) * 2 + 1  # the oracle's size rule
+        p, t = _inputs.preds[0], _inputs.target[0]
+        got = structural_similarity_index_measure(
+            p, t, sigma=sigma, kernel_size=kernel_size, data_range=1.0
+        )
+        want = np_ssim_per_image(p, t, data_range=1.0, sigma=sigma).mean()
+        np.testing.assert_allclose(float(got), want, atol=5e-4)
+
+    @pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1)])
+    def test_k_constants(self, k1, k2):
+        from tests.image.oracles import np_ssim_per_image
+
+        p, t = _inputs.preds[0], _inputs.target[0]
+        got = structural_similarity_index_measure(p, t, data_range=1.0, k1=k1, k2=k2)
+        want = np_ssim_per_image(p, t, data_range=1.0, k1=k1, k2=k2).mean()
+        np.testing.assert_allclose(float(got), want, atol=5e-4)
+
+    def test_contrast_sensitivity_matches_oracle(self):
+        from tests.image.oracles import np_ssim_per_image
+
+        p, t = _inputs.preds[0], _inputs.target[0]
+        got_ssim, got_cs = structural_similarity_index_measure(
+            p, t, data_range=1.0, reduction="none", return_contrast_sensitivity=True
+        )
+        want_ssim, want_cs = np_ssim_per_image(p, t, data_range=1.0, return_cs=True)
+        np.testing.assert_allclose(np.asarray(got_ssim), want_ssim, atol=5e-4)
+        np.testing.assert_allclose(np.asarray(got_cs), want_cs, atol=5e-4)
+
+    def test_return_full_image_shape(self):
+        """reduction='none' preserves the SSIM map; the default reduction
+        collapses it to a scalar — a reference quirk we mirror exactly
+        (reference ssim.py:189-192 applies `reduce` to the full image too)."""
+        p, t = _inputs.preds[0], _inputs.target[0]
+        score, full = structural_similarity_index_measure(
+            p, t, data_range=1.0, reduction="none", return_full_image=True
+        )
+        assert full.shape == p.shape
+        assert score.shape == (p.shape[0],)
+        _, full_scalar = structural_similarity_index_measure(p, t, data_range=1.0, return_full_image=True)
+        assert full_scalar.shape == ()  # the reference's default-reduction quirk
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            {"kernel_size": 4},  # even
+            {"kernel_size": -1},
+            {"sigma": 0.0},
+            {"sigma": -1.5},
+            {"kernel_size": (11, 11, 11)},  # rank mismatch with 2d input
+        ],
+    )
+    def test_invalid_kernel_args(self, kwargs):
+        p, t = _inputs.preds[0], _inputs.target[0]
+        with pytest.raises(ValueError):
+            structural_similarity_index_measure(p, t, data_range=1.0, **kwargs)
+
+    def test_unequal_kernel_size(self):
+        """Anisotropic (h, w) kernels are accepted (reference
+        test_ssim_unequal_kernel_size)."""
+        p, t = _inputs.preds[0], _inputs.target[0]
+        out = structural_similarity_index_measure(
+            p, t, data_range=1.0, kernel_size=(5, 11), sigma=(0.5, 1.5)
+        )
+        assert np.isfinite(float(out))
