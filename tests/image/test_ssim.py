@@ -12,7 +12,7 @@ from metrics_tpu.functional import (
     structural_similarity_index_measure,
 )
 from tests.helpers.testers import MetricTester
-from tests.image.oracles import np_ms_ssim, np_ssim
+from tests.image.oracles import np_ms_ssim, np_ssim, np_ssim_per_image
 
 Input = namedtuple("Input", ["preds", "target"])
 
@@ -148,8 +148,6 @@ class TestSSIMGrid:
 
     @pytest.mark.parametrize("sigma", [0.5, 1.0, 1.5, 2.0])
     def test_sigma_kernel_grid(self, sigma):
-        from tests.image.oracles import np_ssim_per_image
-
         kernel_size = int(3.5 * sigma + 0.5) * 2 + 1  # the oracle's size rule
         p, t = _inputs.preds[0], _inputs.target[0]
         got = structural_similarity_index_measure(
@@ -160,16 +158,12 @@ class TestSSIMGrid:
 
     @pytest.mark.parametrize("k1,k2", [(0.01, 0.03), (0.05, 0.1)])
     def test_k_constants(self, k1, k2):
-        from tests.image.oracles import np_ssim_per_image
-
         p, t = _inputs.preds[0], _inputs.target[0]
         got = structural_similarity_index_measure(p, t, data_range=1.0, k1=k1, k2=k2)
         want = np_ssim_per_image(p, t, data_range=1.0, k1=k1, k2=k2).mean()
         np.testing.assert_allclose(float(got), want, atol=5e-4)
 
     def test_contrast_sensitivity_matches_oracle(self):
-        from tests.image.oracles import np_ssim_per_image
-
         p, t = _inputs.preds[0], _inputs.target[0]
         got_ssim, got_cs = structural_similarity_index_measure(
             p, t, data_range=1.0, reduction="none", return_contrast_sensitivity=True
@@ -207,10 +201,17 @@ class TestSSIMGrid:
             structural_similarity_index_measure(p, t, data_range=1.0, **kwargs)
 
     def test_unequal_kernel_size(self):
-        """Anisotropic (h, w) kernels are accepted (reference
-        test_ssim_unequal_kernel_size)."""
+        """Anisotropic kernels are accepted (reference
+        test_ssim_unequal_kernel_size): gaussian mode sizes the window from
+        the per-axis sigmas; uniform mode makes kernel_size load-bearing."""
         p, t = _inputs.preds[0], _inputs.target[0]
-        out = structural_similarity_index_measure(
-            p, t, data_range=1.0, kernel_size=(5, 11), sigma=(0.5, 1.5)
-        )
+        out = structural_similarity_index_measure(p, t, data_range=1.0, sigma=(0.5, 1.5))
         assert np.isfinite(float(out))
+        out_u = structural_similarity_index_measure(
+            p, t, data_range=1.0, gaussian_kernel=False, kernel_size=(5, 11)
+        )
+        assert np.isfinite(float(out_u))
+        out_u2 = structural_similarity_index_measure(
+            p, t, data_range=1.0, gaussian_kernel=False, kernel_size=(11, 5)
+        )
+        assert float(out_u) != float(out_u2)  # kernel_size actually flows through
