@@ -152,3 +152,100 @@ def test_calibration_error_class_streaming():
     got_stream = float(m.compute())
     got_once = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), n_bins=10))
     assert got_stream == pytest.approx(got_once, abs=1e-6)
+
+
+@pytest.mark.parametrize("squared", [False, True])
+@pytest.mark.parametrize("mode", [None, "one-vs-all"])
+def test_hinge_squared_grid(squared, mode):
+    """squared x multiclass_mode grid vs a direct numpy hinge
+    (reference test_hinge.py parametrizes the same axes)."""
+    rng = np.random.default_rng(7)
+    n, c = 64, 4
+    preds = rng.normal(0, 1.5, (n, c)).astype(np.float32)
+    target = rng.integers(0, c, n)
+    got = np.asarray(hinge_loss(jnp.asarray(preds), jnp.asarray(target), squared=squared, multiclass_mode=mode))
+
+    if mode is None:  # crammer-singer: margin vs best wrong class
+        margin = preds[np.arange(n), target] - np.where(
+            np.eye(c, dtype=bool)[target], -np.inf, preds
+        ).max(1)
+        losses = np.clip(1 - margin, 0, None)
+        expected = np.mean(losses**2 if squared else losses)
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+    else:  # one-vs-all: per-class binary hinge
+        t_signed = np.where(np.eye(c, dtype=bool)[target], 1.0, -1.0)
+        losses = np.clip(1 - t_signed * preds, 0, None)
+        expected = np.mean(losses**2 if squared else losses, axis=0)
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_kl_divergence_log_prob_and_reductions():
+    from scipy.stats import entropy
+
+    rng = np.random.default_rng(8)
+    p = rng.random((16, 6)).astype(np.float32)
+    q = rng.random((16, 6)).astype(np.float32)
+    p_n = p / p.sum(-1, keepdims=True)
+    q_n = q / q.sum(-1, keepdims=True)
+    per_sample = np.asarray([entropy(p_n[i], q_n[i]) for i in range(16)])
+
+    # log-space inputs
+    got = kl_divergence(jnp.asarray(np.log(p_n)), jnp.asarray(np.log(q_n)), log_prob=True)
+    np.testing.assert_allclose(float(got), per_sample.mean(), atol=1e-5)
+    # reductions
+    np.testing.assert_allclose(
+        float(kl_divergence(jnp.asarray(p), jnp.asarray(q), reduction="sum")), per_sample.sum(), atol=1e-4
+    )
+    got_none = kl_divergence(jnp.asarray(p), jnp.asarray(q), reduction="none")
+    np.testing.assert_allclose(np.asarray(got_none), per_sample, atol=1e-5)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_error_multiclass(norm):
+    """Top-label calibration on (N, C) probabilities: confidence is the max
+    prob, accuracy is argmax == target (reference semantics)."""
+    rng = np.random.default_rng(9)
+    n, c = 300, 5
+    raw = rng.random((n, c)).astype(np.float32)
+    preds = raw / raw.sum(1, keepdims=True)
+    target = rng.integers(0, c, n)
+    got = float(calibration_error(jnp.asarray(preds), jnp.asarray(target), n_bins=10, norm=norm))
+
+    conf = preds.max(1)
+    acc = (preds.argmax(1) == target).astype(float)
+    bins = np.linspace(0, 1, 11)
+    idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, 9)
+    terms = [(abs(acc[idx == b].mean() - conf[idx == b].mean()), (idx == b).mean())
+             for b in range(10) if (idx == b).sum()]
+    if norm == "l1":
+        expected = sum(g * p for g, p in terms)
+    elif norm == "max":
+        expected = max(g for g, _ in terms)
+    else:
+        expected = np.sqrt(sum(g**2 * p for g, p in terms))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_ranking_ddp_class_grid():
+    """All three multilabel ranking metrics through the virtual-DDP class
+    path in one sweep (they share state layout)."""
+    from metrics_tpu import CoverageError, LabelRankingAveragePrecision, LabelRankingLoss
+    from sklearn.metrics import (
+        coverage_error as sk_cov,
+        label_ranking_average_precision_score as sk_lrap,
+        label_ranking_loss as sk_lrl,
+    )
+    from tests.helpers.testers import _wire_virtual_ddp
+
+    rng = np.random.default_rng(10)
+    preds = rng.random((4, 32, 5)).astype(np.float32)
+    target = rng.integers(0, 2, (4, 32, 5))
+    for cls, sk in ((CoverageError, sk_cov), (LabelRankingAveragePrecision, sk_lrap), (LabelRankingLoss, sk_lrl)):
+        ranks = [cls() for _ in range(2)]
+        _wire_virtual_ddp(ranks)
+        ranks[0].update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        ranks[1].update(jnp.asarray(preds[1]), jnp.asarray(target[1]))
+        ranks[0].update(jnp.asarray(preds[2]), jnp.asarray(target[2]))
+        ranks[1].update(jnp.asarray(preds[3]), jnp.asarray(target[3]))
+        want = sk(target.reshape(-1, 5), preds.reshape(-1, 5))
+        np.testing.assert_allclose(float(ranks[0].compute()), want, atol=1e-5)
