@@ -49,4 +49,4 @@ class PerceptualEvaluationSpeechQuality(Metric):
         self.total = self.total + pesq_batch.size
 
     def compute(self) -> Array:
-        return self.sum_pesq / self.total
+        return self.sum_pesq / jnp.asarray(self.total, dtype=self.sum_pesq.dtype)

@@ -65,4 +65,4 @@ class PermutationInvariantTraining(Metric):
         self.total = self.total + pit_metric.size
 
     def compute(self) -> Array:
-        return self.sum_pit_metric / self.total
+        return self.sum_pit_metric / jnp.asarray(self.total, dtype=self.sum_pit_metric.dtype)

@@ -62,7 +62,7 @@ class SignalDistortionRatio(Metric):
         self.total = self.total + sdr_batch.size
 
     def compute(self) -> Array:
-        return self.sum_sdr / self.total
+        return self.sum_sdr / jnp.asarray(self.total, dtype=self.sum_sdr.dtype)
 
 
 class ScaleInvariantSignalDistortionRatio(Metric):
@@ -94,4 +94,4 @@ class ScaleInvariantSignalDistortionRatio(Metric):
         self.total = self.total + si_sdr_batch.size
 
     def compute(self) -> Array:
-        return self.sum_si_sdr / self.total
+        return self.sum_si_sdr / jnp.asarray(self.total, dtype=self.sum_si_sdr.dtype)

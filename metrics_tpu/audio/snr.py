@@ -43,7 +43,7 @@ class SignalNoiseRatio(Metric):
         self.total = self.total + snr_batch.size
 
     def compute(self) -> Array:
-        return self.sum_snr / self.total
+        return self.sum_snr / jnp.asarray(self.total, dtype=self.sum_snr.dtype)
 
 
 class ScaleInvariantSignalNoiseRatio(Metric):
@@ -74,4 +74,4 @@ class ScaleInvariantSignalNoiseRatio(Metric):
         self.total = self.total + si_snr_batch.size
 
     def compute(self) -> Array:
-        return self.sum_si_snr / self.total
+        return self.sum_si_snr / jnp.asarray(self.total, dtype=self.sum_si_snr.dtype)

@@ -45,4 +45,4 @@ class ShortTimeObjectiveIntelligibility(Metric):
         self.total = self.total + stoi_batch.size
 
     def compute(self) -> Array:
-        return self.sum_stoi / self.total
+        return self.sum_stoi / jnp.asarray(self.total, dtype=self.sum_stoi.dtype)

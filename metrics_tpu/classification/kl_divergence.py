@@ -55,7 +55,7 @@ class KLDivergence(Metric):
     def compute(self) -> Array:
         measures = dim_zero_cat(self.measures) if self.reduction in ("none", None) else self.measures
         if self.reduction == "mean":
-            return measures / self.total
+            return measures / jnp.asarray(self.total, dtype=measures.dtype)
         if self.reduction == "sum":
             return measures
         return measures

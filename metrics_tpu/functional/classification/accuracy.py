@@ -145,7 +145,7 @@ def _subset_accuracy_update(
 
 
 def _subset_accuracy_compute(correct: Array, total: Array) -> Array:
-    return correct.astype(jnp.float32) / total
+    return correct.astype(jnp.float32) / jnp.asarray(total, dtype=jnp.float32)
 
 
 def accuracy(

@@ -151,6 +151,7 @@ def _auroc_compute(
                     support = jnp.sum(target, axis=0)
                 else:
                     support = _bincount(target.reshape(-1), minlength=num_classes)
+                support = support.astype(jnp.float32)
                 return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
             allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
             raise ValueError(f"Argument `average` expected to be one of the following: {allowed_average} but got {average}")

@@ -107,7 +107,7 @@ def _average_precision_compute(
                 "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
                 UserWarning,
             )
-        return jnp.where(n_valid > 0, jnp.nansum(per_class) / jnp.maximum(n_valid, 1), jnp.nan)
+        return jnp.where(n_valid > 0, jnp.nansum(per_class) / jnp.maximum(n_valid, 1).astype(per_class.dtype), jnp.nan)
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
     if average == "weighted":
         if preds.ndim == target.ndim and target.ndim > 1:

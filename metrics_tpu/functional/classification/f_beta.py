@@ -20,7 +20,8 @@ Array = jax.Array
 
 def _safe_divide(num: Array, denom: Array) -> Array:
     """num / denom with zero denominators mapped to 1 (reference :24)."""
-    denom = jnp.where(denom == 0, 1, denom)
+    denom = jnp.asarray(denom, dtype=num.dtype)  # int counts meet f32 numerators
+    denom = jnp.where(denom == 0, jnp.ones((), dtype=denom.dtype), denom)
     return num / denom
 
 

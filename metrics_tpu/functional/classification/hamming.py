@@ -23,7 +23,7 @@ def _hamming_distance_update(preds: Array, target: Array, threshold: float = 0.5
 
 def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
     """1 - correct/total (reference :45)."""
-    return 1 - correct.astype(jnp.float32) / total
+    return 1 - correct.astype(jnp.float32) / jnp.asarray(total, dtype=jnp.float32)
 
 
 def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:

@@ -37,16 +37,16 @@ def _binary_clf_curve(
 
     preds = preds[desc_score_indices]
     target = target[desc_score_indices]
-    weight = sample_weights[desc_score_indices] if sample_weights is not None else 1.0
-
     distinct_value_indices = jnp.nonzero(preds[1:] - preds[:-1])[0]
     threshold_idxs = jnp.pad(distinct_value_indices, (0, 1), constant_values=target.shape[0] - 1)
     target = (target == pos_label).astype(jnp.int32)
-    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
-
     if sample_weights is not None:
-        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+        weight = sample_weights[desc_score_indices].astype(jnp.float32)
+        tps = jnp.cumsum(target.astype(jnp.float32) * weight, axis=0)[threshold_idxs]
+        fps = jnp.cumsum((1.0 - target.astype(jnp.float32)) * weight, axis=0)[threshold_idxs]
     else:
+        # unweighted: exact integer counts (also strict-promotion clean)
+        tps = jnp.cumsum(target, axis=0)[threshold_idxs]
         fps = 1 + threshold_idxs - tps
     return fps, tps, preds[threshold_idxs]
 

@@ -33,6 +33,7 @@ def _check_ranking_input(preds: Array, target: Array, sample_weight: Optional[Ar
 def _weighted_mean(value, n_elements, sample_weight):
     """value / sum(weights), falling back to / n_elements when the weight sum
     is zero (or no weights were given) — trace-safe, no host pull."""
+    n_elements = jnp.asarray(n_elements, dtype=jnp.float32)  # gathered int counts
     if sample_weight is None:
         return value / n_elements
     safe = jnp.where(sample_weight != 0.0, sample_weight, 1.0)
@@ -87,7 +88,7 @@ def _label_ranking_average_precision_update(
     ratio = jnp.where(relevant, rank_rel / rank_all, 0.0)
     per_sample = jnp.where(
         (n_rel > 0) & (n_rel < n_labels),
-        ratio.sum(axis=1) / jnp.maximum(n_rel, 1),
+        ratio.sum(axis=1) / jnp.maximum(n_rel, 1).astype(jnp.float32),
         1.0,
     )
     if sample_weight is not None:
@@ -119,10 +120,11 @@ def _label_ranking_loss_update(
     mask = (n_rel > 0) & (n_rel < n_labels)
 
     inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
-    per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
-    correction = 0.5 * n_rel * (n_rel + 1)
-    denom = n_rel * (n_labels - n_rel)
-    loss = jnp.where(mask, (per_label_loss.sum(axis=1) - correction) / jnp.maximum(denom, 1), 0.0)
+    per_label_loss = ((n_labels - inverse) * relevant.astype(jnp.int32)).astype(jnp.float32)
+    n_rel_f = n_rel.astype(jnp.float32)
+    correction = 0.5 * n_rel_f * (n_rel_f + 1.0)
+    denom = n_rel_f * (n_labels - n_rel_f)
+    loss = jnp.where(mask, (per_label_loss.sum(axis=1) - correction) / jnp.maximum(denom, 1.0), 0.0)
     if sample_weight is not None:
         loss = loss * jnp.where(mask, sample_weight, 0.0)
         sample_weight = sample_weight.sum()

@@ -22,7 +22,9 @@ def _psnr_compute(
     base: float = 10.0,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(
+        sum_squared_error / jnp.asarray(n_obs, dtype=sum_squared_error.dtype)
+    )
     psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
     return reduce(psnr_vals, reduction)
 
@@ -31,7 +33,10 @@ def _psnr_update(
     preds: Array, target: Array, dim: Optional[Union[int, Tuple[int, ...]]] = None
 ) -> Tuple[Array, Array]:
     """Sum of squared error + observation count, optionally per-``dim``."""
-    preds = preds.astype(jnp.result_type(preds.dtype, jnp.float32))
+    # promote to at least f32 without result_type (which is a strict-mode
+    # promotion error for bf16 vs f32): sub-32-bit floats and ints go to f32
+    if not jnp.issubdtype(preds.dtype, jnp.floating) or jnp.finfo(preds.dtype).bits < 32:
+        preds = preds.astype(jnp.float32)
     target = target.astype(preds.dtype)
     if dim is None:
         sum_squared_error = jnp.sum((preds - target) ** 2)

@@ -39,6 +39,7 @@ def _explained_variance_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Sufficient stats -> explained variance score."""
+    n_obs = jnp.asarray(n_obs, dtype=sum_error.dtype)
     diff_avg = sum_error / n_obs
     numerator = sum_squared_error / n_obs - diff_avg * diff_avg
     target_avg = sum_target / n_obs

@@ -25,7 +25,7 @@ def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, 
 
 
 def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Array:
-    return sum_squared_log_error / n_obs
+    return sum_squared_log_error / jnp.asarray(n_obs, dtype=sum_squared_log_error.dtype)
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:

@@ -24,7 +24,7 @@ def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int
 
 
 def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
-    return sum_abs_error / n_obs
+    return sum_abs_error / jnp.asarray(n_obs, dtype=sum_abs_error.dtype)
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:

@@ -27,6 +27,7 @@ def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]
 
 def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool = True) -> Array:
     """Sufficient stats -> MSE (or RMSE when ``squared=False``)."""
+    n_obs = jnp.asarray(n_obs, dtype=sum_squared_error.dtype)
     mse = sum_squared_error / n_obs
     return mse if squared else jnp.sqrt(mse)
 

@@ -44,6 +44,7 @@ def _r2_score_compute(
     if not isinstance(n_obs, jax.core.Tracer) and n_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
+    n_obs = jnp.asarray(n_obs, dtype=sum_obs.dtype)
     mean_obs = sum_obs / n_obs
     tss = sum_squared_obs - sum_obs * mean_obs
     raw_scores = 1 - (rss / tss)

@@ -27,7 +27,7 @@ def _symmetric_mean_absolute_percentage_error_update(
 
 
 def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, n_obs) -> Array:
-    return sum_abs_per_error / n_obs
+    return sum_abs_per_error / jnp.asarray(n_obs, dtype=sum_abs_per_error.dtype)
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:

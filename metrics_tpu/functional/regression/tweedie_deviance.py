@@ -66,7 +66,7 @@ def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 
 
 
 def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
-    return sum_deviance_score / num_observations
+    return sum_deviance_score / jnp.asarray(num_observations, dtype=sum_deviance_score.dtype)
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:

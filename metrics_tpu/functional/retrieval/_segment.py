@@ -129,7 +129,7 @@ def average_precision_scores(ctx: GroupContext) -> Array:
     """Per-group IR average precision (ref ``functional/retrieval/average_precision.py:20``)."""
     t = (ctx.target > 0).astype(jnp.float32)
     hits = ctx.group_cumsum(t)  # relevant seen up to and incl. this rank
-    contrib = t * hits / (ctx.rank + 1.0)
+    contrib = t * hits / (ctx.rank + 1).astype(jnp.float32)
     total = ctx.group_sum(contrib)
     return jnp.where(ctx.npos > 0, total / jnp.maximum(ctx.npos, 1.0), 0.0)
 
@@ -138,7 +138,7 @@ def reciprocal_rank_scores(ctx: GroupContext) -> Array:
     """Per-group reciprocal rank (ref ``functional/retrieval/reciprocal_rank.py:20``)."""
     sentinel = ctx.num_segments
     first_hit = ctx.group_min(jnp.where(ctx.target > 0, ctx.rank, sentinel))
-    return jnp.where(first_hit < sentinel, 1.0 / (first_hit + 1.0), 0.0)
+    return jnp.where(first_hit < sentinel, 1.0 / (first_hit + 1).astype(jnp.float32), 0.0)
 
 
 def precision_scores(ctx: GroupContext, k: Optional[int], adaptive_k: bool = False) -> Array:
@@ -150,22 +150,22 @@ def precision_scores(ctx: GroupContext, k: Optional[int], adaptive_k: bool = Fal
     else:
         k_g = jnp.where(adaptive_k, jnp.minimum(k, ctx.count), k).astype(jnp.float32)
         mask = _topk_mask(ctx, k)
-    rel = ctx.group_sum(t * mask)
+    rel = ctx.group_sum(t * mask.astype(t.dtype))
     return jnp.where(ctx.npos > 0, rel / jnp.maximum(k_g, 1.0), 0.0)
 
 
 def r_precision_scores(ctx: GroupContext) -> Array:
     """Per-group R-precision (ref ``functional/retrieval/r_precision.py:20``)."""
     t = (ctx.target > 0).astype(jnp.float32)
-    in_top_r = ctx.rank < ctx.npos
-    rel = ctx.group_sum(t * in_top_r)
+    in_top_r = ctx.rank.astype(jnp.float32) < ctx.npos
+    rel = ctx.group_sum(t * in_top_r.astype(t.dtype))
     return jnp.where(ctx.npos > 0, rel / jnp.maximum(ctx.npos, 1.0), 0.0)
 
 
 def recall_scores(ctx: GroupContext, k: Optional[int]) -> Array:
     """Per-group recall@k (ref ``functional/retrieval/recall.py:20``)."""
     t = (ctx.target > 0).astype(jnp.float32)
-    rel = ctx.group_sum(t * _topk_mask(ctx, k))
+    rel = ctx.group_sum(t * _topk_mask(ctx, k).astype(t.dtype))
     return jnp.where(ctx.npos > 0, rel / jnp.maximum(ctx.npos, 1.0), 0.0)
 
 
@@ -173,14 +173,14 @@ def fall_out_scores(ctx: GroupContext, k: Optional[int]) -> Array:
     """Per-group fall-out@k over NEGATIVE documents (ref ``functional/retrieval/fall_out.py:21``)."""
     neg = (ctx.target <= 0).astype(jnp.float32)
     nneg = ctx.group_sum(neg)
-    ret_neg = ctx.group_sum(neg * _topk_mask(ctx, k))
+    ret_neg = ctx.group_sum(neg * _topk_mask(ctx, k).astype(neg.dtype))
     return jnp.where(nneg > 0, ret_neg / jnp.maximum(nneg, 1.0), 0.0)
 
 
 def hit_rate_scores(ctx: GroupContext, k: Optional[int]) -> Array:
     """Per-group hit rate@k (ref ``functional/retrieval/hit_rate.py:20``)."""
     t = (ctx.target > 0).astype(jnp.float32)
-    rel = ctx.group_sum(t * _topk_mask(ctx, k))
+    rel = ctx.group_sum(t * _topk_mask(ctx, k).astype(t.dtype))
     return (rel > 0).astype(jnp.float32)
 
 
@@ -188,15 +188,15 @@ def ndcg_scores(ctx: GroupContext, k: Optional[int]) -> Array:
     """Per-group normalized DCG, non-binary targets allowed (ref
     ``functional/retrieval/ndcg.py:29-74``)."""
     t = ctx.target.astype(jnp.float32)
-    discount = 1.0 / jnp.log2(ctx.rank + 2.0)
+    discount = 1.0 / jnp.log2((ctx.rank + 2).astype(jnp.float32))
     mask = _topk_mask(ctx, k)
-    dcg = ctx.group_sum(t * discount * mask)
+    dcg = ctx.group_sum(t * discount * mask.astype(t.dtype))
 
     # ideal ordering: targets descending within each group; a second stable
     # two-key sort carries the values (group layout and boundaries unchanged)
     _, t_ideal = jax.lax.sort((ctx.gid, -t), num_keys=2)
     t_ideal = -t_ideal
-    ideal = ctx.group_sum(t_ideal * discount * mask)
+    ideal = ctx.group_sum(t_ideal * discount * mask.astype(t.dtype))
     # reference ndcg.py:70-72 zeroes only the ideal == 0 case; a negative
     # ideal (negative relevances are legal non-binary targets) still divides.
     return jnp.where(ideal != 0, dcg / jnp.where(ideal != 0, ideal, 1.0), 0.0)

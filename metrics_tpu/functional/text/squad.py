@@ -12,6 +12,7 @@ from collections import Counter
 from typing import Any, Dict, List, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.functional.text.helper import _put_all
@@ -116,9 +117,10 @@ def _squad_update(
 
 def _squad_compute(f1_score: Array, exact_match: Array, total: Array) -> Dict[str, Array]:
     """Scale sums to percentages."""
+    total = jnp.asarray(total, dtype=jnp.float32)
     return {
-        "exact_match": 100.0 * exact_match / total,
-        "f1": 100.0 * f1_score / total,
+        "exact_match": 100.0 * exact_match.astype(jnp.float32) / total,
+        "f1": 100.0 * f1_score.astype(jnp.float32) / total,
     }
 
 

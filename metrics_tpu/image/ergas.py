@@ -64,5 +64,5 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
         if self._streaming:
             if self.reduction == "sum":
                 return self.score_sum
-            return self.score_sum / self.total
+            return self.score_sum / jnp.asarray(self.total, dtype=self.score_sum.dtype)
         return reduce(dim_zero_cat(self.scores), self.reduction)

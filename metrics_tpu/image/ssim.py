@@ -104,7 +104,7 @@ class StructuralSimilarityIndexMeasure(Metric):
         if self._streaming:
             if self.reduction == "sum":
                 return self.similarity
-            return self.similarity / self.total
+            return self.similarity / jnp.asarray(self.total, dtype=self.similarity.dtype)
         return _ssim_compute(
             dim_zero_cat(self.preds),
             dim_zero_cat(self.target),
@@ -205,7 +205,8 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
             if self.reduction == "sum":
                 sim_stat, cs_stat = self.sim_sum, self.cs_sum
             else:
-                sim_stat, cs_stat = self.sim_sum / self.total, self.cs_sum / self.total
+                total = jnp.asarray(self.total, dtype=self.sim_sum.dtype)
+                sim_stat, cs_stat = self.sim_sum / total, self.cs_sum / total
             return _multiscale_ssim_from_scale_stats(sim_stat, cs_stat, self.betas, self.normalize)
         return _multiscale_ssim_compute(
             dim_zero_cat(self.preds),

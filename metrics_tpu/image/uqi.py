@@ -70,7 +70,7 @@ class UniversalImageQualityIndex(Metric):
         if self._streaming:
             if self.reduction == "sum":
                 return self.score_sum
-            return self.score_sum / self.total
+            return self.score_sum / jnp.asarray(self.total, dtype=self.score_sum.dtype)
         return _uqi_compute(
             dim_zero_cat(self.preds), dim_zero_cat(self.target), self.kernel_size, self.sigma, self.reduction
         )

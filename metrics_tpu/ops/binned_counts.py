@@ -83,7 +83,9 @@ def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interp
 @jax.jit
 def _binned_counts_xla(preds: Array, target: Array, thresholds: Array) -> tuple:
     """Reference XLA formulation: one (N, C, T) fused comparison."""
-    tgt = (target == 1)[:, :, None]
+    # accept bool or {0,1}-int targets; `== 1` on bool is a strict-promotion
+    # error (bool vs weak int), astype(bool) covers both
+    tgt = target.astype(bool)[:, :, None]
     mask = preds[:, :, None] >= thresholds[None, None, :]
     tps = (tgt & mask).sum(axis=0).astype(jnp.float32)
     fps = ((~tgt) & mask).sum(axis=0).astype(jnp.float32)

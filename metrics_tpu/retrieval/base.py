@@ -106,9 +106,9 @@ class RetrievalMetric(Metric, ABC):
             scores = jnp.where(valid, scores, fill)
             keep = nonempty
 
-        n_keep = keep.sum()
+        n_keep = keep.sum().astype(jnp.float32)
         total = jnp.where(keep, scores, 0.0).sum()
-        return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1), 0.0).astype(preds.dtype)
+        return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1.0), 0.0).astype(preds.dtype)
 
     # which groups produce a defined score (fall-out overrides to "negative")
     _required_kind = "positive"

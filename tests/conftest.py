@@ -28,3 +28,13 @@ THRESHOLD = 0.5
 
 def pytest_configure(config):
     assert jax.device_count() >= 8, "tests expect 8 virtual CPU devices"
+
+
+def strict_dtype_promotion() -> bool:
+    """True when the suite runs under JAX_NUMPY_DTYPE_PROMOTION=strict.
+
+    The package itself is strict-promotion clean; flows that legitimately
+    need standard promotion (third-party Flax models, deliberate
+    mixed-precision set_dtype) skip under it.
+    """
+    return os.environ.get("JAX_NUMPY_DTYPE_PROMOTION", "").strip() == "strict"
