@@ -227,3 +227,42 @@ def test_comparison_on_sum_metrics():
     assert not bool((a > b).compute())
     assert not bool((a == b).compute())
     assert bool((a != b).compute())
+
+
+class TestReflectedOperators:
+    """`scalar <op> metric` variants (reference test_composition.py
+    test_metrics_r* battery) — the reflected overloads must build the same
+    lazy DAG with the operands swapped."""
+
+    def test_radd_rsub(self):
+        _check(10.0 + DummyMetric(2.0), 12.0)
+        _check(10.0 - DummyMetric(2.0), 8.0)
+
+    def test_rmul_rtruediv_rfloordiv(self):
+        _check(3.0 * DummyMetric(2.0), 6.0)
+        _check(10.0 / DummyMetric(2.0), 5.0)
+        _check(7.0 // DummyMetric(2.0), 3.0)
+
+    def test_rmod_rpow(self):
+        _check(10.0 % DummyMetric(3.0), 1.0)
+        _check(2.0 ** DummyMetric(3.0), 8.0)
+
+    def test_rmatmul(self):
+        _check(jnp.asarray([2.0, 2.0, 2.0]) @ DummyMetric([1.0, 2.0, 3.0]), 12.0)
+
+    def test_rand_ror_rxor(self):
+        _check(jnp.asarray(3) & DummyMetric(6), 2)
+        _check(jnp.asarray(3) | DummyMetric(6), 7)
+        _check(jnp.asarray(3) ^ DummyMetric(6), 5)
+
+
+def test_compositional_metrics_update_propagates():
+    """update on the composition updates BOTH constituent metrics
+    (reference test_compositional_metrics_update)."""
+    a, b = DummyMetric(1.0), DummyMetric(2.0)
+    comp = a + b
+    comp.update()
+    assert int(a._num_updates) == 1 and int(b._num_updates) == 1
+    comp.update()
+    assert int(a._num_updates) == 2 and int(b._num_updates) == 2
+    np.testing.assert_allclose(float(comp.compute()), 3.0)
