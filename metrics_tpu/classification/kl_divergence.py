@@ -4,7 +4,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.kl_divergence import _kld_compute, _kld_update
+from metrics_tpu.functional.classification.kl_divergence import _kld_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 

@@ -19,7 +19,7 @@ from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusi
 from sklearn.metrics import roc_auc_score as sk_roc_auc
 
 from metrics_tpu import AUROC, AveragePrecision, CohenKappa, ConfusionMatrix, JaccardIndex
-from metrics_tpu.functional import cohen_kappa, confusion_matrix, jaccard_index
+from metrics_tpu.functional import confusion_matrix, jaccard_index
 from tests.classification.inputs import (
     _binary_prob_inputs,
     _multiclass_inputs,

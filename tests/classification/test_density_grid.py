@@ -11,7 +11,6 @@ same stat scores, so the oracle shares no code with the implementations'
 compute paths.
 """
 
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

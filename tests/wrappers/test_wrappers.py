@@ -16,8 +16,6 @@ from metrics_tpu import (
     MetricTracker,
     MinMaxMetric,
     MultioutputWrapper,
-    Precision,
-    Recall,
     SumMetric,
 )
 from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
