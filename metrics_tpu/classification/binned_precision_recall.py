@@ -109,7 +109,9 @@ class BinnedPrecisionRecallCurve(Metric):
             target = target.reshape(-1, 1)
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
-        tps, fps, fns = binned_counts(preds, (target == 1), self.thresholds)
+        # binned_counts binarizes with a strict `== 1` itself (bool-safe under
+        # strict promotion); pass target through so the rule lives in one place
+        tps, fps, fns = binned_counts(preds, target, self.thresholds)
         self.TPs = self.TPs + tps
         self.FPs = self.FPs + fps
         self.FNs = self.FNs + fns

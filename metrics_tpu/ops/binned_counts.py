@@ -83,8 +83,6 @@ def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interp
 @jax.jit
 def _binned_counts_xla(preds: Array, target: Array, thresholds: Array) -> tuple:
     """Reference XLA formulation: one (N, C, T) fused comparison."""
-    # accept bool or {0,1}-int targets; `== 1` on bool is a strict-promotion
-    # error (bool vs weak int), astype(bool) covers both
     tgt = target.astype(bool)[:, :, None]
     mask = preds[:, :, None] >= thresholds[None, None, :]
     tps = (tgt & mask).sum(axis=0).astype(jnp.float32)
@@ -98,13 +96,19 @@ def binned_counts(preds: Array, target: Array, thresholds: Array) -> tuple:
 
     Args:
         preds: ``(N, C)`` scores in [0, 1].
-        target: ``(N, C)`` binary labels.
+        target: ``(N, C)`` binary labels — bool, or integers where ONLY the
+            value ``1`` marks a positive (a ``-1`` ignore sentinel or any
+            other non-{0,1} value counts as negative).
         thresholds: ``(T,)`` sorted thresholds.
 
     Uses the pallas kernel on TPU, the XLA broadcast elsewhere. The kernel's
     (8, T, BL) VMEM mask caps the threshold count (~16 MB VMEM); beyond that
     the XLA formulation takes over.
     """
+    # Binarize with a strict `== 1` so non-{0,1} values (ignore sentinels,
+    # multi-valued labels) count as negatives; bool targets map True -> 1.
+    # Done via int32 to stay clean under strict dtype promotion.
+    target = target.astype(jnp.int32) == 1
     if jax.default_backend() == "tpu" and thresholds.shape[0] <= 256:
         return _binned_counts_pallas(preds, target, thresholds)
     return _binned_counts_xla(preds, target, thresholds)

@@ -107,6 +107,7 @@ class BootStrapper(WrapperMetric):
             )
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.default_rng(seed)
+        self._probe_ok: set = set()  # batch signatures that passed the trace probe
 
         self._vmap = self._try_build_vmap_path()
         if self._vmap:
@@ -170,31 +171,58 @@ class BootStrapper(WrapperMetric):
             return False
         step = self._step
 
-        try:
-            if self.sampling_strategy == "multinomial":
-                idx = jnp.asarray(self._rng.integers(0, size, (self.num_bootstraps, size)))
+        if self.sampling_strategy == "multinomial":
 
-                def one(state, index, *flat):
-                    resampled = [a[index] if m else a for a, m in zip(flat, batch_mask)]
-                    new_state, _ = step(state, *resampled[:n_pos], **dict(zip(keys, resampled[n_pos:])))
-                    return new_state
+            def one(state, index, *flat):
+                resampled = [a[index] if m else a for a, m in zip(flat, batch_mask)]
+                new_state, _ = step(state, *resampled[:n_pos], **dict(zip(keys, resampled[n_pos:])))
+                return new_state
 
-                new = jax.vmap(one, in_axes=(0, 0) + (None,) * len(leaves))(self._stacked_state(), idx, *leaves)
-            else:  # poisson via per-sample weights: update(value, weight)
-                counts = jnp.asarray(self._rng.poisson(1, (self.num_bootstraps, size)), dtype=jnp.float32)
-                value = leaves[0]
-                weight = kwargs.get("weight", args[1] if len(args) > 1 else jnp.ones(size, jnp.float32))
+            def run(index_matrix):
+                return jax.vmap(one, in_axes=(0, 0) + (None,) * len(leaves))(
+                    self._stacked_state(), index_matrix, *leaves
+                )
+
+            dummy = jnp.zeros((self.num_bootstraps, size), jnp.int32)
+            draw = lambda: jnp.asarray(self._rng.integers(0, size, (self.num_bootstraps, size)))
+        else:  # poisson via per-sample weights: update(value, weight)
+            value = leaves[0]
+            weight = kwargs.get("weight", args[1] if len(args) > 1 else jnp.ones(size, jnp.float32))
+            try:
                 weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), (size,))
+            except (TypeError, ValueError):
+                # e.g. per-element (N, D) weights: the weight-multiplier trick
+                # needs one scalar per sample — eager per-copy loop handles it
+                return False
 
-                def one(state, c):
-                    new_state, _ = step(state, value, weight * c)
-                    return new_state
+            def one(state, c):
+                new_state, _ = step(state, value, weight * c)
+                return new_state
 
-                new = jax.vmap(one, in_axes=(0, 0))(self._stacked_state(), counts)
+            def run(count_matrix):
+                return jax.vmap(one, in_axes=(0, 0))(self._stacked_state(), count_matrix)
+
+            dummy = jnp.ones((self.num_bootstraps, size), jnp.float32)
+            draw = lambda: jnp.asarray(self._rng.poisson(1, (self.num_bootstraps, size)), dtype=jnp.float32)
+
+        # Probe trace-compatibility with a dummy index/count matrix BEFORE
+        # consuming RNG, so a rejected batch (metric not trace-ready,
+        # untraceable passthrough args) does not advance the seed stream —
+        # a seeded run falls back with the identical resample sequence it
+        # would have had on the fallback path from the start.
+        def _sig(a: Any) -> Any:
+            return (getattr(a, "shape", None), str(getattr(a, "dtype", type(a).__name__)))
+
+        signature = (self.sampling_strategy, n_pos, tuple(keys), tuple(_sig(a) for a in leaves))
+        if signature not in self._probe_ok:
+            try:
+                jax.eval_shape(run, dummy)
+            except (TypeError, ValueError):
+                return False
+            self._probe_ok.add(signature)
+        try:
+            new = run(draw())
         except (TypeError, ValueError):
-            # metric not trace-ready (e.g. a bare Accuracy() inferring
-            # num_classes from label values) or untraceable passthrough
-            # args: use the per-copy eager loop
             return False
         self._set_stacked_state(new)
         return True
