@@ -39,7 +39,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.distributed import sync_buffer_in_context, sync_reduce_in_context
+from metrics_tpu.utilities.distributed import replicate_typed, sync_buffer_in_context, sync_reduce_in_context
 
 Array = jax.Array
 State = Dict[str, Any]
@@ -191,6 +191,17 @@ def make_step(
         b._update_count = 1
         return new_state, b.compute()
 
+    # Gather-typed states (buffers, cat/None/callable reductions) ride a
+    # 1x-payload varying-typed all_gather; invariant typing is restored on
+    # the small FINAL value instead of the gathered buffer (a pmax identity
+    # collective) so a 1M-sample buffer sync moves ~1x payload, not the
+    # n_dev x of the replicated psum-of-scatter form.
+    _psum_reductions = ("sum", "mean", "max", "min")
+    has_gather_state = any(
+        isinstance(d, CapacityBuffer) or r not in _psum_reductions
+        for r, d in zip(template._reductions.values(), template._defaults.values())
+    )
+
     def compute(state: State) -> Any:
         if axis_name is not None:
             reduced: State = {}
@@ -199,13 +210,18 @@ def make_step(
                     # in-graph uneven cat-state gather (reference
                     # utilities/distributed.py:128-151): gather data + count
                     # per device, concat the filled prefixes
-                    reduced[name] = sync_buffer_in_context(value, axis_name)
+                    reduced[name] = sync_buffer_in_context(value, axis_name, typed="varying")
                 else:
-                    reduced[name] = sync_reduce_in_context(value, template._reductions[name], axis_name)
+                    reduced[name] = sync_reduce_in_context(
+                        value, template._reductions[name], axis_name, typed="varying"
+                    )
             state = reduced
         m = _load(state)
         m._update_count = 1  # state arrived from outside; silence the unused-metric warning
-        return m.compute()
+        out = m.compute()
+        if axis_name is not None and has_gather_state:
+            out = jax.tree_util.tree_map(lambda v: replicate_typed(v, axis_name), out)
+        return out
 
     return init, step, compute
 

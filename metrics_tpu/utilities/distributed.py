@@ -64,13 +64,32 @@ def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str 
 # ---------------------------------------------------------------------------
 
 
-def sync_reduce_in_context(x: Array, reduce_fx: Union[str, Callable, None], axis_name: Union[str, Tuple[str, ...]]) -> Array:
+def sync_reduce_in_context(
+    x: Array,
+    reduce_fx: Union[str, Callable, None],
+    axis_name: Union[str, Tuple[str, ...]],
+    typed: str = "invariant",
+) -> Array:
     """Apply one state's distributed reduction inside a shard_map/pmap context.
 
     ``sum|mean`` -> psum (mean divides by axis size), ``max`` -> pmax,
     ``min`` -> pmin, ``cat``/None/callable -> all_gather along a new leading
     device axis (the callable / None case mirrors the reference's behaviour of
     handing the gathered per-rank stack to user code, metric.py:294-304).
+
+    ``typed`` selects the gather's output typing under shard_map's
+    varying-manual-axes system (psum-family reductions are always
+    invariant-typed; this only affects the cat/None/callable gather):
+
+    * ``"invariant"`` (default): replicated-typed output that satisfies
+      ``out_specs=P()`` directly — lowered as psum of a zero-padded scatter,
+      which moves ``n_dev x`` payload through an all-reduce (2x an
+      all-gather's bytes on an ICI ring).
+    * ``"varying"``: a native ``lax.all_gather`` at 1x payload; the output is
+      device-varying-typed even though every device holds identical values.
+      Restore invariant typing on the (small) final value derived from it
+      with :func:`replicate_typed` before returning through
+      ``out_specs=P()``.
     """
     if reduce_fx == "sum":
         return lax.psum(x, axis_name)
@@ -80,12 +99,7 @@ def sync_reduce_in_context(x: Array, reduce_fx: Union[str, Callable, None], axis
         return lax.pmax(x, axis_name)
     if reduce_fx == "min":
         return lax.pmin(x, axis_name)
-    # cat / None / custom callable: gather per-device values. Implemented as
-    # psum of a zero-padded scatter rather than lax.all_gather: psum outputs
-    # are replicated-typed under shard_map's varying-axes system (all_gather
-    # outputs stay device-varying and fail out_specs=P() inference), and XLA
-    # lowers this dual form to the same all-gather collective on ICI.
-    gathered = _all_gather_replicated(x, axis_name)  # (n_dev, ...) leading axis
+    gathered = _all_gather(x, axis_name, typed)  # (n_dev, ...) leading axis
     if reduce_fx == "cat":
         return gathered.reshape((-1,) + x.shape[1:]) if x.ndim >= 1 else gathered.reshape(-1)
     if callable(reduce_fx):
@@ -93,15 +107,80 @@ def sync_reduce_in_context(x: Array, reduce_fx: Union[str, Callable, None], axis
     return gathered
 
 
+def _all_gather(x: Array, axis_name: Union[str, Tuple[str, ...]], typed: str) -> Array:
+    """All-gather with selectable output typing (see sync_reduce_in_context)."""
+    if typed == "varying":
+        return lax.all_gather(x, axis_name)
+    if typed != "invariant":
+        raise ValueError(f"typed must be 'invariant' or 'varying', got {typed!r}")
+    return _all_gather_replicated(x, axis_name)
+
+
 def _all_gather_replicated(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> Array:
-    """All-gather whose output is replicated-typed: psum(one-hot scatter)."""
+    """All-gather whose output is replicated-typed: psum(one-hot scatter).
+
+    This JAX version has no invariant-typed all_gather (``lax.all_gather``
+    outputs stay device-varying and fail ``out_specs=P()`` inference), so the
+    replicated gather is a psum of a zero-padded scatter — an all-reduce over
+    ``n_dev x`` payload. Prefer ``typed="varying"`` + :func:`replicate_typed`
+    on the final value for large states.
+    """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     padded = jnp.zeros((n,) + x.shape, x.dtype).at[idx].set(x)
     return lax.psum(padded, axis_name)
 
 
-def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]]) -> Any:
+def replicate_typed(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> Array:
+    """Restore invariant (replicated) typing of a device-identical value.
+
+    After a ``typed="varying"`` gather, every device holds identical values
+    but the type system still marks them device-varying, so
+    ``out_specs=P()`` rejects them. ``pmax`` over identical replicas is the
+    cheapest identity collective that re-types: exact for ints and floats
+    (no division), NaN-propagating, and only the FINAL value (typically a
+    scalar or small vector) pays it — not the gathered buffer.
+    """
+    if hasattr(x, "dtype") and x.dtype == jnp.bool_:
+        return lax.pmax(x.astype(jnp.uint8), axis_name).astype(jnp.bool_)
+    return lax.pmax(x, axis_name)
+
+
+def ring_allreduce(x: Array, axis_name: str, op: Callable[[Array, Array], Array] = jnp.add) -> Array:
+    """Manual ring all-reduce via ``lax.ppermute`` (ring-attention pattern).
+
+    Each device folds its neighbours' contributions in ``n - 1`` rotation
+    steps around the ring — the communication schedule of ring attention /
+    pipeline-stage state merges, exposed as a library facility so mesh
+    programs can fold states along an axis without a global ``psum`` (useful
+    when the axis rides a physical ring, when overlapping per-hop compute,
+    or with a non-additive fold ``op``).
+
+    The result is bitwise identical on every device but typed device-varying
+    (``ppermute`` outputs vary by construction); pass it through
+    :func:`replicate_typed` (or any psum-family identity) before a
+    ``shard_map`` ``out_specs=P()`` boundary.
+
+    Args:
+        x: the local contribution on each device.
+        axis_name: mesh axis to ring-reduce over (a single named axis).
+        op: associative+commutative binary fold, default ``jnp.add``.
+            (Commutativity matters: hop ``k`` folds neighbour ``(i - k) %% n``,
+            so contributions arrive in a different order on each device.)
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(_, carry):
+        acc, buf = carry
+        buf = lax.ppermute(buf, axis_name, perm)
+        return op(acc, buf), buf
+
+    acc, _ = lax.fori_loop(0, n - 1, body, (x, x))
+    return acc
+
+
+def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typed: str = "invariant") -> Any:
     """Merge per-device :class:`CapacityBuffer` sample states inside shard_map.
 
     The in-graph analogue of the reference's uneven cat-state gather
@@ -124,6 +203,21 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]]) -> 
       (dropped) otherwise. The merged count is traced; consumers either need
       a mask-aware compute or must restore the known total via
       ``CapacityBuffer.declare_count``.
+
+    ``typed`` selects the gather typing exactly as in
+    :func:`sync_reduce_in_context`: ``"varying"`` moves 1x payload via
+    ``lax.all_gather`` (restore invariance on the final computed value with
+    :func:`replicate_typed`); ``"invariant"`` (default) pays the
+    ``n_dev x`` psum-of-scatter but satisfies ``out_specs=P()`` directly.
+
+    .. warning::
+        If a device's buffer OVERFLOWED under traced counts (appends past
+        ``capacity`` inside a scan), its tail rows were overwritten in place
+        by the clamped ``dynamic_update_slice`` writes — the merged buffer's
+        count is clamped to honest totals, but the surviving rows from that
+        device may be CORRUPTED samples (later appends overwrote earlier
+        rows), not merely a truncated prefix. Arm ``debug_checks`` to detect
+        overflow at runtime, or size ``capacity`` for the worst case.
     """
     from metrics_tpu.utilities.buffers import CapacityBuffer
 
@@ -137,13 +231,13 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]]) -> 
         # static count: gather only the filled prefix — the collective moves
         # n*c rows, not n*capacity
         c = buf._host_count
-        filled = _all_gather_replicated(buf.data[:c], axis_name).reshape((n * c,) + item_shape)
+        filled = _all_gather(buf.data[:c], axis_name, typed).reshape((n * c,) + item_shape)
         merged.data = jnp.zeros((n * cap,) + item_shape, buf.data.dtype).at[: n * c].set(filled)
         merged.count = jnp.asarray(n * c, jnp.int32)
         merged._host_count = n * c
         return merged
-    data = _all_gather_replicated(buf.data, axis_name)  # (n, cap, *item)
-    counts = _all_gather_replicated(buf.count, axis_name)  # (n,)
+    data = _all_gather(buf.data, axis_name, typed)  # (n, cap, *item)
+    counts = _all_gather(buf.count, axis_name, typed)  # (n,)
     # a traced overflow (append past capacity inside a scan) leaves count >
     # capacity while the data writes were clamped; clamp here too so the
     # merge stays dense (no phantom zero rows) and the total stays honest

@@ -11,7 +11,12 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from metrics_tpu.utilities.distributed import gather_all_tensors, sync_reduce_in_context
+from metrics_tpu.utilities.distributed import (
+    gather_all_tensors,
+    replicate_typed,
+    ring_allreduce,
+    sync_reduce_in_context,
+)
 
 try:
     from jax import shard_map as _shard_map_mod  # jax>=0.6 style
@@ -223,3 +228,66 @@ def test_state_dict_is_synced_inside_context():
         synced = np.concatenate([np.asarray(v) for v in c.state_dict()["x"]])
     np.testing.assert_allclose(synced, [1.0, 2.0, 2.0, 4.0])
     np.testing.assert_allclose(np.asarray(jnp.concatenate(c.state_dict()["x"])), [1.0, 2.0])
+
+
+def test_ring_allreduce_matches_psum(mesh):
+    """ring_allreduce(x, axis) == psum(x, axis) on every device."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(N_DEV * 4, 3)).astype(np.float32)
+
+    def step(v):
+        ring = ring_allreduce(v.sum(axis=0), "dp")
+        direct = jax.lax.psum(v.sum(axis=0), "dp")
+        # ppermute results are pp-varying; replicate_typed re-types them for
+        # the P() out-spec without changing the value
+        return replicate_typed(ring, "dp"), direct
+
+    fn = jax.jit(shard_map(step, mesh, in_specs=(P("dp"),), out_specs=(P(), P())))
+    ring, direct = fn(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(direct), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ring), x.sum(axis=0), rtol=1e-5)
+
+
+def test_ring_allreduce_custom_op(mesh):
+    """A non-additive fold (max) rides the same ring schedule."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(N_DEV * 4,)).astype(np.float32)
+
+    def step(v):
+        return replicate_typed(ring_allreduce(v.max(), "dp", op=jnp.maximum), "dp")
+
+    fn = jax.jit(shard_map(step, mesh, in_specs=(P("dp"),), out_specs=P()))
+    assert float(fn(jnp.asarray(x))) == pytest.approx(float(x.max()))
+
+
+@pytest.mark.parametrize("fx", ["cat", None])
+def test_varying_gather_matches_invariant(mesh, fx):
+    """typed='varying' all_gather + replicate_typed == the replicated psum-of-
+    scatter gather, for both the cat and the None (stack) reductions."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(N_DEV * 3, 2)).astype(np.float32)
+
+    def step(v):
+        inv = sync_reduce_in_context(v, fx, "dp")
+        var = sync_reduce_in_context(v, fx, "dp", typed="varying")
+        return inv, replicate_typed(var, "dp")
+
+    fn = jax.jit(shard_map(step, mesh, in_specs=(P("dp"),), out_specs=(P(), P())))
+    inv, var = fn(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(inv), np.asarray(var))
+
+
+def test_replicate_typed_bool(mesh):
+    """Bool values re-type through the uint8 cast without value change."""
+
+    def step(v):
+        flag = sync_reduce_in_context(jnp.any(v > 0), "max", "dp")
+        gathered = sync_reduce_in_context(flag, None, "dp", typed="varying")
+        return replicate_typed(gathered, "dp")
+
+    x = np.zeros(N_DEV, dtype=np.float32)
+    x[3] = 1.0
+    fn = jax.jit(shard_map(step, mesh, in_specs=(P("dp"),), out_specs=P()))
+    out = np.asarray(fn(jnp.asarray(x)))
+    assert out.dtype == np.bool_
+    assert out.all()
