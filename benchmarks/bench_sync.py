@@ -1,0 +1,82 @@
+"""Large-state mesh sync cost: a 1M-sample CapacityBuffer gathered over 8 devices.
+
+Measures ``sync_buffer_in_context`` — the in-graph analogue of the
+reference's uneven cat-state gather (``torchmetrics/utilities/
+distributed.py:128-151``) — on a 1M-sample float32 buffer (125k samples x 8
+devices), comparing the two gather typings:
+
+* ``invariant``: psum of a zero-padded scatter (replicated-typed output,
+  satisfies ``out_specs=P()`` directly) — an all-reduce over ``n_dev x``
+  payload, ~2x an all-gather's bytes on a ring plus the zero-buffer
+  materialization.
+* ``varying``: native ``lax.all_gather`` at 1x payload; invariant typing is
+  restored on the small FINAL value with ``replicate_typed`` (a scalar pmax).
+
+Both the static-count regime (one traced program; the gather moves only the
+filled prefix) and the traced-count regime (post-scan counts; full-capacity
+masked scatter-concat) are measured.
+
+Self-provisions an 8-device virtual CPU mesh, so it must run in its own
+process: ``python -m benchmarks.bench_sync``. Device counts are emulated on
+host cores — ratios between the two typings are meaningful, absolute
+milliseconds are not ICI numbers.
+"""
+import json
+import time
+
+N_DEV = 8
+CAP = 125_000  # per-device samples -> 1M total
+
+
+def measure() -> dict:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", N_DEV)
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+    from metrics_tpu.utilities.distributed import replicate_typed, sync_buffer_in_context
+
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("dp",))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(N_DEV * CAP,)).astype(np.float32))
+
+    def make(regime: str, typed: str):
+        def prog(v):
+            buf = CapacityBuffer(CAP, jnp.float32)
+            buf.append(v)
+            if regime == "traced":
+                buf._host_count = None  # post-scan counts: full-capacity merge
+            merged = sync_buffer_in_context(buf, "dp", typed=typed)
+            val = merged.data.sum()  # zeros beyond the fill: plain sum is exact
+            return replicate_typed(val, "dp") if typed == "varying" else val
+
+        return jax.jit(jax.shard_map(prog, mesh=mesh, in_specs=P("dp"), out_specs=P()))
+
+    out = {}
+    expected = float(x.sum())
+    for regime in ("static", "traced"):
+        for typed in ("invariant", "varying"):
+            fn = make(regime, typed)
+            got = fn(x)
+            got.block_until_ready()
+            assert abs(float(got) - expected) < 1e-2 * max(1.0, abs(expected)), (float(got), expected)
+            times = []
+            for _ in range(7):
+                t0 = time.perf_counter()
+                fn(x).block_until_ready()
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            out[f"buffer_sync_1M_8dev_{regime}_{typed}"] = times[len(times) // 2] * 1000.0
+    return out
+
+
+def main() -> None:
+    for name, ms in measure().items():
+        print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
+
+
+if __name__ == "__main__":
+    main()
