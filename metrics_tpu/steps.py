@@ -112,12 +112,19 @@ def make_step(
         template = metric(*init_args, **init_kwargs)
 
     from metrics_tpu.wrappers.abstract import WrapperMetric
+    from metrics_tpu.wrappers.bootstrapping import BootStrapper
+
+    if isinstance(template, BootStrapper):
+        # the bootstrap replicate states are a fixed-shape stacked pytree —
+        # exactly a scan carry; see _make_bootstrap_step
+        return _make_bootstrap_step(template, axis_name=axis_name, with_value=with_value)
 
     if isinstance(template, WrapperMetric):
         raise ValueError(
             f"{type(template).__name__} is a wrapper metric; its state lives in wrapped children whose"
             " snapshots are not valid jitted-step carries. Build the step from the base metric and apply"
-            " the wrapper semantics outside the step, or use the eager class API."
+            " the wrapper semantics outside the step, or use the eager class API (BootStrapper is the"
+            " exception: its stacked replicate states do form a valid carry)."
         )
 
     for name, default in template._defaults.items():
@@ -222,6 +229,105 @@ def make_step(
         if axis_name is not None and has_gather_state:
             out = jax.tree_util.tree_map(lambda v: replicate_typed(v, axis_name), out)
         return out
+
+    return init, step, compute
+
+
+def _make_bootstrap_step(
+    wrapper: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Pure step functions over a :class:`~metrics_tpu.wrappers.BootStrapper`.
+
+    The carry is ``{"key": jax PRNG key, "boot": stacked replicate states}``
+    — the bootstrap axis lives INSIDE the carry, so the whole wrapper rides
+    ``jax.lax.scan`` / ``shard_map`` as one traced program (the reference's
+    N deep copies, ``torchmetrics/wrappers/bootstrapping.py:48``, become a
+    vmapped axis). Each ``step`` splits the carried key and draws the
+    resample matrix with ``jax.random`` (multinomial: a ``(B, N)`` index
+    gather; poisson: per-sample weight multipliers) — trace-safe, unlike the
+    eager wrapper's host-side numpy generator, so the two paths draw from
+    different streams: parity with the eager wrapper is distributional, not
+    bitwise. The key derives from the wrapper's ``seed`` (0 when unseeded).
+
+    ``compute`` returns the same statistics dict as the eager wrapper
+    (mean/std/quantile/raw over the replicate axis); under ``axis_name``
+    each replicate leaf reduces with the base metric's declared reduction
+    first.
+    """
+    if not wrapper._vmap:
+        raise ValueError(
+            "This BootStrapper fell back to the per-copy eager path (base metric not step-compatible, or"
+            " poisson without sample-weight support), so its state is not a fixed-shape carry. Use a"
+            " step-compatible base metric (fixed-shape sum/min/max states), or the eager wrapper API."
+        )
+    import numpy as np
+
+    base_init, base_step, base_compute = wrapper._init, wrapper._step, wrapper._compute_one
+    n_boot = wrapper.num_bootstraps
+    strategy = wrapper.sampling_strategy
+    reductions = {n: wrapper.base_metric._reductions[n] for n in wrapper._state_names}
+    # an unseeded wrapper must stay nondeterministic across factories (the
+    # eager path's default_rng(None) semantics): entropy-seed the key then,
+    # never a fixed constant — parallel unseeded runs need independent draws
+    seed = int(np.random.SeedSequence().generate_state(1)[0]) if wrapper._seed is None else wrapper._seed
+    stats = {"mean": wrapper.mean, "std": wrapper.std, "quantile": wrapper.quantile, "raw": wrapper.raw}
+
+    def _stacked_init() -> State:
+        one = base_init()
+        return {n: jnp.broadcast_to(v[None], (n_boot,) + jnp.shape(v)) for n, v in one.items()}
+
+    def init() -> State:
+        state = {"key": jax.random.PRNGKey(seed), "boot": _stacked_init()}
+        if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
+            state = jax.tree_util.tree_map(jnp.array, state)
+        return state
+
+    def _apply(boot: State, sub: Array, args: tuple, kwargs: dict) -> State:
+        from metrics_tpu.wrappers.bootstrapping import _apply_resample
+
+        leaves = list(args) + [kwargs[k] for k in sorted(kwargs)]
+        size = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
+        if size is None:
+            raise ValueError(
+                "None of the input contained tensors with a batch dimension, so could not determine"
+                " the sampling size"
+            )
+        if strategy == "multinomial":
+            matrix = jax.random.randint(sub, (n_boot, size), 0, size)
+        else:
+            matrix = jax.random.poisson(sub, 1.0, (n_boot, size)).astype(jnp.float32)
+        return _apply_resample(base_step, boot, matrix, strategy, args, kwargs)
+
+    def _statistics(vals: Array) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        if stats["mean"]:
+            out["mean"] = vals.mean(axis=0)
+        if stats["std"]:
+            out["std"] = vals.std(axis=0, ddof=1)
+        if stats["quantile"] is not None:
+            out["quantile"] = jnp.quantile(vals, jnp.asarray(stats["quantile"]), axis=0)
+        if stats["raw"]:
+            out["raw"] = vals
+        return out
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        key, sub = jax.random.split(state["key"])
+        boot = _apply(state["boot"], sub, args, kwargs)
+        new_state = {"key": key, "boot": boot}
+        if not with_value:
+            return new_state, None
+        # batch-local statistics: the same resample applied to a fresh state
+        # (XLA CSE shares the gathered batches between the two updates)
+        batch_boot = _apply(_stacked_init(), sub, args, kwargs)
+        return new_state, _statistics(jnp.asarray(jax.vmap(base_compute)(batch_boot)))
+
+    def compute(state: State) -> Dict[str, Array]:
+        boot = state["boot"]
+        if axis_name is not None:
+            boot = {n: sync_reduce_in_context(v, reductions[n], axis_name) for n, v in boot.items()}
+        return _statistics(jnp.asarray(jax.vmap(base_compute)(boot)))
 
     return init, step, compute
 

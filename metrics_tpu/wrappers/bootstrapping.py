@@ -52,6 +52,43 @@ def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.Generat
     raise ValueError("Unknown sampling strategy")
 
 
+def _apply_resample(
+    step: Any, boot: Dict[str, Array], matrix: Array, strategy: str, args: tuple, kwargs: dict
+) -> Dict[str, Array]:
+    """Fold one drawn resample matrix into the stacked replicate states.
+
+    The single definition of the resample semantics, shared by the eager
+    wrapper's vmapped update (numpy-drawn matrices) and the pure-step path
+    (jax.random-drawn matrices): ``matrix`` is ``(B, N)`` gather indices for
+    multinomial, or ``(B, N)`` Poisson counts applied as per-sample weight
+    multipliers for poisson. Array leaves whose leading dim equals the batch
+    size are resampled; everything else passes through unchanged.
+    """
+    keys = sorted(kwargs)
+    n_pos = len(args)
+    leaves = list(args) + [kwargs[k] for k in keys]
+    size = matrix.shape[1]
+    batch_mask = [getattr(a, "ndim", 0) >= 1 and a.shape[0] == size for a in leaves]
+    if strategy == "multinomial":
+
+        def one(state, index, *flat):
+            resampled = [a[index] if m else a for a, m in zip(flat, batch_mask)]
+            new_state, _ = step(state, *resampled[:n_pos], **dict(zip(keys, resampled[n_pos:])))
+            return new_state
+
+        return jax.vmap(one, in_axes=(0, 0) + (None,) * len(leaves))(boot, matrix, *leaves)
+    # poisson: a sample drawn c ~ Poisson(1) times is a weight multiplier of c
+    value = leaves[0]
+    weight = kwargs.get("weight", args[1] if len(args) > 1 else jnp.ones(size, jnp.float32))
+    weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), (size,))
+
+    def one_w(state, c):
+        new_state, _ = step(state, value, weight * c)
+        return new_state
+
+    return jax.vmap(one_w, in_axes=(0, 0))(boot, matrix.astype(jnp.float32))
+
+
 class BootStrapper(WrapperMetric):
     """Compute bootstrapped statistics of a base metric.
 
@@ -106,6 +143,7 @@ class BootStrapper(WrapperMetric):
                 f" but received {sampling_strategy}"
             )
         self.sampling_strategy = sampling_strategy
+        self._seed = seed  # make_step's pure-step factory derives its PRNG key from this
         self._rng = np.random.default_rng(seed)
         self._probe_ok: set = set()  # batch signatures that passed the trace probe
 
@@ -162,54 +200,29 @@ class BootStrapper(WrapperMetric):
         keys = sorted(kwargs)
         n_pos = len(args)
         leaves = list(args) + [kwargs[k] for k in keys]
-
-        def _is_batch(a: Any) -> bool:
-            return isinstance(a, (jnp.ndarray, jax.Array, np.ndarray)) and getattr(a, "ndim", 0) >= 1 and a.shape[0] == size
-
-        batch_mask = [_is_batch(a) for a in leaves]
-        if not any(batch_mask):
+        if not any(
+            isinstance(a, (jnp.ndarray, jax.Array, np.ndarray)) and getattr(a, "ndim", 0) >= 1 and a.shape[0] == size
+            for a in leaves
+        ):
             return False
         step = self._step
 
+        def run(matrix):
+            return _apply_resample(step, self._stacked_state(), matrix, self.sampling_strategy, args, kwargs)
+
         if self.sampling_strategy == "multinomial":
-
-            def one(state, index, *flat):
-                resampled = [a[index] if m else a for a, m in zip(flat, batch_mask)]
-                new_state, _ = step(state, *resampled[:n_pos], **dict(zip(keys, resampled[n_pos:])))
-                return new_state
-
-            def run(index_matrix):
-                return jax.vmap(one, in_axes=(0, 0) + (None,) * len(leaves))(
-                    self._stacked_state(), index_matrix, *leaves
-                )
-
             dummy = jnp.zeros((self.num_bootstraps, size), jnp.int32)
             draw = lambda: jnp.asarray(self._rng.integers(0, size, (self.num_bootstraps, size)))
-        else:  # poisson via per-sample weights: update(value, weight)
-            value = leaves[0]
-            weight = kwargs.get("weight", args[1] if len(args) > 1 else jnp.ones(size, jnp.float32))
-            try:
-                weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), (size,))
-            except (TypeError, ValueError):
-                # e.g. per-element (N, D) weights: the weight-multiplier trick
-                # needs one scalar per sample — eager per-copy loop handles it
-                return False
-
-            def one(state, c):
-                new_state, _ = step(state, value, weight * c)
-                return new_state
-
-            def run(count_matrix):
-                return jax.vmap(one, in_axes=(0, 0))(self._stacked_state(), count_matrix)
-
+        else:
             dummy = jnp.ones((self.num_bootstraps, size), jnp.float32)
             draw = lambda: jnp.asarray(self._rng.poisson(1, (self.num_bootstraps, size)), dtype=jnp.float32)
 
         # Probe trace-compatibility with a dummy index/count matrix BEFORE
         # consuming RNG, so a rejected batch (metric not trace-ready,
-        # untraceable passthrough args) does not advance the seed stream —
-        # a seeded run falls back with the identical resample sequence it
-        # would have had on the fallback path from the start.
+        # untraceable passthrough args, non-per-sample poisson weights) does
+        # not advance the seed stream — a seeded run falls back with the
+        # identical resample sequence it would have had on the fallback path
+        # from the start.
         def _sig(a: Any) -> Any:
             return (getattr(a, "shape", None), str(getattr(a, "dtype", type(a).__name__)))
 
