@@ -1,6 +1,9 @@
 """ShortTimeObjectiveIntelligibility metric class.
 
-Behavioral equivalent of reference ``torchmetrics/audio/stoi.py:25``.
+Behavioral equivalent of reference ``torchmetrics/audio/stoi.py:25`` — but
+self-contained: unlike the reference (which hard-requires ``pystoi``), the
+metric runs on the in-repo native STOI/ESTOI implementation when the
+package is absent.
 """
 from typing import Any
 
@@ -15,32 +18,43 @@ Array = jax.Array
 
 
 class ShortTimeObjectiveIntelligibility(Metric):
-    """Mean STOI (host-side pystoi) over evaluated signals.
+    """Mean STOI (host-side) over evaluated signals.
 
     Args:
         fs: sampling frequency.
         extended: use the extended STOI variant.
+        implementation: ``"auto"`` (pystoi when installed, else the native
+            algorithm), ``"native"``, or ``"pystoi"``.
     """
 
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+    def __init__(
+        self, fs: int, extended: bool = False, implementation: str = "auto", **kwargs: Any
+    ) -> None:
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
+        if implementation not in ("auto", "native", "pystoi"):
+            raise ValueError(
+                f"Expected argument `implementation` to be 'auto', 'native' or 'pystoi' but got {implementation}"
+            )
+        if implementation == "pystoi" and not _PYSTOI_AVAILABLE:
             raise ModuleNotFoundError(
-                "STOI metric requires that `pystoi` is installed. Either install as "
-                "`pip install metrics-tpu[audio]` or `pip install pystoi`."
+                "implementation='pystoi' requires that `pystoi` is installed. Either install as "
+                "`pip install metrics-tpu[audio]` or `pip install pystoi` — or use implementation='native'."
             )
         self.fs = fs
         self.extended = extended
+        self.implementation = implementation
 
         self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        stoi_batch = short_time_objective_intelligibility(
+            preds, target, self.fs, self.extended, implementation=self.implementation
+        )
         self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
         self.total = self.total + stoi_batch.size
 

@@ -1,8 +1,10 @@
 """Short-time objective intelligibility (STOI).
 
-Behavioral equivalent of reference ``torchmetrics/functional/audio/stoi.py``:
-a host callback into the ``pystoi`` implementation, gated on the optional
-dependency exactly like the reference.
+Behavioral equivalent of reference ``torchmetrics/functional/audio/stoi.py``
+— but self-contained: the reference only wraps the ``pystoi`` package,
+while this build ships a native implementation of the published algorithm
+(``_stoi_native.py``, Taal 2011 / Jensen 2016) and uses ``pystoi`` merely
+as the bit-parity backend when it happens to be installed.
 """
 import jax
 import jax.numpy as jnp
@@ -17,16 +19,24 @@ __doctest_skip__ = ["short_time_objective_intelligibility"]
 
 
 def short_time_objective_intelligibility(
-    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+    implementation: str = "auto",
 ) -> Array:
-    """STOI (0..1, higher is more intelligible), computed host-side by pystoi.
+    """STOI (~0..1, higher is more intelligible), computed host-side.
 
     Args:
         preds: shape ``[..., time]``.
         target: shape ``[..., time]``.
         fs: sampling frequency.
-        extended: use the extended STOI variant.
+        extended: use the extended STOI (ESTOI) variant.
         keep_same_device: kept for API parity (XLA manages placement).
+        implementation: ``"auto"`` uses ``pystoi`` when installed (bit parity
+            with the reference wrapper) and the in-repo native algorithm
+            otherwise; ``"native"`` / ``"pystoi"`` force one backend.
 
     Example:
         >>> import jax
@@ -36,22 +46,37 @@ def short_time_objective_intelligibility(
         >>> short_time_objective_intelligibility(preds, target, 8000)  # doctest: +SKIP
         Array(-0.0842, dtype=float32)
     """
-    if not _PYSTOI_AVAILABLE:
-        raise ModuleNotFoundError(
-            "STOI metric requires that `pystoi` is installed. Either install as `pip install metrics-tpu[audio]` "
-            "or `pip install pystoi`."
+    if implementation not in ("auto", "native", "pystoi"):
+        raise ValueError(
+            f"Expected argument `implementation` to be 'auto', 'native' or 'pystoi' but got {implementation}"
         )
-    import pystoi
+    use_pystoi = implementation == "pystoi" or (implementation == "auto" and _PYSTOI_AVAILABLE)
+    if implementation == "pystoi" and not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "implementation='pystoi' requires that `pystoi` is installed. Either install as"
+            " `pip install metrics-tpu[audio]` or `pip install pystoi` — or use the built-in"
+            " implementation='native'."
+        )
+    if use_pystoi:
+        import pystoi
+
+        def one(t: np.ndarray, p: np.ndarray) -> float:
+            return pystoi.stoi(t, p, fs, extended=extended)
+
+    else:
+        from metrics_tpu.functional.audio._stoi_native import stoi_native
+
+        def one(t: np.ndarray, p: np.ndarray) -> float:
+            return stoi_native(t, p, fs, extended=extended)
 
     _check_same_shape(preds, target)
 
     preds_np = np.asarray(preds, dtype=np.float64)
     target_np = np.asarray(target, dtype=np.float64)
     if preds_np.ndim == 1:
-        score = pystoi.stoi(target_np, preds_np, fs, extended=extended)
-        return jnp.asarray(score, dtype=jnp.float32)
+        return jnp.asarray(one(target_np, preds_np), dtype=jnp.float32)
 
     flat_preds = preds_np.reshape(-1, preds_np.shape[-1])
     flat_target = target_np.reshape(-1, target_np.shape[-1])
-    scores = [pystoi.stoi(t, p, fs, extended=extended) for t, p in zip(flat_target, flat_preds)]
+    scores = [one(t, p) for t, p in zip(flat_target, flat_preds)]
     return jnp.asarray(scores, dtype=jnp.float32).reshape(preds_np.shape[:-1])

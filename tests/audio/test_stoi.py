@@ -83,14 +83,153 @@ class TestStoiWrapperMocked:
             short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(4000), 8000)
 
 
-def test_missing_backend_error_message():
-    """The install hint must name a real extra (pyproject declares [audio])."""
+def test_forced_pystoi_backend_error_message():
+    """implementation='pystoi' without the package must raise with the real
+    extra name; the DEFAULT path must instead run on the native algorithm."""
     if _PYSTOI_INSTALLED:
         pytest.skip("pystoi installed; error path unreachable")
     with pytest.raises(ModuleNotFoundError, match=r"metrics-tpu\[audio\]"):
-        short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(8000), 8000)
+        short_time_objective_intelligibility(
+            jnp.zeros(8000), jnp.zeros(8000), 8000, implementation="pystoi"
+        )
     with pytest.raises(ModuleNotFoundError, match=r"metrics-tpu\[audio\]"):
-        ShortTimeObjectiveIntelligibility(8000)
+        ShortTimeObjectiveIntelligibility(8000, implementation="pystoi")
+    # default construction + update + compute works natively
+    rng = np.random.default_rng(0)
+    m = ShortTimeObjectiveIntelligibility(10000)
+    x = _speechlike(rng, 12000)
+    m.update(jnp.asarray(x + 0.3 * rng.normal(size=x.size)), jnp.asarray(x))
+    assert 0.0 < float(m.compute()) <= 1.0
+
+
+def test_bad_implementation_argument():
+    with pytest.raises(ValueError, match="implementation"):
+        short_time_objective_intelligibility(jnp.zeros(8000), jnp.zeros(8000), 8000, implementation="c")
+    with pytest.raises(ValueError, match="implementation"):
+        ShortTimeObjectiveIntelligibility(8000, implementation="c")
+
+
+def _speechlike(rng, n, modulate=True):
+    """AR(1)-colored, amplitude-modulated noise — speech-shaped spectrum."""
+    drive = rng.normal(size=n)
+    x = np.empty(n)
+    x[0] = drive[0]
+    for i in range(1, n):
+        x[i] = 0.95 * x[i - 1] + drive[i]
+    if modulate:
+        x = x * (1 + 0.8 * np.sin(2 * np.pi * np.arange(n) / 1600))
+    return x
+
+
+class TestStoiNative:
+    """Property grid for the in-repo STOI/ESTOI algorithm (Taal 2011 /
+    Jensen 2016) — the offline oracle path; pystoi is only an optional
+    bit-parity cross-check (below)."""
+
+    @pytest.mark.parametrize("extended", [False, True])
+    @pytest.mark.parametrize("fs", [10000, 16000, 8000])
+    def test_identity_is_one(self, extended, fs):
+        x = _speechlike(np.random.default_rng(1), 2 * fs)
+        got = float(
+            short_time_objective_intelligibility(
+                jnp.asarray(x), jnp.asarray(x), fs, extended, implementation="native"
+            )
+        )
+        np.testing.assert_allclose(got, 1.0, atol=1e-6)
+
+    @pytest.mark.parametrize("extended", [False, True])
+    def test_monotone_in_noise(self, extended):
+        rng = np.random.default_rng(2)
+        x = _speechlike(rng, 20000)
+        noise = rng.normal(size=x.size)
+        scores = [
+            float(
+                short_time_objective_intelligibility(
+                    jnp.asarray(x + s * x.std() * noise), jnp.asarray(x), 10000, extended,
+                    implementation="native",
+                )
+            )
+            for s in (0.0, 0.2, 0.6, 1.5, 4.0)
+        ]
+        assert all(a > b for a, b in zip(scores, scores[1:])), scores
+        assert scores[0] > 0.999 and scores[-1] < 0.35
+
+    def test_scale_invariance(self):
+        rng = np.random.default_rng(3)
+        x = _speechlike(rng, 16000)
+        y = x + 0.5 * x.std() * rng.normal(size=x.size)
+        base = float(short_time_objective_intelligibility(jnp.asarray(y), jnp.asarray(x), 10000, implementation="native"))
+        for p, t in ((3 * y, x), (y, 2 * x), (0.1 * y, 0.7 * x)):
+            got = float(short_time_objective_intelligibility(jnp.asarray(p), jnp.asarray(t), 10000, implementation="native"))
+            np.testing.assert_allclose(got, base, rtol=1e-9)
+
+    def test_silence_removal(self):
+        """Appending silence to both signals barely moves the score (silent
+        frames are dropped before the band analysis)."""
+        rng = np.random.default_rng(4)
+        x = _speechlike(rng, 16000)
+        y = x + 0.5 * x.std() * rng.normal(size=x.size)
+        base = float(short_time_objective_intelligibility(jnp.asarray(y), jnp.asarray(x), 10000, implementation="native"))
+        pad = np.zeros(6000)
+        padded = float(
+            short_time_objective_intelligibility(
+                jnp.asarray(np.concatenate([y, pad])), jnp.asarray(np.concatenate([x, pad])), 10000,
+                implementation="native",
+            )
+        )
+        np.testing.assert_allclose(padded, base, atol=2e-3)
+
+    def test_short_signal_warns(self):
+        x = np.random.default_rng(5).normal(size=500)
+        with pytest.warns(RuntimeWarning, match="384 ms"):
+            got = short_time_objective_intelligibility(
+                jnp.asarray(x), jnp.asarray(x), 10000, implementation="native"
+            )
+        np.testing.assert_allclose(float(got), 1e-5)
+
+    def test_batch_shapes(self):
+        rng = np.random.default_rng(6)
+        x = np.stack([_speechlike(rng, 12000) for _ in range(4)]).reshape(2, 2, 12000)
+        y = x + 0.4 * x.std() * rng.normal(size=x.shape)
+        out = short_time_objective_intelligibility(
+            jnp.asarray(y), jnp.asarray(x), 10000, implementation="native"
+        )
+        assert out.shape == (2, 2)
+        assert (np.asarray(out) > 0.2).all() and (np.asarray(out) < 1.0).all()
+
+    def test_class_native_accumulation(self):
+        rng = np.random.default_rng(7)
+        m = ShortTimeObjectiveIntelligibility(10000, implementation="native")
+        scores = []
+        for _ in range(3):
+            x = _speechlike(rng, 12000)
+            y = x + 0.5 * x.std() * rng.normal(size=x.size)
+            m.update(jnp.asarray(y), jnp.asarray(x))
+            scores.append(
+                float(short_time_objective_intelligibility(jnp.asarray(y), jnp.asarray(x), 10000, implementation="native"))
+            )
+        np.testing.assert_allclose(float(m.compute()), np.mean(scores), rtol=1e-5)
+
+
+@pytest.mark.skipif(not _PYSTOI_INSTALLED, reason="pystoi package not installed")
+class TestStoiNativeVsPystoi:
+    """Bit-parity sweep native vs pystoi whenever the package is present."""
+
+    @pytest.mark.parametrize("extended", [False, True])
+    @pytest.mark.parametrize("fs", [10000, 16000])
+    def test_native_matches_pystoi(self, extended, fs):
+        import pystoi
+
+        rng = np.random.default_rng(8)
+        x = _speechlike(rng, 2 * fs)
+        y = x + 0.5 * x.std() * rng.normal(size=x.size)
+        got = float(
+            short_time_objective_intelligibility(
+                jnp.asarray(y), jnp.asarray(x), fs, extended, implementation="native"
+            )
+        )
+        want = pystoi.stoi(x, y, fs, extended=extended)
+        np.testing.assert_allclose(got, want, atol=1e-3)
 
 
 @pytest.mark.skipif(not _PYSTOI_INSTALLED, reason="pystoi package not installed")
