@@ -24,7 +24,7 @@ K_BS = 200
 def measure_lpips() -> float:
     from metrics_tpu.image.backbones import NoTrainLpips
 
-    net = NoTrainLpips("alex", rng_seed=0)
+    net = NoTrainLpips("alex", rng_seed=0, allow_random_weights=True)
     a = jax.random.uniform(jax.random.PRNGKey(0), LPIPS_SHAPE, minval=-1, maxval=1)
     b = jax.random.uniform(jax.random.PRNGKey(1), LPIPS_SHAPE, minval=-1, maxval=1)
 

@@ -181,25 +181,42 @@ def save_flat_npz(flat: Dict[str, np.ndarray], path: str) -> None:
 
 def main(argv=None) -> None:
     import argparse
+    import os
+
+    from metrics_tpu.image.backbones.weights import CANONICAL_NAMES, weights_cache_dir
 
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("kind", choices=["inception", "lpips-vgg", "lpips-alex", "lpips-squeeze"])
     parser.add_argument(
-        "torch_checkpoints",
+        "paths",
         nargs="+",
-        help=".pt/.pth state-dict file(s); LPIPS usually needs TWO — the torchvision tower"
-        " checkpoint plus the lpips package's lin-head file — merged here",
+        help=".pt/.pth input state dict(s) — LPIPS usually needs TWO, the torchvision tower plus"
+        " the lpips package's lin-head file, merged here — followed by the output .npz path"
+        " (the output is omitted when --install is given: everything is then an input)",
     )
-    parser.add_argument("out_npz", help="output .npz usable as weights_path=")
+    parser.add_argument(
+        "--install",
+        action="store_true",
+        help=f"write to the discovery cache dir ({weights_cache_dir()}) under the canonical name"
+        " so FID/KID/IS/LPIPS find the weights automatically",
+    )
     parser.add_argument(
         "--allow-partial", action="store_true", help="skip the completeness check (LPIPS kinds only)"
     )
     args = parser.parse_args(argv)
+    if args.install:
+        inputs, out_npz = args.paths, None
+    else:
+        if len(args.paths) < 2:
+            parser.error(
+                "give input checkpoint(s) followed by the output .npz path, or pass --install"
+            )
+        inputs, out_npz = args.paths[:-1], args.paths[-1]
 
     import torch
 
     flat: Dict[str, np.ndarray] = {}
-    for ckpt in args.torch_checkpoints:
+    for ckpt in inputs:
         sd = torch.load(ckpt, map_location="cpu", weights_only=True)
         sd = sd.get("state_dict", sd) if isinstance(sd, dict) else sd
         if args.kind == "inception":
@@ -208,8 +225,20 @@ def main(argv=None) -> None:
             flat.update(convert_lpips_state_dict(args.kind.split("-")[1], sd))
     if args.kind != "inception" and not args.allow_partial:
         validate_lpips_flat(args.kind.split("-")[1], flat)
-    save_flat_npz(flat, args.out_npz)
-    print(f"wrote {len(flat)} arrays to {args.out_npz}")
+    outputs = []
+    if out_npz is not None:
+        outputs.append(out_npz)
+    if args.install:
+        os.makedirs(weights_cache_dir(), exist_ok=True)
+        outputs.append(os.path.join(weights_cache_dir(), CANONICAL_NAMES[args.kind]))
+    for out in outputs:
+        save_flat_npz(flat, out)
+        print(f"wrote {len(flat)} arrays to {out}")
+    if args.install:
+        print(
+            "installed: FID/KID/IS/LPIPS will discover these weights automatically"
+            " (override the directory with $METRICS_TPU_WEIGHTS_DIR)"
+        )
 
 
 if __name__ == "__main__":

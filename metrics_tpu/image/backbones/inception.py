@@ -335,9 +335,15 @@ class NoTrainInceptionV3:
     Args:
         features_list: taps to compute, e.g. ``["2048"]`` (the wrapper returns
             the first tap flattened, like the reference's ``out[0].reshape``).
-        weights_path: optional local checkpoint (``.npz`` flat dict or flax
-            ``.msgpack``); random initialization otherwise (with a warning —
-            shapes/architecture exact, scores not comparable to pretrained).
+        weights_path: local checkpoint (``.npz`` flat dict or flax
+            ``.msgpack``). When omitted, a converted checkpoint is DISCOVERED
+            via ``$METRICS_TPU_WEIGHTS_DIR`` / the user cache dir (see
+            :mod:`.weights`); with nothing found, construction refuses unless
+            ``allow_random_weights=True`` explicitly opts into
+            random-initialized architecture-only mode (with a warning).
+        allow_random_weights: FORCE seeded random initialization
+            (architecture-only smoke mode) — skips discovery so the result
+            does not depend on what happens to sit in the cache.
         rng_seed: seed for random initialization.
         dtype: compute dtype for the conv stack (``jnp.bfloat16`` roughly
             doubles MXU throughput; taps are cast back to float32).
@@ -349,22 +355,26 @@ class NoTrainInceptionV3:
         weights_path: str = None,
         rng_seed: int = 0,
         dtype: Any = jnp.float32,
+        allow_random_weights: bool = False,
     ) -> None:
+        from metrics_tpu.image.backbones.weights import resolve_weights
+
         self.features_list = tuple(str(f) for f in features_list)
         for f in self.features_list:
             if f not in _VALID_FEATURES:
                 raise ValueError(f"Invalid feature {f!r}; valid: {_VALID_FEATURES}")
         self.module = FIDInceptionV3(features_list=self.features_list, dtype=dtype)
         init_input = jnp.zeros((1, 299, 299, 3), jnp.float32)
+        weights_path = resolve_weights("inception", weights_path, allow_random_weights)
         if weights_path is not None:
             template = jax.eval_shape(self.module.init, jax.random.PRNGKey(0), init_input)
             self.variables = _load_variables(template, weights_path)
         else:
             rank_zero_warn(
-                "NoTrainInceptionV3 is running with RANDOM weights (pretrained checkpoints cannot be"
-                " downloaded in this environment). Feature shapes and architecture are exact, but metric"
-                " values are not comparable to pretrained-InceptionV3 results; pass `weights_path=` with a"
-                " locally converted checkpoint for real evaluations.",
+                "NoTrainInceptionV3 is running with RANDOM weights (allow_random_weights=True)."
+                " Feature shapes and architecture are exact, but metric values are not comparable to"
+                " pretrained-InceptionV3 results; convert a checkpoint with"
+                " `python -m metrics_tpu.image.backbones.convert` for real evaluations.",
                 UserWarning,
             )
             self.variables = _fast_init_variables(self.module, (init_input,), rng_seed)

@@ -156,27 +156,42 @@ class NoTrainLpips:
 
     Args:
         net_type: ``"vgg" | "alex" | "squeeze"``.
-        weights_path: optional local checkpoint (``.npz``/``.msgpack``);
-            random initialization with a warning otherwise. The LPIPS linear
-            heads are non-negative in the pretrained nets, so random heads are
-            clamped to their absolute value to keep distances >= 0.
+        weights_path: local checkpoint (``.npz``/``.msgpack``). When omitted,
+            a converted checkpoint is DISCOVERED via
+            ``$METRICS_TPU_WEIGHTS_DIR`` / the user cache dir (see
+            :mod:`.weights`); with nothing found, construction refuses unless
+            ``allow_random_weights=True``. The LPIPS linear heads are
+            non-negative in the pretrained nets, so random heads are clamped
+            to their absolute value to keep distances >= 0.
+        allow_random_weights: FORCE seeded random initialization
+            (architecture-only smoke mode) — skips discovery so the result
+            does not depend on what happens to sit in the cache.
         rng_seed: seed for random initialization.
     """
 
-    def __init__(self, net_type: str = "alex", weights_path: str = None, rng_seed: int = 0) -> None:
+    def __init__(
+        self,
+        net_type: str = "alex",
+        weights_path: str = None,
+        rng_seed: int = 0,
+        allow_random_weights: bool = False,
+    ) -> None:
+        from metrics_tpu.image.backbones.weights import resolve_weights
+
         if net_type not in _BACKBONES:
             raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
         self.net_type = net_type
         self.module = LPIPSNetwork(net_type=net_type)
         dummy = jnp.zeros((1, 16, 16, 3), jnp.float32)
+        weights_path = resolve_weights(f"lpips-{net_type}", weights_path, allow_random_weights)
         if weights_path is not None:
             template = jax.eval_shape(self.module.init, jax.random.PRNGKey(0), dummy, dummy)
             self.variables = _load_variables(template, weights_path)
         else:
             rank_zero_warn(
-                "NoTrainLpips is running with RANDOM weights (pretrained checkpoints cannot be downloaded"
-                " in this environment). Architecture is exact but distances are not comparable to the"
-                " pretrained LPIPS; pass `weights_path=` with a locally converted checkpoint.",
+                "NoTrainLpips is running with RANDOM weights (allow_random_weights=True). Architecture"
+                " is exact but distances are not comparable to the pretrained LPIPS; convert a checkpoint"
+                " with `python -m metrics_tpu.image.backbones.convert` for real evaluations.",
                 UserWarning,
             )
             variables = _fast_init_variables(self.module, (dummy, dummy), rng_seed)

@@ -1,0 +1,107 @@
+"""Pretrained-checkpoint discovery for the image backbones.
+
+The reference obtains its backbone weights by download at first use
+(torch-fidelity for the FID InceptionV3, ``torchmetrics/image/fid.py:40-57``;
+the ``lpips`` package for the LPIPS towers+heads, ``image/lpip.py:33-42``).
+Downloads are not assumed here; instead a converted checkpoint (see
+``python -m metrics_tpu.image.backbones.convert``) is DISCOVERED at
+construction time:
+
+1. an explicit ``weights_path=`` argument wins;
+2. ``$METRICS_TPU_WEIGHTS_DIR/<canonical name>`` if the env var is set;
+3. the user cache dir: ``$XDG_CACHE_HOME/metrics_tpu/weights/<name>`` (or
+   ``~/.cache/metrics_tpu/weights/<name>``).
+
+Canonical file names: ``inception_fid.npz`` for the FID/KID/IS InceptionV3,
+``lpips_{vgg,alex,squeeze}.npz`` for the LPIPS nets. ``convert --install``
+writes straight into the cache dir under these names.
+
+When nothing is found, construction REFUSES by default — a metric silently
+running on random weights produces plausible-looking numbers that are
+meaningless against the literature. Passing ``allow_random_weights=True``
+opts into the random-initialized architecture (useful for smoke tests and
+pipeline development), still with a warning.
+"""
+import os
+from typing import Optional
+
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+WEIGHTS_DIR_ENV = "METRICS_TPU_WEIGHTS_DIR"
+
+#: canonical checkpoint file names, keyed by backbone id
+CANONICAL_NAMES = {
+    "inception": "inception_fid.npz",
+    "lpips-vgg": "lpips_vgg.npz",
+    "lpips-alex": "lpips_alex.npz",
+    "lpips-squeeze": "lpips_squeeze.npz",
+}
+
+# one-line recipes shown in the refusal error, per backbone id
+_CONVERT_HINTS = {
+    "inception": (
+        "python -m metrics_tpu.image.backbones.convert inception"
+        " <torch-fidelity-or-torchvision inception .pth> --install"
+    ),
+    "lpips-vgg": (
+        "python -m metrics_tpu.image.backbones.convert lpips-vgg"
+        " <torchvision vgg16 .pth> <lpips weights/v0.1/vgg.pth> --install"
+    ),
+    "lpips-alex": (
+        "python -m metrics_tpu.image.backbones.convert lpips-alex"
+        " <torchvision alexnet .pth> <lpips weights/v0.1/alex.pth> --install"
+    ),
+    "lpips-squeeze": (
+        "python -m metrics_tpu.image.backbones.convert lpips-squeeze"
+        " <torchvision squeezenet1_1 .pth> <lpips weights/v0.1/squeeze.pth> --install"
+    ),
+}
+
+
+def weights_cache_dir() -> str:
+    """The directory ``convert --install`` writes to and discovery reads from."""
+    env = os.environ.get(WEIGHTS_DIR_ENV)
+    if env:
+        return env
+    xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
+    return os.path.join(xdg, "metrics_tpu", "weights")
+
+
+def discover_weights(backbone: str) -> Optional[str]:
+    """The discovered checkpoint path for a backbone id, or None."""
+    name = CANONICAL_NAMES[backbone]
+    candidate = os.path.join(weights_cache_dir(), name)
+    return candidate if os.path.exists(candidate) else None
+
+
+def resolve_weights(
+    backbone: str, weights_path: Optional[str], allow_random_weights: bool
+) -> Optional[str]:
+    """Resolve the checkpoint a backbone should load.
+
+    Returns a path (explicit or discovered), or ``None`` when random
+    initialization was explicitly requested. Raises
+    :class:`MetricsTPUUserError` otherwise — the honest default: no real
+    weights, no silently-meaningless metric values.
+
+    ``allow_random_weights=True`` FORCES random init (unless an explicit
+    ``weights_path`` was also given): it must stay reproducible and
+    machine-independent, so a checkpoint that happens to sit in the
+    discovery cache does not override it.
+    """
+    if weights_path is not None:
+        return weights_path
+    if allow_random_weights:
+        return None
+    found = discover_weights(backbone)
+    if found is not None:
+        return found
+    raise MetricsTPUUserError(
+        f"No pretrained weights found for backbone {backbone!r}: no `weights_path=` was given and"
+        f" {os.path.join(weights_cache_dir(), CANONICAL_NAMES[backbone])!r} does not exist."
+        " Metric values computed on RANDOM weights are meaningless against published results, so"
+        " construction refuses by default. Either convert a locally available torch checkpoint —\n"
+        f"    {_CONVERT_HINTS[backbone]}\n"
+        f" (or set ${WEIGHTS_DIR_ENV} to a directory containing {CANONICAL_NAMES[backbone]!r}) —"
+        " or opt in explicitly with `allow_random_weights=True` (architecture-only smoke mode)."
+    )

@@ -15,7 +15,9 @@ TPU-first differences:
 * **In-repo Flax InceptionV3 default.** Passing an int (the reference's
   pretrained-InceptionV3 layer selector, ``image/fid.py:228-250``) builds the
   in-repo ``NoTrainInceptionV3`` (``image/backbones/inception.py``) at that
-  feature tap — random-initialized unless ``weights_path=`` points at a
+  feature tap — weights from ``weights_path=`` or the discovery path
+  (``$METRICS_TPU_WEIGHTS_DIR`` / user cache; see ``backbones/weights.py``),
+  loaded from a
   locally converted checkpoint (downloads are unavailable here). A callable
   ``images -> (N, D)`` extractor stays injectable (the reference's
   user-supplied ``torch.nn.Module`` path).
@@ -43,12 +45,14 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: whether ``reset()`` clears the real-set moments.
         weights_path: optional local InceptionV3 checkpoint for the int
             ``feature`` path (``.npz`` flat dict or flax ``.msgpack``);
-            random initialization with a warning otherwise.
+            discovered via the weights cache otherwise. With no checkpoint
+            found, construction refuses unless ``allow_random_weights=True``
+            (architecture-only smoke mode, warned).
 
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu import FrechetInceptionDistance
-        >>> fid = FrechetInceptionDistance(feature=64)
+        >>> fid = FrechetInceptionDistance(feature=64, allow_random_weights=True)
         >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
         >>> real = jax.random.randint(key1, (8, 3, 32, 32), 0, 200, dtype=jnp.uint8)
         >>> fake = jax.random.randint(key2, (8, 3, 32, 32), 100, 255, dtype=jnp.uint8)
@@ -67,6 +71,7 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         feature_dim: int = None,
         weights_path: str = None,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -78,7 +83,9 @@ class FrechetInceptionDistance(Metric):
                 )
             from metrics_tpu.image.backbones import NoTrainInceptionV3
 
-            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+            self.inception = NoTrainInceptionV3(
+                [str(feature)], weights_path=weights_path, allow_random_weights=allow_random_weights
+            )
             feature_dim = feature
         elif callable(feature):
             if feature_dim is None:

@@ -23,7 +23,8 @@ class InceptionScore(Metric):
     Args:
         feature: int/str in ``("logits_unbiased", 64, 192, 768, 2048)``
             selecting an in-repo Flax InceptionV3 tap (uint8 image inputs;
-            random-init unless ``weights_path=`` is given), or a callable
+            weights via ``weights_path=``/discovery, refusing without a
+            checkpoint unless ``allow_random_weights=True``), or a callable
             ``images -> (N, num_classes)`` logits extractor.
         splits: number of splits for the mean/std estimate.
         rng_seed: seed for the pre-split shuffle.
@@ -51,6 +52,7 @@ class InceptionScore(Metric):
         splits: int = 10,
         rng_seed: int = 42,
         weights_path: str = None,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -67,7 +69,9 @@ class InceptionScore(Metric):
                 )
             from metrics_tpu.image.backbones import NoTrainInceptionV3
 
-            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+            self.inception = NoTrainInceptionV3(
+                [str(feature)], weights_path=weights_path, allow_random_weights=allow_random_weights
+            )
         elif callable(feature):
             self.inception = feature
         else:

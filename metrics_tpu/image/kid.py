@@ -54,7 +54,8 @@ class KernelInceptionDistance(Metric):
     Args:
         feature: int/str in ``("logits_unbiased", 64, 192, 768, 2048)``
             selecting an in-repo Flax InceptionV3 tap (uint8 image inputs;
-            random-init unless ``weights_path=`` is given), or a callable
+            weights via ``weights_path=``/discovery, refusing without a
+            checkpoint unless ``allow_random_weights=True``), or a callable
             ``images -> (N, D)`` feature extractor.
         subsets: number of random feature subsets per compute.
         subset_size: samples per subset.
@@ -90,6 +91,7 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         rng_seed: int = 42,
         weights_path: str = None,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -106,7 +108,9 @@ class KernelInceptionDistance(Metric):
                 )
             from metrics_tpu.image.backbones import NoTrainInceptionV3
 
-            self.inception = NoTrainInceptionV3([str(feature)], weights_path=weights_path)
+            self.inception = NoTrainInceptionV3(
+                [str(feature)], weights_path=weights_path, allow_random_weights=allow_random_weights
+            )
         elif callable(feature):
             self.inception = feature
         else:

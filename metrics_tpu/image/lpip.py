@@ -5,7 +5,8 @@ Behavioral equivalent of reference ``torchmetrics/image/lpip.py:44``
 :88-92). ``net_type`` selects the in-repo Flax LPIPS network
 (``image/backbones/lpips_nets.py``: VGG16 / AlexNet / SqueezeNet feature
 stacks + per-layer linear heads, one jitted two-tower XLA program) —
-random-initialized unless ``weights_path=`` points at a locally converted
+weights from ``weights_path=`` or the discovery path (refusing without a
+checkpoint unless ``allow_random_weights=True``), loaded from a locally converted
 checkpoint. A callable ``net`` ``(img1, img2) -> (N,) distances`` stays
 injectable.
 """
@@ -42,6 +43,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         reduction: str = "mean",
         net: Union[Callable, None] = None,
         weights_path: str = None,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -51,7 +53,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if net is None:
             from metrics_tpu.image.backbones import NoTrainLpips
 
-            net = NoTrainLpips(net_type=net_type, weights_path=weights_path)
+            net = NoTrainLpips(
+                net_type=net_type, weights_path=weights_path, allow_random_weights=allow_random_weights
+            )
         self.net = net
 
         valid_reduction = ("mean", "sum")

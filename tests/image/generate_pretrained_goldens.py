@@ -1,0 +1,96 @@
+"""Generate published-value goldens for the pretrained-backbone metrics.
+
+Run this in an environment that has the REFERENCE implementations installed
+(``torch-fidelity`` or ``torchvision`` for InceptionV3 feature extraction,
+the ``lpips`` package for LPIPS) — i.e. anywhere the reference library
+itself could run:
+
+    python tests/image/generate_pretrained_goldens.py
+
+It computes FID / InceptionScore / LPIPS on DETERMINISTIC synthetic image
+sets (seeded, dtype-stable, identical on every machine) **with the
+reference torch implementations and the published pretrained weights**, and
+writes ``tests/image/goldens/pretrained_goldens.json``. Committing that
+file arms ``test_pretrained_parity.py``: whenever converted weights are
+discoverable (``convert --install``), the jax metrics must reproduce these
+reference values.
+
+``test_pretrained_parity.py`` imports ``_image_sets`` / ``_lpips_pairs``
+from here, so the generator and the parity pins are structurally guaranteed
+to run on identical inputs.
+"""
+import json
+import os
+
+import numpy as np
+
+
+def _image_sets():
+    """Two deterministic uint8 image sets, (N, 3, 64, 64)."""
+    rng = np.random.default_rng(1234)
+    base = rng.integers(0, 256, (32, 3, 64, 64), dtype=np.uint8)
+    # the "fake" set: smoothed + brightness-shifted copy, deterministic
+    shifted = np.clip(base.astype(np.int32) + 40, 0, 255).astype(np.uint8)
+    blurred = (shifted[..., :-1] // 2 + shifted[..., 1:] // 2).astype(np.uint8)
+    fake = np.pad(blurred, ((0, 0), (0, 0), (0, 0), (0, 1)), mode="edge")
+    return base, fake
+
+
+def _lpips_pairs():
+    """Deterministic float pairs in [-1, 1], (N, 3, 64, 64)."""
+    rng = np.random.default_rng(99)
+    a = rng.uniform(-1, 1, (8, 3, 64, 64)).astype(np.float32)
+    b = np.clip(a + 0.3 * rng.uniform(-1, 1, a.shape).astype(np.float32), -1, 1)
+    return a, b
+
+
+def main() -> None:
+    import torch
+
+    real, fake = _image_sets()
+    goldens = {}
+
+    # ---- FID + InceptionScore via torchmetrics-or-torch-fidelity ----------
+    try:
+        from torchmetrics.image.fid import FrechetInceptionDistance as TorchFID
+        from torchmetrics.image.inception import InceptionScore as TorchIS
+
+        fid = TorchFID(feature=2048)
+        fid.update(torch.from_numpy(real), real=True)
+        fid.update(torch.from_numpy(fake), real=False)
+        goldens["fid_2048"] = float(fid.compute())
+
+        isc = TorchIS()
+        isc.update(torch.from_numpy(real))
+        mean, std = isc.compute()
+        goldens["inception_score_mean"] = float(mean)
+        goldens["inception_score_std"] = float(std)
+    except ImportError as err:
+        print(f"skipping FID/IS goldens ({err})")
+
+    # ---- LPIPS via the lpips package --------------------------------------
+    try:
+        import lpips as lpips_pkg
+
+        a, b = _lpips_pairs()
+        for net in ("alex", "vgg", "squeeze"):
+            model = lpips_pkg.LPIPS(net=net)
+            with torch.no_grad():
+                d = model(torch.from_numpy(a), torch.from_numpy(b)).squeeze()
+            goldens[f"lpips_{net}"] = [float(v) for v in d]
+    except ImportError as err:
+        print(f"skipping LPIPS goldens ({err})")
+
+    if not goldens:
+        raise SystemExit("no reference packages available; nothing generated")
+
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "pretrained_goldens.json")
+    with open(out, "w") as fh:
+        json.dump(goldens, fh, indent=2, sort_keys=True)
+    print(f"wrote {sorted(goldens)} to {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -73,7 +73,7 @@ class TestInceptionConversion:
 
     def test_full_state_dict_roundtrip(self, tmp_path):
         """A complete synthetic inception state dict loads at the 2048 tap."""
-        template = NoTrainInceptionV3(["2048", "logits"], rng_seed=5)
+        template = NoTrainInceptionV3(["2048", "logits"], rng_seed=5, allow_random_weights=True)
         # fabricate the torch-layout state dict from our own tree, then
         # convert it back and require bit-identical reload
         flat = {}

@@ -46,24 +46,24 @@ class TestInceptionArchitecture:
 
     def test_invalid_feature_rejected(self):
         with pytest.raises(ValueError, match="Invalid feature"):
-            NoTrainInceptionV3(["banana"])
+            NoTrainInceptionV3(["banana"], allow_random_weights=True)
 
     def test_extractor_runs_and_is_deterministic(self):
-        net = NoTrainInceptionV3(["64"])
+        net = NoTrainInceptionV3(["64"], allow_random_weights=True)
         out = net(_imgs(4))
         assert out.shape == (4, 64)
         assert bool(jnp.isfinite(out).all())
         assert np.allclose(out, net(_imgs(4)))
 
     def test_uint8_contract(self):
-        net = NoTrainInceptionV3(["64"])
+        net = NoTrainInceptionV3(["64"], allow_random_weights=True)
         with pytest.raises(TypeError, match="uint8"):
             net(_imgs(4).astype(np.float32))
         with pytest.raises(ValueError, match="N, 3, H, W"):
             net(_imgs(4)[:, :1])
 
     def test_weights_path_roundtrip(self, tmp_path):
-        net = NoTrainInceptionV3(["64"], rng_seed=7)
+        net = NoTrainInceptionV3(["64"], rng_seed=7, allow_random_weights=True)
         path = str(tmp_path / "inception.npz")
         save_variables_npz(net.variables, path)
         net2 = NoTrainInceptionV3(["64"], weights_path=path)
@@ -74,7 +74,7 @@ class TestInceptionArchitecture:
             NoTrainInceptionV3(["64"], weights_path="/nonexistent/weights.npz")
 
     def test_weights_path_shape_mismatch(self, tmp_path):
-        net = NoTrainInceptionV3(["64"])
+        net = NoTrainInceptionV3(["64"], allow_random_weights=True)
         path = str(tmp_path / "bad.npz")
         bad = jax.tree_util.tree_map(lambda v: np.zeros((1,), np.float32), net.variables)
         save_variables_npz(bad, path)
@@ -86,7 +86,7 @@ class TestDefaultExtractorMetrics:
     """FID/KID/IS work out of the box with int/str features (random weights)."""
 
     def test_fid_default_backbone(self):
-        fid = FrechetInceptionDistance(feature=64)
+        fid = FrechetInceptionDistance(feature=64, allow_random_weights=True)
         fid.update(_imgs(8, seed=1), real=True)
         fid.update(_imgs(8, seed=2), real=False)
         val = fid.compute()
@@ -102,7 +102,7 @@ class TestDefaultExtractorMetrics:
             FrechetInceptionDistance(feature="2048")
 
     def test_kid_default_backbone(self):
-        kid = KernelInceptionDistance(feature=64, subsets=2, subset_size=4)
+        kid = KernelInceptionDistance(feature=64, subsets=2, subset_size=4, allow_random_weights=True)
         kid.update(_imgs(8, seed=1), real=True)
         kid.update(_imgs(8, seed=2), real=False)
         mean, std = kid.compute()
@@ -114,7 +114,7 @@ class TestDefaultExtractorMetrics:
 
     def test_is_default_backbone(self):
         # 'logits_unbiased' traces the full network incl. the fc head
-        isc = InceptionScore(splits=2)
+        isc = InceptionScore(splits=2, allow_random_weights=True)
         isc.update(_imgs(8))
         mean, std = isc.compute()
         assert float(mean) >= 1.0 - 1e-5
@@ -128,7 +128,7 @@ class TestDefaultExtractorMetrics:
 class TestLpipsBackbones:
     @pytest.mark.parametrize("net_type", ["alex", "squeeze", "vgg"])
     def test_net_types_construct_and_run(self, net_type):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type=net_type)
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type=net_type, allow_random_weights=True)
         rng = np.random.default_rng(0)
         a = rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32)
         b = rng.uniform(-1, 1, (4, 3, 32, 32)).astype(np.float32)
@@ -137,22 +137,22 @@ class TestLpipsBackbones:
         assert float(val) >= 0  # random heads are abs-clamped, distances stay >= 0
 
     def test_identical_images_zero_distance(self):
-        net = NoTrainLpips("alex")
+        net = NoTrainLpips("alex", allow_random_weights=True)
         a = jnp.asarray(np.random.default_rng(0).uniform(-1, 1, (2, 3, 32, 32)), jnp.float32)
         assert np.allclose(net(a, a), 0.0, atol=1e-6)
 
     def test_input_range_contract(self):
-        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
         bad = jnp.ones((2, 3, 32, 32)) * 2.0
         with pytest.raises(ValueError, match="normalized"):
             lpips.update(bad, bad)
 
     def test_invalid_net_type(self):
         with pytest.raises(ValueError, match="net_type"):
-            NoTrainLpips("bad")
+            NoTrainLpips("bad", allow_random_weights=True)
 
     def test_weights_path_roundtrip(self, tmp_path):
-        net = NoTrainLpips("alex", rng_seed=3)
+        net = NoTrainLpips("alex", rng_seed=3, allow_random_weights=True)
         path = str(tmp_path / "lpips.npz")
         save_variables_npz(net.variables, path)
         net2 = NoTrainLpips("alex", weights_path=path)

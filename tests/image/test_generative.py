@@ -75,7 +75,7 @@ class TestFID:
 
     def test_fid_int_feature_constructs_default_backbone(self):
         # int feature now builds the in-repo Flax InceptionV3 (random-init)
-        fid = FrechetInceptionDistance(feature=64)
+        fid = FrechetInceptionDistance(feature=64, allow_random_weights=True)
         assert fid.feature_dim == 64
         with pytest.raises(ValueError):
             FrechetInceptionDistance(feature=100)
