@@ -47,6 +47,21 @@ State = Dict[str, Any]
 __all__ = ["make_step"]
 
 
+def _fresh_copy(state: State) -> State:
+    """Copy leaves on the eager path so a donated init() can never delete
+    arrays later traces embed as constants; a no-op under a trace (jnp.array
+    on a concrete value would needlessly turn it into a tracer, and donation
+    cannot reach trace-internal values)."""
+    if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
+        return jax.tree_util.tree_map(jnp.array, state)
+    return state
+
+
+def _stack_state(one: State, n: int) -> State:
+    """Broadcast every leaf of a fresh state to a leading replicate axis."""
+    return {name: jnp.broadcast_to(v[None], (n,) + jnp.shape(v)) for name, v in one.items()}
+
+
 def make_step(
     metric: Union[Metric, Type[Metric], "MetricCollection"],  # noqa: F821
     *init_args: Any,
@@ -113,18 +128,27 @@ def make_step(
 
     from metrics_tpu.wrappers.abstract import WrapperMetric
     from metrics_tpu.wrappers.bootstrapping import BootStrapper
+    from metrics_tpu.wrappers.classwise import ClasswiseWrapper
+    from metrics_tpu.wrappers.minmax import MinMaxMetric
+    from metrics_tpu.wrappers.multioutput import MultioutputWrapper
 
     if isinstance(template, BootStrapper):
         # the bootstrap replicate states are a fixed-shape stacked pytree —
         # exactly a scan carry; see _make_bootstrap_step
         return _make_bootstrap_step(template, axis_name=axis_name, with_value=with_value)
+    if isinstance(template, ClasswiseWrapper):
+        return _make_classwise_step(template, axis_name=axis_name, with_value=with_value)
+    if isinstance(template, MinMaxMetric):
+        return _make_minmax_step(template, axis_name=axis_name, with_value=with_value)
+    if isinstance(template, MultioutputWrapper):
+        return _make_multioutput_step(template, axis_name=axis_name, with_value=with_value)
 
     if isinstance(template, WrapperMetric):
         raise ValueError(
-            f"{type(template).__name__} is a wrapper metric; its state lives in wrapped children whose"
-            " snapshots are not valid jitted-step carries. Build the step from the base metric and apply"
-            " the wrapper semantics outside the step, or use the eager class API (BootStrapper is the"
-            " exception: its stacked replicate states do form a valid carry)."
+            f"{type(template).__name__} is a wrapper metric whose state is not a fixed-shape carry"
+            " (snapshot lists / dynamic shapes). Build the step from the base metric and apply the"
+            " wrapper semantics outside the step, or use the eager class API. (BootStrapper,"
+            " ClasswiseWrapper, MinMaxMetric and MultioutputWrapper(remove_nans=False) ARE supported.)"
         )
 
     for name, default in template._defaults.items():
@@ -143,16 +167,9 @@ def make_step(
     def init() -> State:
         worker.reset()
         state = worker.state_pytree()
-        # Eager calls get fresh buffers, never the worker's canonical
-        # defaults: the returned state may be donated (jit(donate_argnums=0))
-        # and donating an aliased default would delete arrays later traces
-        # embed as constants. Inside a trace, skip the copy — jnp.array on a
-        # concrete value would needlessly turn it into a tracer (losing e.g.
-        # CapacityBuffer's host-count mirror), and donation cannot reach
-        # trace-internal values.
-        if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
-            state = jax.tree_util.tree_map(jnp.array, state)
-        return state
+        # fresh buffers on the eager path (donation safety; see _fresh_copy —
+        # the in-trace no-op also preserves CapacityBuffer's host-count mirror)
+        return _fresh_copy(state)
 
     def _load(state: State) -> Metric:
         worker.reset()
@@ -275,14 +292,10 @@ def _make_bootstrap_step(
     stats = {"mean": wrapper.mean, "std": wrapper.std, "quantile": wrapper.quantile, "raw": wrapper.raw}
 
     def _stacked_init() -> State:
-        one = base_init()
-        return {n: jnp.broadcast_to(v[None], (n_boot,) + jnp.shape(v)) for n, v in one.items()}
+        return _stack_state(base_init(), n_boot)
 
     def init() -> State:
-        state = {"key": jax.random.PRNGKey(seed), "boot": _stacked_init()}
-        if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
-            state = jax.tree_util.tree_map(jnp.array, state)
-        return state
+        return _fresh_copy({"key": jax.random.PRNGKey(seed), "boot": _stacked_init()})
 
     def _apply(boot: State, sub: Array, args: tuple, kwargs: dict) -> State:
         from metrics_tpu.wrappers.bootstrapping import _apply_resample
@@ -328,6 +341,131 @@ def _make_bootstrap_step(
         if axis_name is not None:
             boot = {n: sync_reduce_in_context(v, reductions[n], axis_name) for n, v in boot.items()}
         return _statistics(jnp.asarray(jax.vmap(base_compute)(boot)))
+
+    return init, step, compute
+
+
+def _make_classwise_step(
+    wrapper: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """ClasswiseWrapper as a pure step: the carry IS the base metric's state;
+    only the compute output is relabeled into ``{name_label: scalar}``."""
+    base_init, base_step, base_compute = make_step(wrapper.metric, axis_name=axis_name, with_value=with_value)
+    _convert = wrapper._convert  # the wrapper's own labeling (zip-truncating, pure)
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        new_state, value = base_step(state, *args, **kwargs)
+        return new_state, (_convert(jnp.asarray(value)) if with_value else None)
+
+    def compute(state: State) -> Dict[str, Array]:
+        return _convert(jnp.asarray(base_compute(state)))
+
+    return base_init, step, compute
+
+
+def _make_minmax_step(
+    wrapper: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """MinMaxMetric as a pure step.
+
+    The carry is ``{"base": base_state, "min_val", "max_val"}``. Each step
+    folds the batch and advances min/max with the post-update RUNNING value
+    — equivalent to the eager wrapper when ``compute()`` follows every
+    ``update()`` (the tracker's canonical usage). Under ``axis_name`` the
+    running value is the SYNCED one (the base compute inside the step emits
+    its reductions — a per-step collective over the scalar states; the true
+    global trajectory, so avoid wrapping buffer-state metrics whose sync is
+    a full gather).
+    """
+    base_init, base_step, base_compute = make_step(
+        wrapper._base_metric, axis_name=axis_name, with_value=with_value
+    )
+
+    def init() -> State:
+        return {
+            "base": base_init(),
+            "min_val": jnp.asarray(jnp.inf),
+            "max_val": jnp.asarray(-jnp.inf),
+        }
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        new_base, value = base_step(state["base"], *args, **kwargs)
+        running = jnp.asarray(base_compute(new_base), dtype=jnp.float32)
+        if running.size != 1:  # static under trace: raises at trace time, like the eager wrapper
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar, but got shape {running.shape}"
+            )
+        running = running.reshape(())
+        new_state = {
+            "base": new_base,
+            "min_val": jnp.minimum(state["min_val"], running),
+            "max_val": jnp.maximum(state["max_val"], running),
+        }
+        return new_state, value
+
+    def compute(state: State) -> Dict[str, Array]:
+        return {
+            "raw": base_compute(state["base"]),
+            "min": state["min_val"],
+            "max": state["max_val"],
+        }
+
+    return init, step, compute
+
+
+def _make_multioutput_step(
+    wrapper: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """MultioutputWrapper as a pure step: the reference's N deep copies
+    become one stacked state pytree with a leading output axis, and every
+    step is a single ``jax.vmap`` over the sliced ``output_dim`` of the
+    array inputs (reference ``wrappers/multioutput.py:23``)."""
+    if wrapper.remove_nans:
+        raise ValueError(
+            "MultioutputWrapper(remove_nans=True) drops rows by VALUE — a dynamic shape no traced"
+            " step can carry. Construct the wrapper with remove_nans=False for the step API (inputs"
+            " must be NaN-free), or use the eager class API."
+        )
+    if any(isinstance(d, CapacityBuffer) for d in wrapper.metrics[0]._defaults.values()):
+        raise ValueError(
+            "MultioutputWrapper over a sample-buffer base metric is not a stackable step carry"
+            " (CapacityBuffer states cannot broadcast over the output axis). Use the eager class"
+            " API, or one make_step per output."
+        )
+    n_out = len(wrapper.metrics)
+    dim = wrapper.output_dim
+    squeeze = wrapper.squeeze_outputs
+    base_init, base_step, base_compute = make_step(
+        wrapper.metrics[0], axis_name=axis_name, with_value=with_value
+    )
+
+    def init() -> State:
+        return _fresh_copy(_stack_state(base_init(), n_out))
+
+    def _is_array(a: Any) -> bool:
+        return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        keys = sorted(kwargs)
+        n_pos = len(args)
+        leaves = list(args) + [kwargs[k] for k in keys]
+        axes = tuple(dim if _is_array(a) else None for a in leaves)
+
+        def one(s, *flat):
+            flat = [jnp.expand_dims(a, dim) if (_is_array(a) and not squeeze) else a for a in flat]
+            return base_step(s, *flat[:n_pos], **dict(zip(keys, flat[n_pos:])))
+
+        new_state, values = jax.vmap(one, in_axes=(0,) + axes)(state, *leaves)
+        return new_state, (values if with_value else None)
+
+    def compute(state: State) -> Array:
+        return jax.vmap(base_compute)(state)
 
     return init, step, compute
 

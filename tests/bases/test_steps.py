@@ -142,14 +142,15 @@ class TestCollectionStep:
         assert set(vals) == {"val_Accuracy"}
         assert set(compute(state)) == {"val_Accuracy"}
 
-    def test_wrapper_members_rejected_with_guidance(self):
+    def test_dynamic_wrapper_members_rejected_with_guidance(self):
         from metrics_tpu import MetricCollection
-        from metrics_tpu.wrappers import ClasswiseWrapper
+        from metrics_tpu.wrappers import MetricTracker
 
+        tracker = MetricTracker(Accuracy(num_classes=3))
         with pytest.raises(ValueError, match="wrapper"):
-            make_step(ClasswiseWrapper(Accuracy(num_classes=3, average="none")))
+            make_step(tracker)
         with pytest.raises(ValueError, match="wrapper"):
-            make_step(MetricCollection({"cw": ClasswiseWrapper(Accuracy(num_classes=3, average="none"))}))
+            make_step(MetricCollection({"t": MetricTracker(Accuracy(num_classes=3))}))
 
     def test_collection_mesh_parity(self):
         rng = np.random.default_rng(12)
@@ -428,3 +429,159 @@ class TestBootstrapStep:
         boot = BootStrapper(Accuracy(num_classes=3), num_bootstraps=4, sampling_strategy="poisson")
         with pytest.raises(ValueError, match="per-copy eager path"):
             make_step(boot)
+
+
+class TestWrapperSteps:
+    """ClasswiseWrapper / MinMaxMetric / MultioutputWrapper as pure steps."""
+
+    def test_classwise_scan_matches_eager(self):
+        from metrics_tpu.wrappers import ClasswiseWrapper
+
+        rng = np.random.default_rng(31)
+        preds = jnp.asarray(rng.integers(0, 3, (4, 24)))
+        target = jnp.asarray(rng.integers(0, 3, (4, 24)))
+        wrapper = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["a", "b", "c"])
+        init, step, compute = make_step(wrapper)
+        state, _ = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+        got = compute(state)
+
+        eager = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["a", "b", "c"])
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        want = eager.compute()
+        assert set(got) == set(want) == {"accuracy_a", "accuracy_b", "accuracy_c"}
+        for k in want:
+            np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-6)
+
+    def test_minmax_scan_tracks_running_extremes(self):
+        from metrics_tpu import MeanMetric
+        from metrics_tpu.wrappers import MinMaxMetric
+
+        # running means after each batch: 1.0, 2.0 (mean of 1,3), 1.0 (mean of 1,3,-1,1)
+        batches = jnp.asarray([[1.0, 1.0], [3.0, 3.0], [-2.0, 0.0]])
+        init, step, compute = make_step(MinMaxMetric(MeanMetric()))
+        state, _ = jax.lax.scan(lambda s, b: step(s, b), init(), batches)
+        out = compute(state)
+        np.testing.assert_allclose(float(out["raw"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(out["min"]), 1.0, atol=1e-6)
+        np.testing.assert_allclose(float(out["max"]), 2.0, atol=1e-6)
+
+        # eager equivalence when compute() follows every update
+        eager = MinMaxMetric(MeanMetric())
+        for b in batches:
+            eager.update(b)
+            res = eager.compute()
+        np.testing.assert_allclose(float(res["max"]), float(out["max"]), atol=1e-6)
+        np.testing.assert_allclose(float(res["min"]), float(out["min"]), atol=1e-6)
+
+    def test_multioutput_scan_matches_eager(self):
+        from metrics_tpu import MeanSquaredError
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        rng = np.random.default_rng(32)
+        preds = jnp.asarray(rng.normal(size=(3, 16, 2)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(3, 16, 2)).astype(np.float32))
+        wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+        init, step, compute = make_step(wrapper)
+        state, values = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+        got = np.asarray(compute(state))
+        assert got.shape == (2,)
+        assert values.shape == (3, 2)  # per-batch per-output values
+
+        eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False)
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        np.testing.assert_allclose(got, np.asarray(eager.compute()), atol=1e-6)
+
+    def test_multioutput_remove_nans_rejected(self):
+        from metrics_tpu import MeanSquaredError
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        with pytest.raises(ValueError, match="remove_nans"):
+            make_step(MultioutputWrapper(MeanSquaredError(), num_outputs=2))
+
+    def test_wrapper_steps_mesh_parity(self):
+        """All three wrappers sync correctly over the 8-device mesh."""
+        from metrics_tpu import MeanSquaredError
+        from metrics_tpu.wrappers import ClasswiseWrapper, MinMaxMetric, MultioutputWrapper
+
+        rng = np.random.default_rng(33)
+        n = 8 * 16
+
+        # classwise
+        preds_c = jnp.asarray(rng.integers(0, 3, (n,)))
+        target_c = jnp.asarray(rng.integers(0, 3, (n,)))
+        cw = ClasswiseWrapper(Accuracy(num_classes=3, average="none"))
+        ci, cs, cc = make_step(cw, axis_name="dp")
+
+        def prog_c(p, t):
+            s, _ = cs(ci(), p, t)
+            return cc(s)
+
+        got = jax.jit(
+            jax.shard_map(prog_c, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds_c, target_c)
+        eager = ClasswiseWrapper(Accuracy(num_classes=3, average="none"))
+        eager.update(preds_c, target_c)
+        want = eager.compute()
+        for k in want:
+            np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-6)
+
+        # multioutput
+        preds_m = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+        target_m = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+        mi, ms, mc = make_step(
+            MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=False), axis_name="dp"
+        )
+
+        def prog_m(p, t):
+            s, _ = ms(mi(), p, t)
+            return mc(s)
+
+        got_m = jax.jit(
+            jax.shard_map(prog_m, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds_m, target_m)
+        se = np.square(np.asarray(preds_m) - np.asarray(target_m)).mean(axis=0)
+        np.testing.assert_allclose(np.asarray(got_m), se, atol=1e-6)
+
+        # minmax: raw == synced value; min/max bound it
+        mm_i, mm_s, mm_c = make_step(MinMaxMetric(Accuracy(num_classes=3)), axis_name="dp")
+
+        def prog_mm(p, t):
+            s, _ = mm_s(mm_i(), p, t)
+            return mm_c(s)
+
+        out = jax.jit(
+            jax.shard_map(prog_mm, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds_c, target_c)
+        acc = (np.asarray(preds_c) == np.asarray(target_c)).mean()
+        np.testing.assert_allclose(float(out["raw"]), acc, atol=1e-6)
+        assert float(out["min"]) <= acc <= float(out["max"]) + 1e-6
+
+    def test_classwise_excess_labels_truncate_like_eager(self):
+        from metrics_tpu.wrappers import ClasswiseWrapper
+
+        wrapper = ClasswiseWrapper(Accuracy(num_classes=2, average="none"), labels=["a", "b", "c"])
+        init, step, compute = make_step(wrapper)
+        state, _ = step(init(), jnp.asarray([0, 1, 1, 0]), jnp.asarray([0, 1, 0, 0]))
+        got = compute(state)
+        eager = ClasswiseWrapper(Accuracy(num_classes=2, average="none"), labels=["a", "b", "c"])
+        eager.update(jnp.asarray([0, 1, 1, 0]), jnp.asarray([0, 1, 0, 0]))
+        assert set(got) == set(eager.compute()) == {"accuracy_a", "accuracy_b"}
+
+    def test_minmax_vector_base_rejected_like_eager(self):
+        from metrics_tpu.wrappers import MinMaxMetric
+
+        init, step, _ = make_step(MinMaxMetric(Accuracy(num_classes=3, average="none")))
+        with pytest.raises(RuntimeError, match="scalar"):
+            step(init(), jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+
+    def test_multioutput_buffer_base_rejected_with_guidance(self):
+        from metrics_tpu import SpearmanCorrCoef
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        wrapper = MultioutputWrapper(
+            SpearmanCorrCoef(sample_capacity=64), num_outputs=2, remove_nans=False
+        )
+        with pytest.raises(ValueError, match="sample-buffer"):
+            make_step(wrapper)
