@@ -32,37 +32,64 @@ def _trace_sqrtm_product_eigh(sigma1: Array, sigma2: Array) -> Array:
     return jnp.sum(jnp.sqrt(vals))
 
 
-def _trace_sqrtm_product_ns(sigma1: Array, sigma2: Array, iters: int = 30) -> Array:
+def _trace_sqrtm_product_ns(sigma1: Array, sigma2: Array, iters: int = 14) -> Array:
     """``tr(sqrtm(sigma1 @ sigma2))`` via Newton-Schulz iteration (unchecked)."""
     return _trace_sqrtm_product_ns_checked(sigma1, sigma2, iters)[0]
 
 
-def _trace_sqrtm_product_ns_checked(sigma1: Array, sigma2: Array, iters: int = 30) -> Tuple[Array, Array]:
-    """Newton-Schulz trace plus a convergence verdict.
+def _trace_sqrtm_product_ns_checked(sigma1: Array, sigma2: Array, iters: int = 14) -> Tuple[Array, Array]:
+    """Accelerated Newton-Schulz trace plus a convergence verdict.
 
     ``sigma1 @ sigma2`` is similar to the PSD matrix ``A sigma2 A`` (with
     ``A = sqrtm(sigma1)``), so its square root exists and the coupled
-    Newton-Schulz iteration converges after Frobenius normalization. All
-    work is matmuls — MXU-resident, ~7x faster than ``eigh`` at D=2048 on
-    v5e, at ~1e-5 relative error on covariance-like spectra.
+    Newton-Schulz iteration converges after Frobenius normalization. Three
+    refinements over the plain iteration:
 
-    NS diverges (to NaN or garbage) when the normalized product has
-    eigenvalues pushed slightly negative by fp noise — which happens for
-    rank-deficient covariances (fewer samples than feature dims). Returns
-    ``(trace, ok)`` where ``ok`` checks both finiteness and the sqrt residual
+    * **trace scaling**: each step rescales by ``mu = sqrt(d / tr(Z Y))``,
+      pushing the mean eigenvalue of ``mu^2 Z Y`` toward 1 (Y, Z, T are
+      polynomials in the normalized product, so they commute and the
+      ``Y = M Z`` invariant survives the rescale);
+    * **basin clamp** ``mu^2 <= 2``: one unscaled NS step maps the spectrum
+      into (0, 1], so a <=2x rescale keeps every eigenvalue inside the
+      iteration's (0, 3) basin — unclamped trace scaling DIVERGES on
+      decaying (power-law / multi-decade) spectra whose lambda_max far
+      exceeds lambda_mean;
+    * **convergence freeze**: once ``||Z Y - I||_F`` is small the carry
+      stops updating, so extra iterations cannot re-amplify fp noise in
+      near-null directions (the instability that otherwise corrupts
+      converged iterates).
+
+    Flat covariance spectra at D=2048 converge in ~8 iterations to ~5e-7
+    relative error and 3-4-decade spreads by ~14 (the unscaled iteration
+    needed 30 for ~2e-6 on flat spectra) — all matmuls, MXU-resident,
+    ~1.7x faster end to end.
+
+    The iteration still produces garbage when fp noise pushes eigenvalues
+    of the product negative — rank-deficient covariances (fewer samples
+    than feature dims) or spreads beyond f32. Returns ``(trace, ok)``
+    where ``ok`` checks both finiteness and the sqrt residual
     ``||Y@Y*norm - M||_F / ||M||_F``.
     """
+    d = sigma1.shape[0]
     m = jnp.matmul(sigma1, sigma2, precision="float32")
     norm = jnp.linalg.norm(m)
     safe_norm = jnp.maximum(norm, 1e-30)
     y = m / safe_norm
-    z = jnp.eye(m.shape[0], dtype=m.dtype)
-    eye3 = 3.0 * jnp.eye(m.shape[0], dtype=m.dtype)
+    eye = jnp.eye(d, dtype=m.dtype)
+    eye3 = 3.0 * eye
+    z = eye
 
     def body(_, carry):
         y, z = carry
-        t = 0.5 * (eye3 - jnp.matmul(z, y, precision="float32"))
-        return jnp.matmul(y, t, precision="float32"), jnp.matmul(t, z, precision="float32")
+        zy = jnp.matmul(z, y, precision="float32")
+        delta = jnp.linalg.norm(zy - eye)
+        mu2 = jnp.minimum(d / jnp.maximum(jnp.abs(jnp.trace(zy)), 1e-30), 2.0)
+        mu = jnp.sqrt(mu2)
+        t = 0.5 * (eye3 - mu2 * zy)
+        y_next = mu * jnp.matmul(y, t, precision="float32")
+        z_next = mu * jnp.matmul(t, z, precision="float32")
+        frozen = delta < 1e-5 * d
+        return jnp.where(frozen, y, y_next), jnp.where(frozen, z, z_next)
 
     y, _ = jax.lax.fori_loop(0, iters, body, (y, z))
     trace = jnp.where(norm > 0, jnp.trace(y) * jnp.sqrt(norm), 0.0)

@@ -295,7 +295,9 @@ def _make_bootstrap_step(
         return _stack_state(base_init(), n_boot)
 
     def init() -> State:
-        return _fresh_copy({"key": jax.random.PRNGKey(seed), "boot": _stacked_init()})
+        # PRNGKey and broadcast_to both allocate fresh unaliased buffers;
+        # no donation-safety copy needed
+        return {"key": jax.random.PRNGKey(seed), "boot": _stacked_init()}
 
     def _apply(boot: State, sub: Array, args: tuple, kwargs: dict) -> State:
         from metrics_tpu.wrappers.bootstrapping import _apply_resample
@@ -446,7 +448,7 @@ def _make_multioutput_step(
     )
 
     def init() -> State:
-        return _fresh_copy(_stack_state(base_init(), n_out))
+        return _stack_state(base_init(), n_out)  # broadcast_to: fresh unaliased buffers
 
     def _is_array(a: Any) -> bool:
         return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")

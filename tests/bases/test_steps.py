@@ -585,3 +585,39 @@ class TestWrapperSteps:
         )
         with pytest.raises(ValueError, match="sample-buffer"):
             make_step(wrapper)
+
+    def test_wrappers_inside_collection_step(self):
+        """Wrapper members ride the collection step; dict-valued computes
+        splice through the collection's naming like the eager API."""
+        from metrics_tpu import MeanMetric, MetricCollection
+        from metrics_tpu.wrappers import ClasswiseWrapper, MinMaxMetric
+
+        def build():
+            return MetricCollection(
+                {
+                    "cw": ClasswiseWrapper(Accuracy(num_classes=2, average="none")),
+                    "acc": Accuracy(num_classes=2),
+                }
+            )
+
+        init, step, compute = make_step(build())
+        p, t = jnp.asarray([0, 1, 1, 0]), jnp.asarray([0, 1, 0, 0])
+        state, vals = step(init(), p, t)
+        got = compute(state)
+        eager = build()
+        eager.update(p, t)
+        want = eager.compute()
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_allclose(float(got[k]), float(want[k]), atol=1e-6)
+
+        # minmax member: dict-valued compute splices with the member prefix
+        coll = MetricCollection({"mm": MinMaxMetric(MeanMetric())})
+        init2, step2, compute2 = make_step(coll)
+        s2, _ = step2(init2(), jnp.asarray([2.0, 4.0]))
+        out2 = compute2(s2)
+        eager2 = MetricCollection({"mm": MinMaxMetric(MeanMetric())})
+        eager2.update(jnp.asarray([2.0, 4.0]))
+        want2 = eager2.compute()
+        assert set(out2) == set(want2)
+        np.testing.assert_allclose(float(out2[sorted(out2)[0]]), float(want2[sorted(want2)[0]]), atol=1e-6)

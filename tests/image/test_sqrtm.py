@@ -107,3 +107,46 @@ def test_newton_schulz_flags_rank_deficient_divergence():
         assert not bool(ok)
     got = float(_trace_sqrtm_product_eigh(np.asarray(s1, np.float32), np.asarray(s2, np.float32)))
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3 * max(1.0, abs(expected)))
+
+
+def _spectrum_pair(eigvals, seed=0):
+    d = len(eigvals)
+    out = []
+    for s in (seed, seed + 1):
+        q, _ = np.linalg.qr(np.random.default_rng(s).normal(size=(d, d)))
+        out.append((q * eigvals) @ q.T)
+    return out
+
+
+@pytest.mark.parametrize(
+    "eigvals",
+    [
+        pytest.param(100.0 / np.arange(1, 65) ** 2, id="powerlaw-64"),
+        pytest.param(np.logspace(-2, 2, 64), id="logspace-4decades-64"),
+        pytest.param(np.logspace(-1, 1, 128), id="logspace-2decades-128"),
+    ],
+)
+def test_newton_schulz_decaying_spectra_accurate_or_flagged(eigvals):
+    """Decaying / multi-decade spectra — the regime where UNclamped trace
+    scaling diverges (round-4 review finding). The clamped+frozen iteration
+    must converge here, or at minimum flag itself for the eigh fallback."""
+    s1, s2 = _spectrum_pair(eigvals)
+    exact = np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+    trace, ok = _trace_sqrtm_product_ns_checked(
+        np.asarray(s1, np.float32), np.asarray(s2, np.float32)
+    )
+    assert bool(ok), "clamped NS should converge on 2-4 decade spreads"
+    np.testing.assert_allclose(float(trace), exact, rtol=1e-3)
+
+
+def test_newton_schulz_extra_iterations_stay_converged():
+    """The convergence freeze: more iterations can never corrupt a
+    converged iterate (post-convergence noise re-amplification guard)."""
+    s1, s2 = _spectrum_pair(np.logspace(-2, 2, 64), seed=3)
+    exact = np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+    for iters in (14, 25, 40):
+        trace, ok = _trace_sqrtm_product_ns_checked(
+            np.asarray(s1, np.float32), np.asarray(s2, np.float32), iters=iters
+        )
+        assert bool(ok), f"diverged at iters={iters}"
+        np.testing.assert_allclose(float(trace), exact, rtol=1e-3)
