@@ -131,7 +131,7 @@ def test_newton_schulz_decaying_spectra_accurate_or_flagged(eigvals):
     scaling diverges (round-4 review finding). The clamped+frozen iteration
     must converge here, or at minimum flag itself for the eigh fallback."""
     s1, s2 = _spectrum_pair(eigvals)
-    exact = np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+    exact = _scipy_trace(s1, s2)
     trace, ok = _trace_sqrtm_product_ns_checked(
         np.asarray(s1, np.float32), np.asarray(s2, np.float32)
     )
@@ -143,7 +143,7 @@ def test_newton_schulz_extra_iterations_stay_converged():
     """The convergence freeze: more iterations can never corrupt a
     converged iterate (post-convergence noise re-amplification guard)."""
     s1, s2 = _spectrum_pair(np.logspace(-2, 2, 64), seed=3)
-    exact = np.trace(scipy.linalg.sqrtm(s1 @ s2)).real
+    exact = _scipy_trace(s1, s2)
     for iters in (14, 25, 40):
         trace, ok = _trace_sqrtm_product_ns_checked(
             np.asarray(s1, np.float32), np.asarray(s2, np.float32), iters=iters

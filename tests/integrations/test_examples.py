@@ -1,0 +1,27 @@
+"""Smoke-run the runnable example scripts (the reference ships runnable
+``tm_examples/``; ours must stay runnable too). Each runs in its own
+process so it can self-provision the virtual mesh."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.parametrize(
+    "script",
+    ["sharded_eval.py", "bootstrap_confidence.py", "detection_map.py", "train_loop_metrics.py"],
+)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script)],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
