@@ -36,7 +36,6 @@ from metrics_tpu.wrappers import BootStrapper
 
 N_DEV = min(8, jax.device_count())
 N_BATCHES, BATCH, N_CLASSES, N_BOOT = 12, 32 * N_DEV, 5, 50
-PER_DEV = BATCH // N_DEV
 
 mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
 

@@ -279,39 +279,50 @@ class MeanAveragePrecision(Metric):
     # Evaluation (host side)
     # ------------------------------------------------------------------
 
-    def _accumulate_flat(
+    def _accumulate_batch(
         self,
-        scores: np.ndarray,
         matches: np.ndarray,
         ignore: np.ndarray,
-        npig: int,
-    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-        """(recall (T,), precision (T, R)) from flat score-sorted det stats
-        (ref :672). ``scores`` (D,), ``matches``/``ignore`` (T, D)."""
-        if npig == 0:
-            return None
-        n_rec_thrs = len(self.rec_thresholds)
-        tps = matches & ~ignore
-        fps = ~matches & ~ignore
-        tp_sum = np.cumsum(tps, axis=1, dtype=np.float64)
-        fp_sum = np.cumsum(fps, axis=1, dtype=np.float64)
+        npig: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """(recall (G,), precision (G, R)) from stacked score-sorted det rows.
 
-        n_thrs = len(self.iou_thresholds)
-        recall = np.zeros(n_thrs)
-        precision = np.zeros((n_thrs, n_rec_thrs))
+        Vectorized form of the reference's per-(iou-threshold) PR
+        accumulation (ref :672-726): every (area, iou-threshold) pair is one
+        row of ``matches``/``ignore`` (G, D), ``npig`` (G,) its positive-gt
+        count. Rows with ``npig == 0`` are left at -1 (the reference's
+        "skip this cell" sentinel). The per-row recall->precision lookup is
+        a single flat ``searchsorted`` over offset-stacked rows instead of
+        G small ones.
+        """
+        n_groups, n_dets = matches.shape
+        n_rec_thrs = len(self.rec_thresholds)
+        recall = -np.ones(n_groups)
+        precision = -np.ones((n_groups, n_rec_thrs))
+        pos = npig > 0
+        if not pos.any():
+            return recall, precision
+        if n_dets == 0:
+            recall[pos] = 0.0
+            precision[pos] = 0.0
+            return recall, precision
+        tp = np.cumsum(matches & ~ignore, axis=1, dtype=np.float64)
+        fp = np.cumsum(~matches & ~ignore, axis=1, dtype=np.float64)
+        rc = tp / np.where(pos, npig, 1).astype(np.float64)[:, None]
+        pr = tp / (fp + tp + np.finfo(np.float64).eps)
+        # precision envelope: non-increasing from the right (ref :721-726)
+        pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+        # per-row searchsorted on the raw doubles: an offset-stacked single
+        # call would perturb values by ~1 ulp and flip exact threshold
+        # crossings (rc == thr happens routinely: tp/npig vs linspace)
         rec_thresholds = np.asarray(self.rec_thresholds)
-        for idx, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
-            nd = len(tp)
-            rc = tp / npig
-            pr = tp / (fp + tp + np.finfo(np.float64).eps)
-            recall[idx] = rc[-1] if nd else 0
-            # precision envelope: non-increasing from the right (ref :721-726)
-            pr = np.maximum.accumulate(pr[::-1])[::-1]
-            inds_r = np.searchsorted(rc, rec_thresholds, side="left")
-            num_inds = int(inds_r.argmax()) if inds_r.max() >= nd else n_rec_thrs
-            prec_row = np.zeros(n_rec_thrs)
-            prec_row[:num_inds] = pr[inds_r[:num_inds]]
-            precision[idx] = prec_row
+        inds = np.empty((n_groups, n_rec_thrs), dtype=np.int64)
+        for g in range(n_groups):
+            inds[g] = np.searchsorted(rc[g], rec_thresholds, side="left")
+        valid = inds < n_dets  # past-the-end recall thresholds score 0
+        prec = np.where(valid, np.take_along_axis(pr, np.minimum(inds, n_dets - 1), axis=1), 0.0)
+        recall[pos] = rc[pos, -1]
+        precision[pos] = prec[pos]
         return recall, precision
 
     def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
@@ -441,36 +452,67 @@ class MeanAveragePrecision(Metric):
             )  # (A, T, total_det)
 
         d_cls = cell_cls[d_cell_f]  # label of every kept det (flat)
+
+        # class-major, score-descending global det order (stable, so ties
+        # keep the cell-major flat order — the same sequence a fresh
+        # per-class mergesort of -score yields), plus per-(class, area)
+        # positive-gt totals: the full accumulation over every
+        # (class, area, maxdet, iou-threshold) group is ONE native call
+        native_acc = None
+        if native.native_available():
+            cls_arr = np.asarray(class_ids, dtype=np.int64)  # sorted (``_get_classes``)
+            perm = np.lexsort((-d_scores_f, d_cls))
+            cls_counts = np.bincount(
+                np.searchsorted(cls_arr, d_cls), minlength=len(cls_arr)
+            )
+            cls_off = np.zeros(len(cls_arr) + 1, dtype=np.int64)
+            np.cumsum(cls_counts, out=cls_off[1:])
+            npig_ca = np.zeros((len(cls_arr), n_areas), dtype=np.float64)
+            np.add.at(npig_ca, np.searchsorted(cls_arr, cell_cls), gt_ignore_counts.T)
+            native_acc = native.pr_accumulate(
+                det_matches,
+                det_out_flat,
+                perm,
+                cls_off,
+                d_rank_f,
+                npig_ca.astype(np.int64),
+                np.asarray(self.rec_thresholds, dtype=np.float64),
+                np.asarray(self.max_detection_thresholds, dtype=np.int64),
+            )
+        if native_acc is not None:
+            rec_c, prec_c = native_acc  # (C, A, M, T), (C, A, M, T, R)
+            recall = rec_c.transpose(3, 0, 1, 2)  # -> (T, K, A, M)
+            precision = prec_c.transpose(3, 4, 0, 1, 2)  # -> (T, R, K, A, M)
+            return np.ascontiguousarray(precision), np.ascontiguousarray(recall)
+
         for idx_cls, cls in enumerate(class_ids):
             sel = cell_cls == cls
             if not sel.any():
                 continue
-            dm = d_cls == cls
             # ONE sort per class (ref :694 tie order): the md-threshold
             # subsets are rank-filters of the same descending-score order,
             # so restricting the sorted sequence to rank < t reproduces the
             # order a fresh masked sort would give. Flat dets are cell-major
             # rank-major, the same sequence the old padded layout flattened.
-            cls_scores = d_scores_f[dm]
-            order = np.argsort(-cls_scores, kind="mergesort")
-            sorted_scores = cls_scores[order]
-            sorted_rank = d_rank_f[dm][order]
-            m_all = det_matches[:, :, dm][:, :, order]  # (A, T, D)
-            out_all = det_out_flat[:, dm][:, order]  # (A, D)
-            for idx_area in range(n_areas):
-                flat_m = m_all[idx_area]
-                flat_i = ~flat_m & out_all[idx_area][None, :]
-                npig = int(gt_ignore_counts[idx_area][sel].sum())
-                for idx_md, max_det in enumerate(self.max_detection_thresholds):
-                    keep_t = sorted_rank < max_det
-                    acc = self._accumulate_flat(
-                        sorted_scores[keep_t], flat_m[:, keep_t], flat_i[:, keep_t], npig
-                    )
-                    if acc is None:
-                        continue
-                    rec, prec = acc
-                    recall[:, idx_cls, idx_area, idx_md] = rec
-                    precision[:, :, idx_cls, idx_area, idx_md] = prec
+            dm = np.flatnonzero(d_cls == cls)
+            order = dm[np.argsort(-d_scores_f[dm], kind="mergesort")]
+            sorted_rank = d_rank_f[order]
+            m_all = det_matches[:, :, order]  # (A, T, D)
+            ig_all = ~m_all & det_out_flat[:, order][:, None, :]  # (A, T, D)
+            npig_area = np.array(
+                [gt_ignore_counts[idx_area][sel].sum() for idx_area in range(n_areas)]
+            )
+            for idx_md, max_det in enumerate(self.max_detection_thresholds):
+                keep_t = sorted_rank < max_det
+                rec_g, prec_g = self._accumulate_batch(
+                    m_all[:, :, keep_t].reshape(n_areas * n_thrs, -1),
+                    ig_all[:, :, keep_t].reshape(n_areas * n_thrs, -1),
+                    np.repeat(npig_area, n_thrs),
+                )
+                recall[:, idx_cls, :, idx_md] = rec_g.reshape(n_areas, n_thrs).T
+                precision[:, :, idx_cls, :, idx_md] = prec_g.reshape(
+                    n_areas, n_thrs, n_rec
+                ).transpose(1, 2, 0)
         return precision, recall
 
     # ------------------------------------------------------------------

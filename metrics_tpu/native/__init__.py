@@ -121,7 +121,7 @@ def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("METRICS_TPU_NO_NATIVE"):
         return None
     try:
-        so = _compile([_HERE / "levenshtein.c", _HERE / "coco_match.c"])
+        so = _compile([_HERE / "levenshtein.c", _HERE / "coco_match.c", _HERE / "pr_accumulate.c"])
     except Exception:
         # e.g. Path.home() RuntimeError under an arbitrary UID with no HOME:
         # native is an optimization — never let its setup crash a metric
@@ -144,6 +144,13 @@ def _load() -> Optional[ctypes.CDLL]:
             u8p, u8p,
         ]
         lib.mtpu_coco_match.restype = None
+        lib.mtpu_pr_accumulate.argtypes = [
+            u8p, u8p, i64p, i64p, i64p, i64p, f64p, i64p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            f64p, f64p, f64p,
+        ]
+        lib.mtpu_pr_accumulate.restype = None
     except (OSError, AttributeError):
         # unreadable or stale library (missing symbol): fall back to numpy
         return None
@@ -188,6 +195,53 @@ def edit_distance_batch(seqs_a: List[np.ndarray], seqs_b: List[np.ndarray]) -> O
     if (out < 0).any():  # allocation failure inside the kernel
         return None
     return out
+
+
+def pr_accumulate(
+    matches: np.ndarray,
+    out_area: np.ndarray,
+    perm: np.ndarray,
+    cls_off: np.ndarray,
+    rank: np.ndarray,
+    npig: np.ndarray,
+    rec_thresholds: np.ndarray,
+    max_dets: np.ndarray,
+):
+    """Native COCO PR accumulation over all (class, area, maxdet, iou) groups.
+
+    ``matches`` (A, T, Dtot) / ``out_area`` (A, Dtot) bool-or-uint8 det
+    flags, ``perm``/``cls_off`` the class-major score-descending det CSR,
+    ``rank`` per-det within-cell rank, ``npig`` (C, A) positive-gt counts.
+    Returns ``(recall (C, A, M, T), precision (C, A, M, T, R))`` float64
+    with -1 where ``npig == 0``, or None when no native library.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "mtpu_pr_accumulate"):
+        return None
+    A, T, Dtot = matches.shape
+    C = len(cls_off) - 1
+    R = len(rec_thresholds)
+    M = len(max_dets)
+    recall = -np.ones((C, A, M, T), dtype=np.float64)
+    precision = -np.ones((C, A, M, T, R), dtype=np.float64)
+    cls_off = np.ascontiguousarray(cls_off, dtype=np.int64)
+    max_class_d = int(np.diff(cls_off).max()) if C else 0
+    scratch = np.empty(max(2, 2 * max_class_d), dtype=np.float64)
+    lib.mtpu_pr_accumulate(
+        np.ascontiguousarray(matches).view(np.uint8),
+        np.ascontiguousarray(out_area).view(np.uint8),
+        np.ascontiguousarray(perm, dtype=np.int64),
+        cls_off,
+        np.ascontiguousarray(rank, dtype=np.int64),
+        np.ascontiguousarray(npig, dtype=np.int64),
+        np.ascontiguousarray(rec_thresholds, dtype=np.float64),
+        np.ascontiguousarray(max_dets, dtype=np.int64),
+        C, A, T, R, M, Dtot,
+        recall,
+        precision,
+        scratch,
+    )
+    return recall, precision
 
 
 def coco_match(

@@ -1,0 +1,86 @@
+/* COCO precision/recall accumulation over every (class, area, max-det,
+ * IoU-threshold) group in one pass.
+ *
+ * Equivalent of the accumulation step of the COCO evaluation protocol
+ * (reference torchmetrics/detection/mean_ap.py:672-726): detections are
+ * walked in descending score order, TP/FP running counts become a
+ * recall/precision curve, precision takes its non-increasing right-to-left
+ * envelope, and the curve is sampled at R recall thresholds.
+ *
+ * The det walk order is supplied as `perm` — class-major, score-descending
+ * global det indices (cls_off CSR) — so the kernel gathers straight from
+ * the (A, T, Dtot) match table; no per-class copies are materialized.
+ * Rows with npig == 0 are skipped entirely, leaving the caller's -1
+ * sentinel in place. The recall-threshold sampling is a two-pointer merge
+ * (both sequences are non-decreasing): O(D + R) per group instead of R
+ * binary searches.
+ */
+#include <float.h>
+#include <stdint.h>
+
+void mtpu_pr_accumulate(
+    const uint8_t *matches,   /* (A, T, Dtot) greedy-match flags */
+    const uint8_t *out_area,  /* (A, Dtot) det outside area range */
+    const int64_t *perm,      /* (Dtot,) class-major score-desc det index */
+    const int64_t *cls_off,   /* (C+1,) class CSR over perm */
+    const int64_t *rank,      /* (Dtot,) within-cell score rank of each det */
+    const int64_t *npig,      /* (C, A) non-ignored positive gts */
+    const double *rec_thr,    /* (R,) ascending recall thresholds */
+    const int64_t *max_dets,  /* (M,) per-image det caps */
+    int64_t C,
+    int64_t A,
+    int64_t T,
+    int64_t R,
+    int64_t M,
+    int64_t Dtot,
+    double *recall,           /* out: (C, A, M, T), caller-filled with -1 */
+    double *precision,        /* out: (C, A, M, T, R), caller-filled with -1 */
+    double *scratch)          /* (2 * max class det count) doubles */
+{
+    for (int64_t c = 0; c < C; ++c) {
+        const int64_t j0 = cls_off[c], j1 = cls_off[c + 1];
+        double *rc = scratch;
+        double *pr = scratch + (j1 - j0);
+        for (int64_t a = 0; a < A; ++a) {
+            const int64_t np_ca = npig[c * A + a];
+            if (np_ca <= 0)
+                continue; /* keep the -1 sentinel (no positives to recall) */
+            const uint8_t *oa = out_area + a * Dtot;
+            for (int64_t m = 0; m < M; ++m) {
+                const int64_t cap = max_dets[m];
+                for (int64_t t = 0; t < T; ++t) {
+                    const uint8_t *mt = matches + (a * T + t) * Dtot;
+                    double tp = 0.0, fp = 0.0;
+                    int64_t n = 0;
+                    for (int64_t j = j0; j < j1; ++j) {
+                        const int64_t d = perm[j];
+                        if (rank[d] >= cap)
+                            continue;
+                        const int md = mt[d] != 0;
+                        const int ig = !md && oa[d]; /* unmatched out-of-area det */
+                        tp += (double)(md & !ig);
+                        fp += (double)(!md & !ig);
+                        rc[n] = tp / (double)np_ca;
+                        pr[n] = tp / (fp + tp + DBL_EPSILON);
+                        ++n;
+                    }
+                    double *prec_row =
+                        precision + (((c * A + a) * M + m) * T + t) * R;
+                    recall[((c * A + a) * M + m) * T + t] = n ? rc[n - 1] : 0.0;
+                    double run = 0.0;
+                    for (int64_t i = n - 1; i >= 0; --i) {
+                        if (pr[i] > run)
+                            run = pr[i];
+                        pr[i] = run;
+                    }
+                    int64_t i = 0;
+                    for (int64_t r = 0; r < R; ++r) {
+                        while (i < n && rc[i] < rec_thr[r])
+                            ++i;
+                        prec_row[r] = i < n ? pr[i] : 0.0;
+                    }
+                }
+            }
+        }
+    }
+}

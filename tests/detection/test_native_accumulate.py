@@ -1,0 +1,106 @@
+"""Native C PR-accumulation vs the numpy fallback.
+
+``mtpu_pr_accumulate`` (``metrics_tpu/native/pr_accumulate.c``) and
+``MeanAveragePrecision._accumulate_batch`` implement the same COCO
+accumulation step (reference ``torchmetrics/detection/mean_ap.py:672-726``);
+CI machines always have a compiler, so without this test the numpy fallback
+would never execute and the two implementations could drift apart silently
+(the same both-paths discipline as ``tests/text/test_native.py``).
+
+Exactness matters here: recall values ``tp / npig`` routinely land exactly
+ON a ``linspace`` recall threshold, so both paths must compare the raw
+doubles (no offset-stacking tricks) to pick the same envelope index.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu.native as native
+from metrics_tpu import MeanAveragePrecision
+
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="no C compiler: native path unavailable"
+)
+
+
+def _random_case(rng, n_img=40, n_cls=4):
+    preds, tgts = [], []
+    for _ in range(n_img):
+        nd, ng = rng.integers(1, 10), rng.integers(1, 10)
+        xy = rng.uniform(0, 120, (nd, 2)).astype(np.float32)
+        gxy = rng.uniform(0, 120, (ng, 2)).astype(np.float32)
+        preds.append(
+            dict(
+                boxes=np.concatenate([xy, xy + rng.uniform(4, 60, (nd, 2)).astype(np.float32)], 1),
+                # quantized scores force plenty of exact ties
+                scores=(rng.integers(0, 20, nd) / 20.0).astype(np.float32),
+                labels=rng.integers(0, n_cls, nd).astype(np.int32),
+            )
+        )
+        tgts.append(
+            dict(
+                boxes=np.concatenate([gxy, gxy + rng.uniform(4, 60, (ng, 2)).astype(np.float32)], 1),
+                labels=rng.integers(0, n_cls, ng).astype(np.int32),
+            )
+        )
+    return preds, tgts
+
+
+def _full_result(metric):
+    return {k: np.asarray(v) for k, v in metric.compute().items()}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_native_matches_numpy_fallback(monkeypatch, seed):
+    rng = np.random.default_rng(seed)
+    preds, tgts = _random_case(rng)
+
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds, tgts)
+    res_native = _full_result(m)
+
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    m._computed = None
+    res_numpy = _full_result(m)
+
+    assert res_native.keys() == res_numpy.keys()
+    for key in res_native:
+        np.testing.assert_array_equal(
+            res_native[key], res_numpy[key], err_msg=f"native/numpy drift on {key}"
+        )
+
+
+def test_exact_threshold_crossing(monkeypatch):
+    """tp/npig hitting a recall threshold exactly must sample the same index.
+
+    npig=10 with tp reaching 7 gives recall 0.7 while
+    ``linspace(0, 1, 101)[70]`` is 0.7000000000000001 — a 1-ulp gap that an
+    offset-stacked searchsorted collapses. One image, one class, 10 gts, 10
+    perfectly-placed dets exercises every such crossing (tp/10 vs k/100).
+    """
+    rng = np.random.default_rng(99)
+    boxes = np.concatenate(
+        [rng.uniform(0, 400, (10, 2)).astype(np.float32), np.full((10, 2), 30.0, np.float32)],
+        axis=1,
+    )
+    boxes[:, 2:] += boxes[:, :2]
+    preds = [
+        dict(
+            boxes=boxes,
+            scores=np.linspace(0.95, 0.05, 10).astype(np.float32),
+            labels=np.zeros(10, np.int32),
+        )
+    ]
+    tgts = [dict(boxes=boxes, labels=np.zeros(10, np.int32))]
+
+    m = MeanAveragePrecision()
+    m.update(preds, tgts)
+    res_native = _full_result(m)
+
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    m._computed = None
+    res_numpy = _full_result(m)
+
+    for key in res_native:
+        np.testing.assert_array_equal(res_native[key], res_numpy[key], err_msg=key)
+    assert res_native["map"] == pytest.approx(1.0, abs=1e-6)
