@@ -345,8 +345,14 @@ class MeanAveragePrecision(Metric):
         n_rec = len(self.rec_thresholds)
         n_areas = len(self.bbox_area_ranges)
         n_mdets = len(self.max_detection_thresholds)
-        precision = -np.ones((n_thrs, n_rec, len(class_ids), n_areas, n_mdets))
-        recall = -np.ones((n_thrs, len(class_ids), n_areas, n_mdets))
+
+        def _empty():
+            # -1 sentinels; only the numpy fallback and the no-cells early
+            # exit materialize these (the native path returns its own arrays)
+            return (
+                -np.ones((n_thrs, n_rec, len(class_ids), n_areas, n_mdets)),
+                -np.ones((n_thrs, len(class_ids), n_areas, n_mdets)),
+            )
 
         # labels may be arbitrary ints (incl. negative), so encode via their
         # DENSE index in the sorted unique-label set — keys stay collision-
@@ -362,6 +368,7 @@ class MeanAveragePrecision(Metric):
         cells_enc = np.unique(np.concatenate([enc_d, enc_g]))
         n_cells = len(cells_enc)
         if n_cells == 0:
+            precision, recall = _empty()
             return precision, recall
         cell_cls = uniq_labels[(cells_enc % enc_base).astype(np.int64)]
 
@@ -485,6 +492,7 @@ class MeanAveragePrecision(Metric):
             precision = prec_c.transpose(3, 4, 0, 1, 2)  # -> (T, R, K, A, M)
             return np.ascontiguousarray(precision), np.ascontiguousarray(recall)
 
+        precision, recall = _empty()
         for idx_cls, cls in enumerate(class_ids):
             sel = cell_cls == cls
             if not sel.any():

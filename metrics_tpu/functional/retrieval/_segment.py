@@ -192,11 +192,22 @@ def ndcg_scores(ctx: GroupContext, k: Optional[int]) -> Array:
     mask = _topk_mask(ctx, k)
     dcg = ctx.group_sum(t * discount * mask.astype(t.dtype))
 
-    # ideal ordering: targets descending within each group; a second stable
-    # two-key sort carries the values (group layout and boundaries unchanged)
-    _, t_ideal = jax.lax.sort((ctx.gid, -t), num_keys=2)
-    t_ideal = -t_ideal
-    ideal = ctx.group_sum(t_ideal * discount * mask.astype(t.dtype))
+    def _sorted_ideal(_):
+        # general graded targets: ideal ordering is targets descending
+        # within each group; a second stable two-key sort carries the
+        # values (group layout and boundaries unchanged)
+        _, t_ideal = jax.lax.sort((ctx.gid, -t), num_keys=2)
+        return ctx.group_sum(-t_ideal * discount * mask.astype(t.dtype))
+
+    def _binary_ideal(_):
+        # binary targets (the common IR case): the ideal ranking is the
+        # group's npos ones first, so ideal DCG is a plain segment-sum of
+        # discounts over ranks < npos — no second full-length sort
+        within = (ctx.rank < ctx.npos.astype(ctx.rank.dtype)) & mask
+        return ctx.group_sum(jnp.where(within, discount, 0.0))
+
+    is_binary = jnp.all((ctx.target == 0) | (ctx.target == 1))
+    ideal = jax.lax.cond(is_binary, _binary_ideal, _sorted_ideal, None)
     # reference ndcg.py:70-72 zeroes only the ideal == 0 case; a negative
     # ideal (negative relevances are legal non-binary targets) still divides.
     return jnp.where(ideal != 0, dcg / jnp.where(ideal != 0, ideal, 1.0), 0.0)

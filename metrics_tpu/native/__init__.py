@@ -218,6 +218,10 @@ def pr_accumulate(
     lib = _load()
     if lib is None or not hasattr(lib, "mtpu_pr_accumulate"):
         return None
+    if np.any(np.diff(rec_thresholds) < 0):
+        # the C kernel's two-pointer sampling needs ascending thresholds;
+        # callers with a custom unsorted list take the numpy fallback
+        return None
     A, T, Dtot = matches.shape
     C = len(cls_off) - 1
     R = len(rec_thresholds)
