@@ -466,7 +466,8 @@ class MeanAveragePrecision(Metric):
         # positive-gt totals: the full accumulation over every
         # (class, area, maxdet, iou-threshold) group is ONE native call
         native_acc = None
-        if native.native_available():
+        rec_sorted = not np.any(np.diff(np.asarray(self.rec_thresholds)) < 0)
+        if rec_sorted and native.native_available():
             cls_arr = np.asarray(class_ids, dtype=np.int64)  # sorted (``_get_classes``)
             perm = np.lexsort((-d_scores_f, d_cls))
             cls_counts = np.bincount(

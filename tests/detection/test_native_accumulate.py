@@ -70,6 +70,54 @@ def test_native_matches_numpy_fallback(monkeypatch, seed):
         )
 
 
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(iou_thresholds=[0.3, 0.55, 0.8]),
+        dict(rec_thresholds=[0.0, 0.25, 0.5, 0.75, 1.0]),
+        dict(max_detection_thresholds=[2, 5]),
+        dict(
+            iou_thresholds=[0.5, 0.75],
+            rec_thresholds=list(np.linspace(0, 1, 11)),
+            max_detection_thresholds=[1, 3, 8],
+        ),
+    ],
+)
+def test_custom_config_parity(monkeypatch, kwargs):
+    """Non-default threshold grids must agree between native and numpy."""
+    rng = np.random.default_rng(12)
+    preds, tgts = _random_case(rng, n_img=25)
+
+    m = MeanAveragePrecision(**kwargs)
+    m.update(preds, tgts)
+    res_native = _full_result(m)
+
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    m._computed = None
+    res_numpy = _full_result(m)
+
+    for key in res_native:
+        np.testing.assert_array_equal(res_native[key], res_numpy[key], err_msg=key)
+
+
+def test_unsorted_rec_thresholds_fall_back(monkeypatch):
+    """A descending rec_thresholds list must bypass the C two-pointer kernel
+    (which assumes ascending order) and still agree with the numpy path."""
+    rng = np.random.default_rng(21)
+    preds, tgts = _random_case(rng, n_img=20)
+
+    m = MeanAveragePrecision(rec_thresholds=[1.0, 0.5, 0.1])
+    m.update(preds, tgts)
+    res_gated = _full_result(m)  # native gate returns None -> numpy path
+
+    monkeypatch.setattr(native, "native_available", lambda: False)
+    m._computed = None
+    res_numpy = _full_result(m)
+
+    for key in res_gated:
+        np.testing.assert_array_equal(res_gated[key], res_numpy[key], err_msg=key)
+
+
 def test_exact_threshold_crossing(monkeypatch):
     """tp/npig hitting a recall threshold exactly must sample the same index.
 
