@@ -37,7 +37,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, apply_to_collection, dim_zero_cat
+from metrics_tpu.utilities.data import (
+    _flatten,
+    _squeeze_if_scalar,
+    apply_to_collection,
+    coerce_foreign_tensors,
+    dim_zero_cat,
+)
 from metrics_tpu.utilities.distributed import distributed_available, gather_all_tensors
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
@@ -195,6 +201,11 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate the batch AND return the batch-local metric value."""
+        # convert any torch inputs ONCE here: the full-state path calls
+        # update() twice on the same batch, and the per-update coercion
+        # would pay the host transfer twice
+        args = coerce_foreign_tensors(args)
+        kwargs = coerce_foreign_tensors(kwargs)
         if self.full_state_update:
             return self._forward_full_state_update(*args, **kwargs)
         return self._forward_reduce_state_update(*args, **kwargs)
@@ -637,6 +648,8 @@ def _wrap_update(update: Callable) -> Callable:
             )
         self._computed = None
         self._update_count += 1
+        args = coerce_foreign_tensors(args)
+        kwargs = coerce_foreign_tensors(kwargs)
         with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
             update(self, *args, **kwargs)
         if self._dtype_forced:

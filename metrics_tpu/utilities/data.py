@@ -7,6 +7,7 @@ Equivalent surface to the reference's ``torchmetrics/utilities/data.py``
 jittable; ``apply_to_collection`` / ``get_group_indexes`` are host-side
 structural helpers.
 """
+import sys
 from collections import namedtuple
 from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
 
@@ -113,6 +114,34 @@ def apply_to_collection(
     if isinstance(data, (list, tuple)):
         return type(data)(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data)
     return data
+
+
+def coerce_foreign_tensors(data: Any) -> Any:
+    """Convert torch tensors nested anywhere in ``data`` to jax arrays.
+
+    Migration affordance for users of the reference (whose pipelines hand
+    metrics ``torch.Tensor`` batches — reference ``metric.py:229`` consumes
+    them natively): ``update``/``forward`` accept them transparently.
+    Conversion goes through numpy on host (zero-copy for CPU tensors except
+    bfloat16, which numpy cannot represent — that round-trips via float32
+    and re-casts to ``jnp.bfloat16``). No-op when torch was never imported
+    by the process; jax/numpy inputs pass through untouched.
+    """
+    if "torch" not in sys.modules:  # cheap gate: no torch, no torch tensors
+        return data
+    torch = sys.modules["torch"]
+
+    def _convert(t: Any) -> Array:
+        # resolve lazy conj/neg views: .numpy() refuses tensors with those
+        # bits set and detach() does not clear them
+        t = t.detach().resolve_conj().resolve_neg()
+        if t.device.type != "cpu":
+            t = t.cpu()
+        if t.dtype == torch.bfloat16:
+            return jnp.asarray(t.to(torch.float32).numpy()).astype(jnp.bfloat16)
+        return jnp.asarray(t.numpy())
+
+    return apply_to_collection(data, torch.Tensor, _convert)
 
 
 def get_group_indexes(indexes: Array) -> List[Array]:

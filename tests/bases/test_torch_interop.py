@@ -1,0 +1,99 @@
+"""torch.Tensor inputs to update/forward (migration affordance).
+
+Users of the reference hand their metrics ``torch.Tensor`` batches
+(reference ``metric.py:229`` consumes them natively); here the lifecycle
+wrapper converts them to jax arrays before ``update`` runs
+(``metrics_tpu/utilities/data.py::coerce_foreign_tensors``), so existing
+torch data pipelines drive these metrics unchanged.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.utilities.data import coerce_foreign_tensors
+
+
+def test_classification_update_and_forward():
+    preds = np.array([0.1, 0.8, 0.6, 0.3], np.float32)
+    target = np.array([0, 1, 1, 1], np.int64)
+
+    m_t = Accuracy()
+    fwd = m_t(torch.from_numpy(preds), torch.from_numpy(target))
+    m_np = Accuracy()
+    m_np.update(preds, target)
+
+    assert float(m_t.compute()) == pytest.approx(float(m_np.compute()))
+    assert float(fwd) == pytest.approx(float(m_np.compute()))
+
+
+def test_regression_streaming():
+    rng = np.random.default_rng(0)
+    m_t, m_np = MeanSquaredError(), MeanSquaredError()
+    for _ in range(3):
+        p = rng.normal(size=16).astype(np.float32)
+        t = rng.normal(size=16).astype(np.float32)
+        m_t.update(torch.from_numpy(p), torch.from_numpy(t))
+        m_np.update(p, t)
+    assert float(m_t.compute()) == pytest.approx(float(m_np.compute()), rel=1e-6)
+
+
+def test_retrieval_kwarg_tensor():
+    p = np.array([0.2, 0.9, 0.4, 0.7], np.float32)
+    t = np.array([0, 1, 1, 0], np.int64)
+    idx = np.array([0, 0, 1, 1], np.int64)
+    m_t, m_np = RetrievalMAP(), RetrievalMAP()
+    m_t.update(torch.from_numpy(p), torch.from_numpy(t), indexes=torch.from_numpy(idx))
+    m_np.update(p, t, indexes=idx)
+    assert float(m_t.compute()) == pytest.approx(float(m_np.compute()))
+
+
+def test_detection_nested_dicts():
+    boxes = np.array([[10.0, 10.0, 60.0, 60.0]], np.float32)
+    det = [dict(boxes=torch.from_numpy(boxes), scores=torch.tensor([0.9]), labels=torch.tensor([1]))]
+    gt = [dict(boxes=torch.from_numpy(boxes), labels=torch.tensor([1]))]
+    m = MeanAveragePrecision()
+    m.update(det, gt)
+    assert float(m.compute()["map"]) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_collection_update():
+    preds = torch.tensor([0.1, 0.8, 0.6], dtype=torch.float32)
+    target = torch.tensor([0, 1, 1])
+    col = MetricCollection([Accuracy()])
+    col.update(preds, target)
+    assert float(col.compute()["Accuracy"]) == pytest.approx(1.0)
+
+
+def test_bfloat16_roundtrip():
+    t = torch.arange(6, dtype=torch.bfloat16)
+    out = coerce_foreign_tensors(t)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out.astype(jnp.float32)), np.arange(6, dtype=np.float32))
+
+
+def test_requires_grad_tensor_detached():
+    p = torch.tensor([0.2, 0.8], requires_grad=True)
+    out = coerce_foreign_tensors(p)
+    np.testing.assert_allclose(np.asarray(out), [0.2, 0.8], rtol=1e-6)
+
+
+def test_no_torch_gate_passthrough(monkeypatch):
+    sentinel = object()
+    monkeypatch.delitem(sys.modules, "torch")
+    assert coerce_foreign_tensors(sentinel) is sentinel
+
+
+def test_non_tensor_leaves_untouched():
+    data = {"a": [1, "text", None], "b": np.ones(3), "c": jnp.zeros(2)}
+    out = coerce_foreign_tensors(data)
+    assert out["a"] == [1, "text", None]
+    assert out["b"] is data["b"]
+    assert out["c"] is data["c"]
