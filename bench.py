@@ -443,6 +443,16 @@ def main() -> None:
         coll["collection_statscores_multiclass_1M_update"],
         base_collection("multiclass"),
     )
+    # the reference's ONE quantitative perf claim: compute groups give
+    # "2x-3x lower computational cost" (docs overview; SURVEY.md §6). A/B
+    # on the same collection, so the baseline is our own groups-off path.
+    savings = bench_collection.measure_compute_group_savings()
+    emit(
+        "collection_prf1_200k_update_groups_on",
+        savings["collection_prf1_200k_update_groups_on"],
+        savings["collection_prf1_200k_update_groups_off"],
+        baseline="same_collection_compute_groups_off",
+    )
 
     retr = bench_retrieval.measure()
     emit("retrieval_map_1M_docs_compute", retr["retrieval_map_1M_docs_compute"], base_retrieval("map"))

@@ -40,9 +40,51 @@ def measure() -> dict:
     return out
 
 
+def measure_compute_group_savings(n: int = 200_000, n_classes: int = 10, reps: int = 20) -> dict:
+    """Eager class-API update cost: compute groups ON vs OFF.
+
+    The reference's one quantitative perf claim is that compute groups give
+    "2x-3x lower computational cost" on collections sharing state
+    (docs overview, SURVEY.md §6). P/R/F1 all reduce to one stat-scores
+    pass, so the grouped collection runs ONE update for all three.
+    """
+    import time
+
+    from metrics_tpu import F1Score, MetricCollection, Precision, Recall
+
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (n, n_classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, n_classes)
+    out = {}
+    for label, grouped in (("on", True), ("off", False)):
+        col = MetricCollection(
+            {
+                "precision": Precision(num_classes=n_classes, average="macro"),
+                "recall": Recall(num_classes=n_classes, average="macro"),
+                "f1": F1Score(num_classes=n_classes, average="macro"),
+            },
+            compute_groups=grouped,
+        )
+        col.update(preds, target)  # warm compile
+        jax.block_until_ready(col["precision"].tp)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            col.update(preds, target)
+            jax.block_until_ready(col["precision"].tp)
+            times.append(time.perf_counter() - t0)
+        out[f"collection_prf1_200k_update_groups_{label}"] = min(times) * 1000
+    return out
+
+
 def main() -> None:
     for name, ms in measure().items():
         print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
+    savings = measure_compute_group_savings()
+    for name, ms in savings.items():
+        print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
+    on = savings["collection_prf1_200k_update_groups_on"]
+    off = savings["collection_prf1_200k_update_groups_off"]
+    print(json.dumps({"metric": "collection_compute_group_savings", "value": round(off / on, 2), "unit": "x"}))
 
 
 if __name__ == "__main__":

@@ -19,7 +19,7 @@ import jax
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.data import _flatten_dict, allclose
+from metrics_tpu.utilities.data import _flatten_dict, allclose, coerce_foreign_tensors
 
 Array = jax.Array
 
@@ -172,6 +172,10 @@ class MetricCollection(dict):
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-metric forward; batch values under collection keys."""
+        # convert torch inputs ONCE for the whole collection — every member
+        # metric would otherwise pay the host transfer independently
+        args = coerce_foreign_tensors(args)
+        kwargs = coerce_foreign_tensors(kwargs)
         res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
@@ -181,6 +185,8 @@ class MetricCollection(dict):
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update each underlying metric once per compute group."""
+        args = coerce_foreign_tensors(args)
+        kwargs = coerce_foreign_tensors(kwargs)
         if self._groups_checked:
             for group in self._groups.values():
                 m0 = self[group[0]]

@@ -127,9 +127,10 @@ def coerce_foreign_tensors(data: Any) -> Any:
     and re-casts to ``jnp.bfloat16``). No-op when torch was never imported
     by the process; jax/numpy inputs pass through untouched.
     """
-    if "torch" not in sys.modules:  # cheap gate: no torch, no torch tensors
+    torch = sys.modules.get("torch")  # cheap gate: no torch, no torch tensors
+    if torch is None or not hasattr(torch, "Tensor"):
+        # None is the standard sys.modules placeholder for "import blocked"
         return data
-    torch = sys.modules["torch"]
 
     def _convert(t: Any) -> Array:
         # resolve lazy conj/neg views: .numpy() refuses tensors with those

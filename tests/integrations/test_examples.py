@@ -12,7 +12,13 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 
 @pytest.mark.parametrize(
     "script",
-    ["sharded_eval.py", "bootstrap_confidence.py", "detection_map.py", "train_loop_metrics.py"],
+    [
+        "sharded_eval.py",
+        "bootstrap_confidence.py",
+        "detection_map.py",
+        "train_loop_metrics.py",
+        "torch_pipeline_eval.py",
+    ],
 )
 def test_example_runs(script):
     env = dict(os.environ)
