@@ -240,6 +240,43 @@ def base_fid() -> float:
     return _min_ms(run, n_trials=1)
 
 
+def base_ssim() -> float:
+    # eager torch replica of the reference's SSIM data path
+    # (functional/image/ssim.py): gaussian 11x11 window via depthwise
+    # F.conv2d over the 5 SSIM maps, k1/k2 stabilized
+    import torch
+    import torch.nn.functional as F
+
+    torch.manual_seed(0)
+    batch, c, side = 64, 3, 256
+    preds = torch.rand(batch, c, side, side)
+    target = (preds + 0.05 * torch.randn_like(preds)).clamp(0, 1)
+    coords = torch.arange(11, dtype=torch.float32) - 5
+    g = torch.exp(-(coords**2) / (2 * 1.5**2))
+    g = (g / g.sum()).outer(g / g.sum())
+    kernel = g.expand(c, 1, 11, 11).contiguous()
+    c1, c2 = (0.01 * 1.0) ** 2, (0.03 * 1.0) ** 2
+
+    def run():
+        # reflection-pad, valid conv, crop the pad border — the same
+        # region accounting as the shipped kernel (functional/image/ssim.py)
+        pp = F.pad(preds, (5, 5, 5, 5), mode="reflect")
+        tt = F.pad(target, (5, 5, 5, 5), mode="reflect")
+
+        def blur(x):
+            return F.conv2d(x, kernel, groups=c)
+
+        mu_x, mu_y = blur(pp), blur(tt)
+        sx = blur(pp * pp) - mu_x * mu_x
+        sy = blur(tt * tt) - mu_y * mu_y
+        sxy = blur(pp * tt) - mu_x * mu_y
+        num = (2 * mu_x * mu_y + c1) * (2 * sxy + c2)
+        den = (mu_x * mu_x + mu_y * mu_y + c1) * (sx + sy + c2)
+        return float((num / den)[..., 5:-5, 5:-5].mean())
+
+    return _min_ms(run, n_trials=2)
+
+
 def base_map(n_images: int) -> float:
     # reference detection/mean_ap.py: per-(image, class) Python evaluation
     # with per-threshold greedy matching loops (the tests' independent
@@ -460,6 +497,8 @@ def main() -> None:
 
     fid = bench_image.measure()
     emit("fid_10k_2048d_compute", fid["fid_10k_2048d_compute"], base_fid())
+    ssim = bench_image.measure_ssim()
+    emit("ssim_64x3x256x256_compute", ssim["ssim_64x3x256x256_compute"], base_ssim())
 
     ti = bench_text_image.measure()
     emit("lpips_alex_32x64x64_forward", ti["lpips_alex_32x64x64_forward"], base_lpips())

@@ -36,8 +36,31 @@ def measure() -> dict:
     return {"fid_10k_2048d_compute": measure_ms_scaled(make_run, K)}
 
 
+def measure_ssim(batch: int = 64, side: int = 256, k: int = 10) -> dict:
+    """Batched SSIM forward (gaussian 11x11 window): the conv-heavy image
+    kernel, mapped onto the MXU via XLA's grouped depthwise convolutions
+    (the reference runs the same windows through eager torch F.conv2d,
+    ``functional/image/ssim.py``)."""
+    from metrics_tpu.functional import structural_similarity_index_measure
+
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (batch, 3, side, side), dtype=jnp.float32)
+    target = jnp.clip(preds + 0.05 * jax.random.normal(jax.random.PRNGKey(1), preds.shape), 0, 1)
+
+    def make_run(kk):
+        @jax.jit
+        def run(preds=preds, target=target):
+            def body(i, acc):
+                return acc + structural_similarity_index_measure(
+                    jnp.clip(preds * (1.0 + 0.0001 * i), 0, 1), target
+                )
+            return jax.lax.fori_loop(0, kk, body, jnp.zeros(()))
+        return run
+
+    return {f"ssim_{batch}x3x{side}x{side}_compute": measure_ms_scaled(make_run, k)}
+
+
 def main() -> None:
-    for name, ms in measure().items():
+    for name, ms in {**measure(), **measure_ssim()}.items():
         print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
 
 
