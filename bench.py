@@ -28,7 +28,15 @@ measured speedup over that equivalent. Configs:
 - LPIPS AlexNet forward, 32 image pairs at 64x64 (reference: the lpips
   package's eager tower + heads)
 - BERTScore greedy cosine matching, 256 x 128 tokens x 256-d (reference
-  ``functional/text/bert.py:327-360`` eager bmm/max path).
+  ``functional/text/bert.py:327-360`` eager bmm/max path)
+- corpus WER, 10k sentence pairs (reference per-pair pure-python DP loop,
+  ``functional/text/wer.py:23-48``)
+- batched SSIM, 64 x 3 x 256x256 gaussian 11x11 window (reference eager
+  depthwise-conv path, ``functional/image/ssim.py``)
+- MetricCollection compute-groups on-vs-off A/B on the same P/R/F1
+  collection (the reference's documented 2-3x claim)
+- 1M-sample CapacityBuffer mesh sync on 8 virtual devices (A/B vs the
+  replicated psum-of-scatter gather).
 """
 import json
 import time
@@ -236,6 +244,36 @@ def base_fid() -> float:
         covmean = res[0] if isinstance(res, tuple) else res
         diff = mu1 - mu2
         return float(diff.dot(diff) + torch.trace(c1) + torch.trace(c2)) - 2 * float(np.trace(covmean.real))
+
+    return _min_ms(run, n_trials=1)
+
+
+def base_wer() -> float:
+    # the reference's WER data path: a per-pair pure-python list-of-lists
+    # DP loop (reference functional/text/wer.py:23-48, helper._edit_distance)
+    from benchmarks.bench_text_image import wer_corpus
+
+    preds, targets = wer_corpus()
+
+    def edit(a, b):
+        dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+        for i in range(len(a) + 1):
+            dp[i][0] = i
+        for j in range(len(b) + 1):
+            dp[0][j] = j
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                cost = 0 if a[i - 1] == b[j - 1] else 1
+                dp[i][j] = min(dp[i - 1][j] + 1, dp[i][j - 1] + 1, dp[i - 1][j - 1] + cost)
+        return dp[-1][-1]
+
+    def run():
+        errors = total = 0
+        for p, t in zip(preds, targets):
+            pt, tt = p.split(), t.split()
+            errors += edit(pt, tt)
+            total += len(tt)
+        return errors / total
 
     return _min_ms(run, n_trials=1)
 
@@ -503,6 +541,7 @@ def main() -> None:
     ti = bench_text_image.measure()
     emit("lpips_alex_32x64x64_forward", ti["lpips_alex_32x64x64_forward"], base_lpips())
     emit("bertscore_match_256x128x256", ti["bertscore_match_256x128x256"], base_bertscore())
+    emit("wer_10k_pairs_compute", ti["wer_10k_pairs_compute"], base_wer())
 
     emit("detection_map_2k_images_compute", bench_detection.measure(n_trials=2), base_map(2_000))
 

@@ -8,6 +8,7 @@ of the metric the reference runs as eager torch ops
 (``functional/text/bert.py:327-360``); the encoder forward is model-bound
 and benched separately by its owner.
 """
+import functools
 import json
 
 import jax
@@ -70,10 +71,51 @@ def measure_bertscore() -> float:
     return measure_ms_scaled(make_run, K_BS)
 
 
+@functools.lru_cache(maxsize=2)
+def wer_corpus(n_pairs: int = 10_000, n_words: int = 20, vocab: int = 500, seed: int = 0):
+    """Synthetic ASR-style corpus: target sentences plus predictions with
+    ~15% word substitutions and occasional deletions (cached — bench.py's
+    baseline re-times the same corpus)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    words = [f"w{i}" for i in range(vocab)]
+    preds, targets = [], []
+    for _ in range(n_pairs):
+        n = int(rng.integers(max(2, n_words // 2), n_words * 2))
+        tgt = [words[i] for i in rng.integers(0, vocab, n)]
+        pred = [w if rng.uniform() > 0.15 else words[int(rng.integers(0, vocab))] for w in tgt]
+        if rng.uniform() < 0.3 and len(pred) > 2:
+            del pred[int(rng.integers(0, len(pred)))]
+        targets.append(" ".join(tgt))
+        preds.append(" ".join(pred))
+    return preds, targets
+
+
+def measure_wer(n_pairs: int = 10_000) -> float:
+    """Corpus WER through the shipped host path (tokenize, intern to int64
+    ids, ONE batched native-C Levenshtein crossing — numpy fallback when no
+    compiler). The reference runs a per-pair pure-python DP loop
+    (reference ``functional/text/wer.py:23-48``)."""
+    import time
+
+    from metrics_tpu.functional import word_error_rate
+
+    preds, targets = wer_corpus(n_pairs)
+    word_error_rate(preds, targets)  # warm (compiles the .so on first use)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(word_error_rate(preds, targets))  # float(): sync the device scalar
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000
+
+
 def measure() -> dict:
     return {
         "lpips_alex_32x64x64_forward": measure_lpips(),
         "bertscore_match_256x128x256": measure_bertscore(),
+        "wer_10k_pairs_compute": measure_wer(),
     }
 
 
