@@ -72,7 +72,8 @@ def measure_compute_group_savings(n: int = 200_000, n_classes: int = 10, reps: i
             col.update(preds, target)
             jax.block_until_ready(col["precision"].tp)
             times.append(time.perf_counter() - t0)
-        out[f"collection_prf1_200k_update_groups_{label}"] = min(times) * 1000
+        size = f"{n // 1000}k" if n >= 1000 else str(n)
+        out[f"collection_prf1_{size}_update_groups_{label}"] = min(times) * 1000
     return out
 
 

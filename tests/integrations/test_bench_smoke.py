@@ -1,0 +1,67 @@
+"""Bench-harness smoke tests.
+
+The driver runs ``bench.py`` unattended at the end of every round; a
+wiring error there (bad import, renamed key, signature drift) silently
+costs the round its numbers. These tests import every bench module and
+run the parameterizable measure functions at tiny configs — they assert
+plumbing, not performance.
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize(
+    "module",
+    [
+        "bench",
+        "benchmarks._timing",
+        "benchmarks.bench_collection",
+        "benchmarks.bench_curves",
+        "benchmarks.bench_detection",
+        "benchmarks.bench_image",
+        "benchmarks.bench_retrieval",
+        "benchmarks.bench_sync",
+        "benchmarks.bench_text_image",
+        "benchmarks.map_oracle",
+    ],
+)
+def test_bench_module_imports(module):
+    importlib.import_module(module)
+
+
+def test_detection_measure_tiny():
+    from benchmarks import bench_detection
+
+    ms = bench_detection.measure(n_images=20, n_trials=1)
+    assert np.isfinite(ms) and ms > 0
+
+
+def test_ssim_measure_tiny():
+    from benchmarks import bench_image
+
+    out = bench_image.measure_ssim(batch=2, side=32, k=2)
+    (key,) = out.keys()
+    assert key == "ssim_2x3x32x32_compute"
+    assert np.isfinite(out[key]) and out[key] > 0
+
+
+def test_wer_measure_tiny():
+    from benchmarks import bench_text_image
+
+    ms = bench_text_image.measure_wer(n_pairs=50)
+    assert np.isfinite(ms) and ms > 0
+    preds, targets = bench_text_image.wer_corpus(50)
+    assert len(preds) == len(targets) == 50
+
+
+def test_compute_group_savings_tiny():
+    from benchmarks import bench_collection
+
+    out = bench_collection.measure_compute_group_savings(n=500, n_classes=3, reps=1)
+    assert set(out) == {
+        "collection_prf1_500_update_groups_on",
+        "collection_prf1_500_update_groups_off",
+    }
+    assert all(np.isfinite(v) and v > 0 for v in out.values())
