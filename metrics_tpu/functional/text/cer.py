@@ -9,7 +9,7 @@ from typing import List, Tuple, Union
 
 import jax
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
+from metrics_tpu.functional.text.helper import _corpus_edit_stats, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -17,9 +17,8 @@ Array = jax.Array
 def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Host-side: corpus -> (total char edit operations, total reference chars)."""
     preds, target = _normalize_corpus(preds, target)
-    errors = sum(_edit_distance_corpus([list(p) for p in preds], [list(t) for t in target]))
-    total = sum(len(t) for t in target)
-    return _put_scalars(errors, total)
+    dists, _, cnt_t = _corpus_edit_stats(preds, target, "chars")
+    return _put_scalars(dists.sum(), cnt_t.sum())
 
 
 def _cer_compute(errors: Array, total: Array) -> Array:

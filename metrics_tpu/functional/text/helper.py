@@ -98,6 +98,39 @@ def _edit_distance_corpus(
     return [_edit_distance_numpy(p, r) for p, r in encoded]
 
 
+def _corpus_edit_stats(
+    preds: Sequence[str], target: Sequence[str], unit: str = "words"
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-pair ``(edit distance, pred units, target units)`` for a corpus.
+
+    The WER-family sufficient statistics in one shot. ``unit`` is ``"words"``
+    (CPython ``str.split`` semantics) or ``"chars"`` (code points, reference
+    ``cer.py:43-47``). Fast path: the native batch kernel tokenizes, encodes,
+    and runs the DP over the raw UTF-8 bytes in ONE crossing — no Python
+    per-token work at all (measured ~85% of the 10k-pair corpus cost before
+    this path existed). Fallback: host tokenization + `_edit_distance_corpus`.
+    """
+    from metrics_tpu import native
+
+    try:
+        out = native.text_dist_batch(list(preds), list(target), unit)
+    except UnicodeEncodeError:  # lone surrogates: not UTF-8-encodable
+        out = None
+    if out is not None:
+        dists, cnt_p, cnt_t = out
+        return dists, cnt_p, cnt_t
+    if unit == "chars":
+        preds_tok: List[List[str]] = [list(p) for p in preds]
+        tgt_tok: List[List[str]] = [list(t) for t in target]
+    else:
+        preds_tok = [p.split() for p in preds]
+        tgt_tok = [t.split() for t in target]
+    dists = np.asarray(_edit_distance_corpus(preds_tok, tgt_tok), dtype=np.int64)
+    cnt_p = np.fromiter((len(p) for p in preds_tok), dtype=np.int64, count=len(preds_tok))
+    cnt_t = np.fromiter((len(t) for t in tgt_tok), dtype=np.int64, count=len(tgt_tok))
+    return dists, cnt_p, cnt_t
+
+
 def _normalize_corpus(
     preds: Union[str, Sequence[str]],
     target: Union[str, Sequence[str]],

@@ -8,7 +8,9 @@ from typing import List, Tuple, Union
 
 import jax
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
+import numpy as np
+
+from metrics_tpu.functional.text.helper import _corpus_edit_stats, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -16,11 +18,8 @@ Array = jax.Array
 def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Host-side: corpus -> (total edit operations, total max-length words)."""
     preds, target = _normalize_corpus(preds, target)
-    preds_tok = [p.split() for p in preds]
-    tgt_tok = [t.split() for t in target]
-    errors = sum(_edit_distance_corpus(preds_tok, tgt_tok))
-    total = sum(max(len(t), len(p)) for p, t in zip(preds_tok, tgt_tok))
-    return _put_scalars(errors, total)
+    dists, cnt_p, cnt_t = _corpus_edit_stats(preds, target, "words")
+    return _put_scalars(dists.sum(), np.maximum(cnt_p, cnt_t).sum())
 
 
 def _mer_compute(errors: Array, total: Array) -> Array:

@@ -10,7 +10,7 @@ from typing import List, Tuple, Union
 
 import jax
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
+from metrics_tpu.functional.text.helper import _corpus_edit_stats, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -18,11 +18,8 @@ Array = jax.Array
 def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Host-side: corpus -> (total edit operations, total reference words)."""
     preds, target = _normalize_corpus(preds, target)
-    preds_tok = [p.split() for p in preds]
-    tgt_tok = [t.split() for t in target]
-    errors = sum(_edit_distance_corpus(preds_tok, tgt_tok))
-    total = sum(len(t) for t in tgt_tok)
-    return _put_scalars(errors, total)
+    dists, _, cnt_t = _corpus_edit_stats(preds, target, "words")
+    return _put_scalars(dists.sum(), cnt_t.sum())
 
 
 def _wer_compute(errors: Array, total: Array) -> Array:

@@ -16,7 +16,9 @@ from typing import List, Tuple, Union
 
 import jax
 
-from metrics_tpu.functional.text.helper import _edit_distance_corpus, _normalize_corpus, _put_scalars
+import numpy as np
+
+from metrics_tpu.functional.text.helper import _corpus_edit_stats, _normalize_corpus, _put_scalars
 
 Array = jax.Array
 
@@ -26,13 +28,9 @@ def _word_info_update(
 ) -> Tuple[Array, Array, Array]:
     """Host-side: corpus -> (hits, total target words, total pred words)."""
     preds, target = _normalize_corpus(preds, target)
-    preds_tok = [p.split() for p in preds]
-    tgt_tok = [t.split() for t in target]
-    dists = _edit_distance_corpus(preds_tok, tgt_tok)
-    target_total = sum(len(t) for t in tgt_tok)
-    preds_total = sum(len(p) for p in preds_tok)
-    hits = sum(max(len(t), len(p)) - d for p, t, d in zip(preds_tok, tgt_tok, dists))
-    return _put_scalars(hits, target_total, preds_total)
+    dists, cnt_p, cnt_t = _corpus_edit_stats(preds, target, "words")
+    hits = (np.maximum(cnt_p, cnt_t) - dists).sum()
+    return _put_scalars(hits, cnt_t.sum(), cnt_p.sum())
 
 
 def _wil_compute(hits: Array, target_total: Array, preds_total: Array) -> Array:

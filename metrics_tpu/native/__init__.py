@@ -138,6 +138,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.mtpu_edit_distance.restype = ctypes.c_int64
         lib.mtpu_edit_distance_batch.argtypes = [i64p, i64p, i64p, i64p, ctypes.c_int64, i64p]
         lib.mtpu_edit_distance_batch.restype = None
+        lib.mtpu_text_dist_batch.argtypes = [
+            u8p, i64p, u8p, i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p, i64p,
+        ]
+        lib.mtpu_text_dist_batch.restype = ctypes.c_int64
         lib.mtpu_coco_match.argtypes = [
             f32p, i64p, i64p, i64p, i64p, i64p, u8p, f64p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -195,6 +199,41 @@ def edit_distance_batch(seqs_a: List[np.ndarray], seqs_b: List[np.ndarray]) -> O
     if (out < 0).any():  # allocation failure inside the kernel
         return None
     return out
+
+
+def text_dist_batch(corpus_a: List[str], corpus_b: List[str], mode: str):
+    """Whole-corpus edit-distance stats in ONE crossing; None when no lib.
+
+    ``mode`` is ``"words"`` (WER family: CPython whitespace split + FNV-64
+    token hashing, done in C) or ``"chars"`` (CER: Unicode code points).
+    Returns ``(dist, cnt_a, cnt_b)`` int64 arrays — per-pair edit distance
+    and both sides' token/char counts. Strings with lone surrogates cannot
+    be UTF-8-encoded; callers catch UnicodeEncodeError and take the Python
+    path.
+    """
+    if len(corpus_a) != len(corpus_b):
+        raise ValueError(f"Corpus has different size {len(corpus_a)} != {len(corpus_b)}")
+    lib = _load()
+    if lib is None or not hasattr(lib, "mtpu_text_dist_batch"):
+        return None
+    n = len(corpus_a)
+
+    def pack(strs):
+        bs = [s.encode("utf-8") for s in strs]
+        off = np.zeros(len(strs) + 1, dtype=np.int64)
+        np.cumsum([len(b) for b in bs], out=off[1:])
+        flat = np.frombuffer(b"".join(bs), dtype=np.uint8) if off[-1] else np.zeros(0, np.uint8)
+        return np.ascontiguousarray(flat), off
+
+    flat_a, off_a = pack(corpus_a)
+    flat_b, off_b = pack(corpus_b)
+    dist = np.empty(n, dtype=np.int64)
+    cnt_a = np.empty(n, dtype=np.int64)
+    cnt_b = np.empty(n, dtype=np.int64)
+    rc = lib.mtpu_text_dist_batch(
+        flat_a, off_a, flat_b, off_b, n, 0 if mode == "chars" else 1, dist, cnt_a, cnt_b
+    )
+    return None if rc < 0 else (dist, cnt_a, cnt_b)
 
 
 def pr_accumulate(

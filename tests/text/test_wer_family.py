@@ -163,3 +163,87 @@ class TestWERFamilyJiwer:
         np.testing.assert_allclose(
             float(char_error_rate(preds, target)), jiwer.cer(target, preds), atol=1e-6
         )
+
+
+class TestNativeTextDistBatch:
+    """Pin the one-crossing native string kernel (tokenize + FNV encode + DP
+    in C, ``native/levenshtein.c`` ``mtpu_text_dist_batch``) against the
+    pure-Python split/encode path on adversarial inputs."""
+
+    def _python_stats(self, preds, target, unit):
+        if unit == "chars":
+            ptok, ttok = [list(p) for p in preds], [list(t) for t in target]
+        else:
+            ptok, ttok = [p.split() for p in preds], [t.split() for t in target]
+        dists = [_np_edit_distance(p, t) for p, t in zip(ptok, ttok)]
+        return dists, [len(p) for p in ptok], [len(t) for t in ttok]
+
+    @pytest.mark.parametrize("unit", ["words", "chars"])
+    def test_native_matches_python_on_unicode(self, unit):
+        from metrics_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        # the full CPython str.split() whitespace set, multi-byte tokens,
+        # empties, whitespace-only strings, and high code points
+        uni_ws = "\t\n\x0b\x0c\r\x1c\x1d\x1e\x1f \x85\xa0       　"
+        preds = [
+            "hello world",
+            "",
+            "   ",
+            uni_ws,
+            f"a{uni_ws}b　c",
+            "café naïve 你好 \U0001f600",
+            "a" * 300,
+            "x   y",
+            "tok",
+        ]
+        target = [
+            "hello beautiful　world",
+            "non empty",
+            "",
+            "w",
+            f"a{uni_ws}c b",
+            "cafe naive 你好吗 \U0001f601",
+            "a" * 299 + "b",
+            "x y z",
+            "tok",
+        ]
+        got = native.text_dist_batch(preds, target, unit)
+        assert got is not None
+        dist, cnt_p, cnt_t = got
+        want_d, want_p, want_t = self._python_stats(preds, target, unit)
+        np.testing.assert_array_equal(dist, want_d)
+        np.testing.assert_array_equal(cnt_p, want_p)
+        np.testing.assert_array_equal(cnt_t, want_t)
+
+    @pytest.mark.parametrize("unit", ["words", "chars"])
+    def test_native_matches_python_fuzz(self, unit):
+        from metrics_tpu import native
+
+        if not native.native_available():
+            pytest.skip("no native toolchain")
+        rng = np.random.default_rng(11)
+        alphabet = list("ab \t 　é你") + ["\U0001f600"]
+        corpora = [
+            ["".join(rng.choice(alphabet, rng.integers(0, 40))) for _ in range(40)]
+            for _ in range(2)
+        ]
+        got = native.text_dist_batch(corpora[0], corpora[1], unit)
+        assert got is not None
+        dist, cnt_p, cnt_t = got
+        want_d, want_p, want_t = self._python_stats(corpora[0], corpora[1], unit)
+        np.testing.assert_array_equal(dist, want_d)
+        np.testing.assert_array_equal(cnt_p, want_p)
+        np.testing.assert_array_equal(cnt_t, want_t)
+
+    def test_surrogate_falls_back_to_python_path(self):
+        """Lone surrogates cannot be UTF-8-encoded; the corpus helper must
+        still produce correct stats through the Python path."""
+        from metrics_tpu.functional.text.helper import _corpus_edit_stats
+
+        preds = ["ok here", "bad \udc80 token"]
+        target = ["ok there", "bad token"]
+        dists, cnt_p, cnt_t = _corpus_edit_stats(preds, target, "words")
+        assert list(cnt_p) == [2, 3] and list(cnt_t) == [2, 2]
+        assert list(dists) == [1, 1]
