@@ -110,6 +110,8 @@ def _corpus_edit_stats(
     per-token work at all (measured ~85% of the 10k-pair corpus cost before
     this path existed). Fallback: host tokenization + `_edit_distance_corpus`.
     """
+    if unit not in ("chars", "words"):
+        raise ValueError(f"unit must be 'chars' or 'words', got {unit!r}")
     from metrics_tpu import native
 
     try:

@@ -211,6 +211,8 @@ def text_dist_batch(corpus_a: List[str], corpus_b: List[str], mode: str):
     be UTF-8-encoded; callers catch UnicodeEncodeError and take the Python
     path.
     """
+    if mode not in ("chars", "words"):
+        raise ValueError(f"mode must be 'chars' or 'words', got {mode!r}")
     if len(corpus_a) != len(corpus_b):
         raise ValueError(f"Corpus has different size {len(corpus_a)} != {len(corpus_b)}")
     lib = _load()

@@ -50,6 +50,11 @@ class CapacityBuffer:
         self.data: Optional[Array] = None  # allocated on first append
         self.count: Array = jnp.asarray(0, dtype=jnp.int32)
         self._host_count: Optional[int] = 0  # None when count came from a trace
+        # set by sync_buffer_in_context on the MERGED buffer: per-device bool
+        # flags, True where that device appended past capacity under traced
+        # counts (its surviving rows may be overwritten samples). None when
+        # not a mesh-merge product / overflow is statically impossible.
+        self.overflowed: Optional[Array] = None
 
     # -- list-compatible mutating API -----------------------------------
 
@@ -118,6 +123,17 @@ class CapacityBuffer:
             self.count = jnp.asarray(n, dtype=jnp.int32)
         return self
 
+    @property
+    def overflow(self) -> Array:
+        """Traced bool: whether appends ran past ``capacity`` on THIS device.
+
+        Under traced counts the clamped ``dynamic_update_slice`` writes keep
+        incrementing ``count`` past capacity, so ``count > capacity`` is an
+        exact overflow indicator that costs nothing to read in-graph — the
+        production-path alternative to the ``debug_checks`` checkify guard.
+        """
+        return self.count > self.capacity
+
     def materialize(self) -> Array:
         """The filled prefix ``data[:count]`` (eager; count must be concrete)."""
         if self.data is None:
@@ -138,6 +154,7 @@ class CapacityBuffer:
         new.data = self.data  # jnp arrays are immutable
         new.count = self.count
         new._host_count = self._host_count
+        new.overflowed = self.overflowed
         return new
 
     def __repr__(self) -> str:
@@ -147,18 +164,20 @@ class CapacityBuffer:
     # -- pytree protocol -------------------------------------------------
 
     def tree_flatten(self) -> Tuple[tuple, tuple]:
-        if self.data is None:
-            return (self.count,), (self.capacity, self.dtype, False)
-        return (self.count, self.data), (self.capacity, self.dtype, True)
+        children = (self.count,) + (() if self.data is None else (self.data,))
+        if self.overflowed is not None:
+            children = children + (self.overflowed,)
+        return children, (self.capacity, self.dtype, self.data is not None, self.overflowed is not None)
 
     @classmethod
     def tree_unflatten(cls, aux: tuple, children: tuple) -> "CapacityBuffer":
-        capacity, dtype, allocated = aux
+        capacity, dtype, allocated, has_overflow = aux
         new = cls.__new__(cls)
         new.capacity = capacity
         new.dtype = dtype
         new.count = children[0]
         new.data = children[1] if allocated else None
+        new.overflowed = children[-1] if has_overflow else None
         # Only adopt a host mirror from leaves that are free to read: a plain
         # Python/numpy int. int() on a tracer raises, on a ShapeDtypeStruct
         # (eval_shape / orbax restore targets) is a TypeError, and on a live

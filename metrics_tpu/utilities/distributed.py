@@ -216,8 +216,12 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typ
         by the clamped ``dynamic_update_slice`` writes — the merged buffer's
         count is clamped to honest totals, but the surviving rows from that
         device may be CORRUPTED samples (later appends overwrote earlier
-        rows), not merely a truncated prefix. Arm ``debug_checks`` to detect
-        overflow at runtime, or size ``capacity`` for the worst case.
+        rows), not merely a truncated prefix. The merged buffer carries
+        per-device flags in ``merged.overflowed`` (bool ``(n_devices,)``,
+        in-graph, free to read) so production code can detect this without
+        ``debug_checks``; checkify under ``debug_checks`` still hard-fails
+        at the append site, and sizing ``capacity`` for the worst case
+        remains the real fix.
     """
     from metrics_tpu.utilities.buffers import CapacityBuffer
 
@@ -240,18 +244,46 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typ
     counts = _all_gather(buf.count, axis_name, typed)  # (n,)
     # a traced overflow (append past capacity inside a scan) leaves count >
     # capacity while the data writes were clamped; clamp here too so the
-    # merge stays dense (no phantom zero rows) and the total stays honest
+    # merge stays dense (no phantom zero rows) and the total stays honest —
+    # and surface WHICH devices overflowed so production code can react
+    # without arming debug_checks (see CapacityBuffer.overflowed)
+    overflow = counts > cap
     counts = jnp.minimum(counts, cap)
-    offsets = jnp.cumsum(counts) - counts
-    slot = jnp.arange(cap, dtype=jnp.int32)
-    pos = jnp.where(slot[None, :] < counts[:, None], offsets[:, None] + slot[None, :], n * cap)
-    merged.data = (
-        jnp.zeros((n * cap,) + item_shape, buf.data.dtype)
-        .at[pos.reshape(-1)]
-        .set(data.reshape((n * cap,) + item_shape), mode="drop")
-    )
+    # dense concat as n contiguous whole-buffer writes at dynamic offsets,
+    # ascending: device d's stale tail [offset_d + count_d, offset_d + cap)
+    # is exactly covered by device d+1's write (offset_{d+1} = offset_d +
+    # count_d, same cap-row extent), and no later write reaches an earlier
+    # device's real rows — so only the LAST device's tail needs masking to
+    # zeros before its write. Contiguous dynamic_update_slice lowers near
+    # memcpy speed, unlike the masked scatter (39.6ms) or row gather (202ms)
+    # it replaces — measured 1M x 8dev: ~12ms, ~1.2x the static-count path.
+    offsets = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+    zero = jnp.asarray(0, jnp.int32)
+    slot = jnp.arange(cap, dtype=jnp.int32).reshape((cap,) + (1,) * len(item_shape))
+    out = jnp.zeros((n * cap,) + item_shape, buf.data.dtype)
+    if n <= 16:  # unrolled: only the final device's tail needs the mask
+        for d in range(n):
+            rows = data[d]
+            if d == n - 1:
+                rows = jnp.where(slot < counts[d], rows, jnp.zeros((), buf.data.dtype))
+            out = lax.dynamic_update_slice(out, rows, (offsets[d],) + (zero,) * len(item_shape))
+    else:  # pod-scale axes: rolled loop, program size O(1) in n; masking
+        # every device's tail (not just the last) keeps the body uniform
+        if typed == "varying":
+            # the loop carry must already hold the body output's
+            # device-varying type (fori_loop requires equal carry types)
+            out = lax.pvary(out, axis_name)
+
+        def body(d, acc):
+            rows = lax.dynamic_index_in_dim(data, d, keepdims=False)
+            rows = jnp.where(slot < counts[d], rows, jnp.zeros((), buf.data.dtype))
+            return lax.dynamic_update_slice(acc, rows, (offsets[d],) + (zero,) * len(item_shape))
+
+        out = lax.fori_loop(0, n, body, out)
+    merged.data = out
     merged.count = counts.sum().astype(jnp.int32)
     merged._host_count = None
+    merged.overflowed = overflow
     return merged
 
 

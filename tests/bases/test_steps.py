@@ -322,6 +322,35 @@ class TestStaticShapeContract:
         np.testing.assert_allclose(np.asarray(data)[: int(total)], expected)
         np.testing.assert_allclose(np.asarray(data)[int(total) :], 0.0)
 
+    def test_sync_buffer_overflow_flags_observable(self):
+        """Per-device overflow under traced counts is surfaced on the merged
+        buffer (``merged.overflowed``) without debug_checks, the merged count
+        clamps to honest totals, and the local ``overflow`` property agrees."""
+        from metrics_tpu.utilities.buffers import CapacityBuffer
+        from metrics_tpu.utilities.distributed import sync_buffer_in_context
+
+        cap = 4
+        # devices 2 and 5 appended past capacity (counts keep incrementing
+        # while the clamped writes overwrite the tail)
+        counts = jnp.asarray([1, 4, 9, 2, 0, 6, 3, 4], dtype=jnp.int32)
+        values = jnp.arange(8 * cap, dtype=jnp.float32).reshape(8, cap)
+
+        def prog(count, vals):
+            buf = CapacityBuffer(cap)
+            buf.append(vals.reshape(cap))
+            buf.count = count.reshape(())
+            buf._host_count = None
+            local_overflow = buf.overflow
+            merged = sync_buffer_in_context(buf, "dp")
+            return merged.count, merged.overflowed, jax.lax.psum(local_overflow.astype(jnp.int32), "dp")
+
+        total, flags, n_over = jax.jit(
+            jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=(P(), P(), P()))
+        )(counts, values)
+        np.testing.assert_array_equal(np.asarray(flags), np.asarray(counts) > cap)
+        assert int(total) == int(jnp.minimum(counts, cap).sum())
+        assert int(n_over) == 2
+
 
 class TestBootstrapStep:
     """BootStrapper as a pure step: the bootstrap axis rides the carry
