@@ -19,7 +19,7 @@ import jax
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.data import _flatten_dict, allclose, coerce_foreign_tensors
+from metrics_tpu.utilities.data import _flatten_dict, allclose, coerce_foreign_tensors, foreign_coercion_scope
 
 Array = jax.Array
 
@@ -176,7 +176,11 @@ class MetricCollection(dict):
         # metric would otherwise pay the host transfer independently
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
+        with foreign_coercion_scope():  # member forwards must not re-walk
+            res = {
+                k: m(*args, **m._filter_kwargs(**kwargs))
+                for k, m in self.items(keep_base=True, copy_state=False)
+            }
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -187,6 +191,10 @@ class MetricCollection(dict):
         """Update each underlying metric once per compute group."""
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
+        with foreign_coercion_scope():  # member updates must not re-walk
+            self._update_members(*args, **kwargs)
+
+    def _update_members(self, *args: Any, **kwargs: Any) -> None:
         if self._groups_checked:
             for group in self._groups.values():
                 m0 = self[group[0]]

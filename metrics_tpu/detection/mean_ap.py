@@ -320,6 +320,13 @@ class MeanAveragePrecision(Metric):
         for g in range(n_groups):
             inds[g] = np.searchsorted(rc[g], rec_thresholds, side="left")
         valid = inds < n_dets  # past-the-end recall thresholds score 0
+        # reference prefix truncation (ref :729-731): everything from the
+        # FIRST past-the-end threshold onward scores 0 — with a custom
+        # non-ascending rec_thresholds list an in-range threshold after a
+        # past-the-end one is zeroed too, matching the reference exactly
+        overflow = inds.max(axis=1) >= n_dets
+        cols = np.arange(n_rec_thrs)
+        valid &= ~overflow[:, None] | (cols[None, :] < inds.argmax(axis=1)[:, None])
         prec = np.where(valid, np.take_along_axis(pr, np.minimum(inds, n_dets - 1), axis=1), 0.0)
         recall[pos] = rc[pos, -1]
         precision[pos] = prec[pos]

@@ -9,24 +9,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
-from metrics_tpu.utilities.data import _to_float
+from metrics_tpu.functional.pairwise.helpers import run_pairwise
 
 Array = jax.Array
 
 
-def _pairwise_cosine_similarity_update(
-    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
-) -> Array:
-    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
-    x = _to_float(x)
-    y = _to_float(y)
+def _core(x: Array, y: Array) -> Array:
     x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
     y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
-    distance = jnp.matmul(x, y.T, precision="float32")
-    if zero_diagonal:
-        distance = _zero_diagonal(distance)
-    return distance
+    return jnp.matmul(x, y.T, precision="float32")
+
 
 
 def pairwise_cosine_similarity(
@@ -47,5 +39,4 @@ def pairwise_cosine_similarity(
                [0.51449573, 0.8436959 ],
                [0.5299989 , 0.85334015]], dtype=float32)
     """
-    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
-    return _reduce_distance_matrix(distance, reduction)
+    return run_pairwise(_core, x, y, reduction, zero_diagonal)

@@ -9,24 +9,17 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
-from metrics_tpu.utilities.data import _to_float
+from metrics_tpu.functional.pairwise.helpers import run_pairwise
 
 Array = jax.Array
 
 
-def _pairwise_euclidean_distance_update(
-    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
-) -> Array:
-    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
-    x = _to_float(x)
-    y = _to_float(y)
+def _core(x: Array, y: Array) -> Array:
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
-    distance = x_norm + y_norm - 2 * jnp.matmul(x, y.T, precision="float32")
-    if zero_diagonal:
-        distance = _zero_diagonal(distance)
-    return jnp.sqrt(jnp.clip(distance, min=0.0))
+    sq = x_norm + y_norm - 2 * jnp.matmul(x, y.T, precision="float32")
+    return jnp.sqrt(jnp.clip(sq, min=0.0))
+
 
 
 def pairwise_euclidean_distance(
@@ -47,5 +40,4 @@ def pairwise_euclidean_distance(
                [5.3851647, 4.1231055],
                [8.944272 , 7.615773 ]], dtype=float32)
     """
-    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
-    return _reduce_distance_matrix(distance, reduction)
+    return run_pairwise(_core, x, y, reduction, zero_diagonal)

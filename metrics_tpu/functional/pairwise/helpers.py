@@ -1,50 +1,65 @@
-"""Shared input validation / reduction for pairwise kernels.
+"""Shared driver for the pairwise kernels.
 
-Behavioral equivalent of reference
-``torchmetrics/functional/pairwise/helpers.py`` (``_check_input`` :19,
-``_reduce_distance_matrix`` :46).
+Counterpart of reference ``torchmetrics/functional/pairwise/helpers.py``
+(``_check_input`` :19, ``_reduce_distance_matrix`` :46), restructured: the
+reference threads a validate → compute → fill-diagonal → reduce sequence
+through every kernel; here ONE driver (:func:`run_pairwise`) owns that
+lifecycle and each kernel supplies only its ``[N,d],[M,d] -> [N,M]`` core.
+Error strings match the reference for drop-in parity.
 """
-from typing import Optional, Tuple
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utilities.data import _to_float
+
 Array = jax.Array
 
+# last-dim reductions of the [N, M] matrix, keyed by the public `reduction`
+# argument; unknown keys fail fast (before any compute)
+_ROW_REDUCERS: Dict[Optional[str], Callable[[Array], Array]] = {
+    "mean": lambda mat: mat.mean(axis=-1),
+    "sum": lambda mat: mat.sum(axis=-1),
+    "none": lambda mat: mat,
+    None: lambda mat: mat,
+}
 
-def _check_input(x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None) -> Tuple[Array, Array, bool]:
-    """Validate [N,d]/[M,d] shapes; default ``zero_diagonal`` to the x-vs-x case."""
+
+def run_pairwise(
+    core: Callable[[Array, Array], Array],
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Run a pairwise core inside the shared frame.
+
+    The frame owns everything around the math: shape validation, float
+    promotion, the x-vs-x default (whose diagonal zeroes unless the caller
+    says otherwise), diagonal masking, and the optional row reduction.
+    """
+    try:
+        reduce_rows = _ROW_REDUCERS[reduction]
+    except (KeyError, TypeError):  # unknown key, or unhashable value
+        raise ValueError(
+            f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}"
+        ) from None
     x = jnp.asarray(x)
     if x.ndim != 2:
         raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
-    if y is not None:
+    if y is None:
+        y = x
+        if zero_diagonal is None:
+            zero_diagonal = True  # comparing x against itself
+    else:
         y = jnp.asarray(y)
         if y.ndim != 2 or y.shape[1] != x.shape[1]:
             raise ValueError(
                 "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
                 " `d` should be same as the last dimension of `x`"
             )
-        zero_diagonal = False if zero_diagonal is None else zero_diagonal
-    else:
-        y = x
-        zero_diagonal = True if zero_diagonal is None else zero_diagonal
-    return x, y, zero_diagonal
-
-
-def _zero_diagonal(distance: Array) -> Array:
-    """Zero out the diagonal of a square distance matrix (functional form of
-    the reference's in-place ``fill_diagonal_``)."""
-    n, m = distance.shape
-    mask = jnp.eye(n, m, dtype=bool)
-    return jnp.where(mask, 0.0, distance)
-
-
-def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
-    """Reduce a [N,M] distance matrix along its last dimension."""
-    if reduction == "mean":
-        return distmat.mean(axis=-1)
-    if reduction == "sum":
-        return distmat.sum(axis=-1)
-    if reduction is None or reduction == "none":
-        return distmat
-    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+    mat = core(_to_float(x), _to_float(y))
+    if zero_diagonal:
+        mat = jnp.where(jnp.eye(mat.shape[0], mat.shape[1], dtype=bool), 0.0, mat)
+    return reduce_rows(mat)

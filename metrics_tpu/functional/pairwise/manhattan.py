@@ -9,22 +9,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix, _zero_diagonal
-from metrics_tpu.utilities.data import _to_float
+from metrics_tpu.functional.pairwise.helpers import run_pairwise
 
 Array = jax.Array
 
 
-def _pairwise_manhattan_distance_update(
-    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
-) -> Array:
-    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
-    x = _to_float(x)
-    y = _to_float(y)
-    distance = jnp.sum(jnp.abs(x[:, None] - y[None, :]), axis=-1)
-    if zero_diagonal:
-        distance = _zero_diagonal(distance)
-    return distance
+def _core(x: Array, y: Array) -> Array:
+    return jnp.sum(jnp.abs(x[:, None] - y[None, :]), axis=-1)
+
 
 
 def pairwise_manhattan_distance(
@@ -45,5 +37,4 @@ def pairwise_manhattan_distance(
                [ 7.,  5.],
                [12., 10.]], dtype=float32)
     """
-    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
-    return _reduce_distance_matrix(distance, reduction)
+    return run_pairwise(_core, x, y, reduction, zero_diagonal)

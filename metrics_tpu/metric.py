@@ -42,6 +42,7 @@ from metrics_tpu.utilities.data import (
     _squeeze_if_scalar,
     apply_to_collection,
     coerce_foreign_tensors,
+    foreign_coercion_scope,
     dim_zero_cat,
 )
 from metrics_tpu.utilities.distributed import distributed_available, gather_all_tensors
@@ -206,9 +207,10 @@ class Metric(ABC):
         # would pay the host transfer twice
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        if self.full_state_update:
-            return self._forward_full_state_update(*args, **kwargs)
-        return self._forward_reduce_state_update(*args, **kwargs)
+        with foreign_coercion_scope():  # updates below must not re-walk
+            if self.full_state_update:
+                return self._forward_full_state_update(*args, **kwargs)
+            return self._forward_reduce_state_update(*args, **kwargs)
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         # Reference semantics (metric.py:235-275): global update, then the

@@ -44,6 +44,20 @@ from metrics_tpu.utilities.distributed import replicate_typed, sync_buffer_in_co
 Array = jax.Array
 State = Dict[str, Any]
 
+# A state is merge-combinable when its batch contribution (accumulated from
+# the default) folds into the carry with its own declared reduction — the
+# exact property the DDP gather-reduce sync relies on (per-rank states
+# accumulated from zero, merged by dist_reduce_fx). sum/max/min qualify; cat
+# buffers, None and custom reductions don't.
+_MERGE_OPS: Dict[str, Callable] = {"sum": lambda a, b: a + b, "max": jnp.maximum, "min": jnp.minimum}
+
+
+def _is_mergeable(metric: Metric) -> bool:
+    return all(
+        r in _MERGE_OPS and not isinstance(d, CapacityBuffer)
+        for r, d in zip(metric._reductions.values(), metric._defaults.values())
+    )
+
 __all__ = ["make_step"]
 
 
@@ -148,7 +162,7 @@ def make_step(
             f"{type(template).__name__} is a wrapper metric whose state is not a fixed-shape carry"
             " (snapshot lists / dynamic shapes). Build the step from the base metric and apply the"
             " wrapper semantics outside the step, or use the eager class API. (BootStrapper,"
-            " ClasswiseWrapper, MinMaxMetric and MultioutputWrapper(remove_nans=False) ARE supported.)"
+            " ClasswiseWrapper, MinMaxMetric and MultioutputWrapper ARE supported.)"
         )
 
     for name, default in template._defaults.items():
@@ -178,16 +192,7 @@ def make_step(
         worker._computed = None
         return worker
 
-    # A state is merge-combinable when its batch contribution (accumulated
-    # from the default) folds into the carry with its own declared
-    # reduction — the exact property the DDP gather-reduce sync relies on
-    # (per-rank states accumulated from zero, merged by dist_reduce_fx).
-    # sum/max/min qualify; cat buffers, None and custom reductions don't.
-    _MERGE_OPS = {"sum": lambda a, b: a + b, "max": jnp.maximum, "min": jnp.minimum}
-    mergeable = all(
-        r in _MERGE_OPS and not isinstance(d, CapacityBuffer)
-        for r, d in zip(template._reductions.values(), template._defaults.values())
-    )
+    mergeable = _is_mergeable(template)
 
     def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
         if mergeable:
@@ -427,13 +432,26 @@ def _make_multioutput_step(
     """MultioutputWrapper as a pure step: the reference's N deep copies
     become one stacked state pytree with a leading output axis, and every
     step is a single ``jax.vmap`` over the sliced ``output_dim`` of the
-    array inputs (reference ``wrappers/multioutput.py:23``)."""
+    array inputs (reference ``wrappers/multioutput.py:23``).
+
+    ``remove_nans=True`` (NaN-row dropping, reference ``multioutput.py:11``)
+    is expressed with STATIC shapes as masked merge-combination: every row's
+    contribution state is accumulated from the default (an inner ``vmap``),
+    NaN rows are replaced by the default — the identity element of their
+    declared reduction — and the batch folds into the carry with each
+    state's own ``dist_reduce_fx``. That is exactly the DDP gather-reduce
+    equivalence the sync protocol already relies on, so it is available for
+    the same metrics: all states sum/max/min-reducible.
+    """
     if wrapper.remove_nans:
-        raise ValueError(
-            "MultioutputWrapper(remove_nans=True) drops rows by VALUE — a dynamic shape no traced"
-            " step can carry. Construct the wrapper with remove_nans=False for the step API (inputs"
-            " must be NaN-free), or use the eager class API."
-        )
+        if not _is_mergeable(wrapper.metrics[0]):
+            raise ValueError(
+                "MultioutputWrapper(remove_nans=True) as a step needs every base-metric state to be"
+                " sum/max/min-reducible (NaN rows are masked to the reduction identity and"
+                " merge-folded). This base metric has cat/mean/custom states; construct the wrapper"
+                " with remove_nans=False (inputs must be NaN-free) or use the eager class API."
+            )
+        return _make_multioutput_nanmask_step(wrapper, axis_name=axis_name, with_value=with_value)
     if any(isinstance(d, CapacityBuffer) for d in wrapper.metrics[0]._defaults.values()):
         raise ValueError(
             "MultioutputWrapper over a sample-buffer base metric is not a stackable step carry"
@@ -468,6 +486,82 @@ def _make_multioutput_step(
 
     def compute(state: State) -> Array:
         return jax.vmap(base_compute)(state)
+
+    return init, step, compute
+
+
+def _make_multioutput_nanmask_step(
+    wrapper: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """``MultioutputWrapper(remove_nans=True)`` with static shapes.
+
+    Per output, each row's contribution state is accumulated from the
+    default via an inner ``vmap``; rows flagged by ``_get_nan_indices``
+    (reference ``wrappers/multioutput.py:11``) are masked back to the
+    default — the identity of their declared reduction — and the whole
+    batch folds into the carry with each state's ``dist_reduce_fx``. For
+    sum/max/min states this equals dropping the rows exactly (up to float
+    reassociation), by the same argument that makes the DDP gather-reduce
+    sync equal to a single global update.
+    """
+    from metrics_tpu.wrappers.multioutput import _get_nan_indices
+
+    n_out = len(wrapper.metrics)
+    dim = wrapper.output_dim
+    squeeze = wrapper.squeeze_outputs
+    base = wrapper.metrics[0]
+    reductions = dict(base._reductions)
+    row_fold = {"sum": lambda m: m.sum(axis=0), "max": lambda m: m.max(axis=0), "min": lambda m: m.min(axis=0)}
+    base_init, base_step, base_compute_local = make_step(base, axis_name=None, with_value=False)
+    if axis_name is None:
+        base_compute_synced = base_compute_local
+    else:
+        _, _, base_compute_synced = make_step(base, axis_name=axis_name, with_value=False)
+
+    def init() -> State:
+        return _stack_state(base_init(), n_out)
+
+    def _is_array(a: Any) -> bool:
+        return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        keys = sorted(kwargs)
+        n_pos = len(args)
+        leaves = list(args) + [kwargs[k] for k in keys]
+        axes = tuple(dim if _is_array(a) else None for a in leaves)
+
+        def one(s, *flat):
+            flat = [jnp.expand_dims(a, dim) if (_is_array(a) and not squeeze) else a for a in flat]
+            arrays = [a for a in flat if _is_array(a)]
+            drop = _get_nan_indices(*arrays)  # (B,) True -> row removed
+            row_axes = tuple(0 if _is_array(a) else None for a in flat)
+
+            def row_contrib(*row):
+                row = tuple(jnp.expand_dims(a, 0) if _is_array(a) else a for a in row)
+                rs, _ = base_step(base_init(), *row[:n_pos], **dict(zip(keys, row[n_pos:])))
+                return rs
+
+            row_states = jax.vmap(row_contrib, in_axes=row_axes)(*flat)  # leaves: (B, *state)
+            defaults = base_init()
+            batch_state: State = {}
+            for name, rows in row_states.items():
+                keep = (~drop).reshape((-1,) + (1,) * (rows.ndim - 1))
+                masked = jnp.where(keep, rows, defaults[name][None])
+                batch_state[name] = row_fold[reductions[name]](masked)
+            new_s = {
+                name: _MERGE_OPS[reductions[name]](s[name], batch_state[name]) for name in batch_state
+            }
+            if not with_value:
+                return new_s, None
+            return new_s, base_compute_local(batch_state)
+
+        new_state, values = jax.vmap(one, in_axes=(0,) + axes)(state, *leaves)
+        return new_state, (values if with_value else None)
+
+    def compute(state: State) -> Any:
+        return jax.vmap(base_compute_synced)(state)
 
     return init, step, compute
 

@@ -8,7 +8,9 @@ jittable; ``apply_to_collection`` / ``get_group_indexes`` are host-side
 structural helpers.
 """
 import sys
+import threading
 from collections import namedtuple
+from contextlib import contextmanager
 from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
@@ -116,6 +118,27 @@ def apply_to_collection(
     return data
 
 
+_COERCION_SCOPE = threading.local()
+
+
+@contextmanager
+def foreign_coercion_scope():
+    """Mark a region whose inputs were already coerced.
+
+    ``MetricCollection.forward`` → ``Metric.forward`` → ``update`` each
+    coerce defensively (each is a public entry point); wrapping the inner
+    calls in this scope makes the nested :func:`coerce_foreign_tensors`
+    no-ops, so one call walks the (possibly deeply nested) input collection
+    exactly once.
+    """
+    depth = getattr(_COERCION_SCOPE, "depth", 0)
+    _COERCION_SCOPE.depth = depth + 1
+    try:
+        yield
+    finally:
+        _COERCION_SCOPE.depth = depth
+
+
 def coerce_foreign_tensors(data: Any) -> Any:
     """Convert torch tensors nested anywhere in ``data`` to jax arrays.
 
@@ -127,6 +150,8 @@ def coerce_foreign_tensors(data: Any) -> Any:
     and re-casts to ``jnp.bfloat16``). No-op when torch was never imported
     by the process; jax/numpy inputs pass through untouched.
     """
+    if getattr(_COERCION_SCOPE, "depth", 0):
+        return data  # an enclosing foreign_coercion_scope already converted
     torch = sys.modules.get("torch")  # cheap gate: no torch, no torch tensors
     if torch is None or not hasattr(torch, "Tensor"):
         # None is the standard sys.modules placeholder for "import blocked"

@@ -522,12 +522,80 @@ class TestWrapperSteps:
             eager.update(p, t)
         np.testing.assert_allclose(got, np.asarray(eager.compute()), atol=1e-6)
 
-    def test_multioutput_remove_nans_rejected(self):
+    def test_multioutput_remove_nans_step_matches_eager(self):
+        """remove_nans=True as masked merge-combination: NaN rows (different
+        per output) are masked to reduction identities, matching the eager
+        wrapper's row dropping exactly."""
         from metrics_tpu import MeanSquaredError
         from metrics_tpu.wrappers import MultioutputWrapper
 
-        with pytest.raises(ValueError, match="remove_nans"):
-            make_step(MultioutputWrapper(MeanSquaredError(), num_outputs=2))
+        rng = np.random.default_rng(33)
+        preds = rng.normal(size=(3, 16, 2)).astype(np.float32)
+        target = rng.normal(size=(3, 16, 2)).astype(np.float32)
+        preds[0, 3, 0] = np.nan  # output 0 loses row 3 of batch 0
+        target[1, 7, 1] = np.nan  # output 1 loses row 7 of batch 1
+        preds[2, 0, :] = np.nan  # both outputs lose row 0 of batch 2
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+
+        wrapper = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True)
+        init, step, compute = make_step(wrapper)
+        state, values = jax.lax.scan(lambda s, b: step(s, *b), init(), (preds, target))
+        got = np.asarray(compute(state))
+        assert got.shape == (2,) and values.shape == (3, 2)
+
+        eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True)
+        for i, (p, t) in enumerate(zip(preds, target)):
+            batch_vals = eager(p, t)  # forward: batch-local per-output values
+            np.testing.assert_allclose(np.asarray(values)[i], np.asarray(batch_vals).reshape(-1), atol=1e-5)
+        np.testing.assert_allclose(got, np.asarray(eager.compute()), atol=1e-6)
+        assert not np.isnan(got).any()
+
+    def test_multioutput_remove_nans_max_state_base(self):
+        """max-reduced states mask to their -inf identity, not zero."""
+        from metrics_tpu import MaxMetric
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        vals = np.asarray([[1.0, 10.0], [np.nan, 50.0], [3.0, np.nan], [2.0, 20.0]], np.float32)
+        wrapper = MultioutputWrapper(MaxMetric(), num_outputs=2, remove_nans=True)
+        init, step, compute = make_step(wrapper)
+        state, _ = step(init(), jnp.asarray(vals))
+        np.testing.assert_allclose(np.asarray(compute(state)), [3.0, 50.0])
+
+    def test_multioutput_remove_nans_unsupported_base_rejected(self):
+        from metrics_tpu import SpearmanCorrCoef
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        wrapper = MultioutputWrapper(SpearmanCorrCoef(sample_capacity=64), num_outputs=2)
+        with pytest.raises(ValueError, match="sum/max/min"):
+            make_step(wrapper)
+
+    def test_multioutput_remove_nans_mesh_parity(self):
+        """NaN-masked multioutput step syncs over the mesh like the eager
+        wrapper on the global (unsharded) data."""
+        from metrics_tpu import MeanSquaredError
+        from metrics_tpu.wrappers import MultioutputWrapper
+
+        rng = np.random.default_rng(34)
+        preds = rng.normal(size=(64, 2)).astype(np.float32)
+        target = rng.normal(size=(64, 2)).astype(np.float32)
+        preds[[5, 40], 0] = np.nan
+        target[[13, 62], 1] = np.nan
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+
+        init, step, compute = make_step(
+            MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True), axis_name="dp"
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        out = jax.jit(
+            jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds, target)
+        eager = MultioutputWrapper(MeanSquaredError(), num_outputs=2, remove_nans=True)
+        eager.update(preds, target)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(eager.compute()), atol=1e-6)
 
     def test_wrapper_steps_mesh_parity(self):
         """All three wrappers sync correctly over the 8-device mesh."""

@@ -152,3 +152,34 @@ def test_exact_threshold_crossing(monkeypatch):
     for key in res_native:
         np.testing.assert_array_equal(res_native[key], res_numpy[key], err_msg=key)
     assert res_native["map"] == pytest.approx(1.0, abs=1e-6)
+
+
+def test_unsorted_rec_thresholds_prefix_truncation():
+    """Reference semantics (mean_ap.py:729-731): precision fills stop at the
+    FIRST past-the-end recall threshold — with a non-ascending custom list an
+    in-range threshold appearing after it scores 0 too, not its envelope
+    precision. 10 gts / 5 perfect dets -> max recall 0.5; threshold 0.9 is
+    unreachable and precedes 0.2, so BOTH rows zero and mAP is exactly 0."""
+    rng = np.random.default_rng(7)
+    boxes = np.concatenate(
+        [rng.uniform(0, 400, (10, 2)).astype(np.float32), np.full((10, 2), 25.0, np.float32)],
+        axis=1,
+    )
+    boxes[:, 2:] += boxes[:, :2]
+    preds = [
+        dict(
+            boxes=boxes[:5],
+            scores=np.linspace(0.9, 0.5, 5).astype(np.float32),
+            labels=np.zeros(5, np.int32),
+        )
+    ]
+    tgts = [dict(boxes=boxes, labels=np.zeros(10, np.int32))]
+
+    m = MeanAveragePrecision(rec_thresholds=[0.9, 0.2])
+    m.update(preds, tgts)
+    assert float(m.compute()["map"]) == pytest.approx(0.0, abs=1e-9)
+
+    # ascending equivalent: 0.2 is reachable when it comes first
+    m2 = MeanAveragePrecision(rec_thresholds=[0.2, 0.9])
+    m2.update(preds, tgts)
+    assert float(m2.compute()["map"]) == pytest.approx(0.5, abs=1e-6)
