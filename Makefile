@@ -1,7 +1,7 @@
 # Developer entry points (the reference drives test/docs the same way,
 # /root/reference/Makefile).
 
-.PHONY: test docs doctest api clean-docs
+.PHONY: test docs doctest api clean-docs parity-weights
 
 test:
 	python -m pytest tests/ -q
@@ -21,3 +21,8 @@ docs:
 
 clean-docs:
 	rm -rf docs/_build
+
+# published-value parity battery; needs converted checkpoints discoverable
+# (convert --install or $METRICS_TPU_WEIGHTS_DIR) — see docs/parity.md
+parity-weights:
+	python -m pytest tests/image/test_pretrained_parity.py tests/audio/test_pesq.py -v -rs

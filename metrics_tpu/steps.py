@@ -71,6 +71,18 @@ def _fresh_copy(state: State) -> State:
     return state
 
 
+def _is_array(a: Any) -> bool:
+    return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")
+
+
+def _split_update_leaves(args: tuple, kwargs: dict, dim: int):
+    """Flatten (args, kwargs) into vmap leaves with per-leaf output axes."""
+    keys = sorted(kwargs)
+    leaves = list(args) + [kwargs[k] for k in keys]
+    axes = tuple(dim if _is_array(a) else None for a in leaves)
+    return keys, len(args), leaves, axes
+
+
 def _stack_state(one: State, n: int) -> State:
     """Broadcast every leaf of a fresh state to a leading replicate axis."""
     return {name: jnp.broadcast_to(v[None], (n,) + jnp.shape(v)) for name, v in one.items()}
@@ -444,7 +456,9 @@ def _make_multioutput_step(
     the same metrics: all states sum/max/min-reducible.
     """
     if wrapper.remove_nans:
-        if not _is_mergeable(wrapper.metrics[0]):
+        # a nested wrapper base has NO states of its own (empty _defaults),
+        # which would make the mergeability check vacuously true
+        if not wrapper.metrics[0]._defaults or not _is_mergeable(wrapper.metrics[0]):
             raise ValueError(
                 "MultioutputWrapper(remove_nans=True) as a step needs every base-metric state to be"
                 " sum/max/min-reducible (NaN rows are masked to the reduction identity and"
@@ -468,14 +482,8 @@ def _make_multioutput_step(
     def init() -> State:
         return _stack_state(base_init(), n_out)  # broadcast_to: fresh unaliased buffers
 
-    def _is_array(a: Any) -> bool:
-        return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")
-
     def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
-        keys = sorted(kwargs)
-        n_pos = len(args)
-        leaves = list(args) + [kwargs[k] for k in keys]
-        axes = tuple(dim if _is_array(a) else None for a in leaves)
+        keys, n_pos, leaves, axes = _split_update_leaves(args, kwargs, dim)
 
         def one(s, *flat):
             flat = [jnp.expand_dims(a, dim) if (_is_array(a) and not squeeze) else a for a in flat]
@@ -523,14 +531,8 @@ def _make_multioutput_nanmask_step(
     def init() -> State:
         return _stack_state(base_init(), n_out)
 
-    def _is_array(a: Any) -> bool:
-        return isinstance(a, (jnp.ndarray, jax.Array)) or hasattr(a, "__jax_array__")
-
     def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
-        keys = sorted(kwargs)
-        n_pos = len(args)
-        leaves = list(args) + [kwargs[k] for k in keys]
-        axes = tuple(dim if _is_array(a) else None for a in leaves)
+        keys, n_pos, leaves, axes = _split_update_leaves(args, kwargs, dim)
 
         def one(s, *flat):
             flat = [jnp.expand_dims(a, dim) if (_is_array(a) and not squeeze) else a for a in flat]

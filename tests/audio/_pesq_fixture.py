@@ -1,0 +1,77 @@
+"""Deterministic PESQ golden-fixture corpus.
+
+PESQ is defined by the ITU-T P.862 C implementation (the reference wraps it
+too — reference ``functional/audio/pesq.py:75-101``), and that library is
+not installable in the build environment. These helpers make the oracle gap
+one command wide instead of permanent:
+
+* :func:`make_corpus` regenerates an identical degraded-speech test corpus
+  from seeds on any machine (nothing but tiny metadata is stored).
+* ``python -m tests.audio.generate_pesq_goldens`` — run on ANY machine with
+  ``pip install pesq`` — scores the corpus with the real library and writes
+  ``tests/audio/pesq_goldens.json``.
+* ``tests/audio/test_pesq.py::TestPesqGoldens`` then pins the wrapper
+  against those recorded scores: end-to-end when ``pesq`` is present,
+  through a replay backend (recorded real scores, keyed by signal digest)
+  when it is not.
+"""
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+GOLDEN_PATH = Path(__file__).resolve().parent / "pesq_goldens.json"
+
+# (case id, fs, mode, seed, SNR dB or None for an exact copy)
+CASES: List[Tuple[str, int, str, int, object]] = [
+    ("nb_clean_copy", 8000, "nb", 10, None),
+    ("nb_snr20", 8000, "nb", 11, 20.0),
+    ("nb_snr5", 8000, "nb", 12, 5.0),
+    ("wb_clean_copy", 16000, "wb", 13, None),
+    ("wb_snr20", 16000, "wb", 14, 20.0),
+    ("wb_snr0", 16000, "wb", 15, 0.0),
+]
+
+
+def _voiced_signal(rng: np.random.Generator, fs: int, seconds: float = 2.0) -> np.ndarray:
+    """Speech-like reference: F0-modulated harmonic stack with a syllabic
+    amplitude envelope (white noise alone sits at the PESQ floor and would
+    make every golden score degenerate)."""
+    t = np.arange(int(fs * seconds)) / fs
+    f0 = 120.0 + 30.0 * np.sin(2 * np.pi * 2.3 * t) + 10.0 * rng.normal()
+    phase = 2 * np.pi * np.cumsum(f0) / fs
+    sig = sum((0.6 / k) * np.sin(k * phase + rng.uniform(0, 2 * np.pi)) for k in range(1, 6))
+    envelope = 0.25 + 0.75 * np.clip(np.sin(2 * np.pi * 3.1 * t + rng.uniform(0, 2 * np.pi)), 0, None)
+    return (sig * envelope * 0.3).astype(np.float32)
+
+
+def make_corpus() -> Dict[str, Dict]:
+    """Regenerate the full (reference, degraded) corpus from CASES."""
+    corpus = {}
+    for case_id, fs, mode, seed, snr_db in CASES:
+        rng = np.random.default_rng(seed)
+        ref = _voiced_signal(rng, fs)
+        if snr_db is None:
+            deg = ref.copy()
+        else:
+            noise = rng.normal(0, 1, ref.shape).astype(np.float32)
+            noise *= np.linalg.norm(ref) / (np.linalg.norm(noise) * 10 ** (float(snr_db) / 20))
+            deg = (ref + noise).astype(np.float32)
+        corpus[case_id] = {"fs": fs, "mode": mode, "ref": ref, "deg": deg}
+    return corpus
+
+
+def signal_digest(ref: np.ndarray, deg: np.ndarray) -> str:
+    """Stable key for replaying a recorded score against exact signals."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(ref, dtype=np.float32).tobytes())
+    h.update(np.ascontiguousarray(deg, dtype=np.float32).tobytes())
+    return h.hexdigest()[:24]
+
+
+def load_goldens() -> Dict[str, Dict]:
+    if not GOLDEN_PATH.exists():
+        return {}
+    return json.loads(GOLDEN_PATH.read_text())

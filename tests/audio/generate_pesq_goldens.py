@@ -1,0 +1,38 @@
+"""Generate the PESQ golden fixture with the REAL ITU-T P.862 library.
+
+Run on any machine with the ``pesq`` package (the build environment cannot
+install it):
+
+    pip install pesq
+    python -m tests.audio.generate_pesq_goldens
+
+and commit the resulting ``tests/audio/pesq_goldens.json``. Only metadata
+and scores are stored; the signals regenerate deterministically from seeds
+(``tests/audio/_pesq_fixture.py``), so the fixture stays a few hundred
+bytes. ``tests/audio/test_pesq.py::TestPesqGoldens`` picks the file up
+automatically.
+"""
+import json
+
+from tests.audio._pesq_fixture import GOLDEN_PATH, make_corpus, signal_digest
+
+
+def main() -> None:
+    import pesq as pesq_backend  # hard requirement: goldens must be REAL scores
+
+    goldens = {}
+    for case_id, case in make_corpus().items():
+        score = float(pesq_backend.pesq(case["fs"], case["ref"], case["deg"], case["mode"]))
+        goldens[case_id] = {
+            "fs": case["fs"],
+            "mode": case["mode"],
+            "digest": signal_digest(case["ref"], case["deg"]),
+            "score": score,
+        }
+        print(f"{case_id}: {score:.4f}")
+    GOLDEN_PATH.write_text(json.dumps(goldens, indent=1, sort_keys=True) + "\n")
+    print(f"wrote {GOLDEN_PATH}")
+
+
+if __name__ == "__main__":
+    main()

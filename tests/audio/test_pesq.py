@@ -116,3 +116,60 @@ class TestPesqRealBackend:
         out = perceptual_evaluation_speech_quality(jnp.asarray(p), jnp.asarray(t), fs, mode)
         expected = [pesq_backend.pesq(fs, tt, pp, mode) for tt, pp in zip(t, p)]
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4)
+
+
+class TestPesqGoldens:
+    """Pin the wrapper against REAL recorded P.862 scores.
+
+    ``tests/audio/pesq_goldens.json`` is produced once by
+    ``python -m tests.audio.generate_pesq_goldens`` on any pesq-equipped
+    machine (see ``tests/audio/_pesq_fixture.py``). With the library
+    present the pin is end-to-end; without it, a replay backend feeds the
+    recorded real scores through the full wrapper path (keyed by signal
+    digest, so any corpus drift fails loudly instead of silently passing).
+    """
+
+    def _cases(self):
+        from tests.audio._pesq_fixture import load_goldens, make_corpus, signal_digest
+
+        goldens = load_goldens()
+        if not goldens:
+            pytest.skip(
+                "no PESQ golden fixture committed yet — run "
+                "`python -m tests.audio.generate_pesq_goldens` on a pesq-equipped machine"
+            )
+        corpus = make_corpus()
+        for case_id, golden in goldens.items():
+            case = corpus[case_id]
+            assert golden["digest"] == signal_digest(case["ref"], case["deg"]), (
+                f"{case_id}: regenerated corpus no longer matches the recorded fixture;"
+                " regenerate pesq_goldens.json"
+            )
+            yield case_id, case, golden
+
+    def test_wrapper_matches_recorded_scores(self, monkeypatch):
+        if not _PESQ_INSTALLED:
+            from tests.audio._pesq_fixture import load_goldens, signal_digest
+
+            recorded = {g["digest"]: g["score"] for g in load_goldens().values()} if load_goldens() else {}
+
+            def replay(fs, ref, deg, mode):
+                return recorded[signal_digest(np.asarray(ref), np.asarray(deg))]
+
+            fake = types.ModuleType("pesq")
+            fake.pesq = replay
+            monkeypatch.setitem(sys.modules, "pesq", fake)
+            monkeypatch.setattr(pesq_fn_mod, "_PESQ_AVAILABLE", True)
+        for case_id, case, golden in self._cases():
+            out = perceptual_evaluation_speech_quality(
+                jnp.asarray(case["deg"]), jnp.asarray(case["ref"]), case["fs"], case["mode"]
+            )
+            np.testing.assert_allclose(float(out), golden["score"], rtol=1e-4, err_msg=case_id)
+
+    def test_golden_scores_are_sane(self):
+        """Recorded MOS-LQO values must sit in P.862 range and order by SNR."""
+        goldens = {cid: g for cid, _, g in self._cases()}
+        for cid, g in goldens.items():
+            assert 0.5 <= g["score"] <= 5.0, (cid, g["score"])
+        assert goldens["nb_clean_copy"]["score"] > goldens["nb_snr20"]["score"] > goldens["nb_snr5"]["score"]
+        assert goldens["wb_clean_copy"]["score"] > goldens["wb_snr20"]["score"] > goldens["wb_snr0"]["score"]
