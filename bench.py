@@ -424,16 +424,119 @@ def base_bertscore() -> float:
         return _min_ms(run, n_trials=2)
 
 
-def _best_prior_values() -> dict:
-    """Best (lowest) prior-round value per metric, from BENCH_r*.json tails.
+def bench_probes() -> dict:
+    """Chip-state calibration probes, one per op class.
 
-    Used by the regression gate: each fresh measurement is compared against
-    the best any prior round recorded for the same metric name.
+    The tunneled chip's performance state flips BETWEEN processes as well as
+    within a session, and round-4/5 data shows it is per-op-class: one
+    session ran sorts ~1.9x slow while matmuls sat at historical bests.
+    These three fixed microkernels — a 1M-element sort, a bf16 matmul
+    chain, a 1M x 10 elementwise reduce — are emitted as ordinary rows, so
+    every BENCH_r*.json records the session's state per class and the
+    regression gate can compare row regressions against the probe's own
+    slowdown instead of blaming the code.
     """
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks._timing import measure_ms_scaled
+
+    x = jax.random.uniform(jax.random.PRNGKey(7), (N_SAMPLES,), dtype=jnp.float32)
+    a = jax.random.normal(jax.random.PRNGKey(8), (1024, 1024), dtype=jnp.bfloat16) * 0.03
+    e = jax.random.uniform(jax.random.PRNGKey(9), (N_SAMPLES, 10), dtype=jnp.bfloat16)
+
+    def make_sort(k):
+        @jax.jit
+        def run():
+            def body(i, acc):
+                return acc + jnp.sort(x * (1.0 + 1e-6 * i))[0]
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
+
+    def make_matmul(k):
+        @jax.jit
+        def run():
+            def body(i, y):
+                y = jnp.matmul(y, a)  # bf16 MXU chain
+                return y / (jnp.abs(y).max() + 1e-6)
+
+            return jnp.sum(jax.lax.fori_loop(0, k, body, a).astype(jnp.float32))
+        return run
+
+    def make_elementwise(k):
+        @jax.jit
+        def run():
+            def body(i, acc):
+                return acc + jnp.sum((e * (1.0 + 1e-3 * i.astype(jnp.bfloat16)))).astype(jnp.float32)
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
+
+    # tunnel RTT: one device round trip (put + tiny add + sync), DIRECT
+    # samples — the RTT phase swings 20us-90ms and dominates any row that
+    # pays one synchronous round trip per call (e.g. the host-side WER row)
+    import numpy as np
+
+    from benchmarks._timing import cluster_direct_samples
+
+    z = jnp.zeros(())
+    float(z + 1.0)  # warm
+    rtt = []
+    for i in range(10):
+        t0 = time.perf_counter()
+        float(jax.device_put(np.float32(i)) + z)
+        rtt.append((time.perf_counter() - t0) * 1000)
+
+    w = jax.random.normal(jax.random.PRNGKey(10), (64, 64, 3, 3), dtype=jnp.bfloat16) * 0.05
+    c_in = jax.random.normal(jax.random.PRNGKey(11), (16, 64, 32, 32), dtype=jnp.bfloat16)
+
+    def make_conv(k):
+        @jax.jit
+        def run():
+            def body(i, y):
+                y = jax.lax.conv_general_dilated(
+                    y, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")
+                )
+                return y / (jnp.abs(y).max() + 1e-6)
+
+            return jnp.sum(jax.lax.fori_loop(0, k, body, c_in).astype(jnp.float32))
+        return run
+
+    return {
+        "probe_tunnel_rtt": cluster_direct_samples(rtt),
+        "probe_sort_1M": measure_ms_scaled(make_sort, 8),
+        "probe_matmul_1024_bf16": measure_ms_scaled(make_matmul, 1024),
+        "probe_conv_64ch_3x3": measure_ms_scaled(make_conv, 256),
+        "probe_elementwise_1Mx10": measure_ms_scaled(make_elementwise, 512),
+    }
+
+
+# which probe calibrates which row, matched by the row's actual dominant op
+# class: big dense matmuls -> matmul probe; dense conv towers -> conv probe;
+# separable-depthwise SSIM is bandwidth/VPU-bound -> elementwise probe;
+# host-side rows have no probe (raw comparison with the confound note)
+_PROBE_CLASS = {
+    "auroc_exact_1M_compute": "probe_sort_1M",
+    "retrieval_map_1M_docs_compute": "probe_sort_1M",
+    "retrieval_ndcg_1M_docs_compute": "probe_sort_1M",
+    "fid_10k_2048d_compute": "probe_matmul_1024_bf16",
+    "bertscore_match_256x128x256": "probe_matmul_1024_bf16",
+    "lpips_alex_32x64x64_forward": "probe_conv_64ch_3x3",
+    "ssim_64x3x256x256_compute": "probe_elementwise_1Mx10",
+    "accuracy_1M_update_compute_wallclock": "probe_elementwise_1Mx10",
+    "binned_counts_1M_T100_update": "probe_elementwise_1Mx10",
+    "collection_statscores_binary_1M_update": "probe_elementwise_1Mx10",
+    "collection_statscores_multiclass_1M_update": "probe_elementwise_1Mx10",
+}
+
+
+def _prior_rounds() -> list:
+    """Per-file {metric: value} dicts from BENCH_r*.json tails, in order."""
     import glob
     import os
 
-    best: dict = {}
+    rounds = []
     here = os.path.dirname(os.path.abspath(__file__))
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
@@ -441,6 +544,7 @@ def _best_prior_values() -> dict:
                 tail = json.load(f).get("tail", "")
         except (OSError, ValueError):
             continue
+        rows: dict = {}
         for line in tail.splitlines():
             line = line.strip()
             if not line.startswith("{"):
@@ -451,7 +555,37 @@ def _best_prior_values() -> dict:
                 continue
             name, value = row.get("metric"), row.get("value")
             if isinstance(value, (int, float)) and value > 0:
-                best[name] = min(best.get(name, float("inf")), float(value))
+                rows[name] = min(rows.get(name, float("inf")), float(value))
+        if rows:
+            rounds.append(rows)
+    return rounds
+
+
+def _best_prior_values() -> dict:
+    """Best (lowest) prior-round value per metric, across BENCH_r*.json."""
+    best: dict = {}
+    for rows in _prior_rounds():
+        for name, value in rows.items():
+            best[name] = min(best.get(name, float("inf")), value)
+    return best
+
+
+def _best_prior_normalized() -> dict:
+    """Best prior row-to-class-probe RATIO per metric.
+
+    The chip's per-op-class performance state flips between sessions, so
+    raw round-over-round value comparison confounds code changes with chip
+    state. The row/probe ratio is state-invariant (row and probe scale
+    together by construction), so the gate prefers it whenever a prior
+    round recorded the probes (r5+); earlier rounds fall back to raw
+    comparison with the confound note.
+    """
+    best: dict = {}
+    for rows in _prior_rounds():
+        for name, probe in _PROBE_CLASS.items():
+            if name in rows and rows.get(probe, 0) > 0:
+                ratio = rows[name] / rows[probe]
+                best[name] = min(best.get(name, float("inf")), ratio)
     return best
 
 
@@ -476,6 +610,9 @@ def main() -> None:
         file=sys.stderr,
     )
     prior = _best_prior_values()
+    prior_norm = _best_prior_normalized()
+    emitted_rows: list = []
+    session_probe_values: dict = {}
 
     def emit(name: str, ours_ms: float, base_ms: float, baseline: str = "torch_cpu_eager") -> None:
         # print each row as soon as it exists: a timeout mid-run must not
@@ -485,27 +622,64 @@ def main() -> None:
         if not math.isfinite(ours_ms) or ours_ms <= 0:
             print(f"SKIPPED {name}: measurement invalid (dispatch noise > workload)", file=sys.stderr)
             return
-        print(
-            json.dumps(
-                {
-                    "metric": name,
-                    "value": round(ours_ms, 3),
-                    "unit": "ms",
-                    "vs_baseline": round(base_ms / ours_ms, 3),
-                    "baseline": baseline,
-                }
-            ),
-            flush=True,
-        )
+        row = {
+            "metric": name,
+            "value": round(ours_ms, 3),
+            "unit": "ms",
+            "vs_baseline": round(base_ms / ours_ms, 3),
+            "baseline": baseline,
+        }
+        # bimodal-chip protocol (benchmarks/_timing.py): the value IS the
+        # fast-mode median; both mode medians + sample counts ride along so
+        # rounds stay comparable regardless of which state the sweep hit
+        if hasattr(ours_ms, "n_fast"):
+            row["fast_mode_median"] = round(ours_ms.fast_mode_median, 3)
+            row["slow_mode_median"] = (
+                None if ours_ms.slow_mode_median is None else round(ours_ms.slow_mode_median, 3)
+            )
+            row["n_fast"] = ours_ms.n_fast
+            row["n_slow"] = ours_ms.n_slow
+        line = json.dumps(row)
+        print(line, flush=True)
+        emitted_rows.append(line)
+        if name.startswith("probe_"):
+            return  # probes RECORD session state; gating them is meaningless
         best = prior.get(name)
-        if best is not None and ours_ms > 1.5 * best:
+        if best is None:
+            return
+        # state-invariant gate: compare the row/class-probe RATIO against
+        # the best prior ratio whenever a probe-bearing round exists — the
+        # chip's per-op-class state cancels out of the ratio. Rounds
+        # predating the probes can only be compared raw (confounded).
+        probe = _PROBE_CLASS.get(name)
+        probe_now = session_probe_values.get(probe)
+        norm_best = prior_norm.get(name)
+        if probe_now and norm_best is not None:
+            ratio = float(ours_ms) / probe_now
+            if ratio > 1.5 * norm_best:
+                print(
+                    f"REGRESSION {name}: row/probe ratio {ratio:.1f} vs best prior"
+                    f" {norm_best:.1f} ({ratio / norm_best:.2f}x) — state-invariant"
+                    " comparison, this is NOT chip-mode noise.",
+                    file=sys.stderr,
+                )
+            return
+        if ours_ms > 1.5 * best:
             print(
-                f"REGRESSION {name}: {ours_ms:.3f} ms vs best prior round {best:.3f} ms"
-                f" ({ours_ms / best:.2f}x). Known confound: the tunneled chip exhibits a"
-                " bimodal ~1.9x performance state (benchmarks/RESULTS.md, round-4 note) —"
-                " re-measure in a fresh session before blaming the code.",
+                f"REGRESSION {name}: fast-mode {float(ours_ms):.3f} ms vs best prior round"
+                f" {best:.3f} ms ({float(ours_ms) / best:.2f}x). No probe-bearing prior"
+                " round exists for a state-invariant comparison; the per-class probe rows"
+                " in THIS sweep record the session state (benchmarks/_timing.py).",
                 file=sys.stderr,
             )
+
+    # chip-state probes first: they calibrate the gate for every later row
+    probes = bench_probes()
+    for pname, pval in probes.items():
+        if math.isfinite(pval) and pval > 0:
+            session_probe_values[pname] = float(pval)
+            pbest = prior.get(pname)
+            emit(pname, pval, pbest if pbest is not None else float(pval), baseline="best_prior_probe")
 
     curves = bench_curves.measure()
     emit("auroc_exact_1M_compute", curves["auroc_exact_1M_compute"], base_auroc())
@@ -575,6 +749,15 @@ def main() -> None:
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", bench_accuracy_tpu(), base_accuracy())
+
+    # repeat the full compact table as the FINAL stdout block, headline row
+    # still last: the driver's BENCH_r*.json tail capture truncates early
+    # output, so this guarantees every row survives into the record
+    # (VERDICT r4 weak #6). Rows are identical JSON to the incremental
+    # prints; duplicate lines are harmless to the prior-round min scan.
+    print("=== full row table (headline last) ===")
+    for line in emitted_rows:
+        print(line, flush=True)
 
 
 if __name__ == "__main__":

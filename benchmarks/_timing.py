@@ -1,20 +1,32 @@
-"""Shared timing for benchmarks: in-jit repetition + paired-K differencing.
+"""Shared timing for benchmarks: in-jit repetition + paired-K differencing
++ bimodal-mode clustering.
 
 Tunneled TPU setups add a host<->device round-trip per dispatch whose
 latency swings between ~20 us and ~90 ms phases (sometimes seconds). Every
 benchmark repeats its workload K times inside one jit and again at 2K; the
 estimator INTERLEAVES the K and 2K trials and differences each adjacent
 pair, so both sides of every difference see the same RTT phase and the
-dispatch cost cancels per pair. The estimate is the MEDIAN of the positive
-pair differences that pass a consistency gate (min-selection over noisy
-differences is biased low — it would flatter vs_baseline ratios); if no
-consistent pair cluster exists (phase noise exceeded the workload
+dispatch cost cancels per pair.
+
+On top of the RTT noise, the chip itself has a BIMODAL ~1.9x performance
+state that flips between processes AND within a session (measured round 4,
+benchmarks/RESULTS.md) — slower but otherwise healthy execution, which no
+amount of pair differencing removes. A single published number is therefore
+whichever mode the sweep happened to hit, and round-over-round comparisons
+were confounded. The fix: keep every per-pair sample, CLUSTER the samples
+at the largest consecutive gap (modes are ~1.9x apart; a 1.35x split
+threshold separates them while absorbing ordinary jitter), and publish
+``{fast_mode_median, slow_mode_median, n_fast, n_slow}``. The headline
+value is the FAST-mode median — the chip's actual capability — and the
+regression gate compares fast mode against fast mode.
+
+If no consistent sample cluster exists (phase noise exceeded the workload
 entirely), the measurement is NaN rather than a fabricated number, and
 ``measure_ms_scaled`` doubles K until the workload swamps the noise.
 """
 import math
 import time
-from typing import Callable
+from typing import Callable, List, Optional
 
 import jax
 
@@ -23,57 +35,121 @@ from metrics_tpu.utilities.compile_cache import enable_persistent_cache
 enable_persistent_cache()
 
 
+class ModalMs(float):
+    """A per-repeat milliseconds estimate that carries its mode statistics.
+
+    The float value IS the fast-mode median, so existing consumers keep
+    working; ``slow_mode_median`` is None when every sample landed in one
+    mode.
+    """
+
+    fast_mode_median: float
+    slow_mode_median: Optional[float]
+    n_fast: int
+    n_slow: int
+
+    def __new__(cls, fast: float, slow: Optional[float], n_fast: int, n_slow: int) -> "ModalMs":
+        self = super().__new__(cls, fast)
+        self.fast_mode_median = fast
+        self.slow_mode_median = slow
+        self.n_fast = n_fast
+        self.n_slow = n_slow
+        return self
+
+
+def _median(sorted_vals: List[float]) -> float:
+    mid = len(sorted_vals) // 2
+    if len(sorted_vals) % 2:
+        return sorted_vals[mid]
+    return 0.5 * (sorted_vals[mid - 1] + sorted_vals[mid])
+
+
+# modes sit ~1.9x apart; a split threshold halfway (geometrically) between
+# ordinary jitter and the mode ratio separates them reliably
+_MODE_SPLIT_RATIO = 1.35
+# samples beyond this band around the MEDIAN are mid-pair phase flips /
+# dispatch stalls / differencing undershoots, not a mode (the mode ratio is
+# ~1.9, comfortably inside the band whichever mode holds the median)
+_OUTLIER_RATIO = 2.5
+
+
+def _cluster_modes(samples: List[float]) -> Optional[ModalMs]:
+    """Split per-pair samples into the two known chip modes.
+
+    Returns None (-> caller escalates K) when the samples cannot support a
+    trustworthy estimate. A LONE low sample is rejected rather than
+    published: pair differencing occasionally undershoots (a phase flip
+    mid-pair), and min-selection over that noise is biased low — a real
+    fast mode shows at least two agreeing samples.
+    """
+    if not samples:
+        return None
+    s = sorted(samples)
+    # anchor at the SMALLEST sample that has a partner agreeing within the
+    # mode-split ratio: a lone minimum is differencing undershoot, but two
+    # agreeing small samples are real — and anchoring there (not at the
+    # median) keeps a true fast mode even when slow-phase samples are the
+    # majority. Samples beyond the outlier band of the anchor are dispatch
+    # stalls (the real slow mode at ~1.9x sits inside the band).
+    anchor = next((s[i] for i in range(len(s) - 1) if s[i + 1] <= _MODE_SPLIT_RATIO * s[i]), None)
+    if anchor is None:
+        return None  # no two samples agree: nothing trustworthy to publish
+    s = [d for d in s if anchor <= d <= anchor * _OUTLIER_RATIO]
+    while len(s) >= 2:
+        if s[-1] <= _MODE_SPLIT_RATIO * s[0]:
+            return ModalMs(_median(s), None, len(s), 0)
+        cut = max(range(1, len(s)), key=lambda i: s[i] / s[i - 1])
+        if cut == 1:
+            if len(s) == 2:
+                return None  # two disagreeing samples decide nothing
+            s = s[1:]  # lone low sample: differencing undershoot, drop
+            continue
+        return ModalMs(_median(s[:cut]), _median(s[cut:]), cut, len(s) - cut)
+    return None
+
+
 def measure_ms(
     run: Callable[[], jax.Array],
     k_repeats: int,
-    n_timing: int = 8,
+    n_timing: int = 10,
     run_double: Callable[[], jax.Array] = None,
 ) -> float:
     """Wall-clock ms per repeat: interleaved ``(T(2K) - T(K)) / K`` pairs.
 
-    ``run`` executes the workload K times inside one jit, ``run_double`` the
-    same workload 2K times. Returns NaN when no pair produced a usable
-    difference (dispatch-phase noise larger than the whole workload).
+    Returns a :class:`ModalMs` (fast-mode median + mode stats) or NaN when
+    no pair produced a usable difference (dispatch-phase noise larger than
+    the whole workload).
     """
     if run_double is None:
         raise TypeError("measure_ms requires run_double (the 2K-repeat thunk)")
     float(run())  # warmup + compile
     float(run_double())
-    diffs = []
+    samples = []
     for _ in range(n_timing):
         t0 = time.perf_counter()
         float(run())
         t1 = time.perf_counter()
         float(run_double())
         t2 = time.perf_counter()
-        diffs.append((t2 - t1) - (t1 - t0))
-    usable = sorted(d for d in diffs if d > 0)
-    # consistency gate: trust the estimate only when the two smallest
-    # positive pairs agree within 2x — random noise differences are
-    # continuous and almost never produce two small near-equal positives,
-    # while genuine workload differences cluster tightly
-    if len(usable) < 2 or usable[1] > 2.0 * usable[0]:
-        return math.nan
-    # median of the gated cluster (pairs within 2x of the smallest), not the
-    # raw min: min-selection over noisy differences is biased low
-    cluster = [d for d in usable if d <= 2.0 * usable[0]]
-    mid = len(cluster) // 2
-    median = cluster[mid] if len(cluster) % 2 else 0.5 * (cluster[mid - 1] + cluster[mid])
-    return median / k_repeats * 1000.0
+        diff = (t2 - t1) - (t1 - t0)
+        if diff > 0:
+            samples.append(diff / k_repeats * 1000.0)
+    out = _cluster_modes(samples)
+    return math.nan if out is None else out
 
 
 def measure_ms_scaled(
     make_run: Callable[[int], Callable[[], jax.Array]],
     k_repeats: int,
-    n_timing: int = 8,
+    n_timing: int = 10,
     max_doublings: int = 3,
 ) -> float:
     """``measure_ms`` with automatic K escalation.
 
-    ``make_run(k)`` builds the K-repeat thunk. When the consistency gate
-    rejects a measurement (RTT phase noise bigger than the whole K-repeat
-    workload), K doubles — growing the workload until it swamps the noise —
-    up to ``max_doublings`` times before conceding NaN.
+    ``make_run(k)`` builds the K-repeat thunk. When clustering fails (RTT
+    phase noise bigger than the whole K-repeat workload), K doubles —
+    growing the workload until it swamps the noise — up to
+    ``max_doublings`` times before conceding NaN.
     """
     k = k_repeats
     for _ in range(max_doublings + 1):
@@ -82,3 +158,19 @@ def measure_ms_scaled(
             return ms
         k *= 2
     return math.nan
+
+
+def cluster_direct_samples(samples: List[float]) -> Optional[ModalMs]:
+    """Mode stats for DIRECT wall-clock samples (no pair differencing).
+
+    A completed wall-clock measurement cannot undershoot — the work
+    physically finished — so unlike :func:`_cluster_modes` the fast cluster
+    anchors at the MINIMUM: samples within the outlier band of it are the
+    fast phase (e.g. fast tunnel-RTT calls), the rest the slow phase.
+    """
+    if not samples:
+        return None
+    s = sorted(samples)
+    fast = [d for d in s if d <= _OUTLIER_RATIO * s[0]]
+    slow = [d for d in s if d > _OUTLIER_RATIO * s[0]]
+    return ModalMs(_median(fast), _median(slow) if slow else None, len(fast), len(slow))

@@ -104,11 +104,16 @@ def measure_wer(n_pairs: int = 10_000) -> float:
     preds, targets = wer_corpus(n_pairs)
     word_error_rate(preds, targets)  # warm (compiles the .so on first use)
     times = []
-    for _ in range(3):
+    for _ in range(8):
         t0 = time.perf_counter()
         float(word_error_rate(preds, targets))  # float(): sync the device scalar
-        times.append(time.perf_counter() - t0)
-    return min(times) * 1000
+        times.append((time.perf_counter() - t0) * 1000)
+    # the call is ONE host-compute pass + one tunnel round trip; the RTT
+    # phase swings 20us-90ms, so cluster direct samples instead of praying
+    # the 3-trial min hit a fast phase (benchmarks/_timing.py)
+    from benchmarks._timing import cluster_direct_samples
+
+    return cluster_direct_samples(times)
 
 
 def measure() -> dict:

@@ -15,12 +15,9 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.image.helper import (
     _avg_pool,
-    _depthwise_conv,
-    _gaussian_kernel_2d,
-    _gaussian_kernel_3d,
+    _gaussian,
     _reflection_pad,
-    _uniform_kernel_2d,
-    _uniform_kernel_3d,
+    _separable_depthwise_conv,
 )
 from metrics_tpu.utilities.checks import _check_same_shape
 from metrics_tpu.utilities.distributed import reduce
@@ -93,7 +90,6 @@ def _ssim_compute(
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
-    channel = preds.shape[1]
     dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
     preds = preds.astype(dtype)
     target = target.astype(dtype)
@@ -105,16 +101,16 @@ def _ssim_compute(
     preds = _reflection_pad(preds, pads)
     target = _reflection_pad(target, pads)
 
+    # separable window: gaussian/uniform factor exactly into 1D kernels,
+    # one depthwise pass per spatial dim (sum-of-taps cost, not product)
     if gaussian_kernel:
-        make = _gaussian_kernel_3d if is_3d else _gaussian_kernel_2d
-        kernel = make(channel, gauss_kernel_size, sigma, dtype)
+        kernels_1d = [_gaussian(k, s, dtype) for k, s in zip(gauss_kernel_size, sigma)]
     else:
-        make_u = _uniform_kernel_3d if is_3d else _uniform_kernel_2d
-        kernel = make_u(channel, kernel_size, dtype)
+        kernels_1d = [jnp.ones((1, k), dtype) / k for k in kernel_size]
 
     # one conv over the 5 stacked moment inputs: mu_p, mu_t, E[p^2], E[t^2], E[pt]
     input_list = jnp.concatenate([preds, target, preds * preds, target * target, preds * target])
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]
     mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
 

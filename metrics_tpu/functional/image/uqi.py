@@ -16,7 +16,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflection_pad
+from metrics_tpu.functional.image.helper import _gaussian, _reflection_pad, _separable_depthwise_conv
 from metrics_tpu.utilities.checks import _check_same_shape
 from metrics_tpu.utilities.distributed import reduce
 
@@ -58,18 +58,17 @@ def _uqi_compute(
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
-    channel = preds.shape[1]
     dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
     preds = preds.astype(dtype)
     target = target.astype(dtype)
-    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    kernels_1d = [_gaussian(k, s, dtype) for k, s in zip(kernel_size, sigma)]
     pads = [(k - 1) // 2 for k in kernel_size]
 
     preds_p = _reflection_pad(preds, pads)
     target_p = _reflection_pad(target, pads)
 
     input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _separable_depthwise_conv(input_list, kernels_1d)
     b = preds.shape[0]
     mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
 
