@@ -167,10 +167,19 @@ def cluster_direct_samples(samples: List[float]) -> Optional[ModalMs]:
     physically finished — so unlike :func:`_cluster_modes` the fast cluster
     anchors at the MINIMUM: samples within the outlier band of it are the
     fast phase (e.g. fast tunnel-RTT calls), the rest the slow phase.
+
+    Anchoring still needs agreement: with the 20us-90ms RTT swing, ONE lucky
+    fast-phase call out of 8-10 is not a mode, and publishing it would make
+    direct rows (WER, probe_tunnel_rtt) round-over-round noisy in exactly
+    the way this protocol exists to avoid. The minimum only anchors the
+    fast cluster when a second sample agrees within the mode-split ratio;
+    otherwise the overall median is published (single mode, no split).
     """
     if not samples:
         return None
     s = sorted(samples)
-    fast = [d for d in s if d <= _OUTLIER_RATIO * s[0]]
-    slow = [d for d in s if d > _OUTLIER_RATIO * s[0]]
-    return ModalMs(_median(fast), _median(slow) if slow else None, len(fast), len(slow))
+    if len(s) >= 2 and s[1] <= _MODE_SPLIT_RATIO * s[0]:
+        fast = [d for d in s if d <= _OUTLIER_RATIO * s[0]]
+        slow = [d for d in s if d > _OUTLIER_RATIO * s[0]]
+        return ModalMs(_median(fast), _median(slow) if slow else None, len(fast), len(slow))
+    return ModalMs(_median(s), None, len(s), 0)

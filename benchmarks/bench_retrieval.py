@@ -14,6 +14,7 @@ from benchmarks._timing import measure_ms_scaled
 from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
 
 N_QUERIES, DOCS, K = 10_000, 100, 10
+TOP_K, K_TOPK = 10, 40  # @k row is ~4x faster; K scales to keep ~40 ms trials
 N = N_QUERIES * DOCS
 
 
@@ -40,6 +41,26 @@ def measure() -> dict:
             return run
 
         out[f"{name}_1M_docs_compute"] = measure_ms_scaled(make_run, K)
+
+    # MAP@k=10 over the same 1M docs: the segment-local top-k path — one
+    # per-query lax.top_k over the dense (Q, D) view plus (Q, k) row math,
+    # no full multi-operand sort (see functional/retrieval/_segment.py)
+    metric10 = RetrievalMAP(k=TOP_K)
+    metric10.update(preds, target, indexes=indexes)
+    p, t = metric10.preds[0], metric10.target[0]
+    topk_kernel = jax.jit(
+        lambda p, t, m=metric10: _compute_topk_once(m, p, t, (N_QUERIES, DOCS))
+    )
+
+    def make_run_topk(k, p=p, t=t, kern=topk_kernel):
+        @jax.jit
+        def run(p=p, t=t):
+            def body(j, acc):
+                return acc + kern(p * (1.0 + 0.0001 * j), t)
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+        return run
+
+    out["retrieval_map_k10_1M_docs_compute"] = measure_ms_scaled(make_run_topk, K_TOPK)
     return out
 
 
@@ -56,6 +77,15 @@ def _compute_once(metric, preds, target, indexes):
     valid = metric._valid_groups(ctx)
     keep = ctx.nonempty & valid
     return jnp.where(keep, scores, 0.0).sum() / jnp.maximum(keep.sum(), 1)
+
+
+def _compute_topk_once(metric, preds, target, shape):
+    from metrics_tpu.functional.retrieval._segment import make_topk_context
+
+    tctx = make_topk_context(preds, target, shape, metric.k)
+    scores = metric._metric_topk(tctx)
+    valid = metric._valid_groups_topk(tctx)
+    return jnp.where(valid, scores, 0.0).sum() / jnp.maximum(valid.sum(), 1)
 
 
 if __name__ == "__main__":

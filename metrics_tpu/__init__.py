@@ -14,6 +14,10 @@ _logger.setLevel(logging.INFO)
 
 __version__ = "0.3.0"
 
+from metrics_tpu.utilities.compat import install_jax_compat  # noqa: E402
+
+install_jax_compat()
+
 from metrics_tpu import functional  # noqa: E402, F401
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402, F401
 from metrics_tpu.classification import (  # noqa: E402, F401
@@ -108,7 +112,7 @@ from metrics_tpu.text import (  # noqa: E402, F401
     WordInfoLost,
     WordInfoPreserved,
 )
-from metrics_tpu.steps import make_step  # noqa: E402, F401
+from metrics_tpu.steps import make_epoch, make_step  # noqa: E402, F401
 from metrics_tpu.utilities.debug import debug_checks  # noqa: E402, F401
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
@@ -174,6 +178,7 @@ __all__ = [
     "MetricCollection",
     "MetricTracker",
     "MinMaxMetric",
+    "make_epoch",
     "make_step",
     "debug_checks",
     "MultioutputWrapper",

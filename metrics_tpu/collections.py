@@ -176,7 +176,7 @@ class MetricCollection(dict):
         # metric would otherwise pay the host transfer independently
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with foreign_coercion_scope():  # member forwards must not re-walk
+        with foreign_coercion_scope(args, kwargs):  # member forwards must not re-walk these
             res = {
                 k: m(*args, **m._filter_kwargs(**kwargs))
                 for k, m in self.items(keep_base=True, copy_state=False)
@@ -191,7 +191,7 @@ class MetricCollection(dict):
         """Update each underlying metric once per compute group."""
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with foreign_coercion_scope():  # member updates must not re-walk
+        with foreign_coercion_scope(args, kwargs):  # member updates must not re-walk these
             self._update_members(*args, **kwargs)
 
     def _update_members(self, *args: Any, **kwargs: Any) -> None:

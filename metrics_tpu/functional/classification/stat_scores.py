@@ -125,8 +125,12 @@ def _stat_scores_update(
         # per sample, a correct argmax gives (tp=1, tn=C-1) and an incorrect
         # one (fp=1, fn=1, tn=C-2), so four sums collapse to one compare.
         # Only taken with validate_args=False (skips the gate's value checks).
+        # The compare runs through the ops/argmax_compare streaming tile on
+        # TPU (classes stay lane-resident; no argmax relayout pass).
+        from metrics_tpu.ops.argmax_compare import argmax_correct_count
+
         n, c = preds.shape
-        correct = jnp.sum(jnp.argmax(preds, axis=1) == target).astype(jnp.int32)
+        correct = argmax_correct_count(preds, target)
         n_arr = jnp.asarray(n, dtype=jnp.int32)
         return correct, n_arr - correct, n_arr * (c - 2) + correct, n_arr - correct
 

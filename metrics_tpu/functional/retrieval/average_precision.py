@@ -1,19 +1,28 @@
 """Functional IR average precision.
 
 Behavioral equivalent of reference
-``torchmetrics/functional/retrieval/average_precision.py:20``.
+``torchmetrics/functional/retrieval/average_precision.py:20``; ``top_k``
+follows the reference's later cutoff semantics (precision summed over the
+first ``k`` ranks, normalized by ``min(npos, k)``).
 """
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.retrieval._segment import average_precision_scores, make_group_context
+from metrics_tpu.functional.retrieval._segment import (
+    average_precision_scores,
+    average_precision_scores_topk,
+    make_group_context,
+    make_topk_context,
+)
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
 
 Array = jax.Array
 
 
-def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """Average precision of a single query's ranked documents.
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Average precision of a single query's ranked documents, optionally @k.
 
     Example:
         >>> import jax.numpy as jnp
@@ -24,5 +33,12 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
         Array(0.8333334, dtype=float32)
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    if top_k is not None and top_k < preds.shape[0]:
+        # single-query dense top-k fast path: one lax.top_k instead of the
+        # full sort (bitwise-equal; see _segment.py)
+        tctx = make_topk_context(preds, target, (1, preds.shape[0]), top_k)
+        return average_precision_scores_topk(tctx, k=top_k)[0].astype(preds.dtype)
     ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
-    return average_precision_scores(ctx)[0].astype(preds.dtype)
+    return average_precision_scores(ctx, k=top_k)[0].astype(preds.dtype)

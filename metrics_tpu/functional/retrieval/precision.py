@@ -8,7 +8,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.retrieval._segment import make_group_context, precision_scores
+from metrics_tpu.functional.retrieval._segment import (
+    make_group_context,
+    make_topk_context,
+    precision_scores,
+    precision_scores_topk,
+)
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
 
 Array = jax.Array
@@ -30,5 +35,10 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
         raise ValueError("`adaptive_k` has to be a boolean")
     if k is not None and not (isinstance(k, int) and k > 0):
         raise ValueError("`k` has to be a positive integer or None")
+    if k is not None and k < preds.shape[0]:
+        # single-query dense top-k fast path: one lax.top_k instead of the
+        # full sort (bitwise-equal; see _segment.py)
+        tctx = make_topk_context(preds, target, (1, preds.shape[0]), k)
+        return precision_scores_topk(tctx, k=k, adaptive_k=adaptive_k)[0].astype(preds.dtype)
     ctx = make_group_context(preds, target, jnp.zeros(preds.shape, dtype=jnp.int32))
     return precision_scores(ctx, k=k, adaptive_k=adaptive_k)[0].astype(preds.dtype)

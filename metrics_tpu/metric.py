@@ -207,7 +207,7 @@ class Metric(ABC):
         # would pay the host transfer twice
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with foreign_coercion_scope():  # updates below must not re-walk
+        with foreign_coercion_scope(args, kwargs):  # updates below must not re-walk these
             if self.full_state_update:
                 return self._forward_full_state_update(*args, **kwargs)
             return self._forward_reduce_state_update(*args, **kwargs)

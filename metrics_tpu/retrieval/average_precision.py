@@ -1,17 +1,29 @@
 """RetrievalMAP metric class.
 
-Behavioral equivalent of reference ``torchmetrics/retrieval/average_precision.py:22``.
+Behavioral equivalent of reference ``torchmetrics/retrieval/average_precision.py:22``,
+plus the MAP@k cutoff of the reference's later ``top_k`` argument (precision
+summed over the first ``k`` ranks, normalized by ``min(npos, k)``).
 """
+from typing import Any, Optional
+
 import jax
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, average_precision_scores
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    average_precision_scores,
+    average_precision_scores_topk,
+)
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision over queries.
+    """Mean average precision over queries, optionally @k.
+
+    Args:
+        k: consider only the top ``k`` documents per query (default: all).
 
     Example:
         >>> import jax.numpy as jnp
@@ -24,5 +36,27 @@ class RetrievalMAP(RetrievalMetric):
         Array(0.7916667, dtype=float32)
     """
 
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        *,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        # k is keyword-only: this class's third POSITIONAL argument has
+        # historically been the base's sample_capacity, and silently
+        # reinterpreting it as k would change existing callers' semantics
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
-        return average_precision_scores(ctx)
+        return average_precision_scores(ctx, k=self.k)
+
+    def _topk_k(self) -> Optional[int]:
+        return self.k
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        return average_precision_scores_topk(tctx, k=self.k)

@@ -13,7 +13,13 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, make_group_context
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    dense_group_shape,
+    make_group_context,
+    make_topk_context,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.buffers import _cat_state_default
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
@@ -90,6 +96,17 @@ class RetrievalMetric(Metric, ABC):
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
 
+        # segment-local top-k fast path: an @k metric over a dense regular
+        # layout selects its k documents with one per-query lax.top_k
+        # instead of the full multi-operand sort (bitwise-equal; pinned by
+        # tests/retrieval/test_k_grid.py). Ragged layouts, k >= docs and
+        # full-rank metrics fall through to the sorted pipeline.
+        k = self._topk_k()
+        if k is not None:
+            shape = dense_group_shape(indexes)
+            if shape is not None and k < shape[1]:
+                return self._compute_topk(preds, target, shape, k)
+
         ctx = make_group_context(preds, target, indexes)
         scores = self._metric_vectorized(ctx)
         valid = self._valid_groups(ctx)
@@ -119,3 +136,40 @@ class RetrievalMetric(Metric, ABC):
     @abstractmethod
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
         """Dense (num_segments,) per-group scores."""
+
+    # ------------------------------------------------------------------
+    # Dense top-k fast path (see functional/retrieval/_segment.py)
+    # ------------------------------------------------------------------
+
+    def _topk_k(self) -> Optional[int]:
+        """The metric's top-k cutoff, or None when it reads every rank (the
+        @k subclasses return their ``k``)."""
+        return None
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        """Per-query scores on the dense top-k view; subclasses returning a
+        non-None :meth:`_topk_k` must implement this."""
+        raise NotImplementedError
+
+    def _valid_groups_topk(self, tctx: TopKContext) -> Array:
+        return tctx.npos > 0
+
+    def _compute_topk(self, preds: Array, target: Array, shape, k: int) -> Array:
+        tctx = make_topk_context(preds, target, shape, k)
+        scores = self._metric_topk(tctx)
+        valid = self._valid_groups_topk(tctx)
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(~valid)):
+                raise ValueError(f"`compute` method was provided with a query with no {self._required_kind} target.")
+
+        if self.empty_target_action == "skip":
+            keep = valid
+        else:
+            fill = 1.0 if self.empty_target_action == "pos" else 0.0
+            scores = jnp.where(valid, scores, fill)
+            keep = jnp.ones_like(valid)
+
+        n_keep = keep.sum().astype(jnp.float32)
+        total = jnp.where(keep, scores, 0.0).sum()
+        return jnp.where(n_keep > 0, total / jnp.maximum(n_keep, 1.0), 0.0).astype(preds.dtype)

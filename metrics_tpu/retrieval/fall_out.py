@@ -6,7 +6,12 @@ from typing import Any, Optional
 
 import jax
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, fall_out_scores
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    fall_out_scores,
+    fall_out_scores_topk,
+)
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -51,3 +56,12 @@ class RetrievalFallOut(RetrievalMetric):
 
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
         return fall_out_scores(ctx, k=self.k)
+
+    def _topk_k(self) -> Optional[int]:
+        return self.k
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        return fall_out_scores_topk(tctx)
+
+    def _valid_groups_topk(self, tctx: TopKContext) -> Array:
+        return (tctx.count.astype(tctx.npos.dtype) - tctx.npos) > 0

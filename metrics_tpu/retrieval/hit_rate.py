@@ -6,7 +6,12 @@ from typing import Any, Optional
 
 import jax
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, hit_rate_scores
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    hit_rate_scores,
+    hit_rate_scores_topk,
+)
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -40,3 +45,9 @@ class RetrievalHitRate(RetrievalMetric):
 
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
         return hit_rate_scores(ctx, k=self.k)
+
+    def _topk_k(self) -> Optional[int]:
+        return self.k
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        return hit_rate_scores_topk(tctx)

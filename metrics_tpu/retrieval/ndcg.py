@@ -6,7 +6,12 @@ from typing import Any, Optional
 
 import jax
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, ndcg_scores
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    ndcg_scores,
+    ndcg_scores_topk,
+)
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -48,3 +53,14 @@ class RetrievalNormalizedDCG(RetrievalMetric):
 
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
         return ndcg_scores(ctx, k=self.k)
+
+    def _topk_k(self) -> Optional[int]:
+        return self.k
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        return ndcg_scores_topk(tctx)
+
+    def _valid_groups_topk(self, tctx: TopKContext) -> Array:
+        # float targets allowed: "no positive" means the target sum is zero
+        total = tctx.target2d.astype(tctx.npos.dtype).sum(axis=1)
+        return total != 0

@@ -6,7 +6,12 @@ from typing import Any, Optional
 
 import jax
 
-from metrics_tpu.functional.retrieval._segment import GroupContext, precision_scores
+from metrics_tpu.functional.retrieval._segment import (
+    GroupContext,
+    TopKContext,
+    precision_scores,
+    precision_scores_topk,
+)
 from metrics_tpu.retrieval.base import RetrievalMetric
 
 Array = jax.Array
@@ -48,3 +53,9 @@ class RetrievalPrecision(RetrievalMetric):
 
     def _metric_vectorized(self, ctx: GroupContext) -> Array:
         return precision_scores(ctx, k=self.k, adaptive_k=self.adaptive_k)
+
+    def _topk_k(self) -> Optional[int]:
+        return self.k
+
+    def _metric_topk(self, tctx: TopKContext) -> Array:
+        return precision_scores_topk(tctx, k=self.k, adaptive_k=self.adaptive_k)

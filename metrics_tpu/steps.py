@@ -58,7 +58,7 @@ def _is_mergeable(metric: Metric) -> bool:
         for r, d in zip(metric._reductions.values(), metric._defaults.values())
     )
 
-__all__ = ["make_step"]
+__all__ = ["make_epoch", "make_step"]
 
 
 def _fresh_copy(state: State) -> State:
@@ -265,6 +265,159 @@ def make_step(
         return out
 
     return init, step, compute
+
+
+# fold a stacked (B, *state) leaf down its leading axis with the state's own
+# declared reduction — the epoch-axis analogue of _MERGE_OPS
+_FOLD_OPS: Dict[str, Callable] = {
+    "sum": lambda m: m.sum(axis=0),
+    "max": lambda m: m.max(axis=0),
+    "min": lambda m: m.min(axis=0),
+}
+
+
+def make_epoch(
+    metric: Union[Metric, Type[Metric], "MetricCollection"],  # noqa: F821
+    *init_args: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    with_values: bool = False,
+    jit_epoch: bool = True,
+    **init_kwargs: Any,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Build ``(init, epoch, compute)``: a WHOLE epoch of batches per launch.
+
+    ``epoch(state, *batches, **kw_batches)`` folds every batch of an epoch
+    into the carry inside ONE compiled program: array inputs carry a leading
+    epoch axis (``(num_batches, batch_size, ...)``), and the per-batch
+    ``step`` of :func:`make_step` is rolled into the program instead of being
+    dispatched once per batch — an eager loop of 16 ``step`` calls becomes
+    one launch, which is where small-batch epochs lose most of their time on
+    dispatch-latency-bound (tunneled) devices.
+
+    How the batches are rolled depends on the metric's states:
+
+    * **merge-combinable states** (every state sum/max/min-reducible — the
+      same property the DDP gather-reduce sync relies on): the whole epoch
+      collapses to ONE update over the flattened ``(num_batches *
+      batch_size, ...)`` inputs, merged into the carry. XLA sees a single
+      full-width kernel — no sequential per-batch chain at all. With
+      ``with_values=True`` the per-batch contributions are instead computed
+      under one ``jax.vmap`` (still one launch) so each batch's local value
+      exists.
+    * **anything else** ``make_step`` supports (running-moment states,
+      wrappers, collections): a ``jax.lax.scan`` of the step over the epoch
+      axis — one launch, sequential inner kernels.
+
+    Args:
+        metric: as :func:`make_step` (class, instance, or collection).
+        axis_name: as :func:`make_step`; ``compute`` reduces over the mesh
+            axis. Call ``epoch`` inside the same ``shard_map`` program.
+        with_values: when True, ``epoch`` also returns the stacked per-batch
+            metric values (``(num_batches, ...)``) — the scanned analogue of
+            ``step``'s batch-local value; when False (default) it returns
+            ``(state', None)`` and skips that work.
+        jit_epoch: wrap ``epoch`` in ``jax.jit`` with the carry donated
+            (default). Pass False when composing it inside an outer jit /
+            ``shard_map`` yourself.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.steps import make_epoch
+        >>> init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+        >>> preds = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]])  # 2 batches
+        >>> target = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]])
+        >>> state, _ = epoch(init(), preds, target)  # ONE launch
+        >>> compute(state)
+        Array(0.75, dtype=float32)
+    """
+    from metrics_tpu.wrappers.abstract import WrapperMetric
+
+    # construct a class argument ONCE and hand the instance to make_step
+    # (which clones it), so ctor work is not duplicated
+    if isinstance(metric, type) and issubclass(metric, Metric):
+        metric = metric(*init_args, **init_kwargs)
+        init_args, init_kwargs = (), {}
+
+    mergeable = False
+    reductions: Dict[str, str] = {}
+    if isinstance(metric, Metric) and not isinstance(metric, WrapperMetric):
+        mergeable = _is_mergeable(metric)
+        reductions = dict(metric._reductions)
+
+    init, step, compute = make_step(
+        metric, *init_args, axis_name=axis_name, with_value=with_values, **init_kwargs
+    )
+
+    def _split(batches: tuple, kw_batches: dict):
+        keys = sorted(kw_batches)
+        leaves = list(batches) + [kw_batches[k] for k in keys]
+        return keys, len(batches), leaves
+
+    def _rebuild(keys, n_pos, leaves):
+        return tuple(leaves[:n_pos]), dict(zip(keys, leaves[n_pos:]))
+
+    def _epoch_scan(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
+        keys, n_pos, leaves = _split(batches, kw_batches)
+        scanned_idx = [i for i, a in enumerate(leaves) if _is_array(a)]
+        static = {i: a for i, a in enumerate(leaves) if i not in scanned_idx}
+
+        def body(s, xs):
+            merged = [static[i] if i in static else xs[scanned_idx.index(i)] for i in range(len(leaves))]
+            args_b, kwargs_b = _rebuild(keys, n_pos, merged)
+            s2, value = step(s, *args_b, **kwargs_b)
+            return s2, (value if with_values else None)
+
+        return jax.lax.scan(body, state, tuple(leaves[i] for i in scanned_idx))
+
+    def _epoch_vmap(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
+        # mergeable + per-batch values: every batch's contribution state is
+        # accumulated from the default under one vmap, folded down the epoch
+        # axis with its own declared reduction, and merged into the carry —
+        # parallel inner kernels instead of a sequential scan chain
+        keys, n_pos, leaves = _split(batches, kw_batches)
+        axes = tuple(0 if _is_array(a) else None for a in leaves)
+
+        def contrib(*flat):
+            args_b, kwargs_b = _rebuild(keys, n_pos, list(flat))
+            return step(init(), *args_b, **kwargs_b)
+
+        batch_states, values = jax.vmap(contrib, in_axes=axes)(*leaves)
+        new_state = {
+            name: _MERGE_OPS[reductions[name]](state[name], _FOLD_OPS[reductions[name]](rows))
+            for name, rows in batch_states.items()
+        }
+        return new_state, (values if with_values else None)
+
+    def _epoch_flat(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
+        # mergeable, no values: ONE update over the flattened epoch. Valid by
+        # the same invariant the DDP gather-reduce sync relies on — merging
+        # per-batch (per-rank) updates equals one update over their
+        # concatenation when every state folds with sum/max/min.
+        keys, n_pos, leaves = _split(batches, kw_batches)
+        flat = [
+            a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]) if _is_array(a) else a
+            for a in leaves
+        ]
+        args_b, kwargs_b = _rebuild(keys, n_pos, flat)
+        new_state, _ = step(state, *args_b, **kwargs_b)
+        return new_state, None
+
+    def epoch(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
+        if not mergeable:
+            return _epoch_scan(state, *batches, **kw_batches)
+        if with_values:
+            return _epoch_vmap(state, *batches, **kw_batches)
+        _, _, leaves = _split(batches, kw_batches)
+        if all(getattr(a, "ndim", 0) >= 2 for a in leaves if _is_array(a)):
+            return _epoch_flat(state, *batches, **kw_batches)
+        # an array leaf with only the epoch axis (per-batch scalars, e.g.
+        # MeanMetric weights) has no sample axis to flatten into
+        return _epoch_vmap(state, *batches, **kw_batches)
+
+    if jit_epoch:
+        epoch = jax.jit(epoch, donate_argnums=0)
+    return init, epoch, compute
 
 
 def _make_bootstrap_step(

@@ -1,0 +1,29 @@
+"""Version compatibility for the supported JAX range.
+
+The package targets current JAX, where ``shard_map`` is a top-level
+``jax.shard_map``; on older installs (<= 0.4.x) the same function lives at
+``jax.experimental.shard_map.shard_map`` with a matching keyword signature.
+Every public example, benchmark and test in this repo addresses the stable
+spelling, so on old installs we alias it once at import — a no-op wherever
+``jax.shard_map`` already exists.
+"""
+import jax
+
+__all__ = ["install_jax_compat"]
+
+
+def install_jax_compat() -> None:
+    """Backfill ``jax.shard_map`` / ``lax.pcast`` / ``lax.pvary`` on older
+    JAX releases (idempotent)."""
+    if not hasattr(jax, "shard_map"):
+        try:
+            from jax.experimental.shard_map import shard_map
+        except ImportError:  # pragma: no cover - very old jax; nothing to do
+            return
+        jax.shard_map = shard_map
+    # releases predating the varying-manual-axes type system have no
+    # replicated/varying distinction, so the casts are identities there
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = lambda x, axis_name=None, *, to=None: x
+    if not hasattr(jax.lax, "pvary"):
+        jax.lax.pvary = lambda x, axis_name=None: x

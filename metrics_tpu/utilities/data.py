@@ -101,20 +101,26 @@ def apply_to_collection(
     dtype: Union[type, tuple],
     function: Callable,
     *args: Any,
+    prune: Optional[Callable[[Any], bool]] = None,
     **kwargs: Any,
 ) -> Any:
     """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
 
-    Mirrors reference ``utilities/data.py:146``.
+    Mirrors reference ``utilities/data.py:146``. A ``prune`` predicate stops
+    the walk at any node it accepts (the node is returned unchanged).
     """
+    if prune is not None and prune(data):
+        return data
     if isinstance(data, dtype):
         return function(data, *args, **kwargs)
     if isinstance(data, Mapping):
-        return type(data)({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+        return type(data)(
+            {k: apply_to_collection(v, dtype, function, *args, prune=prune, **kwargs) for k, v in data.items()}
+        )
     if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
-        return type(data)(*(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data))
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, prune=prune, **kwargs) for d in data))
     if isinstance(data, (list, tuple)):
-        return type(data)(apply_to_collection(d, dtype, function, *args, **kwargs) for d in data)
+        return type(data)(apply_to_collection(d, dtype, function, *args, prune=prune, **kwargs) for d in data)
     return data
 
 
@@ -122,21 +128,42 @@ _COERCION_SCOPE = threading.local()
 
 
 @contextmanager
-def foreign_coercion_scope():
-    """Mark a region whose inputs were already coerced.
+def foreign_coercion_scope(*coerced: Any):
+    """Mark containers whose elements were ALREADY coerced.
 
     ``MetricCollection.forward`` → ``Metric.forward`` → ``update`` each
-    coerce defensively (each is a public entry point); wrapping the inner
-    calls in this scope makes the nested :func:`coerce_foreign_tensors`
-    no-ops, so one call walks the (possibly deeply nested) input collection
-    exactly once.
+    coerce defensively (each is a public entry point); registering the
+    already-converted containers here lets the nested
+    :func:`coerce_foreign_tensors` calls prune their walk at exactly those
+    objects, so one call converts the (possibly deeply nested) input
+    collection exactly once.
+
+    Suppression is scoped to the IDENTITY of the registered elements — not
+    the whole thread — so a composite metric whose ``update`` builds fresh
+    torch tensors and feeds them to a nested metric still gets those
+    converted (they are new objects, never registered).
     """
-    depth = getattr(_COERCION_SCOPE, "depth", 0)
-    _COERCION_SCOPE.depth = depth + 1
+    ids = getattr(_COERCION_SCOPE, "ids", None)
+    if ids is None:
+        ids = _COERCION_SCOPE.ids = {}
+    added = []
+    for container in coerced:
+        if isinstance(container, Mapping):
+            items = container.values()
+        elif isinstance(container, (list, tuple)):
+            items = container
+        else:
+            items = (container,)
+        for item in items:
+            key = id(item)
+            if key not in ids:
+                ids[key] = item  # strong ref pins the id for the scope's life
+                added.append(key)
     try:
         yield
     finally:
-        _COERCION_SCOPE.depth = depth
+        for key in added:
+            del ids[key]
 
 
 def coerce_foreign_tensors(data: Any) -> Any:
@@ -148,10 +175,10 @@ def coerce_foreign_tensors(data: Any) -> Any:
     Conversion goes through numpy on host (zero-copy for CPU tensors except
     bfloat16, which numpy cannot represent — that round-trips via float32
     and re-casts to ``jnp.bfloat16``). No-op when torch was never imported
-    by the process; jax/numpy inputs pass through untouched.
+    by the process; jax/numpy inputs pass through untouched. Objects
+    registered by an enclosing :func:`foreign_coercion_scope` (already
+    coerced once) prune the walk.
     """
-    if getattr(_COERCION_SCOPE, "depth", 0):
-        return data  # an enclosing foreign_coercion_scope already converted
     torch = sys.modules.get("torch")  # cheap gate: no torch, no torch tensors
     if torch is None or not hasattr(torch, "Tensor"):
         # None is the standard sys.modules placeholder for "import blocked"
@@ -167,7 +194,11 @@ def coerce_foreign_tensors(data: Any) -> Any:
             return jnp.asarray(t.to(torch.float32).numpy()).astype(jnp.bfloat16)
         return jnp.asarray(t.numpy())
 
-    return apply_to_collection(data, torch.Tensor, _convert)
+    ids = getattr(_COERCION_SCOPE, "ids", None)
+    if not ids:
+        return apply_to_collection(data, torch.Tensor, _convert)
+    # prune at objects an enclosing scope already coerced (torch-free subtrees)
+    return apply_to_collection(data, torch.Tensor, _convert, prune=lambda d: id(d) in ids)
 
 
 def get_group_indexes(indexes: Array) -> List[Array]:

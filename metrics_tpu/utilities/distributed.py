@@ -64,6 +64,14 @@ def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str 
 # ---------------------------------------------------------------------------
 
 
+def _axis_size(axis_name: Union[str, Tuple[str, ...]]) -> int:
+    """Static mesh-axis size; ``lax.psum(1)`` on jax releases predating
+    ``lax.axis_size`` (folded to a constant under SPMD, not a collective)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def sync_reduce_in_context(
     x: Array,
     reduce_fx: Union[str, Callable, None],
@@ -125,7 +133,7 @@ def _all_gather_replicated(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> 
     ``n_dev x`` payload. Prefer ``typed="varying"`` + :func:`replicate_typed`
     on the final value for large states.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     padded = jnp.zeros((n,) + x.shape, x.dtype).at[idx].set(x)
     return lax.psum(padded, axis_name)
@@ -168,7 +176,7 @@ def ring_allreduce(x: Array, axis_name: str, op: Callable[[Array, Array], Array]
             (Commutativity matters: hop ``k`` folds neighbour ``(i - k) %% n``,
             so contributions arrive in a different order on each device.)
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(_, carry):
@@ -225,7 +233,7 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typ
     """
     from metrics_tpu.utilities.buffers import CapacityBuffer
 
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     cap = buf.capacity
     merged = CapacityBuffer(n * cap, buf.dtype)
     if buf.data is None:  # SPMD symmetry: no device appended anything

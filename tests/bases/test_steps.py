@@ -719,3 +719,149 @@ class TestWrapperSteps:
         assert set(out2) == set(want2)
         for k in want2:
             np.testing.assert_allclose(float(out2[k]), float(want2[k]), atol=1e-6)
+
+
+class TestEpochFusion:
+    """make_epoch: a whole epoch of batches folded in ONE compiled program
+    equals N sequential update() calls (ISSUE 1 tentpole)."""
+
+    def _epoch_data(self, seed=0, batches=6, size=32):
+        rng = np.random.default_rng(seed)
+        preds = jnp.asarray(rng.normal(size=(batches, size, NUM_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, (batches, size)))
+        return preds, target
+
+    def test_epoch_matches_sequential_updates_accuracy(self):
+        from metrics_tpu import make_epoch
+
+        preds, target = self._epoch_data()
+        init, epoch, compute = make_epoch(Accuracy, num_classes=NUM_CLASSES)
+        state, values = epoch(init(), preds, target)
+        assert values is None  # with_values defaults off
+
+        eager = Accuracy(num_classes=NUM_CLASSES)
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-6)
+
+    def test_epoch_matches_sequential_updates_stat_scores(self):
+        from metrics_tpu import StatScores, make_epoch
+
+        preds, target = self._epoch_data(seed=1)
+        init, epoch, compute = make_epoch(StatScores, reduce="micro", num_classes=NUM_CLASSES)
+        state, _ = epoch(init(), preds, target)
+
+        eager = StatScores(reduce="micro", num_classes=NUM_CLASSES)
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        np.testing.assert_array_equal(np.asarray(compute(state)), np.asarray(eager.compute()))
+
+    def test_epoch_with_values_matches_per_batch_forward(self):
+        from metrics_tpu import make_epoch
+
+        preds, target = self._epoch_data(seed=2)
+        init, epoch, compute = make_epoch(Accuracy, num_classes=NUM_CLASSES, with_values=True)
+        state, values = epoch(init(), preds, target)
+        assert values.shape[0] == preds.shape[0]
+
+        eager = Accuracy(num_classes=NUM_CLASSES)
+        for b, (p, t) in enumerate(zip(preds, target)):
+            batch_value = eager(p, t)  # forward: batch-local value
+            np.testing.assert_allclose(float(values[b]), float(batch_value), atol=1e-6)
+        np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-6)
+
+    def test_epoch_sum_moment_metric(self):
+        """Sum-moment states (R2Score) fold through the merge path intact."""
+        from metrics_tpu import make_epoch
+
+        rng = np.random.default_rng(3)
+        preds = jnp.asarray(rng.normal(size=(5, 16)).astype(np.float32))
+        target = preds + jnp.asarray((rng.normal(size=(5, 16)) * 0.1).astype(np.float32))
+        init, epoch, compute = make_epoch(R2Score)
+        state, _ = epoch(init(), preds, target)
+
+        eager = R2Score()
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        np.testing.assert_allclose(float(compute(state)), float(eager.compute()), atol=1e-5)
+
+    def test_epoch_per_batch_scalar_inputs(self):
+        """An array leaf with only the epoch axis (per-batch scalars) cannot
+        flatten; the vmap-merge path handles it."""
+        from metrics_tpu import make_epoch
+
+        init, epoch, compute = make_epoch(MeanMetric)
+        state, _ = epoch(init(), jnp.asarray([1.0, 3.0, 5.0]))
+        np.testing.assert_allclose(float(compute(state)), 3.0, atol=1e-6)
+
+    def test_epoch_collection(self):
+        from metrics_tpu import F1Score, MetricCollection, make_epoch
+
+        preds, target = self._epoch_data(seed=4)
+        coll = MetricCollection(
+            [Accuracy(num_classes=NUM_CLASSES), F1Score(num_classes=NUM_CLASSES, average="macro")]
+        )
+        init, epoch, compute = make_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+        out = compute(state)
+
+        eager = coll.clone()
+        eager.reset()
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+        want = eager.compute()
+        assert set(out) == set(want)
+        for name in out:
+            np.testing.assert_allclose(float(out[name]), float(want[name]), atol=1e-6)
+
+    def test_epoch_under_axis_name(self):
+        """Sharded epochs: per-device epoch folds + mesh-collective compute
+        equals one global eager accumulation."""
+        from metrics_tpu import make_epoch
+
+        n_dev = 8
+        rng = np.random.default_rng(5)
+        preds = jnp.asarray(rng.normal(size=(n_dev, 4, 16, NUM_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, (n_dev, 4, 16)))
+
+        init, epoch, compute = make_epoch(
+            Accuracy, num_classes=NUM_CLASSES, axis_name="dp", jit_epoch=False
+        )
+
+        def prog(p, t):
+            state, _ = epoch(init(), p[0], t[0])
+            return compute(state)
+
+        out = jax.jit(
+            jax.shard_map(prog, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds, target)
+
+        eager = Accuracy(num_classes=NUM_CLASSES)
+        eager.update(preds.reshape(-1, NUM_CLASSES), target.reshape(-1))
+        np.testing.assert_allclose(float(out), float(eager.compute()), atol=1e-6)
+
+    def test_epoch_merge_fold_has_no_scan_chain(self):
+        """The mergeable epoch must lower WITHOUT a sequential scan chain
+        (the flattened single-update formulation — the perf property this
+        round ships); running-moment metrics keep the scan."""
+        from metrics_tpu import make_epoch
+
+        def prims(jaxpr, acc):
+            for eqn in jaxpr.eqns:
+                acc.add(eqn.primitive.name)
+                for p in eqn.params.values():
+                    if hasattr(p, "jaxpr"):
+                        prims(p.jaxpr, acc)
+            return acc
+
+        preds, target = self._epoch_data(seed=6)
+        init, epoch, compute = make_epoch(Accuracy, num_classes=NUM_CLASSES, jit_epoch=False)
+        flat = prims(jax.make_jaxpr(epoch)(init(), preds, target).jaxpr, set())
+        assert "scan" not in flat, "merge-fold epoch reintroduced a sequential scan chain"
+
+        from metrics_tpu import PearsonCorrCoef
+
+        init2, epoch2, _ = make_epoch(PearsonCorrCoef, jit_epoch=False)
+        p = jnp.zeros((3, 8), jnp.float32)
+        scanned = prims(jax.make_jaxpr(epoch2)(init2(), p, p).jaxpr, set())
+        assert "scan" in scanned  # non-mergeable (running-moment) states ride lax.scan

@@ -52,8 +52,47 @@ def test_wer_measure_tiny():
 
     ms = bench_text_image.measure_wer(n_pairs=50)
     assert np.isfinite(ms) and ms > 0
+    # split reporting: the published value is HOST kernel time; the device
+    # round trip rides along as its own field
+    assert hasattr(ms, "tunnel_rtt_ms") and ms.tunnel_rtt_ms >= 0
     preds, targets = bench_text_image.wer_corpus(50)
     assert len(preds) == len(targets) == 50
+
+
+def test_retrieval_topk_bench_kernel_tiny():
+    from benchmarks import bench_retrieval
+
+    saved = (bench_retrieval.N_QUERIES, bench_retrieval.DOCS, bench_retrieval.K, bench_retrieval.K_TOPK)
+    try:
+        bench_retrieval.N_QUERIES, bench_retrieval.DOCS = 20, 30
+        bench_retrieval.K, bench_retrieval.K_TOPK = 2, 2
+        bench_retrieval.N = 20 * 30
+        out = bench_retrieval.measure()
+    finally:
+        (bench_retrieval.N_QUERIES, bench_retrieval.DOCS, bench_retrieval.K, bench_retrieval.K_TOPK) = saved
+        bench_retrieval.N = bench_retrieval.N_QUERIES * bench_retrieval.DOCS
+    assert "retrieval_map_k10_1M_docs_compute" in out
+    assert all(np.isfinite(v) and v > 0 for v in out.values())
+
+
+def test_cluster_direct_samples_protocol():
+    """Direct-sample clustering: a lone fast sample must NOT anchor the
+    published median (ADVICE round-5 low #3); two agreeing fast samples do."""
+    from benchmarks._timing import cluster_direct_samples
+
+    # lone minimum, rest 10x slower: publish the overall median, no split
+    lone = cluster_direct_samples([10.0, 100.0, 101.0, 102.0, 103.0])
+    assert lone.slow_mode_median is None
+    assert lone.fast_mode_median == 101.0  # overall median
+    # two agreeing fast samples: min-anchored fast/slow split as before
+    agreeing = cluster_direct_samples([10.0, 11.0, 100.0, 101.0, 102.0])
+    assert agreeing.fast_mode_median == 10.5
+    assert agreeing.slow_mode_median == 101.0
+    assert (agreeing.n_fast, agreeing.n_slow) == (2, 3)
+    # degenerate inputs
+    assert cluster_direct_samples([]) is None
+    single = cluster_direct_samples([42.0])
+    assert float(single) == 42.0
 
 
 def test_compute_group_savings_tiny():

@@ -1,0 +1,86 @@
+"""Pallas argmax-compare kernel vs the jnp.argmax oracle (interpret mode on
+CPU) and the XLA fallback, pinning the first-max tie and NaN-greatest
+contracts."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops.argmax_compare import (
+    _argmax_correct_pallas,
+    _argmax_correct_xla,
+    argmax_correct_count,
+)
+
+
+def _oracle(preds, target):
+    return int((np.argmax(preds, axis=1) == target).sum())
+
+
+@pytest.mark.parametrize("n,c", [(7, 2), (100, 10), (5000, 10), (2048, 3), (2049, 17)])
+def test_pallas_interpret_matches_oracle(n, c):
+    rng = np.random.default_rng(0)
+    preds = rng.normal(size=(n, c)).astype(np.float32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    got = _argmax_correct_pallas(jnp.asarray(preds), jnp.asarray(target), interpret=True)
+    assert int(got) == _oracle(preds, target)
+
+
+def test_pallas_tie_first_index():
+    # ties take the FIRST max index, exactly like jnp.argmax
+    preds = np.asarray(
+        [[1.0, 1.0, 0.0], [0.5, 0.7, 0.7], [2.0, 2.0, 2.0]], dtype=np.float32
+    )
+    target = np.asarray([0, 1, 2], dtype=np.int32)  # matches: row0 yes, row1 yes, row2 no
+    got = _argmax_correct_pallas(jnp.asarray(preds), jnp.asarray(target), interpret=True)
+    assert int(got) == _oracle(preds, target) == 2
+
+
+def test_pallas_nan_sorts_greatest():
+    preds = np.asarray(
+        [
+            [0.0, np.nan, 5.0],  # argmax -> 1 (first NaN)
+            [np.nan, np.nan, 0.0],  # argmax -> 0
+            [1.0, 0.0, 2.0],  # argmax -> 2
+        ],
+        dtype=np.float32,
+    )
+    target = np.asarray([1, 0, 2], dtype=np.int32)
+    got = _argmax_correct_pallas(jnp.asarray(preds), jnp.asarray(target), interpret=True)
+    assert int(got) == _oracle(preds, target) == 3
+
+
+def test_pallas_bf16_inputs():
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.normal(size=(300, 10)), dtype=jnp.bfloat16)
+    target = jnp.asarray(rng.integers(0, 10, 300).astype(np.int32))
+    got = _argmax_correct_pallas(preds, target, interpret=True)
+    want = int(jnp.sum(jnp.argmax(preds, axis=1) == target))
+    assert int(got) == want
+
+
+def test_xla_and_dispatch():
+    rng = np.random.default_rng(2)
+    preds = rng.normal(size=(999, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 999).astype(np.int32)
+    want = _oracle(preds, target)
+    assert int(_argmax_correct_xla(jnp.asarray(preds), jnp.asarray(target))) == want
+    assert int(argmax_correct_count(jnp.asarray(preds), jnp.asarray(target))) == want
+
+
+def test_stat_scores_fast_path_unchanged():
+    """The micro-multiclass fast path still equals the full formulation."""
+    from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.normal(size=(257, 10)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 10, 257))
+    fast = _stat_scores_update(preds, target, reduce="micro", validate_args=False)
+    slow = _stat_scores_update(preds, target, reduce="micro", validate_args=True)
+    for f, s in zip(fast, slow):
+        assert int(f) == int(s)
+
+
+def test_empty_input_returns_zero():
+    got = argmax_correct_count(jnp.zeros((0, 5)), jnp.zeros((0,), jnp.int32))
+    assert int(got) == 0

@@ -94,6 +94,132 @@ _ALL_METRICS = [
 ]
 
 
+# ---------------------------------------------------------------------------
+# Segment-local top-k fast path vs full-sort fallback
+# ---------------------------------------------------------------------------
+
+_TOPK_METRICS = [
+    RetrievalMAP,
+    RetrievalPrecision,
+    RetrievalRecall,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalNormalizedDCG,
+]
+
+
+def _dense_case(q=20, docs=100, seed=0, graded=False, with_empty=True):
+    """Regular (q, docs) layout with heavy score ties and (optionally) an
+    all-empty-target query."""
+    rng = np.random.default_rng(seed)
+    preds = np.round(rng.uniform(0, 1, q * docs), 1).astype(np.float32)  # ties
+    if graded:
+        target = rng.integers(0, 4, q * docs).astype(np.int32)
+    else:
+        target = (rng.uniform(0, 1, q * docs) > 0.8).astype(np.int32)
+    if with_empty:
+        target[:docs] = 0  # query 0: no positive target
+        target[docs : 2 * docs] = 1  # query 1: no negative target (fall-out-empty)
+    indexes = np.repeat(np.arange(q), docs).astype(np.int32)
+    return jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes)
+
+
+class TestTopKFastPathParity:
+    """The dense lax.top_k path and the full multi-operand sort agree."""
+
+    @pytest.mark.parametrize("metric_class", _TOPK_METRICS)
+    @pytest.mark.parametrize("k", [1, 5, 10, 100, 150])  # 100 == docs; 150 > docs
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_class_path_parity(self, metric_class, k, action):
+        graded = metric_class is RetrievalNormalizedDCG
+        preds, target, indexes = _dense_case(graded=graded)
+        fast = metric_class(k=k, empty_target_action=action)
+        fast.update(preds, target, indexes=indexes)
+        slow = metric_class(k=k, empty_target_action=action)
+        slow.update(preds, target, indexes=indexes)
+        slow._topk_k = lambda: None  # force the full-sort fallback
+        np.testing.assert_allclose(float(fast.compute()), float(slow.compute()), rtol=1e-6, atol=1e-7)
+
+    def test_selected_documents_bitwise_identical(self):
+        """The top-k path selects EXACTLY the documents the stable full sort
+        ranks first — same set, same order, ties broken identically."""
+        from metrics_tpu.functional.retrieval._segment import (
+            make_group_context,
+            make_topk_context,
+        )
+
+        preds, target, indexes = _dense_case(graded=True)
+        q, docs, k = 20, 100, 7
+        ctx = make_group_context(preds, target, indexes)
+        sorted_target = np.asarray(ctx.target).reshape(q, docs)
+        sorted_preds = np.asarray(ctx.preds).reshape(q, docs)
+        tctx = make_topk_context(preds, target, (q, docs), k)
+        np.testing.assert_array_equal(np.asarray(tctx.topk_target), sorted_target[:, :k])
+        np.testing.assert_array_equal(np.asarray(tctx.topk_preds), sorted_preds[:, :k])
+
+    def test_ragged_layout_falls_back(self):
+        """Non-uniform group sizes must bypass the dense path (and agree
+        with the per-query oracle semantics via the full sort)."""
+        from metrics_tpu.functional.retrieval._segment import dense_group_shape
+
+        indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1], dtype=jnp.int32)
+        assert dense_group_shape(indexes) is None
+        preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        target = jnp.asarray([0, 0, 1, 0, 1, 0, 1])
+        m = RetrievalPrecision(k=2)
+        m.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m.compute()), 0.5, atol=1e-6)
+
+    def test_dense_shape_detection(self):
+        from metrics_tpu.functional.retrieval._segment import dense_group_shape
+
+        assert dense_group_shape(jnp.asarray([0, 0, 1, 1, 2, 2], dtype=jnp.int32)) == (3, 2)
+        # nondecreasing with gaps in ids is still dense
+        assert dense_group_shape(jnp.asarray([0, 0, 7, 7], dtype=jnp.int32)) == (2, 2)
+        # out-of-order groups are not
+        assert dense_group_shape(jnp.asarray([1, 1, 0, 0], dtype=jnp.int32)) is None
+        assert dense_group_shape(jnp.asarray([], dtype=jnp.int32)) is None
+
+    def test_error_policy_raises_on_fast_path(self):
+        preds, target, indexes = _dense_case()
+        m = RetrievalPrecision(k=3, empty_target_action="error")
+        m.update(preds, target, indexes=indexes)
+        with pytest.raises(ValueError, match="no positive"):
+            m.compute()
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_map_k_against_numpy_oracle(self, k):
+        """MAP@k semantics pinned independently: precision summed over the
+        first k ranks, normalized by min(npos, k)."""
+        rng = np.random.default_rng(3)
+        q, docs = 8, 12
+        preds = rng.uniform(0, 1, (q, docs)).astype(np.float32)
+        target = (rng.uniform(0, 1, (q, docs)) > 0.6).astype(np.int32)
+
+        def ap_at_k(p, t):
+            order = np.argsort(-p, kind="stable")
+            rel = t[order][:k]
+            if t.sum() == 0:
+                return 0.0
+            hits = np.cumsum(rel)
+            prec = hits / (np.arange(len(rel)) + 1)
+            return float((prec * rel).sum() / min(t.sum(), k))
+
+        want = np.mean([ap_at_k(preds[i], target[i]) for i in range(q)])
+        m = RetrievalMAP(k=k)
+        m.update(
+            jnp.asarray(preds.reshape(-1)),
+            jnp.asarray(target.reshape(-1)),
+            indexes=jnp.asarray(np.repeat(np.arange(q), docs).astype(np.int32)),
+        )
+        np.testing.assert_allclose(float(m.compute()), want, atol=1e-6)
+        # functional form with top_k agrees with the same oracle per query
+        from metrics_tpu.functional import retrieval_average_precision
+
+        got0 = retrieval_average_precision(jnp.asarray(preds[0]), jnp.asarray(target[0]), top_k=k)
+        np.testing.assert_allclose(float(got0), ap_at_k(preds[0], target[0]), atol=1e-6)
+
+
 class TestPolicyGrid:
     @pytest.mark.parametrize("metric_class", _ALL_METRICS)
     @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
@@ -139,3 +265,37 @@ class TestPolicyGrid:
     def test_bad_ignore_index_rejected(self, metric_class):
         with pytest.raises(ValueError, match="ignore_index"):
             metric_class(ignore_index="nope")
+
+
+def test_topk_nan_scores_rank_last_both_paths():
+    """NaN scores bury the document on BOTH paths (the full sort's total
+    order puts NaN last; the top-k path remaps NaN to -inf)."""
+    preds = jnp.asarray([0.9, jnp.nan, 0.1, 0.8, 0.5, 0.4, 0.3, 0.2])
+    target = jnp.asarray([1, 0, 1, 1, 1, 0, 0, 1])
+    indexes = jnp.asarray([0, 0, 0, 0, 1, 1, 1, 1], dtype=jnp.int32)
+    fast = RetrievalRecall(k=2)
+    fast.update(preds, target, indexes=indexes)
+    slow = RetrievalRecall(k=2)
+    slow.update(preds, target, indexes=indexes)
+    slow._topk_k = lambda: None
+    np.testing.assert_allclose(float(fast.compute()), float(slow.compute()), atol=1e-7)
+
+
+def test_topk_pathological_scores_match_full_sort_exactly():
+    """NaN / ±inf / ±0 / tied scores: the top-k path's int-key ranking
+    reproduces the full sort's document selection bitwise."""
+    from metrics_tpu.functional.retrieval._segment import (
+        make_group_context,
+        make_topk_context,
+    )
+
+    preds = jnp.asarray([0.5, jnp.nan, -jnp.inf, 0.9, 0.0, -0.0, jnp.inf, 0.5])
+    target = jnp.asarray([1, 2, 3, 4, 5, 6, 7, 8])
+    indexes = jnp.zeros(8, jnp.int32)
+    ctx = make_group_context(preds, target, indexes)
+    sorted_t = np.asarray(ctx.target).reshape(1, 8)
+    sorted_p = np.asarray(ctx.preds).reshape(1, 8)
+    for k in (1, 2, 3, 5, 8):
+        tctx = make_topk_context(preds, target, (1, 8), k)
+        np.testing.assert_array_equal(np.asarray(tctx.topk_target), sorted_t[:, :k])
+        np.testing.assert_array_equal(np.asarray(tctx.topk_preds), sorted_p[:, :k])
