@@ -600,7 +600,7 @@ def _best_prior_normalized() -> dict:
     return best
 
 
-def main() -> None:
+def main(json_path: "str | None" = None) -> None:
     from benchmarks import (
         bench_collection,
         bench_curves,
@@ -613,6 +613,25 @@ def main() -> None:
     import math
     import sys
 
+    # compile split WITHOUT arming the full obs layer: the jax.monitoring
+    # listener (recording once installed, independent of obs.enable)
+    # accumulates backend compile seconds per section. The full layer stays
+    # OFF — its eager-path spans/counters would sit inside the timed
+    # regions of eager rows (e.g. the compute-group A/B) and confound the
+    # comparison against prior rounds measured without it.
+    from metrics_tpu import obs
+
+    compile_listener_ok = obs.install_compile_listener()
+    if not compile_listener_ok:
+        print(
+            "WARNING: jax.monitoring listener unavailable — section_compile_s"
+            " will read 0.0 and does NOT mean fully-cached runs.",
+            file=sys.stderr,
+        )
+
+    def _compile_seconds() -> float:
+        return obs.get_counter("jax.compile_seconds")
+
     print(
         "NOTE: vs_baseline is the speedup over the REFERENCE'S EAGER DATA PATH RE-TIMED IN"
         " TORCH ON THIS HOST'S CPU (the reference publishes no numbers). BASELINE.md's"
@@ -623,7 +642,18 @@ def main() -> None:
     prior = _best_prior_values()
     prior_norm = _best_prior_normalized()
     emitted_rows: list = []
+    emitted_dicts: list = []
     session_probe_values: dict = {}
+    _section_compile_s: list = [0.0]  # compile seconds attributed to the current section
+
+    def section(measure_fn, *args, **kwargs):
+        """Run one measurement section, attributing its backend compile
+        seconds (from the obs jax.monitoring listener) to the rows it
+        emits — the compile-vs-run split the JSON record publishes."""
+        c0 = _compile_seconds()
+        out = measure_fn(*args, **kwargs)
+        _section_compile_s[0] = _compile_seconds() - c0
+        return out
 
     def emit(name: str, ours_ms: float, base_ms: float, baseline: str = "torch_cpu_eager") -> None:
         # print each row as soon as it exists: a timeout mid-run must not
@@ -654,9 +684,14 @@ def main() -> None:
         # call would add, published separately from the kernel time
         if hasattr(ours_ms, "tunnel_rtt_ms"):
             row["tunnel_rtt_ms"] = round(ours_ms.tunnel_rtt_ms, 3)
+        # compile-vs-run split: the row's `value` is steady-state run time;
+        # `section_compile_s` is the backend compile time the row's section
+        # paid once (shared across rows measured in the same section)
+        row["section_compile_s"] = round(_section_compile_s[0], 3)
         line = json.dumps(row)
         print(line, flush=True)
         emitted_rows.append(line)
+        emitted_dicts.append(row)
         if name.startswith("probe_"):
             return  # probes RECORD session state; gating them is meaningless
         best = prior.get(name)
@@ -689,18 +724,18 @@ def main() -> None:
             )
 
     # chip-state probes first: they calibrate the gate for every later row
-    probes = bench_probes()
+    probes = section(bench_probes)
     for pname, pval in probes.items():
         if math.isfinite(pval) and pval > 0:
             session_probe_values[pname] = float(pval)
             pbest = prior.get(pname)
             emit(pname, pval, pbest if pbest is not None else float(pval), baseline="best_prior_probe")
 
-    curves = bench_curves.measure()
+    curves = section(bench_curves.measure)
     emit("auroc_exact_1M_compute", curves["auroc_exact_1M_compute"], base_auroc())
     emit("binned_counts_1M_T100_update", curves["binned_counts_1M_T100_update"], base_binned())
 
-    coll = bench_collection.measure()
+    coll = section(bench_collection.measure)
     emit("collection_statscores_binary_1M_update", coll["collection_statscores_binary_1M_update"], base_collection("binary"))
     emit(
         "collection_statscores_multiclass_1M_update",
@@ -710,7 +745,7 @@ def main() -> None:
     # the reference's ONE quantitative perf claim: compute groups give
     # "2x-3x lower computational cost" (docs overview; SURVEY.md §6). A/B
     # on the same collection, so the baseline is our own groups-off path.
-    savings = bench_collection.measure_compute_group_savings()
+    savings = section(bench_collection.measure_compute_group_savings)
     emit(
         "collection_prf1_200k_update_groups_on",
         savings["collection_prf1_200k_update_groups_on"],
@@ -718,7 +753,7 @@ def main() -> None:
         baseline="same_collection_compute_groups_off",
     )
 
-    retr = bench_retrieval.measure()
+    retr = section(bench_retrieval.measure)
     emit("retrieval_map_1M_docs_compute", retr["retrieval_map_1M_docs_compute"], base_retrieval("map"))
     emit("retrieval_ndcg_1M_docs_compute", retr["retrieval_ndcg_1M_docs_compute"], base_retrieval("ndcg"))
     # MAP@k=10, same 1M docs: the segment-local top-k path (per-query
@@ -729,17 +764,17 @@ def main() -> None:
         base_retrieval("map_k10"),
     )
 
-    fid = bench_image.measure()
+    fid = section(bench_image.measure)
     emit("fid_10k_2048d_compute", fid["fid_10k_2048d_compute"], base_fid())
-    ssim = bench_image.measure_ssim()
+    ssim = section(bench_image.measure_ssim)
     emit("ssim_64x3x256x256_compute", ssim["ssim_64x3x256x256_compute"], base_ssim())
 
-    ti = bench_text_image.measure()
+    ti = section(bench_text_image.measure)
     emit("lpips_alex_32x64x64_forward", ti["lpips_alex_32x64x64_forward"], base_lpips())
     emit("bertscore_match_256x128x256", ti["bertscore_match_256x128x256"], base_bertscore())
     emit("wer_10k_pairs_compute", ti["wer_10k_pairs_compute"], base_wer())
 
-    emit("detection_map_2k_images_compute", bench_detection.measure(n_trials=2), base_map(2_000))
+    emit("detection_map_2k_images_compute", section(bench_detection.measure, n_trials=2), base_map(2_000))
 
     # large-state mesh sync (8 virtual CPU devices; own process because the
     # backend here is already initialized on the TPU). The ratio is the old
@@ -760,6 +795,10 @@ def main() -> None:
             if line.startswith("{"):
                 row = json.loads(line)
                 rows[row["metric"]] = row["value"]
+        # this row compiles in the SUBPROCESS, invisible to the in-process
+        # compile listener — 0.0 is the honest attribution (never the
+        # previous section's leftovers)
+        _section_compile_s[0] = 0.0
         emit(
             "buffer_sync_1M_8dev_compute",
             rows["buffer_sync_1M_8dev_static_varying"],
@@ -770,7 +809,7 @@ def main() -> None:
         print(f"SKIPPED buffer_sync_1M_8dev_compute: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
-    emit("accuracy_1M_update_compute_wallclock", bench_accuracy_tpu(), base_accuracy())
+    emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())
 
     # repeat the full compact table as the FINAL stdout block, headline row
     # still last: the driver's BENCH_r*.json tail capture truncates early
@@ -781,6 +820,62 @@ def main() -> None:
     for line in emitted_rows:
         print(line, flush=True)
 
+    if json_path:
+        write_json_record(json_path, emitted_dicts)
+
+
+def write_json_record(path: str, rows: list) -> None:
+    """Write the machine-readable sweep record (``--json BENCH_rNN.json``).
+
+    One self-describing file per round: device kind + jax version (so a
+    TPU sweep and a CPU fallback can never be confused again), every row
+    with its compile-vs-run split, and the obs snapshot (total backend
+    compile seconds, per-step trace counts) — the bench trajectory the
+    round-over-round tooling can diff mechanically.
+    """
+    import platform
+    import sys
+    import time as _time
+
+    import jax
+
+    from metrics_tpu import obs
+
+    dev = jax.devices()[0]
+    record = {
+        "schema": 1,
+        "recorded_unix": int(_time.time()),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "device_count": jax.device_count(),
+        "jax_version": jax.__version__,
+        "python_version": platform.python_version(),
+        "rows": rows,
+        "obs": {
+            # False means the monitoring API was unavailable: every
+            # section_compile_s is then 0.0 by construction, NOT a sign of
+            # fully-cached runs — trajectory tooling must check this flag.
+            # Read-only probe: writing a record must not install anything.
+            "compile_listener_installed": obs.compile_listener_installed(),
+            "jax_compile_seconds": obs.get_counter("jax.compile_seconds"),
+            "jax_compiles": obs.get_counter("jax.compiles"),
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {path} ({len(rows)} rows)", file=sys.stderr)
+
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--json",
+        metavar="PATH",
+        default=None,
+        help="also write the full sweep as one machine-readable JSON record"
+        " (device kind, jax version, per-row compile-vs-run split, obs totals)",
+    )
+    main(json_path=parser.parse_args().json)

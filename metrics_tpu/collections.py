@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import jax
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs.tracing import trace_span as _obs_span
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import _flatten_dict, allclose, coerce_foreign_tensors, foreign_coercion_scope
 
@@ -176,11 +177,12 @@ class MetricCollection(dict):
         # metric would otherwise pay the host transfer independently
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with foreign_coercion_scope(args, kwargs):  # member forwards must not re-walk these
-            res = {
-                k: m(*args, **m._filter_kwargs(**kwargs))
-                for k, m in self.items(keep_base=True, copy_state=False)
-            }
+        with _obs_span("MetricCollection.forward", category="forward"):
+            with foreign_coercion_scope(args, kwargs):  # member forwards must not re-walk these
+                res = {
+                    k: m(*args, **m._filter_kwargs(**kwargs))
+                    for k, m in self.items(keep_base=True, copy_state=False)
+                }
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -191,8 +193,9 @@ class MetricCollection(dict):
         """Update each underlying metric once per compute group."""
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with foreign_coercion_scope(args, kwargs):  # member updates must not re-walk these
-            self._update_members(*args, **kwargs)
+        with _obs_span("MetricCollection.update", category="update"):
+            with foreign_coercion_scope(args, kwargs):  # member updates must not re-walk these
+                self._update_members(*args, **kwargs)
 
     def _update_members(self, *args: Any, **kwargs: Any) -> None:
         if self._groups_checked:
@@ -273,10 +276,11 @@ class MetricCollection(dict):
 
     def compute(self) -> Dict[str, Any]:
         """Compute every metric; group members read the representative state."""
-        if self._groups_checked:
-            self._compute_groups_create_state_ref()
-            self._state_is_copy = True
-        res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        with _obs_span("MetricCollection.compute", category="compute"):
+            if self._groups_checked:
+                self._compute_groups_create_state_ref()
+                self._state_is_copy = True
+            res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 

@@ -36,6 +36,11 @@ class MetricLogger:
         self._scalars: Dict[str, List[Any]] = {}
         self._step_values: Dict[str, Any] = {}
         self.history: List[Dict[str, Any]] = []
+        # index-parallel to `history`: one obs snapshot per closed epoch
+        # (None for epochs closed while metrics_tpu.obs was disabled) —
+        # kept beside `history`, not inside it, so epoch_values() consumers
+        # never see a phantom metric name
+        self.obs_history: List[Optional[Dict[str, Any]]] = []
 
     def log(self, name: str, value: Any, *update_args: Any, on_step: bool = True, **update_kwargs: Any) -> Optional[Any]:
         """Log a metric (with its update args) or a plain scalar under ``name``.
@@ -87,7 +92,15 @@ class MetricLogger:
         """Epoch aggregates: ``compute()`` (with dist sync) for metrics, mean
         for scalars. With ``reset`` (default), metrics are reset and scalar
         buffers cleared — the trainer's end-of-epoch behavior — and the
-        values are appended to ``history``."""
+        values are appended to ``history``.
+
+        ``obs_history`` stays index-parallel to ``history``:
+        ``logger.obs_history[e]`` is the obs snapshot at the close of epoch
+        ``e`` when the observability layer was armed then
+        (``metrics_tpu.obs.enable()``), and ``None`` for epochs closed while
+        it was off — kept OUT of the returned values dict so metric
+        consumers never see a phantom key.
+        """
         out: Dict[str, Any] = {}
         for name, metric in self._metrics.items():
             if metric._effective_update_count():
@@ -102,4 +115,11 @@ class MetricLogger:
             # _step_values is left alone: step_values() drains itself, and a
             # loop may flush the final batch's step values after epoch close
             self.history.append(out)
+            from metrics_tpu import obs
+
+            # None (not absence) for obs-off epochs: obs_history[e] must
+            # always describe history[e], even if obs is toggled mid-run.
+            # spans=False: archiving the full span ring every epoch would
+            # duplicate ~max_spans dicts per entry over a long run
+            self.obs_history.append(obs.snapshot(spans=False) if obs.enabled() else None)
         return out

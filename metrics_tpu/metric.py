@@ -45,6 +45,11 @@ from metrics_tpu.utilities.data import (
     foreign_coercion_scope,
     dim_zero_cat,
 )
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.obs.tracing import pytree_nbytes as _obs_nbytes
+from metrics_tpu.obs.tracing import trace_span as _obs_span
 from metrics_tpu.utilities.distributed import distributed_available, gather_all_tensors
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
@@ -202,6 +207,14 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate the batch AND return the batch-local metric value."""
+        if _obs_enabled():
+            name = type(self).__name__
+            _obs_inc("metric.forwards", metric=name)
+            with _obs_span(f"{name}.forward", category="forward"):
+                return self._forward_impl(*args, **kwargs)
+        return self._forward_impl(*args, **kwargs)
+
+    def _forward_impl(self, *args: Any, **kwargs: Any) -> Any:
         # convert any torch inputs ONCE here: the full-state path calls
         # update() twice on the same batch, and the per-update coercion
         # would pay the host transfer twice
@@ -309,6 +322,14 @@ class Metric(ABC):
 
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:456``)."""
+        if _obs_enabled():
+            _obs_inc("metric.resets", metric=type(self).__name__)
+            with _obs_span(f"{type(self).__name__}.reset", category="reset"):
+                self._reset_impl()
+            return
+        self._reset_impl()
+
+    def _reset_impl(self) -> None:
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
@@ -372,11 +393,16 @@ class Metric(ABC):
             raise MetricsTPUUserError("The Metric has already been synced.")
         is_distributed = (distributed_available_fn or self.distributed_available_fn)()
         if not should_sync or not is_distributed:
+            if _obs_enabled():
+                _obs_inc("metric.sync_noops", metric=type(self).__name__)
             return
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
-        self._cache = self._snapshot_state()
-        self._sync_dist(dist_sync_fn, process_group=process_group)
+        if _obs_enabled():
+            _obs_inc("metric.syncs", metric=type(self).__name__)
+        with _obs_span(f"{type(self).__name__}.sync", category="sync"):
+            self._cache = self._snapshot_state()
+            self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -652,8 +678,13 @@ def _wrap_update(update: Callable) -> Callable:
         self._update_count += 1
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
-        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+        # annotate_always: disabled mode keeps emitting exactly the bare
+        # TraceAnnotation this site always had; enabled adds named_scope +
+        # the host span + counters
+        with _obs_span(f"{type(self).__name__}.update", category="update", annotate_always=True):
             update(self, *args, **kwargs)
+        if _obs_enabled():
+            _obs_inc("metric.updates", metric=type(self).__name__)
         if self._dtype_forced:
             # jnp ops promote dtypes (no in-place torch semantics); pin
             # non-list float states back to the forced dtype.
@@ -679,12 +710,25 @@ def _wrap_compute(compute: Callable) -> Callable:
             )
         if self._computed is not None:
             return self._computed
+        if _obs_enabled():
+            name = type(self).__name__
+            _obs_inc("metric.computes", metric=name)
+            # accumulated-state footprint at its per-epoch peak, BEFORE the
+            # sync context (local state). Recorded here rather than per
+            # update: walking a list/cat state's B arrays on every one of B
+            # updates would be O(B^2) over an epoch, and the pre-compute
+            # value is the one capacity planning needs anyway.
+            _obs_gauge(
+                "metric.state_bytes",
+                _obs_nbytes({n: getattr(self, n) for n in self._defaults}),
+                metric=name,
+            )
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             should_sync=self._to_sync,
             should_unsync=self._should_unsync,
         ):
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+            with _obs_span(f"{type(self).__name__}.compute", category="compute", annotate_always=True):
                 value = compute(self)
             self._computed = _squeeze_if_scalar(value)
         return self._computed

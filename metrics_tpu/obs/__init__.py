@@ -1,0 +1,93 @@
+"""``metrics_tpu.obs`` — observability for every metric hot path.
+
+Four pillars, all zero-overhead when disabled (the default; the compiled
+HLO of a jitted step with the layer off is byte-identical to an
+uninstrumented build — pinned by ``tests/bases/test_obs.py``):
+
+1. **Lifecycle tracing** — ``Metric.update/forward/compute/sync/reset``,
+   ``MetricCollection`` and the ``make_step``/``make_epoch`` pure steps run
+   under ``jax.named_scope`` + ``jax.profiler.TraceAnnotation``, so
+   per-metric work is attributable in TPU profiler timelines; eager phases
+   also land in a host-side span log (name, nesting, wall ms).
+2. **Recompile telemetry** — tracings, compiles and compile seconds per
+   jitted step, with a one-shot storm warning when one step re-traces past
+   ``recompile_warn_threshold`` (shape/dtype drift).
+3. **Runtime-counter registry** — updates applied, fused-epoch launches and
+   batches folded, per-metric state bytes, collective sync count + payload
+   bytes, ``CapacityBuffer`` clamp-risk events. **Counter semantics under
+   jit:** hooks are Python, so inside jitted code they run at TRACE time —
+   counters on jitted paths (``metric.updates`` reached through a jitted
+   step, ``sync.collectives``, ``sync.payload_bytes``) count once per
+   compiled program, not per execution. Per-execution series exist where
+   the entry point is eager: ``metric.*`` via the eager class API,
+   ``epoch.launches``/``epoch.batches_folded`` (counted host-side at the
+   ``make_epoch`` entry), ``sync.gathers`` (eager DCN path).
+4. **Export** — :func:`snapshot` (plain dict), :func:`to_prometheus`,
+   :func:`to_json`; ``MetricLogger`` archives a snapshot per epoch and
+   ``bench.py --json`` splits compile from run time per row.
+
+Quick start::
+
+    import metrics_tpu.obs as obs
+
+    obs.enable()                       # or METRICS_TPU_OBS=1
+    ...                                # run your metric pipeline
+    print(obs.snapshot()["counters"])  # {'metric.updates{metric=Accuracy}': 128.0, ...}
+    print(obs.to_prometheus())         # scrape-ready text
+
+See ``docs/observability.md`` for the full guide.
+"""
+from metrics_tpu.obs import registry as _registry  # noqa: F401
+from metrics_tpu.obs.export import snapshot, to_json, to_prometheus
+from metrics_tpu.obs.recompile import (
+    compile_listener_installed,
+    install_compile_listener,
+    note_trace,
+    track_compiles,
+)
+from metrics_tpu.obs.registry import (
+    configure,
+    counters,
+    enable,
+    enabled,
+    gauges,
+    get_counter,
+    get_gauge,
+    inc,
+    set_gauge,
+    spans,
+)
+from metrics_tpu.obs.tracing import pytree_nbytes, trace_span
+
+__all__ = [
+    "compile_listener_installed",
+    "configure",
+    "counters",
+    "enable",
+    "enabled",
+    "gauges",
+    "get_counter",
+    "get_gauge",
+    "inc",
+    "install_compile_listener",
+    "note_trace",
+    "pytree_nbytes",
+    "reset",
+    "set_gauge",
+    "snapshot",
+    "spans",
+    "to_json",
+    "to_prometheus",
+    "trace_span",
+    "track_compiles",
+]
+
+
+def reset() -> None:
+    """Clear all counters/gauges/spans and re-arm the one-shot storm warning
+    (the enabled flag and config survive — this separates measurement
+    windows, it doesn't disarm the layer)."""
+    from metrics_tpu.obs import recompile as _recompile
+
+    _registry.reset()
+    _recompile.reset_storm_warnings()

@@ -1,0 +1,83 @@
+"""Export surface: plain-dict snapshot, Prometheus text, JSON.
+
+``snapshot()`` is the canonical read: a plain nested dict (counters,
+gauges, spans, config, enabled flag) safe to log, diff between epochs
+(:class:`~metrics_tpu.integrations.MetricLogger` archives one per epoch
+when the layer is enabled), or attach to bench rows. The two dumpers
+re-serialize a snapshot without touching live registry state, so exporters
+can run on a snapshot taken at a consistent instant.
+
+Prometheus naming: series ``a.b.c{x=y}`` becomes
+``metrics_tpu_a_b_c{x="y"}`` — dots to underscores, every label value
+quoted, one ``# TYPE`` line per family (counters ``counter``, gauges
+``gauge``). Spans are not exported to Prometheus (they are per-event, not
+a series); they ride the JSON dump.
+"""
+import json
+import re
+from typing import Any, Dict, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["snapshot", "to_json", "to_prometheus"]
+
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+
+
+def snapshot(spans: bool = True) -> Dict[str, Any]:
+    """Everything the obs layer knows, as one plain dict.
+
+    ``spans=False`` omits the span ring (counters/gauges only, plus the
+    ring's current length under ``span_count``) — the right shape for
+    per-epoch archiving, where copying the full up-to-``max_spans`` ring
+    every epoch would duplicate mostly-identical entries across snapshots.
+    """
+    out = {
+        "enabled": _reg.enabled(),
+        "counters": _reg.counters(),
+        "gauges": _reg.gauges(),
+        "config": {k: _reg.get_config(k) for k in ("recompile_warn_threshold", "max_spans")},
+    }
+    if spans:
+        out["spans"] = _reg.spans()
+    else:
+        out["span_count"] = len(_reg.spans())
+    return out
+
+
+def _prom_series(key: str, value: float, out: list) -> None:
+    m = _KEY_RE.match(key)
+    name = "metrics_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", (m.group("name") if m else key))
+    labels = (m.group("labels") or "") if m else ""
+    if labels:
+        pairs = []
+        for part in labels.split(","):
+            k, _, v = part.partition("=")
+            pairs.append(f'{re.sub(r"[^a-zA-Z0-9_]", "_", k)}="{v}"')
+        name = f"{name}{{{','.join(pairs)}}}"
+    out.append(f"{name} {value:g}")
+
+
+def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Render a snapshot in the Prometheus text exposition format."""
+    snap = snapshot() if snap is None else snap
+    lines: list = []
+    typed: set = set()
+    for kind, family in (("counter", "counters"), ("gauge", "gauges")):
+        for key in sorted(snap.get(family, {})):
+            m = _KEY_RE.match(key)
+            base = "metrics_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", (m.group("name") if m else key))
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {kind}")
+            _prom_series(key, snap[family][key], lines)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_json(snap: Optional[Dict[str, Any]] = None, path: Optional[str] = None, indent: int = 2) -> str:
+    """Serialize a snapshot to JSON; optionally also write it to ``path``."""
+    text = json.dumps(snapshot() if snap is None else snap, indent=indent, sort_keys=True)
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+    return text

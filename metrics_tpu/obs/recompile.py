@@ -1,0 +1,210 @@
+"""Recompile telemetry: tracings, compiles and compile-seconds per step.
+
+A jitted metric step that keeps re-tracing (batch-size drift, dtype
+flapping, a Python scalar leaking into the signature) silently turns a
+microsecond hot path into a seconds-long compile storm — invisible today
+because jax retraces without a word. Three hooks make it visible:
+
+* :func:`note_trace` — called at the top of every ``make_step`` /
+  ``make_epoch`` function body. The body of a jitted function only executes
+  when jax is TRACING it, so an in-body counter bump counts exactly the
+  tracings of that step (eager calls are counted separately by probing the
+  trace state). Crossing ``recompile_warn_threshold`` distinct tracings
+  fires a one-shot ``rank_zero_warn`` storm warning.
+* :func:`track_compiles` — wraps a jitted callable; a call during which the
+  step's tracing counter advanced is attributed to ``compile_seconds``
+  (trace + lower + backend compile all happen inside that call), every
+  other call to ``run_seconds``. This is the compile-vs-run split
+  ``bench.py --json`` publishes per row.
+* :func:`install_compile_listener` — registers a ``jax.monitoring``
+  duration listener so EVERY backend compile in the process (not just ones
+  routed through ``make_step``) lands in ``jax.compile_seconds`` /
+  ``jax.compiles``. Best-effort: silently unavailable on jax builds
+  without the listener API.
+
+All three are inert unless the registry is enabled; ``note_trace`` in a
+traced body adds zero operations to the program (a Python-level counter
+bump at trace time only).
+"""
+import time
+from typing import Any, Callable, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["compile_listener_installed", "install_compile_listener", "note_trace", "track_compiles"]
+
+_warned_steps: set = set()
+# per-factory trace counts for the storm heuristic: the PUBLIC step.traces
+# counter aggregates by step label (class name), so eight distinct
+# make_step(Accuracy) factories tracing once each would pool to 8 and fake
+# a storm; each factory passes its own token so the threshold only sees
+# retraces of that one step
+_traces_by_token: dict = {}
+_listener_installed = False
+
+
+_trace_probe: Optional[Callable[[], bool]] = None
+
+
+def _resolve_trace_probe() -> Callable[[], bool]:
+    """Resolve a ``() -> currently-tracing`` probe ONCE against this jax.
+
+    ``jax.core.trace_state_clean`` is the cheap probe but lives in the
+    deprecated ``jax.core`` namespace; newer releases keep it under
+    ``jax._src.core``. The last-resort fallback stages a constant and asks
+    whether it came back as a tracer (omnistaging guarantees it does under
+    any trace) — never a silent wrong answer, unlike swallowing per call.
+    """
+    try:
+        import jax
+
+        fn = getattr(jax.core, "trace_state_clean", None)
+        if fn is None:
+            from jax._src import core as _core
+
+            fn = getattr(_core, "trace_state_clean", None)
+        if fn is not None:
+            fn()  # probe once; a broken shim falls through to the fallback
+            return lambda: not fn()
+    except Exception:
+        pass
+
+    def _tracer_fallback() -> bool:
+        import jax
+        import jax.numpy as jnp
+
+        return isinstance(jnp.zeros(()), jax.core.Tracer)
+
+    return _tracer_fallback
+
+
+def _in_trace_context() -> bool:
+    global _trace_probe
+    if _trace_probe is None:
+        _trace_probe = _resolve_trace_probe()
+    return _trace_probe()
+
+
+def note_trace(step: str, token: Optional[object] = None) -> None:
+    """Record one execution of a step function body under the given name.
+
+    Inside a trace: counts a (re)tracing of the jitted step and fires the
+    recompile-storm warning at the configured threshold. Outside a trace:
+    counts an eager call. ``token`` identifies ONE step factory (the public
+    ``step.traces`` counter aggregates by label across factories, but the
+    storm threshold must only see retraces of the same step).
+    """
+    if not _reg.enabled():
+        return
+    if not _in_trace_context():
+        _reg.inc("step.eager_calls", step=step)
+        return
+    _reg.inc("step.traces", step=step)
+    threshold = _reg.get_config("recompile_warn_threshold")
+    key = token if token is not None else step
+    if len(_traces_by_token) >= 4096 and key not in _traces_by_token:
+        # bound the per-factory book-keeping in factory-per-job loops; losing
+        # old factories' counts only delays a storm warning, never leaks
+        _traces_by_token.clear()
+    traces = _traces_by_token[key] = _traces_by_token.get(key, 0) + 1
+    if threshold and traces >= threshold and key not in _warned_steps:
+        _warned_steps.add(key)
+        from metrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(
+            f"Recompile storm: jitted metric step '{step}' has been traced {int(traces)} times"
+            f" (threshold {threshold}). Every distinct input shape/dtype signature compiles a new"
+            " program — pad batches to a stable shape, pin dtypes, or hash-check what varies."
+            " Raise the threshold with metrics_tpu.obs.configure(recompile_warn_threshold=N).",
+            UserWarning,
+        )
+
+
+def reset_storm_warnings() -> None:
+    """Re-arm the one-shot storm warning (used by tests and obs.reset)."""
+    _warned_steps.clear()
+    _traces_by_token.clear()
+
+
+def track_compiles(fn: Callable, step: str) -> Callable:
+    """Wrap a jitted callable to split its wall time into compile vs run.
+
+    The step's ``note_trace`` counter is read before and after each call: a
+    call that advanced it paid for trace+lower+compile and lands in
+    ``compile_seconds{step=...}`` / ``compiles{step=...}``; a cache-hit call
+    lands in ``run_seconds{step=...}`` / ``runs{step=...}``. Disabled mode
+    short-circuits to the raw callable (one predicate per call).
+    """
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if not _reg.enabled():
+            return fn(*args, **kwargs)
+        before = _reg.get_counter("step.traces", step=step)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        if _reg.get_counter("step.traces", step=step) > before:
+            _reg.inc("compile_seconds", dt, step=step)
+            _reg.inc("compiles", step=step)
+        else:
+            _reg.inc("run_seconds", dt, step=step)
+            _reg.inc("runs", step=step)
+        return out
+
+    return wrapped
+
+
+def note_epoch_launch(step: str, n_batches: Optional[int]) -> None:
+    """Count one fused-epoch launch and the batches it folds (host-side,
+    from the eager entry's argument shapes — zero trace impact)."""
+    if not _reg.enabled():
+        return
+    _reg.inc("epoch.launches", step=step)
+    if n_batches is not None:
+        _reg.inc("epoch.batches_folded", float(n_batches), step=step)
+        _reg.set_gauge("epoch.batches_per_launch", float(n_batches), step=step)
+
+
+def compile_listener_installed() -> bool:
+    """Whether the backend-compile listener is live — without installing it."""
+    return _listener_installed
+
+
+def install_compile_listener() -> bool:
+    """Register a process-wide ``jax.monitoring`` listener for backend
+    compile durations. Returns True when installed (idempotent).
+
+    Installation is itself the opt-in: once installed, the listener records
+    ``jax.compiles`` / ``jax.compile_seconds`` regardless of the enabled
+    flag, so a consumer that only wants the compile split (e.g. ``bench.py``
+    attributing section compile time) need not arm the full layer — whose
+    eager-path spans/counters would sit inside timed regions."""
+    global _listener_installed
+    if _listener_installed:
+        return True
+    try:
+        from jax._src import monitoring
+    except Exception:
+        return False
+    if not hasattr(monitoring, "register_event_duration_secs_listener"):
+        return False
+
+    def _on_duration(event: str, duration: float, **kwargs: Any) -> None:
+        # ONLY the backend-compile phase: jax emits several events per
+        # compiled program whose names contain "compile" (jaxpr trace,
+        # MLIR lowering, cache-hit time-SAVED), and summing them would
+        # overcount one compile ~10x and book phantom seconds on warm
+        # persistent-cache hits. The backend_compile_duration event is the
+        # actual XLA compile wall time, once per program.
+        if event.endswith("backend_compile_duration"):
+            _reg.inc("jax.compile_seconds", duration)
+            _reg.inc("jax.compiles")
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _listener_installed = True
+    return True

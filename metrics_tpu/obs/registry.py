@@ -1,0 +1,191 @@
+"""Process-wide observability registry: counters, gauges, span log, config.
+
+One flat registry per process, guarded by a lock, holding three kinds of
+runtime telemetry (SURVEY §1's blind spot — the reference has no equivalent):
+
+* **counters** — monotonically increasing event counts (updates applied,
+  collectives emitted, tracings per jitted step, buffer clamp risks).
+* **gauges** — last-written values (per-metric state bytes, batches folded
+  into the latest fused-epoch program).
+* **spans** — host-side wall-clock records of eager lifecycle phases
+  (name, nesting depth, milliseconds), capped at ``max_spans`` so an
+  unbounded training loop cannot leak memory; overflow is itself counted
+  under ``obs.spans_dropped``.
+
+Keys are ``name{label=value,...}`` with labels sorted, so the same logical
+series always lands on one key and the Prometheus dumper
+(:mod:`metrics_tpu.obs.export`) can re-split them mechanically.
+
+The registry is **disabled by default** and every instrumentation point in
+the package checks :func:`enabled` before doing any work, so the disabled
+mode adds nothing to compiled programs (the HLO-identity test in
+``tests/bases/test_obs.py`` pins this) and only a predicate call to eager
+paths. Enable with :func:`enable` or ``METRICS_TPU_OBS=1``.
+"""
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+__all__ = [
+    "configure",
+    "counters",
+    "enable",
+    "enabled",
+    "gauges",
+    "get_config",
+    "get_counter",
+    "get_gauge",
+    "inc",
+    "record_span",
+    "reset",
+    "set_gauge",
+    "spans",
+]
+
+_lock = threading.Lock()
+_ENABLED = os.environ.get("METRICS_TPU_OBS", "").strip().lower() not in ("", "0", "false", "no", "off")
+
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+# ring buffer: a full log drops the OLDEST span so the window always shows
+# the most recent activity (a keep-oldest cap would freeze the log on
+# run-start warmup forever); evictions are counted under obs.spans_dropped
+_spans: Deque[Dict[str, Any]] = deque(maxlen=4096)
+
+_config: Dict[str, Any] = {
+    # warn when one jitted step has been traced this many times (shape/dtype
+    # drift recompiles every distinct signature; see obs.recompile)
+    "recompile_warn_threshold": 8,
+    # host-side span ring size; evictions increment obs.spans_dropped
+    "max_spans": 4096,
+}
+
+# thread-local nesting depth for the span recorder
+_tls = threading.local()
+
+
+def enable(on: bool = True) -> bool:
+    """Turn the observability layer on (or off); returns the previous state."""
+    global _ENABLED
+    previous = _ENABLED
+    _ENABLED = bool(on)
+    return previous
+
+
+def enabled() -> bool:
+    """True when the observability layer is armed (``METRICS_TPU_OBS=1`` or
+    :func:`enable`). Every hook in the package is behind this predicate."""
+    return _ENABLED
+
+
+def configure(**kwargs: Any) -> Dict[str, Any]:
+    """Update config knobs (``recompile_warn_threshold``, ``max_spans``);
+    returns the previous values of the keys that changed."""
+    global _spans
+    previous = {}
+    with _lock:
+        for key, value in kwargs.items():
+            if key not in _config:
+                raise ValueError(f"Unknown obs config key {key!r}; valid: {sorted(_config)}")
+            previous[key] = _config[key]
+            _config[key] = value
+            if key == "max_spans":
+                _spans = deque(_spans, maxlen=int(value))
+    return previous
+
+
+def get_config(key: str) -> Any:
+    return _config[key]
+
+
+_LABEL_UNSAFE = re.compile(r'[,={}"\\\n]')
+
+
+def _key(name: str, labels: Dict[str, Any]) -> str:
+    if not labels:
+        return name
+    # label values are sanitized into the flat series key: ',' '=' '{' '}'
+    # quotes/backslashes/newlines would make the key un-splittable for the
+    # Prometheus dumper (and produce scrape-breaking exposition text)
+    inner = ",".join(f"{k}={_LABEL_UNSAFE.sub('_', str(labels[k]))}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+def inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    """Add ``value`` to counter ``name`` (labels become part of the series key)."""
+    key = _key(name, labels)
+    with _lock:
+        _counters[key] = _counters.get(key, 0.0) + value
+
+
+def set_gauge(name: str, value: float, **labels: Any) -> None:
+    """Set gauge ``name`` to its latest observed ``value``."""
+    with _lock:
+        _gauges[_key(name, labels)] = float(value)
+
+
+def get_counter(name: str, **labels: Any) -> float:
+    with _lock:
+        return _counters.get(_key(name, labels), 0.0)
+
+
+def get_gauge(name: str, **labels: Any) -> Optional[float]:
+    with _lock:
+        return _gauges.get(_key(name, labels))
+
+
+def record_span(name: str, wall_ms: float, depth: int, category: Optional[str] = None) -> None:
+    """Append one finished host-side span to the ring (evicting the oldest
+    when ``max_spans`` is reached, so the log always covers recent work)."""
+    span = {"name": name, "wall_ms": wall_ms, "depth": depth, "t": time.time()}
+    if category is not None:
+        span["category"] = category
+    with _lock:
+        if len(_spans) == _spans.maxlen:
+            _counters["obs.spans_dropped"] = _counters.get("obs.spans_dropped", 0.0) + 1.0
+        _spans.append(span)
+
+
+def _span_depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def _push_span() -> int:
+    depth = getattr(_tls, "depth", 0)
+    _tls.depth = depth + 1
+    return depth
+
+
+def _pop_span() -> None:
+    _tls.depth = max(0, getattr(_tls, "depth", 1) - 1)
+
+
+def counters() -> Dict[str, float]:
+    """A copy of every counter series."""
+    with _lock:
+        return dict(_counters)
+
+
+def gauges() -> Dict[str, float]:
+    """A copy of every gauge series."""
+    with _lock:
+        return dict(_gauges)
+
+
+def spans() -> List[Dict[str, Any]]:
+    """A copy of the host-side span log (eager lifecycle phases only —
+    device-side attribution lives in the profiler timeline, not here)."""
+    with _lock:
+        return [dict(s) for s in _spans]
+
+
+def reset() -> None:
+    """Clear all counters, gauges and spans (the enabled flag and config
+    survive — reset separates measurement windows, it doesn't disarm)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _spans.clear()

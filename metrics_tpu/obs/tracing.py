@@ -1,0 +1,90 @@
+"""Lifecycle tracing: named scopes for device timelines, spans for host time.
+
+Two attribution surfaces, entered together by :func:`trace_span`:
+
+* ``jax.named_scope`` — stamps every op traced inside the block with the
+  scope name, so per-metric work is attributable in TPU profiler (xprof)
+  timelines and in HLO dumps. This CHANGES the lowered program's metadata,
+  which is exactly why it is only entered when the obs layer is enabled:
+  disabled-mode HLO must stay byte-identical to an uninstrumented build
+  (pinned by ``tests/bases/test_obs.py``).
+* ``jax.profiler.TraceAnnotation`` — a host-side profiler marker (no HLO
+  effect) for plain-Python phases.
+
+On exit, an enabled span also records ``(name, nesting depth, wall ms)``
+into the registry's host-side span log — the cheap always-available answer
+to "where did the eager step spend its time" when no profiler is attached.
+
+``annotate_always=True`` preserves pre-obs behaviour for the two sites that
+already carried a bare ``TraceAnnotation`` (``Metric.update`` /
+``Metric.compute``): disabled mode keeps emitting that annotation and
+nothing else.
+"""
+import time
+from contextlib import contextmanager, nullcontext
+from typing import Any, Iterator, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["pytree_nbytes", "trace_span"]
+
+# one shared stateless instance: the disabled path must not build a fresh
+# generator-based context manager per call on per-batch eager hot paths
+_NULL_CM = nullcontext()
+
+
+@contextmanager
+def _active_span(name: str, category: Optional[str]) -> Iterator[None]:
+    import jax
+
+    depth = _reg._push_span()
+    t0 = time.perf_counter()
+    try:
+        with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        _reg._pop_span()
+        _reg.record_span(name, (time.perf_counter() - t0) * 1000.0, depth, category)
+
+
+def trace_span(name: str, category: Optional[str] = None, annotate_always: bool = False):
+    """Context manager wrapping one lifecycle phase.
+
+    Disabled: a no-op (or, with ``annotate_always``, exactly the bare
+    ``TraceAnnotation`` the pre-obs code emitted). Enabled: named scope +
+    trace annotation + host span record.
+    """
+    if not _reg.enabled():
+        if annotate_always:
+            import jax
+
+            return jax.profiler.TraceAnnotation(name)
+        return _NULL_CM
+    return _active_span(name, category)
+
+
+def pytree_nbytes(tree: Any) -> int:
+    """Total bytes of every array leaf in a metric-state pytree.
+
+    Shape/dtype metadata only — no device sync, works on tracers. Lists of
+    arrays (unbounded cat states) and :class:`CapacityBuffer` instances
+    (counts the allocated ``(capacity, *item)`` backing array) are walked
+    like any other container.
+    """
+    import jax
+
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+
+    total = 0
+
+    def _leaf(x: Any) -> None:
+        nonlocal total
+        if isinstance(x, CapacityBuffer):
+            if x.data is not None:
+                total += x.data.size * x.data.dtype.itemsize
+            total += 4  # the int32 fill counter
+        elif hasattr(x, "dtype") and hasattr(x, "size"):
+            total += x.size * x.dtype.itemsize
+
+    jax.tree_util.tree_map(_leaf, tree, is_leaf=lambda x: isinstance(x, CapacityBuffer))
+    return total

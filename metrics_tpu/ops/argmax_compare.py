@@ -52,6 +52,13 @@ def _kernel(preds_ref, target_ref, out_ref, *, num_classes: int):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _argmax_correct_pallas(preds: Array, target: Array, interpret: bool = False) -> Array:
+    from metrics_tpu.obs.tracing import trace_span
+
+    with trace_span("ops.argmax_compare", category="kernel"):
+        return _argmax_correct_pallas_impl(preds, target, interpret)
+
+
+def _argmax_correct_pallas_impl(preds: Array, target: Array, interpret: bool = False) -> Array:
     n, c = preds.shape
     n_pad = -n % _BLOCK_ROWS
     # pad rows with preds=0 / target=-1: their argmax lands in [0, C) and

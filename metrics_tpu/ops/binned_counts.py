@@ -61,6 +61,13 @@ def _kernel(thr_ref, preds_ref, target_ref, tp_ref, fp_ref):
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _binned_counts_pallas(preds: Array, target: Array, thresholds: Array, interpret: bool = False) -> tuple:
+    from metrics_tpu.obs.tracing import trace_span
+
+    with trace_span("ops.binned_counts", category="kernel"):
+        return _binned_counts_pallas_impl(preds, target, thresholds, interpret)
+
+
+def _binned_counts_pallas_impl(preds: Array, target: Array, thresholds: Array, interpret: bool = False) -> tuple:
     n, c = preds.shape
     t = thresholds.shape[0]
     # bf16 mask block: (8, T, BL) x 2 bytes. Half the old f32 footprint, so

@@ -38,6 +38,10 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs.recompile import note_epoch_launch as _obs_epoch_launch
+from metrics_tpu.obs.recompile import note_trace as _obs_note_trace
+from metrics_tpu.obs.recompile import track_compiles as _obs_track_compiles
+from metrics_tpu.obs.tracing import trace_span as _obs_span
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.distributed import replicate_typed, sync_buffer_in_context, sync_reduce_in_context
 
@@ -205,32 +209,42 @@ def make_step(
         return worker
 
     mergeable = _is_mergeable(template)
+    obs_name = type(template).__name__
+    # labels/tokens hoisted out of the per-call path: the step label keys the
+    # aggregate counters; the token scopes the storm threshold to THIS factory
+    _step_label, _compute_label = f"{obs_name}.step", f"{obs_name}.step_compute"
+    _step_token, _compute_token = object(), object()
 
     def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
-        if mergeable:
-            # ONE update on a fresh state; the carry merge is elementwise and
-            # the batch-local value reuses the same batch statistics — no
-            # double update even eagerly
-            b = _load(init())
-            b.update(*args, **kwargs)
-            batch_state = b.state_pytree()
-            new_state = {
-                name: _MERGE_OPS[template._reductions[name]](state[name], batch_state[name])
-                for name in batch_state
-            }
+        # trace-time Python only: counts (re)tracings of a jitted step /
+        # eager calls, and names the traced ops for xprof. Disabled-mode HLO
+        # is byte-identical (tests/bases/test_obs.py pins this).
+        _obs_note_trace(_step_label, _step_token)
+        with _obs_span(_step_label, category="step"):
+            if mergeable:
+                # ONE update on a fresh state; the carry merge is elementwise and
+                # the batch-local value reuses the same batch statistics — no
+                # double update even eagerly
+                b = _load(init())
+                b.update(*args, **kwargs)
+                batch_state = b.state_pytree()
+                new_state = {
+                    name: _MERGE_OPS[template._reductions[name]](state[name], batch_state[name])
+                    for name in batch_state
+                }
+                if not with_value:
+                    return new_state, None
+                b._update_count = 1
+                return new_state, b.compute()
+            m = _load(state)
+            m.update(*args, **kwargs)
+            new_state = m.state_pytree()
             if not with_value:
                 return new_state, None
+            b = _load(init())
+            b.update(*args, **kwargs)
             b._update_count = 1
             return new_state, b.compute()
-        m = _load(state)
-        m.update(*args, **kwargs)
-        new_state = m.state_pytree()
-        if not with_value:
-            return new_state, None
-        b = _load(init())
-        b.update(*args, **kwargs)
-        b._update_count = 1
-        return new_state, b.compute()
 
     # Gather-typed states (buffers, cat/None/callable reductions) ride a
     # 1x-payload varying-typed all_gather; invariant typing is restored on
@@ -244,6 +258,13 @@ def make_step(
     )
 
     def compute(state: State) -> Any:
+        _obs_note_trace(_compute_label, _compute_token)
+        # span shares _compute_label ("X.step_compute") with the counter —
+        # and stays distinguishable from the eager Metric.compute span
+        with _obs_span(_compute_label, category="compute"):
+            return _compute_impl(state)
+
+    def _compute_impl(state: State) -> Any:
         if axis_name is not None:
             reduced: State = {}
             for name, value in state.items():
@@ -403,20 +424,43 @@ def make_epoch(
         new_state, _ = step(state, *args_b, **kwargs_b)
         return new_state, None
 
+    obs_name = type(metric).__name__
+    _epoch_label = f"{obs_name}.epoch"
+    _epoch_token = object()
+
     def epoch(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
-        if not mergeable:
-            return _epoch_scan(state, *batches, **kw_batches)
-        if with_values:
+        _obs_note_trace(_epoch_label, _epoch_token)
+        with _obs_span(_epoch_label, category="epoch"):
+            if not mergeable:
+                return _epoch_scan(state, *batches, **kw_batches)
+            if with_values:
+                return _epoch_vmap(state, *batches, **kw_batches)
+            _, _, leaves = _split(batches, kw_batches)
+            if all(getattr(a, "ndim", 0) >= 2 for a in leaves if _is_array(a)):
+                return _epoch_flat(state, *batches, **kw_batches)
+            # an array leaf with only the epoch axis (per-batch scalars, e.g.
+            # MeanMetric weights) has no sample axis to flatten into
             return _epoch_vmap(state, *batches, **kw_batches)
-        _, _, leaves = _split(batches, kw_batches)
-        if all(getattr(a, "ndim", 0) >= 2 for a in leaves if _is_array(a)):
-            return _epoch_flat(state, *batches, **kw_batches)
-        # an array leaf with only the epoch axis (per-batch scalars, e.g.
-        # MeanMetric weights) has no sample axis to flatten into
-        return _epoch_vmap(state, *batches, **kw_batches)
 
     if jit_epoch:
-        epoch = jax.jit(epoch, donate_argnums=0)
+        raw_jitted = jax.jit(epoch, donate_argnums=0)
+        jitted = _obs_track_compiles(raw_jitted, _epoch_label)
+
+        def epoch(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:  # noqa: F811
+            # fused-epoch launch accounting from the EAGER entry's argument
+            # shapes (host-side; the jitted program is untouched)
+            leaves = list(batches) + list(kw_batches.values())
+            n_batches = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
+            _obs_epoch_launch(_epoch_label, n_batches)
+            return jitted(state, *batches, **kw_batches)
+
+        # keep the jitted-callable surface usable through the accounting
+        # wrapper (AOT lowering, cache control, introspection)
+        epoch.__wrapped__ = raw_jitted
+        for attr in ("lower", "eval_shape", "trace", "clear_cache"):
+            if hasattr(raw_jitted, attr):
+                setattr(epoch, attr, getattr(raw_jitted, attr))
+
     return init, epoch, compute
 
 

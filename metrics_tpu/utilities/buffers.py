@@ -67,6 +67,11 @@ class CapacityBuffer:
         n = batch.shape[0]
         if self._host_count is not None:
             if self._host_count + n > self.capacity:
+                from metrics_tpu.obs.registry import enabled as _obs_enabled
+                from metrics_tpu.obs.registry import inc as _obs_inc
+
+                if _obs_enabled():
+                    _obs_inc("capacity_buffer.eager_overflows")
                 raise ValueError(
                     f"CapacityBuffer overflow: {self._host_count} + {n} > capacity {self.capacity}."
                     " Raise `sample_capacity` or switch to unbounded list states."
@@ -76,9 +81,18 @@ class CapacityBuffer:
             # post-boundary traced count: overflow silently clamps to the
             # tail. debug_checks arms a checkify guard for exactly this
             # (SURVEY §7 hard part 4) — surfaced by checkify.checkify(step).
+            # The obs layer counts every such clamp-RISK site (overflow is
+            # data-dependent and unknowable at trace time; the counter says
+            # how many appends ran without the host-count guard).
+            from metrics_tpu.obs.registry import enabled as _obs_enabled
+            from metrics_tpu.obs.registry import inc as _obs_inc
             from metrics_tpu.utilities.debug import debug_checks_enabled
 
+            if _obs_enabled():
+                _obs_inc("capacity_buffer.clamp_risk_appends")
             if debug_checks_enabled():
+                if _obs_enabled():
+                    _obs_inc("capacity_buffer.checkify_guards_armed")
                 from jax.experimental import checkify
 
                 checkify.check(

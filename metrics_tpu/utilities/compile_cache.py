@@ -11,10 +11,34 @@ CACHE_DIR = os.path.join(os.path.expanduser("~"), ".cache", "metrics_tpu_xla")
 
 
 def enable_persistent_cache() -> None:
-    try:
-        import jax
+    """Point jax at the shared on-disk compile cache.
 
+    Only the EXPECTED failure — a jax build without the cache knobs
+    (``jax.config.update`` raises ``AttributeError``/``KeyError`` for an
+    unrecognized option) — is swallowed, and even then a
+    ``rank_zero_debug`` line says the cache is disabled, so a silent
+    cold-compile-only run is diagnosable from the logs. Anything else
+    (import failure, permission error writing the config) propagates:
+    swallowing it used to hide real misconfiguration behind minutes of
+    recompiles.
+    """
+    import jax
+
+    from metrics_tpu.obs.registry import enabled as _obs_enabled
+    from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+
+    try:
         jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-    except Exception:  # older jax without the knob: cold compiles only
-        pass
+    except (AttributeError, KeyError) as err:  # older jax without the knob
+        from metrics_tpu.utilities.prints import rank_zero_debug
+
+        rank_zero_debug(
+            f"persistent XLA compile cache disabled (jax lacks the config knob: {err});"
+            " this process pays cold compiles only"
+        )
+        if _obs_enabled():
+            _obs_gauge("compile_cache.persistent_enabled", 0.0)
+        return
+    if _obs_enabled():
+        _obs_gauge("compile_cache.persistent_enabled", 1.0)

@@ -32,6 +32,13 @@ def debug_checks(enable: bool = True) -> bool:
     global _ENABLED
     previous = _ENABLED
     _ENABLED = bool(enable)
+    # mirror the toggle into the obs registry so a snapshot records whether
+    # the traced guards were armed during the run it describes
+    from metrics_tpu.obs.registry import enabled as _obs_enabled
+    from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+
+    if _obs_enabled():
+        _obs_gauge("debug.checks_enabled", 1.0 if _ENABLED else 0.0)
     return previous
 
 

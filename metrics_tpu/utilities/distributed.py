@@ -20,10 +20,26 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+
 Array = jax.Array
 
 # Reduction spec vocabulary shared with Metric.add_state's dist_reduce_fx.
 _SUM_LIKE = ("sum", "mean")
+
+
+def _obs_count_collective(op: str, nbytes: int) -> None:
+    """Count one collective + its per-device payload bytes.
+
+    For the in-jit SPMD helpers this fires at TRACE time (the only moment
+    Python runs under jit): the counters read "collectives emitted into the
+    program, with their static payload" — one increment per compiled
+    program, not per execution. The eager DCN gather counts per call.
+    """
+    if _obs_enabled():
+        _obs_inc("sync.collectives", op=op)
+        _obs_inc("sync.payload_bytes", float(nbytes), op=op)
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -99,14 +115,20 @@ def sync_reduce_in_context(
       with :func:`replicate_typed` before returning through
       ``out_specs=P()``.
     """
+    nbytes = x.size * x.dtype.itemsize if hasattr(x, "size") else 0
     if reduce_fx == "sum":
+        _obs_count_collective("psum", nbytes)
         return lax.psum(x, axis_name)
     if reduce_fx == "mean":
+        _obs_count_collective("pmean", nbytes)
         return lax.pmean(x, axis_name)
     if reduce_fx == "max":
+        _obs_count_collective("pmax", nbytes)
         return lax.pmax(x, axis_name)
     if reduce_fx == "min":
+        _obs_count_collective("pmin", nbytes)
         return lax.pmin(x, axis_name)
+    _obs_count_collective("all_gather", nbytes)
     gathered = _all_gather(x, axis_name, typed)  # (n_dev, ...) leading axis
     if reduce_fx == "cat":
         return gathered.reshape((-1,) + x.shape[1:]) if x.ndim >= 1 else gathered.reshape(-1)
@@ -239,6 +261,7 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typ
     if buf.data is None:  # SPMD symmetry: no device appended anything
         return merged
     item_shape = buf.data.shape[1:]
+    _obs_count_collective("buffer_gather", buf.data.size * buf.data.dtype.itemsize)
     if buf._host_count is not None:
         # static count: gather only the filled prefix — the collective moves
         # n*c rows, not n*capacity
@@ -310,6 +333,9 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     """
     if jax.process_count() == 1:
         return [result]
+    if _obs_enabled():
+        _obs_inc("sync.gathers")
+        _obs_inc("sync.payload_bytes", float(result.size * result.dtype.itemsize), op="process_allgather")
     from jax.experimental import multihost_utils
 
     local_size = jnp.asarray(result.shape, dtype=jnp.int32)

@@ -13,13 +13,50 @@ from typing import Any, Callable
 _logger = logging.getLogger("metrics_tpu")
 
 
-def _get_rank() -> int:
+def _jax_distributed_initialized() -> bool:
+    """True when ``jax.distributed.initialize`` has run (DCN world exists)."""
     try:
         import jax
 
-        return jax.process_index()
+        if hasattr(jax.distributed, "is_initialized"):  # jax >= 0.4.34
+            return bool(jax.distributed.is_initialized())
+        from jax._src import distributed
+
+        return getattr(distributed.global_state, "client", None) is not None
     except Exception:
-        return int(os.environ.get("LOCAL_RANK", 0))
+        return False
+
+
+def _backend_already_initialized() -> bool:
+    """True when an XLA backend is ALREADY live — without creating one.
+
+    ``jax.process_index()`` initializes the backend as a side effect, which
+    an early log line must never trigger (it would pin the platform before
+    user code gets to configure it, e.g. conftest's 8-virtual-device mesh).
+    """
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return bool(xla_bridge.backends_are_initialized())
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
+def _get_rank() -> int:
+    # only consult jax when doing so cannot initialize the backend as a side
+    # effect: either the distributed runtime is up (process_index is then
+    # authoritative) or a backend already exists. Otherwise fall back to the
+    # launcher-provided env var.
+    try:
+        if _jax_distributed_initialized() or _backend_already_initialized():
+            import jax
+
+            return jax.process_index()
+    except Exception:
+        pass
+    return int(os.environ.get("LOCAL_RANK", 0))
 
 
 def rank_zero_only(fn: Callable) -> Callable:

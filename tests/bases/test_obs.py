@@ -1,0 +1,366 @@
+"""Observability layer (``metrics_tpu.obs``): HLO identity when disabled,
+named scopes + counters + recompile telemetry + export when enabled.
+
+The load-bearing test is :func:`test_disabled_hlo_byte_identical`: with the
+layer off (the default), the lowered program of a jitted ``make_step`` must
+be byte-identical to one built with every instrumentation hook monkeypatched
+to a literal no-op — i.e. the disabled mode adds NOTHING to compiled code,
+so production paths pay nothing for the layer existing.
+"""
+import warnings
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.metric as metric_mod
+import metrics_tpu.obs as obs
+import metrics_tpu.steps as steps_mod
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.steps import make_epoch, make_step
+from metrics_tpu.utilities.buffers import CapacityBuffer
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Every test starts disabled with an empty registry and restores both."""
+    prev = obs.enable(False)
+    obs.reset()
+    yield
+    obs.enable(prev)
+    obs.reset()
+
+
+def _compiled_hlo(fn, *args) -> str:
+    """Compiled HLO text — named scopes land in per-op ``op_name`` metadata,
+    so enabled/disabled programs are distinguishable, while Python frame
+    bookkeeping (which shifts with the test harness) does not leak in."""
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+_PREDS = jnp.asarray([0, 1, 2, 2])
+_TARGET = jnp.asarray([0, 1, 1, 2])
+
+
+@contextmanager
+def _instrumentation_bypassed():
+    """Replace every obs hook the step path runs with a literal no-op."""
+
+    @contextmanager
+    def null_span(*args, **kwargs):
+        yield
+
+    saved = (
+        steps_mod._obs_span,
+        steps_mod._obs_note_trace,
+        metric_mod._obs_span,
+        metric_mod._obs_enabled,
+    )
+    steps_mod._obs_span = null_span
+    steps_mod._obs_note_trace = lambda *a, **k: None
+    metric_mod._obs_span = null_span
+    metric_mod._obs_enabled = lambda: False
+    try:
+        yield
+    finally:
+        (
+            steps_mod._obs_span,
+            steps_mod._obs_note_trace,
+            metric_mod._obs_span,
+            metric_mod._obs_enabled,
+        ) = saved
+
+
+class TestDisabledIsFree:
+    def test_disabled_hlo_byte_identical(self):
+        """Disabled-mode compiled HLO == HLO with hooks physically absent."""
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        hlo_disabled = _compiled_hlo(step, init(), _PREDS, _TARGET)
+        with _instrumentation_bypassed():
+            init2, step2, _ = make_step(Accuracy, num_classes=3)
+            hlo_bypassed = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
+        assert hlo_disabled == hlo_bypassed
+
+    def test_enable_disable_round_trip_identical(self):
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        before = _compiled_hlo(step, init(), _PREDS, _TARGET)
+        obs.enable()
+        initE, stepE, _ = make_step(Accuracy, num_classes=3)
+        _compiled_hlo(stepE, initE(), _PREDS, _TARGET)
+        obs.enable(False)
+        init3, step3, _ = make_step(Accuracy, num_classes=3)
+        after = _compiled_hlo(step3, init3(), _PREDS, _TARGET)
+        assert before == after
+
+    def test_disabled_records_nothing(self):
+        acc = Accuracy()
+        acc(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.compute()
+        acc.reset()
+        snap = obs.snapshot()
+        assert snap["counters"] == {}
+        assert snap["gauges"] == {}
+        assert snap["spans"] == []
+
+
+class TestLifecycleTracing:
+    def test_enabled_lowering_carries_named_scopes(self):
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
+        assert "Accuracy.step" not in hlo_off
+        obs.enable()
+        init2, step2, _ = make_step(Accuracy, num_classes=3)
+        hlo_on = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
+        assert "Accuracy.step" in hlo_on
+        assert "Accuracy.update" in hlo_on
+
+    def test_span_per_lifecycle_phase(self):
+        obs.enable()
+        acc = Accuracy()
+        acc(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))  # forward (+update)
+        acc.update(jnp.asarray([0.7]), jnp.asarray([1]))
+        acc.compute()
+        acc.reset()
+        categories = {s.get("category") for s in obs.spans()}
+        assert {"forward", "update", "compute", "reset"} <= categories
+        names = [s["name"] for s in obs.spans()]
+        assert "Accuracy.update" in names and "Accuracy.forward" in names
+
+    def test_sync_span_and_counter(self):
+        obs.enable()
+        acc = Accuracy()
+        acc.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.sync(should_sync=True, distributed_available_fn=lambda: True)
+        acc.unsync()
+        assert obs.get_counter("metric.syncs", metric="Accuracy") == 1
+        assert "Accuracy.sync" in [s["name"] for s in obs.spans()]
+        assert {"sync"} <= {s.get("category") for s in obs.spans()}
+
+    def test_nested_spans_carry_depth(self):
+        obs.enable()
+        acc = Accuracy()
+        acc(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        spans = obs.spans()
+        fwd = next(s for s in spans if s["name"] == "Accuracy.forward")
+        upd = next(s for s in spans if s["name"] == "Accuracy.update")
+        assert upd["depth"] > fwd["depth"] == 0
+
+    def test_span_ring_keeps_newest(self):
+        """A full span log evicts the OLDEST entry — the window must show
+        recent activity, not freeze on run-start warmup."""
+        obs.enable()
+        prev = obs.configure(max_spans=4)
+        try:
+            for i in range(6):
+                obs._registry.record_span(f"span{i}", 1.0, 0)
+            names = [s["name"] for s in obs.spans()]
+            assert names == ["span2", "span3", "span4", "span5"]
+            assert obs.get_counter("obs.spans_dropped") == 2
+        finally:
+            obs.configure(**prev)
+
+    def test_collection_spans(self):
+        obs.enable()
+        coll = MetricCollection([Accuracy()])
+        coll.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        coll.compute()
+        names = [s["name"] for s in obs.spans()]
+        assert "MetricCollection.update" in names
+        assert "MetricCollection.compute" in names
+
+
+class TestCounters:
+    def test_update_and_state_bytes(self):
+        obs.enable()
+        acc = Accuracy()
+        acc.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.update(jnp.asarray([0.7]), jnp.asarray([1]))
+        assert obs.get_counter("metric.updates", metric="Accuracy") == 2
+        assert obs.get_gauge("metric.state_bytes", metric="Accuracy") is None  # not yet computed
+        acc.compute()
+        # Accuracy keeps 4 int32 scalar stat-score states = 16 bytes
+        assert obs.get_gauge("metric.state_bytes", metric="Accuracy") == 16.0
+
+    def test_two_device_sync_counts_and_payload_bytes(self):
+        obs.enable()
+        init, step, compute = make_step(Accuracy, num_classes=3, axis_name="dp")
+
+        def shard_fn(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        out = jax.pmap(shard_fn, axis_name="dp")(
+            jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]]),
+            jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]]),
+        )
+        assert float(out[0]) == float(out[1]) == 0.75
+        counters = obs.counters()
+        sync_count = sum(v for k, v in counters.items() if k.startswith("sync.collectives"))
+        payload = sum(v for k, v in counters.items() if k.startswith("sync.payload_bytes"))
+        assert sync_count > 0
+        assert payload > 0
+        assert obs.get_gauge("metric.state_bytes", metric="Accuracy") > 0
+
+    def test_capacity_buffer_eager_overflow_counted(self):
+        obs.enable()
+        buf = CapacityBuffer(2)
+        buf.append(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(ValueError, match="overflow"):
+            buf.append(jnp.asarray([3.0]))
+        assert obs.get_counter("capacity_buffer.eager_overflows") == 1
+
+    def test_capacity_buffer_clamp_risk_counted_under_trace(self):
+        obs.enable()
+
+        def traced(data, count):
+            buf = CapacityBuffer(4)
+            buf.append(jnp.zeros((2,)))
+            buf.count = count  # simulate a scan-carried (traced) count
+            buf._host_count = None
+            buf.append(data)
+            return buf.data
+
+        jax.jit(traced).lower(jnp.ones((2,)), jnp.asarray(2, jnp.int32))
+        assert obs.get_counter("capacity_buffer.clamp_risk_appends") >= 1
+
+
+class TestRecompileTelemetry:
+    def test_traces_counted_and_storm_warns_at_threshold(self):
+        obs.enable()
+        prev = obs.configure(recompile_warn_threshold=3)
+        try:
+            init, step, _ = make_step(Accuracy, num_classes=3)
+            jstep = jax.jit(step)
+            for n in (4, 8):  # two distinct shapes: below threshold, no warning
+                jstep(init(), jnp.arange(n) % 3, (jnp.arange(n) + 1) % 3)
+            assert obs.get_counter("step.traces", step="Accuracy.step") == 2
+            with pytest.warns(UserWarning, match="Recompile storm"):
+                jstep(init(), jnp.arange(16) % 3, (jnp.arange(16) + 1) % 3)
+            assert obs.get_counter("step.traces", step="Accuracy.step") == 3
+        finally:
+            obs.configure(**prev)
+
+    def test_no_false_storm_across_distinct_factories(self):
+        """N separate make_step(Accuracy) factories tracing ONCE each must
+        not pool into a fake storm (the threshold is per factory, even
+        though the public step.traces counter aggregates by label)."""
+        obs.enable()
+        prev = obs.configure(recompile_warn_threshold=3)
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                for _ in range(4):
+                    init, step, _ = make_step(Accuracy, num_classes=3)
+                    jax.jit(step)(init(), _PREDS, _TARGET)
+            assert obs.get_counter("step.traces", step="Accuracy.step") == 4
+            assert not any("Recompile storm" in str(w.message) for w in caught)
+        finally:
+            obs.configure(**prev)
+
+    def test_epoch_compile_run_split_and_launch_accounting(self):
+        obs.enable()
+        init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+        preds = jnp.asarray([[0, 1], [2, 1]])
+        target = jnp.asarray([[0, 1], [2, 0]])
+        state, _ = epoch(init(), preds, target)
+        state, _ = epoch(state, preds, target)
+        assert float(compute(state)) == 0.75
+        assert obs.get_counter("compiles", step="Accuracy.epoch") == 1
+        assert obs.get_counter("runs", step="Accuracy.epoch") == 1
+        assert obs.get_counter("compile_seconds", step="Accuracy.epoch") > 0
+        assert obs.get_counter("epoch.launches", step="Accuracy.epoch") == 2
+        assert obs.get_counter("epoch.batches_folded", step="Accuracy.epoch") == 4
+        assert obs.get_gauge("epoch.batches_per_launch", step="Accuracy.epoch") == 2
+
+    def test_backend_compile_listener_counts_once_per_program(self):
+        """One jitted program == one jax.compiles increment (the listener
+        must not also count the jaxpr-trace / MLIR-lowering / cache-hit
+        events whose names merely contain 'compile')."""
+        import time
+
+        obs.enable()
+        assert obs.install_compile_listener()
+        x = jnp.asarray(2.0)
+        _ = float(x + 1)  # warm the implicit convert/add programs first
+        before = obs.get_counter("jax.compiles")
+        seconds_before = obs.get_counter("jax.compile_seconds")
+        # a constant unique to this run keeps the program out of any warm
+        # persistent compile cache
+        c = float(int(time.time() * 1000) % 100003) + 2.0
+        jax.jit(lambda v: v * c + 1)(x)
+        assert obs.get_counter("jax.compiles") == before + 1
+        assert obs.get_counter("jax.compile_seconds") > seconds_before
+
+    def test_eager_calls_counted_separately(self):
+        obs.enable()
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        step(init(), _PREDS, _TARGET)  # eager: body runs outside any trace
+        assert obs.get_counter("step.eager_calls", step="Accuracy.step") == 1
+        assert obs.get_counter("step.traces", step="Accuracy.step") == 0
+
+    def test_epoch_wrapper_keeps_jitted_surface(self):
+        """The launch-accounting wrapper must not hide the jit object's AOT
+        surface (lower/eval_shape/...) the docstring promises."""
+        init, epoch, _ = make_epoch(Accuracy, num_classes=3)
+        preds = jnp.asarray([[0, 1], [2, 1]])
+        target = jnp.asarray([[0, 1], [2, 0]])
+        lowered = epoch.lower(init(), preds, target)
+        assert "jit" in lowered.as_text()
+        assert hasattr(epoch, "__wrapped__")
+
+
+class TestExport:
+    def test_snapshot_shape_and_prometheus_text(self):
+        obs.enable()
+        acc = Accuracy()
+        acc.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.compute()  # records the state_bytes gauge
+        snap = obs.snapshot()
+        assert snap["enabled"] is True
+        assert snap["counters"]["metric.updates{metric=Accuracy}"] == 1.0
+        text = obs.to_prometheus(snap)
+        assert "# TYPE metrics_tpu_metric_updates counter" in text
+        assert 'metrics_tpu_metric_updates{metric="Accuracy"} 1' in text
+        assert "# TYPE metrics_tpu_metric_state_bytes gauge" in text
+
+    def test_label_values_sanitized_for_export(self):
+        """Label values containing ',', '=', or quotes must not corrupt the
+        flat series key or the Prometheus exposition text."""
+        obs.enable()
+        obs.inc("x", tag='a,b=c"d')
+        assert obs.get_counter("x", tag='a,b=c"d') == 1.0  # same sanitization on read
+        text = obs.to_prometheus()
+        assert 'metrics_tpu_x{tag="a_b_c_d"} 1' in text
+
+    def test_json_round_trip(self, tmp_path):
+        import json
+
+        obs.enable()
+        obs.inc("demo.counter", 2.5, kind="x")
+        path = tmp_path / "obs.json"
+        text = obs.to_json(path=str(path))
+        loaded = json.loads(text)
+        assert loaded["counters"]["demo.counter{kind=x}"] == 2.5
+        assert json.loads(path.read_text()) == loaded
+
+    def test_reset_clears_but_keeps_enabled(self):
+        obs.enable()
+        obs.inc("x")
+        obs.reset()
+        assert obs.enabled() is True
+        assert obs.counters() == {}
+
+
+class TestStepWrappers:
+    def test_mse_step_under_obs_matches_plain(self):
+        """Enabled instrumentation must not change values (non-mergeable path)."""
+        preds = jnp.asarray([0.5, 1.5, 2.0])
+        target = jnp.asarray([1.0, 1.0, 2.0])
+        init, step, compute = make_step(MeanSquaredError)
+        state, _ = step(init(), preds, target)
+        expected = float(compute(state))
+        obs.enable()
+        init2, step2, compute2 = make_step(MeanSquaredError)
+        state2, _ = jax.jit(step2)(init2(), preds, target)
+        assert float(compute2(state2)) == pytest.approx(expected)

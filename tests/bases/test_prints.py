@@ -1,0 +1,85 @@
+"""Rank resolution must never initialize the XLA backend as a side effect.
+
+``jax.process_index()`` spins up the backend if none exists — an early
+``rank_zero_warn`` (e.g. at import time, before conftest configures the
+8-virtual-device mesh) must therefore consult jax only when the distributed
+runtime or a backend is ALREADY live, and otherwise read the launcher's
+``LOCAL_RANK`` env var.
+"""
+import jax
+import pytest
+
+from metrics_tpu.utilities import prints
+
+
+def test_rank_zero_with_live_backend():
+    # the test process has a backend (conftest initialized it): process_index
+    # is authoritative and this single-process run is rank 0
+    assert prints._backend_already_initialized()
+    assert prints._get_rank() == 0
+
+
+def test_early_call_uses_env_not_process_index(monkeypatch):
+    """Before any backend exists, _get_rank must not touch jax at all."""
+    monkeypatch.setattr(prints, "_jax_distributed_initialized", lambda: False)
+    monkeypatch.setattr(prints, "_backend_already_initialized", lambda: False)
+
+    def _boom():
+        raise AssertionError("jax.process_index() was called — would initialize the backend")
+
+    monkeypatch.setattr(jax, "process_index", _boom)
+    monkeypatch.setenv("LOCAL_RANK", "3")
+    assert prints._get_rank() == 3
+
+
+def test_early_call_defaults_to_rank_zero(monkeypatch):
+    monkeypatch.setattr(prints, "_jax_distributed_initialized", lambda: False)
+    monkeypatch.setattr(prints, "_backend_already_initialized", lambda: False)
+    monkeypatch.delenv("LOCAL_RANK", raising=False)
+    assert prints._get_rank() == 0
+
+
+def test_distributed_initialized_wins_over_env(monkeypatch):
+    """With the DCN runtime up, process_index is authoritative — LOCAL_RANK
+    (which a launcher may set per-node, not per-process) is ignored."""
+    monkeypatch.setattr(prints, "_jax_distributed_initialized", lambda: True)
+    monkeypatch.setattr(jax, "process_index", lambda: 7)
+    monkeypatch.setenv("LOCAL_RANK", "3")
+    assert prints._get_rank() == 7
+
+
+def test_rank_zero_only_respects_rank(monkeypatch):
+    calls = []
+    gated = prints.rank_zero_only(lambda: calls.append(1))
+    monkeypatch.setattr(prints, "_get_rank", lambda: 1)
+    assert gated() is None
+    assert calls == []
+    monkeypatch.setattr(prints, "_get_rank", lambda: 0)
+    gated()
+    assert calls == [1]
+
+
+def test_rank_zero_warn_emits(recwarn):
+    prints.rank_zero_warn("obs test warning", UserWarning)
+    assert any("obs test warning" in str(w.message) for w in recwarn.list)
+
+
+def test_process_index_failure_falls_back_to_env(monkeypatch):
+    """Even when the probes say jax is safe to consult, a process_index
+    failure must degrade to the env var, not propagate."""
+    monkeypatch.setattr(prints, "_jax_distributed_initialized", lambda: True)
+
+    def _boom():
+        raise RuntimeError("backend gone")
+
+    monkeypatch.setattr(jax, "process_index", _boom)
+    monkeypatch.setenv("LOCAL_RANK", "2")
+    assert prints._get_rank() == 2
+
+
+@pytest.mark.parametrize("value", ["0", "5"])
+def test_local_rank_parsed_as_int(monkeypatch, value):
+    monkeypatch.setattr(prints, "_jax_distributed_initialized", lambda: False)
+    monkeypatch.setattr(prints, "_backend_already_initialized", lambda: False)
+    monkeypatch.setenv("LOCAL_RANK", value)
+    assert prints._get_rank() == int(value)

@@ -1,0 +1,60 @@
+"""CI smoke: jitted ``make_step`` + ``make_epoch`` with observability ON.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.obs_smoke`` (the CI
+tier-1 job does). Asserts that the enabled obs layer does not break tracing
+or change values, that counters/annotations actually record, and that the
+export surface produces output — the cheap end-to-end arm of the pinned
+unit tests in ``tests/bases/test_obs.py``.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    import metrics_tpu.obs as obs
+    from metrics_tpu import Accuracy
+    from metrics_tpu.steps import make_epoch, make_step
+
+    obs.enable()
+    obs.install_compile_listener()
+
+    # jitted step: two shapes -> two tracings, values unchanged
+    init, step, compute = make_step(Accuracy, num_classes=3)
+    jstep = jax.jit(step)
+    state = init()
+    state, v1 = jstep(state, jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+    state, v2 = jstep(state, jnp.asarray([1, 1, 0, 2, 0, 1]), jnp.asarray([0, 1, 0, 2, 0, 1]))
+    assert float(v1) == 0.75, float(v1)
+    assert abs(float(compute(state)) - 0.8) < 1e-6, float(compute(state))
+    assert obs.get_counter("step.traces", step="Accuracy.step") == 2
+
+    # named scopes in the compiled program
+    hlo = jax.jit(step).lower(init(), jnp.asarray([0, 1]), jnp.asarray([0, 1])).compile().as_text()
+    assert "Accuracy.step" in hlo, "named scope missing from compiled HLO"
+
+    # fused epoch: compile/run split + launch accounting
+    initE, epoch, computeE = make_epoch(Accuracy, num_classes=3)
+    preds = jnp.asarray([[0, 1], [2, 1]])
+    target = jnp.asarray([[0, 1], [2, 0]])
+    st, _ = epoch(initE(), preds, target)
+    st, _ = epoch(st, preds, target)
+    assert float(computeE(st)) == 0.75
+    assert obs.get_counter("compiles", step="Accuracy.epoch") == 1
+    assert obs.get_counter("runs", step="Accuracy.epoch") == 1
+    assert obs.get_counter("epoch.batches_folded", step="Accuracy.epoch") == 4
+
+    # export surface produces output
+    snap = obs.snapshot()
+    assert snap["counters"], "empty counter snapshot"
+    text = obs.to_prometheus(snap)
+    assert "metrics_tpu_step_traces" in text, text[:200]
+    print("obs smoke OK:", len(snap["counters"]), "counter series,",
+          f"{obs.get_counter('jax.compile_seconds'):.2f}s backend compile time")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,3 +119,79 @@ def test_logger_step_values_survive_epoch_close():
     logger.epoch_values()  # close epoch first ...
     step = logger.step_values()  # ... final batch's step values still there
     assert "acc" in step
+
+
+def test_logger_history_archives_across_epochs():
+    """history[e] is exactly what epoch_values() returned for epoch e."""
+    rng = np.random.default_rng(7)
+    logger = MetricLogger()
+    acc = Accuracy()
+    returned = []
+    for epoch in range(3):
+        for _ in range(2):
+            p, t = rng.uniform(0, 1, 16), rng.integers(0, 2, 16)
+            logger.log("acc", acc, jnp.asarray(p), jnp.asarray(t))
+            logger.log("loss", float(p.mean()))
+        returned.append(logger.epoch_values())
+    assert len(logger.history) == 3
+    for archived, ret in zip(logger.history, returned):
+        assert archived.keys() == ret.keys() == {"acc", "loss"}
+        assert float(archived["acc"]) == float(ret["acc"])
+        assert archived["loss"] == ret["loss"]
+    assert acc._update_count == 0  # epoch close reset the metric
+
+
+def test_logger_epoch_values_without_reset_does_not_archive():
+    logger = MetricLogger()
+    acc = Accuracy()
+    logger.log("acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    peek = logger.epoch_values(reset=False)
+    assert float(peek["acc"]) == 1.0
+    assert logger.history == []
+    assert acc._update_count == 1  # state survives the peek
+    final = logger.epoch_values()
+    assert float(final["acc"]) == 1.0
+    assert len(logger.history) == 1
+
+
+def test_logger_mixed_metric_and_scalar():
+    """Metric objects and plain scalars share one epoch cleanly."""
+    logger = MetricLogger()
+    acc = Accuracy()
+    logger.log("acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    logger.log("loss", 0.5)
+    logger.log("lr", 1e-3)
+    step = logger.step_values()
+    assert set(step) == {"acc", "loss", "lr"}
+    logger.log("acc", acc, jnp.asarray([0.1]), jnp.asarray([1]))
+    logger.log("loss", 0.3)
+    vals = logger.epoch_values()
+    assert set(vals) == {"acc", "loss", "lr"}
+    np.testing.assert_allclose(float(vals["acc"]), 2 / 3, atol=1e-6)
+    np.testing.assert_allclose(vals["loss"], 0.4, atol=1e-9)  # mean of the buffer
+    assert vals["lr"] == pytest.approx(1e-3)
+    # scalar buffers cleared with the epoch
+    assert logger.epoch_values() == {}
+
+
+def test_logger_obs_history_archived_when_enabled():
+    import metrics_tpu.obs as obs
+
+    logger = MetricLogger()
+    acc = Accuracy()
+    logger.log("acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    logger.epoch_values()
+    assert logger.obs_history == [None]  # disabled epoch: placeholder keeps alignment
+    prev = obs.enable()
+    try:
+        obs.reset()
+        logger.log("acc", acc, jnp.asarray([0.9]), jnp.asarray([1]))
+        logger.epoch_values()
+        # index-parallel with history even across the mid-run toggle
+        assert len(logger.obs_history) == len(logger.history) == 2
+        snap = logger.obs_history[1]
+        assert snap["counters"]["metric.forwards{metric=Accuracy}"] >= 1
+        assert "obs" not in logger.history[-1]
+    finally:
+        obs.enable(prev)
+        obs.reset()
