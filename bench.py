@@ -434,6 +434,64 @@ def base_bertscore() -> float:
         return _min_ms(run, n_trials=2)
 
 
+def bench_checkpoint() -> dict:
+    """Checkpoint save/restore latency over a realistic eval-sweep state.
+
+    A 1M-sample f32 ``CapacityBuffer``-backed AUROC (the heaviest ordinary
+    checkpoint payload: ~8 MB of cat-state plus scalars). Three numbers:
+
+    - ``checkpoint_save_1M_sync`` — full blocking ``CheckpointManager.save``
+      (stage + orbax write + manifest + atomic rename + rotation).
+    - ``checkpoint_save_1M_async_stall`` — the time ``save()`` holds the
+      training loop in async mode: the main-thread state snapshot only,
+      persistence rides the background worker (drained before each timing
+      so successive saves never queue).
+    - ``checkpoint_restore_1M`` — latest-checkpoint discovery + orbax read
+      + state load, the resume-path cost after a preemption.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC
+    from metrics_tpu.ft import CheckpointManager
+
+    n = N_SAMPLES
+    metric = AUROC(sample_capacity=n)
+    key = jax.random.PRNGKey(3)
+    preds = jax.random.uniform(key, (n,), dtype=jnp.float32)
+    target = jax.random.bernoulli(jax.random.PRNGKey(4), 0.5, (n,)).astype(jnp.int32)
+    metric.update(preds, target)
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt.")
+    out: dict = {}
+    try:
+        sync_mgr = CheckpointManager(os.path.join(root, "sync"), keep_last=2)
+        out["checkpoint_save_1M_sync"] = _min_ms(lambda: sync_mgr.save(metric), n_trials=3)
+
+        async_mgr = CheckpointManager(os.path.join(root, "async"), keep_last=2, async_save=True)
+        # warm + measure only the save() call (the stall), not the drain;
+        # each wait() between timings keeps the NEXT timed save from queuing
+        async_mgr.save(metric)
+        async_mgr.wait()
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            async_mgr.save(metric)
+            times.append((time.perf_counter() - t0) * 1000.0)
+            async_mgr.wait()
+        out["checkpoint_save_1M_async_stall"] = min(times)
+
+        restored = AUROC(sample_capacity=n)
+        out["checkpoint_restore_1M"] = _min_ms(lambda: sync_mgr.restore(restored), n_trials=3)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def bench_probes() -> dict:
     """Chip-state calibration probes, one per op class.
 
@@ -807,6 +865,33 @@ def main(json_path: "str | None" = None) -> None:
         )
     except (subprocess.SubprocessError, OSError, KeyError, ValueError) as err:
         print(f"SKIPPED buffer_sync_1M_8dev_compute: {err}", file=sys.stderr)
+
+    # fault-tolerance: checkpoint save/restore latency, sync vs async (the
+    # async row's ratio is the training-loop stall saved by the background
+    # writer — an A/B on the same manager/state, not a torch baseline)
+    try:
+        ckpt = section(bench_checkpoint)
+        sync_ms = ckpt["checkpoint_save_1M_sync"]
+        emit(
+            "checkpoint_save_1M_sync",
+            sync_ms,
+            prior.get("checkpoint_save_1M_sync", sync_ms),
+            baseline="best_prior_self",
+        )
+        emit(
+            "checkpoint_save_1M_async_stall",
+            ckpt["checkpoint_save_1M_async_stall"],
+            sync_ms,
+            baseline="sync_save_same_state",
+        )
+        emit(
+            "checkpoint_restore_1M",
+            ckpt["checkpoint_restore_1M"],
+            prior.get("checkpoint_restore_1M", ckpt["checkpoint_restore_1M"]),
+            baseline="best_prior_self",
+        )
+    except Exception as err:  # noqa: BLE001 — a missing orbax must not kill the sweep
+        print(f"SKIPPED checkpoint rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

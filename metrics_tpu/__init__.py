@@ -112,6 +112,7 @@ from metrics_tpu.text import (  # noqa: E402, F401
     WordInfoLost,
     WordInfoPreserved,
 )
+from metrics_tpu import ft  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
 from metrics_tpu.steps import make_epoch, make_step  # noqa: E402, F401
 from metrics_tpu.utilities.debug import debug_checks  # noqa: E402, F401
@@ -182,6 +183,7 @@ __all__ = [
     "make_epoch",
     "make_step",
     "debug_checks",
+    "ft",
     "obs",
     "MultioutputWrapper",
     "MaxMetric",

@@ -292,6 +292,59 @@ class MetricCollection(dict):
             self._compute_groups_create_state_ref(copy=True)
         self._state_is_copy = False
 
+    def _resync_compute_groups_after_restore(self) -> None:
+        """Re-establish group bookkeeping after members were restored
+        individually (checkpoint load).
+
+        A restored member holds real state, never a reference to its group
+        representative, so ``_state_is_copy`` must drop. And when the
+        restored states contradict the discovered grouping (the checkpoint
+        came from a differently-grouped or groups-off collection), keeping
+        the groups would have the next ``update`` touch only the
+        representative and the next ``compute`` alias its state over the
+        differing restored member state — silently discarding it. Groups
+        are then re-derived from scratch on the next update.
+        """
+        self._state_is_copy = False
+        if not self._groups_checked:
+            return
+        consistent = all(
+            self._equal_metric_states(self[group[0]], self[name])
+            for group in self._groups.values()
+            for name in group[1:]
+        )
+        if consistent:
+            return
+        if isinstance(self._enable_compute_groups, list):
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "Restored member states contradict the user-specified `compute_groups`;"
+                " dissolving the groups so the restored state survives. Check that the"
+                " checkpoint was saved from an identically-grouped collection.",
+                UserWarning,
+            )
+        self._groups = {i: [name] for i, name in enumerate(self.keys(keep_base=True))}
+        self._groups_checked = False
+
+    def save(self, path: Any) -> None:
+        """Atomically persist every member's state to ``path`` (orbax tree
+        keyed by metric name; see ``utilities/checkpoint.save_state``). For
+        rotation, manifests and async saves use
+        :class:`metrics_tpu.ft.CheckpointManager`."""
+        from metrics_tpu.utilities.checkpoint import save_state
+
+        save_state(path, self)
+
+    def restore(self, path: Any) -> "MetricCollection":
+        """Restore member states saved by :meth:`save`; returns ``self``.
+        Compute-group bookkeeping is re-synced so a post-restore ``update``
+        cannot clobber restored non-representative state."""
+        from metrics_tpu.utilities.checkpoint import restore_state
+
+        restore_state(path, self)
+        return self
+
     # ------------------------------------------------------------------
     # dict protocol with prefix/postfix
     # ------------------------------------------------------------------

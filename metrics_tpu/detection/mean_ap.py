@@ -259,14 +259,27 @@ class MeanAveragePrecision(Metric):
         Rank r's ``img_idx`` values are shifted by the total image count of
         ranks 0..r-1 so per-image grouping survives the gather (the flat-
         buffer analogue of the reference's list-of-tensors gather).
+
+        Like ``Metric._sync_dist``, degradation is atomic: the 8 gathers
+        here must agree on the world — local detections against globally
+        gathered ground truths would mass-produce false negatives, and a
+        degraded ``gathered_counts`` shorter than the box chunk lists
+        would break the offset arithmetic. If any gather degrades to its
+        per-host partial, the whole sync falls back to local-only state.
         """
+        from metrics_tpu.ft.retry import degraded_sync_scope
+
         group = process_group or self.process_group
+        names = ("det_boxes", "det_scores", "det_labels", "det_img_idx", "gt_boxes", "gt_labels", "gt_img_idx")
+        local = {name: _cat_or_empty(getattr(self, name), name) for name in names}
         gathered: Dict[str, List] = {}
-        for name in ("det_boxes", "det_scores", "det_labels", "det_img_idx", "gt_boxes", "gt_labels", "gt_img_idx"):
-            value = getattr(self, name)
-            cat = _cat_or_empty(value, name)
-            gathered[name] = dist_sync_fn(cat, group=group)
-        gathered_counts = dist_sync_fn(self.n_images, group=group)
+        with degraded_sync_scope() as scope:
+            for name in names:
+                gathered[name] = dist_sync_fn(local[name], group=group)
+            gathered_counts = dist_sync_fn(self.n_images, group=group)
+        if scope["degraded"]:
+            gathered = {name: [local[name]] for name in names}
+            gathered_counts = [self.n_images]
 
         offsets = np.concatenate([[0], np.cumsum([int(c) for c in gathered_counts])])
         for name in ("det_img_idx", "gt_img_idx"):

@@ -1,0 +1,59 @@
+"""``metrics_tpu.ft`` — fault tolerance for preemptible, flaky fleets.
+
+Production-scale eval on preemptible TPU pods fails in three ways the core
+library must survive (the ROADMAP north star): the process is **killed**
+mid-sweep (preemption), a peer host is **flaky** during an eager DCN sync,
+and a checkpoint write is **torn** by the kill. Four components, one per
+failure mode plus the tooling to prove them:
+
+1. :class:`~metrics_tpu.ft.manager.CheckpointManager` — atomic
+   (stage + rename) rotating checkpoints with async background saves,
+   monotonic latest-checkpoint discovery and a bundled manifest
+   (watermark, topology, obs snapshot, logger history).
+2. :class:`~metrics_tpu.ft.journal.BatchJournal` — exactly-once batch
+   accounting: a monotonic ``(epoch, step)`` watermark saved with every
+   checkpoint; on restore the :class:`~metrics_tpu.ft.journal.ResumeCursor`
+   tells the loop (or ``make_epoch``'s ``resume_from=``) which batches are
+   already folded, so a preempted run resumes with bitwise-identical
+   ``compute()`` — no drops, no double counts.
+3. :mod:`~metrics_tpu.ft.retry` — retry/timeout/backoff around the eager
+   DCN collectives with a degraded local-only fallback: exhausted retries
+   return per-host partial results, warn once, and bump
+   ``ft.retries``/``ft.degraded_syncs`` in the obs registry instead of
+   hanging the fleet.
+4. :mod:`~metrics_tpu.ft.faults` — the fault-injection harness (transient
+   gather failures, crash-mid-save, clock-skewed manifests) the
+   kill-and-resume and degraded-sync tests are built on.
+
+Convenience surface: ``Metric.save(path)`` / ``Metric.restore(path)`` and
+the :class:`~metrics_tpu.collections.MetricCollection` equivalents wrap
+the atomic single-checkpoint path; reach for the manager when you need
+rotation, manifests or async saves. See ``docs/fault_tolerance.md``.
+"""
+from metrics_tpu.ft import faults  # noqa: F401  (import order: retry consumes it)
+from metrics_tpu.ft.journal import BatchJournal, ResumeCursor, trim_epoch_batches
+from metrics_tpu.ft.retry import (
+    AttemptTimeout,
+    DegradedSyncError,
+    RetryPolicy,
+    call_with_retries,
+    configure_retries,
+    get_retry_policy,
+    reset_degraded_warnings,
+)
+from metrics_tpu.ft.manager import CheckpointManager
+
+__all__ = [
+    "AttemptTimeout",
+    "BatchJournal",
+    "CheckpointManager",
+    "DegradedSyncError",
+    "ResumeCursor",
+    "RetryPolicy",
+    "call_with_retries",
+    "configure_retries",
+    "faults",
+    "get_retry_policy",
+    "reset_degraded_warnings",
+    "trim_epoch_batches",
+]

@@ -1,0 +1,152 @@
+"""Fault-injection harness for the fault-tolerance subsystem.
+
+Correctness tooling, not production machinery: the kill-and-resume and
+degraded-sync guarantees in :mod:`metrics_tpu.ft` are only guarantees if a
+test can *make* the failure happen on demand. This module arms named
+injection points that the production seams consult:
+
+* ``"checkpoint.pre_rename"`` — inside
+  :func:`metrics_tpu.utilities.checkpoint.atomic_dir_swap`, after the
+  staged checkpoint is fully written but BEFORE the atomic rename — the
+  crash-mid-save window. Injecting here must never corrupt the previous
+  "latest" checkpoint.
+* ``"gather_all_tensors"`` / any retry ``op`` label — inside
+  :func:`metrics_tpu.ft.retry.call_with_retries`, before each attempt —
+  transient DCN collective failures.
+* clock skew — :func:`clock_skew` shifts the wall clock the
+  :class:`~metrics_tpu.ft.manager.CheckpointManager` stamps into manifests,
+  so ordering-by-timestamp bugs (NTP drift across hosts) become testable;
+  discovery must order by monotonic sequence number instead.
+
+Production cost when nothing is armed: :func:`maybe_fail` is a single
+dict read per seam hit (the module rides the normal ``metrics_tpu.ft``
+import; seams in ``utilities/`` import it deferred only to avoid the
+module-level cycle with ``ft.manager``).
+"""
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional, Type
+
+__all__ = [
+    "FaultInjected",
+    "SimulatedPreemption",
+    "armed",
+    "clock_skew",
+    "crash_mid_save",
+    "inject",
+    "maybe_fail",
+    "now",
+    "transient_gather_failures",
+]
+
+_lock = threading.Lock()
+_armed: Dict[str, Dict[str, Any]] = {}
+_clock_skew_s: float = 0.0
+
+
+class FaultInjected(RuntimeError):
+    """Raised at an armed injection point (a simulated transient failure)."""
+
+
+class SimulatedPreemption(FaultInjected):
+    """A simulated preemption/crash (e.g. SIGKILL mid-save) for in-process
+    tests; the CI smoke test delivers a real SIGKILL from a subprocess."""
+
+
+def maybe_fail(point: str) -> None:
+    """Raise at ``point`` if a fault is armed there; no-op otherwise.
+
+    Called by the production seams (checkpoint rename, retry attempts).
+    ``after`` skips the first N hits; ``count`` bounds how many raise.
+    """
+    spec = _armed.get(point)
+    if spec is None:
+        return
+    with _lock:
+        spec = _armed.get(point)
+        if spec is None:
+            return
+        if spec["after"] > 0:
+            spec["after"] -= 1
+            return
+        if spec["count"] <= 0:
+            return
+        spec["count"] -= 1
+        spec["raised"] += 1
+        exc = spec["exc"]
+    raise exc(f"injected fault at {point!r}")
+
+
+@contextmanager
+def inject(
+    point: str,
+    *,
+    count: int = 1,
+    after: int = 0,
+    exc: Type[BaseException] = FaultInjected,
+) -> Iterator[Dict[str, Any]]:
+    """Arm injection point ``point`` for the duration of the ``with`` block.
+
+    The first ``after`` hits pass through, then the next ``count`` hits
+    raise ``exc``. Yields the live spec dict — ``spec["raised"]`` counts
+    how many faults actually fired (assert it in tests so a fault that was
+    never reached cannot silently pass).
+    """
+    spec = {"count": int(count), "after": int(after), "exc": exc, "raised": 0}
+    with _lock:
+        if point in _armed:
+            raise RuntimeError(f"injection point {point!r} is already armed")
+        _armed[point] = spec
+    try:
+        yield spec
+    finally:
+        with _lock:
+            _armed.pop(point, None)
+
+
+@contextmanager
+def transient_gather_failures(
+    count: int = 1, *, after: int = 0, exc: Type[BaseException] = FaultInjected
+) -> Iterator[Dict[str, Any]]:
+    """Fail the next ``count`` eager DCN gather attempts (retry op
+    ``"gather_all_tensors"``) — the transient-collective scenario the
+    :mod:`metrics_tpu.ft.retry` policy exists for."""
+    with inject("gather_all_tensors", count=count, after=after, exc=exc) as spec:
+        yield spec
+
+
+@contextmanager
+def crash_mid_save(count: int = 1, *, after: int = 0) -> Iterator[Dict[str, Any]]:
+    """Simulate a crash after the checkpoint payload is staged but before
+    the atomic rename publishes it — the previous checkpoint must survive
+    intact and discovery must not see a half-written one."""
+    with inject("checkpoint.pre_rename", count=count, after=after, exc=SimulatedPreemption) as spec:
+        yield spec
+
+
+def now() -> float:
+    """``time.time()`` plus any armed clock skew — the manifest timestamp
+    source for :class:`~metrics_tpu.ft.manager.CheckpointManager`."""
+    return time.time() + _clock_skew_s
+
+
+@contextmanager
+def clock_skew(offset_s: float) -> Iterator[None]:
+    """Shift the manifest wall clock by ``offset_s`` seconds (positive =
+    future). Checkpoints saved under skew get lying timestamps; ordering
+    must come from the monotonic sequence number, never from the clock."""
+    global _clock_skew_s
+    previous = _clock_skew_s
+    _clock_skew_s = float(offset_s)
+    try:
+        yield
+    finally:
+        _clock_skew_s = previous
+
+
+def armed(point: Optional[str] = None) -> bool:
+    """True when ``point`` (or, with None, anything) is armed."""
+    if point is None:
+        return bool(_armed)
+    return point in _armed

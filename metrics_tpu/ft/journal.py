@@ -1,0 +1,194 @@
+"""Exactly-once batch accounting for preemption-safe metric streams.
+
+A metric checkpoint alone is not resume-safe: the training/eval loop that
+feeds it must know *which batches the saved state already contains*, or a
+restart re-folds the tail of the last epoch (double-count) or skips it
+(drop). :class:`BatchJournal` is that missing piece — a monotonic
+``(epoch, step)`` watermark advanced as batches are folded and persisted
+inside every :class:`~metrics_tpu.ft.manager.CheckpointManager` manifest.
+
+On restore, :attr:`BatchJournal.resume_from` hands the loop a
+:class:`ResumeCursor` naming the first not-yet-folded batch:
+
+* eager loops ask :meth:`BatchJournal.should_fold` per batch;
+* :func:`~metrics_tpu.steps.make_epoch` consumers pass the cursor straight
+  to the epoch entry point (``epoch(state, *batches, resume_from=cursor,
+  epoch_index=e)``) and the already-folded leading batches of the resumed
+  epoch are sliced off host-side before launch;
+* :func:`trim_epoch_batches` is the same slicing as a standalone helper
+  for hand-rolled pipelines.
+
+Because ``Metric._update_count`` rides the checkpoint tree and the skipped
+batches are never re-applied, the restored count stays exactly the
+uninterrupted run's count — the invariant the kill-and-resume tests pin
+bitwise (``tests/ft/test_kill_resume.py``).
+
+Step indices are per-epoch (batch index within the epoch), epochs are
+absolute; both are plain Python ints so the journal never touches the
+device.
+"""
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+__all__ = ["BatchJournal", "ResumeCursor", "trim_epoch_batches"]
+
+
+class ResumeCursor(NamedTuple):
+    """First batch NOT yet folded into the checkpointed state."""
+
+    epoch: int
+    step: int
+
+
+class BatchJournal:
+    """Monotonic ``(epoch, step)`` watermark of folded batches.
+
+    ``record(epoch, step)`` marks batch ``step`` of ``epoch`` as folded into
+    metric state; out-of-order records raise (a regressing watermark means
+    the caller's accounting is broken, and persisting it would corrupt every
+    later resume). ``epoch_end(epoch, num_steps)`` is a convenience for
+    whole-epoch folds (:func:`~metrics_tpu.steps.make_epoch`).
+
+    Example::
+
+        journal = BatchJournal()
+        for epoch in range(E):
+            for step, batch in enumerate(batches):
+                if not journal.should_fold(epoch, step):
+                    continue          # already in the restored state
+                metric.update(*batch)
+                journal.record(epoch, step)
+            manager.save(metric, journal=journal, epoch=epoch)
+    """
+
+    def __init__(self) -> None:
+        self._watermark: Optional[Tuple[int, int]] = None
+        self._folded: int = 0
+
+    # ------------------------------------------------------------------
+    # Recording
+    # ------------------------------------------------------------------
+
+    def record(self, epoch: int, step: int) -> None:
+        """Mark batch ``step`` of ``epoch`` as folded (monotonic)."""
+        mark = (int(epoch), int(step))
+        if mark[0] < 0 or mark[1] < 0:
+            raise ValueError(f"epoch/step must be non-negative, got {mark}")
+        if self._watermark is not None and mark <= self._watermark:
+            raise ValueError(
+                f"non-monotonic journal record {mark}: watermark is already {self._watermark}."
+                " Each (epoch, step) may be folded exactly once."
+            )
+        self._watermark = mark
+        self._folded += 1
+
+    def epoch_end(self, epoch: int, num_steps: int) -> None:
+        """Record a whole epoch of ``num_steps`` batches folded at once
+        (counting any prefix of the epoch already on the watermark).
+
+        Idempotent for epochs the watermark already covers: a resumed loop
+        replays ``for e in range(num_epochs)`` from zero, the fused epoch
+        entry no-ops on fully-folded epochs, and this must match — an
+        already-recorded ``epoch_end`` is a no-op, never an error (unlike
+        :meth:`record`, whose per-batch callers gate on
+        :meth:`should_fold` instead).
+        """
+        if num_steps <= 0:
+            return
+        mark = (int(epoch), int(num_steps) - 1)
+        if self._watermark is not None and mark <= self._watermark:
+            return  # epoch already folded (resume replay)
+        already = 0
+        if self._watermark is not None and self._watermark[0] == int(epoch):
+            already = self._watermark[1] + 1
+        self._watermark = mark
+        self._folded += int(num_steps) - already
+
+    # ------------------------------------------------------------------
+    # Resume
+    # ------------------------------------------------------------------
+
+    @property
+    def watermark(self) -> Optional[Tuple[int, int]]:
+        """Last folded ``(epoch, step)``, or None before any fold."""
+        return self._watermark
+
+    @property
+    def folded(self) -> int:
+        """Total batches folded — mirrors the metric's honest update count."""
+        return self._folded
+
+    @property
+    def resume_from(self) -> ResumeCursor:
+        """Cursor of the first batch a resumed loop must fold.
+
+        The step index is within the watermark epoch; a loop whose epochs
+        are shorter than ``watermark.step + 1`` simply finds
+        :meth:`should_fold` False for the whole epoch and moves on.
+        """
+        if self._watermark is None:
+            return ResumeCursor(0, 0)
+        return ResumeCursor(self._watermark[0], self._watermark[1] + 1)
+
+    def should_fold(self, epoch: int, step: int) -> bool:
+        """False when batch ``(epoch, step)`` is already in the restored
+        state — the exactly-once predicate for eager loops."""
+        if self._watermark is None:
+            return True
+        return (int(epoch), int(step)) > self._watermark
+
+    # ------------------------------------------------------------------
+    # Persistence (rides the CheckpointManager manifest as plain JSON)
+    # ------------------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "watermark": None if self._watermark is None else list(self._watermark),
+            "folded": self._folded,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "BatchJournal":
+        mark = state.get("watermark")
+        self._watermark = None if mark is None else (int(mark[0]), int(mark[1]))
+        self._folded = int(state.get("folded", 0))
+        return self
+
+    def __repr__(self) -> str:
+        return f"BatchJournal(watermark={self._watermark}, folded={self._folded})"
+
+
+def trim_epoch_batches(cursor: Any, epoch_index: int, leaves: list) -> Tuple[list, int, bool]:
+    """Slice already-folded leading batches off an epoch's stacked inputs.
+
+    Args:
+        cursor: a :class:`ResumeCursor` (or ``(epoch, step)`` tuple) from a
+            restored journal, or a :class:`BatchJournal` itself.
+        epoch_index: which epoch these batches belong to.
+        leaves: the epoch's input leaves; array leaves carry the
+            ``(num_batches, ...)`` epoch axis (non-arrays pass through).
+
+    Returns:
+        ``(trimmed_leaves, n_skipped, fully_folded)`` — ``fully_folded``
+        True means every batch of this epoch is already in the restored
+        state and the caller should skip the launch entirely.
+    """
+    if isinstance(cursor, BatchJournal):
+        cursor = cursor.resume_from
+    epoch0, step0 = int(cursor[0]), int(cursor[1])
+    epoch_index = int(epoch_index)
+    if epoch_index < epoch0:
+        return leaves, _leading_axis(leaves), True
+    if epoch_index > epoch0 or step0 == 0:
+        return leaves, 0, False
+    n_batches = _leading_axis(leaves)
+    if step0 >= n_batches:
+        return leaves, n_batches, True
+    trimmed = [a[step0:] if _has_epoch_axis(a) else a for a in leaves]
+    return trimmed, step0, False
+
+
+def _has_epoch_axis(a: Any) -> bool:
+    return getattr(a, "ndim", 0) >= 1
+
+
+def _leading_axis(leaves: list) -> int:
+    return next((a.shape[0] for a in leaves if _has_epoch_axis(a)), 0)

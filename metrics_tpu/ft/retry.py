@@ -1,0 +1,292 @@
+"""Retry / timeout / backoff policy for the eager DCN collectives.
+
+The in-jit SPMD collectives (``lax.psum`` et al.) live inside XLA and fail
+as a program; the *eager* cross-process path
+(:func:`~metrics_tpu.utilities.distributed.gather_all_tensors` over
+``multihost_utils.process_allgather``) is a host-side RPC against every
+other process — on a preemptible pod it sees flaky hosts, restarting
+workers and transient transport errors. The reference has no failure
+handling there at all (``torchmetrics/utilities/distributed.py:102``: one
+``all_gather``, hang or raise); this module gives the port a policy:
+
+* **retry with exponential backoff** — transient failures are retried up
+  to :attr:`RetryPolicy.max_retries` times, sleeping
+  ``backoff_s * backoff_factor**attempt`` (capped at ``max_backoff_s``)
+  between attempts; every retry bumps the ``ft.retries{op=...}`` counter.
+* **timeout** — with :attr:`RetryPolicy.timeout_s` set, each attempt runs
+  in a watchdog thread and a hang counts as a failure. The hung attempt's
+  thread cannot be cancelled (the collective owns it); it is abandoned as
+  a daemon — acceptable for a process that is about to degrade or die,
+  which is exactly when timeouts fire. A timed-out attempt is NOT retried
+  by default (:attr:`RetryPolicy.retry_on_timeout`): the abandoned call
+  may still be inside the collective, and issuing a second concurrent one
+  from the same process could pair with peers' collectives out of order —
+  a timeout goes straight to the degraded fallback (or raises).
+* **degraded fallback** — when retries are exhausted and the policy allows
+  it, the caller's fallback produces a *per-host partial result* (for a
+  gather: just the local shard) instead of hanging the fleet; a one-shot
+  ``rank_zero_warn`` per op names the degradation and the
+  ``ft.degraded_syncs{op=...}`` counter records every occurrence, so a
+  degraded eval is loud in both logs and the obs snapshot.
+
+Fault injection: each attempt first consults
+:func:`metrics_tpu.ft.faults.maybe_fail` under the op label, so tests arm
+transient failures without touching the network stack.
+"""
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Dict, Optional, Set
+
+from metrics_tpu.ft import faults as _faults
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = [
+    "AttemptTimeout",
+    "DegradedSyncError",
+    "RetryPolicy",
+    "active_scope_degraded",
+    "call_with_retries",
+    "collective_fence_armed",
+    "configure_retries",
+    "degraded_sync_scope",
+    "get_retry_policy",
+    "reset_collective_fence",
+    "reset_degraded_warnings",
+]
+
+
+class DegradedSyncError(RuntimeError):
+    """Retries exhausted and the policy forbids the degraded fallback."""
+
+
+class AttemptTimeout(TimeoutError):
+    """An attempt exceeded ``RetryPolicy.timeout_s`` (watchdog-raised; the
+    abandoned attempt may still be running inside the collective)."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Failure-handling policy for one eager collective call.
+
+    Args:
+        max_retries: attempts AFTER the first (0 = fail fast).
+        backoff_s: sleep before the first retry.
+        backoff_factor: multiplier per further retry.
+        max_backoff_s: backoff ceiling.
+        timeout_s: per-attempt wall-clock budget (None = no watchdog — a
+            hard-hung collective is then NOT detected; set this on
+            preemptible fleets).
+        degraded_fallback: on exhaustion, return the caller's per-host
+            partial result instead of raising.
+        retry_on_timeout: retry after a timed-out attempt. Default False:
+            the abandoned attempt may still sit inside the collective, and
+            a second concurrent call from this process could mis-pair with
+            peers' collectives — a timeout exhausts immediately. Enable
+            only for ops that are safe to run concurrently with their own
+            ghost (idempotent RPCs, not collectives).
+        non_retryable: exception types re-raised immediately — no retry,
+            no degradation. Defaults to the deterministic programming-error
+            family (a TypeError from a bad state leaf will fail every
+            retry identically, and degrading it would silently turn a bug
+            into fleet-wide local-only metric values forever). Transport /
+            runtime failures stay retryable.
+    """
+
+    max_retries: int = 3
+    backoff_s: float = 0.25
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 30.0
+    timeout_s: Optional[float] = None
+    degraded_fallback: bool = True
+    retry_on_timeout: bool = False
+    non_retryable: tuple = (TypeError, ValueError, AssertionError, NotImplementedError)
+
+    def __post_init__(self) -> None:
+        # a negative count would run ZERO attempts and "degrade" without
+        # ever issuing the collective
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
+        if self.timeout_s is not None and self.timeout_s <= 0:
+            raise ValueError(f"timeout_s must be positive (or None), got {self.timeout_s}")
+
+
+_policy = RetryPolicy()
+_policy_lock = threading.Lock()
+_warned_ops: Set[str] = set()
+# once ANY attempt in this process has failed or timed out, a ghost /
+# mis-paired collective becomes possible — consumers (the gather's
+# self-echo fence) stay on the unfenced fast path until then
+_observed_failures = False
+_scope_tls = threading.local()
+
+
+def configure_retries(**kwargs: Any) -> RetryPolicy:
+    """Update fields of the process-wide default policy; returns the
+    PREVIOUS policy (pass its fields back to restore)."""
+    global _policy
+    with _policy_lock:
+        previous = _policy
+        _policy = replace(_policy, **kwargs)
+    return previous
+
+
+def get_retry_policy() -> RetryPolicy:
+    """The current process-wide default policy."""
+    return _policy
+
+
+def reset_degraded_warnings() -> None:
+    """Re-arm the one-shot per-op degraded-mode warning (test hook, and for
+    long-lived processes that want the warning once per incident window)."""
+    with _policy_lock:
+        _warned_ops.clear()
+
+
+def collective_fence_armed() -> bool:
+    """True once any retry attempt in this process failed or timed out.
+
+    Before that, no abandoned/ghost collective can exist in this process,
+    so consistency fences (the gather's self-echo check) can skip their
+    per-call cost; afterwards they stay armed for the process lifetime
+    (a ghost can linger arbitrarily long inside a hung collective)."""
+    return _observed_failures
+
+
+def reset_collective_fence() -> None:
+    """Disarm the failure-observed flag (test hook only: in production a
+    ghost collective can outlive any incident window)."""
+    global _observed_failures
+    _observed_failures = False
+
+
+@contextmanager
+def degraded_sync_scope():
+    """Observe whether any ``call_with_retries`` on this thread degraded
+    while the scope was open.
+
+    Yields a dict whose ``"degraded"`` flag flips True the moment a call
+    inside the scope takes its fallback — the hook
+    :meth:`Metric._sync_dist` uses to make degradation atomic across a
+    multi-state sync (one state gathered globally + another degraded
+    locally would be a hybrid worse than either)."""
+    stack = getattr(_scope_tls, "stack", None)
+    if stack is None:
+        stack = _scope_tls.stack = []
+    box = {"degraded": False}
+    stack.append(box)
+    try:
+        yield box
+    finally:
+        stack.pop()
+
+
+def active_scope_degraded() -> bool:
+    """True when an enclosing :func:`degraded_sync_scope` on this thread has
+    already degraded. Later collectives in the same scope consult this to
+    short-circuit straight to their per-host partial: their results will be
+    discarded by the atomic fallback anyway, so paying the full
+    retry/backoff cycle per remaining state (and bumping
+    ``ft.degraded_syncs`` once per state) would only stall the sync and
+    inflate the counter."""
+    return any(box["degraded"] for box in getattr(_scope_tls, "stack", []) or [])
+
+
+def _attempt(fn: Callable[[], Any], timeout_s: Optional[float], op: str) -> Any:
+    _faults.maybe_fail(op)
+    if timeout_s is None:
+        return fn()
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+
+    def runner() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 — relayed to the caller below
+            box["error"] = err
+        finally:
+            done.set()
+
+    # daemon: a hung collective keeps its thread; the watchdog abandons it
+    thread = threading.Thread(target=runner, daemon=True, name=f"ft-retry-{op}")
+    thread.start()
+    if not done.wait(timeout_s):
+        raise AttemptTimeout(f"{op} exceeded timeout_s={timeout_s}")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def call_with_retries(
+    fn: Callable[[], Any],
+    *,
+    op: str,
+    policy: Optional[RetryPolicy] = None,
+    fallback: Optional[Callable[[BaseException], Any]] = None,
+) -> Any:
+    """Run ``fn`` under the retry/timeout/degraded policy.
+
+    Args:
+        fn: zero-arg callable performing the collective.
+        op: label for counters, warnings and fault injection
+            (e.g. ``"gather_all_tensors"``).
+        policy: override the process-wide default for this call.
+        fallback: ``(last_error) -> degraded result`` — the per-host
+            partial answer used when retries are exhausted and
+            ``degraded_fallback`` is set. Without one, exhaustion raises
+            :class:`DegradedSyncError` regardless of the policy.
+
+    Returns:
+        ``fn()``'s result, or the fallback's degraded result.
+    """
+    p = policy if policy is not None else _policy
+    delay = p.backoff_s
+    last_error: Optional[BaseException] = None
+    attempts = 0
+    for attempt in range(p.max_retries + 1):
+        try:
+            attempts += 1
+            return _attempt(fn, p.timeout_s, op)
+        except Exception as err:  # noqa: BLE001 — policy decides what survives
+            if isinstance(err, p.non_retryable):
+                raise  # deterministic bug: every retry would fail identically
+            last_error = err
+            global _observed_failures
+            _observed_failures = True  # ghost collectives now possible; arm fences
+            if isinstance(err, AttemptTimeout) and not p.retry_on_timeout:
+                break  # the ghost attempt may still be in flight; don't race it
+            if attempt < p.max_retries:
+                if _obs_enabled():
+                    _obs_inc("ft.retries", op=op)
+                time.sleep(min(delay, p.max_backoff_s))
+                delay *= p.backoff_factor
+    assert last_error is not None
+    # report the attempts that actually ran — a no-retry timeout breaks out
+    # after ONE, and claiming max_retries+1 would mislead incident triage
+    if p.degraded_fallback and fallback is not None:
+        if _obs_enabled():
+            _obs_inc("ft.degraded_syncs", op=op)
+        with _policy_lock:
+            first = op not in _warned_ops
+            _warned_ops.add(op)
+        if first:
+            rank_zero_warn(
+                f"{op} failed after {attempts} attempt(s) ({last_error!r});"
+                " degrading to per-host partial results for this and further"
+                " occurrences. Metric values on this host now reflect ONLY its"
+                " local shard until the collective recovers."
+                " (ft.degraded_syncs counts every degraded sync.)",
+                RuntimeWarning,
+            )
+        for box in getattr(_scope_tls, "stack", []) or []:
+            box["degraded"] = True
+        return fallback(last_error)
+    reason = (
+        "the policy forbids degraded mode (degraded_fallback=False)"
+        if not p.degraded_fallback
+        else "the call site provided no fallback"
+    )
+    raise DegradedSyncError(f"{op} failed after {attempts} attempt(s) and {reason}") from last_error

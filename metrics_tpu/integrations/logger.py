@@ -28,6 +28,18 @@ from metrics_tpu.metric import Metric
 __all__ = ["MetricLogger"]
 
 
+def _jsonable(value: Any) -> Any:
+    """History values (jnp scalars/arrays, nested dicts) as plain JSON types
+    — the manifest the CheckpointManager bundles must be json.dump-able."""
+    if isinstance(value, dict):
+        return {k: _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if hasattr(value, "tolist"):  # jnp / numpy arrays and scalars
+        return value.tolist()
+    return value
+
+
 class MetricLogger:
     """Drives ``forward``-per-step / ``compute``+``reset``-per-epoch logging."""
 
@@ -123,3 +135,37 @@ class MetricLogger:
             # duplicate ~max_spans dicts per entry over a long run
             self.obs_history.append(obs.snapshot(spans=False) if obs.enabled() else None)
         return out
+
+    # ------------------------------------------------------------------
+    # Fault-tolerant resume (rides the ft.CheckpointManager manifest)
+    # ------------------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-serializable logger archive for checkpoint manifests.
+
+        Covers the closed-epoch record (``history`` + the index-parallel
+        ``obs_history``) and the mid-epoch scalar buffers, so a run resumed
+        by :class:`metrics_tpu.ft.CheckpointManager` keeps its full logging
+        trajectory across a preemption. Metric OBJECTS are not here — their
+        states ride the checkpoint's orbax tree; re-bind them by logging
+        the restored metrics under the same names. History values come back
+        as plain floats/lists (device arrays do not survive JSON).
+        """
+        # every field is a snapshot COPY: an async CheckpointManager save
+        # serializes this dict on a background thread while the loop keeps
+        # closing epochs — aliasing the live lists would let obs_history
+        # grow mid-serialization and break its history index-parallelism
+        return {
+            "history": _jsonable(self.history),
+            "obs_history": _jsonable(self.obs_history),
+            "scalars": {k: [float(v) for v in vs] for k, vs in self._scalars.items()},
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "MetricLogger":
+        """Restore :meth:`state_dict` — ``history``/``obs_history`` continue
+        appending after the restored epochs; mid-epoch scalar buffers resume
+        accumulating. Returns ``self``."""
+        self.history = list(state.get("history", []))
+        self.obs_history = list(state.get("obs_history", []))
+        self._scalars = {k: list(vs) for k, vs in state.get("scalars", {}).items()}
+        return self

@@ -351,7 +351,18 @@ class Metric(ABC):
     # ------------------------------------------------------------------
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
-        """Gather + reduce every state across processes (reference ``metric.py:279``)."""
+        """Gather + reduce every state across processes (reference ``metric.py:279``).
+
+        Degradation is atomic across the metric's states: each state is a
+        separate eager gather, and if ANY of them falls back to its
+        per-host partial (retries exhausted — see ``metrics_tpu.ft.retry``)
+        the whole sync degrades to local-only state. A hybrid — one state
+        globally summed, another local — would compute values that are
+        neither the global nor the local answer (e.g. a global numerator
+        over a local denominator).
+        """
+        from metrics_tpu.ft.retry import degraded_sync_scope
+
         input_dict = {name: getattr(self, name) for name in self._reductions}
         for name, value in input_dict.items():
             if isinstance(value, list) and value:
@@ -359,12 +370,19 @@ class Metric(ABC):
             elif isinstance(value, CapacityBuffer):
                 input_dict[name] = [value.materialize()] if value else []
 
-        output_dict = apply_to_collection(
-            input_dict,
-            (jnp.ndarray, jax.Array),
-            dist_sync_fn,
-            group=process_group or self.process_group,
-        )
+        with degraded_sync_scope() as scope:
+            output_dict = apply_to_collection(
+                input_dict,
+                (jnp.ndarray, jax.Array),
+                dist_sync_fn,
+                group=process_group or self.process_group,
+            )
+        if scope["degraded"]:
+            # local-only for EVERY state: the per-host shape each gather's
+            # own fallback produces, applied consistently
+            output_dict = apply_to_collection(
+                input_dict, (jnp.ndarray, jax.Array), lambda x, group=None: [x], group=None
+            )
 
         for name, outputs in output_dict.items():
             if isinstance(getattr(self, name), (list, CapacityBuffer)):
@@ -476,6 +494,29 @@ class Metric(ABC):
         """Toggle persistence of all states (reference ``metric.py:566``)."""
         for name in self._persistent:
             self._persistent[name] = mode
+
+    def save(self, path: Any) -> None:
+        """Atomically persist this metric's state to ``path``.
+
+        The state pytree (including cat lists, ``CapacityBuffer`` contents
+        and ``_update_count``) is staged and published with one rename, so
+        a crash mid-save never leaves a corrupt checkpoint. In a
+        distributed setting save inside ``sync_context()`` so the persisted
+        state is the globally-reduced one. For rotation, manifests, async
+        saves and exactly-once resume cursors use
+        :class:`metrics_tpu.ft.CheckpointManager`.
+        """
+        from metrics_tpu.utilities.checkpoint import save_state
+
+        save_state(path, self)
+
+    def restore(self, path: Any) -> "Metric":
+        """Restore state saved by :meth:`save` into this metric; returns
+        ``self``, which continues accumulating from the restored point."""
+        from metrics_tpu.utilities.checkpoint import restore_state
+
+        restore_state(path, self)
+        return self
 
     # ------------------------------------------------------------------
     # Misc protocol

@@ -56,6 +56,7 @@ from metrics_tpu.obs.registry import (
     inc,
     set_gauge,
     spans,
+    sum_counter,
 )
 from metrics_tpu.obs.tracing import pytree_nbytes, trace_span
 
@@ -76,6 +77,7 @@ __all__ = [
     "set_gauge",
     "snapshot",
     "spans",
+    "sum_counter",
     "to_json",
     "to_prometheus",
     "trace_span",

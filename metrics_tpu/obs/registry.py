@@ -14,7 +14,16 @@ runtime telemetry (SURVEY §1's blind spot — the reference has no equivalent):
 
 Keys are ``name{label=value,...}`` with labels sorted, so the same logical
 series always lands on one key and the Prometheus dumper
-(:mod:`metrics_tpu.obs.export`) can re-split them mechanically.
+(:mod:`metrics_tpu.obs.export`) can re-split them mechanically;
+:func:`sum_counter` totals a family across its label values (e.g. every
+``op=`` series of ``ft.degraded_syncs``).
+
+The fault-tolerance subsystem (:mod:`metrics_tpu.ft`) reports through this
+registry: ``ft.retries{op=}`` / ``ft.degraded_syncs{op=}`` from the DCN
+retry policy, ``ft.checkpoint_saves{mode=}`` / ``ft.checkpoint_restores``
+/ ``ft.checkpoints_rotated`` plus the ``ft.checkpoint_save_ms`` gauge from
+the checkpoint manager — so a degraded or retry-storming sync is visible
+in the same snapshot as the metric counters it distorts.
 
 The registry is **disabled by default** and every instrumentation point in
 the package checks :func:`enabled` before doing any work, so the disabled
@@ -43,6 +52,7 @@ __all__ = [
     "reset",
     "set_gauge",
     "spans",
+    "sum_counter",
 ]
 
 _lock = threading.Lock()
@@ -135,6 +145,16 @@ def get_counter(name: str, **labels: Any) -> float:
 def get_gauge(name: str, **labels: Any) -> Optional[float]:
     with _lock:
         return _gauges.get(_key(name, labels))
+
+
+def sum_counter(name: str) -> float:
+    """Total of counter family ``name`` across ALL of its labeled series
+    (plus any unlabeled one). ``get_counter`` addresses one exact series;
+    this answers "did ANY ft.degraded_syncs fire" without enumerating the
+    op labels."""
+    prefix = name + "{"
+    with _lock:
+        return sum(v for k, v in _counters.items() if k == name or k.startswith(prefix))
 
 
 def record_span(name: str, wall_ms: float, depth: int, category: Optional[str] = None) -> None:

@@ -341,6 +341,19 @@ def make_epoch(
             (default). Pass False when composing it inside an outer jit /
             ``shard_map`` yourself.
 
+    Exactly-once resume:
+        ``epoch`` accepts two reserved keyword arguments, ``resume_from``
+        (a :class:`~metrics_tpu.ft.ResumeCursor` from a restored
+        :class:`~metrics_tpu.ft.BatchJournal`) and ``epoch_index`` (this
+        epoch's absolute index). Batches the restored state already folded
+        are sliced off host-side before the launch — a fully-folded epoch
+        returns ``(state, None)`` without launching — so a preempted sweep
+        resumed from a checkpoint never double-counts (the kill-and-resume
+        tests pin ``compute()`` bitwise-equal to an uninterrupted run).
+        The resumed epoch's trimmed shape costs one extra trace; later
+        epochs reuse the full-shape program. With ``with_values=True`` the
+        returned per-batch values cover only the freshly-folded batches.
+
     Example:
         >>> import jax, jax.numpy as jnp
         >>> from metrics_tpu import Accuracy
@@ -446,9 +459,20 @@ def make_epoch(
         raw_jitted = jax.jit(epoch, donate_argnums=0)
         jitted = _obs_track_compiles(raw_jitted, _epoch_label)
 
-        def epoch(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:  # noqa: F811
+        def epoch(  # noqa: F811
+            state: State,
+            *batches: Any,
+            resume_from: Any = None,
+            epoch_index: Optional[int] = None,
+            **kw_batches: Any,
+        ) -> Tuple[State, Any]:
+            if resume_from is not None:
+                batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
+                if done:  # every batch of this epoch is already in the state
+                    return state, None
             # fused-epoch launch accounting from the EAGER entry's argument
-            # shapes (host-side; the jitted program is untouched)
+            # shapes (host-side; the jitted program is untouched) — counted
+            # AFTER resume trimming so batches_folded stays honest
             leaves = list(batches) + list(kw_batches.values())
             n_batches = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
             _obs_epoch_launch(_epoch_label, n_batches)
@@ -460,8 +484,40 @@ def make_epoch(
         for attr in ("lower", "eval_shape", "trace", "clear_cache"):
             if hasattr(raw_jitted, attr):
                 setattr(epoch, attr, getattr(raw_jitted, attr))
+    else:
+        _inner_epoch = epoch
+
+        def epoch(  # noqa: F811
+            state: State,
+            *batches: Any,
+            resume_from: Any = None,
+            epoch_index: Optional[int] = None,
+            **kw_batches: Any,
+        ) -> Tuple[State, Any]:
+            if resume_from is not None:
+                # host-side trim: the cursor must be concrete (slice sizes
+                # are shapes), which it is when it comes from a restored
+                # journal rather than a traced value
+                batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
+                if done:
+                    return state, None
+            return _inner_epoch(state, *batches, **kw_batches)
 
     return init, epoch, compute
+
+
+def _apply_resume(resume_from: Any, epoch_index: Optional[int], batches: tuple, kw_batches: dict):
+    """Slice already-folded leading batches off the epoch inputs (host-side;
+    see :mod:`metrics_tpu.ft.journal` for the cursor semantics)."""
+    from metrics_tpu.ft.journal import trim_epoch_batches
+
+    if epoch_index is None:
+        raise ValueError("epoch(resume_from=...) also needs epoch_index= (this epoch's absolute index)")
+    keys = sorted(kw_batches)
+    n_pos = len(batches)
+    leaves = list(batches) + [kw_batches[k] for k in keys]
+    trimmed, _n_skipped, done = trim_epoch_batches(resume_from, epoch_index, leaves)
+    return tuple(trimmed[:n_pos]), dict(zip(keys, trimmed[n_pos:])), done
 
 
 def _make_bootstrap_step(

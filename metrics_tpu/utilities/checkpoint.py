@@ -5,22 +5,98 @@ The reference checkpoints metric state through ``nn.Module.state_dict``
 ``sync_context``, ``tests/bases/test_ddp.py:226-234``). The TPU-native
 equivalent: metric state is already a pytree (``Metric.state_pytree``), so
 persistence is orbax save/restore of that pytree. List (cat) states are
-stored as dicts keyed by position so arbitrary-length accumulations
-round-trip; scalar bookkeeping (``_update_count``) rides along so a restored
-metric continues streaming where it left off.
+stored as dicts keyed by position with a ``__list_len`` sentinel so
+arbitrary-length accumulations — including empty ones — round-trip; scalar
+bookkeeping (``_update_count``) rides along so a restored metric continues
+streaming where it left off.
 
 ``save_state``/``restore_state`` accept a single :class:`Metric` or a
 :class:`MetricCollection` (saved as one composite keyed by metric name).
+Writes are atomic (:func:`atomic_dir_swap`): the tree is staged in a
+sibling temp directory and published with one ``os.replace``, so a crash
+mid-save can never leave a half-written "latest" checkpoint. Rotation,
+manifests, async saves and resume cursors live one level up in
+:class:`metrics_tpu.ft.manager.CheckpointManager`.
 """
 import json
 import os
+import shutil
+import tempfile
+from contextlib import contextmanager
 from enum import Enum
-from typing import Any, Dict, Union
+from typing import Any, Dict, Iterator, Union
 
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["save_state", "restore_state", "metric_state_to_tree", "load_metric_state_tree"]
+__all__ = [
+    "atomic_dir_swap",
+    "save_state",
+    "restore_state",
+    "metric_state_to_tree",
+    "load_metric_state_tree",
+]
+
+_LIST_LEN_KEY = "__list_len"
+
+
+def _maybe_inject(point: str) -> None:
+    # deferred import: ft.manager imports this module at its top level, so
+    # a module-level import here would cycle. At call time the package is
+    # fully initialized and this is a sys.modules hit; maybe_fail itself is
+    # one dict read when nothing is armed.
+    from metrics_tpu.ft import faults
+
+    faults.maybe_fail(point)
+
+
+@contextmanager
+def atomic_dir_swap(final_path: Union[str, os.PathLike]) -> Iterator[str]:
+    """Stage a directory write, then atomically publish it at ``final_path``.
+
+    Yields a staging path (inside a sibling scratch dir, same filesystem);
+    on clean exit the staged directory becomes ``final_path`` via
+    ``os.replace`` — readers see either the complete old version or the
+    complete new one, never a partial write. On error the stage is
+    discarded and any existing ``final_path`` is untouched. Leftover
+    ``.tmp.*`` scratch dirs from a hard kill are inert (hidden from
+    checkpoint discovery) and cleaned by the next
+    :class:`~metrics_tpu.ft.manager.CheckpointManager` save.
+
+    Overwriting an existing ``final_path`` needs two renames (directories
+    cannot be exchanged in one syscall), so a kill between them would
+    otherwise lose the old version: it is parked at the VISIBLE sibling
+    ``<final>.prev`` for the window and removed after the publish.
+    :func:`restore_state` falls back to ``<final>.prev`` when
+    ``final_path`` is missing, so even that instant is recoverable; a
+    stale ``.prev`` orphaned by such a kill is removed once the next save
+    publishes a newer complete version.
+    """
+    final = os.fspath(os.path.abspath(final_path))
+    parent = os.path.dirname(final) or "."
+    os.makedirs(parent, exist_ok=True)
+    scratch = tempfile.mkdtemp(prefix=".tmp.", dir=parent)
+    stage = os.path.join(scratch, "stage")
+    try:
+        yield stage
+        if not os.path.isdir(stage):
+            raise FileNotFoundError(f"atomic_dir_swap: nothing was staged at {stage}")
+        _maybe_inject("checkpoint.pre_rename")
+        prev = final + ".prev"
+        if os.path.lexists(final):
+            if os.path.lexists(prev):
+                shutil.rmtree(prev, ignore_errors=True)
+            os.replace(final, prev)
+            _maybe_inject("checkpoint.mid_swap")
+            os.replace(stage, final)
+        else:
+            os.replace(stage, final)
+        # only AFTER the new complete version is published: until then a
+        # .prev (possibly orphaned by a kill in the window above, with
+        # final missing) is the sole recovery copy
+        shutil.rmtree(prev, ignore_errors=True)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _pack(value: Any) -> Any:
@@ -34,7 +110,11 @@ def _pack(value: Any) -> Any:
             packed["__capbuf_data"] = value.data
         return packed
     if isinstance(value, list):
-        return {f"__list_{i}": v for i, v in enumerate(value)}
+        # explicit length sentinel: an EMPTY list still packs to a non-empty
+        # dict, so unpacking never has to guess from key shapes alone
+        packed = {f"__list_{i}": v for i, v in enumerate(value)}
+        packed[_LIST_LEN_KEY] = jnp.asarray(len(value), jnp.int32)
+        return packed
     return value
 
 
@@ -48,7 +128,12 @@ def _unpack(value: Any) -> Any:
         buf.count = jnp.asarray(value["__capbuf_count"], jnp.int32)
         buf._host_count = None  # concretized lazily on first use
         return buf
-    if isinstance(value, dict) and all(k.startswith("__list_") for k in value):
+    if isinstance(value, dict) and _LIST_LEN_KEY in value:
+        return [value[f"__list_{i}"] for i in range(int(value[_LIST_LEN_KEY]))]
+    # legacy packing (pre-__list_len checkpoints): positional keys only. The
+    # non-empty requirement matters — an empty dict satisfies the all()
+    # vacuously and would silently round-trip a non-list state as []
+    if isinstance(value, dict) and value and all(k.startswith("__list_") for k in value):
         return [value[f"__list_{i}"] for i in range(len(value))]
     return value
 
@@ -82,35 +167,64 @@ def load_metric_state_tree(metric: Any, tree: Dict[str, Any]) -> None:
         for name, m in metric.items():
             if name in tree:
                 load_metric_state_tree(m, tree[name])
+        # members now hold individually-restored real state; the group
+        # state-ref bookkeeping (representative aliasing, _state_is_copy)
+        # must be re-established or the next update can clobber restored
+        # non-representative state (see collections.py)
+        if hasattr(metric, "_resync_compute_groups_after_restore"):
+            metric._resync_compute_groups_after_restore()
         return
     metric._update_count = int(tree.get("__update_count", metric._update_count))
     if "__aux" in tree:
         aux = json.loads(bytes(np.asarray(tree["__aux"]).astype(np.uint8)).decode())
         for name, value in aux.items():
             setattr(metric, name, value)
-    metric.load_state_pytree(
-        {k: _unpack(v) for k, v in tree.items() if k not in ("__update_count", "__aux")}
-    )
+    state: Dict[str, Any] = {}
+    for key, value in tree.items():
+        if key in ("__update_count", "__aux"):
+            continue
+        unpacked = _unpack(value)
+        if isinstance(unpacked, dict) and not unpacked and isinstance(metric._defaults.get(key), list):
+            # legacy pre-__list_len checkpoints packed an EMPTY cat list as
+            # {}; _unpack can't tell that from a genuine empty dict, but the
+            # state's declared default can
+            unpacked = []
+        state[key] = unpacked
+    metric.load_state_pytree(state)
     metric._computed = None
 
 
 def save_state(path: Union[str, os.PathLike], metric: Any) -> None:
-    """Write the metric/collection state to ``path`` with orbax.
+    """Write the metric/collection state to ``path`` with orbax, atomically.
 
-    In a distributed setting call inside ``sync_context`` (mirroring the
-    reference's DDP checkpoint recipe) so the saved state is the global one.
+    The orbax tree is staged in a sibling temp dir and published with one
+    rename (:func:`atomic_dir_swap`), so a crash mid-save leaves any
+    previous checkpoint at ``path`` intact rather than a corrupt partial
+    write. In a distributed setting call inside ``sync_context`` (mirroring
+    the reference's DDP checkpoint recipe) so the saved state is the global
+    one.
     """
     import orbax.checkpoint as ocp
 
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(os.fspath(os.path.abspath(path)), metric_state_to_tree(metric))
+    tree = metric_state_to_tree(metric)
+    with atomic_dir_swap(path) as stage:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(stage, tree)
 
 
 def restore_state(path: Union[str, os.PathLike], metric: Any) -> Any:
-    """Restore state saved by :func:`save_state` into ``metric``; returns it."""
+    """Restore state saved by :func:`save_state` into ``metric``; returns it.
+
+    When ``path`` is missing but ``<path>.prev`` exists — a kill landed in
+    :func:`atomic_dir_swap`'s two-rename overwrite window — the parked
+    previous checkpoint is restored instead (nothing is ever lost).
+    """
     import orbax.checkpoint as ocp
 
+    target = os.fspath(os.path.abspath(path))
+    if not os.path.exists(target) and os.path.isdir(target + ".prev"):
+        target = target + ".prev"
     with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.restore(os.fspath(os.path.abspath(path)))
+        tree = ckptr.restore(target)
     load_metric_state_tree(metric, tree)
     return metric

@@ -330,12 +330,80 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     shapes first, pads dim 0 to the max, gathers, then trims. Returns a list
     with one entry per process (single-process: ``[result]``). ``group`` is
     accepted for API parity and ignored (mesh axes handle grouping in-jit).
+
+    Failure handling (the reference has none — one ``all_gather``, hang or
+    raise): the whole gather runs under the :mod:`metrics_tpu.ft.retry`
+    policy. Transient failures are retried with backoff (``ft.retries``
+    counter); exhausting the policy degrades to the local per-host partial
+    ``[result]`` with a one-shot warning and an ``ft.degraded_syncs`` bump,
+    so a flaky peer degrades this host's metric values instead of hanging
+    the fleet. Set ``configure_retries(degraded_fallback=False)`` to make
+    exhaustion raise :class:`~metrics_tpu.ft.retry.DegradedSyncError`
+    instead.
+
+    Retries are per-host best-effort, not fleet-coordinated: a retried
+    gather only succeeds if the peers reach their matching collective
+    (give every process the same policy), a timed-out attempt is NOT
+    retried (the abandoned call could mis-pair with a fresh one — it
+    degrades immediately), and without ``timeout_s`` a hard-hung peer is
+    not detected. Once any attempt in this process has failed or timed
+    out, every gather is additionally **self-echo fenced**: the gathered
+    slot for this process must equal its local contribution bitwise, so a
+    retried collective that mis-paired with a neighbouring collective (a
+    failed attempt can have partially executed on peers) is detected and
+    treated as a failure rather than returned as silently misaligned
+    "global" state; healthy processes never pay the fence. The degraded
+    fallback bounds the damage.
     """
     if jax.process_count() == 1:
         return [result]
+    from metrics_tpu.ft.retry import active_scope_degraded, call_with_retries
+
+    if active_scope_degraded():
+        # an earlier gather of this sync already degraded and the enclosing
+        # scope will discard this result in favour of local state — skip
+        # the doomed retry/backoff cycle entirely
+        return [result]
+    return call_with_retries(
+        lambda: _checked_gather_all_tensors(result),
+        op="gather_all_tensors",
+        # degraded mode: this host's own shard only — the per-host partial
+        # result shape every consumer already handles (single-process case)
+        fallback=lambda _err: [result],
+    )
+
+
+def _checked_gather_all_tensors(result: Array) -> List[Array]:
+    """One gather attempt plus the self-echo fence (see gather_all_tensors).
+
+    The fence arms only after some retry attempt in this process has
+    failed or timed out — before that no ghost collective can exist, so
+    healthy fleets skip the per-gather payload compare + host sync."""
+    from metrics_tpu.ft.retry import collective_fence_armed
+
+    out = _gather_all_tensors_impl(result)
+    if collective_fence_armed():
+        own = out[jax.process_index()]
+        equal_nan = bool(jnp.issubdtype(jnp.asarray(result).dtype, jnp.inexact))
+        if tuple(own.shape) != tuple(result.shape) or not bool(
+            jnp.array_equal(own, result, equal_nan=equal_nan)
+        ):
+            raise RuntimeError(
+                "gather_all_tensors self-echo mismatch: the gathered slot for this"
+                " process does not match its local contribution — a retried"
+                " collective likely mis-paired with a neighbouring collective."
+                " Treating the attempt as failed."
+            )
+    # count one LOGICAL gather, after the fence accepts it: failed or
+    # fence-rejected attempts must not inflate the traffic counters the
+    # incident analysis correlates with ft.degraded_syncs
     if _obs_enabled():
         _obs_inc("sync.gathers")
         _obs_inc("sync.payload_bytes", float(result.size * result.dtype.itemsize), op="process_allgather")
+    return out
+
+
+def _gather_all_tensors_impl(result: Array) -> List[Array]:
     from jax.experimental import multihost_utils
 
     local_size = jnp.asarray(result.shape, dtype=jnp.int32)

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 
 from metrics_tpu import Accuracy, CatMetric, MeanMetric, MetricCollection
 from metrics_tpu.utilities.checkpoint import (
+    _pack,
+    _unpack,
     load_metric_state_tree,
     metric_state_to_tree,
     restore_state,
@@ -81,5 +83,112 @@ def test_checkpoint_with_compute_groups():
     load_metric_state_tree(restored, metric_state_to_tree(coll))
     want = coll.compute()
     got = restored.compute()
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), atol=1e-7)
+
+
+def test_unpack_requires_nonempty_list_dict():
+    """Regression: an empty dict satisfied the all-keys-__list_ check
+    vacuously and silently round-tripped as []."""
+    assert _unpack({}) == {}
+    # legacy positional packing (pre-__list_len checkpoints) still unpacks
+    legacy = {"__list_0": jnp.asarray([1.0]), "__list_1": jnp.asarray([2.0])}
+    out = _unpack(legacy)
+    assert isinstance(out, list) and len(out) == 2
+
+
+def test_legacy_empty_list_pack_restores():
+    """A pre-__list_len checkpoint packed an EMPTY cat list as {}; the
+    state's declared default disambiguates it from a genuine dict so old
+    checkpoints keep loading."""
+    m = CatMetric()
+    load_metric_state_tree(m, {"value": {}, "__update_count": jnp.asarray(0, jnp.int32)})
+    assert m.value == []
+    m.update(jnp.asarray([7.0]))  # and keeps streaming
+    np.testing.assert_allclose(np.asarray(m.compute()), [7.0], atol=1e-8)
+
+
+def test_empty_list_state_roundtrips_via_sentinel():
+    """An EMPTY cat-list state packs to a non-empty dict (__list_len) and
+    comes back as an empty list, not as a dict or a dropped state."""
+    packed = _pack([])
+    assert packed and int(packed["__list_len"]) == 0
+    assert _unpack(packed) == []
+
+    never_updated = CatMetric()
+    tree = metric_state_to_tree(never_updated)
+    fresh = CatMetric()
+    load_metric_state_tree(fresh, tree)
+    assert fresh.value == []
+    fresh.update(jnp.asarray([4.0, 5.0]))  # keeps streaming after restore
+    np.testing.assert_allclose(np.asarray(fresh.compute()), [4.0, 5.0], atol=1e-8)
+
+
+def test_restore_divergent_states_dissolves_compute_groups():
+    """Regression (ISSUE 3 satellite): restoring member states that
+    contradict the discovered grouping must re-derive the groups — keeping
+    them would let the next update touch only the representative and the
+    next compute alias its state over the restored non-representative
+    state, silently discarding it."""
+    from metrics_tpu import Precision, Recall
+
+    p1, t1 = jnp.asarray([0.9, 0.2, 0.8, 0.1]), jnp.asarray([1, 0, 0, 1])
+    p2, t2 = jnp.asarray([0.7, 0.6, 0.3, 0.9]), jnp.asarray([1, 1, 0, 0])
+    p3, t3 = jnp.asarray([0.4, 0.8, 0.6, 0.2]), jnp.asarray([0, 1, 1, 0])
+
+    # groups-off source: members hold DIVERGENT accumulated states
+    src = MetricCollection([Precision(), Recall()], compute_groups=False)
+    src["Precision"].update(p1, t1)
+    src["Precision"].update(p2, t2)
+    src["Recall"].update(p1, t1)
+    tree = metric_state_to_tree(src)
+
+    # target with an ACTIVE merged compute group
+    dst = MetricCollection([Precision(), Recall()])
+    dst.update(p3, t3)
+    assert len(dst.compute_groups) == 1
+    load_metric_state_tree(dst, tree)
+    dst.update(p3, t3)
+    got = dst.compute()
+
+    from metrics_tpu import Precision as P, Recall as R
+
+    exp_p = P()
+    for p, t in ((p1, t1), (p2, t2), (p3, t3)):
+        exp_p.update(p, t)
+    exp_r = R()
+    for p, t in ((p1, t1), (p3, t3)):
+        exp_r.update(p, t)
+    np.testing.assert_allclose(np.asarray(got["Precision"]), np.asarray(exp_p.compute()), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(got["Recall"]), np.asarray(exp_r.compute()), atol=1e-7)
+    assert dst["Precision"]._update_count == 3
+    assert dst["Recall"]._update_count == 2
+
+
+def test_restore_consistent_states_keeps_compute_groups():
+    """The common path — checkpoint from an identically-grouped collection —
+    must keep the discovered groups (the dedup saving) after restore."""
+    from metrics_tpu import Precision, Recall
+
+    p1, t1 = jnp.asarray([0.9, 0.2, 0.8, 0.1]), jnp.asarray([1, 0, 0, 1])
+    p2, t2 = jnp.asarray([0.7, 0.6, 0.3, 0.9]), jnp.asarray([1, 1, 0, 0])
+
+    src = MetricCollection([Precision(), Recall()])
+    src.update(p1, t1)
+    tree = metric_state_to_tree(src)
+
+    dst = MetricCollection([Precision(), Recall()])
+    dst.update(p1, t1)
+    dst.compute()  # leaves _state_is_copy=True — the aliased-refs regime
+    load_metric_state_tree(dst, tree)
+    assert len(dst.compute_groups) == 1  # consistent restore keeps the group
+    assert not dst._state_is_copy  # but members hold real state, not refs
+    dst.update(p2, t2)
+    got = dst.compute()
+
+    ref = MetricCollection([Precision(), Recall()])
+    ref.update(p1, t1)
+    ref.update(p2, t2)
+    want = ref.compute()
     for k in want:
         np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), atol=1e-7)

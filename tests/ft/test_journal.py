@@ -1,0 +1,170 @@
+"""BatchJournal exactly-once accounting: watermark monotonicity, resume
+cursors, epoch trimming, and the make_epoch ``resume_from`` integration."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import Accuracy, MeanMetric
+from metrics_tpu.ft import BatchJournal, ResumeCursor, trim_epoch_batches
+from metrics_tpu.steps import make_epoch
+
+
+class TestBatchJournal:
+    def test_fresh_journal_folds_everything(self):
+        j = BatchJournal()
+        assert j.watermark is None
+        assert j.resume_from == ResumeCursor(0, 0)
+        assert j.should_fold(0, 0)
+        assert j.should_fold(5, 3)
+
+    def test_record_advances_watermark_and_count(self):
+        j = BatchJournal()
+        j.record(0, 0)
+        j.record(0, 1)
+        j.record(1, 0)
+        assert j.watermark == (1, 0)
+        assert j.folded == 3
+        assert j.resume_from == ResumeCursor(1, 1)
+
+    def test_non_monotonic_record_raises(self):
+        j = BatchJournal()
+        j.record(1, 2)
+        with pytest.raises(ValueError, match="non-monotonic"):
+            j.record(1, 2)  # duplicate
+        with pytest.raises(ValueError, match="non-monotonic"):
+            j.record(1, 1)  # regress step
+        with pytest.raises(ValueError, match="non-monotonic"):
+            j.record(0, 9)  # regress epoch
+        with pytest.raises(ValueError):
+            j.record(-1, 0)
+
+    def test_should_fold_is_the_exactly_once_predicate(self):
+        j = BatchJournal()
+        j.record(2, 4)
+        assert not j.should_fold(2, 4)
+        assert not j.should_fold(2, 0)
+        assert not j.should_fold(1, 99)
+        assert j.should_fold(2, 5)
+        assert j.should_fold(3, 0)
+
+    def test_epoch_end_counts_whole_and_resumed_epochs(self):
+        j = BatchJournal()
+        j.epoch_end(0, 10)
+        assert j.watermark == (0, 9)
+        assert j.folded == 10
+        # resumed epoch: 4 batches already on the watermark, 6 fresh
+        j2 = BatchJournal()
+        j2.record(1, 3)
+        folded_before = j2.folded
+        j2.epoch_end(1, 10)
+        assert j2.watermark == (1, 9)
+        assert j2.folded == folded_before + 6
+        # already-covered epochs are a NO-OP (a resumed loop replays epoch
+        # indices from zero; this must mirror the fused epoch's no-op)
+        j2.epoch_end(1, 10)
+        j2.epoch_end(0, 10)
+        assert j2.watermark == (1, 9) and j2.folded == folded_before + 6
+        j2.epoch_end(2, 0)  # empty epoch: no-op
+        assert j2.watermark == (1, 9)
+
+    def test_resumed_multi_epoch_loop_replays_cleanly(self):
+        """Regression: the documented resume recipe — replay every epoch
+        from zero, letting should_fold / epoch_end skip the folded prefix —
+        must not raise on the already-covered epochs."""
+        j = BatchJournal()
+        j.epoch_end(0, 6)
+        j.record(1, 0)
+        j.record(1, 1)  # preempted mid-epoch 1
+        restored = BatchJournal().load_state_dict(j.state_dict())
+        for e in range(3):
+            restored.epoch_end(e, 6)
+        assert restored.watermark == (2, 5)
+        assert restored.folded == 18
+
+    def test_state_dict_roundtrip(self):
+        j = BatchJournal()
+        j.record(3, 7)
+        j.record(3, 8)
+        restored = BatchJournal().load_state_dict(j.state_dict())
+        assert restored.watermark == (3, 7 + 1)
+        assert restored.folded == 2
+        assert restored.resume_from == j.resume_from
+        # fresh journal roundtrips too
+        empty = BatchJournal().load_state_dict(BatchJournal().state_dict())
+        assert empty.watermark is None and empty.folded == 0
+
+
+class TestTrimEpochBatches:
+    def setup_method(self):
+        self.leaves = [jnp.arange(12).reshape(4, 3), jnp.arange(4)]
+
+    def test_earlier_epoch_is_fully_folded(self):
+        _, skipped, done = trim_epoch_batches(ResumeCursor(2, 1), 1, self.leaves)
+        assert done and skipped == 4
+
+    def test_later_epoch_is_untouched(self):
+        trimmed, skipped, done = trim_epoch_batches(ResumeCursor(2, 1), 3, self.leaves)
+        assert not done and skipped == 0
+        assert trimmed is self.leaves
+
+    def test_same_epoch_partial_trim(self):
+        trimmed, skipped, done = trim_epoch_batches(ResumeCursor(2, 3), 2, self.leaves)
+        assert not done and skipped == 3
+        np.testing.assert_array_equal(np.asarray(trimmed[0]), [[9, 10, 11]])
+        np.testing.assert_array_equal(np.asarray(trimmed[1]), [3])
+
+    def test_cursor_at_or_past_epoch_length_means_done(self):
+        _, skipped, done = trim_epoch_batches(ResumeCursor(2, 4), 2, self.leaves)
+        assert done and skipped == 4
+        _, _, done = trim_epoch_batches(ResumeCursor(2, 99), 2, self.leaves)
+        assert done
+
+    def test_journal_accepted_directly(self):
+        j = BatchJournal()
+        j.record(0, 1)  # batches 0..1 folded -> resume at (0, 2)
+        trimmed, skipped, done = trim_epoch_batches(j, 0, self.leaves)
+        assert not done and skipped == 2
+        assert trimmed[0].shape == (2, 3)
+
+    def test_non_array_leaves_pass_through(self):
+        trimmed, _, done = trim_epoch_batches(ResumeCursor(0, 2), 0, [self.leaves[0], "static"])
+        assert not done
+        assert trimmed[1] == "static"
+
+
+class TestMakeEpochResume:
+    def test_resumed_epoch_matches_uninterrupted(self):
+        init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+        preds = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2], [0, 0, 1, 1], [2, 2, 2, 0]])
+        target = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2], [0, 0, 1, 2], [2, 0, 2, 0]])
+        state, _ = epoch(init(), preds, target)
+        ref = np.asarray(compute(state))
+
+        resumed, _ = epoch(init(), preds[:2], target[:2])  # "crashed" after batch 1
+        resumed, _ = epoch(resumed, preds, target, resume_from=ResumeCursor(0, 2), epoch_index=0)
+        np.testing.assert_array_equal(np.asarray(compute(resumed)), ref)
+
+    def test_fully_folded_epoch_is_a_noop(self):
+        init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+        preds = jnp.asarray([[0, 1], [2, 1]])
+        target = jnp.asarray([[0, 1], [2, 0]])
+        state, _ = epoch(init(), preds, target)
+        before = np.asarray(compute(state))
+        state2, values = epoch(state, preds, target, resume_from=ResumeCursor(1, 0), epoch_index=0)
+        assert values is None
+        np.testing.assert_array_equal(np.asarray(compute(state2)), before)
+
+    def test_resume_requires_epoch_index(self):
+        init, epoch, _ = make_epoch(Accuracy, num_classes=3)
+        with pytest.raises(ValueError, match="epoch_index"):
+            epoch(init(), jnp.asarray([[0]]), jnp.asarray([[0]]), resume_from=ResumeCursor(0, 0))
+
+    def test_resume_on_unjitted_epoch(self):
+        init, epoch, compute = make_epoch(MeanMetric, jit_epoch=False)
+        values = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        state, _ = epoch(init(), values)
+        ref = float(compute(state))
+        resumed, _ = epoch(init(), values[:1])
+        resumed, _ = epoch(resumed, values, resume_from=ResumeCursor(0, 1), epoch_index=0)
+        assert float(compute(resumed)) == ref

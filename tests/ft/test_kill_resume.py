@@ -1,0 +1,197 @@
+"""Kill-and-resume property (ISSUE acceptance criterion).
+
+A run preempted at an ARBITRARY batch and resumed from the latest
+checkpoint must produce ``compute()`` results bitwise-identical to an
+uninterrupted run — no dropped and no double-counted batches. The
+preemption is injected with the fault harness mid-epoch; the resumed
+process is modeled by fresh metric/journal objects restored through the
+:class:`~metrics_tpu.ft.CheckpointManager`. Batch order is identical in
+both runs, so float accumulation order is identical and the comparison can
+be exact (``assert_array_equal``), not approximate.
+
+Covered state shapes: scalar monoid states (MeanMetric), a
+MetricCollection with ACTIVE compute groups (Precision/Recall sharing one
+stat-scores pipeline), and a ``CapacityBuffer``-backed cat-state metric
+(AUROC with ``sample_capacity``).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytest.importorskip("orbax.checkpoint")
+
+from metrics_tpu import AUROC, MeanMetric, MetricCollection, Precision, Recall  # noqa: E402
+from metrics_tpu.ft import BatchJournal, CheckpointManager, ResumeCursor, faults  # noqa: E402
+from metrics_tpu.steps import make_epoch  # noqa: E402
+
+N_BATCHES = 12
+
+
+def _float_batches(seed=0):
+    key = jax.random.PRNGKey(seed)
+    # values with noisy mantissas so any reordering/double-count WOULD move bits
+    return [jax.random.normal(jax.random.fold_in(key, i), (8,)) * 3.7 for i in range(N_BATCHES)]
+
+
+def _classification_batches(seed=1):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(N_BATCHES):
+        k1, k2 = jax.random.split(jax.random.fold_in(key, i))
+        out.append(
+            (jax.random.uniform(k1, (16,)), jax.random.bernoulli(k2, 0.4, (16,)).astype(jnp.int32))
+        )
+    return out
+
+
+def _run_until_preempted(make_target, update, batches, kill_at, ckpt_dir, save_every=1):
+    """Eval loop that checkpoints as it goes and dies at batch ``kill_at``."""
+    mgr = CheckpointManager(ckpt_dir, keep_last=2)
+    target, journal = make_target(), BatchJournal()
+    with pytest.raises(faults.SimulatedPreemption):
+        with faults.inject("eval.batch", after=kill_at, count=1, exc=faults.SimulatedPreemption) as spec:
+            for step, batch in enumerate(batches):
+                faults.maybe_fail("eval.batch")
+                update(target, batch)
+                journal.record(0, step)
+                if step % save_every == 0:
+                    mgr.save(target, journal=journal, epoch=0, step=step)
+    assert spec["raised"] == 1
+    return mgr
+
+
+def _resume_and_finish(make_target, update, compute, batches, mgr):
+    """The restarted process: restore latest, skip folded batches, finish."""
+    target, journal = make_target(), BatchJournal()
+    manifest = mgr.restore(target, journal=journal)
+    assert manifest is not None, "preempted run must have left a checkpoint"
+    folded_before = journal.folded
+    for step, batch in enumerate(batches):
+        if not journal.should_fold(0, step):
+            continue
+        update(target, batch)
+        journal.record(0, step)
+    assert journal.folded == N_BATCHES
+    assert folded_before < N_BATCHES  # the resume actually had work to do
+    return compute(target)
+
+
+@pytest.mark.parametrize("kill_at", [1, 5, N_BATCHES - 1])
+class TestKillResumeBitwise:
+    def test_metric_scalar_states(self, tmp_path, kill_at):
+        batches = _float_batches()
+        ref = MeanMetric()
+        for b in batches:
+            ref.update(b)
+        expected = np.asarray(ref.compute())
+        assert ref._update_count == N_BATCHES
+
+        mgr = _run_until_preempted(MeanMetric, lambda m, b: m.update(b), batches, kill_at, tmp_path)
+        resumed_value = _resume_and_finish(
+            MeanMetric, lambda m, b: m.update(b), lambda m: m.compute(), batches, mgr
+        )
+        np.testing.assert_array_equal(np.asarray(resumed_value), expected)
+
+    def test_collection_with_compute_groups(self, tmp_path, kill_at):
+        batches = _classification_batches()
+
+        def make_coll():
+            return MetricCollection([Precision(), Recall()])
+
+        ref = make_coll()
+        for p, t in batches:
+            ref.update(p, t)
+        assert len(ref.compute_groups) == 1, "P/R must share one compute group"
+        expected = {k: np.asarray(v) for k, v in ref.compute().items()}
+
+        mgr = _run_until_preempted(make_coll, lambda c, b: c.update(*b), batches, kill_at, tmp_path)
+        resumed = _resume_and_finish(
+            make_coll, lambda c, b: c.update(*b), lambda c: c.compute(), batches, mgr
+        )
+        assert set(resumed) == set(expected)
+        for k in expected:
+            np.testing.assert_array_equal(np.asarray(resumed[k]), expected[k])
+
+    def test_capacity_buffer_cat_states(self, tmp_path, kill_at):
+        batches = _classification_batches(seed=2)
+        capacity = N_BATCHES * 16
+
+        def make_auroc():
+            return AUROC(sample_capacity=capacity)
+
+        ref = make_auroc()
+        for p, t in batches:
+            ref.update(p, t)
+        expected = np.asarray(ref.compute())
+
+        mgr = _run_until_preempted(make_auroc, lambda m, b: m.update(*b), batches, kill_at, tmp_path)
+        resumed_value = _resume_and_finish(
+            make_auroc, lambda m, b: m.update(*b), lambda m: m.compute(), batches, mgr
+        )
+        np.testing.assert_array_equal(np.asarray(resumed_value), expected)
+
+
+class TestKillResumeUpdateCount:
+    def test_update_count_not_double_counted(self, tmp_path):
+        """The restored count continues exactly — the _update_count honesty
+        half of the exactly-once contract."""
+        batches = _float_batches(seed=3)
+        mgr = _run_until_preempted(MeanMetric, lambda m, b: m.update(b), batches, kill_at=4, ckpt_dir=tmp_path)
+        m, journal = MeanMetric(), BatchJournal()
+        mgr.restore(m, journal=journal)
+        assert m._update_count == journal.folded == 4  # batches 0..3 folded pre-kill
+        for step, b in enumerate(batches):
+            if journal.should_fold(0, step):
+                m.update(b)
+                journal.record(0, step)
+        assert m._update_count == N_BATCHES
+
+
+class TestKillResumeFusedEpoch:
+    def test_make_epoch_resume_from_checkpointed_journal(self, tmp_path):
+        """Fused-epoch consumer: preempt between epochs of a multi-epoch
+        sweep, restore, and feed the journal's cursor to epoch()."""
+        init, epoch, compute = make_epoch(MeanMetric)
+        key = jax.random.PRNGKey(7)
+        # integer-valued floats: the resumed run folds epoch 1 as two flat
+        # updates where the uninterrupted run folds it as one, so the sum
+        # REDUCTION TREE differs — exact-in-f32 addends keep both exact and
+        # the bitwise comparison meaningful
+        epochs = [
+            jax.random.randint(jax.random.fold_in(key, e), (6, 8), 0, 100).astype(jnp.float32)
+            for e in range(3)
+        ]
+
+        state = init()
+        for e, data in enumerate(epochs):
+            state, _ = epoch(state, data)
+        expected = np.asarray(compute(state))
+
+        # interrupted run: epoch 0 fully folded + 2 batches of epoch 1, then killed.
+        # (the partial epoch is modeled by an explicit journal watermark — the
+        # per-batch path is exercised above; here the point is the cursor
+        # handoff into the fused entry point)
+        mgr = CheckpointManager(tmp_path / "fused")
+        journal = BatchJournal()
+        state = init()
+        state, _ = epoch(state, epochs[0])
+        journal.epoch_end(0, 6)
+        state, _ = epoch(state, epochs[1][:2])
+        journal.record(1, 0)
+        journal.record(1, 1)
+        holder = MeanMetric()
+        holder.load_state_pytree(state)
+        holder._update_count = journal.folded
+        mgr.save(holder, journal=journal, epoch=1, step=1)
+
+        # resumed process
+        restored, journal2 = MeanMetric(), BatchJournal()
+        mgr.restore(restored, journal=journal2)
+        state2 = restored.state_pytree()
+        cursor = journal2.resume_from
+        assert cursor == ResumeCursor(1, 2)
+        for e, data in enumerate(epochs):
+            state2, _ = epoch(state2, data, resume_from=cursor, epoch_index=e)
+        np.testing.assert_array_equal(np.asarray(compute(state2)), expected)

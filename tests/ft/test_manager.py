@@ -1,0 +1,301 @@
+"""CheckpointManager: atomicity, rotation, discovery, async saves,
+manifests, crash-mid-save and clock-skew fault injection."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+pytest.importorskip("orbax.checkpoint")
+
+from metrics_tpu import Accuracy, MeanMetric, MetricCollection, Precision, Recall, obs  # noqa: E402
+from metrics_tpu.ft import BatchJournal, CheckpointManager, faults  # noqa: E402
+from metrics_tpu.integrations import MetricLogger  # noqa: E402
+
+
+def _mean_with(values):
+    m = MeanMetric()
+    for v in values:
+        m.update(v)
+    return m
+
+
+class TestSaveRestore:
+    def test_roundtrip_with_manifest(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "ckpts")
+        journal = BatchJournal()
+        journal.record(0, 0)
+        journal.record(0, 1)
+        m = _mean_with([1.0, 2.0])
+        path = mgr.save(m, journal=journal, epoch=0, step=1, extra={"run": "sweep-7"})
+        assert os.path.isdir(path)
+
+        m2, j2 = MeanMetric(), BatchJournal()
+        manifest = mgr.restore(m2, journal=j2)
+        assert float(m2.compute()) == float(m.compute())
+        assert m2._update_count == 2
+        assert j2.watermark == (0, 1) and j2.resume_from == (0, 2)
+        assert manifest["epoch"] == 0 and manifest["step"] == 1
+        assert manifest["extra"] == {"run": "sweep-7"}
+        assert manifest["process_count"] >= 1 and "jax_version" in manifest
+
+    def test_restore_warns_when_journal_requested_but_absent(self, tmp_path):
+        """A checkpoint saved WITHOUT journal= cannot make resume
+        exactly-once; silently leaving the caller's journal fresh would
+        re-fold every batch — warn loudly instead."""
+        import warnings
+
+        mgr = CheckpointManager(tmp_path / "noj")
+        mgr.save(_mean_with([1.0]))  # no journal=
+        m, j = MeanMetric(), BatchJournal()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            mgr.restore(m, journal=j)
+        assert any("carries no journal" in str(w.message) for w in caught)
+        assert j.watermark is None
+
+    def test_restore_with_no_checkpoint_is_a_fresh_start(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "empty")
+        m = MeanMetric()
+        assert mgr.restore(m) is None
+        assert mgr.latest() is None
+        assert mgr.read_manifest() is None
+        assert m._update_count == 0
+
+    def test_collection_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "coll")
+        coll = MetricCollection([Precision(), Recall()])
+        coll.update(jnp.asarray([0.9, 0.2, 0.8]), jnp.asarray([1, 0, 1]))
+        mgr.save(coll, epoch=0)
+        coll2 = MetricCollection([Precision(), Recall()])
+        mgr.restore(coll2)
+        want, got = coll.compute(), coll2.compute()
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]))
+
+    def test_obs_snapshot_rides_manifest_when_enabled(self, tmp_path):
+        was = obs.enable(True)
+        try:
+            obs.reset()
+            m = _mean_with([1.0])
+            mgr = CheckpointManager(tmp_path / "obsck")
+            mgr.save(m)
+            manifest = mgr.read_manifest()
+            assert "obs" in manifest
+            assert any(k.startswith("metric.updates") for k in manifest["obs"]["counters"])
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+    def test_logger_history_survives_restart(self, tmp_path):
+        logger = MetricLogger()
+        acc = Accuracy()
+        logger.log("val/acc", acc, jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        logger.log("val/loss", 0.5)
+        logger.epoch_values()
+        logger.log("val/loss", 0.25)  # mid-epoch scalar buffer
+
+        mgr = CheckpointManager(tmp_path / "logck")
+        mgr.save(acc, logger=logger, epoch=1)
+
+        acc2, logger2 = Accuracy(), MetricLogger()
+        mgr.restore(acc2, logger=logger2)
+        assert len(logger2.history) == 1
+        assert logger2.history[0]["val/acc"] == pytest.approx(1.0)
+        assert logger2.history[0]["val/loss"] == pytest.approx(0.5)
+        assert len(logger2.obs_history) == 1
+        # the mid-epoch scalar buffer resumes accumulating
+        logger2.log("val/loss", 0.75)
+        assert logger2.epoch_values()["val/loss"] == pytest.approx(0.5)
+
+
+class TestRotationAndDiscovery:
+    def test_keep_last_rotation(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "rot", keep_last=2)
+        m = MeanMetric()
+        for i in range(5):
+            m.update(float(i))
+            mgr.save(m, step=i)
+        ckpts = mgr.checkpoints()
+        assert [seq for seq, _ in ckpts] == [3, 4]
+        assert mgr.latest().endswith("ckpt-00000004")
+        # the retained newest checkpoint restores the newest state
+        m2 = MeanMetric()
+        mgr.restore(m2)
+        assert float(m2.compute()) == float(m.compute())
+
+    def test_keep_all_when_none(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "all", keep_last=None)
+        m = _mean_with([1.0])
+        for _ in range(4):
+            mgr.save(m)
+        assert len(mgr.checkpoints()) == 4
+
+    def test_keep_last_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="keep_last"):
+            CheckpointManager(tmp_path, keep_last=0)
+
+    def test_latest_orders_by_seq_not_clock(self, tmp_path):
+        """Manifest timestamps lie under clock skew; seq order must win."""
+        mgr = CheckpointManager(tmp_path / "skew", keep_last=None)
+        with faults.clock_skew(+1e6):  # far future
+            mgr.save(_mean_with([1.0]), step=0)
+        mgr.save(_mean_with([1.0, 2.0]), step=1)
+        manifests = [mgr.read_manifest(p) for _, p in mgr.checkpoints()]
+        assert manifests[0]["recorded_unix"] > manifests[1]["recorded_unix"]  # skew took
+        assert mgr.latest().endswith("ckpt-00000001")
+        m = MeanMetric()
+        assert mgr.restore(m)["step"] == 1
+        assert float(m.compute()) == 1.5
+
+    def test_incomplete_dirs_are_invisible(self, tmp_path):
+        root = tmp_path / "inc"
+        mgr = CheckpointManager(root)
+        mgr.save(_mean_with([1.0]))
+        # a torn dir (no manifest) and a staging leftover must not surface
+        os.makedirs(root / "ckpt-00000007" / "state")
+        os.makedirs(root / ".tmp.killed" / "stage")
+        assert [seq for seq, _ in mgr.checkpoints()] == [0]
+        mgr.save(_mean_with([1.0]))
+        assert not (root / ".tmp.killed").exists()  # swept on the next save
+
+    def test_rotation_orphans_are_swept(self, tmp_path):
+        """A kill between rotation's manifest unlink and its rmtree leaves a
+        manifest-less ckpt husk; the next save must reclaim the disk (but
+        never touch husks NEWER than the newest complete checkpoint)."""
+        root = tmp_path / "orph"
+        mgr = CheckpointManager(root, keep_last=2)
+        for i in range(3):
+            mgr.save(_mean_with([float(i)]), step=i)
+        # simulate the interrupted-rotation husk below the newest complete
+        # seq, and one above it (e.g. another process mid-publish)
+        os.makedirs(root / "ckpt-00000000" / "state", exist_ok=True)
+        os.makedirs(root / "ckpt-00000099" / "state")
+        mgr.save(_mean_with([9.0]), step=9)
+        assert not (root / "ckpt-00000000").exists()  # orphan reclaimed
+        assert (root / "ckpt-00000099").exists()  # newer husk left alone
+        assert [seq for seq, _ in mgr.checkpoints()] == [2, 3]
+
+
+class TestCrashMidSave:
+    def test_previous_latest_survives_crash(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "crash")
+        m = _mean_with([1.0])
+        mgr.save(m, step=0)
+        m.update(2.0)
+        with faults.crash_mid_save() as spec:
+            with pytest.raises(faults.SimulatedPreemption):
+                mgr.save(m, step=1)
+        assert spec["raised"] == 1
+        assert len(mgr.checkpoints()) == 1
+        m2 = MeanMetric()
+        manifest = mgr.restore(m2)
+        assert manifest["step"] == 0
+        assert float(m2.compute()) == 1.0  # pre-crash state, not torn
+        # and the manager recovers: the next save publishes normally
+        mgr.save(m, step=1)
+        assert mgr.read_manifest()["step"] == 1
+
+    def test_save_state_is_atomic_on_crash(self, tmp_path):
+        """The legacy single-path save survives a crash mid-write too."""
+        m = _mean_with([1.0, 3.0])
+        target = tmp_path / "single"
+        m.save(target)
+        m.update(5.0)
+        with faults.crash_mid_save():
+            with pytest.raises(faults.SimulatedPreemption):
+                m.save(target)
+        m2 = MeanMetric().restore(target)
+        assert float(m2.compute()) == 2.0  # the complete previous write
+        assert [p for p in os.listdir(tmp_path) if p.startswith(".tmp.")] == []
+
+    def test_mid_swap_kill_is_recoverable_via_prev(self, tmp_path):
+        """Overwriting an existing path needs two renames; a kill between
+        them parks the old checkpoint at <path>.prev and restore falls back
+        to it — the previous state is never lost."""
+        m = _mean_with([1.0, 3.0])
+        target = tmp_path / "swap"
+        m.save(target)
+        m.update(5.0)
+        with faults.inject("checkpoint.mid_swap", exc=faults.SimulatedPreemption) as spec:
+            with pytest.raises(faults.SimulatedPreemption):
+                m.save(target)
+        assert spec["raised"] == 1
+        assert not os.path.exists(target)  # the two-rename window
+        assert os.path.isdir(str(target) + ".prev")
+        m2 = MeanMetric().restore(target)  # transparent .prev fallback
+        assert float(m2.compute()) == 2.0
+        # the next save republishes normally, removes the now-superseded
+        # .prev, and restore prefers the real path
+        m.save(target)
+        m3 = MeanMetric().restore(target)
+        assert float(m3.compute()) == 3.0
+        assert not os.path.exists(str(target) + ".prev")
+
+
+class TestAsyncSave:
+    def test_async_save_equivalent_to_sync(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "async", async_save=True)
+        m = _mean_with([1.0, 2.0, 3.0])
+        path = mgr.save(m, epoch=0)
+        # the snapshot happened on THIS thread at save(): mutating the
+        # metric afterwards must not leak into the checkpoint
+        m.update(100.0)
+        mgr.wait()
+        assert mgr.latest() == path
+        m2 = MeanMetric()
+        mgr.restore(m2)
+        assert float(m2.compute()) == 2.0
+        assert m2._update_count == 3
+
+    def test_async_saves_serialize_and_rotate(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "async2", keep_last=2, async_save=True)
+        m = MeanMetric()
+        for i in range(4):
+            m.update(float(i))
+            mgr.save(m, step=i)
+        mgr.wait()
+        assert [seq for seq, _ in mgr.checkpoints()] == [2, 3]
+
+    def test_async_save_survives_donated_buffers(self, tmp_path):
+        """The async snapshot must COPY device buffers: the caller's next
+        jitted step donates the carry (make_epoch jits with donate_argnums=0),
+        and an aliasing snapshot would read deleted arrays off-thread."""
+        from metrics_tpu.steps import make_epoch
+
+        init, epoch, _ = make_epoch(MeanMetric)
+        data = jnp.arange(8.0).reshape(2, 4)
+        state, _ = epoch(init(), data)
+        holder = MeanMetric()
+        holder.load_state_pytree(state)
+        holder._update_count = 1
+        mgr = CheckpointManager(tmp_path / "donated", async_save=True)
+        mgr.save(holder, epoch=0)
+        state, _ = epoch(state, data)  # donates the buffers the save aliased
+        mgr.wait()  # must not surface "Array has been deleted"
+        restored = MeanMetric()
+        assert mgr.restore(restored) is not None
+        assert float(restored.compute()) == float(jnp.mean(data))
+
+    def test_async_error_surfaces_on_wait(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "async3", async_save=True)
+        with faults.crash_mid_save():
+            mgr.save(_mean_with([1.0]))
+            with pytest.raises(faults.SimulatedPreemption):
+                mgr.wait()
+        assert mgr.checkpoints() == []
+
+
+class TestManifestFile:
+    def test_manifest_is_valid_json_on_disk(self, tmp_path):
+        mgr = CheckpointManager(tmp_path / "mf")
+        journal = BatchJournal()
+        journal.record(2, 41)
+        path = mgr.save(_mean_with([1.0]), journal=journal, epoch=2, step=41)
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["journal"]["watermark"] == [2, 41]
+        assert manifest["schema"] == 1
+        assert manifest["seq"] == 0

@@ -1,0 +1,135 @@
+"""CI smoke: real-SIGKILL preemption mid-epoch, resume, bitwise equality.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.preemption_smoke``
+(the CI test job does). The in-process kill-and-resume battery
+(``tests/ft/test_kill_resume.py``) injects preemptions as exceptions; this
+smoke delivers the real thing: a worker subprocess streams batches through
+a checkpointing eval loop and **SIGKILLs itself MID-SAVE** — the fault
+harness fires a real SIGKILL at the ``checkpoint.pre_rename`` seam, after
+the checkpoint is staged but before the rename publishes it. No atexit, no
+finally blocks, no flushed buffers: exactly a preemption, landed in the
+torn-write window. The relaunched worker resumes from the latest COMPLETE
+checkpoint via the journal cursor and must finish with:
+
+* ``compute()`` bitwise-identical to an uninterrupted in-process run
+  (the mid-save batch was folded in memory but never published — it must
+  be re-folded exactly once),
+* an honest ``_update_count`` (every batch folded exactly once),
+* the killed save's leftover ``.tmp.*`` staging dir present after the
+  kill, ignored by discovery, and swept by the resumed run's saves.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_BATCHES = 24
+KILL_AT = 13  # arbitrary mid-epoch batch; the worker dies before folding it
+BATCH = 32
+
+
+def _batches():
+    import jax
+
+    key = jax.random.PRNGKey(42)
+    # noisy-mantissa floats: any drop/double-count moves bits in the mean
+    return [jax.random.normal(jax.random.fold_in(key, i), (BATCH,)) * 2.345 for i in range(N_BATCHES)]
+
+
+class _SigkillMidSave(BaseException):
+    """Fault-injection payload that delivers a REAL SIGKILL the instant the
+    'checkpoint.pre_rename' seam fires — i.e. after the checkpoint is fully
+    staged but before the atomic rename publishes it. Instantiation (inside
+    ``faults.maybe_fail``) is the kill, so no Python cleanup runs and the
+    staging dir genuinely survives on disk."""
+
+    def __init__(self, *args: object) -> None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def worker(ckpt_dir: str, out_path: str, kill_at: int) -> None:
+    from metrics_tpu import MeanMetric
+    from metrics_tpu.ft import BatchJournal, CheckpointManager, faults
+
+    mgr = CheckpointManager(ckpt_dir, keep_last=2)
+    metric, journal = MeanMetric(), BatchJournal()
+    manifest = mgr.restore(metric, journal=journal)
+    print(f"worker: start folded={journal.folded} resumed={manifest is not None}", flush=True)
+    for step, batch in enumerate(_batches()):
+        if not journal.should_fold(0, step):
+            continue
+        metric.update(batch)
+        journal.record(0, step)
+        if step == kill_at:
+            # die MID-SAVE: batch kill_at is folded in memory and staged on
+            # disk, but never published — the resumed run must re-fold it
+            # exactly once off the previous checkpoint, and the leftover
+            # .tmp.* staging dir must be invisible to discovery
+            with faults.inject("checkpoint.pre_rename", exc=_SigkillMidSave):
+                mgr.save(metric, journal=journal, epoch=0, step=step)
+            raise AssertionError("unreachable: SIGKILL fired mid-save")
+        mgr.save(metric, journal=journal, epoch=0, step=step)
+    result = {
+        "value": float(metric.compute()),
+        "update_count": metric._update_count,
+        "folded": journal.folded,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print(f"worker: done {result}", flush=True)
+
+
+def main() -> None:
+    import numpy as np
+
+    from metrics_tpu import MeanMetric
+
+    reference = MeanMetric()
+    for batch in _batches():
+        reference.update(batch)
+    expected = float(reference.compute())
+
+    tmp = tempfile.mkdtemp(prefix="preemption_smoke.")
+    ckpt_dir = os.path.join(tmp, "ckpts")
+    out_path = os.path.join(tmp, "result.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run(kill_at: int) -> int:
+        cmd = [sys.executable, "-m", "tests.integrations.preemption_smoke",
+               "--worker", ckpt_dir, out_path, str(kill_at)]
+        return subprocess.run(cmd, env=env, timeout=600).returncode
+
+    rc = run(KILL_AT)
+    assert rc == -signal.SIGKILL, f"first run should die by SIGKILL, got rc={rc}"
+    assert not os.path.exists(out_path), "killed run must not have produced a result"
+    assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir), "killed run must have checkpointed"
+    leftovers = [n for n in os.listdir(ckpt_dir) if n.startswith(".tmp.")]
+    assert leftovers, "SIGKILL mid-save must leave a staging dir (it fired before the rename)"
+
+    rc = run(kill_at=-1)  # resume, no kill
+    assert rc == 0, f"resumed run failed rc={rc}"
+    assert not any(n.startswith(".tmp.") for n in os.listdir(ckpt_dir)), (
+        "resumed run's saves must sweep the stale staging leftovers"
+    )
+    with open(out_path) as f:
+        result = json.load(f)
+
+    assert result["update_count"] == result["folded"] == N_BATCHES, result
+    assert np.float32(result["value"]) == np.float32(expected), (
+        f"kill-and-resume value {result['value']!r} != uninterrupted {expected!r} (bitwise)"
+    )
+    print(
+        f"preemption smoke OK: SIGKILL at batch {KILL_AT}/{N_BATCHES}, resumed to"
+        f" bitwise-equal compute() = {result['value']}"
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    else:
+        main()
