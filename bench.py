@@ -492,6 +492,72 @@ def bench_checkpoint() -> dict:
     return out
 
 
+def bench_streaming(n: int = N_SAMPLES) -> dict:
+    """Streaming-subsystem hot paths over the standard 1M-sample stream.
+
+    - ``streaming_auroc_1M_update`` — fold 1M (score, label) pairs into the
+      default 2048-bin ``ScoreLabelSketch`` (one jitted scatter-add /
+      binned-counts launch): the per-epoch cost that replaces the exact
+      path's O(N) HBM accumulation.
+    - ``streaming_auroc_1M_merge`` — one sketch merge (the mesh/window/
+      resume combine op), fori-loop amortized so dispatch doesn't swamp a
+      16 KB elementwise add.
+    - ``streaming_auroc_1M_compute`` — AUROC envelope midpoint from the
+      sketch, amortized likewise; compare ``auroc_exact_1M_compute`` to see
+      what the documented error bound buys.
+    - ``windowed_fold_k16`` — one ``make_stream_step`` launch on a 16-shard
+      ring (fold + rotate + expire + window compute in one program).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.steps import make_stream_step
+    from metrics_tpu.streaming import ScoreLabelSketch, StreamingAUROC, WindowedMetric
+
+    preds = jax.random.uniform(jax.random.PRNGKey(7), (n,), dtype=jnp.float32)
+    target = jax.random.bernoulli(jax.random.PRNGKey(8), 0.5, (n,)).astype(jnp.int32)
+    out: dict = {}
+
+    template = ScoreLabelSketch(2048)
+    fold = jax.jit(lambda p, t: template.fold(p, t))
+    out["streaming_auroc_1M_update"] = _min_ms(lambda: jax.block_until_ready(fold(preds, target)))
+
+    sketch_a = fold(preds, target)
+    sketch_b = fold(jnp.flip(preds), jnp.flip(1 - target))
+
+    @jax.jit
+    def merge_k(a, b):
+        return jax.lax.fori_loop(0, K_REPEATS, lambda _, acc: acc.merge(b), a)
+
+    out["streaming_auroc_1M_merge"] = (
+        _min_ms(lambda: jax.block_until_ready(merge_k(sketch_a, sketch_b))) / K_REPEATS
+    )
+
+    @jax.jit
+    def compute_k(s):
+        return jax.lax.fori_loop(0, K_REPEATS, lambda _, acc: acc + s.auroc(), jnp.float32(0))
+
+    out["streaming_auroc_1M_compute"] = (
+        _min_ms(lambda: jax.block_until_ready(compute_k(sketch_a))) / K_REPEATS
+    )
+
+    init, step, _ = make_stream_step(
+        WindowedMetric(StreamingAUROC(num_bins=2048), window=16, updates_per_slot=1)
+    )
+    pb, tb = preds[: max(1, n // N_BATCHES)], target[: max(1, n // N_BATCHES)]
+    state = init()
+    state, value = step(state, pb, tb)  # warm: one trace+compile
+    jax.block_until_ready(value)
+    times = []
+    for _ in range(8):  # the donated carry re-threads, so time calls singly
+        t0 = time.perf_counter()
+        state, value = step(state, pb, tb)
+        jax.block_until_ready(value)
+        times.append(time.perf_counter() - t0)
+    out["windowed_fold_k16"] = min(times) * 1000.0
+    return out
+
+
 def bench_probes() -> dict:
     """Chip-state calibration probes, one per op class.
 
@@ -892,6 +958,28 @@ def main(json_path: "str | None" = None) -> None:
         )
     except Exception as err:  # noqa: BLE001 — a missing orbax must not kill the sweep
         print(f"SKIPPED checkpoint rows: {err}", file=sys.stderr)
+
+    # streaming subsystem: bounded-memory sketch fold/merge/compute + the
+    # one-launch windowed monitor step. The compute row's A/B is the exact
+    # 1M AUROC compute from the curves section — what the documented error
+    # bound buys; the others gate against their own best prior round.
+    try:
+        stream_rows = section(bench_streaming)
+        for row_name in ("streaming_auroc_1M_update", "streaming_auroc_1M_merge", "windowed_fold_k16"):
+            emit(
+                row_name,
+                stream_rows[row_name],
+                prior.get(row_name, stream_rows[row_name]),
+                baseline="best_prior_self",
+            )
+        emit(
+            "streaming_auroc_1M_compute",
+            stream_rows["streaming_auroc_1M_compute"],
+            curves["auroc_exact_1M_compute"],
+            baseline="exact_auroc_same_stream",
+        )
+    except Exception as err:  # noqa: BLE001 — streaming rows must not kill the sweep
+        print(f"SKIPPED streaming rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

@@ -114,7 +114,8 @@ from metrics_tpu.text import (  # noqa: E402, F401
 )
 from metrics_tpu import ft  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
-from metrics_tpu.steps import make_epoch, make_step  # noqa: E402, F401
+from metrics_tpu import streaming  # noqa: E402, F401
+from metrics_tpu.steps import make_epoch, make_step, make_stream_step  # noqa: E402, F401
 from metrics_tpu.utilities.debug import debug_checks  # noqa: E402, F401
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
@@ -182,9 +183,11 @@ __all__ = [
     "MinMaxMetric",
     "make_epoch",
     "make_step",
+    "make_stream_step",
     "debug_checks",
     "ft",
     "obs",
+    "streaming",
     "MultioutputWrapper",
     "MaxMetric",
     "MeanAveragePrecision",

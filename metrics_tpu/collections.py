@@ -19,6 +19,7 @@ import jax
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.obs.tracing import trace_span as _obs_span
+from metrics_tpu.streaming.sketches import Sketch
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.data import _flatten_dict, allclose, coerce_foreign_tensors, foreign_coercion_scope
 
@@ -257,6 +258,13 @@ class MetricCollection(dict):
                 if len(state1) != len(state2):
                     return False
                 if len(state1) and not allclose(state1.materialize(), state2.materialize()):
+                    return False
+            elif isinstance(state1, Sketch):
+                if state1.config() != state2.config():
+                    return False
+                leaves1 = jax.tree_util.tree_leaves(state1)
+                leaves2 = jax.tree_util.tree_leaves(state2)
+                if not all(allclose(s1, s2) for s1, s2 in zip(leaves1, leaves2)):
                     return False
             elif not allclose(state1, state2):
                 return False

@@ -29,6 +29,12 @@ Convenience surface: ``Metric.save(path)`` / ``Metric.restore(path)`` and
 the :class:`~metrics_tpu.collections.MetricCollection` equivalents wrap
 the atomic single-checkpoint path; reach for the manager when you need
 rotation, manifests or async saves. See ``docs/fault_tolerance.md``.
+
+Always-on monitors are first-class here too: streaming sketch states and
+window-ring bookkeeping (:mod:`metrics_tpu.streaming`) ride the same
+manifest round-trip, and gating folds on the journal watermark makes a
+preempted monitoring loop's resume reproduce ``compute()`` bitwise
+(``tests/streaming/test_windows.py``).
 """
 from metrics_tpu.ft import faults  # noqa: F401  (import order: retry consumes it)
 from metrics_tpu.ft.journal import BatchJournal, ResumeCursor, trim_epoch_batches

@@ -50,13 +50,18 @@ from metrics_tpu.obs.registry import inc as _obs_inc
 from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.obs.tracing import pytree_nbytes as _obs_nbytes
 from metrics_tpu.obs.tracing import trace_span as _obs_span
+from metrics_tpu.streaming.sketches import Sketch
 from metrics_tpu.utilities.distributed import distributed_available, gather_all_tensors
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
 
-_VALID_REDUCTIONS = ("sum", "mean", "cat", "min", "max")
+# "sketch" marks a state whose value is a mergeable summary
+# (metrics_tpu.streaming.sketches.Sketch): merged with state.merge(other)
+# in forward folds and the eager gather, and leafwise psum/pmin/pmax under
+# shard_map (utilities.distributed.sync_sketch_in_context)
+_VALID_REDUCTIONS = ("sum", "mean", "cat", "min", "max", "sketch")
 
 
 def jit_distributed_available() -> bool:
@@ -174,11 +179,18 @@ class Metric(ABC):
                 raise ValueError("`default` CapacityBuffer state must be initially empty")
             if dist_reduce_fx not in ("cat", None):
                 raise ValueError("CapacityBuffer states require dist_reduce_fx='cat' or None")
+        elif isinstance(default, Sketch):
+            if dist_reduce_fx is None:
+                dist_reduce_fx = "sketch"
+            elif dist_reduce_fx != "sketch":
+                raise ValueError("Sketch states require dist_reduce_fx='sketch' or None")
         elif isinstance(default, (np.ndarray, np.generic)):
             default = jnp.asarray(default)
+        if dist_reduce_fx == "sketch" and not isinstance(default, Sketch):
+            raise ValueError("dist_reduce_fx='sketch' requires a streaming.sketches.Sketch default")
         # python scalars/other types are rejected like the reference
         # (metric.py:188-191)
-        if not isinstance(default, (list, jnp.ndarray, jax.Array, CapacityBuffer)):
+        if not isinstance(default, (list, jnp.ndarray, jax.Array, CapacityBuffer, Sketch)):
             raise ValueError("Invalid `default`: state must be a jax array or an empty list")
         if isinstance(default, list) and default:
             raise ValueError("`default` list state must be initially empty")
@@ -287,6 +299,9 @@ class Metric(ABC):
             if isinstance(acc, list):
                 setattr(self, name, acc + list(new))
                 continue
+            if reduce_fx == "sketch":
+                setattr(self, name, acc.merge(new))
+                continue
             if reduce_fx == "mean":
                 # Running average over update calls (stack-mean over two
                 # partials would mis-weight unequal histories).
@@ -364,11 +379,17 @@ class Metric(ABC):
         from metrics_tpu.ft.retry import degraded_sync_scope
 
         input_dict = {name: getattr(self, name) for name in self._reductions}
+        sketch_defs: Dict[str, Any] = {}
         for name, value in input_dict.items():
             if isinstance(value, list) and value:
                 input_dict[name] = [dim_zero_cat(value)]
             elif isinstance(value, CapacityBuffer):
                 input_dict[name] = [value.materialize()] if value else []
+            elif isinstance(value, Sketch):
+                # gather each static-shape leaf, rebuild one sketch per
+                # rank below, then fold them with the merge monoid
+                leaves, sketch_defs[name] = jax.tree_util.tree_flatten(value)
+                input_dict[name] = leaves
 
         with degraded_sync_scope() as scope:
             output_dict = apply_to_collection(
@@ -385,6 +406,15 @@ class Metric(ABC):
             )
 
         for name, outputs in output_dict.items():
+            if name in sketch_defs:
+                # outputs is [leaf][rank]; regroup per rank and merge
+                n_ranks = len(outputs[0]) if outputs else 1
+                ranks = [
+                    jax.tree_util.tree_unflatten(sketch_defs[name], [leaf_out[r] for leaf_out in outputs])
+                    for r in range(n_ranks)
+                ]
+                setattr(self, name, functools.reduce(lambda a, b: a.merge(b), ranks))
+                continue
             if isinstance(getattr(self, name), (list, CapacityBuffer)):
                 # outputs is a list-of-lists: one gathered list per original
                 # (pre-concatenated) element — flatten to per-rank tensors.
@@ -468,6 +498,8 @@ class Metric(ABC):
                 v = state[name]
                 if isinstance(v, CapacityBuffer):
                     setattr(self, name, deepcopy(v))
+                elif isinstance(v, Sketch):
+                    setattr(self, name, v)  # immutable summary: share directly
                 else:
                     setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
 
@@ -487,6 +519,8 @@ class Metric(ABC):
                 v = state_dict[key]
                 if isinstance(v, CapacityBuffer):
                     setattr(self, name, deepcopy(v))
+                elif isinstance(v, Sketch):
+                    setattr(self, name, v)
                 else:
                     setattr(self, name, list(v) if isinstance(v, (list, tuple)) else jnp.asarray(v))
 
@@ -541,10 +575,12 @@ class Metric(ABC):
                 if value.data is not None and jnp.issubdtype(value.data.dtype, jnp.floating):
                     value.data = value.data.astype(dst_type)
                     value.dtype = jnp.dtype(dst_type)  # future appends cast too
+            elif isinstance(value, Sketch):
+                pass  # summary counts keep their exact-integer f32 dtype
             else:
                 setattr(self, name, _cast(value))
             default = self._defaults[name]
-            if not isinstance(default, (list, CapacityBuffer)):
+            if not isinstance(default, (list, CapacityBuffer, Sketch)):
                 self._defaults[name] = _cast(default)
         return self
 
@@ -703,6 +739,8 @@ def _apply_reduction(reduce_fx: Union[str, Callable], outputs: List[Array]) -> A
         return jnp.stack(outputs).min(axis=0)
     if reduce_fx == "cat":
         return jnp.concatenate([jnp.atleast_1d(o) for o in outputs], axis=0)
+    if reduce_fx == "sketch":
+        return functools.reduce(lambda a, b: a.merge(b), outputs)
     if callable(reduce_fx):
         return reduce_fx(jnp.stack(outputs))
     raise MetricsTPUUserError(f"Unsupported dist_reduce_fx {reduce_fx}")

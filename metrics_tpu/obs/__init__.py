@@ -14,7 +14,9 @@ uninstrumented build — pinned by ``tests/bases/test_obs.py``):
    ``recompile_warn_threshold`` (shape/dtype drift).
 3. **Runtime-counter registry** — updates applied, fused-epoch launches and
    batches folded, per-metric state bytes, collective sync count + payload
-   bytes, ``CapacityBuffer`` clamp-risk events. **Counter semantics under
+   bytes, ``CapacityBuffer`` clamp-risk events, and the streaming
+   subsystem's ``stream.windows_expired`` / ``stream.drift_checks`` /
+   ``stream.drift_alerts`` series. **Counter semantics under
    jit:** hooks are Python, so inside jitted code they run at TRACE time —
    counters on jitted paths (``metric.updates`` reached through a jitted
    step, ``sync.collectives``, ``sync.payload_bytes``) count once per

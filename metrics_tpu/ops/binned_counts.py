@@ -139,3 +139,33 @@ def binned_counts(preds: Array, target: Array, thresholds: Array) -> tuple:
     if jax.default_backend() == "tpu" and thresholds.shape[0] <= 256:
         return _binned_counts_pallas(preds, target, thresholds)
     return _binned_counts_xla(preds, target, thresholds)
+
+
+def binned_label_histograms(preds: Array, target: Array, num_bins: int) -> tuple:
+    """Per-bin ``(positive, negative)`` label histograms over ``num_bins``
+    equal score bins in [0, 1] — the sufficient statistic of the streaming
+    ``ScoreLabelSketch`` — via the fused threshold kernel.
+
+    Bin ``k`` covers ``[k/T, (k+1)/T)`` with the last bin closed at 1.0
+    (scores are clipped into range first). The kernel's outputs are
+    cumulative ``>= threshold`` counts, so the per-bin masses are the
+    adjacent differences; keeping that layout translation HERE, beside the
+    kernel that defines it, lets every consumer share one definition.
+
+    Args:
+        preds: ``(N,)`` scores (clipped to [0, 1]).
+        target: ``(N,)`` binary labels (strict ``== 1`` marks a positive).
+        num_bins: ``T``; the pallas path engages on TPU at ``T <= 256``.
+
+    Returns:
+        ``(pos_hist, neg_hist)``, each ``(T,)`` float32.
+    """
+    thresholds = jnp.arange(num_bins, dtype=jnp.float32) / num_bins
+    preds = jnp.clip(jnp.ravel(preds), 0.0, 1.0)
+    target = jnp.ravel(target).astype(jnp.int32)
+    tps, fps, _ = binned_counts(preds[:, None], target[:, None], thresholds)
+    tp_cum, fp_cum = tps[0], fps[0]  # counts with score >= k/T
+    zero = jnp.zeros((1,), jnp.float32)
+    pos_hist = tp_cum - jnp.concatenate([tp_cum[1:], zero])
+    neg_hist = fp_cum - jnp.concatenate([fp_cum[1:], zero])
+    return pos_hist, neg_hist

@@ -42,8 +42,14 @@ from metrics_tpu.obs.recompile import note_epoch_launch as _obs_epoch_launch
 from metrics_tpu.obs.recompile import note_trace as _obs_note_trace
 from metrics_tpu.obs.recompile import track_compiles as _obs_track_compiles
 from metrics_tpu.obs.tracing import trace_span as _obs_span
+from metrics_tpu.streaming.sketches import Sketch
 from metrics_tpu.utilities.buffers import CapacityBuffer
-from metrics_tpu.utilities.distributed import replicate_typed, sync_buffer_in_context, sync_reduce_in_context
+from metrics_tpu.utilities.distributed import (
+    replicate_typed,
+    sync_buffer_in_context,
+    sync_reduce_in_context,
+    sync_sketch_in_context,
+)
 
 Array = jax.Array
 State = Dict[str, Any]
@@ -51,9 +57,15 @@ State = Dict[str, Any]
 # A state is merge-combinable when its batch contribution (accumulated from
 # the default) folds into the carry with its own declared reduction — the
 # exact property the DDP gather-reduce sync relies on (per-rank states
-# accumulated from zero, merged by dist_reduce_fx). sum/max/min qualify; cat
-# buffers, None and custom reductions don't.
-_MERGE_OPS: Dict[str, Callable] = {"sum": lambda a, b: a + b, "max": jnp.maximum, "min": jnp.minimum}
+# accumulated from zero, merged by dist_reduce_fx). sum/max/min and sketch
+# summaries (merge is their defining monoid) qualify; cat buffers, None and
+# custom reductions don't.
+_MERGE_OPS: Dict[str, Callable] = {
+    "sum": lambda a, b: a + b,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+    "sketch": lambda a, b: a.merge(b),
+}
 
 
 def _is_mergeable(metric: Metric) -> bool:
@@ -62,7 +74,7 @@ def _is_mergeable(metric: Metric) -> bool:
         for r, d in zip(metric._reductions.values(), metric._defaults.values())
     )
 
-__all__ = ["make_epoch", "make_step"]
+__all__ = ["make_epoch", "make_step", "make_stream_step"]
 
 
 def _fresh_copy(state: State) -> State:
@@ -251,7 +263,8 @@ def make_step(
     # the small FINAL value instead of the gathered buffer (a pmax identity
     # collective) so a 1M-sample buffer sync moves ~1x payload, not the
     # n_dev x of the replicated psum-of-scatter form.
-    _psum_reductions = ("sum", "mean", "max", "min")
+    # sketch states sync leafwise through the psum family too — no gather
+    _psum_reductions = ("sum", "mean", "max", "min", "sketch")
     has_gather_state = any(
         isinstance(d, CapacityBuffer) or r not in _psum_reductions
         for r, d in zip(template._reductions.values(), template._defaults.values())
@@ -273,6 +286,11 @@ def make_step(
                     # utilities/distributed.py:128-151): gather data + count
                     # per device, concat the filled prefixes
                     reduced[name] = sync_buffer_in_context(value, axis_name, typed="varying")
+                elif isinstance(value, Sketch):
+                    # leafwise psum/pmin/pmax == the sketch merge over the
+                    # mesh (counts add, extremes extremize) — same payload
+                    # shape as a sum state, no gather
+                    reduced[name] = sync_sketch_in_context(value, axis_name)
                 else:
                     reduced[name] = sync_reduce_in_context(
                         value, template._reductions[name], axis_name, typed="varying"
@@ -289,11 +307,13 @@ def make_step(
 
 
 # fold a stacked (B, *state) leaf down its leading axis with the state's own
-# declared reduction — the epoch-axis analogue of _MERGE_OPS
+# declared reduction — the epoch-axis analogue of _MERGE_OPS (a vmapped
+# sketch state is a Sketch whose leaves carry the stacked axis)
 _FOLD_OPS: Dict[str, Callable] = {
     "sum": lambda m: m.sum(axis=0),
     "max": lambda m: m.max(axis=0),
     "min": lambda m: m.min(axis=0),
+    "sketch": lambda m: m.reduce_leading_axis(),
 }
 
 
@@ -506,6 +526,218 @@ def make_epoch(
     return init, epoch, compute
 
 
+def make_stream_step(
+    metric: Any,
+    *,
+    axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    jit_step: bool = True,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Build ``(init, stream_step, compute)`` from a windowed/decayed metric:
+    one launch folds a batch AND emits the current window value.
+
+    The eager :class:`~metrics_tpu.streaming.WindowedMetric` /
+    :class:`~metrics_tpu.streaming.DecayedMetric` API pays one dispatch for
+    the fold and another for every ``compute()``; an always-on monitor
+    wants both per batch. ``stream_step(state, *batch) -> (state', value)``
+    rolls the batch contribution, the ring-slot fold (or decay), the
+    automatic window rotation with shard expiry, and the refold-and-compute
+    of the CURRENT window into one traced program — the streaming analogue
+    of :func:`make_step`'s fused forward.
+
+    Args:
+        metric: a configured :class:`~metrics_tpu.streaming.WindowedMetric`
+            (``updates_per_slot`` must be set — ring rotation must be
+            expressible in-graph) or
+            :class:`~metrics_tpu.streaming.DecayedMetric` instance. The
+            wrapper's accumulated eager state is not carried over.
+        axis_name: as :func:`make_step`; both the per-step window value and
+            ``compute`` reduce the base state over the mesh axis — call
+            ``stream_step`` inside the same ``shard_map`` program.
+        jit_step: wrap ``stream_step`` in ``jax.jit`` with the carry
+            donated (default). Pass False when composing into an outer jit.
+
+    The carry is a plain state pytree (ring position and in-slot counter
+    ride as traced int32 scalars), so a monitoring loop can checkpoint it
+    with :class:`metrics_tpu.ft.CheckpointManager` and resume exactly-once
+    through the journal watermark like any epoch state.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.steps import make_stream_step
+        >>> from metrics_tpu.streaming import WindowedMetric
+        >>> acc = Accuracy(num_classes=2, multiclass=True)  # static classes for jit
+        >>> init, step, compute = make_stream_step(WindowedMetric(acc, window=2))
+        >>> state = init()
+        >>> state, v = step(state, jnp.asarray([1, 1]), jnp.asarray([1, 1]))
+        >>> state, v = step(state, jnp.asarray([0, 0]), jnp.asarray([1, 1]))
+        >>> float(v)  # window of the last 2 batches, one launch per step
+        0.5
+    """
+    from metrics_tpu.streaming.windows import DecayedMetric, WindowedMetric
+
+    if isinstance(metric, WindowedMetric):
+        if metric.updates_per_slot is None:
+            raise ValueError(
+                "make_stream_step needs WindowedMetric(updates_per_slot=N): ring rotation"
+                " must happen in-graph, and a host-side advance() cannot reach a jitted step."
+            )
+        make = _make_windowed_stream_step
+    elif isinstance(metric, DecayedMetric):
+        make = _make_decayed_stream_step
+    else:
+        raise ValueError(
+            f"make_stream_step expects a WindowedMetric or DecayedMetric instance, got"
+            f" {type(metric).__name__}. Wrap the base metric first (metrics_tpu.streaming)."
+        )
+    init, step, compute = make(metric, axis_name)
+
+    obs_name = f"{type(metric).__name__}[{type(metric._worker).__name__}]"
+    _step_label = f"{obs_name}.stream_step"
+    _step_token = object()
+
+    def traced_step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        _obs_note_trace(_step_label, _step_token)
+        with _obs_span(_step_label, category="step"):
+            return step(state, *args, **kwargs)
+
+    inner = _obs_track_compiles(jax.jit(traced_step, donate_argnums=0), _step_label) if jit_step else traced_step
+
+    if isinstance(metric, WindowedMetric):
+        # host-side ring-expiry accounting at the EAGER entry (the
+        # make_epoch launch-counter pattern: the jitted program is
+        # untouched and in-graph hooks would only fire at trace time).
+        # Mirrors the carried pos arithmetic, so it assumes the normal
+        # monitoring-loop shape — one linear state thread per factory.
+        ups_count, k_count = metric.updates_per_slot, metric.window
+        worker_name = type(metric._worker).__name__
+        calls = [0]
+
+        def stream_step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+            from metrics_tpu.obs.registry import enabled as _obs_enabled
+            from metrics_tpu.obs.registry import inc as _obs_inc
+
+            if _obs_enabled():
+                calls[0] += 1
+                if calls[0] > 1 and (calls[0] - 1) % ups_count == 0:
+                    rotation = (calls[0] - 1) // ups_count
+                    if rotation >= k_count:  # the cleared shard had content
+                        _obs_inc("stream.windows_expired", metric=worker_name)
+            return inner(state, *args, **kwargs)
+
+    else:
+        stream_step = inner
+    return init, stream_step, compute
+
+
+def _windowed_fold(reductions: Dict[str, str], slots: State) -> State:
+    return {name: _FOLD_OPS[red](slots[name]) for name, red in reductions.items()}
+
+
+def _make_windowed_stream_step(
+    metric: Any, axis_name: Optional[Union[str, Tuple[str, ...]]]
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """WindowedMetric as a pure step: the carry is ``{"slots": ring of K
+    state shards, "pos", "in_slot"}``; each step merges the batch
+    contribution into the current shard, rotates + expires in-graph when
+    the shard fills, and emits the base compute over the refolded window —
+    bitwise the eager wrapper's update-then-compute sequence."""
+    k = metric.window
+    ups = metric.updates_per_slot
+    reductions = dict(metric._base_reductions)
+    base_init, base_step, base_compute = make_step(metric._worker, axis_name=axis_name, with_value=False)
+
+    def _stack_slots(one: State) -> State:
+        return {
+            name: one[name].stack(k) if red == "sketch" else jnp.broadcast_to(
+                one[name][None], (k,) + jnp.shape(one[name])
+            )
+            for name, red in reductions.items()
+        }
+
+    def init() -> State:
+        return {
+            "slots": _stack_slots(base_init()),
+            "pos": jnp.asarray(0, jnp.int32),
+            "in_slot": jnp.asarray(0, jnp.int32),
+        }
+
+    def _set_row(stacked: Any, red: str, pos: Array, row: Any) -> Any:
+        if red == "sketch":
+            return stacked.set_slot(pos, row)
+        return jax.lax.dynamic_update_index_in_dim(stacked, row.astype(stacked.dtype), pos, 0)
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        contrib, _ = base_step(base_init(), *args, **kwargs)  # mergeable: state IS the contribution
+        pos, in_slot = state["pos"], state["in_slot"]
+        # lazy rotation BEFORE the fold (the eager wrapper's order): when
+        # the current shard is full, the ring advances and the oldest shard
+        # expires to the state default, then the batch folds into the fresh
+        # current shard — the emitted value always covers the newest batch
+        wrap = in_slot >= ups
+        new_pos = jnp.where(wrap, (pos + 1) % k, pos)
+        defaults = base_init()
+        slots: State = {}
+        for name, red in reductions.items():
+            stacked = state["slots"][name]
+            cleared = _set_row(stacked, red, new_pos, defaults[name])
+            expired = jax.tree_util.tree_map(
+                lambda c, s: jnp.where(wrap, c, s), cleared, stacked
+            )
+            if red == "sketch":
+                slots[name] = expired.merge_into_slot(new_pos, contrib[name])
+            else:
+                row = jax.lax.dynamic_index_in_dim(expired, new_pos, keepdims=False)
+                slots[name] = _set_row(expired, red, new_pos, _MERGE_OPS[red](row, contrib[name]))
+        new_in_slot = jnp.where(wrap, 1, in_slot + 1)
+        value = base_compute(_windowed_fold(reductions, slots))
+        return {"slots": slots, "pos": new_pos, "in_slot": new_in_slot}, value
+
+    def compute(state: State) -> Any:
+        return base_compute(_windowed_fold(reductions, state["slots"]))
+
+    return init, step, compute
+
+
+def _make_decayed_stream_step(
+    metric: Any, axis_name: Optional[Union[str, Tuple[str, ...]]]
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """DecayedMetric as a pure step: the carry is the base state (int sum
+    states lifted to f32 — decayed counts are fractional); each step scales
+    by the half-life decay, merges the batch contribution, and emits the
+    base compute of the decayed state."""
+    decay = metric.decay
+    reductions = dict(metric._base_reductions)
+    base_init, base_step, base_compute = make_step(metric._worker, axis_name=axis_name, with_value=False)
+
+    def _lift(state: State) -> State:
+        return {
+            name: state[name]
+            if red == "sketch" or jnp.issubdtype(state[name].dtype, jnp.floating)
+            else state[name].astype(jnp.float32)
+            for name, red in reductions.items()
+        }
+
+    def init() -> State:
+        return _lift(base_init())
+
+    def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
+        contrib, _ = base_step(base_init(), *args, **kwargs)
+        new_state: State = {}
+        for name, red in reductions.items():
+            acc = state[name]
+            if red == "sketch":
+                new_state[name] = acc.scale_sum_leaves(jnp.asarray(decay, jnp.float32)).merge(contrib[name])
+            else:
+                new_state[name] = acc * jnp.asarray(decay, acc.dtype) + contrib[name].astype(acc.dtype)
+        return new_state, base_compute(new_state)
+
+    def compute(state: State) -> Any:
+        return base_compute(state)
+
+    return init, step, compute
+
+
 def _apply_resume(resume_from: Any, epoch_index: Optional[int], batches: tuple, kw_batches: dict):
     """Slice already-folded leading batches off the epoch inputs (host-side;
     see :mod:`metrics_tpu.ft.journal` for the cursor semantics)."""
@@ -711,19 +943,23 @@ def _make_multioutput_step(
     if wrapper.remove_nans:
         # a nested wrapper base has NO states of its own (empty _defaults),
         # which would make the mergeability check vacuously true
-        if not wrapper.metrics[0]._defaults or not _is_mergeable(wrapper.metrics[0]):
+        if (
+            not wrapper.metrics[0]._defaults
+            or not _is_mergeable(wrapper.metrics[0])
+            or any(isinstance(d, Sketch) for d in wrapper.metrics[0]._defaults.values())
+        ):
             raise ValueError(
                 "MultioutputWrapper(remove_nans=True) as a step needs every base-metric state to be"
                 " sum/max/min-reducible (NaN rows are masked to the reduction identity and"
-                " merge-folded). This base metric has cat/mean/custom states; construct the wrapper"
-                " with remove_nans=False (inputs must be NaN-free) or use the eager class API."
+                " merge-folded). This base metric has cat/mean/custom/sketch states; construct the"
+                " wrapper with remove_nans=False (inputs must be NaN-free) or use the eager class API."
             )
         return _make_multioutput_nanmask_step(wrapper, axis_name=axis_name, with_value=with_value)
-    if any(isinstance(d, CapacityBuffer) for d in wrapper.metrics[0]._defaults.values()):
+    if any(isinstance(d, (CapacityBuffer, Sketch)) for d in wrapper.metrics[0]._defaults.values()):
         raise ValueError(
-            "MultioutputWrapper over a sample-buffer base metric is not a stackable step carry"
-            " (CapacityBuffer states cannot broadcast over the output axis). Use the eager class"
-            " API, or one make_step per output."
+            "MultioutputWrapper over a sample-buffer or sketch base metric is not a stackable"
+            " step carry (these states cannot broadcast over the output axis here). Use the"
+            " eager class API, or one make_step per output."
         )
     n_out = len(wrapper.metrics)
     dim = wrapper.output_dim

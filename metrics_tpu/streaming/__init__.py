@@ -1,0 +1,81 @@
+"""``metrics_tpu.streaming`` — always-on online monitoring over endless streams.
+
+The epoch lifecycle (``update``/``compute``/``reset``) assumes a finite
+pass over a dataset; serving-time monitoring streams forever. This
+subsystem supplies the three missing pieces (see ``docs/streaming.md``):
+
+1. **Sketch states** (:mod:`~metrics_tpu.streaming.sketches`) — fixed-size,
+   jit-safe, pytree-registered summaries whose merge is associative and
+   commutative: :class:`QuantileSketch` and :class:`ScoreLabelSketch` back
+   the bounded-memory :class:`StreamingAUROC` /
+   :class:`StreamingAveragePrecision` / :class:`StreamingQuantile` metrics,
+   each with a documented, computable error bound vs the exact
+   sample-keeping path.
+2. **Windowed and decayed wrappers** (:mod:`~metrics_tpu.streaming.windows`)
+   — :class:`WindowedMetric` (ring of expirable state shards) and
+   :class:`DecayedMetric` (half-life EWMA fold); drive them one launch per
+   batch with :func:`metrics_tpu.steps.make_stream_step`.
+3. **Drift monitors** (:mod:`~metrics_tpu.streaming.drift`) — PSI / KL / JS
+   divergence of the live sketch against a frozen reference, with
+   threshold alerts surfaced through ``metrics_tpu.obs`` counters.
+
+Sketch-state metrics checkpoint through
+:class:`metrics_tpu.ft.CheckpointManager` (manifest round-trip,
+exactly-once resume via the journal watermark) like any other metric.
+"""
+from typing import Any
+
+# sketches.py has no dependency on metric.py, so it loads eagerly (metric.py
+# itself imports Sketch for the "sketch" reduction registry); everything
+# depending on Metric loads lazily through __getattr__ to keep this package
+# importable mid-way through metrics_tpu.metric's own import.
+from metrics_tpu.streaming.sketches import (  # noqa: F401
+    QuantileSketch,
+    ScoreLabelSketch,
+    Sketch,
+    merge_all,
+    sketch_from_pack_tree,
+)
+
+__all__ = [
+    "DecayedMetric",
+    "DriftMonitor",
+    "QuantileSketch",
+    "ScoreLabelSketch",
+    "Sketch",
+    "StreamingAUROC",
+    "StreamingAveragePrecision",
+    "StreamingQuantile",
+    "WindowedMetric",
+    "js_divergence",
+    "kl_divergence",
+    "merge_all",
+    "population_stability_index",
+    "sketch_from_pack_tree",
+]
+
+_LAZY = {
+    "StreamingAUROC": "metrics_tpu.streaming.metrics",
+    "StreamingAveragePrecision": "metrics_tpu.streaming.metrics",
+    "StreamingQuantile": "metrics_tpu.streaming.metrics",
+    "WindowedMetric": "metrics_tpu.streaming.windows",
+    "DecayedMetric": "metrics_tpu.streaming.windows",
+    "DriftMonitor": "metrics_tpu.streaming.drift",
+    "js_divergence": "metrics_tpu.streaming.drift",
+    "kl_divergence": "metrics_tpu.streaming.drift",
+    "population_stability_index": "metrics_tpu.streaming.drift",
+}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY:
+        import importlib
+
+        value = getattr(importlib.import_module(_LAZY[name]), name)
+        globals()[name] = value  # cache: subsequent lookups skip __getattr__
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__() -> list:
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,200 @@
+"""Distribution-drift monitors over sketch summaries.
+
+Always-on monitoring's third question (after "what is the metric in this
+window" and "what are its quantiles"): *has the input distribution moved
+away from the one the model was validated on?* The sketches already carry
+the answer — their normalized bin masses are a fixed-size empirical
+distribution — so drift detection is a pure function of a **frozen
+reference sketch** and the **live sketch**, no samples retained on either
+side.
+
+Three standard divergences (all computed on smoothed bin masses):
+
+* :func:`population_stability_index` — PSI, the model-monitoring staple;
+  common alert folklore: < 0.1 stable, 0.1-0.25 moderate shift, > 0.25
+  action needed (the :class:`DriftMonitor` default threshold is 0.2).
+* :func:`kl_divergence` — KL(live ‖ reference), asymmetric, unbounded.
+* :func:`js_divergence` — symmetric, bounded by ``ln 2``.
+
+:class:`DriftMonitor` wraps them with thresholds and surfaces alerts
+through the obs registry (``stream.drift_checks`` / ``stream.drift_alerts``
+counters, per-monitor labels, plus a one-shot ``rank_zero_warn``), so a
+drifting stream shows up in the same :func:`metrics_tpu.obs.snapshot` as
+the metric values it is about to invalidate.
+"""
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.streaming.sketches import Sketch
+
+Array = jax.Array
+
+__all__ = [
+    "DriftMonitor",
+    "js_divergence",
+    "kl_divergence",
+    "population_stability_index",
+]
+
+
+def _masses(dist: Union[Sketch, Array], eps: float) -> Array:
+    """Smoothed, renormalized bin masses from a sketch or a raw mass
+    vector (adding ``eps`` everywhere keeps empty bins from blowing up the
+    log ratios — the standard PSI smoothing)."""
+    m = dist.bin_masses() if isinstance(dist, Sketch) else jnp.asarray(dist, jnp.float32)
+    m = m + jnp.asarray(eps, jnp.float32)
+    return m / m.sum()
+
+
+def population_stability_index(
+    reference: Union[Sketch, Array], live: Union[Sketch, Array], eps: float = 1e-6
+) -> Array:
+    """PSI = sum_b (live_b - ref_b) * ln(live_b / ref_b); jit-safe."""
+    p = _masses(live, eps)
+    q = _masses(reference, eps)
+    return ((p - q) * jnp.log(p / q)).sum()
+
+
+def kl_divergence(
+    reference: Union[Sketch, Array], live: Union[Sketch, Array], eps: float = 1e-6
+) -> Array:
+    """KL(live ‖ reference) over smoothed bin masses; jit-safe."""
+    p = _masses(live, eps)
+    q = _masses(reference, eps)
+    return (p * jnp.log(p / q)).sum()
+
+
+def js_divergence(
+    reference: Union[Sketch, Array], live: Union[Sketch, Array], eps: float = 1e-6
+) -> Array:
+    """Jensen-Shannon divergence (symmetric, <= ln 2); jit-safe."""
+    p = _masses(live, eps)
+    q = _masses(reference, eps)
+    m = (p + q) / 2.0
+    return ((p * jnp.log(p / m)).sum() + (q * jnp.log(q / m)).sum()) / 2.0
+
+
+class DriftMonitor:
+    """Threshold alerts on the divergence between a frozen reference sketch
+    and the live stream's sketch.
+
+    Args:
+        reference: the frozen validation-time sketch (any
+            :class:`~metrics_tpu.streaming.sketches.Sketch`; a sketch-backed
+            metric also works — its sketch state is extracted and frozen).
+        psi_threshold: alert when PSI exceeds this (``None`` disarms).
+        kl_threshold / js_threshold: further optional alarms.
+        eps: bin-mass smoothing for the log ratios.
+        name: label on the ``stream.drift_*`` obs counter series.
+        warn: emit a one-shot ``rank_zero_warn`` on the first alert.
+
+    :meth:`check` is eager (host-side booleans + obs counters); the module
+    divergence functions are jit-safe for in-graph use.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import DriftMonitor, QuantileSketch
+        >>> ref = QuantileSketch(num_bins=32).fold(jnp.linspace(0.0, 1.0, 512))
+        >>> live = QuantileSketch(num_bins=32).fold(jnp.linspace(0.0, 1.0, 512))
+        >>> report = DriftMonitor(ref, warn=False).check(live)
+        >>> bool(report["alert"])
+        False
+    """
+
+    def __init__(
+        self,
+        reference: Union[Sketch, Any],
+        psi_threshold: Optional[float] = 0.2,
+        kl_threshold: Optional[float] = None,
+        js_threshold: Optional[float] = None,
+        eps: float = 1e-6,
+        name: str = "default",
+        warn: bool = True,
+    ) -> None:
+        self.reference = self._extract_sketch(reference)
+        self.psi_threshold = psi_threshold
+        self.kl_threshold = kl_threshold
+        self.js_threshold = js_threshold
+        if psi_threshold is None and kl_threshold is None and js_threshold is None:
+            raise ValueError("DriftMonitor needs at least one armed threshold")
+        self.eps = float(eps)
+        self.name = str(name)
+        self.warn = bool(warn)
+        self._warned = False
+
+    @staticmethod
+    def _extract_sketch(source: Any) -> Sketch:
+        if isinstance(source, Sketch):
+            return source
+        # a sketch-backed Metric: freeze its (single) sketch state
+        defaults = getattr(source, "_defaults", None)
+        if defaults:
+            sketches = [getattr(source, n) for n in defaults if isinstance(getattr(source, n), Sketch)]
+            if len(sketches) == 1:
+                return sketches[0]
+        raise ValueError(
+            "DriftMonitor reference must be a Sketch or a metric with exactly one sketch state,"
+            f" got {type(source).__name__}"
+        )
+
+    def divergences(self, live: Union[Sketch, Any]) -> Dict[str, Array]:
+        """All three divergences of ``live`` vs the frozen reference
+        (traced values; no thresholds, no counters)."""
+        live = self._extract_sketch(live)
+        return {
+            "psi": population_stability_index(self.reference, live, self.eps),
+            "kl": kl_divergence(self.reference, live, self.eps),
+            "js": js_divergence(self.reference, live, self.eps),
+        }
+
+    def check(self, live: Union[Sketch, Any]) -> Dict[str, Any]:
+        """Divergences + threshold verdict, with obs accounting.
+
+        Returns ``{"psi", "kl", "js"`` (floats)``, "alert"`` (bool)``,
+        "triggered"`` (list of threshold names that fired)``}``. Every call
+        bumps ``stream.drift_checks{monitor=name}``; every alerting call
+        bumps ``stream.drift_alerts{monitor=name}``.
+        """
+        values = {k: float(v) for k, v in self.divergences(live).items()}
+        triggered = [
+            key
+            for key, threshold in (
+                ("psi", self.psi_threshold),
+                ("kl", self.kl_threshold),
+                ("js", self.js_threshold),
+            )
+            if threshold is not None and values[key] > threshold
+        ]
+        if _obs_enabled():
+            _obs_inc("stream.drift_checks", monitor=self.name)
+            if triggered:
+                _obs_inc("stream.drift_alerts", monitor=self.name)
+        if triggered and self.warn and not self._warned:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            self._warned = True
+            details = ", ".join(f"{k}={values[k]:.4f}" for k in triggered)
+            rank_zero_warn(
+                f"DriftMonitor {self.name!r}: live distribution drifted past threshold(s)"
+                f" ({details}). Metric values over this stream may no longer be"
+                " comparable to the reference window. Further alerts are counted"
+                " under stream.drift_alerts{monitor=" + self.name + "} without warning again.",
+                UserWarning,
+            )
+        return {**values, "alert": bool(triggered), "triggered": triggered}
+
+    def __repr__(self) -> str:
+        armed = {
+            k: v
+            for k, v in (
+                ("psi", self.psi_threshold),
+                ("kl", self.kl_threshold),
+                ("js", self.js_threshold),
+            )
+            if v is not None
+        }
+        return f"DriftMonitor(name={self.name!r}, reference={self.reference!r}, thresholds={armed})"

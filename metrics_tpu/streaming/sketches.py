@@ -1,0 +1,544 @@
+"""Mergeable, bounded-memory sketch states for always-on online monitoring.
+
+The exact curve metrics answer unbounded streams with host offload
+(``compute_on_cpu``) or a capped HBM buffer (``CapacityBuffer``) — both
+keep *samples*, so memory is O(N) or the tail is lost. A **sketch** keeps a
+fixed-size *summary* instead: device state is a few KB regardless of how
+many samples streamed through, and accuracy degrades gracefully with a
+documented, *computable* error bound.
+
+Two sketches, one contract:
+
+* :class:`QuantileSketch` — a bounded-memory rank/quantile summary in the
+  KLL tradition (fixed space, documented rank error), realized as a
+  fixed-resolution binned histogram plus exact min/max tracking. Where KLL
+  buys adaptivity with randomized compaction, this design buys an **exactly
+  associative and commutative merge** (counts add, extremes min/max) — the
+  property that lets states fold under ``lax.scan``, merge order-invariantly
+  across mesh shards, and replay-merge bitwise after a preemption resume.
+* :class:`ScoreLabelSketch` — per-bin positive/negative label histograms
+  over scores in [0, 1], the sufficient statistic for binned ROC / PR
+  analysis. Backs :class:`~metrics_tpu.streaming.metrics.StreamingAUROC`
+  and :class:`~metrics_tpu.streaming.metrics.StreamingAveragePrecision`
+  with envelope bounds: the sketch knows which *bin* every sample landed
+  in but not the within-bin order, so it computes the attainable interval
+  over all orderings and returns its midpoint — the half-width IS the
+  error bound (``tests/streaming`` pins it at 1M samples).
+
+Every sketch is a **registered jax pytree with static aux config**: it is a
+valid ``jit``/``scan``/``vmap`` carry, its leaves ride ``shard_map``
+collectives (each leaf declares sum/min/max), and it serializes through
+``metrics_tpu.utilities.checkpoint`` / :class:`metrics_tpu.ft.CheckpointManager`
+unchanged. Merges are closed under the sketch algebra:
+
+    ``merge`` is associative + commutative; a fresh sketch is the identity.
+
+which is exactly the contract ``dist_reduce_fx="sketch"`` states rely on
+(see ``metrics_tpu.metric.Metric.add_state``).
+"""
+import functools
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple, Type, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["QuantileSketch", "ScoreLabelSketch", "Sketch", "sketch_from_pack_tree"]
+
+# class registry for checkpoint round-trips (utilities/checkpoint._unpack)
+_SKETCH_REGISTRY: Dict[str, Type["Sketch"]] = {}
+
+
+class Sketch:
+    """Base class: static-config, array-leaf summaries with a monoid merge.
+
+    Subclasses declare
+
+    * ``_leaf_fields`` — ordered ``(name, reduction)`` pairs; ``reduction``
+      in ``{"sum", "min", "max"}`` is both the merge op of :meth:`merge`
+      and the mesh collective the state syncs with
+      (:func:`metrics_tpu.utilities.distributed.sync_sketch_in_context`).
+    * ``_config_fields`` — static Python aux (bin counts, ranges); two
+      sketches merge only when their configs are equal.
+
+    The flatten/unflatten protocol intentionally accepts leaves of any
+    shape: ``vmap``/``make_epoch`` stack a leading batch axis onto every
+    leaf and fold it back down with :meth:`reduce_leading_axis`.
+    """
+
+    _leaf_fields: Tuple[Tuple[str, str], ...] = ()
+    _config_fields: Tuple[str, ...] = ()
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        _SKETCH_REGISTRY[cls.__name__] = cls
+        jax.tree_util.register_pytree_node_class(cls)
+
+    # -- pytree protocol -------------------------------------------------
+
+    def tree_flatten(self) -> Tuple[tuple, tuple]:
+        children = tuple(getattr(self, name) for name, _ in self._leaf_fields)
+        aux = tuple(getattr(self, name) for name in self._config_fields)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux: tuple, children: tuple) -> "Sketch":
+        new = cls.__new__(cls)
+        for name, value in zip(cls._config_fields, aux):
+            object.__setattr__(new, name, value)
+        for (name, _), child in zip(cls._leaf_fields, children):
+            object.__setattr__(new, name, child)
+        return new
+
+    # -- config / identity ----------------------------------------------
+
+    def config(self) -> Dict[str, Any]:
+        """The static configuration (merge compatibility key)."""
+        return {name: getattr(self, name) for name in self._config_fields}
+
+    def _check_mergeable(self, other: "Sketch") -> None:
+        if type(other) is not type(self):
+            raise ValueError(f"cannot merge {type(self).__name__} with {type(other).__name__}")
+        if other.config() != self.config():
+            raise ValueError(
+                f"cannot merge {type(self).__name__} sketches with different configs:"
+                f" {self.config()} vs {other.config()}"
+            )
+
+    def _replace_leaves(self, **leaves: Any) -> "Sketch":
+        children = tuple(leaves.get(name, getattr(self, name)) for name, _ in self._leaf_fields)
+        return type(self).tree_unflatten(tuple(getattr(self, n) for n in self._config_fields), children)
+
+    # -- merge algebra ---------------------------------------------------
+
+    def merge(self, other: "Sketch") -> "Sketch":
+        """Combine two summaries; associative, commutative, identity = a
+        fresh sketch of the same config. Jit-safe (pure leaf arithmetic)."""
+        self._check_mergeable(other)
+        out = {}
+        for name, red in self._leaf_fields:
+            a, b = getattr(self, name), getattr(other, name)
+            if red == "sum":
+                out[name] = a + b
+            elif red == "min":
+                out[name] = jnp.minimum(a, b)
+            else:
+                out[name] = jnp.maximum(a, b)
+        return self._replace_leaves(**out)
+
+    def stack(self, k: int) -> "Sketch":
+        """Broadcast every leaf to a leading replicate axis of size ``k``
+        (a ring of ``k`` identity slots — see ``streaming/windows.py``)."""
+        return self._replace_leaves(
+            **{
+                name: jnp.broadcast_to(getattr(self, name)[None], (k,) + jnp.shape(getattr(self, name)))
+                for name, _ in self._leaf_fields
+            }
+        )
+
+    def reduce_leading_axis(self) -> "Sketch":
+        """Fold a stacked sketch (leaves ``(k, *shape)``) back down axis 0
+        with each leaf's declared reduction — the merge of all ``k`` slots."""
+        out = {}
+        for name, red in self._leaf_fields:
+            leaf = getattr(self, name)
+            out[name] = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[red](leaf, axis=0)
+        return self._replace_leaves(**out)
+
+    def slot(self, index: Union[int, Array]) -> "Sketch":
+        """Row ``index`` of a stacked sketch (dynamic index allowed)."""
+        return self._replace_leaves(
+            **{
+                name: jax.lax.dynamic_index_in_dim(getattr(self, name), index, keepdims=False)
+                for name, _ in self._leaf_fields
+            }
+        )
+
+    def set_slot(self, index: Union[int, Array], row: "Sketch") -> "Sketch":
+        """A stacked sketch with row ``index`` replaced by ``row``."""
+        self._check_mergeable(row)
+        return self._replace_leaves(
+            **{
+                name: jax.lax.dynamic_update_index_in_dim(
+                    getattr(self, name), getattr(row, name).astype(getattr(self, name).dtype), index, 0
+                )
+                for name, _ in self._leaf_fields
+            }
+        )
+
+    def merge_into_slot(self, index: Union[int, Array], batch: "Sketch") -> "Sketch":
+        """Merge ``batch`` into row ``index`` of a stacked sketch."""
+        return self.set_slot(index, self.slot(index).merge(batch))
+
+    def scale_sum_leaves(self, factor: Union[float, Array]) -> "Sketch":
+        """Exponential decay primitive: scale every ``sum`` leaf by
+        ``factor`` (counts are linear, so a decayed sketch is still a valid
+        weighted summary); ``min``/``max`` leaves pass through untouched —
+        they remain all-time extremes (see ``DecayedMetric``)."""
+        out = {}
+        for name, red in self._leaf_fields:
+            leaf = getattr(self, name)
+            out[name] = leaf * factor if red == "sum" else leaf
+        return self._replace_leaves(**out)
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the summary (shape/dtype metadata only)."""
+        total = 0
+        for name, _ in self._leaf_fields:
+            leaf = getattr(self, name)
+            total += int(jnp.size(leaf)) * jnp.asarray(leaf).dtype.itemsize if hasattr(leaf, "dtype") else 0
+        return total
+
+    def bin_masses(self) -> Array:
+        """Normalized per-bin probability masses (drift-monitor input)."""
+        raise NotImplementedError
+
+    # -- checkpoint packing (utilities/checkpoint._pack/_unpack) ---------
+
+    def to_pack_tree(self) -> Dict[str, Any]:
+        packed: Dict[str, Any] = {
+            "__sketch_meta": jnp.frombuffer(
+                json.dumps({"class": type(self).__name__, "config": self.config()}).encode(),
+                dtype=jnp.uint8,
+            )
+        }
+        for name, _ in self._leaf_fields:
+            packed[f"__sketch_leaf_{name}"] = getattr(self, name)
+        return packed
+
+    def __repr__(self) -> str:
+        cfg = ", ".join(f"{k}={v}" for k, v in self.config().items())
+        return f"{type(self).__name__}({cfg})"
+
+
+def sketch_from_pack_tree(tree: Dict[str, Any]) -> Sketch:
+    """Rebuild a sketch from :meth:`Sketch.to_pack_tree` output (checkpoint
+    restore path; leaves may arrive as numpy arrays from orbax)."""
+    import numpy as np
+
+    meta = json.loads(bytes(np.asarray(tree["__sketch_meta"]).astype(np.uint8)).decode())
+    cls = _SKETCH_REGISTRY[meta["class"]]
+    new = cls(**meta["config"])
+    leaves = {
+        name: jnp.asarray(tree[f"__sketch_leaf_{name}"]).astype(getattr(new, name).dtype)
+        for name, _ in cls._leaf_fields
+    }
+    return new._replace_leaves(**leaves)
+
+
+class QuantileSketch(Sketch):
+    """Bounded-memory quantile summary with an exactly-mergeable state.
+
+    A fixed grid of ``num_bins`` equal-width bins over ``[lo, hi]`` plus an
+    underflow and an overflow bin and exact min/max tracking — ``4 *
+    (num_bins + 2) + 8`` bytes of device state no matter how many
+    samples fold through. KLL-style in its guarantee (fixed space, bounded
+    rank error); unlike randomized KLL compaction the merge is **bitwise
+    associative and commutative** (integer-valued count sums + extreme
+    min/max), so fold order — scan carries, mesh shards, windowed-slot
+    refolds, preemption-resume replays — can never change the state.
+
+    Error bound (documented + computable): a quantile query returns the
+    MIDPOINT of the [clipped] edges of the bin holding the target rank —
+    the true value lies within those edges, so :meth:`quantile_bounds`'
+    half-width bounds the value error; it is at most
+    ``(hi - lo) / (2 * num_bins)`` for data inside ``[lo, hi]``. Mass
+    outside the range is tracked in the unbounded under/overflow bins
+    whose edges are the exact running min/max.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import QuantileSketch
+        >>> sk = QuantileSketch(num_bins=100, lo=0.0, hi=1.0)
+        >>> sk = sk.fold(jnp.linspace(0.0, 1.0, 1001))
+        >>> float(jnp.round(sk.quantile(0.5), 3))  # exact median 0.5, bound 0.005
+        0.505
+    """
+
+    _leaf_fields = (("counts", "sum"), ("minv", "min"), ("maxv", "max"))
+    _config_fields = ("num_bins", "lo", "hi")
+
+    def __init__(self, num_bins: int = 1024, lo: float = 0.0, hi: float = 1.0) -> None:
+        if num_bins < 1:
+            raise ValueError(f"`num_bins` must be positive, got {num_bins}")
+        if not hi > lo:
+            raise ValueError(f"need hi > lo, got [{lo}, {hi}]")
+        self.num_bins = int(num_bins)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.counts = jnp.zeros(self.num_bins + 2, dtype=jnp.float32)
+        self.minv = jnp.asarray(jnp.inf, dtype=jnp.float32)
+        self.maxv = jnp.asarray(-jnp.inf, dtype=jnp.float32)
+
+    # -- accumulation ----------------------------------------------------
+
+    def fold(self, values: Array, weights: Optional[Array] = None) -> "QuantileSketch":
+        """A new sketch with ``values`` (optionally ``weights``-weighted)
+        folded in. Pure and jit-safe: one scatter-add plus two extremes."""
+        values = jnp.ravel(jnp.asarray(values)).astype(jnp.float32)
+        width = (self.hi - self.lo) / self.num_bins
+        idx = jnp.floor((values - self.lo) / width).astype(jnp.int32)
+        # bin 0 = underflow (-inf, lo); 1..num_bins = grid; num_bins+1 = overflow [hi, inf)
+        idx = jnp.clip(idx + 1, 0, self.num_bins + 1)
+        w = (
+            jnp.ones_like(values)
+            if weights is None
+            else jnp.ravel(jnp.asarray(weights)).astype(jnp.float32)
+        )
+        counts = self.counts.at[idx].add(w)
+        return self._replace_leaves(
+            counts=counts,
+            minv=jnp.minimum(self.minv, values.min(initial=jnp.inf)),
+            maxv=jnp.maximum(self.maxv, values.max(initial=-jnp.inf)),
+        )
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def count(self) -> Array:
+        """Total folded weight."""
+        return self.counts.sum()
+
+    def _bin_edges(self) -> Tuple[Array, Array]:
+        """Per-bin (lower, upper) value edges, clipped to the observed
+        [min, max] so empty range never widens the envelope."""
+        width = (self.hi - self.lo) / self.num_bins
+        grid = self.lo + width * jnp.arange(self.num_bins + 1, dtype=jnp.float32)
+        lower = jnp.concatenate([jnp.asarray([-jnp.inf], jnp.float32), grid])
+        upper = jnp.concatenate([grid, jnp.asarray([jnp.inf], jnp.float32)])
+        lower = jnp.clip(lower, self.minv, self.maxv)
+        upper = jnp.clip(upper, self.minv, self.maxv)
+        return lower, upper
+
+    def quantile_bounds(self, q: Union[float, Sequence[float], Array]) -> Tuple[Array, Array]:
+        """Rigorous (lower, upper) envelope for quantile(s) ``q``: the
+        [clipped] edges of the bin holding the target rank. The true
+        quantile of the folded stream lies inside; half the width is the
+        value error of :meth:`quantile`."""
+        q = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+        lower, upper = self._bin_edges()
+        cum = jnp.cumsum(self.counts)
+        total = cum[-1]
+        rank = jnp.clip(q, 0.0, 1.0) * total
+        # first bin whose cumulative mass reaches the rank AND is non-empty
+        idx = jnp.searchsorted(cum, jnp.maximum(rank, jnp.finfo(jnp.float32).tiny), side="left")
+        idx = jnp.clip(idx, 0, self.num_bins + 1)
+        lo, hi = lower[idx], upper[idx]
+        # the extremes are tracked EXACTLY: q=0/q=1 envelopes collapse to a point
+        lo = jnp.where(q <= 0.0, self.minv, jnp.where(q >= 1.0, self.maxv, lo))
+        hi = jnp.where(q <= 0.0, self.minv, jnp.where(q >= 1.0, self.maxv, hi))
+        return lo, hi
+
+    def quantile(self, q: Union[float, Sequence[float], Array]) -> Array:
+        """Approximate quantile(s): the MIDPOINT of the rigorous envelope
+        (scalar in -> scalar out). Midpoint, not rank interpolation: the
+        exact quantile can sit anywhere inside its bin regardless of the
+        rank's position within the bin's mass (all that mass may be one
+        repeated value at an edge), so only the midpoint honors the
+        ``|quantile(q) - exact| <= half-width`` contract of
+        :meth:`quantile_bounds`."""
+        q_arr = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+        lower, upper = self.quantile_bounds(q_arr)
+        total = self.counts.sum()
+        out = jnp.where(total > 0, (lower + upper) / 2.0, jnp.nan)
+        return out[0] if jnp.ndim(q) == 0 else out
+
+    def bin_masses(self) -> Array:
+        """Normalized per-bin masses (``num_bins + 2`` incl. under/overflow)."""
+        total = self.counts.sum()
+        return self.counts / jnp.maximum(total, 1.0)
+
+
+class ScoreLabelSketch(Sketch):
+    """Per-bin positive/negative score histograms: the binned sufficient
+    statistic for ROC / PR curve metrics over scores in ``[0, 1]``.
+
+    State is two ``(num_bins,)`` count vectors (positives / negatives per
+    score bin) — ``8 * num_bins`` bytes regardless of stream length; the
+    default 2048 bins is 16 KB. Counts are integer-valued float32 (exact
+    to 2^24), so merges are bitwise associative/commutative and mesh
+    merges are plain ``psum``.
+
+    Accumulation reuses the fused threshold-binning kernel
+    (:func:`metrics_tpu.ops.binned_counts.binned_counts` — one HBM read of
+    preds/target on TPU) when the backend and bin count suit it, and an
+    O(N) scatter-add bincount elsewhere; both produce identical counts.
+
+    Curve values come with **envelope bounds**: scores are ordered across
+    bins but unordered within one, so the sketch computes the attainable
+    interval over every within-bin ordering and returns its midpoint
+    (:meth:`auroc`, :meth:`average_precision`); the half-width — e.g.
+    ``sum_b P_b * N_b / (2 * P * N)`` for AUROC — is the documented error
+    bound (:meth:`auroc_bounds`, :meth:`average_precision_bounds`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import ScoreLabelSketch
+        >>> sk = ScoreLabelSketch(num_bins=64)
+        >>> sk = sk.fold(jnp.asarray([0.1, 0.8, 0.4, 0.9]), jnp.asarray([0, 1, 0, 1]))
+        >>> float(sk.auroc())
+        1.0
+    """
+
+    _leaf_fields = (("pos", "sum"), ("neg", "sum"))
+    _config_fields = ("num_bins",)
+
+    def __init__(self, num_bins: int = 2048) -> None:
+        if num_bins < 2:
+            raise ValueError(f"`num_bins` must be >= 2, got {num_bins}")
+        self.num_bins = int(num_bins)
+        self.pos = jnp.zeros(self.num_bins, dtype=jnp.float32)
+        self.neg = jnp.zeros(self.num_bins, dtype=jnp.float32)
+
+    # -- accumulation ----------------------------------------------------
+
+    def fold(self, preds: Array, target: Array) -> "ScoreLabelSketch":
+        """A new sketch with a batch of ``(score in [0,1], binary label)``
+        pairs folded in (scores are clipped into range). Pure, jit-safe."""
+        preds = jnp.ravel(jnp.asarray(preds)).astype(jnp.float32)
+        target = jnp.ravel(jnp.asarray(target)).astype(jnp.int32) == 1
+        if jax.default_backend() == "tpu" and self.num_bins <= 256:
+            pos_hist, neg_hist = self._hists_via_kernel(preds, target)
+        else:
+            pos_hist, neg_hist = self._hists_via_bincount(preds, target)
+        return self._replace_leaves(pos=self.pos + pos_hist, neg=self.neg + neg_hist)
+
+    def _hists_via_bincount(self, preds: Array, target: Array) -> Tuple[Array, Array]:
+        # bin by searchsorted against the SAME float32 `k/T` thresholds the
+        # kernel arm compares with — `int(v * T)` truncation disagrees with
+        # `v >= k/T` on boundary scores whenever k/T is inexact in f32
+        # (e.g. T=100, v=float32(0.53)), and the two arms must produce
+        # identical counts or a TPU-folded and a CPU-folded sketch of the
+        # same stream would diverge (pinned by test_fold_arms_agree)
+        thresholds = jnp.arange(self.num_bins, dtype=jnp.float32) / self.num_bins
+        idx = jnp.clip(
+            jnp.searchsorted(thresholds, preds, side="right").astype(jnp.int32) - 1,
+            0,
+            self.num_bins - 1,
+        )
+        t = target.astype(jnp.float32)
+        pos_hist = jnp.zeros(self.num_bins, jnp.float32).at[idx].add(t)
+        neg_hist = jnp.zeros(self.num_bins, jnp.float32).at[idx].add(1.0 - t)
+        return pos_hist, neg_hist
+
+    def _hists_via_kernel(self, preds: Array, target: Array) -> Tuple[Array, Array]:
+        # one HBM read of preds/target through the fused pallas threshold
+        # kernel; the cumulative->per-bin translation lives beside the
+        # kernel (bin k = [k/T, (k+1)/T), last bin closed at 1.0 — matching
+        # the bincount clip)
+        from metrics_tpu.ops.binned_counts import binned_label_histograms
+
+        return binned_label_histograms(preds, target.astype(jnp.int32), self.num_bins)
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def count(self) -> Array:
+        return self.pos.sum() + self.neg.sum()
+
+    def curve_counts(self) -> Tuple[Array, Array]:
+        """Cumulative ``(TP, FP)`` at each bin's lower edge, descending
+        through score bins — the binned ROC curve's support points."""
+        tp = jnp.cumsum(self.pos[::-1])[::-1]
+        fp = jnp.cumsum(self.neg[::-1])[::-1]
+        return tp, fp
+
+    def auroc_bounds(self) -> Tuple[Array, Array]:
+        """Rigorous (lower, upper) AUROC envelope over every within-bin
+        ordering: a (pos, neg) pair in different bins is ordered identically
+        under all of them; a same-bin pair contributes anywhere in [0, 1]."""
+        p_total = self.pos.sum()
+        n_total = self.neg.sum()
+        pn = jnp.maximum(p_total * n_total, 1.0)
+        # positives strictly above each bin
+        pos_above = jnp.concatenate([jnp.cumsum(self.pos[::-1])[::-1][1:], jnp.zeros((1,), jnp.float32)])
+        cross = (self.neg * pos_above).sum()  # pairs ordered correctly in every interleaving
+        same = (self.neg * self.pos).sum()  # same-bin pairs: [0, 1] each
+        lo = jnp.where(p_total * n_total > 0, cross / pn, jnp.nan)
+        hi = jnp.where(p_total * n_total > 0, (cross + same) / pn, jnp.nan)
+        return lo, hi
+
+    def auroc(self) -> Array:
+        """Binned AUROC: the envelope midpoint (== trapezoidal area under
+        the binned ROC curve; same-bin pairs count 1/2, the tie
+        convention of exact AUROC)."""
+        lo, hi = self.auroc_bounds()
+        return (lo + hi) / 2.0
+
+    def auroc_error_bound(self) -> Array:
+        """``sum_b P_b * N_b / (2 * P * N)`` — the half-width of
+        :meth:`auroc_bounds`; ``|auroc() - exact| <= this`` always."""
+        lo, hi = self.auroc_bounds()
+        return (hi - lo) / 2.0
+
+    def average_precision_bounds(self) -> Tuple[Array, Array]:
+        """Rigorous (lower, upper) envelope for average precision.
+
+        Within bin ``b`` (``p`` positives, ``n`` negatives, ``Pa``/``Na``
+        positives/negatives in strictly-higher bins), the ``j``-th bin
+        positive's precision is a concave increasing function of ``j``
+        bounded by the all-positives-first and all-negatives-first
+        orderings; Jensen (upper) and the chord inequality (lower) turn
+        the per-positive sums into closed forms. Exact AP of the stream —
+        any within-bin ordering — lies inside the interval.
+        """
+        p, n = self.pos, self.neg
+        p_total = jnp.maximum(p.sum(), 1.0)
+        pos_above = jnp.concatenate([jnp.cumsum(p[::-1])[::-1][1:], jnp.zeros((1,), jnp.float32)])
+        neg_above = jnp.concatenate([jnp.cumsum(n[::-1])[::-1][1:], jnp.zeros((1,), jnp.float32)])
+        has = p > 0
+        safe_p = jnp.where(has, p, 1.0)
+        # upper: positives first; f(j) = (Pa+j)/(Pa+Na+j) concave increasing,
+        # so sum_{j=1..p} f(j) <= p * f((p+1)/2)
+        j_mid = (safe_p + 1.0) / 2.0
+        upper_terms = safe_p * (pos_above + j_mid) / jnp.maximum(pos_above + neg_above + j_mid, 1.0)
+        # lower: negatives first; g(j) = (Pa+j)/(Pa+Na+n+j) concave increasing,
+        # so sum_{j=1..p} g(j) >= p * (g(1) + g(p)) / 2
+        denom0 = jnp.maximum(pos_above + neg_above + n + 1.0, 1.0)
+        denom1 = jnp.maximum(pos_above + neg_above + n + safe_p, 1.0)
+        lower_terms = safe_p * ((pos_above + 1.0) / denom0 + (pos_above + safe_p) / denom1) / 2.0
+        zero = jnp.zeros((), jnp.float32)
+        hi = jnp.where(has, upper_terms, zero).sum() / p_total
+        lo = jnp.where(has, lower_terms, zero).sum() / p_total
+        nanless = self.pos.sum() > 0
+        return (
+            jnp.where(nanless, jnp.clip(lo, 0.0, 1.0), jnp.nan),
+            jnp.where(nanless, jnp.clip(hi, 0.0, 1.0), jnp.nan),
+        )
+
+    def average_precision(self) -> Array:
+        """Binned average precision: the envelope midpoint."""
+        lo, hi = self.average_precision_bounds()
+        return (lo + hi) / 2.0
+
+    def average_precision_error_bound(self) -> Array:
+        """Half-width of :meth:`average_precision_bounds` —
+        ``|average_precision() - exact| <= this`` always."""
+        lo, hi = self.average_precision_bounds()
+        return (hi - lo) / 2.0
+
+    def bin_masses(self) -> Array:
+        """Normalized per-bin (pos + neg) score masses (drift input)."""
+        total = self.count
+        return (self.pos + self.neg) / jnp.maximum(total, 1.0)
+
+    def label_masses(self) -> Tuple[Array, Array]:
+        """Per-class normalized masses ``(pos_masses, neg_masses)`` —
+        class-conditional drift inputs."""
+        return (
+            self.pos / jnp.maximum(self.pos.sum(), 1.0),
+            self.neg / jnp.maximum(self.neg.sum(), 1.0),
+        )
+
+
+def merge_all(sketches: Sequence[Sketch]) -> Sketch:
+    """Left fold of :meth:`Sketch.merge` over a non-empty sequence (order
+    irrelevant by the merge algebra)."""
+    if not sketches:
+        raise ValueError("merge_all needs at least one sketch")
+    return functools.reduce(lambda a, b: a.merge(b), sketches)

@@ -1,0 +1,317 @@
+"""Time-windowed and exponentially-decayed metric wrappers.
+
+The epoch-oriented ``update/compute/reset`` lifecycle answers "what is the
+metric over everything since the last reset" — always-on monitoring needs
+"what is the metric over the last hour" (:class:`WindowedMetric`) and
+"what is the metric now, with the past fading" (:class:`DecayedMetric`).
+Both wrap any **merge-combinable** metric (every state sum/max/min- or
+sketch-reducible — the property ``make_epoch``'s fused path and the DDP
+gather-reduce sync already rely on) and stay ordinary
+:class:`~metrics_tpu.metric.Metric` subclasses: ``MetricCollection``
+membership, mesh sync (per-slot elementwise), and
+:class:`metrics_tpu.ft.CheckpointManager` round-trips (the ring position
+rides ``_aux_attrs``) all work unchanged.
+
+* :class:`WindowedMetric` — a ring of ``window`` state shards. Each
+  ``update`` folds into the current shard; :meth:`~WindowedMetric.advance`
+  (or every ``updates_per_slot`` updates) rotates the ring and **expires**
+  the oldest shard by resetting it to the state default — the
+  expire-and-refold that an accumulated monoid state cannot express
+  (you cannot subtract a max). ``compute`` refolds the live shards and
+  runs the base metric's math.
+* :class:`DecayedMetric` — exponential time decay applied *inside* the
+  fold: ``state <- decay * state + batch_state`` with
+  ``decay = 0.5 ** (1 / half_life)``, so every value is a half-life-
+  weighted EWMA of the stream. Requires sum-combinable states (counts are
+  linear; a max cannot fade).
+
+For the jit/scan-native path — fold a batch and emit the current window
+value in ONE launch — see :func:`metrics_tpu.steps.make_stream_step`.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.streaming.sketches import Sketch
+from metrics_tpu.utilities.buffers import CapacityBuffer
+from metrics_tpu.utilities.data import coerce_foreign_tensors
+
+Array = jax.Array
+
+__all__ = ["DecayedMetric", "WindowedMetric"]
+
+_WINDOW_REDUCTIONS = ("sum", "max", "min", "sketch")
+_DECAY_REDUCTIONS = ("sum", "sketch")
+
+
+def _check_streamable(metric: Metric, allowed: Tuple[str, ...], wrapper: str) -> Dict[str, str]:
+    """Validate the base metric's states are combinable under ``allowed``
+    reductions; returns ``{state_name: reduction}``."""
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.wrappers.abstract import WrapperMetric
+
+    if isinstance(metric, MetricCollection):
+        raise ValueError(f"{wrapper} wraps a single Metric; wrap each collection member instead")
+    if isinstance(metric, WrapperMetric):
+        raise ValueError(f"{wrapper} cannot wrap wrapper metrics; wrap the base metric directly")
+    if not isinstance(metric, Metric):
+        raise ValueError(f"{wrapper} expects a Metric instance, got {type(metric).__name__}")
+    if not metric._defaults:
+        raise ValueError(f"{wrapper} base metric {type(metric).__name__} declares no states")
+    reductions: Dict[str, str] = {}
+    for name, red in metric._reductions.items():
+        default = metric._defaults[name]
+        if isinstance(default, (list, CapacityBuffer)) or red not in allowed:
+            raise ValueError(
+                f"{wrapper} needs every state of {type(metric).__name__} to be"
+                f" {'/'.join(allowed)}-combinable, but state {name!r} has"
+                f" dist_reduce_fx={red!r} (default type {type(default).__name__})."
+                " Sample-buffer and cat-list states cannot be expired or decayed;"
+                " use a sketch-backed streaming metric (metrics_tpu.streaming) as the base."
+            )
+        reductions[name] = red
+    return reductions
+
+
+def _merge_state(red: str, acc: Any, new: Any) -> Any:
+    # the steps.py registry is THE definition of merge-combination; the
+    # eager wrappers and the jitted make_stream_step path must share it or
+    # their pinned bitwise parity could silently diverge
+    from metrics_tpu.steps import _MERGE_OPS
+
+    return _MERGE_OPS[red](acc, new)
+
+
+def _fold_axis0(red: str, value: Any) -> Any:
+    from metrics_tpu.steps import _FOLD_OPS
+
+    return _FOLD_OPS[red](value)
+
+
+class _StreamWrapper(Metric):
+    """Shared plumbing: a worker clone of the base metric builds batch
+    contributions and runs ``compute`` over the refolded state."""
+
+    def __init__(self, base_metric: Metric, allowed: Tuple[str, ...], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._base_reductions = _check_streamable(base_metric, allowed, type(self).__name__)
+        template = base_metric.clone()
+        template.reset()
+        self._worker = template
+
+    def _batch_state(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        w = self._worker
+        w.reset()
+        w.update(*args, **kwargs)
+        return w.state_pytree()
+
+    def _compute_from(self, state: Dict[str, Any]) -> Any:
+        w = self._worker
+        w.reset()
+        w.load_state_pytree(state)
+        # our own compute wrapper already synced THIS metric's states
+        # across processes (per-slot / decayed elementwise) — the base math
+        # must not re-sync
+        w._to_sync = False
+        w._computed = None
+        w._update_count = max(1, self._update_count)
+        return w.compute()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Fold the batch AND return its batch-local base-metric value."""
+        args = coerce_foreign_tensors(args)
+        kwargs = coerce_foreign_tensors(kwargs)
+        self.update(*args, **kwargs)
+        w = self._worker
+        w.reset()
+        w.update(*args, **kwargs)
+        w._to_sync = self.dist_sync_on_step
+        w._computed = None
+        w._update_count = 1
+        self._forward_cache = w.compute()
+        return self._forward_cache
+
+
+class WindowedMetric(_StreamWrapper):
+    """Sliding-window metric: a ring of ``window`` expirable state shards.
+
+    Args:
+        base_metric: any merge-combinable metric (all states
+            sum/max/min/sketch-reducible) — e.g. ``Accuracy``,
+            ``MeanSquaredError``, ``StreamingAUROC``.
+        window: number of ring shards ``K``. ``compute()`` covers the
+            current shard plus the ``K - 1`` most recent expired-into ones.
+        updates_per_slot: rotate the ring automatically after this many
+            updates per shard (the window then spans between
+            ``(K-1)*u + 1`` and ``K*u`` most recent updates). ``None``
+            disables auto-rotation; call :meth:`advance` at your own
+            boundaries (e.g. wall-clock minutes).
+
+    Every rotation that clears a previously-written shard bumps the
+    ``stream.windows_expired`` obs counter.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.streaming import WindowedMetric
+        >>> w = WindowedMetric(Accuracy(), window=2, updates_per_slot=1)
+        >>> w.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+        >>> w.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+        >>> float(w.compute())  # both shards in the window
+        0.5
+        >>> w.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+        >>> float(w.compute())  # the all-correct shard has expired
+        0.0
+    """
+
+    full_state_update = False
+    _aux_attrs = ("_pos", "_in_slot", "_slot_filled")
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        window: int,
+        updates_per_slot: Optional[int] = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(base_metric, _WINDOW_REDUCTIONS, **kwargs)
+        if window < 1:
+            raise ValueError(f"`window` must be positive, got {window}")
+        if updates_per_slot is not None and updates_per_slot < 1:
+            raise ValueError(f"`updates_per_slot` must be positive or None, got {updates_per_slot}")
+        self.window = int(window)
+        self.updates_per_slot = None if updates_per_slot is None else int(updates_per_slot)
+        self._pos = 0
+        self._in_slot = 0
+        self._slot_filled = [0] * self.window
+        for name, red in self._base_reductions.items():
+            default = self._worker._defaults[name]
+            if isinstance(default, Sketch):
+                stacked = default.stack(self.window)
+            else:
+                stacked = jnp.broadcast_to(default[None], (self.window,) + jnp.shape(default))
+            self.add_state(name, default=stacked, dist_reduce_fx=red)
+        self._slot_defaults = {name: deepcopy(self._worker._defaults[name]) for name in self._base_reductions}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        # rotate LAZILY before the fold (not eagerly after it): the window
+        # right after N updates then spans exactly the most recent
+        # min(N, window * updates_per_slot) of them, with no empty current
+        # shard diluting it
+        if self.updates_per_slot is not None and self._in_slot >= self.updates_per_slot:
+            self.advance()
+        batch = self._batch_state(*args, **kwargs)
+        pos = self._pos
+        for name, red in self._base_reductions.items():
+            stacked = getattr(self, name)
+            if red == "sketch":
+                setattr(self, name, stacked.merge_into_slot(pos, batch[name]))
+            else:
+                merged = _merge_state(red, stacked[pos], batch[name])
+                setattr(self, name, stacked.at[pos].set(merged.astype(stacked.dtype)))
+        self._slot_filled[pos] = 1
+        self._in_slot += 1
+
+    def advance(self) -> None:
+        """Rotate the ring: the oldest shard is expired (reset to the state
+        default) and becomes the new current shard."""
+        next_pos = (self._pos + 1) % self.window
+        if self._slot_filled[next_pos] and _obs_enabled():
+            _obs_inc("stream.windows_expired", metric=type(self._worker).__name__)
+        for name, red in self._base_reductions.items():
+            stacked = getattr(self, name)
+            default = self._slot_defaults[name]
+            if red == "sketch":
+                setattr(self, name, stacked.set_slot(next_pos, default))
+            else:
+                setattr(self, name, stacked.at[next_pos].set(default.astype(stacked.dtype)))
+        self._slot_filled[next_pos] = 0
+        self._pos = next_pos
+        self._in_slot = 0
+        self._computed = None
+
+    def compute(self) -> Any:
+        folded = {
+            name: _fold_axis0(red, getattr(self, name)) for name, red in self._base_reductions.items()
+        }
+        return self._compute_from(folded)
+
+    def _reset_impl(self) -> None:
+        super()._reset_impl()
+        self._pos = 0
+        self._in_slot = 0
+        self._slot_filled = [0] * self.window
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}({type(self._worker).__name__}, window={self.window},"
+            f" updates_per_slot={self.updates_per_slot})"
+        )
+
+
+class DecayedMetric(_StreamWrapper):
+    """Exponentially-decayed metric: the past fades with a half-life.
+
+    Each update scales the accumulated state by
+    ``decay = 0.5 ** (1 / half_life)`` before merging the batch
+    contribution, so a batch folded ``half_life`` updates ago carries half
+    the weight of the current one — an EWMA over the stream with an
+    effective window of ``1 / (1 - decay)`` updates. Requires
+    sum-combinable states (counts and sketch counts are linear under
+    scaling; a max cannot fade — :class:`WindowedMetric` covers those).
+    Sketch min/max leaves are left undecayed: they remain all-time
+    extremes, which only the unbounded edge bins of a quantile envelope
+    ever consult.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.streaming import DecayedMetric
+        >>> d = DecayedMetric(Accuracy(), half_life=1.0)
+        >>> d.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+        >>> d.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+        >>> float(jnp.round(d.compute(), 4))  # recent all-correct weighs 2x
+        0.6667
+    """
+
+    full_state_update = False
+
+    def __init__(self, base_metric: Metric, half_life: float, **kwargs: Any) -> None:
+        super().__init__(base_metric, _DECAY_REDUCTIONS, **kwargs)
+        if not half_life > 0:
+            raise ValueError(f"`half_life` must be positive, got {half_life}")
+        self.half_life = float(half_life)
+        self.decay = float(0.5 ** (1.0 / self.half_life))
+        for name, red in self._base_reductions.items():
+            default = deepcopy(self._worker._defaults[name])
+            if not isinstance(default, Sketch) and not jnp.issubdtype(default.dtype, jnp.floating):
+                # decayed counts are fractional; int states go float up front
+                # (strict-promotion clean: no int*float mixing in update)
+                default = default.astype(jnp.float32)
+            self.add_state(name, default=default, dist_reduce_fx=red)
+
+    @property
+    def effective_window(self) -> float:
+        """Total weight of an infinite stream: ``1 / (1 - decay)`` updates."""
+        return 1.0 / (1.0 - self.decay)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        batch = self._batch_state(*args, **kwargs)
+        for name, red in self._base_reductions.items():
+            acc = getattr(self, name)
+            if red == "sketch":
+                setattr(self, name, acc.scale_sum_leaves(jnp.asarray(self.decay, jnp.float32)).merge(batch[name]))
+            else:
+                decay = jnp.asarray(self.decay, acc.dtype)
+                setattr(self, name, acc * decay + batch[name].astype(acc.dtype))
+
+    def compute(self) -> Any:
+        return self._compute_from({name: getattr(self, name) for name in self._base_reductions})
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({type(self._worker).__name__}, half_life={self.half_life})"

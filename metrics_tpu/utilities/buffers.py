@@ -36,10 +36,18 @@ class CapacityBuffer:
     ``append`` writes at the current count via ``lax.dynamic_update_slice``
     (jit-safe, static shapes). The fill count is mirrored as a plain Python
     int on the eager path, so appends never block on a device round-trip;
-    eager overflow raises. Inside a trace the mirror is unavailable and the
+    eager overflow raises (naming capacity, current count and the offending
+    append length). Inside a trace the mirror is unavailable and the
     caller owns the capacity contract — ``dynamic_update_slice`` clamps the
     start index, so excess samples silently overwrite the buffer tail
     (a linear buffer, not ring wraparound).
+
+    A capacity buffer still keeps *samples* — memory is O(capacity) and a
+    stream longer than the capacity cannot fit. For always-on monitoring
+    over unbounded streams, the bounded-memory alternative is a mergeable
+    sketch state (:mod:`metrics_tpu.streaming.sketches`): a few KB of
+    summary regardless of stream length, with a documented error bound vs
+    this exact-sample path (``docs/streaming.md``).
     """
 
     def __init__(self, capacity: int, dtype: Any = None) -> None:
@@ -73,8 +81,13 @@ class CapacityBuffer:
                 if _obs_enabled():
                     _obs_inc("capacity_buffer.eager_overflows")
                 raise ValueError(
-                    f"CapacityBuffer overflow: {self._host_count} + {n} > capacity {self.capacity}."
-                    " Raise `sample_capacity` or switch to unbounded list states."
+                    f"CapacityBuffer overflow: appending {n} sample(s) to a buffer already"
+                    f" holding {self._host_count} of capacity {self.capacity} would exceed it"
+                    f" by {self._host_count + n - self.capacity}. Raise `sample_capacity`,"
+                    " switch to unbounded list states, or — for endless streams — use a"
+                    " bounded-memory sketch metric (metrics_tpu.streaming: StreamingAUROC/"
+                    "StreamingAveragePrecision/StreamingQuantile keep a fixed-size mergeable"
+                    " summary instead of samples)."
                 )
             self._host_count += n
         else:

@@ -100,10 +100,15 @@ def atomic_dir_swap(final_path: Union[str, os.PathLike]) -> Iterator[str]:
 
 
 def _pack(value: Any) -> Any:
-    """Lists/buffers become plain dicts (orbax trees need stable structure
-    built from standard containers)."""
+    """Lists/buffers/sketches become plain dicts (orbax trees need stable
+    structure built from standard containers)."""
+    from metrics_tpu.streaming.sketches import Sketch
     from metrics_tpu.utilities.buffers import CapacityBuffer
 
+    if isinstance(value, Sketch):
+        # leaves + a JSON-in-uint8 meta blob naming the sketch class and
+        # its static config, so restore can rebuild without a target
+        return value.to_pack_tree()
     if isinstance(value, CapacityBuffer):
         packed = {"__capbuf_capacity": jnp.asarray(value.capacity, jnp.int32), "__capbuf_count": value.count}
         if value.data is not None:
@@ -121,6 +126,10 @@ def _pack(value: Any) -> Any:
 def _unpack(value: Any) -> Any:
     from metrics_tpu.utilities.buffers import CapacityBuffer
 
+    if isinstance(value, dict) and "__sketch_meta" in value:
+        from metrics_tpu.streaming.sketches import sketch_from_pack_tree
+
+        return sketch_from_pack_tree(value)
     if isinstance(value, dict) and "__capbuf_capacity" in value:
         buf = CapacityBuffer(int(value["__capbuf_capacity"]))
         if "__capbuf_data" in value:

@@ -210,6 +210,28 @@ def ring_allreduce(x: Array, axis_name: str, op: Callable[[Array, Array], Array]
     return acc
 
 
+def sync_sketch_in_context(
+    sketch: Any, axis_name: Union[str, Tuple[str, ...]], typed: str = "invariant"
+) -> Any:
+    """Merge per-device sketch summaries inside shard_map/pmap.
+
+    The in-jit arm of the ``dist_reduce_fx="sketch"`` registry entry: every
+    leaf of a :class:`metrics_tpu.streaming.sketches.Sketch` declares its
+    own reduction (``sum``/``min``/``max``), so the mesh merge is leafwise
+    :func:`sync_reduce_in_context` — count vectors psum, extremes
+    pmin/pmax. Because the sketch merge is that exact monoid, the result
+    equals folding every device's sketch with ``merge`` in any order, and
+    the payload is the fixed sketch size (a few KB) — never a gather of
+    samples. psum-family collectives are invariant-typed on every path, so
+    ``typed`` only matters if a future sketch declares a gather-typed leaf.
+    """
+    reduced = {
+        name: sync_reduce_in_context(getattr(sketch, name), red, axis_name, typed=typed)
+        for name, red in sketch._leaf_fields
+    }
+    return sketch._replace_leaves(**reduced)
+
+
 def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typed: str = "invariant") -> Any:
     """Merge per-device :class:`CapacityBuffer` sample states inside shard_map.
 

@@ -1,0 +1,92 @@
+"""CI smoke: the streaming subsystem end to end on a plain CPU runner.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.streaming_smoke``
+(the CI tier-1 job does, mirroring ``obs_smoke``). The cheap end-to-end
+arm of the pinned unit tests in ``tests/streaming/``: a sketch-backed
+metric streams within its documented error bound, the jitted
+``make_stream_step`` launch emits eager-parity window values without
+retracing, a drift monitor alerts through the obs counters, and a
+checkpoint round-trip reproduces the value bitwise.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import tempfile
+
+    import metrics_tpu.obs as obs
+    from metrics_tpu.ft import BatchJournal, CheckpointManager
+    from metrics_tpu.steps import make_stream_step
+    from metrics_tpu.streaming import DriftMonitor, StreamingAUROC, WindowedMetric
+
+    obs.enable()
+    rng = np.random.default_rng(0)
+    preds = rng.uniform(0, 1, 40_000).astype(np.float32)
+    target = (rng.uniform(0, 1, 40_000) < 0.25 + 0.5 * preds).astype(np.int32)
+
+    # bounded-memory AUROC within its computable bound vs the exact answer
+    m = StreamingAUROC(num_bins=1024)
+    for i in range(0, 40_000, 10_000):
+        m.update(jnp.asarray(preds[i : i + 10_000]), jnp.asarray(target[i : i + 10_000]))
+    order = np.argsort(-preds, kind="stable")
+    ranked = target[order]
+    tps = np.cumsum(ranked)
+    fps = np.cumsum(1 - ranked)
+    trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 fallback
+    exact = float(trapezoid(tps / tps[-1], fps / fps[-1]))  # exact AUROC, pure numpy
+    got, bound = float(m.compute()), float(m.error_bound())
+    assert abs(got - exact) <= bound + 1e-6, (got, exact, bound)
+    assert m.sketch.nbytes <= 64 * 1024, m.sketch.nbytes
+
+    # jitted stream step: eager parity per step, one trace for the loop
+    eager = WindowedMetric(StreamingAUROC(num_bins=256), window=3, updates_per_slot=1)
+    init, step, compute = make_stream_step(
+        WindowedMetric(StreamingAUROC(num_bins=256), window=3, updates_per_slot=1)
+    )
+    state = init()
+    for i in range(6):
+        pb = jnp.asarray(preds[i * 2_000 : (i + 1) * 2_000])
+        tb = jnp.asarray(target[i * 2_000 : (i + 1) * 2_000])
+        eager.update(pb, tb)
+        state, value = step(state, pb, tb)
+        assert float(value) == float(eager.compute()), i
+    label = "WindowedMetric[StreamingAUROC].stream_step"
+    assert obs.get_counter("step.traces", step=label) == 1, "stream step retraced"
+    assert obs.get_counter("stream.windows_expired", metric="StreamingAUROC") > 0
+
+    # drift monitor alerts and counts
+    ref = StreamingAUROC(num_bins=256)
+    ref.update(jnp.asarray(preds[:10_000]), jnp.asarray(target[:10_000]))
+    live = StreamingAUROC(num_bins=256)
+    live.update(jnp.asarray(preds[:10_000] * 0.3), jnp.asarray(target[:10_000]))
+    report = DriftMonitor(ref, psi_threshold=0.2, name="smoke", warn=False).check(live)
+    assert report["alert"], report
+    assert obs.get_counter("stream.drift_alerts", monitor="smoke") == 1
+
+    # checkpoint round-trip: manifest watermark + bitwise value
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = CheckpointManager(os.path.join(tmp, "ck"))
+        journal = BatchJournal()
+        journal.record(0, 0)
+        mgr.save(eager, journal=journal, epoch=0, step=0)
+        resumed = WindowedMetric(StreamingAUROC(num_bins=256), window=3, updates_per_slot=1)
+        j2 = BatchJournal()
+        manifest = mgr.restore(resumed, journal=j2)
+        assert manifest["journal"]["watermark"] == [0, 0]
+        assert float(resumed.compute()) == float(eager.compute())
+
+    print("streaming smoke OK")
+    print(
+        "  auroc", round(got, 6), "exact", round(exact, 6), "bound", round(bound, 6),
+        "| sketch bytes", m.sketch.nbytes,
+    )
+
+
+if __name__ == "__main__":
+    main()

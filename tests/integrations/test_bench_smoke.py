@@ -31,6 +31,19 @@ def test_bench_module_imports(module):
     importlib.import_module(module)
 
 
+def test_streaming_measure_tiny():
+    import bench
+
+    out = bench.bench_streaming(n=4_096)
+    assert set(out) == {
+        "streaming_auroc_1M_update",
+        "streaming_auroc_1M_merge",
+        "streaming_auroc_1M_compute",
+        "windowed_fold_k16",
+    }
+    assert all(np.isfinite(v) and v > 0 for v in out.values())
+
+
 def test_detection_measure_tiny():
     from benchmarks import bench_detection
 

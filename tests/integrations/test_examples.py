@@ -18,6 +18,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
         "detection_map.py",
         "train_loop_metrics.py",
         "torch_pipeline_eval.py",
+        "streaming_monitor.py",
     ],
 )
 def test_example_runs(script):

@@ -1,0 +1,134 @@
+"""Drift monitors: divergence math, threshold alerts, obs accounting."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.streaming import (
+    DriftMonitor,
+    QuantileSketch,
+    ScoreLabelSketch,
+    StreamingAUROC,
+    js_divergence,
+    kl_divergence,
+    population_stability_index,
+)
+
+
+@pytest.fixture(scope="module")
+def sketches():
+    rng = np.random.default_rng(21)
+    base = rng.uniform(0, 1, 20_000).astype(np.float32)
+    ref = QuantileSketch(num_bins=64).fold(jnp.asarray(base[:10_000]))
+    same = QuantileSketch(num_bins=64).fold(jnp.asarray(base[10_000:]))
+    shifted = QuantileSketch(num_bins=64).fold(jnp.asarray(base[:10_000] * 0.3))
+    return ref, same, shifted
+
+
+def test_divergences_zero_for_identical(sketches):
+    ref, _, _ = sketches
+    assert float(population_stability_index(ref, ref)) == pytest.approx(0.0, abs=1e-6)
+    assert float(kl_divergence(ref, ref)) == pytest.approx(0.0, abs=1e-6)
+    assert float(js_divergence(ref, ref)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_divergences_orderings(sketches):
+    ref, same, shifted = sketches
+    assert float(population_stability_index(ref, shifted)) > float(
+        population_stability_index(ref, same)
+    )
+    # PSI and JS symmetric; KL not
+    assert float(population_stability_index(ref, shifted)) == pytest.approx(
+        float(population_stability_index(shifted, ref)), rel=1e-5
+    )
+    assert float(js_divergence(ref, shifted)) == pytest.approx(
+        float(js_divergence(shifted, ref)), rel=1e-5
+    )
+    assert float(js_divergence(ref, shifted)) <= float(np.log(2)) + 1e-6
+
+
+def test_divergences_against_numpy(sketches):
+    """Pin the formulas against a direct NumPy evaluation of the masses."""
+    ref, _, shifted = sketches
+    eps = 1e-6
+    p = np.asarray(shifted.bin_masses()) + eps
+    p /= p.sum()
+    q = np.asarray(ref.bin_masses()) + eps
+    q /= q.sum()
+    assert float(population_stability_index(ref, shifted)) == pytest.approx(
+        float(((p - q) * np.log(p / q)).sum()), rel=1e-4
+    )
+    assert float(kl_divergence(ref, shifted)) == pytest.approx(
+        float((p * np.log(p / q)).sum()), rel=1e-4
+    )
+
+
+def test_divergences_jit_safe(sketches):
+    ref, _, shifted = sketches
+    fn = jax.jit(lambda a, b: population_stability_index(a, b))
+    assert float(fn(ref, shifted)) == pytest.approx(
+        float(population_stability_index(ref, shifted)), rel=1e-6
+    )
+
+
+def test_monitor_alerts_and_counters(sketches):
+    ref, same, shifted = sketches
+    prev = obs.enable()
+    obs.reset()
+    try:
+        mon = DriftMonitor(ref, psi_threshold=0.2, name="t", warn=False)
+        ok = mon.check(same)
+        assert not ok["alert"] and ok["triggered"] == []
+        bad = mon.check(shifted)
+        assert bad["alert"] and "psi" in bad["triggered"]
+        assert obs.get_counter("stream.drift_checks", monitor="t") == 2
+        assert obs.get_counter("stream.drift_alerts", monitor="t") == 1
+    finally:
+        obs.enable(prev)
+        obs.reset()
+
+
+def test_monitor_one_shot_warning(sketches):
+    ref, _, shifted = sketches
+    mon = DriftMonitor(ref, psi_threshold=0.1, name="warned")
+    with pytest.warns(UserWarning, match="drifted past threshold"):
+        mon.check(shifted)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second alert must NOT warn again
+        assert mon.check(shifted)["alert"]
+
+
+def test_monitor_from_metric_reference():
+    rng = np.random.default_rng(4)
+    preds = rng.uniform(0, 1, 5_000).astype(np.float32)
+    target = rng.integers(0, 2, 5_000).astype(np.int32)
+    ref_metric = StreamingAUROC(num_bins=64)
+    ref_metric.update(jnp.asarray(preds), jnp.asarray(target))
+    live = StreamingAUROC(num_bins=64)
+    live.update(jnp.asarray(preds * 0.2), jnp.asarray(target))
+    mon = DriftMonitor(ref_metric, psi_threshold=0.2, warn=False)
+    assert mon.check(live)["alert"]
+
+
+def test_monitor_validation(sketches):
+    ref, _, _ = sketches
+    with pytest.raises(ValueError, match="at least one armed threshold"):
+        DriftMonitor(ref, psi_threshold=None)
+    with pytest.raises(ValueError, match="exactly one sketch state"):
+        DriftMonitor(object())
+
+
+def test_label_conditional_masses():
+    """ScoreLabelSketch exposes class-conditional masses for per-class
+    drift (e.g. score drift only among predicted positives)."""
+    sk = ScoreLabelSketch(num_bins=4).fold(
+        jnp.asarray([0.1, 0.1, 0.9, 0.9]), jnp.asarray([0, 0, 1, 1])
+    )
+    pos_m, neg_m = sk.label_masses()
+    assert float(pos_m.sum()) == pytest.approx(1.0)
+    assert float(neg_m.sum()) == pytest.approx(1.0)
+    assert float(pos_m[-1]) == pytest.approx(1.0)  # positives all in top bin
+    assert float(neg_m[0]) == pytest.approx(1.0)

@@ -1,0 +1,279 @@
+"""Sketch merge algebra + error-bound pins.
+
+The whole streaming subsystem stands on two properties of the sketches:
+
+1. ``merge`` is an exact monoid — associative, commutative, fresh sketch
+   as identity — BITWISE, across any shard count and fold order (this is
+   what makes mesh merges order-invariant and preemption-resume replays
+   reproducible).
+2. the documented error bounds hold against exact NumPy/sklearn answers
+   on large (1M-sample) synthetic streams.
+"""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.streaming import (
+    QuantileSketch,
+    ScoreLabelSketch,
+    merge_all,
+    sketch_from_pack_tree,
+)
+
+N_BIG = 1_000_000
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+def _shard_sketches(kind, data, n_shards):
+    rng = np.random.default_rng(7)
+    bounds = np.sort(rng.choice(np.arange(1, len(data[0])), size=n_shards - 1, replace=False))
+    pieces = []
+    start = 0
+    for end in list(bounds) + [len(data[0])]:
+        if kind == "quantile":
+            sk = QuantileSketch(num_bins=64, lo=0.0, hi=1.0).fold(jnp.asarray(data[0][start:end]))
+        else:
+            sk = ScoreLabelSketch(num_bins=64).fold(
+                jnp.asarray(data[0][start:end]), jnp.asarray(data[1][start:end])
+            )
+        pieces.append(sk)
+        start = end
+    return pieces
+
+
+@pytest.fixture(scope="module")
+def stream():
+    rng = np.random.default_rng(3)
+    preds = rng.uniform(0, 1, 4096).astype(np.float32)
+    target = rng.integers(0, 2, 4096).astype(np.int32)
+    return preds, target
+
+
+@pytest.mark.parametrize("kind", ["quantile", "scorelabel"])
+@pytest.mark.parametrize("n_shards", [2, 3, 5, 8])
+def test_merge_associative_commutative_bitwise(kind, n_shards, stream):
+    """Every parenthesization and permutation of shard merges produces the
+    SAME sketch, bitwise (uneven shard sizes included)."""
+    pieces = _shard_sketches(kind, stream, n_shards)
+    reference = merge_all(pieces)
+    # commutativity + associativity: every permutation, left fold
+    for perm in itertools.islice(itertools.permutations(range(n_shards)), 12):
+        assert _leaves_equal(reference, merge_all([pieces[i] for i in perm]))
+    # a different association: pairwise tree fold
+    level = list(pieces)
+    while len(level) > 1:
+        level = [
+            level[i].merge(level[i + 1]) if i + 1 < len(level) else level[i]
+            for i in range(0, len(level), 2)
+        ]
+    assert _leaves_equal(reference, level[0])
+
+
+@pytest.mark.parametrize("kind", ["quantile", "scorelabel"])
+def test_merge_identity(kind, stream):
+    """A fresh sketch is the merge identity, on either side."""
+    preds, target = stream
+    if kind == "quantile":
+        full = QuantileSketch(num_bins=64).fold(jnp.asarray(preds))
+        fresh = QuantileSketch(num_bins=64)
+    else:
+        full = ScoreLabelSketch(num_bins=64).fold(jnp.asarray(preds), jnp.asarray(target))
+        fresh = ScoreLabelSketch(num_bins=64)
+    assert _leaves_equal(full, full.merge(fresh))
+    assert _leaves_equal(full, fresh.merge(full))
+
+
+def test_merge_config_mismatch_raises(stream):
+    with pytest.raises(ValueError, match="different configs"):
+        QuantileSketch(num_bins=64).merge(QuantileSketch(num_bins=32))
+    with pytest.raises(ValueError, match="cannot merge"):
+        QuantileSketch(num_bins=64).merge(ScoreLabelSketch(num_bins=64))
+
+
+def test_sharded_fold_equals_single_fold(stream):
+    """Merging per-shard folds == one fold over the concatenation (the
+    make_epoch / DDP equivalence), bitwise for integer-valued counts."""
+    preds, target = stream
+    whole = ScoreLabelSketch(num_bins=64).fold(jnp.asarray(preds), jnp.asarray(target))
+    merged = merge_all(_shard_sketches("scorelabel", stream, 4))
+    assert _leaves_equal(whole, merged)
+
+
+def test_quantile_error_bound_1m():
+    """|quantile() - exact NumPy quantile| <= the computable envelope
+    half-width at 1M samples, for several distributions and ranks."""
+    rng = np.random.default_rng(11)
+    qs = np.asarray([0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99], np.float32)
+    for name, values in {
+        "uniform": rng.uniform(0, 1, N_BIG),
+        "beta": rng.beta(2.0, 5.0, N_BIG),
+        "clipped_normal": np.clip(rng.normal(0.5, 0.2, N_BIG), 0, 1),
+    }.items():
+        values = values.astype(np.float32)
+        sk = QuantileSketch(num_bins=1024, lo=0.0, hi=1.0).fold(jnp.asarray(values))
+        got = np.asarray(sk.quantile(jnp.asarray(qs)))
+        lo, hi = (np.asarray(a) for a in sk.quantile_bounds(jnp.asarray(qs)))
+        exact = np.quantile(values, qs).astype(np.float32)
+        half = (hi - lo) / 2
+        assert np.all(half <= (1.0 / 1024) / 2 + 1e-6), name  # in-range data
+        assert np.all(np.abs(got - exact) <= half + 1e-5), (name, got, exact, half)
+        # exact value inside the rigorous envelope
+        assert np.all(exact >= lo - 1e-5) and np.all(exact <= hi + 1e-5), name
+
+
+def test_quantile_bound_holds_on_skewed_mass():
+    """The half-width contract on adversarially skewed data: nearly all
+    mass is one repeated value at a bin's low edge, so a rank-interpolated
+    estimate would land anywhere in the bin while the exact quantile sits
+    at its edge — only the envelope midpoint keeps |est - exact| within
+    the half-width."""
+    values = np.asarray([0.05] + [0.41] * 100 + [0.95], np.float32)
+    sk = QuantileSketch(num_bins=10, lo=0.0, hi=1.0).fold(jnp.asarray(values))
+    q = 100 / 102
+    est = float(sk.quantile(q))
+    exact = float(np.quantile(values, q))
+    lo, hi = (float(x[0]) for x in sk.quantile_bounds(jnp.asarray([q])))
+    assert lo - 1e-6 <= exact <= hi + 1e-6
+    assert abs(est - exact) <= (hi - lo) / 2 + 1e-6
+
+
+def test_quantile_out_of_range_mass():
+    """Out-of-range values land in the min/max-edged overflow bins; extreme
+    quantiles stay exact at the observed extremes."""
+    values = np.concatenate([np.full(10, -3.0), np.linspace(0, 1, 80), np.full(10, 7.0)]).astype(
+        np.float32
+    )
+    sk = QuantileSketch(num_bins=16, lo=0.0, hi=1.0).fold(jnp.asarray(values))
+    assert float(sk.quantile(0.0)) == -3.0
+    assert float(sk.quantile(1.0)) == 7.0
+    lo, hi = sk.quantile_bounds(jnp.asarray([0.05]))
+    assert float(lo[0]) == -3.0  # underflow bin spans [min, lo]
+
+
+def test_auroc_ap_error_bound_1m():
+    """|sketch value - exact sklearn value| <= the computable half-width at
+    1M samples, and the exact value sits inside the rigorous envelope."""
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    rng = np.random.default_rng(13)
+    preds = rng.uniform(0, 1, N_BIG).astype(np.float32)
+    target = (rng.uniform(0, 1, N_BIG) < 0.2 + 0.6 * preds).astype(np.int32)
+    exact_auroc = sklearn_metrics.roc_auc_score(target, preds)
+    exact_ap = sklearn_metrics.average_precision_score(target, preds)
+
+    sk = ScoreLabelSketch(num_bins=2048).fold(jnp.asarray(preds), jnp.asarray(target))
+    lo, hi = (float(x) for x in sk.auroc_bounds())
+    assert lo - 1e-6 <= exact_auroc <= hi + 1e-6
+    assert abs(float(sk.auroc()) - exact_auroc) <= float(sk.auroc_error_bound()) + 1e-6
+    assert float(sk.auroc_error_bound()) < 5e-3  # tight at 2048 bins
+
+    lo, hi = (float(x) for x in sk.average_precision_bounds())
+    assert lo - 1e-5 <= exact_ap <= hi + 1e-5
+    assert abs(float(sk.average_precision()) - exact_ap) <= float(
+        sk.average_precision_error_bound()
+    ) + 1e-5
+    assert float(sk.average_precision_error_bound()) < 5e-3
+
+
+def test_scorelabel_extreme_orderings():
+    """Perfectly separated and perfectly inverted streams hit the envelope
+    ends exactly (no same-bin pairs -> zero-width envelope)."""
+    preds = jnp.asarray([0.1, 0.2, 0.8, 0.9])
+    sk = ScoreLabelSketch(num_bins=16).fold(preds, jnp.asarray([0, 0, 1, 1]))
+    assert float(sk.auroc()) == 1.0 and float(sk.auroc_error_bound()) == 0.0
+    assert float(sk.average_precision()) == pytest.approx(1.0)
+    sk = ScoreLabelSketch(num_bins=16).fold(preds, jnp.asarray([1, 1, 0, 0]))
+    assert float(sk.auroc()) == 0.0
+
+
+def test_sketch_jit_scan_vmap_carry(stream):
+    """Sketches are valid jit/scan/vmap carries: folding under lax.scan
+    equals the eager fold, bitwise."""
+    preds, target = stream
+    p = jnp.asarray(preds[:4000].reshape(8, 500))
+    t = jnp.asarray(target[:4000].reshape(8, 500))
+
+    def body(sk, batch):
+        return sk.fold(batch[0], batch[1]), None
+
+    scanned, _ = jax.lax.scan(body, ScoreLabelSketch(num_bins=64), (p, t))
+    eager = ScoreLabelSketch(num_bins=64).fold(p.reshape(-1), t.reshape(-1))
+    assert _leaves_equal(scanned, eager)
+
+    # vmap per-batch folds, then reduce the stacked axis = same state
+    stacked = jax.vmap(lambda pb, tb: ScoreLabelSketch(num_bins=64).fold(pb, tb))(p, t)
+    assert _leaves_equal(stacked.reduce_leading_axis(), eager)
+
+
+def test_slot_ops_roundtrip(stream):
+    """stack/slot/set_slot/merge_into_slot are consistent (ring plumbing)."""
+    preds, target = stream
+    base = ScoreLabelSketch(num_bins=32)
+    row = base.fold(jnp.asarray(preds[:100]), jnp.asarray(target[:100]))
+    ring = base.stack(4).set_slot(2, row)
+    assert _leaves_equal(ring.slot(2), row)
+    assert _leaves_equal(ring.slot(0), base)
+    merged = ring.merge_into_slot(2, row)
+    assert _leaves_equal(merged.slot(2), row.merge(row))
+    assert _leaves_equal(ring.reduce_leading_axis(), row)  # 3 identity slots
+
+
+def test_pack_tree_roundtrip(stream):
+    """Checkpoint packing reconstructs class, config and leaves exactly —
+    including from numpy leaves (the orbax restore shape)."""
+    preds, target = stream
+    for sk in (
+        QuantileSketch(num_bins=48, lo=-2.0, hi=3.0).fold(jnp.asarray(preds)),
+        ScoreLabelSketch(num_bins=96).fold(jnp.asarray(preds), jnp.asarray(target)),
+    ):
+        packed = sk.to_pack_tree()
+        packed_np = {k: np.asarray(v) for k, v in packed.items()}
+        restored = sketch_from_pack_tree(packed_np)
+        assert type(restored) is type(sk)
+        assert restored.config() == sk.config()
+        assert _leaves_equal(restored, sk)
+
+
+def test_scale_sum_leaves():
+    """Decay scales counts but never the min/max extremes."""
+    sk = QuantileSketch(num_bins=8, lo=0.0, hi=1.0).fold(jnp.asarray([0.1, 0.9]))
+    scaled = sk.scale_sum_leaves(0.5)
+    assert float(scaled.counts.sum()) == pytest.approx(1.0)
+    assert float(scaled.minv) == pytest.approx(0.1)
+    assert float(scaled.maxv) == pytest.approx(0.9)
+
+
+@pytest.mark.parametrize("num_bins", [100, 128, 193])
+def test_fold_arms_agree(stream, num_bins):
+    """The kernel-backed fold arm (ops.binned_label_histograms, via the
+    fused threshold kernel) and the scatter-add bincount arm produce
+    IDENTICAL histograms — including the 0.0/1.0 edge bins and EVERY f32
+    bin-boundary score at non-power-of-two bin counts, where `int(v*T)`
+    truncation would disagree with the kernel's `v >= k/T` comparison — so
+    the backend-dependent arm selection can never change sketch state."""
+    from metrics_tpu.ops.binned_counts import binned_label_histograms
+
+    preds, target = stream
+    boundaries = np.arange(num_bins, dtype=np.float32) / num_bins
+    preds = np.concatenate([preds, boundaries, [0.0, 1.0]]).astype(np.float32)
+    rng = np.random.default_rng(1)
+    target = rng.integers(0, 2, len(preds)).astype(np.int32)
+    sk = ScoreLabelSketch(num_bins=num_bins)
+    ph, nh = sk._hists_via_bincount(jnp.asarray(preds), jnp.asarray(target) == 1)
+    ph2, nh2 = binned_label_histograms(jnp.asarray(preds), jnp.asarray(target), num_bins)
+    assert np.array_equal(np.asarray(ph), np.asarray(ph2))
+    assert np.array_equal(np.asarray(nh), np.asarray(nh2))
+
+
+def test_nbytes_budget():
+    """The acceptance budget: a 2048-bin score/label sketch is 16 KB."""
+    assert ScoreLabelSketch(num_bins=2048).nbytes == 2 * 2048 * 4
+    assert QuantileSketch(num_bins=1024).nbytes == (1024 + 2) * 4 + 8

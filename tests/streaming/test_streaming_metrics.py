@@ -1,0 +1,309 @@
+"""StreamingAUROC / StreamingAveragePrecision / StreamingQuantile:
+bounded-memory accuracy, lifecycle integration, mesh order-invariance and
+checkpoint resume — the acceptance pins of the streaming subsystem.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import Accuracy, MetricCollection
+from metrics_tpu.steps import make_epoch, make_step
+from metrics_tpu.streaming import (
+    StreamingAUROC,
+    StreamingAveragePrecision,
+    StreamingQuantile,
+)
+from metrics_tpu.utilities.distributed import sync_sketch_in_context
+
+try:
+    from jax import shard_map as _shard_map_mod  # noqa: F401  jax>=0.6 style
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+N_DEV = 8
+N_BIG = 1_000_000
+
+
+@pytest.fixture(scope="module")
+def big_stream():
+    rng = np.random.default_rng(5)
+    preds = rng.uniform(0, 1, N_BIG).astype(np.float32)
+    target = (rng.uniform(0, 1, N_BIG) < 0.25 + 0.5 * preds).astype(np.int32)
+    return preds, target
+
+
+def test_streaming_auroc_1m_error_bound_and_state_budget(big_stream):
+    """ACCEPTANCE: 1M streamed samples stay within the documented error
+    bound of exact AUROC while device state holds <= 64 KB."""
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    preds, target = big_stream
+    m = StreamingAUROC()  # default 2048 bins
+    for i in range(0, N_BIG, 100_000):  # streamed in 10 batches
+        m.update(jnp.asarray(preds[i : i + 100_000]), jnp.asarray(target[i : i + 100_000]))
+    exact = sklearn_metrics.roc_auc_score(target, preds)
+    got = float(m.compute())
+    bound = float(m.error_bound())
+    assert abs(got - exact) <= bound + 1e-6
+    assert bound < 5e-3
+    assert m.sketch.nbytes <= 64 * 1024
+    lo, hi = (float(x) for x in m.bounds())
+    assert lo - 1e-6 <= exact <= hi + 1e-6
+
+
+def test_streaming_ap_1m_error_bound(big_stream):
+    sklearn_metrics = pytest.importorskip("sklearn.metrics")
+    preds, target = big_stream
+    m = StreamingAveragePrecision()
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    exact = sklearn_metrics.average_precision_score(target, preds)
+    assert abs(float(m.compute()) - exact) <= float(m.error_bound()) + 1e-5
+    assert m.sketch.nbytes <= 64 * 1024
+
+
+def test_streaming_quantile_1m(big_stream):
+    preds, _ = big_stream
+    m = StreamingQuantile(q=[0.1, 0.5, 0.9], num_bins=1024)
+    m.update(jnp.asarray(preds))
+    exact = np.quantile(preds, [0.1, 0.5, 0.9])
+    got = np.asarray(m.compute())
+    bound = np.asarray(m.error_bound())
+    assert np.all(np.abs(got - exact) <= bound + 1e-5)
+    assert np.all(bound <= (1.0 / 1024) / 2 + 1e-6)
+
+
+def test_scalar_quantile_shape():
+    m = StreamingQuantile(q=0.5, num_bins=64)
+    m.update(jnp.linspace(0, 1, 101))
+    assert jnp.ndim(m.compute()) == 0
+    assert float(m.compute()) == pytest.approx(0.5, abs=1e-2)
+
+
+def test_streamed_equals_single_update_bitwise(big_stream):
+    """Batched streaming == one update over the concatenation, bitwise —
+    the merge-fold property the fused epoch path relies on."""
+    preds, target = big_stream
+    a = StreamingAUROC(num_bins=256)
+    for i in range(0, 50_000, 5_000):
+        a.update(jnp.asarray(preds[i : i + 5_000]), jnp.asarray(target[i : i + 5_000]))
+    b = StreamingAUROC(num_bins=256)
+    b.update(jnp.asarray(preds[:50_000]), jnp.asarray(target[:50_000]))
+    assert float(a.compute()) == float(b.compute())
+
+
+def test_forward_returns_batch_value_and_accumulates(big_stream):
+    preds, target = big_stream
+    m = StreamingAUROC(num_bins=256)
+    v1 = m(jnp.asarray(preds[:4_000]), jnp.asarray(target[:4_000]))
+    batch_only = StreamingAUROC(num_bins=256)
+    batch_only.update(jnp.asarray(preds[:4_000]), jnp.asarray(target[:4_000]))
+    assert float(v1) == float(batch_only.compute())
+    m(jnp.asarray(preds[4_000:8_000]), jnp.asarray(target[4_000:8_000]))
+    full = StreamingAUROC(num_bins=256)
+    full.update(jnp.asarray(preds[:8_000]), jnp.asarray(target[:8_000]))
+    assert float(m.compute()) == float(full.compute())
+
+
+def test_reset_restores_identity(big_stream):
+    preds, target = big_stream
+    m = StreamingAUROC(num_bins=64)
+    m.update(jnp.asarray(preds[:1_000]), jnp.asarray(target[:1_000]))
+    m.reset()
+    assert float(m.sketch.count) == 0.0
+
+
+def test_make_step_scan_parity(big_stream):
+    preds, target = big_stream
+    init, step, compute = make_step(StreamingAUROC, num_bins=256)
+    p = jnp.asarray(preds[:8_000].reshape(8, 1_000))
+    t = jnp.asarray(target[:8_000].reshape(8, 1_000))
+    state, values = jax.lax.scan(lambda s, b: step(s, *b), init(), (p, t))
+    eager = StreamingAUROC(num_bins=256)
+    eager.update(p.reshape(-1), t.reshape(-1))
+    assert float(compute(state)) == float(eager.compute())
+    assert values.shape == (8,)
+
+
+@pytest.mark.parametrize("with_values", [False, True])
+def test_make_epoch_parity(big_stream, with_values):
+    """Sketch states ride the fused epoch (flat/vmap) paths bitwise."""
+    preds, target = big_stream
+    init, epoch, compute = make_epoch(StreamingAUROC, num_bins=256, with_values=with_values)
+    p = jnp.asarray(preds[:8_000].reshape(8, 1_000))
+    t = jnp.asarray(target[:8_000].reshape(8, 1_000))
+    state, values = epoch(init(), p, t)
+    eager = StreamingAUROC(num_bins=256)
+    eager.update(p.reshape(-1), t.reshape(-1))
+    assert float(compute(state)) == float(eager.compute())
+    if with_values:
+        assert values.shape == (8,)
+
+
+def test_mesh_merge_order_invariant_bitwise(big_stream):
+    """ACCEPTANCE: the sketch state merges order-invariantly across mesh
+    shards — permuting which device holds which shard leaves the merged
+    state bitwise identical, and compute() equals the global eager value."""
+    preds, target = big_stream
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("dp",))
+    init, step, compute = make_step(StreamingAUROC(num_bins=256), axis_name="dp")
+    p = jnp.asarray(preds[: N_DEV * 1_000].reshape(N_DEV, 1_000))
+    t = jnp.asarray(target[: N_DEV * 1_000].reshape(N_DEV, 1_000))
+
+    def value_prog(pb, tb):
+        state, _ = step(init(), pb[0], tb[0])
+        return compute(state)
+
+    fn = jax.jit(shard_map(value_prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+    eager = StreamingAUROC(num_bins=256)
+    eager.update(p.reshape(-1), t.reshape(-1))
+    assert float(fn(p, t)) == float(eager.compute())
+
+    def state_prog(pb, tb):
+        state, _ = step(init(), pb[0], tb[0])
+        return sync_sketch_in_context(state["sketch"], "dp")
+
+    sfn = jax.jit(shard_map(state_prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+    merged = sfn(p, t)
+    for perm in ([7, 6, 5, 4, 3, 2, 1, 0], [3, 1, 7, 0, 5, 2, 6, 4]):
+        permuted = sfn(p[np.asarray(perm)], t[np.asarray(perm)])
+        for a, b in zip(jax.tree_util.tree_leaves(merged), jax.tree_util.tree_leaves(permuted)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_eager_sync_merges_sketches(big_stream):
+    """The eager DCN gather path: a simulated 2-rank dist_sync_fn merges
+    per-rank sketches into the global one (parity with pooled data)."""
+    preds, target = big_stream
+    p0, t0 = jnp.asarray(preds[:3_000]), jnp.asarray(target[:3_000])
+    p1, t1 = jnp.asarray(preds[3_000:5_000]), jnp.asarray(target[3_000:5_000])
+    other = StreamingAUROC(num_bins=128)
+    other.update(p1, t1)
+    other_leaves = jax.tree_util.tree_leaves(other.sketch)
+
+    rank_i = [0]
+
+    def fake_gather(x, group=None):
+        out = [x, other_leaves[rank_i[0] % len(other_leaves)]]
+        rank_i[0] += 1
+        return out
+
+    m = StreamingAUROC(num_bins=128, dist_sync_fn=fake_gather)
+    m.update(p0, t0)
+    with m.sync_context(distributed_available_fn=lambda: True):
+        synced = float(m.sketch.auroc())
+    pooled = StreamingAUROC(num_bins=128)
+    pooled.update(jnp.asarray(preds[:5_000]), jnp.asarray(target[:5_000]))
+    assert synced == float(pooled.compute())
+    # unsync restored the local-only state
+    local = StreamingAUROC(num_bins=128)
+    local.update(p0, t0)
+    assert float(m.compute.__wrapped__(m)) == float(local.compute.__wrapped__(local))
+
+
+def test_collection_membership_and_compute_groups(big_stream):
+    preds, target = big_stream
+    coll = MetricCollection(
+        [StreamingAUROC(num_bins=128), StreamingAveragePrecision(num_bins=128)]
+    )
+    coll.update(jnp.asarray(preds[:2_000]), jnp.asarray(target[:2_000]))
+    coll.update(jnp.asarray(preds[2_000:4_000]), jnp.asarray(target[2_000:4_000]))
+    res = coll.compute()
+    # identical sketch states -> one compute group, values still distinct
+    assert len(coll.compute_groups) == 1
+    ref = StreamingAUROC(num_bins=128)
+    ref.update(jnp.asarray(preds[:4_000]), jnp.asarray(target[:4_000]))
+    assert float(res["StreamingAUROC"]) == float(ref.compute())
+
+    cinit, cstep, ccompute = make_step(coll)
+    state, _ = cstep(cinit(), jnp.asarray(preds[:4_000]), jnp.asarray(target[:4_000]))
+    out = ccompute(state)
+    assert float(out["StreamingAUROC"]) == float(ref.compute())
+
+
+def test_checkpoint_manager_roundtrip_bitwise(tmp_path, big_stream):
+    """Kill-and-resume through ft.CheckpointManager: restored sketch metric
+    continues the stream and reproduces compute() bitwise."""
+    preds, target = big_stream
+    from metrics_tpu.ft import BatchJournal, CheckpointManager
+
+    mgr = CheckpointManager(os.path.join(tmp_path, "ck"))
+    journal = BatchJournal()
+    m = StreamingAUROC(num_bins=256)
+    m.update(jnp.asarray(preds[:2_000]), jnp.asarray(target[:2_000]))
+    journal.record(0, 0)
+    mgr.save(m, journal=journal, epoch=0, step=0)
+
+    resumed = StreamingAUROC(num_bins=256)
+    j2 = BatchJournal()
+    manifest = mgr.restore(resumed, journal=j2)
+    assert manifest["journal"]["watermark"] == [0, 0]
+    assert not j2.should_fold(0, 0)  # exactly-once: batch 0 never refolds
+    assert j2.should_fold(0, 1)
+    assert resumed._update_count == m._update_count
+
+    for metric in (m, resumed):
+        metric.update(jnp.asarray(preds[2_000:4_000]), jnp.asarray(target[2_000:4_000]))
+    assert float(m.compute()) == float(resumed.compute())
+
+
+def test_metric_save_restore_bitwise(tmp_path, big_stream):
+    preds, target = big_stream
+    m = StreamingAveragePrecision(num_bins=128)
+    m.update(jnp.asarray(preds[:2_000]), jnp.asarray(target[:2_000]))
+    m.save(tmp_path / "snap")
+    other = StreamingAveragePrecision(num_bins=128).restore(tmp_path / "snap")
+    assert float(m.compute()) == float(other.compute())
+
+
+def test_set_dtype_leaves_sketch_counts_exact(big_stream):
+    preds, target = big_stream
+    m = StreamingAUROC(num_bins=64)
+    m.update(jnp.asarray(preds[:1_000]), jnp.asarray(target[:1_000]))
+    before = float(m.compute())
+    m.half()
+    m.update(jnp.asarray(preds[1_000:1_001]), jnp.asarray(target[1_000:1_001]))
+    assert m.sketch.pos.dtype == jnp.float32  # counts stay exact-integer f32
+    assert isinstance(before, float)
+
+
+def test_add_state_sketch_validation():
+    from metrics_tpu.metric import Metric
+    from metrics_tpu.streaming import ScoreLabelSketch
+
+    class Bad(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", default=ScoreLabelSketch(8), dist_reduce_fx="sum")
+
+        def update(self):  # pragma: no cover - never reached
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(ValueError, match="dist_reduce_fx='sketch' or None"):
+        Bad()
+
+    class Bad2(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", default=jnp.zeros(4), dist_reduce_fx="sketch")
+
+        def update(self):  # pragma: no cover
+            pass
+
+        def compute(self):  # pragma: no cover
+            pass
+
+    with pytest.raises(ValueError, match="requires a streaming.sketches.Sketch"):
+        Bad2()

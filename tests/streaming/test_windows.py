@@ -1,0 +1,277 @@
+"""WindowedMetric / DecayedMetric semantics + make_stream_step parity +
+checkpoint kill-and-resume (the windowed acceptance pin).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection, obs
+from metrics_tpu.steps import make_stream_step
+from metrics_tpu.streaming import DecayedMetric, StreamingAUROC, WindowedMetric
+
+
+@pytest.fixture(scope="module")
+def stream():
+    rng = np.random.default_rng(9)
+    preds = rng.uniform(0, 1, 20_000).astype(np.float32)
+    target = (rng.uniform(0, 1, 20_000) < 0.3 + 0.4 * preds).astype(np.int32)
+    return preds, target
+
+
+def _batches(stream, n, size=1_000):
+    preds, target = stream
+    for i in range(n):
+        sl = slice(i * size, (i + 1) * size)
+        yield jnp.asarray(preds[sl]), jnp.asarray(target[sl])
+
+
+def test_window_expiry_semantics():
+    """The window covers exactly the last `window * updates_per_slot`
+    updates; older shards are expired, not merely down-weighted."""
+    w = WindowedMetric(Accuracy(), window=2, updates_per_slot=1)
+    w.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+    assert float(w.compute()) == 1.0
+    w.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+    assert float(w.compute()) == 0.5  # both shards live
+    w.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+    assert float(w.compute()) == 0.0  # the all-correct shard expired
+
+
+def test_window_equals_exact_sliding_window(stream):
+    """Against a brute-force recompute over the trailing batches."""
+    preds, target = stream
+    k, ups = 3, 2
+    w = WindowedMetric(Accuracy(), window=k, updates_per_slot=ups)
+    hist = []
+    for pb, tb in _batches(stream, 9):
+        pb_lbl = (pb > 0.5).astype(jnp.int32)
+        w.update(pb_lbl, tb)
+        hist.append((pb_lbl, tb))
+        # live shard has 1..ups updates; expired shards are whole
+        n_live = ((len(hist) - 1) % ups) + 1
+        span = (k - 1) * ups + n_live
+        exact = Accuracy()
+        for b in hist[-span:]:
+            exact.update(*b)
+        assert float(w.compute()) == pytest.approx(float(exact.compute()), abs=1e-6)
+
+
+def test_manual_advance():
+    w = WindowedMetric(Accuracy(), window=2, updates_per_slot=None)
+    for _ in range(5):  # all into one shard until the caller says otherwise
+        w.update(jnp.asarray([1, 1]), jnp.asarray([1, 1]))
+    w.advance()
+    w.update(jnp.asarray([0, 0]), jnp.asarray([1, 1]))
+    assert float(w.compute()) == pytest.approx(10 / 12)
+    w.advance()  # expires the 10-correct shard
+    assert float(w.compute()) == 0.0
+
+
+def test_windows_expired_counter():
+    prev = obs.enable()
+    obs.reset()
+    try:
+        w = WindowedMetric(Accuracy(), window=2, updates_per_slot=1)
+        for _ in range(4):
+            w.update(jnp.asarray([1, 1]), jnp.asarray([1, 1]))
+        # rotations happen lazily at updates 2,3,4; slots previously
+        # written are cleared on rotations 3 and 4
+        assert obs.get_counter("stream.windows_expired", metric="Accuracy") == 2
+    finally:
+        obs.enable(prev)
+        obs.reset()
+
+
+def test_windowed_sketch_base(stream):
+    """A sketch-state metric as the windowed base: expiry drops its counts."""
+    preds, target = stream
+    w = WindowedMetric(StreamingAUROC(num_bins=64), window=2, updates_per_slot=1)
+    for pb, tb in _batches(stream, 3):
+        w.update(pb, tb)
+    exact = StreamingAUROC(num_bins=64)
+    for pb, tb in list(_batches(stream, 3))[-2:]:
+        exact.update(pb, tb)
+    assert float(w.compute()) == float(exact.compute())
+
+
+def test_windowed_rejects_buffer_states():
+    from metrics_tpu import AUROC
+
+    with pytest.raises(ValueError, match="combinable"):
+        WindowedMetric(AUROC(), window=2)  # cat-list states cannot expire
+    with pytest.raises(ValueError, match="combinable"):
+        DecayedMetric(AUROC(sample_capacity=128), half_life=2.0)
+
+
+def test_decayed_rejects_max_states():
+    from metrics_tpu import MaxMetric
+
+    with pytest.raises(ValueError, match="combinable"):
+        DecayedMetric(MaxMetric(), half_life=2.0)  # a max cannot fade
+
+
+def test_decayed_half_life_weighting():
+    d = DecayedMetric(Accuracy(), half_life=1.0)
+    d.update(jnp.asarray([0, 0, 0, 0]), jnp.asarray([1, 1, 1, 1]))
+    d.update(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))
+    # recent all-correct weighs 2x the all-wrong batch: 2/3
+    assert float(d.compute()) == pytest.approx(2 / 3, abs=1e-6)
+    assert d.effective_window == pytest.approx(2.0)
+
+
+def test_decayed_equals_exact_ewma(stream):
+    d = DecayedMetric(MeanSquaredError(), half_life=3.0)
+    decay = d.decay
+    num = den = 0.0
+    for pb, tb in _batches(stream, 6):
+        d.update(pb, tb)
+        num = num * decay + float(jnp.sum((pb - tb) ** 2))
+        den = den * decay + pb.shape[0]
+        assert float(d.compute()) == pytest.approx(num / den, rel=1e-5)
+
+
+def test_wrappers_ride_collections(stream):
+    coll = MetricCollection(
+        {
+            "acc_w": WindowedMetric(Accuracy(), window=2, updates_per_slot=1),
+            "acc_d": DecayedMetric(Accuracy(), half_life=2.0),
+        }
+    )
+    for pb, tb in _batches(stream, 3):
+        coll.update((pb > 0.5).astype(jnp.int32), tb)
+    res = coll.compute()
+    assert set(res) == {"acc_w", "acc_d"}
+
+
+def test_forward_returns_batch_value(stream):
+    w = WindowedMetric(Accuracy(), window=2, updates_per_slot=1)
+    pb = jnp.asarray([1, 0, 1, 1])
+    tb = jnp.asarray([1, 1, 1, 1])
+    assert float(w(pb, tb)) == 0.75  # batch-local value
+    d = DecayedMetric(Accuracy(), half_life=2.0)
+    assert float(d(pb, tb)) == 0.75
+
+
+@pytest.mark.parametrize("ups", [1, 2])
+def test_stream_step_parity_windowed(stream, ups):
+    """make_stream_step == the eager wrapper, step by step, incl. rotation
+    boundaries (one launch folds AND emits the window value)."""
+    eager = WindowedMetric(StreamingAUROC(num_bins=64), window=3, updates_per_slot=ups)
+    init, step, compute = make_stream_step(
+        WindowedMetric(StreamingAUROC(num_bins=64), window=3, updates_per_slot=ups)
+    )
+    state = init()
+    for pb, tb in _batches(stream, 8):
+        eager.update(pb, tb)
+        state, value = step(state, pb, tb)
+        assert float(value) == float(eager.compute())
+        assert float(compute(jax.tree_util.tree_map(lambda x: x, state))) == float(eager.compute())
+
+
+def test_stream_step_parity_decayed(stream):
+    eager = DecayedMetric(Accuracy(num_classes=2, multiclass=True), half_life=4.0)
+    init, step, compute = make_stream_step(
+        DecayedMetric(Accuracy(num_classes=2, multiclass=True), half_life=4.0)
+    )
+    state = init()
+    for pb, tb in _batches(stream, 5):
+        pb_lbl = (pb > 0.5).astype(jnp.int32)
+        eager.update(pb_lbl, tb)
+        state, value = step(state, pb_lbl, tb)
+        assert float(value) == pytest.approx(float(eager.compute()), rel=1e-6)
+
+
+def test_stream_step_requires_wrapper():
+    with pytest.raises(ValueError, match="WindowedMetric or DecayedMetric"):
+        make_stream_step(Accuracy())
+    with pytest.raises(ValueError, match="updates_per_slot"):
+        make_stream_step(WindowedMetric(Accuracy(), window=2, updates_per_slot=None))
+
+
+def test_stream_step_single_trace(stream):
+    """The whole fold+rotate+compute pipeline is ONE jitted program: a
+    second same-shape step call must not retrace."""
+    prev = obs.enable()
+    obs.reset()
+    try:
+        init, step, _ = make_stream_step(
+            WindowedMetric(StreamingAUROC(num_bins=32), window=2, updates_per_slot=1)
+        )
+        state = init()
+        batches = list(_batches(stream, 3))
+        for pb, tb in batches:
+            state, _ = step(state, pb, tb)
+        label = "WindowedMetric[StreamingAUROC].stream_step"
+        assert obs.get_counter("step.traces", step=label) == 1
+    finally:
+        obs.enable(prev)
+        obs.reset()
+
+
+def test_windowed_kill_resume_bitwise(tmp_path, stream):
+    """ACCEPTANCE: kill-and-resume of a windowed metric through
+    ft.CheckpointManager reproduces compute() bitwise — ring position,
+    shard fill bookkeeping and sketch states all survive the manifest
+    round-trip, and the journal watermark keeps the resume exactly-once."""
+    from metrics_tpu.ft import BatchJournal, CheckpointManager
+
+    preds, target = stream
+    batches = list(_batches(stream, 6))
+
+    # uninterrupted run
+    uninterrupted = WindowedMetric(StreamingAUROC(num_bins=64), window=2, updates_per_slot=2)
+    for epoch_step, (pb, tb) in enumerate(batches):
+        uninterrupted.update(pb, tb)
+
+    # "killed" after batch 2 (checkpoint saved), resumed in a fresh object
+    mgr = CheckpointManager(os.path.join(tmp_path, "ck"))
+    journal = BatchJournal()
+    victim = WindowedMetric(StreamingAUROC(num_bins=64), window=2, updates_per_slot=2)
+    for epoch_step, (pb, tb) in enumerate(batches[:3]):
+        victim.update(pb, tb)
+        journal.record(0, epoch_step)
+    mgr.save(victim, journal=journal, epoch=0, step=2)
+    del victim  # the kill
+
+    resumed = WindowedMetric(StreamingAUROC(num_bins=64), window=2, updates_per_slot=2)
+    j2 = BatchJournal()
+    mgr.restore(resumed, journal=j2)
+    for epoch_step, (pb, tb) in enumerate(batches):
+        if not j2.should_fold(0, epoch_step):
+            continue  # exactly-once: already in the restored state
+        resumed.update(pb, tb)
+        j2.record(0, epoch_step)
+
+    assert resumed._pos == uninterrupted._pos
+    assert resumed._slot_filled == uninterrupted._slot_filled
+    assert float(resumed.compute()) == float(uninterrupted.compute())
+
+
+def test_decayed_kill_resume_bitwise(tmp_path, stream):
+    from metrics_tpu.ft import BatchJournal, CheckpointManager
+
+    batches = list(_batches(stream, 4))
+    uninterrupted = DecayedMetric(Accuracy(), half_life=2.0)
+    for pb, tb in batches:
+        uninterrupted.update((pb > 0.5).astype(jnp.int32), tb)
+
+    mgr = CheckpointManager(os.path.join(tmp_path, "ck"))
+    journal = BatchJournal()
+    victim = DecayedMetric(Accuracy(), half_life=2.0)
+    for step_i, (pb, tb) in enumerate(batches[:2]):
+        victim.update((pb > 0.5).astype(jnp.int32), tb)
+        journal.record(0, step_i)
+    mgr.save(victim, journal=journal, epoch=0, step=1)
+
+    resumed = DecayedMetric(Accuracy(), half_life=2.0)
+    j2 = BatchJournal()
+    mgr.restore(resumed, journal=j2)
+    for step_i, (pb, tb) in enumerate(batches):
+        if not j2.should_fold(0, step_i):
+            continue
+        resumed.update((pb > 0.5).astype(jnp.int32), tb)
+        j2.record(0, step_i)
+    assert float(resumed.compute()) == float(uninterrupted.compute())
