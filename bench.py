@@ -649,21 +649,10 @@ def bench_probes() -> dict:
 # which probe calibrates which row, matched by the row's actual dominant op
 # class: big dense matmuls -> matmul probe; dense conv towers -> conv probe;
 # separable-depthwise SSIM is bandwidth/VPU-bound -> elementwise probe;
-# host-side rows have no probe (raw comparison with the confound note)
-_PROBE_CLASS = {
-    "auroc_exact_1M_compute": "probe_sort_1M",
-    "retrieval_map_1M_docs_compute": "probe_sort_1M",
-    "retrieval_ndcg_1M_docs_compute": "probe_sort_1M",
-    "retrieval_map_k10_1M_docs_compute": "probe_sort_1M",
-    "fid_10k_2048d_compute": "probe_matmul_1024_bf16",
-    "bertscore_match_256x128x256": "probe_matmul_1024_bf16",
-    "lpips_alex_32x64x64_forward": "probe_conv_64ch_3x3",
-    "ssim_64x3x256x256_compute": "probe_elementwise_1Mx10",
-    "accuracy_1M_update_compute_wallclock": "probe_elementwise_1Mx10",
-    "binned_counts_1M_T100_update": "probe_elementwise_1Mx10",
-    "collection_statscores_binary_1M_update": "probe_elementwise_1Mx10",
-    "collection_statscores_multiclass_1M_update": "probe_elementwise_1Mx10",
-}
+# host-side rows have no probe (raw comparison with the confound note).
+# Shared with the --compare gate so the two can never disagree about a
+# row's calibration class.
+from benchmarks.compare import PROBE_CLASS as _PROBE_CLASS  # noqa: E402
 
 
 def _prior_rounds() -> list:
@@ -724,7 +713,11 @@ def _best_prior_normalized() -> dict:
     return best
 
 
-def main(json_path: "str | None" = None) -> None:
+def main(
+    json_path: "str | None" = None,
+    compare_path: "str | None" = None,
+    compare_threshold: float = 1.5,
+) -> None:
     from benchmarks import (
         bench_collection,
         bench_curves,
@@ -993,21 +986,52 @@ def main(json_path: "str | None" = None) -> None:
     for line in emitted_rows:
         print(line, flush=True)
 
+    record = build_record(emitted_dicts) if (json_path or compare_path) else None
     if json_path:
-        write_json_record(json_path, emitted_dicts)
+        _dump_record(json_path, record)
+
+    if compare_path:
+        # regression gate against a prior record: exits nonzero on a gated
+        # regression (EXIT_REGRESSED) or a cross-device refusal
+        # (EXIT_REFUSED) so CI fails loudly instead of archiving a slower
+        # round as if nothing happened. The SAME record object --json just
+        # wrote is compared (rows normalized by the same rows_by_metric as
+        # load_record), so in-memory and reloaded gating can never differ.
+        from benchmarks.compare import (
+            BenchRecord,
+            CompareRefused,
+            EXIT_REFUSED,
+            compare_records,
+            load_record,
+            render_report,
+            rows_by_metric,
+        )
+
+        new_rec = BenchRecord(
+            rows_by_metric(record["rows"]),
+            path="<this sweep>",
+            device_kind=record["device_kind"],
+            platform=record["platform"],
+            jax_version=record["jax_version"],
+            device_count=record["device_count"],
+            process_count=record["process_count"],
+        )
+        try:
+            result = compare_records(load_record(compare_path), new_rec, threshold=compare_threshold)
+        except CompareRefused as err:
+            print(f"REFUSED: {err}", file=sys.stderr)
+            sys.exit(EXIT_REFUSED)
+        print(render_report(result), end="")
+        if result["exit_code"]:
+            sys.exit(result["exit_code"])
 
 
-def write_json_record(path: str, rows: list) -> None:
-    """Write the machine-readable sweep record (``--json BENCH_rNN.json``).
-
-    One self-describing file per round: device kind + jax version (so a
-    TPU sweep and a CPU fallback can never be confused again), every row
-    with its compile-vs-run split, and the obs snapshot (total backend
-    compile seconds, per-step trace counts) — the bench trajectory the
-    round-over-round tooling can diff mechanically.
-    """
+def build_record(rows: list) -> dict:
+    """The machine-readable sweep record as a dict (see ``--json``): device
+    kind + jax version + host count header (so a TPU sweep, a CPU fallback
+    and a multi-host run can never be confused), every row with its
+    compile-vs-run split, and the obs compile totals."""
     import platform
-    import sys
     import time as _time
 
     import jax
@@ -1015,12 +1039,13 @@ def write_json_record(path: str, rows: list) -> None:
     from metrics_tpu import obs
 
     dev = jax.devices()[0]
-    record = {
+    return {
         "schema": 1,
         "recorded_unix": int(_time.time()),
         "device_kind": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
         "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
         "jax_version": jax.__version__,
         "python_version": platform.python_version(),
         "rows": rows,
@@ -1034,10 +1059,21 @@ def write_json_record(path: str, rows: list) -> None:
             "jax_compiles": obs.get_counter("jax.compiles"),
         },
     }
+
+
+def _dump_record(path: str, record: dict) -> None:
+    import sys
+
     with open(path, "w") as f:
         json.dump(record, f, indent=2, sort_keys=True)
         f.write("\n")
-    print(f"wrote {path} ({len(rows)} rows)", file=sys.stderr)
+    print(f"wrote {path} ({len(record['rows'])} rows)", file=sys.stderr)
+
+
+def write_json_record(path: str, rows: list) -> None:
+    """Write the machine-readable sweep record (``--json BENCH_rNN.json``);
+    see :func:`build_record` for the shape."""
+    _dump_record(path, build_record(rows))
 
 
 if __name__ == "__main__":
@@ -1051,4 +1087,24 @@ if __name__ == "__main__":
         help="also write the full sweep as one machine-readable JSON record"
         " (device kind, jax version, per-row compile-vs-run split, obs totals)",
     )
-    main(json_path=parser.parse_args().json)
+    parser.add_argument(
+        "--compare",
+        metavar="OLD.json",
+        default=None,
+        help="gate this sweep against a prior bench record (benchmarks/compare.py):"
+        " prints the delta report and exits nonzero on a regression past"
+        " --compare-threshold; refuses cross-device comparisons (exit 2)",
+    )
+    parser.add_argument(
+        "--compare-threshold",
+        type=float,
+        default=1.5,
+        metavar="X",
+        help="regression gate ratio for --compare (default 1.5)",
+    )
+    _args = parser.parse_args()
+    main(
+        json_path=_args.json,
+        compare_path=_args.compare,
+        compare_threshold=_args.compare_threshold,
+    )

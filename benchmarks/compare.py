@@ -1,0 +1,419 @@
+"""Round-over-round bench comparison: noise-aware deltas, regression gate.
+
+Five ``BENCH_rNN.json`` records accumulated before this module existed with
+zero tooling to diff them — a hot path could get 1.5x slower between
+rounds and nothing would say so. This module is that gate:
+
+* :func:`load_record` reads BOTH record shapes in the tree — the driver's
+  ``{"tail": <stdout tail>}`` captures (rows parsed back out of the JSON
+  lines, keeping the best value per metric) and ``bench.py --json``'s
+  self-describing ``{"rows": [...], "device_kind": ...}`` records.
+* :func:`compare_records` computes per-row deltas between two records and
+  gates them. The published ``value`` of a row is already the fast-mode
+  median of the bimodal-chip protocol (``benchmarks/_timing.py``); the
+  comparison is **noise-aware** on top of that: a side whose
+  ``n_fast`` sample count is below ``min_n_fast`` (or whose slow-mode
+  samples outnumber its fast ones) is marked low-confidence and never
+  gates, and when both records carry the chip-state probe rows the gate
+  compares the **row/probe ratio** instead of raw values — the per-op-class
+  chip state cancels out of the ratio, so a slow chip session cannot fake
+  a regression (the same protocol ``bench.py`` applies against its best
+  prior round). Probe rows themselves record state and are never gated.
+* **Cross-device refusal**: records carry ``device_kind``; comparing a TPU
+  sweep against a CPU fallback is meaningless and exits with its own code
+  (:data:`EXIT_REFUSED`) and a clear message rather than a wall of fake
+  regressions. Driver-tail records predate the header and compare with a
+  confound warning.
+* :func:`trend_table` renders the metric x round markdown table across
+  ``BENCH_r01..rNN``.
+
+CLI (also wired as ``bench.py --compare OLD.json``)::
+
+    python -m benchmarks.compare OLD.json NEW.json [--threshold 1.5]
+    python -m benchmarks.compare --trend BENCH_r*.json
+
+Exit codes: 0 pass, :data:`EXIT_REGRESSED` (1) at least one gated row
+regressed past the threshold, :data:`EXIT_REFUSED` (2) cross-device or
+unreadable input.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "EXIT_OK",
+    "EXIT_REFUSED",
+    "EXIT_REGRESSED",
+    "PROBE_CLASS",
+    "BenchRecord",
+    "CompareRefused",
+    "compare_records",
+    "load_record",
+    "render_report",
+    "rows_by_metric",
+    "trend_table",
+]
+
+EXIT_OK = 0
+EXIT_REGRESSED = 1
+EXIT_REFUSED = 2
+
+# which chip-state probe calibrates which row, by the row's dominant op
+# class (bench.py emits the probe rows; see bench_probes there). Shared
+# with bench.py's against-best-prior gate so the two gates can never
+# disagree about a row's calibration class.
+PROBE_CLASS: Dict[str, str] = {
+    "auroc_exact_1M_compute": "probe_sort_1M",
+    "retrieval_map_1M_docs_compute": "probe_sort_1M",
+    "retrieval_ndcg_1M_docs_compute": "probe_sort_1M",
+    "retrieval_map_k10_1M_docs_compute": "probe_sort_1M",
+    "fid_10k_2048d_compute": "probe_matmul_1024_bf16",
+    "bertscore_match_256x128x256": "probe_matmul_1024_bf16",
+    "lpips_alex_32x64x64_forward": "probe_conv_64ch_3x3",
+    "ssim_64x3x256x256_compute": "probe_elementwise_1Mx10",
+    "accuracy_1M_update_compute_wallclock": "probe_elementwise_1Mx10",
+    "binned_counts_1M_T100_update": "probe_elementwise_1Mx10",
+    "collection_statscores_binary_1M_update": "probe_elementwise_1Mx10",
+    "collection_statscores_multiclass_1M_update": "probe_elementwise_1Mx10",
+}
+
+
+class CompareRefused(RuntimeError):
+    """Raised when two records are not comparable (cross-device, unreadable)."""
+
+
+def rows_by_metric(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Normalize a row list to ``{metric: row}``, keeping the best (lowest)
+    value per duplicate metric and dropping malformed rows — the ONE
+    normalization every record path shares, so an in-memory record can
+    never gate differently from the same record reloaded from disk."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        name, value = row.get("metric"), row.get("value")
+        if not isinstance(name, str) or not isinstance(value, (int, float)) or value <= 0:
+            continue
+        prev = out.get(name)
+        if prev is None or value < prev["value"]:
+            out[name] = row
+    return out
+
+
+class BenchRecord:
+    """One normalized bench record: ``{metric: row}`` plus the header."""
+
+    def __init__(
+        self,
+        rows: Dict[str, Dict[str, Any]],
+        path: str = "<memory>",
+        device_kind: Optional[str] = None,
+        platform: Optional[str] = None,
+        jax_version: Optional[str] = None,
+        device_count: Optional[int] = None,
+        process_count: Optional[int] = None,
+        source: str = "record",
+    ) -> None:
+        self.rows = rows
+        self.path = path
+        self.device_kind = device_kind
+        self.platform = platform
+        self.jax_version = jax_version
+        self.device_count = device_count
+        self.process_count = process_count
+        self.source = source
+
+    def header(self) -> str:
+        """One human-readable line: where the record ran."""
+        dev = self.device_kind or "unknown-device"
+        parts = [f"device_kind={dev}"]
+        if self.platform:
+            parts.append(f"platform={self.platform}")
+        if self.device_count is not None:
+            parts.append(f"devices={self.device_count}")
+        if self.process_count is not None:
+            parts.append(f"hosts={self.process_count}")
+        parts.append(f"jax={self.jax_version or 'unknown'}")
+        return f"{os.path.basename(self.path)}: {', '.join(parts)} [{self.source}]"
+
+    def __repr__(self) -> str:
+        return f"BenchRecord({self.header()}, {len(self.rows)} rows)"
+
+
+def _rows_from_lines(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse bench stdout JSON lines (duplicate lines from the repeated
+    final table are harmless — :func:`rows_by_metric` keeps the best)."""
+    rows: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            continue
+    return rows_by_metric(rows)
+
+
+def load_record(path: str) -> BenchRecord:
+    """Read a bench record off disk, whichever of the two shapes it is."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as err:
+        raise CompareRefused(f"cannot read bench record {path!r}: {err}") from err
+    if isinstance(data, dict) and isinstance(data.get("rows"), list):
+        return BenchRecord(
+            rows_by_metric(data["rows"]),
+            path=path,
+            device_kind=data.get("device_kind"),
+            platform=data.get("platform"),
+            jax_version=data.get("jax_version"),
+            device_count=data.get("device_count"),
+            process_count=data.get("process_count"),
+            source="record",
+        )
+    if isinstance(data, dict) and isinstance(data.get("tail"), str):
+        return BenchRecord(_rows_from_lines(data["tail"]), path=path, source="driver_tail")
+    raise CompareRefused(
+        f"unrecognized bench record shape in {path!r}: expected a bench.py --json"
+        " record (\"rows\" list) or a driver capture (\"tail\" string)"
+    )
+
+
+def _row_value(row: Dict[str, Any]) -> float:
+    """The comparable number for a row: the fast-mode median when the
+    bimodal protocol recorded one, else the published value."""
+    fast = row.get("fast_mode_median")
+    if isinstance(fast, (int, float)) and fast > 0:
+        return float(fast)
+    return float(row["value"])
+
+
+def _row_confidence(row: Dict[str, Any], min_n_fast: int) -> Optional[str]:
+    """``None`` when the row's measurement is gate-grade, else the reason
+    it is low-confidence (few fast-mode samples, slow-mode dominated)."""
+    n_fast = row.get("n_fast")
+    if n_fast is None:
+        return None  # pre-protocol row: no sample counts to judge by
+    n_slow = row.get("n_slow") or 0
+    if n_fast < min_n_fast:
+        return f"n_fast={n_fast}<{min_n_fast}"
+    if n_slow > n_fast:
+        return f"slow-mode dominated ({n_slow}>{n_fast})"
+    return None
+
+
+def compare_records(
+    old: BenchRecord,
+    new: BenchRecord,
+    threshold: float = 1.5,
+    min_n_fast: int = 2,
+    allow_cross_device: bool = False,
+) -> Dict[str, Any]:
+    """Diff two records row by row; gate regressions past ``threshold``.
+
+    Returns ``{"rows": [...], "regressions": [names], "exit_code": int,
+    "old", "new"}``. Each output row carries ``metric``, ``old_ms``,
+    ``new_ms``, ``ratio`` (new/old), ``norm_ratio`` (row/probe-normalized,
+    when both sides carry the row's chip-state probe), ``verdict`` in
+    ``{"ok", "REGRESSION", "improved", "low-confidence", "probe", "new",
+    "removed"}`` and a ``note``. The gate uses ``norm_ratio`` when
+    available (chip-state invariant), the raw ``ratio`` otherwise.
+    """
+    if (
+        not allow_cross_device
+        and old.device_kind is not None
+        and new.device_kind is not None
+        and old.device_kind != new.device_kind
+    ):
+        raise CompareRefused(
+            f"refusing to compare across device kinds: {old.path} ran on"
+            f" {old.device_kind!r} but {new.path} ran on {new.device_kind!r}."
+            " A latency delta between different hardware measures the hardware,"
+            " not the code — rerun the old sweep on the new device kind, or pass"
+            " --allow-cross-device to override."
+        )
+    out_rows: List[Dict[str, Any]] = []
+    regressions: List[str] = []
+    for name in sorted(set(old.rows) | set(new.rows)):
+        o, n = old.rows.get(name), new.rows.get(name)
+        if o is None or n is None:
+            out_rows.append(
+                {
+                    "metric": name,
+                    "old_ms": None if o is None else _row_value(o),
+                    "new_ms": None if n is None else _row_value(n),
+                    "ratio": None,
+                    "norm_ratio": None,
+                    "verdict": "new" if o is None else "removed",
+                    "note": "",
+                }
+            )
+            continue
+        old_v, new_v = _row_value(o), _row_value(n)
+        ratio = new_v / old_v
+        probe = PROBE_CLASS.get(name)
+        norm_ratio = None
+        if probe and probe in old.rows and probe in new.rows:
+            old_p, new_p = _row_value(old.rows[probe]), _row_value(new.rows[probe])
+            if old_p > 0 and new_p > 0:
+                norm_ratio = (new_v / new_p) / (old_v / old_p)
+        note_parts = []
+        conf = _row_confidence(o, min_n_fast) or _row_confidence(n, min_n_fast)
+        effective = norm_ratio if norm_ratio is not None else ratio
+        if name.startswith("probe_"):
+            verdict = "probe"  # probes RECORD chip state; gating them is meaningless
+        elif conf is not None:
+            verdict = "low-confidence"
+            note_parts.append(conf)
+        elif effective > threshold:
+            verdict = "REGRESSION"
+            regressions.append(name)
+            if norm_ratio is None and probe:
+                note_parts.append("no probe on one side: raw (chip-state-confounded) ratio")
+        elif effective < 1.0 / threshold:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        if norm_ratio is not None:
+            note_parts.append("probe-normalized gate")
+        out_rows.append(
+            {
+                "metric": name,
+                "old_ms": old_v,
+                "new_ms": new_v,
+                "ratio": ratio,
+                "norm_ratio": norm_ratio,
+                "verdict": verdict,
+                "note": "; ".join(note_parts),
+            }
+        )
+    return {
+        "rows": out_rows,
+        "regressions": regressions,
+        "exit_code": EXIT_REGRESSED if regressions else EXIT_OK,
+        "old": old,
+        "new": new,
+        "threshold": threshold,
+    }
+
+
+def _fmt(v: Optional[float], pattern: str = "{:.3f}") -> str:
+    return "—" if v is None else pattern.format(v)
+
+
+def render_report(result: Dict[str, Any]) -> str:
+    """Markdown report: header lines (device/jax/hosts of both records),
+    the per-row delta table, and the gate verdict."""
+    old, new = result["old"], result["new"]
+    lines = [
+        "# Bench comparison",
+        "",
+        f"- old: {old.header()}",
+        f"- new: {new.header()}",
+        f"- gate threshold: {result['threshold']}x"
+        + " (row/probe-normalized where probes exist on both sides)",
+    ]
+    if old.device_kind is None or new.device_kind is None:
+        lines.append(
+            "- WARNING: at least one record carries no device_kind (driver-tail"
+            " capture) — deltas may be confounded by hardware differences."
+        )
+    lines += [
+        "",
+        "| metric | old ms | new ms | Δ× | norm Δ× | verdict | note |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for row in result["rows"]:
+        lines.append(
+            f"| {row['metric']} | {_fmt(row['old_ms'])} | {_fmt(row['new_ms'])} |"
+            f" {_fmt(row['ratio'], '{:.2f}')} | {_fmt(row['norm_ratio'], '{:.2f}')} |"
+            f" {row['verdict']} | {row['note']} |"
+        )
+    lines.append("")
+    if result["regressions"]:
+        lines.append(
+            f"**GATE: FAIL — {len(result['regressions'])} regression(s):"
+            f" {', '.join(result['regressions'])}**"
+        )
+    else:
+        lines.append("GATE: pass")
+    return "\n".join(lines) + "\n"
+
+
+def trend_table(paths: List[str]) -> str:
+    """Markdown metric x round trend table across bench records, in the
+    given order (pass ``BENCH_r*.json`` sorted for the chronology)."""
+    records = [load_record(p) for p in paths]
+    names = sorted({name for rec in records for name in rec.rows})
+    heads = [os.path.basename(p).replace(".json", "") for p in paths]
+    lines = [
+        "# Bench trend (ms; fast-mode median where recorded)",
+        "",
+        "| metric | " + " | ".join(heads) + " |",
+        "|---|" + "---|" * len(heads),
+    ]
+    for name in names:
+        cells = []
+        for rec in records:
+            row = rec.rows.get(name)
+            cells.append("—" if row is None else f"{_row_value(row):.3f}")
+        lines.append(f"| {name} | " + " | ".join(cells) + " |")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("records", nargs="*", help="OLD.json NEW.json (or record list with --trend)")
+    parser.add_argument("--threshold", type=float, default=1.5, help="gate at new/old > this (default 1.5)")
+    parser.add_argument(
+        "--min-n-fast", type=int, default=2,
+        help="rows with fewer fast-mode samples on either side are low-confidence and never gate",
+    )
+    parser.add_argument(
+        "--allow-cross-device", action="store_true",
+        help="compare records from different device kinds anyway (deltas measure the hardware!)",
+    )
+    parser.add_argument(
+        "--trend", action="store_true",
+        help="render the metric x round trend table over the given records instead of gating",
+    )
+    parser.add_argument("--markdown", metavar="PATH", default=None, help="also write the report to PATH")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.trend:
+            paths: List[str] = []
+            for pattern in args.records or [os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "BENCH_r*.json")]:
+                expanded = sorted(glob.glob(pattern))
+                paths.extend(expanded if expanded else [pattern])
+            if not paths:
+                raise CompareRefused("--trend found no bench records")
+            report = trend_table(paths)
+            code = EXIT_OK
+        else:
+            if len(args.records) != 2:
+                parser.error("compare mode needs exactly two records: OLD.json NEW.json")
+            old, new = load_record(args.records[0]), load_record(args.records[1])
+            result = compare_records(
+                old, new,
+                threshold=args.threshold,
+                min_n_fast=args.min_n_fast,
+                allow_cross_device=args.allow_cross_device,
+            )
+            report = render_report(result)
+            code = result["exit_code"]
+    except CompareRefused as err:
+        print(f"REFUSED: {err}", file=sys.stderr)
+        return EXIT_REFUSED
+    print(report, end="")
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(report)
+    return code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

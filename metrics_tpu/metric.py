@@ -27,6 +27,7 @@ scriptability is replaced by the update/compute kernels being jit-traceable.
 """
 import functools
 import inspect
+import time
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -47,6 +48,7 @@ from metrics_tpu.utilities.data import (
 )
 from metrics_tpu.obs.registry import enabled as _obs_enabled
 from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
 from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.obs.tracing import pytree_nbytes as _obs_nbytes
 from metrics_tpu.obs.tracing import trace_span as _obs_span
@@ -448,9 +450,23 @@ class Metric(ABC):
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
         if _obs_enabled():
             _obs_inc("metric.syncs", metric=type(self).__name__)
+            # one straggler probe per LOGICAL sync (per-leaf gathers would
+            # align the hosts on the first barrier and record ~0 after);
+            # internally gated on the OPT-IN arrival_skew_probe knob +
+            # multi-process — the probe is a collective, so it only runs
+            # where the operator armed it fleet-wide
+            from metrics_tpu.utilities.distributed import record_arrival_skew
+
+            record_arrival_skew()
+        _t0 = time.perf_counter()
         with _obs_span(f"{type(self).__name__}.sync", category="sync"):
             self._cache = self._snapshot_state()
             self._sync_dist(dist_sync_fn, process_group=process_group)
+        if _obs_enabled():
+            # whole-metric sync latency (every state's gather) as a
+            # distribution — the per-gather op=gather_all_tensors histogram
+            # in utilities.distributed carries the per-collective view
+            _obs_observe("metric.sync_ms", (time.perf_counter() - _t0) * 1000.0, metric=type(self).__name__)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:

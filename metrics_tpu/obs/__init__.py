@@ -1,6 +1,6 @@
 """``metrics_tpu.obs`` — observability for every metric hot path.
 
-Four pillars, all zero-overhead when disabled (the default; the compiled
+Six pillars, all zero-overhead when disabled (the default; the compiled
 HLO of a jitted step with the layer off is byte-identical to an
 uninstrumented build — pinned by ``tests/bases/test_obs.py``):
 
@@ -24,9 +24,23 @@ uninstrumented build — pinned by ``tests/bases/test_obs.py``):
    the entry point is eager: ``metric.*`` via the eager class API,
    ``epoch.launches``/``epoch.batches_folded`` (counted host-side at the
    ``make_epoch`` entry), ``sync.gathers`` (eager DCN path).
-4. **Export** — :func:`snapshot` (plain dict), :func:`to_prometheus`,
-   :func:`to_json`; ``MetricLogger`` archives a snapshot per epoch and
-   ``bench.py --json`` splits compile from run time per row.
+4. **Performance tier** — :func:`observe` feeds fixed log-spaced
+   **histograms** (p50/p95/p99 via :func:`get_histogram`);
+   ``configure(device_timing=True)`` times tracked launches into
+   ``step.latency_ms{step=}``; ``configure(cost_analysis=True)`` pulls
+   ``Compiled.cost_analysis()`` into FLOPs / bytes-accessed / arithmetic-
+   intensity gauges; :func:`profile` captures an xprof timeline
+   programmatically (see :mod:`metrics_tpu.obs.profile`).
+5. **Health** — :class:`HealthMonitor` classifies the registry into
+   straggler / sync-latency / recompile-storm / clamp-risk /
+   degraded-sync conditions with one-shot warnings
+   (see :mod:`metrics_tpu.obs.health`).
+6. **Export** — :func:`snapshot` (plain dict), :func:`to_prometheus`
+   (counters, gauges, and ``histogram`` families with
+   ``_bucket``/``_sum``/``_count``), :func:`to_json`; ``MetricLogger``
+   archives a snapshot per epoch, ``bench.py --json`` splits compile from
+   run time per row, and ``bench.py --compare OLD.json`` gates new rounds
+   against prior records (``benchmarks/compare.py``).
 
 Quick start::
 
@@ -41,6 +55,8 @@ See ``docs/observability.md`` for the full guide.
 """
 from metrics_tpu.obs import registry as _registry  # noqa: F401
 from metrics_tpu.obs.export import snapshot, to_json, to_prometheus
+from metrics_tpu.obs.health import HealthMonitor
+from metrics_tpu.obs.profile import instrument, profile, record_cost_analysis, time_launch
 from metrics_tpu.obs.recompile import (
     compile_listener_installed,
     install_compile_listener,
@@ -48,6 +64,8 @@ from metrics_tpu.obs.recompile import (
     track_compiles,
 )
 from metrics_tpu.obs.registry import (
+    HISTOGRAM_EDGES,
+    HistogramSnapshot,
     configure,
     counters,
     enable,
@@ -55,7 +73,10 @@ from metrics_tpu.obs.registry import (
     gauges,
     get_counter,
     get_gauge,
+    get_histogram,
+    histograms,
     inc,
+    observe,
     set_gauge,
     spans,
     sum_counter,
@@ -63,6 +84,9 @@ from metrics_tpu.obs.registry import (
 from metrics_tpu.obs.tracing import pytree_nbytes, trace_span
 
 __all__ = [
+    "HISTOGRAM_EDGES",
+    "HealthMonitor",
+    "HistogramSnapshot",
     "compile_listener_installed",
     "configure",
     "counters",
@@ -71,15 +95,22 @@ __all__ = [
     "gauges",
     "get_counter",
     "get_gauge",
+    "get_histogram",
+    "histograms",
     "inc",
     "install_compile_listener",
+    "instrument",
     "note_trace",
+    "observe",
+    "profile",
     "pytree_nbytes",
+    "record_cost_analysis",
     "reset",
     "set_gauge",
     "snapshot",
     "spans",
     "sum_counter",
+    "time_launch",
     "to_json",
     "to_prometheus",
     "trace_span",

@@ -1,42 +1,64 @@
 """Export surface: plain-dict snapshot, Prometheus text, JSON.
 
 ``snapshot()`` is the canonical read: a plain nested dict (counters,
-gauges, spans, config, enabled flag) safe to log, diff between epochs
-(:class:`~metrics_tpu.integrations.MetricLogger` archives one per epoch
-when the layer is enabled), or attach to bench rows. The two dumpers
+gauges, histograms, spans, config, enabled flag) safe to log, diff between
+epochs (:class:`~metrics_tpu.integrations.MetricLogger` archives one per
+epoch when the layer is enabled), or attach to bench rows. The two dumpers
 re-serialize a snapshot without touching live registry state, so exporters
 can run on a snapshot taken at a consistent instant.
 
 Prometheus naming: series ``a.b.c{x=y}`` becomes
 ``metrics_tpu_a_b_c{x="y"}`` — dots to underscores, every label value
-quoted, one ``# TYPE`` line per family (counters ``counter``, gauges
-``gauge``). Spans are not exported to Prometheus (they are per-event, not
-a series); they ride the JSON dump.
+quoted with backslash/quote/newline escaped per the text exposition
+format, one ``# TYPE`` line per family (counters ``counter``, gauges
+``gauge``, histograms ``histogram``). Histogram series expand into the
+standard ``_bucket{le=...}`` cumulative counts (with a ``+Inf`` bucket),
+``_sum`` and ``_count``. Spans are not exported to Prometheus (they are
+per-event, not a series); they ride the JSON dump.
+
+Label splitting honours the registry's quoting: a label value that
+contains key syntax is stored quoted-and-escaped in the flat key
+(:func:`metrics_tpu.obs.registry._fmt_label_value`), so the splitter here
+breaks on commas only OUTSIDE quoted values and unescapes before
+re-escaping for exposition — hostile values round-trip instead of
+corrupting neighbouring labels.
 """
 import json
 import re
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.obs import registry as _reg
 
 __all__ = ["snapshot", "to_json", "to_prometheus"]
 
-_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+_KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$", re.DOTALL)
+_NAME_SAFE = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def snapshot(spans: bool = True) -> Dict[str, Any]:
     """Everything the obs layer knows, as one plain dict.
 
-    ``spans=False`` omits the span ring (counters/gauges only, plus the
-    ring's current length under ``span_count``) — the right shape for
-    per-epoch archiving, where copying the full up-to-``max_spans`` ring
-    every epoch would duplicate mostly-identical entries across snapshots.
+    ``spans=False`` omits the span ring (counters/gauges/histograms only,
+    plus the ring's current length under ``span_count``) — the right shape
+    for per-epoch archiving, where copying the full up-to-``max_spans``
+    ring every epoch would duplicate mostly-identical entries across
+    snapshots.
     """
     out = {
         "enabled": _reg.enabled(),
         "counters": _reg.counters(),
         "gauges": _reg.gauges(),
-        "config": {k: _reg.get_config(k) for k in ("recompile_warn_threshold", "max_spans")},
+        "histograms": _reg.histograms(),
+        "config": {
+            k: _reg.get_config(k)
+            for k in (
+                "recompile_warn_threshold",
+                "max_spans",
+                "device_timing",
+                "cost_analysis",
+                "arrival_skew_probe",
+            )
+        },
     }
     if spans:
         out["spans"] = _reg.spans()
@@ -45,17 +67,88 @@ def snapshot(spans: bool = True) -> Dict[str, Any]:
     return out
 
 
-def _prom_series(key: str, value: float, out: list) -> None:
+def _parse_labels(labels: str) -> List[Tuple[str, str]]:
+    """Split a flat-key label blob into (name, raw value) pairs.
+
+    Values quoted by the registry (``k="a,b\\"c"``) are unescaped; bare
+    values are taken verbatim up to the next comma. Commas inside quotes
+    never split.
+    """
+    pairs: List[Tuple[str, str]] = []
+    i, n = 0, len(labels)
+    while i < n:
+        eq = labels.find("=", i)
+        if eq < 0:  # trailing junk without '='; keep it as a valueless label
+            pairs.append((labels[i:], ""))
+            break
+        key = labels[i:eq]
+        i = eq + 1
+        if i < n and labels[i] == '"':
+            i += 1
+            buf: List[str] = []
+            while i < n:
+                ch = labels[i]
+                if ch == "\\" and i + 1 < n:
+                    nxt = labels[i + 1]
+                    buf.append("\n" if nxt == "n" else nxt)
+                    i += 2
+                    continue
+                if ch == '"':
+                    i += 1
+                    break
+                buf.append(ch)
+                i += 1
+            value = "".join(buf)
+        else:
+            end = labels.find(",", i)
+            end = n if end < 0 else end
+            value = labels[i:end]
+            i = end
+        if i < n and labels[i] == ",":
+            i += 1
+        pairs.append((key, value))
+    return pairs
+
+
+# exposition escaping == the registry's key escaping by construction: one
+# shared implementation, so the quoted-label round trip can never drift
+_escape_label_value = _reg._escape_label_value
+
+
+def _prom_parts(key: str) -> Tuple[str, List[Tuple[str, str]]]:
+    """Flat registry key -> (sanitized metric name, parsed label pairs)."""
     m = _KEY_RE.match(key)
-    name = "metrics_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", (m.group("name") if m else key))
-    labels = (m.group("labels") or "") if m else ""
-    if labels:
-        pairs = []
-        for part in labels.split(","):
-            k, _, v = part.partition("=")
-            pairs.append(f'{re.sub(r"[^a-zA-Z0-9_]", "_", k)}="{v}"')
-        name = f"{name}{{{','.join(pairs)}}}"
-    out.append(f"{name} {value:g}")
+    raw_name = m.group("name") if m else key
+    name = "metrics_tpu_" + _NAME_SAFE.sub("_", raw_name)
+    labels = _parse_labels(m.group("labels") or "") if m else []
+    return name, labels
+
+
+def _fmt_labels(pairs: List[Tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{_NAME_SAFE.sub("_", k)}="{_escape_label_value(v)}"' for k, v in pairs)
+    return f"{{{inner}}}"
+
+
+def _prom_series(key: str, value: float, out: list) -> None:
+    name, pairs = _prom_parts(key)
+    out.append(f"{name}{_fmt_labels(pairs)} {value:g}")
+
+
+def _prom_histogram(key: str, hist: Dict[str, Any], out: list) -> None:
+    """One histogram series -> ``_bucket``/``_sum``/``_count`` lines with
+    cumulative counts and the mandatory ``+Inf`` bucket."""
+    name, pairs = _prom_parts(key)
+    edges = hist.get("edges") or list(_reg.HISTOGRAM_EDGES)
+    buckets = hist.get("buckets") or []
+    cum = 0
+    for edge, count in zip(edges, buckets):
+        cum += count
+        out.append(f'{name}_bucket{_fmt_labels(pairs + [("le", f"{edge:g}")])} {cum}')
+    out.append(f'{name}_bucket{_fmt_labels(pairs + [("le", "+Inf")])} {hist.get("count", cum)}')
+    out.append(f"{name}_sum{_fmt_labels(pairs)} {hist.get('sum', 0.0):g}")
+    out.append(f"{name}_count{_fmt_labels(pairs)} {hist.get('count', cum)}")
 
 
 def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
@@ -65,12 +158,17 @@ def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     typed: set = set()
     for kind, family in (("counter", "counters"), ("gauge", "gauges")):
         for key in sorted(snap.get(family, {})):
-            m = _KEY_RE.match(key)
-            base = "metrics_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", (m.group("name") if m else key))
+            base, _ = _prom_parts(key)
             if base not in typed:
                 typed.add(base)
                 lines.append(f"# TYPE {base} {kind}")
             _prom_series(key, snap[family][key], lines)
+    for key in sorted(snap.get("histograms", {})):
+        base, _ = _prom_parts(key)
+        if base not in typed:
+            typed.add(base)
+            lines.append(f"# TYPE {base} histogram")
+        _prom_histogram(key, snap["histograms"][key], lines)
     return "\n".join(lines) + ("\n" if lines else "")
 
 

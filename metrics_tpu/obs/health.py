@@ -1,0 +1,215 @@
+"""Fleet-health monitoring over the obs registry: stragglers, storms, clamps.
+
+The registry already collects the raw signals — sync latency histograms
+and the arrival-skew gauge from :mod:`metrics_tpu.utilities.distributed`,
+per-step trace counters from :mod:`metrics_tpu.obs.recompile`, buffer
+clamp-risk counters, and the fault-tolerance subsystem's degraded-sync
+counts. :class:`HealthMonitor` turns them into verdicts: call
+:meth:`~HealthMonitor.check` periodically (per epoch is the natural
+cadence) and it classifies the current window into named conditions,
+raises a one-shot ``rank_zero_warn`` per condition kind, and counts
+``health.checks{monitor=}`` / ``health.alerts{monitor=,kind=}`` so the
+alert history rides the same :func:`metrics_tpu.obs.snapshot` as the
+metrics it protects — the :class:`~metrics_tpu.streaming.DriftMonitor`
+pattern, applied to the fleet instead of the data distribution.
+
+Conditions (each independently armable):
+
+* ``straggler`` — the ``sync.arrival_skew_ms`` gauge (this host's wait in
+  the pre-gather barrier — its lead over the slowest peer) exceeds
+  ``skew_threshold_ms``.
+* ``sync_latency`` — p95 of the ``sync.latency_ms{op=gather_all_tensors}``
+  histogram exceeds ``sync_p95_ms``.
+* ``recompile_storm`` — some step's ``step.traces{step=}`` counter reached
+  ``recompile_threshold`` (default: the registry's
+  ``recompile_warn_threshold``); catches drift on steps whose own one-shot
+  warning already fired and was lost in logs.
+* ``clamp_risk`` — ``capacity_buffer.clamp_risk_appends`` or
+  ``capacity_buffer.eager_overflows`` is nonzero: some buffer-backed
+  metric may be silently truncating samples.
+* ``degraded_sync`` — any ``ft.degraded_syncs`` series fired: some host
+  computed over local-only state and cross-host values are no longer
+  comparable.
+"""
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["HealthMonitor"]
+
+
+class HealthMonitor:
+    """One-shot-warning health checks over the live obs registry.
+
+    Args:
+        skew_threshold_ms: arm the ``straggler`` condition at this
+            cross-host arrival skew (``None`` disarms).
+        sync_p95_ms: arm ``sync_latency`` when the eager DCN gather's p95
+            exceeds this many milliseconds (``None`` disarms).
+        recompile_threshold: arm ``recompile_storm`` at this many tracings
+            of one step; ``None`` uses the registry's
+            ``recompile_warn_threshold`` at check time.
+        clamp_risk: arm the buffer ``clamp_risk`` condition.
+        degraded_syncs: arm the ``degraded_sync`` condition.
+        name: label on the ``health.*`` counter series.
+        warn: emit a one-shot ``rank_zero_warn`` per condition kind.
+
+    Example:
+        >>> from metrics_tpu.obs.health import HealthMonitor
+        >>> report = HealthMonitor(warn=False).check()
+        >>> report["healthy"]
+        True
+    """
+
+    def __init__(
+        self,
+        skew_threshold_ms: Optional[float] = 1000.0,
+        sync_p95_ms: Optional[float] = None,
+        recompile_threshold: Optional[int] = None,
+        clamp_risk: bool = True,
+        degraded_syncs: bool = True,
+        name: str = "default",
+        warn: bool = True,
+    ) -> None:
+        self.skew_threshold_ms = skew_threshold_ms
+        self.sync_p95_ms = sync_p95_ms
+        self.recompile_threshold = recompile_threshold
+        self.clamp_risk = bool(clamp_risk)
+        self.degraded_syncs = bool(degraded_syncs)
+        self.name = str(name)
+        self.warn = bool(warn)
+        self._warned_kinds: set = set()
+
+    # ------------------------------------------------------------------
+    # individual condition probes (each returns a detail string or None)
+    # ------------------------------------------------------------------
+
+    def _check_straggler(self) -> Optional[str]:
+        if self.skew_threshold_ms is None:
+            return None
+        skew = _reg.get_gauge("sync.arrival_skew_ms")
+        if skew is not None and skew > self.skew_threshold_ms:
+            return (
+                f"cross-host arrival skew {skew:.0f} ms > {self.skew_threshold_ms:.0f} ms —"
+                " this host reaches sync points far ahead of the slowest peer"
+            )
+        return None
+
+    def _check_sync_latency(self) -> Optional[str]:
+        if self.sync_p95_ms is None:
+            return None
+        hist = _reg.get_histogram("sync.latency_ms", op="gather_all_tensors")
+        if hist is not None and hist.count and hist.p95 > self.sync_p95_ms:
+            return (
+                f"eager DCN gather p95 {hist.p95:.0f} ms > {self.sync_p95_ms:.0f} ms"
+                f" over {hist.count} gathers"
+            )
+        return None
+
+    def _check_recompile_storm(self) -> Optional[str]:
+        threshold = self.recompile_threshold
+        if threshold is None:
+            threshold = _reg.get_config("recompile_warn_threshold")
+        if not threshold:
+            return None
+        prefix = "step.traces{"
+        storming = {
+            key[len(prefix):-1]: int(count)
+            for key, count in _reg.counters().items()
+            if key.startswith(prefix) and count >= threshold
+        }
+        if storming:
+            worst = max(storming, key=storming.get)
+            return (
+                f"{len(storming)} step(s) at/over {threshold} tracings"
+                f" (worst: {worst} x{storming[worst]}) — shape/dtype drift recompiles"
+                " a new program per signature"
+            )
+        return None
+
+    def _check_clamp_risk(self) -> Optional[str]:
+        if not self.clamp_risk:
+            return None
+        clamps = _reg.get_counter("capacity_buffer.clamp_risk_appends")
+        overflows = _reg.get_counter("capacity_buffer.eager_overflows")
+        if clamps or overflows:
+            return (
+                f"capacity-buffer overflow pressure: {int(clamps)} clamp-risk traced"
+                f" append(s), {int(overflows)} eager overflow(s) — buffer-backed"
+                " metrics may be truncating samples; raise sample_capacity or switch"
+                " to a sketch-backed streaming metric"
+            )
+        return None
+
+    def _check_degraded_sync(self) -> Optional[str]:
+        if not self.degraded_syncs:
+            return None
+        degraded = _reg.sum_counter("ft.degraded_syncs")
+        if degraded:
+            return (
+                f"{int(degraded)} degraded sync(s): some host fell back to local-only"
+                " state after exhausting DCN retries — cross-host metric values are"
+                " not comparable for those windows"
+            )
+        return None
+
+    # ------------------------------------------------------------------
+
+    def check(self) -> Dict[str, Any]:
+        """Run every armed condition against the current registry state.
+
+        Returns ``{"healthy": bool, "warnings": [{"kind", "detail"}, ...]}``.
+        Bumps ``health.checks{monitor=}`` per call and
+        ``health.alerts{monitor=,kind=}`` per firing condition; the first
+        firing of each kind also emits one ``rank_zero_warn`` (later
+        firings only count — re-arm with :meth:`reset_warnings`).
+        """
+        probes = (
+            ("straggler", self._check_straggler),
+            ("sync_latency", self._check_sync_latency),
+            ("recompile_storm", self._check_recompile_storm),
+            ("clamp_risk", self._check_clamp_risk),
+            ("degraded_sync", self._check_degraded_sync),
+        )
+        warnings: List[Dict[str, str]] = []
+        for kind, probe in probes:
+            detail = probe()
+            if detail is not None:
+                warnings.append({"kind": kind, "detail": detail})
+        if _reg.enabled():
+            _reg.inc("health.checks", monitor=self.name)
+            for w in warnings:
+                _reg.inc("health.alerts", monitor=self.name, kind=w["kind"])
+        if self.warn:
+            for w in warnings:
+                if w["kind"] in self._warned_kinds:
+                    continue
+                self._warned_kinds.add(w["kind"])
+                from metrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"HealthMonitor {self.name!r} [{w['kind']}]: {w['detail']}. Further"
+                    " alerts of this kind are counted under health.alerts{monitor="
+                    + self.name
+                    + "} without warning again.",
+                    UserWarning,
+                )
+        return {"healthy": not warnings, "warnings": warnings}
+
+    def reset_warnings(self) -> None:
+        """Re-arm the one-shot warning for every condition kind."""
+        self._warned_kinds.clear()
+
+    def __repr__(self) -> str:
+        armed = {
+            k: v
+            for k, v in (
+                ("skew_threshold_ms", self.skew_threshold_ms),
+                ("sync_p95_ms", self.sync_p95_ms),
+                ("recompile_threshold", self.recompile_threshold),
+                ("clamp_risk", self.clamp_risk or None),
+                ("degraded_syncs", self.degraded_syncs or None),
+            )
+            if v is not None
+        }
+        return f"HealthMonitor(name={self.name!r}, {armed})"

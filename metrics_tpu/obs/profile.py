@@ -1,0 +1,173 @@
+"""Programmatic profiling, per-launch device timing, cost-analysis gauges.
+
+Three answers to "how fast did it run, and why" (the performance tier on
+top of the counter/span registry):
+
+* :func:`profile` — programmatic xprof capture around a code block via
+  ``jax.profiler.trace``: the TPU timeline lands in a TensorBoard-readable
+  log dir, with every op grouped under the ``jax.named_scope`` names the
+  tracing layer stamps (enable the obs layer BEFORE building steps so the
+  scopes are in the traced programs).
+* **device timing** (``obs.configure(device_timing=True)``) — every
+  tracked launch (the jitted ``make_epoch`` / ``make_stream_step``
+  callables, eager ``make_step`` step/compute calls, eager pallas kernel
+  dispatches) is followed by ``jax.block_until_ready`` and the wall delta
+  lands in the ``step.latency_ms{step=...}`` histogram — real device-time
+  distributions (p50/p95/p99) instead of dispatch-only wall clock.
+  Opt-in because the block is a host sync: it serializes launches that an
+  async dispatch queue would overlap.
+* **cost analysis** (``obs.configure(cost_analysis=True)``) — each compile
+  of a tracked step pulls ``Compiled.cost_analysis()`` for the lowered
+  program into gauges: ``step.flops{step=}``, ``step.bytes_accessed{step=}``
+  and their ratio ``step.arithmetic_intensity{step=}`` (FLOPs/byte — the
+  roofline x-coordinate). Attribution is per lowered signature, refreshed
+  on every retrace, so shape drift shows up as moving gauges next to the
+  ``step.traces`` counter it also bumps.
+
+All three are inert unless the registry is enabled; the two config modes
+additionally default off so merely enabling the layer never adds host
+syncs or AOT compiles.
+"""
+import functools
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterator, Optional
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["instrument", "profile", "record_cost_analysis", "time_launch"]
+
+
+@contextmanager
+def profile(logdir: str, create_perfetto_link: bool = False) -> Iterator[str]:
+    """Capture an xprof/TensorBoard profile of the enclosed block.
+
+    Thin, obs-integrated wrapper over ``jax.profiler.trace``: the capture
+    always runs (profiling is its own opt-in — like
+    :func:`~metrics_tpu.obs.install_compile_listener`, calling it IS the
+    consent), and when the obs layer is enabled the capture is also counted
+    under ``profile.captures`` with its wall time in the
+    ``profile.capture_ms`` histogram.
+
+    Args:
+        logdir: directory for the trace files (``tensorboard --logdir`` /
+            xprof reads it; one timestamped subdir per capture).
+        create_perfetto_link: forward to ``jax.profiler.trace`` — prints a
+            Perfetto UI link for the captured trace (blocks until visited).
+
+    Example::
+
+        with obs.profile("/tmp/prof"):
+            state, _ = epoch(state, preds, target)
+    """
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.trace(logdir, create_perfetto_link=create_perfetto_link):
+        yield logdir
+    if _reg.enabled():
+        _reg.inc("profile.captures")
+        _reg.observe("profile.capture_ms", (time.perf_counter() - t0) * 1000.0)
+
+
+def _timing_armed() -> bool:
+    return _reg.enabled() and bool(_reg.get_config("device_timing"))
+
+
+def time_launch(fn: Callable, step: str) -> Callable:
+    """Wrap an EAGER-callable so device timing records its launch latency.
+
+    When ``device_timing`` is armed and the call happens outside any trace,
+    the wrapper blocks on the outputs and records the wall delta into
+    ``step.latency_ms{step=...}``. Under a trace it is pass-through (Python
+    runs at trace time only — blocking on tracers is impossible and the
+    wrapper must add zero operations to compiled programs), and with the
+    mode off it costs one predicate per call. For a callable YOU jitted,
+    wrap the jitted object with :func:`instrument` instead, so the compile
+    launches are split out of the latency distribution.
+    """
+    from metrics_tpu.obs.recompile import _in_trace_context
+
+    @functools.wraps(fn)
+    def timed(*args: Any, **kwargs: Any) -> Any:
+        if not _timing_armed() or _in_trace_context():
+            return fn(*args, **kwargs)
+        import jax
+
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        _reg.observe("step.latency_ms", (time.perf_counter() - t0) * 1000.0, step=step)
+        return out
+
+    return timed
+
+
+def instrument(fn: Callable, step: str) -> Callable:
+    """Arm a JITTED callable with the full tracked-launch telemetry.
+
+    The same wrapper ``make_epoch`` / ``make_stream_step`` apply to their
+    internal jits, for steps you jit yourself::
+
+        init, step_fn, compute = make_step(Accuracy, num_classes=10)
+        jstep = obs.instrument(jax.jit(step_fn, donate_argnums=0), "Accuracy.step")
+
+    Per call this splits wall time into compile vs run
+    (``compiles``/``runs``/``compile_seconds``/``run_seconds{step=}``);
+    with ``device_timing`` armed, cache-hit launches block on their outputs
+    and land in the ``step.latency_ms{step=}`` histogram (compile launches
+    are excluded — their wall time is dominated by compilation and already
+    attributed to ``compile_seconds``); with ``cost_analysis`` armed, each
+    compile records the lowered program's FLOPs/bytes gauges.
+    """
+    from metrics_tpu.obs.recompile import track_compiles
+
+    return track_compiles(fn, step)
+
+
+def _as_spec(leaf: Any) -> Any:
+    import jax
+
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+    return leaf
+
+
+def record_cost_analysis(fn: Callable, args: tuple, kwargs: dict, step: str) -> bool:
+    """Pull ``Compiled.cost_analysis()`` for ``fn(*args, **kwargs)`` into
+    per-step gauges; returns True when the gauges were written.
+
+    ``fn`` must be a jitted callable. The call signature is abstracted to
+    ``ShapeDtypeStruct`` leaves first, so AOT lowering never touches the
+    actual buffers — donated arguments may already be consumed by the call
+    that triggered the attribution (only their metadata is read). The AOT
+    retrace runs with :func:`~metrics_tpu.obs.recompile.note_trace`
+    suppressed so attribution can never inflate ``step.traces`` or trip the
+    storm warning. Failures (backends without cost analysis, non-jit
+    callables) count under ``profile.cost_analysis_failures{step=}`` and
+    never raise.
+    """
+    import jax
+
+    from metrics_tpu.obs import recompile as _recompile
+
+    try:
+        spec_args, spec_kwargs = jax.tree_util.tree_map(_as_spec, (tuple(args), dict(kwargs)))
+        with _recompile.suppress_note_trace():
+            cost = fn.lower(*spec_args, **spec_kwargs).compile().cost_analysis()
+    except Exception:  # noqa: BLE001 — telemetry must never break the step
+        _reg.inc("profile.cost_analysis_failures", step=step)
+        return False
+    # jax returns one properties dict per computation (list on older
+    # releases, bare dict on newer); the entry point is always first
+    entry = cost[0] if isinstance(cost, (list, tuple)) and cost else cost
+    if not isinstance(entry, dict):
+        _reg.inc("profile.cost_analysis_failures", step=step)
+        return False
+    flops = float(entry.get("flops", 0.0) or 0.0)
+    nbytes = float(entry.get("bytes accessed", 0.0) or 0.0)
+    _reg.set_gauge("step.flops", flops, step=step)
+    _reg.set_gauge("step.bytes_accessed", nbytes, step=step)
+    if nbytes > 0.0:
+        _reg.set_gauge("step.arithmetic_intensity", flops / nbytes, step=step)
+    return True

@@ -26,12 +26,20 @@ All three are inert unless the registry is enabled; ``note_trace`` in a
 traced body adds zero operations to the program (a Python-level counter
 bump at trace time only).
 """
+import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Optional
 
 from metrics_tpu.obs import registry as _reg
 
-__all__ = ["compile_listener_installed", "install_compile_listener", "note_trace", "track_compiles"]
+__all__ = [
+    "compile_listener_installed",
+    "install_compile_listener",
+    "note_trace",
+    "suppress_note_trace",
+    "track_compiles",
+]
 
 _warned_steps: set = set()
 # per-factory trace counts for the storm heuristic: the PUBLIC step.traces
@@ -85,6 +93,25 @@ def _in_trace_context() -> bool:
     return _trace_probe()
 
 
+# thread-local suppression flag: cost-analysis attribution re-traces the
+# step via AOT lower(), and that bookkeeping trace must not count as a real
+# (re)tracing or advance the storm threshold
+_tls = threading.local()
+
+
+@contextmanager
+def suppress_note_trace():
+    """Silence :func:`note_trace` on this thread for the enclosed block
+    (used by :func:`metrics_tpu.obs.profile.record_cost_analysis` around
+    its AOT lower+compile, whose retrace is attribution, not drift)."""
+    prev = getattr(_tls, "suppressed", False)
+    _tls.suppressed = True
+    try:
+        yield
+    finally:
+        _tls.suppressed = prev
+
+
 def note_trace(step: str, token: Optional[object] = None) -> None:
     """Record one execution of a step function body under the given name.
 
@@ -94,7 +121,7 @@ def note_trace(step: str, token: Optional[object] = None) -> None:
     ``step.traces`` counter aggregates by label across factories, but the
     storm threshold must only see retraces of the same step).
     """
-    if not _reg.enabled():
+    if not _reg.enabled() or getattr(_tls, "suppressed", False):
         return
     if not _in_trace_context():
         _reg.inc("step.eager_calls", step=step)
@@ -134,6 +161,15 @@ def track_compiles(fn: Callable, step: str) -> Callable:
     ``compile_seconds{step=...}`` / ``compiles{step=...}``; a cache-hit call
     lands in ``run_seconds{step=...}`` / ``runs{step=...}``. Disabled mode
     short-circuits to the raw callable (one predicate per call).
+
+    Two opt-in modes extend the split (see :mod:`metrics_tpu.obs.profile`):
+    with ``obs.configure(device_timing=True)`` every cache-hit launch
+    blocks on its outputs and the wall delta lands in the
+    ``step.latency_ms{step=...}`` histogram (compile launches are excluded
+    — their wall time is compilation, already in ``compile_seconds``);
+    with ``obs.configure(cost_analysis=True)`` every compile-paying call
+    records the lowered program's FLOPs / bytes-accessed / arithmetic-
+    intensity gauges for this step.
     """
     import functools
 
@@ -141,16 +177,30 @@ def track_compiles(fn: Callable, step: str) -> Callable:
     def wrapped(*args: Any, **kwargs: Any) -> Any:
         if not _reg.enabled():
             return fn(*args, **kwargs)
+        device_timing = bool(_reg.get_config("device_timing"))
         before = _reg.get_counter("step.traces", step=step)
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
+        compiled_now = _reg.get_counter("step.traces", step=step) > before
+        if device_timing and not compiled_now:
+            import jax
+
+            jax.block_until_ready(out)
         dt = time.perf_counter() - t0
-        if _reg.get_counter("step.traces", step=step) > before:
+        if compiled_now:
             _reg.inc("compile_seconds", dt, step=step)
             _reg.inc("compiles", step=step)
+            if _reg.get_config("cost_analysis"):
+                from metrics_tpu.obs.profile import record_cost_analysis
+
+                # args are only read as shape/dtype metadata, so donated
+                # (already-consumed) buffers are safe to pass
+                record_cost_analysis(fn, args, kwargs, step)
         else:
             _reg.inc("run_seconds", dt, step=step)
             _reg.inc("runs", step=step)
+            if device_timing:
+                _reg.observe("step.latency_ms", dt * 1000.0, step=step)
         return out
 
     return wrapped

@@ -1,12 +1,20 @@
-"""Process-wide observability registry: counters, gauges, span log, config.
+"""Process-wide observability registry: counters, gauges, histograms, spans.
 
-One flat registry per process, guarded by a lock, holding three kinds of
+One flat registry per process, guarded by a lock, holding four kinds of
 runtime telemetry (SURVEY §1's blind spot — the reference has no equivalent):
 
 * **counters** — monotonically increasing event counts (updates applied,
   collectives emitted, tracings per jitted step, buffer clamp risks).
 * **gauges** — last-written values (per-metric state bytes, batches folded
   into the latest fused-epoch program).
+* **histograms** — latency distributions over fixed log-spaced bins
+  (:data:`HISTOGRAM_EDGES`: 6 buckets per decade, 1 µs – 100 s in ms), all
+  host-side and jit-free: :func:`observe` is a bisect + three dict writes,
+  and because every histogram shares the same static edges, snapshots from
+  different processes/rounds compare and merge bucketwise.
+  :func:`get_histogram` hands back a :class:`HistogramSnapshot` with
+  ``p50``/``p95``/``p99`` accessors and arbitrary :meth:`~HistogramSnapshot.percentile`
+  queries (bucket-interpolated, clamped to the observed min/max).
 * **spans** — host-side wall-clock records of eager lifecycle phases
   (name, nesting depth, milliseconds), capped at ``max_spans`` so an
   unbounded training loop cannot leak memory; overflow is itself counted
@@ -14,9 +22,11 @@ runtime telemetry (SURVEY §1's blind spot — the reference has no equivalent):
 
 Keys are ``name{label=value,...}`` with labels sorted, so the same logical
 series always lands on one key and the Prometheus dumper
-(:mod:`metrics_tpu.obs.export`) can re-split them mechanically;
-:func:`sum_counter` totals a family across its label values (e.g. every
-``op=`` series of ``ft.degraded_syncs``).
+(:mod:`metrics_tpu.obs.export`) can re-split them mechanically; a label
+value containing key syntax (``, = { } " \\`` or a newline) is stored
+quoted with backslash escapes, so hostile values survive the round trip
+instead of being mangled. :func:`sum_counter` totals a family across its
+label values (e.g. every ``op=`` series of ``ft.degraded_syncs``).
 
 The fault-tolerance subsystem (:mod:`metrics_tpu.ft`) reports through this
 registry: ``ft.retries{op=}`` / ``ft.degraded_syncs{op=}`` from the DCN
@@ -31,14 +41,18 @@ mode adds nothing to compiled programs (the HLO-identity test in
 ``tests/bases/test_obs.py`` pins this) and only a predicate call to eager
 paths. Enable with :func:`enable` or ``METRICS_TPU_OBS=1``.
 """
+import math
 import os
 import re
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 __all__ = [
+    "HISTOGRAM_EDGES",
+    "HistogramSnapshot",
     "configure",
     "counters",
     "enable",
@@ -47,7 +61,10 @@ __all__ = [
     "get_config",
     "get_counter",
     "get_gauge",
+    "get_histogram",
+    "histograms",
     "inc",
+    "observe",
     "record_span",
     "reset",
     "set_gauge",
@@ -60,6 +77,8 @@ _ENABLED = os.environ.get("METRICS_TPU_OBS", "").strip().lower() not in ("", "0"
 
 _counters: Dict[str, float] = {}
 _gauges: Dict[str, float] = {}
+# histogram series: key -> {"counts": per-bucket, "sum", "count", "min", "max"}
+_histograms: Dict[str, Dict[str, Any]] = {}
 # ring buffer: a full log drops the OLDEST span so the window always shows
 # the most recent activity (a keep-oldest cap would freeze the log on
 # run-start warmup forever); evictions are counted under obs.spans_dropped
@@ -71,6 +90,23 @@ _config: Dict[str, Any] = {
     "recompile_warn_threshold": 8,
     # host-side span ring size; evictions increment obs.spans_dropped
     "max_spans": 4096,
+    # opt-in per-launch device timing: tracked/eager step launches
+    # block_until_ready and land in step.latency_ms{step=} histograms
+    # (adds one host sync per launch — see metrics_tpu.obs.profile)
+    "device_timing": False,
+    # opt-in cost-analysis attribution: every compile of a tracked step
+    # pulls Compiled.cost_analysis() into step.flops / step.bytes_accessed
+    # / step.arithmetic_intensity gauges (one AOT lower+compile per new
+    # signature — see metrics_tpu.obs.profile.record_cost_analysis)
+    "cost_analysis": False,
+    # opt-in: each multi-process Metric.sync runs one tiny barrier
+    # collective first and records the wait as the sync.arrival_skew_ms
+    # gauge (this host's lead over the slowest peer;
+    # utilities.distributed.record_arrival_skew). Default OFF because the
+    # probe is a COLLECTIVE: it must be armed identically on every
+    # process, and an ad-hoc obs.enable() on one host must never be able
+    # to deadlock the fleet's next sync.
+    "arrival_skew_probe": False,
 }
 
 # thread-local nesting depth for the span recorder
@@ -92,18 +128,29 @@ def enabled() -> bool:
 
 
 def configure(**kwargs: Any) -> Dict[str, Any]:
-    """Update config knobs (``recompile_warn_threshold``, ``max_spans``);
-    returns the previous values of the keys that changed."""
+    """Update config knobs (``recompile_warn_threshold``, ``max_spans``,
+    ``device_timing``, ``cost_analysis``, ``arrival_skew_probe``); returns
+    the previous values of the keys that changed."""
     global _spans
     previous = {}
     with _lock:
         for key, value in kwargs.items():
             if key not in _config:
                 raise ValueError(f"Unknown obs config key {key!r}; valid: {sorted(_config)}")
+            if key == "max_spans":
+                value = int(value)
+                if value < 1:
+                    raise ValueError(f"max_spans must be >= 1, got {value}")
             previous[key] = _config[key]
             _config[key] = value
             if key == "max_spans":
-                _spans = deque(_spans, maxlen=int(value))
+                # live resize: deque(iterable, maxlen) keeps the LAST items,
+                # so a shrink preserves the newest spans — and the entries it
+                # evicts are dropped spans like any ring overflow, counted
+                evicted = len(_spans) - value
+                if evicted > 0:
+                    _counters["obs.spans_dropped"] = _counters.get("obs.spans_dropped", 0.0) + evicted
+                _spans = deque(_spans, maxlen=value)
     return previous
 
 
@@ -114,13 +161,35 @@ def get_config(key: str) -> Any:
 _LABEL_UNSAFE = re.compile(r'[,={}"\\\n]')
 
 
+def _escape_label_value(value: str) -> str:
+    """Backslash-escape a label value: ``\\`` then ``"`` then newline (in
+    that order so escapes are never double-escaped). ONE implementation,
+    shared by the key quoting below and the Prometheus exposition dumper
+    (:mod:`metrics_tpu.obs.export`) — the quoted-label round trip depends
+    on both sides agreeing byte for byte."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_label_value(value: Any) -> str:
+    """Render one label value into the flat series key.
+
+    Plain values go in bare (``metric=Accuracy``) so existing keys stay
+    stable; a value containing key syntax (``, = { } " \\`` or a newline)
+    is stored QUOTED with backslash escapes — the Prometheus dumper
+    (:func:`metrics_tpu.obs.export._parse_labels`) splits on commas only
+    outside quotes and unescapes, so hostile values survive verbatim
+    instead of being flattened to underscores.
+    """
+    s = str(value)
+    if not _LABEL_UNSAFE.search(s):
+        return s
+    return f'"{_escape_label_value(s)}"'
+
+
 def _key(name: str, labels: Dict[str, Any]) -> str:
     if not labels:
         return name
-    # label values are sanitized into the flat series key: ',' '=' '{' '}'
-    # quotes/backslashes/newlines would make the key un-splittable for the
-    # Prometheus dumper (and produce scrape-breaking exposition text)
-    inner = ",".join(f"{k}={_LABEL_UNSAFE.sub('_', str(labels[k]))}" for k in sorted(labels))
+    inner = ",".join(f"{k}={_fmt_label_value(labels[k])}" for k in sorted(labels))
     return f"{name}{{{inner}}}"
 
 
@@ -145,6 +214,140 @@ def get_counter(name: str, **labels: Any) -> float:
 def get_gauge(name: str, **labels: Any) -> Optional[float]:
     with _lock:
         return _gauges.get(_key(name, labels))
+
+
+# Fixed log-spaced bucket upper bounds (ms): 6 buckets per decade over
+# 1 µs .. 100 s, plus an implicit +Inf overflow bucket. Shared by EVERY
+# histogram so snapshots from different steps/hosts/rounds line up
+# bucketwise; the ~47% bucket width bounds any percentile's relative error
+# by the same factor, which is plenty to flag a 2x latency regression.
+HISTOGRAM_EDGES: Tuple[float, ...] = tuple(10.0 ** (i / 6.0 - 3.0) for i in range(49))
+
+
+class HistogramSnapshot:
+    """Read-only view of one histogram series (see :func:`get_histogram`).
+
+    ``counts`` has ``len(HISTOGRAM_EDGES) + 1`` per-bucket (non-cumulative)
+    entries, the last being the +Inf overflow bucket. ``p50``/``p95``/``p99``
+    and :meth:`percentile` interpolate linearly inside the hit bucket and
+    clamp to the observed ``[min, max]``, so a single-valued series reports
+    that exact value at every quantile.
+    """
+
+    __slots__ = ("counts", "sum", "count", "min", "max")
+
+    def __init__(self, counts: List[int], total: float, count: int, vmin: float, vmax: float) -> None:
+        self.counts = list(counts)
+        self.sum = float(total)
+        self.count = int(count)
+        self.min = float(vmin)
+        self.max = float(vmax)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` in [0, 1]; ``None`` on an empty series."""
+        if self.count == 0:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            prev = cum
+            cum += c
+            if cum >= target:
+                lo = HISTOGRAM_EDGES[i - 1] if i > 0 else 0.0
+                hi = HISTOGRAM_EDGES[i] if i < len(HISTOGRAM_EDGES) else self.max
+                value = lo + (hi - lo) * ((target - prev) / c)
+                return min(max(value, self.min), self.max)
+        return self.max
+
+    @property
+    def p50(self) -> Optional[float]:
+        return self.percentile(0.50)
+
+    @property
+    def p95(self) -> Optional[float]:
+        return self.percentile(0.95)
+
+    @property
+    def p99(self) -> Optional[float]:
+        return self.percentile(0.99)
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.sum / self.count if self.count else None
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Plain-dict form for :func:`metrics_tpu.obs.snapshot` / JSON: raw
+        bucket counts plus the shared edges (self-describing) and the three
+        headline percentiles precomputed."""
+        return {
+            "buckets": list(self.counts),
+            "edges": list(HISTOGRAM_EDGES),
+            "sum": self.sum,
+            "count": self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.p50,
+            "p95": self.p95,
+            "p99": self.p99,
+        }
+
+    def __repr__(self) -> str:
+        if not self.count:
+            return "HistogramSnapshot(empty)"
+        return (
+            f"HistogramSnapshot(count={self.count}, p50={self.p50:.3g},"
+            f" p95={self.p95:.3g}, p99={self.p99:.3g}, max={self.max:.3g})"
+        )
+
+
+def observe(name: str, value: float, **labels: Any) -> None:
+    """Record one sample into histogram ``name`` (fixed log-spaced bins,
+    host-side, jit-free — a bisect plus three dict writes under the lock)."""
+    v = float(value)
+    if not math.isfinite(v):
+        return  # NaN/inf would poison sum/mean/max (and inf breaks strict JSON)
+    key = _key(name, labels)
+    idx = bisect_left(HISTOGRAM_EDGES, v)
+    with _lock:
+        h = _histograms.get(key)
+        if h is None:
+            h = _histograms[key] = {
+                "counts": [0] * (len(HISTOGRAM_EDGES) + 1),
+                "sum": 0.0,
+                "count": 0,
+                "min": math.inf,
+                "max": -math.inf,
+            }
+        h["counts"][idx] += 1
+        h["sum"] += v
+        h["count"] += 1
+        if v < h["min"]:
+            h["min"] = v
+        if v > h["max"]:
+            h["max"] = v
+
+
+def get_histogram(name: str, **labels: Any) -> Optional[HistogramSnapshot]:
+    """Snapshot of one histogram series, or ``None`` if never observed."""
+    with _lock:
+        h = _histograms.get(_key(name, labels))
+        if h is None:
+            return None
+        return HistogramSnapshot(h["counts"], h["sum"], h["count"], h["min"], h["max"])
+
+
+def histograms() -> Dict[str, Dict[str, Any]]:
+    """A plain-dict copy of every histogram series (see
+    :meth:`HistogramSnapshot.to_dict` for the per-series shape)."""
+    with _lock:
+        out = {}
+        for key, h in _histograms.items():
+            out[key] = HistogramSnapshot(h["counts"], h["sum"], h["count"], h["min"], h["max"]).to_dict()
+        return out
 
 
 def sum_counter(name: str) -> float:
@@ -203,9 +406,11 @@ def spans() -> List[Dict[str, Any]]:
 
 
 def reset() -> None:
-    """Clear all counters, gauges and spans (the enabled flag and config
-    survive — reset separates measurement windows, it doesn't disarm)."""
+    """Clear all counters, gauges, histograms and spans (the enabled flag
+    and config survive — reset separates measurement windows, it doesn't
+    disarm)."""
     with _lock:
         _counters.clear()
         _gauges.clear()
+        _histograms.clear()
         _spans.clear()

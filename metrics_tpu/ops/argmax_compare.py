@@ -86,6 +86,15 @@ def _argmax_correct_xla(preds: Array, target: Array) -> Array:
     return jnp.sum(jnp.argmax(preds, axis=1) == target).astype(jnp.int32)
 
 
+# one launch-timing wrapper per compiled dispatch target (same step label:
+# the pallas/XLA choice is an internal detail of the same logical kernel);
+# trace-transparent and one predicate per eager call when timing is off
+from metrics_tpu.obs.profile import time_launch as _obs_time_launch  # noqa: E402
+
+_timed_pallas = _obs_time_launch(_argmax_correct_pallas, "ops.argmax_compare")
+_timed_xla = _obs_time_launch(_argmax_correct_xla, "ops.argmax_compare")
+
+
 def argmax_correct_count(preds: Array, target: Array) -> Array:
     """Number of rows whose first-max class index equals ``target`` (int32).
 
@@ -97,11 +106,16 @@ def argmax_correct_count(preds: Array, target: Array) -> Array:
     Uses the pallas streaming tile on TPU for lane-resident class counts,
     the XLA argmax elsewhere (and for empty inputs, which have no block to
     stream).
+
+    With ``obs.configure(device_timing=True)`` armed, eager dispatches of
+    either compiled kernel land in the ``step.latency_ms{step=
+    ops.argmax_compare}`` histogram (in-jit call sites are untouched —
+    the wrapper is trace-transparent).
     """
     if (
         jax.default_backend() == "tpu"
         and preds.shape[0] > 0
         and 1 < preds.shape[1] <= _MAX_LANE_CLASSES
     ):
-        return _argmax_correct_pallas(preds, target)
-    return _argmax_correct_xla(preds, target)
+        return _timed_pallas(preds, target)
+    return _timed_xla(preds, target)

@@ -118,6 +118,15 @@ def _binned_counts_xla(preds: Array, target: Array, thresholds: Array) -> tuple:
     return tps, fps, fns
 
 
+# launch-timing wrappers for eager dispatches of either compiled kernel
+# (same step label: the pallas/XLA choice is internal); trace-transparent,
+# one predicate per eager call when obs device timing is off
+from metrics_tpu.obs.profile import time_launch as _obs_time_launch  # noqa: E402
+
+_timed_pallas = _obs_time_launch(_binned_counts_pallas, "ops.binned_counts")
+_timed_xla = _obs_time_launch(_binned_counts_xla, "ops.binned_counts")
+
+
 def binned_counts(preds: Array, target: Array, thresholds: Array) -> tuple:
     """``(TPs, FPs, FNs)`` each ``(C, T)`` float32.
 
@@ -137,8 +146,8 @@ def binned_counts(preds: Array, target: Array, thresholds: Array) -> tuple:
     # Done via int32 to stay clean under strict dtype promotion.
     target = target.astype(jnp.int32) == 1
     if jax.default_backend() == "tpu" and thresholds.shape[0] <= 256:
-        return _binned_counts_pallas(preds, target, thresholds)
-    return _binned_counts_xla(preds, target, thresholds)
+        return _timed_pallas(preds, target, thresholds)
+    return _timed_xla(preds, target, thresholds)
 
 
 def binned_label_histograms(preds: Array, target: Array, num_bins: int) -> tuple:

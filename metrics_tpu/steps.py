@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs.profile import time_launch as _obs_time_launch
 from metrics_tpu.obs.recompile import note_epoch_launch as _obs_epoch_launch
 from metrics_tpu.obs.recompile import note_trace as _obs_note_trace
 from metrics_tpu.obs.recompile import track_compiles as _obs_track_compiles
@@ -303,7 +304,12 @@ def make_step(
             out = jax.tree_util.tree_map(lambda v: replicate_typed(v, axis_name), out)
         return out
 
-    return init, step, compute
+    # per-launch device timing (obs.configure(device_timing=True)): EAGER
+    # step/compute calls block on their outputs and land in the
+    # step.latency_ms{step=} histograms; under any trace the wrapper is
+    # pass-through, so jitted/scanned/vmapped uses are untouched — wrap a
+    # jitted step with obs.instrument() for tracked-launch timing there
+    return init, _obs_time_launch(step, _step_label), _obs_time_launch(compute, _compute_label)
 
 
 # fold a stacked (B, *state) leaf down its leading axis with the state's own
@@ -505,7 +511,9 @@ def make_epoch(
             if hasattr(raw_jitted, attr):
                 setattr(epoch, attr, getattr(raw_jitted, attr))
     else:
-        _inner_epoch = epoch
+        # un-jitted epochs still get per-launch device timing at the eager
+        # entry (trace-transparent when composed into an outer jit)
+        _inner_epoch = _obs_time_launch(epoch, _epoch_label)
 
         def epoch(  # noqa: F811
             state: State,
@@ -601,7 +609,11 @@ def make_stream_step(
         with _obs_span(_step_label, category="step"):
             return step(state, *args, **kwargs)
 
-    inner = _obs_track_compiles(jax.jit(traced_step, donate_argnums=0), _step_label) if jit_step else traced_step
+    inner = (
+        _obs_track_compiles(jax.jit(traced_step, donate_argnums=0), _step_label)
+        if jit_step
+        else _obs_time_launch(traced_step, _step_label)
+    )
 
     if isinstance(metric, WindowedMetric):
         # host-side ring-expiry accounting at the EAGER entry (the

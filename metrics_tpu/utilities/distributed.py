@@ -14,6 +14,7 @@ groups, synchronization lowers to XLA collectives over a ``jax.sharding.Mesh``:
 
 The reference's ``process_group`` argument maps to a tuple of mesh axis names.
 """
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -21,7 +22,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import get_config as _obs_get_config
 from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 
 Array = jax.Array
 
@@ -40,6 +44,89 @@ def _obs_count_collective(op: str, nbytes: int) -> None:
     if _obs_enabled():
         _obs_inc("sync.collectives", op=op)
         _obs_inc("sync.payload_bytes", float(nbytes), op=op)
+
+
+# ---------------------------------------------------------------------------
+# Trace-time seam for in-jit collectives
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_SEAM: Optional[Callable[[Array, str, Any], Array]] = None
+
+
+def set_collective_seam(seam: Optional[Callable[[Array, str, Any], Array]]) -> Optional[Callable]:
+    """Install a trace-time hook around every in-jit sync collective.
+
+    ``seam(x, op, axis_name) -> x`` runs at TRACE time on the operand of
+    each :func:`sync_reduce_in_context` collective (``op`` is the lowered
+    collective's name: ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``;
+    sketch states pass through leafwise). Whatever the seam returns is what
+    the collective consumes, so health tooling can thread extra in-graph
+    measurement through the sync point — e.g. an ``lax.pmax`` over a
+    device-local timestamp-ish counter to measure in-jit arrival spread, or
+    a ``jax.debug.callback`` marker — without the sync code knowing about
+    it. The seam only applies while the obs layer is ENABLED, so disabled-
+    mode programs stay byte-identical regardless of what is installed.
+
+    Pass ``None`` to uninstall; returns the previously installed seam.
+    """
+    global _COLLECTIVE_SEAM
+    previous = _COLLECTIVE_SEAM
+    _COLLECTIVE_SEAM = seam
+    return previous
+
+
+def _apply_seam(x: Array, op: str, axis_name: Any) -> Array:
+    if _COLLECTIVE_SEAM is not None and _obs_enabled():
+        return _COLLECTIVE_SEAM(x, op, axis_name)
+    return x
+
+
+def record_arrival_skew() -> bool:
+    """One tiny barrier collective at a LOGICAL sync point: the time this
+    host spends blocked in it is (last peer's arrival - this host's
+    arrival) + transfer — an upper bound on this host's LEAD over the
+    slowest peer, measured without comparing cross-host clocks. Lands in
+    the ``sync.arrival_skew_ms`` gauge (latest) and the
+    ``sync.arrival_wait_ms`` histogram (distribution; a distinct family so
+    gauge and histogram types never collide in one Prometheus family). A
+    host that is itself the straggler reads ~0, so straggler hunting means
+    comparing the gauge ACROSS hosts (high = far ahead of the fleet,
+    consistently ~0 = the straggler). Returns True when a sample was
+    recorded.
+
+    Called by :meth:`metrics_tpu.Metric.sync` once per metric sync — NOT
+    per state-leaf gather, where the first barrier would align the hosts
+    and every later probe would overwrite the gauge with ~0. Call it
+    yourself at the top of any custom sync protocol. Gated on the obs
+    layer, the ``arrival_skew_probe`` config knob (default OFF) and a
+    multi-process runtime, so an unconditional call site stays free when
+    any of those is off. The knob defaults off because the probe is a
+    COLLECTIVE: arm it — and the obs layer — IDENTICALLY on every
+    process, or the barrier on the armed hosts pairs against the payload
+    gather on the others and the sync hangs or corrupts in a way no retry
+    policy can see.
+
+    Best-effort: the probe is telemetry and must never take down a sync
+    the retry policy could have saved — a failing barrier only counts
+    under ``sync.arrival_skew_probe_failures`` (the payload gather that
+    follows will surface a genuinely dead fleet through the retry path).
+    """
+    if not _obs_enabled() or not _obs_get_config("arrival_skew_probe"):
+        return False
+    try:
+        if jax.process_count() == 1:
+            return False
+        from jax.experimental import multihost_utils
+
+        t0 = time.perf_counter()
+        multihost_utils.process_allgather(jnp.zeros((), jnp.int32))
+        wait_ms = (time.perf_counter() - t0) * 1000.0
+    except Exception:  # noqa: BLE001 — see docstring
+        _obs_inc("sync.arrival_skew_probe_failures")
+        return False
+    _obs_gauge("sync.arrival_skew_ms", wait_ms)
+    _obs_observe("sync.arrival_wait_ms", wait_ms)
+    return True
 
 
 def reduce(x: Array, reduction: str) -> Array:
@@ -116,6 +203,10 @@ def sync_reduce_in_context(
       ``out_specs=P()``.
     """
     nbytes = x.size * x.dtype.itemsize if hasattr(x, "size") else 0
+    _op = {"sum": "psum", "mean": "pmean", "max": "pmax", "min": "pmin"}.get(reduce_fx, "all_gather")
+    # trace-time seam (set_collective_seam): health tooling can thread
+    # extra in-graph measurement through every sync point
+    x = _apply_seam(x, _op, axis_name)
     if reduce_fx == "sum":
         _obs_count_collective("psum", nbytes)
         return lax.psum(x, axis_name)
@@ -386,13 +477,21 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
         # scope will discard this result in favour of local state — skip
         # the doomed retry/backoff cycle entirely
         return [result]
-    return call_with_retries(
+    health_armed = _obs_enabled()
+    t0 = time.perf_counter()
+    out = call_with_retries(
         lambda: _checked_gather_all_tensors(result),
         op="gather_all_tensors",
         # degraded mode: this host's own shard only — the per-host partial
         # result shape every consumer already handles (single-process case)
         fallback=lambda _err: [result],
     )
+    if health_armed:
+        # end-to-end logical gather latency (retries + backoff included:
+        # that IS what the training loop paid) into the p50/p95/p99-able
+        # histogram the HealthMonitor's sync_latency condition reads
+        _obs_observe("sync.latency_ms", (time.perf_counter() - t0) * 1000.0, op="gather_all_tensors")
+    return out
 
 
 def _checked_gather_all_tensors(result: Array) -> List[Array]:

@@ -1,0 +1,237 @@
+"""HealthMonitor conditions, sync-latency/skew recording, collective seam.
+
+The monitor reads ONLY the obs registry, so every condition is testable by
+planting the registry state a sick fleet would produce and asserting the
+verdict, the one-shot warning, and the ``health.*`` counter accounting —
+the same contract ``DriftMonitor`` pins for data drift.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu import Accuracy
+from metrics_tpu.obs.health import HealthMonitor
+from metrics_tpu.steps import make_step
+from metrics_tpu.utilities import distributed as dist_mod
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    prev = obs.enable(False)
+    obs.reset()
+    yield
+    obs.enable(prev)
+    obs.reset()
+
+
+class TestHealthMonitor:
+    def test_empty_registry_is_healthy_and_counts_checks(self):
+        obs.enable()
+        monitor = HealthMonitor(warn=False)
+        report = monitor.check()
+        assert report["healthy"] is True and report["warnings"] == []
+        assert obs.get_counter("health.checks", monitor="default") == 1
+        assert obs.sum_counter("health.alerts") == 0
+
+    def test_straggler_from_arrival_skew_gauge(self):
+        obs.enable()
+        obs.set_gauge("sync.arrival_skew_ms", 5000.0)
+        monitor = HealthMonitor(skew_threshold_ms=1000.0)
+        with pytest.warns(UserWarning, match="straggler"):
+            report = monitor.check()
+        assert [w["kind"] for w in report["warnings"]] == ["straggler"]
+        assert obs.get_counter("health.alerts", kind="straggler", monitor="default") == 1
+        # one-shot: a second alerting check counts but does not warn again
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            monitor.check()
+        assert not any("straggler" in str(w.message) for w in caught)
+        assert obs.get_counter("health.alerts", kind="straggler", monitor="default") == 2
+        monitor.reset_warnings()
+        with pytest.warns(UserWarning, match="straggler"):
+            monitor.check()
+
+    def test_sync_latency_p95_condition(self):
+        obs.enable()
+        for v in [10.0] * 19 + [9000.0]:
+            obs.observe("sync.latency_ms", v, op="gather_all_tensors")
+        assert HealthMonitor(sync_p95_ms=5000.0, warn=False).check()["healthy"] is True
+        for _ in range(19):
+            obs.observe("sync.latency_ms", 9000.0, op="gather_all_tensors")
+        report = HealthMonitor(sync_p95_ms=5000.0, warn=False).check()
+        assert [w["kind"] for w in report["warnings"]] == ["sync_latency"]
+
+    def test_recompile_storm_condition_uses_config_threshold(self):
+        obs.enable()
+        prev = obs.configure(recompile_warn_threshold=4)
+        try:
+            obs.inc("step.traces", 4, step="Flappy.step")
+            report = HealthMonitor(warn=False).check()
+            kinds = [w["kind"] for w in report["warnings"]]
+            assert kinds == ["recompile_storm"]
+            assert "Flappy.step" in report["warnings"][0]["detail"]
+        finally:
+            obs.configure(**prev)
+
+    def test_clamp_risk_and_degraded_sync_conditions(self):
+        obs.enable()
+        obs.inc("capacity_buffer.clamp_risk_appends")
+        obs.inc("ft.degraded_syncs", op="gather_all_tensors")
+        report = HealthMonitor(warn=False).check()
+        assert {w["kind"] for w in report["warnings"]} == {"clamp_risk", "degraded_sync"}
+        # disarming both conditions makes the same registry state healthy
+        calm = HealthMonitor(clamp_risk=False, degraded_syncs=False, warn=False).check()
+        assert calm["healthy"] is True
+
+    def test_disabled_layer_still_classifies_but_does_not_count(self):
+        obs.enable()
+        obs.set_gauge("sync.arrival_skew_ms", 5000.0)
+        obs.enable(False)
+        report = HealthMonitor(warn=False).check()
+        assert report["healthy"] is False
+        assert obs.get_counter("health.checks", monitor="default") == 0
+
+
+class TestSyncTelemetry:
+    @pytest.fixture()
+    def _probe_armed(self):
+        prev = obs.configure(arrival_skew_probe=True)
+        yield
+        obs.configure(**prev)
+
+    def test_arrival_skew_probe_records_gauge_and_histogram(self, monkeypatch, _probe_armed):
+        obs.enable()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", lambda x: x)
+        assert dist_mod.record_arrival_skew() is True
+        assert obs.get_gauge("sync.arrival_skew_ms") >= 0.0
+        # histogram rides its OWN family so gauge/histogram Prometheus
+        # types never collide under one name
+        assert obs.get_histogram("sync.arrival_wait_ms").count == 1
+        assert obs.get_histogram("sync.arrival_skew_ms") is None
+
+    def test_arrival_skew_probe_off_by_default(self, monkeypatch):
+        """The probe is a COLLECTIVE: default-off, so an ad-hoc
+        obs.enable() on one host can never deadlock the fleet's sync."""
+        obs.enable()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        from jax.experimental import multihost_utils
+
+        def never(_x):
+            raise AssertionError("probe collective ran without opt-in")
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", never)
+        assert dist_mod.record_arrival_skew() is False
+
+    def test_arrival_skew_probe_gated(self, monkeypatch, _probe_armed):
+        obs.enable()
+        assert dist_mod.record_arrival_skew() is False  # single process
+        obs.enable(False)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        assert dist_mod.record_arrival_skew() is False  # layer off
+
+    def test_arrival_skew_probe_failure_counted_not_raised(self, monkeypatch, _probe_armed):
+        obs.enable()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        from jax.experimental import multihost_utils
+
+        def boom(_x):
+            raise RuntimeError("peer lost")
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+        assert dist_mod.record_arrival_skew() is False
+        assert obs.get_counter("sync.arrival_skew_probe_failures") == 1
+
+    def test_metric_sync_runs_one_probe_per_logical_sync(self, monkeypatch, _probe_armed):
+        """A multi-state metric gathers once per state leaf, but the skew
+        probe must fire ONCE per sync — per-leaf probes would align the
+        hosts on the first barrier and overwrite the gauge with ~0."""
+        obs.enable()
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        from jax.experimental import multihost_utils
+
+        barriers = []
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", lambda x: barriers.append(1) or x
+        )
+        monkeypatch.setattr(
+            dist_mod, "_gather_all_tensors_impl", lambda result: [result, result]
+        )
+        acc = Accuracy()  # four stat-score state leaves
+        acc.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.sync(should_sync=True, distributed_available_fn=lambda: True)
+        acc.unsync()
+        assert len(barriers) == 1
+        assert obs.get_histogram("sync.arrival_wait_ms").count == 1
+
+    def test_metric_sync_latency_histogram(self):
+        obs.enable()
+        acc = Accuracy()
+        acc.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+        acc.sync(should_sync=True, distributed_available_fn=lambda: True)
+        acc.unsync()
+        h = obs.get_histogram("metric.sync_ms", metric="Accuracy")
+        assert h is not None and h.count == 1 and h.p50 >= 0.0
+
+
+class TestCollectiveSeam:
+    def test_seam_sees_every_in_jit_collective_and_preserves_values(self):
+        """The trace-time seam fires once per collective per TRACE with the
+        lowered op name, can thread extra in-graph work through the sync
+        point, and an identity seam must not change results."""
+        obs.enable()
+        calls = []
+
+        def seam(x, op, axis_name):
+            calls.append((op, axis_name))
+            return x
+
+        prev = dist_mod.set_collective_seam(seam)
+        try:
+            init, step, compute = make_step(Accuracy, num_classes=3, axis_name="dp")
+
+            def shard_fn(p, t):
+                state, _ = step(init(), p, t)
+                return compute(state)
+
+            out = jax.pmap(shard_fn, axis_name="dp")(
+                jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]]),
+                jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]]),
+            )
+            assert float(out[0]) == float(out[1]) == 0.75
+            assert calls, "seam never fired"
+            assert all(op == "psum" and axis == "dp" for op, axis in calls)
+        finally:
+            dist_mod.set_collective_seam(prev)
+
+    def test_seam_inert_when_obs_disabled(self):
+        calls = []
+        prev = dist_mod.set_collective_seam(lambda x, op, a: calls.append(op) or x)
+        try:
+            init, step, compute = make_step(Accuracy, num_classes=3, axis_name="dp")
+
+            def shard_fn(p, t):
+                state, _ = step(init(), p, t)
+                return compute(state)
+
+            jax.pmap(shard_fn, axis_name="dp")(
+                jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]]),
+                jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]]),
+            )
+            assert calls == []  # disabled mode: the seam must not exist
+        finally:
+            dist_mod.set_collective_seam(prev)
+
+    def test_uninstall_returns_previous(self):
+        prev = dist_mod.set_collective_seam(None)
+        try:
+            seam = lambda x, op, a: x  # noqa: E731
+            assert dist_mod.set_collective_seam(seam) is None
+            assert dist_mod.set_collective_seam(None) is seam
+        finally:
+            dist_mod.set_collective_seam(prev)

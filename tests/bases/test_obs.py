@@ -106,14 +106,22 @@ class TestDisabledIsFree:
 
 class TestLifecycleTracing:
     def test_enabled_lowering_carries_named_scopes(self):
-        init, step, _ = make_step(Accuracy, num_classes=3)
-        hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
-        assert "Accuracy.step" not in hlo_off
-        obs.enable()
-        init2, step2, _ = make_step(Accuracy, num_classes=3)
-        hlo_on = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
-        assert "Accuracy.step" in hlo_on
-        assert "Accuracy.update" in hlo_on
+        # the persistent compile cache strips op metadata from its KEY, so
+        # a scope-free executable cached by an earlier disabled-mode run
+        # would be served for the enabled-mode compile and hide the scopes
+        # this test pins — compile fresh for the comparison
+        try:
+            jax.config.update("jax_enable_compilation_cache", False)
+            init, step, _ = make_step(Accuracy, num_classes=3)
+            hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
+            assert "Accuracy.step" not in hlo_off
+            obs.enable()
+            init2, step2, _ = make_step(Accuracy, num_classes=3)
+            hlo_on = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
+            assert "Accuracy.step" in hlo_on
+            assert "Accuracy.update" in hlo_on
+        finally:
+            jax.config.update("jax_enable_compilation_cache", True)
 
     def test_span_per_lifecycle_phase(self):
         obs.enable()
@@ -324,14 +332,32 @@ class TestExport:
         assert 'metrics_tpu_metric_updates{metric="Accuracy"} 1' in text
         assert "# TYPE metrics_tpu_metric_state_bytes gauge" in text
 
-    def test_label_values_sanitized_for_export(self):
-        """Label values containing ',', '=', or quotes must not corrupt the
-        flat series key or the Prometheus exposition text."""
+    def test_hostile_label_values_round_trip_escaped(self):
+        """A label value containing every piece of key/exposition syntax
+        (comma, '=', quote, backslash, newline) must survive VERBATIM: the
+        registry key stays addressable, the Prometheus exposition escapes
+        backslash/quote/newline per the text format, and the label splitter
+        breaks on commas only OUTSIDE quoted values."""
         obs.enable()
-        obs.inc("x", tag='a,b=c"d')
-        assert obs.get_counter("x", tag='a,b=c"d') == 1.0  # same sanitization on read
+        hostile = 'a,b=c"d\\e\nf'
+        obs.inc("x", tag=hostile, plain="ok")
+        assert obs.get_counter("x", tag=hostile, plain="ok") == 1.0  # same key on read
         text = obs.to_prometheus()
-        assert 'metrics_tpu_x{tag="a_b_c_d"} 1' in text
+        # exposition escapes: \ -> \\, " -> \", newline -> \n; the comma
+        # stays literal inside the quoted value and must NOT split labels
+        assert 'metrics_tpu_x{plain="ok",tag="a,b=c\\"d\\\\e\\nf"} 1' in text
+        assert text.count("tag=") == 1
+
+    def test_hostile_labels_parse_back_from_exposition(self):
+        """Round-trip through the export-side label parser: quoted values
+        with embedded commas/escapes come back as the original strings."""
+        from metrics_tpu.obs.export import _parse_labels
+        from metrics_tpu.obs.registry import _key
+
+        hostile = 'a,b=c"d\\e\nf'
+        key = _key("x", {"tag": hostile, "plain": "ok"})
+        labels_blob = key[len("x{"):-1]
+        assert dict(_parse_labels(labels_blob)) == {"tag": hostile, "plain": "ok"}
 
     def test_json_round_trip(self, tmp_path):
         import json
@@ -350,6 +376,295 @@ class TestExport:
         obs.reset()
         assert obs.enabled() is True
         assert obs.counters() == {}
+
+
+class TestHistograms:
+    def test_observe_counts_sum_and_percentiles(self):
+        for v in [1.0] * 50 + [10.0] * 45 + [100.0] * 5:
+            obs.observe("lat", v, step="s")
+        h = obs.get_histogram("lat", step="s")
+        assert h.count == 100
+        assert h.sum == pytest.approx(50 * 1.0 + 45 * 10.0 + 5 * 100.0)
+        assert (h.min, h.max) == (1.0, 100.0)
+        # log-spaced buckets: a percentile lands inside its value's bucket
+        # (<= one bucket width of relative error)
+        assert h.p50 == pytest.approx(1.0, rel=0.5)
+        assert h.p95 == pytest.approx(10.0, rel=0.5)
+        assert 10.0 <= h.p99 <= 100.0
+        assert h.mean == pytest.approx(h.sum / 100)
+
+    def test_single_value_series_reports_it_at_every_quantile(self):
+        obs.observe("one", 3.7)
+        h = obs.get_histogram("one")
+        assert h.p50 == h.p95 == h.p99 == 3.7  # clamped to [min, max]
+        assert h.percentile(0.0) == 3.7 and h.percentile(1.0) == 3.7
+
+    def test_overflow_bucket_catches_values_past_the_last_edge(self):
+        from metrics_tpu.obs.registry import HISTOGRAM_EDGES
+
+        obs.observe("big", 10.0 * HISTOGRAM_EDGES[-1])
+        h = obs.get_histogram("big")
+        assert h.counts[-1] == 1 and sum(h.counts) == 1
+        assert h.p99 == 10.0 * HISTOGRAM_EDGES[-1]  # clamped to observed max
+
+    def test_empty_and_nan(self):
+        assert obs.get_histogram("never") is None
+        obs.observe("nan", float("nan"))  # must not create a poisoned series
+        assert obs.get_histogram("nan") is None
+
+    def test_percentile_rejects_out_of_range(self):
+        obs.observe("x", 1.0)
+        with pytest.raises(ValueError, match="quantile"):
+            obs.get_histogram("x").percentile(1.5)
+
+    def test_snapshot_and_reset(self):
+        obs.enable()
+        obs.observe("lat", 2.0, step="s")
+        snap = obs.snapshot()
+        entry = snap["histograms"]["lat{step=s}"]
+        assert entry["count"] == 1 and entry["p50"] == 2.0
+        assert len(entry["buckets"]) == len(entry["edges"]) + 1
+        obs.reset()
+        assert obs.snapshot()["histograms"] == {}
+
+    def test_prometheus_histogram_family(self):
+        obs.observe("lat", 0.5, step="s")
+        obs.observe("lat", 0.5, step="s")
+        obs.observe("lat", 2.0e9, step="s")  # overflow bucket
+        text = obs.to_prometheus({"histograms": {"lat{step=s}": obs.histograms()["lat{step=s}"]}})
+        assert "# TYPE metrics_tpu_lat histogram" in text
+        assert 'metrics_tpu_lat_bucket{step="s",le="+Inf"} 3' in text
+        # 0.5 lands in the first bucket whose edge covers it (10^(-1/6))
+        assert 'metrics_tpu_lat_bucket{step="s",le="0.681292"} 2' in text
+        assert 'metrics_tpu_lat_count{step="s"} 3' in text
+        assert 'metrics_tpu_lat_sum{step="s"} 2e+09' in text
+
+
+def _parse_prometheus(text: str):
+    """Minimal exposition-format parser for the round-trip test: returns
+    ({family: kind}, [(name, {label: value}, float)]). Honours quoted label
+    values with backslash escapes — format drift here must fail loudly."""
+    import re as _re
+
+    types, series = {}, []
+    name_re = _re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            assert parts[0] == "#" and parts[1] == "TYPE", line
+            assert name_re.match(parts[2]), parts[2]
+            assert parts[3] in ("counter", "gauge", "histogram"), line
+            assert parts[2] not in types, f"family {parts[2]} typed twice"
+            types[parts[2]] = parts[3]
+            continue
+        if "{" in line:
+            name, rest = line.split("{", 1)
+            blob, value_str = rest.rsplit("} ", 1)
+            labels, i = {}, 0
+            while i < len(blob):
+                eq = blob.index("=", i)
+                key = blob[i:eq]
+                assert blob[eq + 1] == '"', f"unquoted exposition value in {line!r}"
+                j, buf = eq + 2, []
+                while blob[j] != '"':
+                    if blob[j] == "\\":
+                        buf.append({"n": "\n", "\\": "\\", '"': '"'}[blob[j + 1]])
+                        j += 2
+                    else:
+                        buf.append(blob[j])
+                        j += 1
+                labels[key] = "".join(buf)
+                i = j + 1
+                if i < len(blob):
+                    assert blob[i] == ",", line
+                    i += 1
+        else:
+            name, value_str = line.rsplit(" ", 1)
+            labels = {}
+        assert name_re.match(name.split("_bucket")[0]), name
+        series.append((name, labels, float(value_str)))
+    return types, series
+
+
+class TestPrometheusRoundTrip:
+    def test_full_exposition_reparses(self):
+        """Re-parse the whole to_prometheus() output — TYPE lines, label
+        quoting/escaping, histogram bucket structure — so any format drift
+        fails this test instead of a scrape."""
+        obs.enable()
+        obs.inc("events", 3, kind="a")
+        obs.inc("events", kind='hosti,le="v\\al\nue')
+        obs.set_gauge("level", 7.25, zone="z1")
+        for v in (0.5, 5.0, 50.0):
+            obs.observe("lat", v, step="epoch")
+        types, series = _parse_prometheus(obs.to_prometheus())
+        assert types["metrics_tpu_events"] == "counter"
+        assert types["metrics_tpu_level"] == "gauge"
+        assert types["metrics_tpu_lat"] == "histogram"
+        by_name = {}
+        for name, labels, value in series:
+            by_name.setdefault(name, []).append((labels, value))
+        # hostile label value came back VERBATIM
+        assert ({"kind": 'hosti,le="v\\al\nue'}, 1.0) in by_name["metrics_tpu_events"]
+        assert ({"kind": "a"}, 3.0) in by_name["metrics_tpu_events"]
+        assert by_name["metrics_tpu_level"] == [({"zone": "z1"}, 7.25)]
+        # histogram: cumulative non-decreasing buckets, +Inf == _count,
+        # _sum/_count present exactly once for the series
+        buckets = by_name["metrics_tpu_lat_bucket"]
+        cum = [v for _, v in buckets]
+        assert cum == sorted(cum)
+        les = [labels["le"] for labels, _ in buckets]
+        assert les[-1] == "+Inf"
+        assert all(labels["step"] == "epoch" for labels, _ in buckets)
+        (_, count) = by_name["metrics_tpu_lat_count"][0]
+        assert buckets[-1][1] == count == 3
+        (_, total) = by_name["metrics_tpu_lat_sum"][0]
+        assert total == pytest.approx(55.5)
+        # finite le values parse as floats and strictly increase
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite) and len(set(finite)) == len(finite)
+
+
+class TestSpanRingResize:
+    def test_shrink_preserves_newest_and_counts_evictions(self):
+        obs.enable()
+        prev = obs.configure(max_spans=8)
+        try:
+            for i in range(6):
+                obs._registry.record_span(f"s{i}", 1.0, 0)
+            obs.configure(max_spans=3)
+            assert [s["name"] for s in obs.spans()] == ["s3", "s4", "s5"]
+            assert obs.get_counter("obs.spans_dropped") == 3
+        finally:
+            obs.configure(**prev)
+
+    def test_grow_keeps_entries_and_extends_capacity(self):
+        obs.enable()
+        prev = obs.configure(max_spans=3)
+        try:
+            for i in range(3):
+                obs._registry.record_span(f"a{i}", 1.0, 0)
+            obs.configure(max_spans=6)
+            assert obs.get_counter("obs.spans_dropped") == 0  # grow drops nothing
+            for i in range(3):
+                obs._registry.record_span(f"b{i}", 1.0, 0)
+            names = [s["name"] for s in obs.spans()]
+            assert names == ["a0", "a1", "a2", "b0", "b1", "b2"]
+            obs._registry.record_span("b3", 1.0, 0)  # now full at 6: evicts a0
+            assert [s["name"] for s in obs.spans()][0] == "a1"
+            assert obs.get_counter("obs.spans_dropped") == 1
+        finally:
+            obs.configure(**prev)
+
+    def test_invalid_max_spans_rejected(self):
+        with pytest.raises(ValueError, match="max_spans"):
+            obs.configure(max_spans=0)
+
+
+class TestDeviceTimingAndCostAnalysis:
+    def test_epoch_latency_histogram_and_cost_gauges(self):
+        """The acceptance surface: with device_timing + cost_analysis armed,
+        a make_epoch factory produces step.latency_ms histograms and
+        FLOPs/bytes/intensity gauges visible in snapshot() AND the
+        Prometheus exposition — without inflating the trace/compile split."""
+        obs.enable()
+        prev = obs.configure(device_timing=True, cost_analysis=True)
+        try:
+            init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+            preds = jnp.asarray([[0, 1], [2, 1]])
+            target = jnp.asarray([[0, 1], [2, 0]])
+            state, _ = epoch(init(), preds, target)  # compile launch -> cost gauges
+            state, _ = epoch(state, preds, target)  # run launch -> latency sample
+            assert float(compute(state)) == 0.75
+            snap = obs.snapshot()
+            assert "step.latency_ms{step=Accuracy.epoch}" in snap["histograms"]
+            h = obs.get_histogram("step.latency_ms", step="Accuracy.epoch")
+            assert h.count == 1 and h.p50 > 0  # compile launch excluded
+            assert obs.get_gauge("step.flops", step="Accuracy.epoch") is not None
+            assert obs.get_gauge("step.bytes_accessed", step="Accuracy.epoch") > 0
+            assert obs.get_gauge("step.arithmetic_intensity", step="Accuracy.epoch") > 0
+            # the AOT cost-analysis retrace is bookkeeping, not drift: the
+            # public counters still read one trace, one compile, one run
+            assert obs.get_counter("step.traces", step="Accuracy.epoch") == 1
+            assert obs.get_counter("compiles", step="Accuracy.epoch") == 1
+            assert obs.get_counter("runs", step="Accuracy.epoch") == 1
+            text = obs.to_prometheus(snap)
+            assert "# TYPE metrics_tpu_step_latency_ms histogram" in text
+            assert 'metrics_tpu_step_latency_ms_bucket{step="Accuracy.epoch",le="+Inf"} 1' in text
+            assert 'metrics_tpu_step_latency_ms_count{step="Accuracy.epoch"} 1' in text
+            assert "metrics_tpu_step_flops" in text
+        finally:
+            obs.configure(**prev)
+
+    def test_eager_step_and_compute_latency_recorded(self):
+        obs.enable()
+        prev = obs.configure(device_timing=True)
+        try:
+            init, step, compute = make_step(Accuracy, num_classes=3)
+            state, _ = step(init(), _PREDS, _TARGET)  # eager launch
+            compute(state)
+            assert obs.get_histogram("step.latency_ms", step="Accuracy.step").count == 1
+            assert obs.get_histogram("step.latency_ms", step="Accuracy.step_compute").count == 1
+        finally:
+            obs.configure(**prev)
+
+    def test_instrumented_jit_excludes_compile_launches(self):
+        obs.enable()
+        prev = obs.configure(device_timing=True)
+        try:
+            init, step, _ = make_step(Accuracy, num_classes=3)
+            jstep = obs.instrument(jax.jit(step), "Accuracy.step")
+            jstep(init(), _PREDS, _TARGET)  # compile: excluded from latency
+            assert obs.get_histogram("step.latency_ms", step="Accuracy.step") is None
+            jstep(init(), _PREDS, _TARGET)  # cache hit: recorded
+            h = obs.get_histogram("step.latency_ms", step="Accuracy.step")
+            assert h is not None and h.count == 1
+        finally:
+            obs.configure(**prev)
+
+    def test_device_timing_off_records_nothing(self):
+        obs.enable()
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        step(init(), _PREDS, _TARGET)
+        assert obs.get_histogram("step.latency_ms", step="Accuracy.step") is None
+
+    def test_timing_does_not_change_values_or_disabled_hlo(self):
+        """device_timing is host-side only: jitted programs stay
+        byte-identical whether the mode is armed or not."""
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
+        prev = obs.configure(device_timing=True)
+        try:
+            init2, step2, _ = make_step(Accuracy, num_classes=3)
+            hlo_timed = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
+        finally:
+            obs.configure(**prev)
+        assert hlo_off == hlo_timed
+
+    def test_cost_analysis_failure_is_counted_not_raised(self):
+        obs.enable()
+
+        def not_jitted(x):
+            return x
+
+        assert obs.record_cost_analysis(not_jitted, (jnp.zeros(()),), {}, "bogus") is False
+        assert obs.get_counter("profile.cost_analysis_failures", step="bogus") == 1
+
+
+class TestProfileCapture:
+    def test_profile_writes_trace_files_and_counts(self, tmp_path):
+        obs.enable()
+        f = jax.jit(lambda x: x * 2 + 1)
+        with obs.profile(str(tmp_path)) as logdir:
+            f(jnp.arange(8.0)).block_until_ready()
+        import os
+
+        files = [n for _, _, fs in os.walk(logdir) for n in fs]
+        assert files, "profile capture produced no trace files"
+        assert obs.get_counter("profile.captures") == 1
+        assert obs.get_histogram("profile.capture_ms").count == 1
 
 
 class TestStepWrappers:

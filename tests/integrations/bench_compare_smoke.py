@@ -1,0 +1,105 @@
+"""CI smoke: the bench regression gate end-to-end on a TINY real run.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.bench_compare_smoke``
+(the CI tier-1 job does). Four arms, all through the real record builder
+and the real gate (``bench.build_record`` + ``benchmarks/compare.py``):
+
+1. a tiny-config measurement (the 500-sample compute-group A/B) becomes a
+   real ``--json``-shape record and compares against the checked-in
+   fixture — plumbing only, so the threshold is huge (CI runners differ
+   in speed; what must work is the load/parse/normalize/report path);
+2. the same record against ITSELF at the production threshold must pass;
+3. an injected 2x slowdown of every row must exit nonzero;
+4. a device-kind mismatch must REFUSE with exit 2, not fake-regress.
+
+A gate that cannot fail is decoration — arms 3 and 4 are the test that it
+can.
+"""
+import copy
+import json
+import os
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_compare_fixture.json")
+
+
+def main() -> None:
+    import bench
+    from benchmarks import bench_collection
+    from benchmarks.compare import (
+        EXIT_OK,
+        EXIT_REFUSED,
+        EXIT_REGRESSED,
+        CompareRefused,
+        compare_records,
+        load_record,
+        render_report,
+    )
+
+    # --- tiny real measurement -> real record --------------------------------
+    tiny = bench_collection.measure_compute_group_savings(n=500, n_classes=3, reps=1)
+    rows = [
+        {"metric": name, "value": round(float(ms), 3), "unit": "ms", "vs_baseline": 1.0}
+        for name, ms in tiny.items()
+    ]
+    record = bench.build_record(rows)
+    assert record["device_kind"], "record must carry a device kind"
+    assert record["jax_version"] and "process_count" in record
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_compare_smoke.")
+    new_path = os.path.join(tmpdir, "NEW.json")
+    with open(new_path, "w") as f:
+        json.dump(record, f)
+
+    # --- arm 1: vs the checked-in fixture (plumbing; rows overlap) -----------
+    old = load_record(FIXTURE)
+    new = load_record(new_path)
+    result = compare_records(old, new, threshold=1e9)
+    report = render_report(result)
+    assert result["exit_code"] == EXIT_OK, report
+    overlapping = [r for r in result["rows"] if r["old_ms"] and r["new_ms"]]
+    assert overlapping, "fixture and tiny run share no rows — smoke lost its teeth"
+    assert "device_kind=" in report and "jax=" in report
+
+    # --- arm 2: identical inputs pass at the production threshold ------------
+    result = compare_records(new, new, threshold=1.5)
+    assert result["exit_code"] == EXIT_OK, render_report(result)
+
+    # --- arm 3: injected 2x slowdown must exit nonzero ------------------------
+    slowed = copy.deepcopy(record)
+    for row in slowed["rows"]:
+        row["value"] *= 2.0
+    slow_path = os.path.join(tmpdir, "SLOW.json")
+    with open(slow_path, "w") as f:
+        json.dump(slowed, f)
+    result = compare_records(new, load_record(slow_path), threshold=1.5)
+    assert result["exit_code"] == EXIT_REGRESSED, "a 2x slowdown sailed through the gate"
+    assert result["regressions"], render_report(result)
+
+    # --- arm 4: cross-device comparison refused -------------------------------
+    foreign = copy.deepcopy(record)
+    foreign["device_kind"] = "TPU v99 (smoke)"
+    foreign_path = os.path.join(tmpdir, "FOREIGN.json")
+    with open(foreign_path, "w") as f:
+        json.dump(foreign, f)
+    try:
+        compare_records(new, load_record(foreign_path))
+    except CompareRefused as err:
+        assert "TPU v99" in str(err)
+    else:
+        raise AssertionError("cross-device comparison was not refused")
+    assert EXIT_REFUSED == 2
+
+    print(
+        "bench compare smoke OK:",
+        f"{len(overlapping)} overlapping row(s) vs fixture,",
+        f"2x injection flagged {len(result['regressions'])} regression(s),",
+        "cross-device refused",
+    )
+
+
+if __name__ == "__main__":
+    main()

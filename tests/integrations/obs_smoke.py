@@ -32,8 +32,14 @@ def main() -> None:
     assert abs(float(compute(state)) - 0.8) < 1e-6, float(compute(state))
     assert obs.get_counter("step.traces", step="Accuracy.step") == 2
 
-    # named scopes in the compiled program
-    hlo = jax.jit(step).lower(init(), jnp.asarray([0, 1]), jnp.asarray([0, 1])).compile().as_text()
+    # named scopes in the compiled program (compile fresh: the persistent
+    # cache strips op metadata from its key, so a scope-free executable
+    # cached by a disabled-mode run would otherwise be served here)
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+        hlo = jax.jit(step).lower(init(), jnp.asarray([0, 1]), jnp.asarray([0, 1])).compile().as_text()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
     assert "Accuracy.step" in hlo, "named scope missing from compiled HLO"
 
     # fused epoch: compile/run split + launch accounting
@@ -52,8 +58,48 @@ def main() -> None:
     assert snap["counters"], "empty counter snapshot"
     text = obs.to_prometheus(snap)
     assert "metrics_tpu_step_traces" in text, text[:200]
+
+    # performance tier: device-timing histograms + cost-analysis gauges on
+    # a fresh fused-epoch factory (opt-in modes; first launch pays compile
+    # and records the cost gauges, second is a timed cache hit)
+    obs.configure(device_timing=True, cost_analysis=True)
+    initT, epochT, computeT = make_epoch(Accuracy, num_classes=3)
+    stT, _ = epochT(initT(), preds, target)
+    stT, _ = epochT(stT, preds, target)
+    assert float(computeT(stT)) == 0.75
+    hist = obs.get_histogram("step.latency_ms", step="Accuracy.epoch")
+    assert hist is not None and hist.count == 1 and hist.p50 > 0, hist
+    assert obs.get_gauge("step.bytes_accessed", step="Accuracy.epoch") > 0
+    assert obs.get_gauge("step.flops", step="Accuracy.epoch") is not None
+    text = obs.to_prometheus()
+    assert "# TYPE metrics_tpu_step_latency_ms histogram" in text
+    assert 'metrics_tpu_step_latency_ms_bucket{step="Accuracy.epoch",le="+Inf"} 1' in text
+    assert "metrics_tpu_step_latency_ms_sum" in text
+    obs.configure(device_timing=False, cost_analysis=False)
+
+    # programmatic profile capture writes trace files
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix="obs_smoke_prof.")
+    with obs.profile(logdir):
+        st2, _ = epoch(st, preds, target)
+        jax.block_until_ready(st2)
+    trace_files = [n for _, _, fs in os.walk(logdir) for n in fs]
+    assert trace_files, "profile capture produced no trace files"
+    assert obs.get_counter("profile.captures") == 1
+
+    # fleet health: this healthy single-host run must classify healthy, and
+    # a planted straggler gauge must flip it
+    report = obs.HealthMonitor(warn=False).check()
+    assert report["healthy"], report
+    obs.set_gauge("sync.arrival_skew_ms", 10_000.0)
+    report = obs.HealthMonitor(warn=False).check()
+    assert [w["kind"] for w in report["warnings"]] == ["straggler"], report
+
     print("obs smoke OK:", len(snap["counters"]), "counter series,",
-          f"{obs.get_counter('jax.compile_seconds'):.2f}s backend compile time")
+          f"{obs.get_counter('jax.compile_seconds'):.2f}s backend compile time,",
+          f"epoch p50 {hist.p50 * 1000:.0f}us,",
+          f"{len(trace_files)} profile trace file(s)")
 
 
 if __name__ == "__main__":

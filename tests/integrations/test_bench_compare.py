@@ -1,0 +1,217 @@
+"""Plumbing tests for the bench regression gate (``benchmarks/compare.py``).
+
+These assert the gate's CONTRACT, not performance: identical inputs pass
+(exit 0), an injected 2x slowdown fails (nonzero exit), cross-device
+comparisons are refused with their own exit code and a clear message, both
+record shapes in the tree load, the noise-awareness rules (n_fast /
+probe normalization) hold, and the CLI surfaces (`python -m
+benchmarks.compare`, `bench.py --compare`) expose all of it.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from benchmarks.compare import (
+    EXIT_OK,
+    EXIT_REFUSED,
+    EXIT_REGRESSED,
+    BenchRecord,
+    CompareRefused,
+    PROBE_CLASS,
+    compare_records,
+    load_record,
+    render_report,
+    trend_table,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_compare_fixture.json")
+
+
+def _fixture_dict() -> dict:
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+def _write(tmp_path, name, data) -> str:
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(data, f)
+    return path
+
+
+def _slowed(data: dict, factor: float, metrics=None) -> dict:
+    out = copy.deepcopy(data)
+    for row in out["rows"]:
+        if metrics is None or row["metric"] in metrics:
+            row["value"] = row["value"] * factor
+            if row.get("fast_mode_median") is not None:
+                row["fast_mode_median"] = row["fast_mode_median"] * factor
+    return out
+
+
+class TestLoadRecord:
+    def test_json_record_shape(self):
+        rec = load_record(FIXTURE)
+        assert rec.device_kind == "cpu" and rec.source == "record"
+        assert rec.process_count == 1 and rec.device_count == 8
+        assert "collection_prf1_500_update_groups_on" in rec.rows
+        assert "device_kind=cpu" in rec.header() and "hosts=1" in rec.header()
+
+    def test_driver_tail_shape(self):
+        rec = load_record(os.path.join(REPO, "BENCH_r05.json"))
+        assert rec.source == "driver_tail" and rec.device_kind is None
+        assert "accuracy_1M_update_compute_wallclock" in rec.rows
+
+    def test_unreadable_and_malformed_refused(self, tmp_path):
+        with pytest.raises(CompareRefused, match="cannot read"):
+            load_record(str(tmp_path / "missing.json"))
+        bad = _write(tmp_path, "bad.json", {"neither": "shape"})
+        with pytest.raises(CompareRefused, match="unrecognized"):
+            load_record(bad)
+
+
+class TestGate:
+    def test_identical_inputs_pass(self):
+        rec = load_record(FIXTURE)
+        result = compare_records(rec, rec)
+        assert result["exit_code"] == EXIT_OK and result["regressions"] == []
+        verdicts = {r["metric"]: r["verdict"] for r in result["rows"]}
+        assert verdicts["probe_elementwise_1Mx10"] == "probe"  # probes never gate
+        assert verdicts["flaky_row_one_fast_sample"] == "low-confidence"
+
+    def test_injected_2x_slowdown_fails(self, tmp_path):
+        old = load_record(FIXTURE)
+        new = load_record(_write(tmp_path, "slow.json", _slowed(_fixture_dict(), 2.0)))
+        result = compare_records(old, new)
+        assert result["exit_code"] == EXIT_REGRESSED
+        assert "collection_prf1_500_update_groups_on" in result["regressions"]
+        # the noise rules hold even amid a regression: probes and
+        # low-confidence rows are reported but never in the gate list
+        assert "probe_elementwise_1Mx10" not in result["regressions"]
+        assert "flaky_row_one_fast_sample" not in result["regressions"]
+        report = render_report(result)
+        assert "GATE: FAIL" in report and "REGRESSION" in report
+
+    def test_probe_normalization_cancels_chip_state(self, tmp_path):
+        """A row 2x slower while its class probe is also 2x slower is chip
+        state, not code — the normalized ratio gates, and reads 1.0."""
+        probed = "accuracy_1M_update_compute_wallclock"
+        probe = PROBE_CLASS[probed]
+        slowed = _slowed(_fixture_dict(), 2.0, metrics={probed, probe})
+        old = load_record(FIXTURE)
+        new = load_record(_write(tmp_path, "chipslow.json", slowed))
+        result = compare_records(old, new)
+        row = next(r for r in result["rows"] if r["metric"] == probed)
+        assert row["norm_ratio"] == pytest.approx(1.0)
+        assert row["verdict"] == "ok" and probed not in result["regressions"]
+
+    def test_probe_normalized_regression_still_fires(self, tmp_path):
+        """Row 3x slower, probe unchanged: the normalized ratio shows the
+        real regression and the gate fails."""
+        probed = "accuracy_1M_update_compute_wallclock"
+        slowed = _slowed(_fixture_dict(), 3.0, metrics={probed})
+        result = compare_records(
+            load_record(FIXTURE), load_record(_write(tmp_path, "rowslow.json", slowed))
+        )
+        row = next(r for r in result["rows"] if r["metric"] == probed)
+        assert row["norm_ratio"] == pytest.approx(3.0)
+        assert probed in result["regressions"]
+
+    def test_threshold_is_configurable(self, tmp_path):
+        old = load_record(FIXTURE)
+        new = load_record(_write(tmp_path, "slow13.json", _slowed(_fixture_dict(), 1.3)))
+        assert compare_records(old, new, threshold=1.5)["exit_code"] == EXIT_OK
+        assert compare_records(old, new, threshold=1.2)["exit_code"] == EXIT_REGRESSED
+
+    def test_new_and_removed_rows_reported_not_gated(self, tmp_path):
+        data = _fixture_dict()
+        data["rows"] = [r for r in data["rows"] if r["metric"] != "accuracy_1M_update_compute_wallclock"]
+        data["rows"].append({"metric": "brand_new_row", "value": 1.0, "unit": "ms", "vs_baseline": 1.0})
+        result = compare_records(load_record(FIXTURE), load_record(_write(tmp_path, "churn.json", data)))
+        verdicts = {r["metric"]: r["verdict"] for r in result["rows"]}
+        assert verdicts["brand_new_row"] == "new"
+        assert verdicts["accuracy_1M_update_compute_wallclock"] == "removed"
+        assert result["exit_code"] == EXIT_OK
+
+
+class TestCrossDevice:
+    def test_refused_with_clear_message(self, tmp_path):
+        other = _fixture_dict()
+        other["device_kind"] = "TPU v4"
+        old = load_record(FIXTURE)
+        new = load_record(_write(tmp_path, "tpu.json", other))
+        with pytest.raises(CompareRefused, match="TPU v4") as err:
+            compare_records(old, new)
+        assert "cpu" in str(err.value)
+
+    def test_override_flag_allows_it(self, tmp_path):
+        other = _fixture_dict()
+        other["device_kind"] = "TPU v4"
+        new = load_record(_write(tmp_path, "tpu.json", other))
+        result = compare_records(load_record(FIXTURE), new, allow_cross_device=True)
+        assert result["exit_code"] == EXIT_OK
+
+    def test_headerless_driver_tail_compares_with_warning(self):
+        rec = load_record(os.path.join(REPO, "BENCH_r05.json"))
+        result = compare_records(rec, rec)
+        assert result["exit_code"] == EXIT_OK
+        assert "WARNING" in render_report(result)
+
+
+class TestTrend:
+    def test_trend_table_across_rounds(self):
+        paths = sorted(
+            os.path.join(REPO, f) for f in os.listdir(REPO)
+            if f.startswith("BENCH_r0") and f.endswith(".json")
+        )
+        table = trend_table(paths)
+        assert "accuracy_1M_update_compute_wallclock" in table
+        assert table.count("|") > len(paths) * 3  # metric x round grid rendered
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "benchmarks.compare", *args],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+
+    def test_pass_fail_refuse_exit_codes(self, tmp_path):
+        assert self._run(FIXTURE, FIXTURE).returncode == EXIT_OK
+        slow = _write(tmp_path, "slow.json", _slowed(_fixture_dict(), 2.0))
+        out = self._run(FIXTURE, slow)
+        assert out.returncode == EXIT_REGRESSED
+        assert "GATE: FAIL" in out.stdout
+        other = _fixture_dict()
+        other["device_kind"] = "TPU v4"
+        tpu = _write(tmp_path, "tpu.json", other)
+        out = self._run(FIXTURE, tpu)
+        assert out.returncode == EXIT_REFUSED
+        assert "refusing to compare across device kinds" in out.stderr
+
+    def test_report_header_records_device_and_jax(self):
+        out = self._run(FIXTURE, FIXTURE)
+        assert "device_kind=cpu" in out.stdout
+        assert "jax=0.4.37" in out.stdout
+        assert "hosts=1" in out.stdout
+
+    def test_markdown_written(self, tmp_path):
+        md = str(tmp_path / "report.md")
+        assert self._run(FIXTURE, FIXTURE, "--markdown", md).returncode == EXIT_OK
+        with open(md) as f:
+            assert "# Bench comparison" in f.read()
+
+
+def test_bench_cli_exposes_compare_flags():
+    """bench.py's CLI accepts --compare/--compare-threshold (CI calls it)."""
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--help"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--compare" in out.stdout and "--compare-threshold" in out.stdout
