@@ -772,7 +772,7 @@ def main(
         _section_compile_s[0] = _compile_seconds() - c0
         return out
 
-    def emit(name: str, ours_ms: float, base_ms: float, baseline: str = "torch_cpu_eager") -> None:
+    def emit(name: str, ours_ms: float, base_ms: float, baseline: str = "torch_cpu_eager", unit: str = "ms") -> None:
         # print each row as soon as it exists: a timeout mid-run must not
         # lose the rows already measured. A NaN measurement (dispatch-phase
         # noise swamped the workload) is reported to stderr and the row is
@@ -783,7 +783,7 @@ def main(
         row = {
             "metric": name,
             "value": round(ours_ms, 3),
-            "unit": "ms",
+            "unit": unit,
             "vs_baseline": round(base_ms / ours_ms, 3),
             "baseline": baseline,
         }
@@ -869,6 +869,29 @@ def main(
         savings["collection_prf1_200k_update_groups_off"],
         baseline="same_collection_compute_groups_off",
     )
+
+    # whole-collection fusion (round 7): the 12-metric acceptance config in
+    # ONE launch per epoch fold vs the (group-deduped) eager batch loop on
+    # the same device, plus the launch-count pin — a fusion break to
+    # per-member launches reads 12x and fails the --compare gate.
+    try:
+        fusion = section(bench_collection.measure_collection_fusion)
+        eager_epoch_ms = section(bench_collection.measure_collection_eager_epoch)
+        emit(
+            "collection12_1M_epoch_wallclock",
+            fusion["collection12_1M_epoch_wallclock"],
+            eager_epoch_ms,
+            baseline="eager_collection_same_device",
+        )
+        emit(
+            "collection12_launch_count",
+            fusion["collection12_launch_count"],
+            prior.get("collection12_launch_count", fusion["collection12_launch_count"]),
+            baseline="best_prior_self",
+            unit="launches",
+        )
+    except Exception as err:  # noqa: BLE001 — fusion rows must not kill the sweep
+        print(f"SKIPPED collection fusion rows: {err}", file=sys.stderr)
 
     retr = section(bench_retrieval.measure)
     emit("retrieval_map_1M_docs_compute", retr["retrieval_map_1M_docs_compute"], base_retrieval("map"))
@@ -1102,7 +1125,23 @@ if __name__ == "__main__":
         metavar="X",
         help="regression gate ratio for --compare (default 1.5)",
     )
+    parser.add_argument(
+        "--trend",
+        nargs="*",
+        metavar="RECORD",
+        default=None,
+        help="render the metric x round trend table over the given bench"
+        " records (default: BENCH_r*.json beside this script) instead of"
+        " running the sweep; rounds missing a row render as gaps (—), so"
+        " rows added in later rounds never break the table",
+    )
     _args = parser.parse_args()
+    if _args.trend is not None:
+        # delegate to the compare CLI's trend mode (benchmarks/compare.py):
+        # no sweep runs, and absent rows are rendered as gaps per round
+        from benchmarks.compare import main as _compare_main
+
+        raise SystemExit(_compare_main(["--trend", *_args.trend]))
     main(
         json_path=_args.json,
         compare_path=_args.compare,

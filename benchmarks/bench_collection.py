@@ -77,9 +77,140 @@ def measure_compute_group_savings(n: int = 200_000, n_classes: int = 10, reps: i
     return out
 
 
+def fusion_collection(n_classes: int = 10):
+    """The acceptance config: 12 classification metrics over one prediction
+    stream — stat-scores family (one compute group), confusion-matrix family
+    (another), plus micro accuracy and hamming distance."""
+    from metrics_tpu import (
+        Accuracy,
+        CohenKappa,
+        ConfusionMatrix,
+        F1Score,
+        FBetaScore,
+        HammingDistance,
+        JaccardIndex,
+        MatthewsCorrCoef,
+        MetricCollection,
+        Precision,
+        Recall,
+        Specificity,
+        StatScores,
+    )
+
+    c = n_classes
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=c),
+            "prec": Precision(num_classes=c, average="macro"),
+            "rec": Recall(num_classes=c, average="macro"),
+            "f1": F1Score(num_classes=c, average="macro"),
+            "spec": Specificity(num_classes=c, average="macro"),
+            "stat": StatScores(num_classes=c, reduce="macro"),
+            "fbeta": FBetaScore(num_classes=c, beta=2.0, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=c),
+            "kappa": CohenKappa(num_classes=c),
+            "mcc": MatthewsCorrCoef(num_classes=c),
+            "jaccard": JaccardIndex(num_classes=c),
+            "hamming": HammingDistance(),
+        }
+    )
+
+
+def measure_collection_fusion(n: int = N, n_classes: int = C, n_batches: int = 16, reps: int = 8) -> dict:
+    """Whole-collection fusion rows (round 7).
+
+    - ``collection12_1M_epoch_wallclock`` — ONE fused
+      ``make_collection_epoch`` launch folding a 16-batch 1M-sample epoch
+      into all 12 metrics (update dedup: 4 update groups), plus the fused
+      whole-collection compute launch. The donated carry re-threads, so
+      calls are timed singly (the ``windowed_fold`` protocol).
+    - ``collection12_launch_count`` — tracked epoch launches per fold,
+      read from the obs ``epoch.launches`` counter family AFTER the timing
+      pass (the layer stays off inside timed regions). Counted across ALL
+      step labels (``obs.sum_counter``), so a fusion regression that falls
+      back to one ``make_epoch`` per member reads 12x and fails the
+      ``--compare`` gate; a broken routing that records NO launch raises
+      here (the row must go missing loudly, never be fabricated).
+    """
+    import time
+
+    from metrics_tpu import obs
+    from metrics_tpu.steps import make_collection_epoch
+
+    coll = fusion_collection(n_classes)
+    batch = max(1, n // n_batches)
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (n_batches, batch, n_classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.PRNGKey(1), (n_batches, batch), 0, n_classes)
+    preds.block_until_ready()
+
+    init, epoch, compute = make_collection_epoch(coll)
+    state, _ = epoch(init(), preds, target)  # warm: one trace+compile
+    jax.block_until_ready(compute(state))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        state, _ = epoch(state, preds, target)
+        jax.block_until_ready(compute(state))
+        times.append(time.perf_counter() - t0)
+    out = {"collection12_1M_epoch_wallclock": min(times) * 1000.0}
+
+    # launch accounting outside the timed region: obs on, one fold, read
+    # the whole epoch.launches label FAMILY, obs off again — per-member
+    # fallback paths carry their own labels, and those must count
+    was_enabled = obs.enabled()
+    obs.enable()
+    try:
+        before = obs.sum_counter("epoch.launches")
+        state, _ = epoch(state, preds, target)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        launches = obs.sum_counter("epoch.launches") - before
+    finally:
+        obs.enable(was_enabled)
+    if launches <= 0:
+        raise RuntimeError(
+            "collection fusion launch accounting recorded ZERO epoch launches —"
+            " the fused entry point is no longer routed through note_epoch_launch;"
+            " refusing to fabricate the collection12_launch_count row"
+        )
+    out["collection12_launch_count"] = launches
+    return out
+
+
+def measure_collection_eager_epoch(n: int = N, n_classes: int = C, n_batches: int = 16, reps: int = 3) -> float:
+    """The loop the fused epoch replaces: the eager class-API collection
+    driven batch by batch (compute groups active, so this is the DEDUPED
+    eager cost — the fusion win is on top of the 2-3x group saving), plus
+    the per-member eager computes."""
+    import time
+
+    coll = fusion_collection(n_classes)
+    batch = max(1, n // n_batches)
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (n_batches, batch, n_classes), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.PRNGKey(1), (n_batches, batch), 0, n_classes)
+    preds.block_until_ready()
+
+    def run_epoch():
+        coll.reset()
+        for i in range(n_batches):
+            coll.update(preds[i], target[i])
+        out = coll.compute()
+        jax.block_until_ready(list(out.values()))
+
+    run_epoch()  # warm compiles + group discovery
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_epoch()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000.0
+
+
 def main() -> None:
     for name, ms in measure().items():
         print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))
+    for name, value in measure_collection_fusion().items():
+        unit = "launches" if name.endswith("launch_count") else "ms"
+        print(json.dumps({"metric": name, "value": round(value, 3), "unit": unit}))
     savings = measure_compute_group_savings()
     for name, ms in savings.items():
         print(json.dumps({"metric": name, "value": round(ms, 3), "unit": "ms"}))

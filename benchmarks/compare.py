@@ -78,6 +78,10 @@ PROBE_CLASS: Dict[str, str] = {
     "binned_counts_1M_T100_update": "probe_elementwise_1Mx10",
     "collection_statscores_binary_1M_update": "probe_elementwise_1Mx10",
     "collection_statscores_multiclass_1M_update": "probe_elementwise_1Mx10",
+    # fused whole-collection epoch: compare/one-hot/reduce dominated
+    # (collection12_launch_count is a COUNT row — no probe; its raw ratio
+    # pins fusion at one launch per epoch)
+    "collection12_1M_epoch_wallclock": "probe_elementwise_1Mx10",
 }
 
 

@@ -115,7 +115,14 @@ from metrics_tpu.text import (  # noqa: E402, F401
 from metrics_tpu import ft  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
 from metrics_tpu import streaming  # noqa: E402, F401
-from metrics_tpu.steps import make_epoch, make_step, make_stream_step  # noqa: E402, F401
+from metrics_tpu.metric import register_state_reduction  # noqa: E402, F401
+from metrics_tpu.steps import (  # noqa: E402, F401
+    make_collection_epoch,
+    make_collection_step,
+    make_epoch,
+    make_step,
+    make_stream_step,
+)
 from metrics_tpu.utilities.debug import debug_checks  # noqa: E402, F401
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
@@ -181,9 +188,12 @@ __all__ = [
     "MetricCollection",
     "MetricTracker",
     "MinMaxMetric",
+    "make_collection_epoch",
+    "make_collection_step",
     "make_epoch",
     "make_step",
     "make_stream_step",
+    "register_state_reduction",
     "debug_checks",
     "ft",
     "obs",

@@ -174,16 +174,28 @@ class MetricCollection(dict):
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-metric forward; batch values under collection keys."""
+        from metrics_tpu.utilities.checks import shared_input_format_scope
+
         # convert torch inputs ONCE for the whole collection — every member
         # metric would otherwise pay the host transfer independently
         args = coerce_foreign_tensors(args)
         kwargs = coerce_foreign_tensors(kwargs)
         with _obs_span("MetricCollection.forward", category="forward"):
             with foreign_coercion_scope(args, kwargs):  # member forwards must not re-walk these
-                res = {
-                    k: m(*args, **m._filter_kwargs(**kwargs))
-                    for k, m in self.items(keep_base=True, copy_state=False)
-                }
+                if self._state_is_copy:
+                    # the last compute aliased group state by reference;
+                    # members with in-place states (buffers, cat lists) must
+                    # not update through the alias
+                    self._compute_groups_create_state_ref(copy=True)
+                    self._state_is_copy = False
+                with shared_input_format_scope():  # format/check pass once per parameterization
+                    res = {
+                        k: m(*args, **m._filter_kwargs(**kwargs))
+                        for k, m in self.items(keep_base=True, copy_state=False)
+                    }
+                # forward is an update entry point too: detect compute groups
+                # after the first real batch, same as update()
+                self._maybe_merge_compute_groups()
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -199,21 +211,61 @@ class MetricCollection(dict):
                 self._update_members(*args, **kwargs)
 
     def _update_members(self, *args: Any, **kwargs: Any) -> None:
+        from metrics_tpu.utilities.checks import shared_input_format_scope
+
         if self._groups_checked:
-            for group in self._groups.values():
-                m0 = self[group[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            with shared_input_format_scope():  # format/check pass once per parameterization
+                for group in self._groups.values():
+                    m0 = self[group[0]]
+                    m0.update(*args, **m0._filter_kwargs(**kwargs))
             if self._state_is_copy:
                 # previous compute copied states by reference; members must
                 # not be updated while aliasing the representative
                 self._compute_groups_create_state_ref(copy=True)
                 self._state_is_copy = False
         else:
-            for m in self.values(copy_state=False):
-                m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
-                self._merge_compute_groups()
-                self._groups_checked = True
+            with shared_input_format_scope():
+                for m in self.values(copy_state=False):
+                    m.update(*args, **m._filter_kwargs(**kwargs))
+            self._maybe_merge_compute_groups()
+
+    def _maybe_merge_compute_groups(self) -> None:
+        """Run the O(n^2) pairwise group detection ONCE, after the first
+        REAL batch, and cache the verdict.
+
+        Two guards around :meth:`_merge_compute_groups`: the verdict is
+        cached in ``_groups_checked`` so no later update (from any entry
+        point — ``update`` or ``forward``) re-runs the pairwise comparison;
+        and detection waits for a batch that actually moved some state off
+        its default — on an all-default collection (an empty first batch, a
+        zero-preserving update) every same-structure member compares equal
+        and would falsely merge into one group, silently dropping updates
+        of the non-representatives forever after.
+        """
+        if self._groups_checked or not self._enable_compute_groups:
+            return
+        if all(self._states_at_defaults(m) for m in self.values(copy_state=False)):
+            return  # no real batch yet: all-default states would falsely merge
+        self._merge_compute_groups()
+        self._groups_checked = True
+
+    @staticmethod
+    def _states_at_defaults(metric: Metric) -> bool:
+        """Whether every state still equals its reset default (cheap O(state)
+        scan, not the pairwise comparison)."""
+        for name, default in metric._defaults.items():
+            value = getattr(metric, name)
+            if isinstance(value, (list, CapacityBuffer)):
+                if len(value):
+                    return False
+            elif isinstance(value, Sketch):
+                leaves_v = jax.tree_util.tree_leaves(value)
+                leaves_d = jax.tree_util.tree_leaves(default)
+                if not all(allclose(a, b) for a, b in zip(leaves_v, leaves_d)):
+                    return False
+            elif not allclose(value, jax.numpy.asarray(default)):
+                return False
+        return True
 
     def _merge_compute_groups(self) -> None:
         """Iteratively merge groups whose representatives share equal states

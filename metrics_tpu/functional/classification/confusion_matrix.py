@@ -31,27 +31,15 @@ def _confusion_matrix_update(
         unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
         bins = _bincount(unique_mapping, minlength=4 * num_classes)
         return bins.reshape(num_classes, 2, 2)
-    if num_classes > 64:
-        # C^2 bins exceed the one-hot bincount's work bound, but the count
-        # matrix factors as onehot(target)^T @ onehot(preds) — an MXU matmul
-        # with f32 accumulation, ~2x faster than TPU scatter and flat in C.
-        # Chunked over samples so peak memory stays O(chunk * C), not O(N * C).
-        t_flat = target.reshape(-1).astype(jnp.int32)
-        p_flat = preds.reshape(-1).astype(jnp.int32)
-        chunk = 65536
-        pad = -t_flat.shape[0] % chunk
-        # pad with out-of-range index -1: one_hot maps it to the zero row
-        t_flat = jnp.pad(t_flat, (0, pad), constant_values=-1).reshape(-1, chunk)
-        p_flat = jnp.pad(p_flat, (0, pad), constant_values=-1).reshape(-1, chunk)
+    if jax.default_backend() == "tpu" or num_classes > 64:
+        # The count matrix factors as onehot(target)^T @ onehot(preds): on
+        # TPU the ops/confusion_bincount pallas tile keeps the (C, C) block
+        # VMEM-resident while sample tiles stream through (one input pass,
+        # no C^2-bin scatter); elsewhere the chunk-scanned MXU contraction
+        # takes over past the one-hot bincount's C^2 work bound.
+        from metrics_tpu.ops.confusion_bincount import confusion_counts
 
-        def body(acc, batch):
-            t_c, p_c = batch
-            oh_t = jax.nn.one_hot(t_c, num_classes, dtype=jnp.bfloat16)
-            oh_p = jax.nn.one_hot(p_c, num_classes, dtype=jnp.bfloat16)
-            return acc + jax.lax.dot(oh_t.T, oh_p, preferred_element_type=jnp.float32), None
-
-        confmat, _ = jax.lax.scan(body, jnp.zeros((num_classes, num_classes), jnp.float32), (t_flat, p_flat))
-        return confmat.astype(jnp.int32)
+        return confusion_counts(preds.reshape(-1), target.reshape(-1), num_classes)
     unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
     bins = _bincount(unique_mapping, minlength=num_classes**2)
     return bins.reshape(num_classes, num_classes)

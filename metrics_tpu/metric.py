@@ -65,6 +65,70 @@ Array = jax.Array
 # shard_map (utilities.distributed.sync_sketch_in_context)
 _VALID_REDUCTIONS = ("sum", "mean", "cat", "min", "max", "sketch")
 
+# named reductions registered at runtime via register_state_reduction():
+# {name: {"merge": a,b -> merged, "fold": (B, *state) -> state,
+#         "list_reduce": [per-rank states] -> state}}
+_CUSTOM_REDUCTIONS: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_state_reduction(
+    name: str,
+    *,
+    merge: Callable,
+    fold: Optional[Callable] = None,
+    list_reduce: Optional[Callable] = None,
+) -> None:
+    """Register a custom named ``dist_reduce_fx`` for :meth:`Metric.add_state`.
+
+    The hook extends the reduce registries end to end: the eager
+    ``forward`` merge and cross-process gather (this module), and the
+    merge-combinable fast paths of :func:`metrics_tpu.steps.make_epoch` /
+    the fused collection factories (``_MERGE_OPS``/``_FOLD_OPS``) — a
+    metric whose every state uses a registered reduction rides the
+    flattened one-launch epoch and the collection update-dedup grouping
+    exactly like a ``sum`` state.
+
+    Args:
+        name: the registry key (usable as ``dist_reduce_fx=name``). Must
+            not collide with a built-in reduction.
+        merge: ``(acc, batch) -> merged`` — MUST be associative and
+            commutative with the state default as identity, and merging
+            per-batch contributions must equal one update over the
+            concatenated batches (the same invariant the DDP gather-reduce
+            sync and the flattened-epoch fast path rely on for sum/max/min).
+        fold: ``stacked (B, *state) -> state`` down the leading axis;
+            defaults to a left fold of ``merge`` over that axis.
+        list_reduce: ``[per-rank states] -> state`` for the eager DCN
+            gather; defaults to a left fold of ``merge``.
+
+    Note:
+        In-jit mesh sync (``axis_name=``) still requires one of the
+        built-in collective reductions; custom names are for the eager
+        gather and the merge-combinable single-launch paths.
+    """
+    global _VALID_REDUCTIONS
+    if not name or not isinstance(name, str):
+        raise ValueError(f"Reduction name must be a non-empty string, got {name!r}")
+    if name in _VALID_REDUCTIONS and name not in _CUSTOM_REDUCTIONS:
+        raise ValueError(f"Cannot override the built-in reduction {name!r}")
+    if not callable(merge):
+        raise ValueError("`merge` must be callable")
+    if fold is None:
+        def fold(stacked: Any, _merge: Callable = merge) -> Any:
+            return functools.reduce(_merge, [stacked[i] for i in range(stacked.shape[0])])
+    if list_reduce is None:
+        def list_reduce(outputs: List[Any], _merge: Callable = merge) -> Any:
+            return functools.reduce(_merge, outputs)
+    _CUSTOM_REDUCTIONS[name] = {"merge": merge, "fold": fold, "list_reduce": list_reduce}
+    if name not in _VALID_REDUCTIONS:
+        _VALID_REDUCTIONS = _VALID_REDUCTIONS + (name,)
+    # propagate into the step-fusion registries (deferred import: steps
+    # imports this module at load)
+    from metrics_tpu import steps as _steps
+
+    _steps._MERGE_OPS[name] = merge
+    _steps._FOLD_OPS[name] = fold
+
 
 def jit_distributed_available() -> bool:
     """Availability probe (parity with reference ``metric.py:40``)."""
@@ -757,6 +821,8 @@ def _apply_reduction(reduce_fx: Union[str, Callable], outputs: List[Array]) -> A
         return jnp.concatenate([jnp.atleast_1d(o) for o in outputs], axis=0)
     if reduce_fx == "sketch":
         return functools.reduce(lambda a, b: a.merge(b), outputs)
+    if isinstance(reduce_fx, str) and reduce_fx in _CUSTOM_REDUCTIONS:
+        return _CUSTOM_REDUCTIONS[reduce_fx]["list_reduce"](outputs)
     if callable(reduce_fx):
         return reduce_fx(jnp.stack(outputs))
     raise MetricsTPUUserError(f"Unsupported dist_reduce_fx {reduce_fx}")

@@ -36,6 +36,7 @@ from metrics_tpu.obs import registry as _reg
 __all__ = [
     "compile_listener_installed",
     "install_compile_listener",
+    "note_collection_fusion",
     "note_trace",
     "suppress_note_trace",
     "track_compiles",
@@ -215,6 +216,22 @@ def note_epoch_launch(step: str, n_batches: Optional[int]) -> None:
     if n_batches is not None:
         _reg.inc("epoch.batches_folded", float(n_batches), step=step)
         _reg.set_gauge("epoch.batches_per_launch", float(n_batches), step=step)
+
+
+def note_collection_fusion(step: str, n_members: int, n_groups: int) -> None:
+    """Record a fused collection program's member/update-group counts under
+    its per-collection step label (``collection.members`` /
+    ``collection.update_groups`` gauges) — the cost-attribution key for
+    whole-collection fusion: ``step.flops``/``step.bytes_accessed`` rows
+    carry the same ``step=`` label, so a 12-member 4-group program's cost
+    is attributable to the collection rather than smeared over members.
+
+    Called from the (possibly traced) fused body: a Python-level gauge set
+    at trace time only — zero operations in the compiled program."""
+    if not _reg.enabled():
+        return
+    _reg.set_gauge("collection.members", float(n_members), step=step)
+    _reg.set_gauge("collection.update_groups", float(n_groups), step=step)
 
 
 def compile_listener_installed() -> bool:

@@ -5,5 +5,6 @@ leaves bandwidth on the table. Every kernel has an XLA fallback used on
 non-TPU backends (and for oracle comparison in tests).
 """
 from metrics_tpu.ops.binned_counts import binned_counts  # noqa: F401
+from metrics_tpu.ops.confusion_bincount import bincount_counts, confusion_counts  # noqa: F401
 
-__all__ = ["binned_counts"]
+__all__ = ["bincount_counts", "binned_counts", "confusion_counts"]

@@ -30,6 +30,14 @@ Static-shape contract: every state must be an array or a fixed-capacity
 buffer. Metrics whose states are unbounded Python lists (exact curve
 metrics without ``sample_capacity``) are rejected with guidance, since a
 growing pytree cannot be a ``scan`` carry.
+
+Whole-collection fusion: :func:`make_collection_epoch` /
+:func:`make_collection_step` lower an entire ``MetricCollection`` into one
+traced program — members with provably identical update computations share
+ONE update (the compute-group dedup extended from state to the update pass
+itself), the input normalization/format-check pass runs once per
+parameterization, and a fused ``compute`` evaluates every member's value
+in a single further launch.
 """
 from copy import deepcopy
 from typing import Any, Callable, Dict, Optional, Tuple, Type, Union
@@ -75,16 +83,29 @@ def _is_mergeable(metric: Metric) -> bool:
         for r, d in zip(metric._reductions.values(), metric._defaults.values())
     )
 
-__all__ = ["make_epoch", "make_step", "make_stream_step"]
+__all__ = [
+    "make_collection_epoch",
+    "make_collection_step",
+    "make_epoch",
+    "make_step",
+    "make_stream_step",
+]
 
 
 def _fresh_copy(state: State) -> State:
     """Copy leaves on the eager path so a donated init() can never delete
     arrays later traces embed as constants; a no-op under a trace (jnp.array
     on a concrete value would needlessly turn it into a tracer, and donation
-    cannot reach trace-internal values)."""
+    cannot reach trace-internal values).
+
+    The copy pins each leaf's dtype explicitly, which also strips jax's
+    weak-type flag: a weak-typed scalar default (``jnp.asarray(0)``) would
+    otherwise make the SECOND jitted-epoch call retrace, because the first
+    call's output carry comes back strong-typed."""
     if not isinstance(jnp.zeros(()), jax.core.Tracer):  # not under a trace
-        return jax.tree_util.tree_map(jnp.array, state)
+        return jax.tree_util.tree_map(
+            lambda v: jnp.array(v, dtype=v.dtype) if hasattr(v, "dtype") else jnp.array(v), state
+        )
     return state
 
 
@@ -352,8 +373,11 @@ def make_epoch(
       under one ``jax.vmap`` (still one launch) so each batch's local value
       exists.
     * **anything else** ``make_step`` supports (running-moment states,
-      wrappers, collections): a ``jax.lax.scan`` of the step over the epoch
+      wrappers): a ``jax.lax.scan`` of the step over the epoch
       axis — one launch, sequential inner kernels.
+    * a :class:`MetricCollection` routes to :func:`make_collection_epoch`
+      (whole-collection fusion: update dedup + shared input pass + one
+      launch for every member).
 
     Args:
         metric: as :func:`make_step` (class, instance, or collection).
@@ -391,7 +415,17 @@ def make_epoch(
         >>> compute(state)
         Array(0.75, dtype=float32)
     """
+    from metrics_tpu.collections import MetricCollection
     from metrics_tpu.wrappers.abstract import WrapperMetric
+
+    if isinstance(metric, MetricCollection):
+        # whole-collection fusion: one launch per epoch for every member,
+        # update dedup across compute-grouped members, shared input pass
+        if init_args or init_kwargs:
+            raise TypeError("make_epoch(collection) takes no extra args; configure the collection itself")
+        return make_collection_epoch(
+            metric, axis_name=axis_name, with_values=with_values, jit_epoch=jit_epoch
+        )
 
     # construct a class argument ONCE and hand the instance to make_step
     # (which clones it), so ctor work is not duplicated
@@ -1069,22 +1103,31 @@ def _make_multioutput_nanmask_step(
     return init, step, compute
 
 
-def _make_collection_step(
+def _collection_fusion_plan(
     collection: Any,
     axis_name: Optional[Union[str, Tuple[str, ...]]],
     with_value: bool,
-) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
-    """Pure step functions over a whole :class:`MetricCollection`.
+) -> Dict[str, Any]:
+    """Shared machinery of the fused collection step/epoch factories.
 
-    The state is ``{metric_name: child_state}``; one ``step`` updates every
-    member inside the same traced program. The eager API's compute-group
-    dedup (update only the group representative, reference
-    ``collections.py:138-157``) is unnecessary here: members with identical
-    update math produce identical subexpressions that XLA's CSE folds into
-    one computation, so the collection pays for each distinct kernel once
-    per program regardless of how many metrics share it.
+    Builds, per member, the pure sub-functions it will run with, and an
+    UPDATE-GROUP resolver: members whose batch-contribution computation is
+    provably identical (same state names/reductions/defaults, same filtered
+    kwargs, and the same traced jaxpr + embedded constants on the call's
+    input shapes) share ONE update per traced program. Unlike the eager
+    compute-group heuristic (state equality after the first batch, which a
+    coincidental batch can fool), jaxpr equality is sound: identical
+    programs on identical inputs produce identical states by construction.
+
+    Members that cannot ride the contribution-merge formulation (wrappers,
+    cat/buffer/``mean``/custom states, metrics with update-derived aux
+    attrs) fall back to their own :func:`make_step` sub-functions inside
+    the same traced body — still one launch, just no shared update.
     """
+    import numpy as np
+
     from metrics_tpu.utilities.data import _flatten_dict
+    from metrics_tpu.wrappers.abstract import WrapperMetric
 
     template = collection.clone()
     template.reset()
@@ -1092,25 +1135,558 @@ def _make_collection_step(
     # flatten + prefix/postfix naming as the eager collection's compute
     # (collections.py:260-267), so dict-valued members splice identically
     children = {name: m for name, m in template.items(keep_base=True, copy_state=False)}
-    subs = {
-        name: (make_step(m, axis_name=axis_name, with_value=with_value), m)
-        for name, m in children.items()
-    }
+
+    groupable: Dict[str, bool] = {}
+    subs: Dict[str, Tuple] = {}  # solo members: full (init, step, compute)
+    local_subs: Dict[str, Tuple] = {}  # groupable: axis_name-free, value-free
+    synced_compute: Dict[str, Callable] = {}
+    state_keys: Dict[str, Any] = {}
+    for name, m in children.items():
+        is_groupable = (
+            isinstance(m, Metric)
+            and not isinstance(m, WrapperMetric)
+            and bool(m._defaults)
+            and _is_mergeable(m)
+            # update-derived Python attrs (e.g. a detected input mode) are
+            # only set on the worker whose update actually runs; members
+            # relying on them must run their own update
+            and not type(m)._aux_attrs
+        )
+        groupable[name] = is_groupable
+        if is_groupable:
+            local_subs[name] = make_step(m, axis_name=None, with_value=False)
+            synced_compute[name] = (
+                local_subs[name][2]
+                if axis_name is None
+                else make_step(m, axis_name=axis_name, with_value=False)[2]
+            )
+            # the grouping key's data part: state names, reductions and the
+            # default VALUES (two identical update programs starting from
+            # different defaults produce different contributions — defaults
+            # ride the jaxpr as consts, invisible to its pretty-print)
+            state_keys[name] = tuple(
+                (
+                    sname,
+                    str(m._reductions[sname]),
+                    tuple(
+                        (str(leaf.dtype), tuple(leaf.shape), np.asarray(leaf).tobytes())
+                        for leaf in jax.tree_util.tree_leaves(m._defaults[sname])
+                    ),
+                )
+                for sname in m._defaults
+            )
+        else:
+            subs[name] = make_step(m, axis_name=axis_name, with_value=with_value)
 
     def _named(res: Dict[str, Any]) -> Dict[str, Any]:
         return {template._set_name(k): v for k, v in _flatten_dict(res).items()}
 
     def init() -> State:
-        return {name: sub_init() for name, ((sub_init, _, _), _) in subs.items()}
+        return {
+            name: (local_subs[name][0]() if groupable[name] else subs[name][0]())
+            for name in children
+        }
+
+    group_cache: Dict[Any, list] = {}
+
+    def _leaf_sig(a: Any) -> Any:
+        if _is_array(a):
+            return (tuple(a.shape), str(a.dtype))
+        return ("py", repr(a))
+
+    def resolve_groups(args: tuple, kwargs: dict) -> list:
+        """``[(representative, [member names])]`` for these input shapes."""
+        sig = (
+            tuple(_leaf_sig(a) for a in args),
+            tuple(sorted((k, _leaf_sig(v)) for k, v in kwargs.items())),
+        )
+        cached = group_cache.get(sig)
+        if cached is not None:
+            return cached
+        av_args = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) if _is_array(a) else a for a in args
+        )
+        av_kwargs = {
+            k: jax.ShapeDtypeStruct(v.shape, v.dtype) if _is_array(v) else v
+            for k, v in kwargs.items()
+        }
+        keyed: Dict[Any, list] = {}
+        order: list = []
+        from metrics_tpu.obs.recompile import suppress_note_trace
+
+        for name, m in children.items():
+            key: Any = ("solo", name)
+            if groupable[name]:
+                fk = tuple(sorted(m._filter_kwargs(**av_kwargs)))
+                li, ls, _ = local_subs[name]
+
+                def contrib(*leaves, _li=li, _ls=ls, _n=len(av_args), _keys=fk):
+                    s, _ = _ls(_li(), *leaves[:_n], **dict(zip(_keys, leaves[_n:])))
+                    return s
+
+                try:
+                    # abstract probe: traces, never executes; its retrace is
+                    # bookkeeping, not shape drift, so it must not count
+                    with suppress_note_trace():
+                        jaxpr = jax.make_jaxpr(contrib)(
+                            *av_args, *[av_kwargs[k] for k in fk]
+                        )
+                    consts = jaxpr.consts
+                    if sum(np.asarray(c).nbytes for c in consts) <= 1 << 20:
+                        key = (
+                            "jaxpr",
+                            fk,
+                            state_keys[name],
+                            str(jaxpr),
+                            tuple(np.asarray(c).tobytes() for c in consts),
+                        )
+                except Exception:
+                    pass  # un-probeable member stays solo
+            entry = keyed.get(key)
+            if entry is None:
+                keyed[key] = entry = []
+                order.append(entry)
+            entry.append(name)
+        groups = [(members[0], members) for members in order]
+        group_cache[sig] = groups
+        return groups
+
+    return {
+        "template": template,
+        "children": children,
+        "groupable": groupable,
+        "subs": subs,
+        "local_subs": local_subs,
+        "synced_compute": synced_compute,
+        "named": _named,
+        "init": init,
+        "resolve_groups": resolve_groups,
+        "label": f"MetricCollection[{len(children)}]",
+    }
+
+
+def _make_collection_step(
+    collection: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    with_value: bool,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Pure step functions over a whole :class:`MetricCollection`, with
+    update dedup and a shared input-normalization pass.
+
+    The state is ``{metric_name: child_state}``; one ``step`` updates every
+    member inside the same traced program. Members grouped by the fusion
+    plan (see :func:`_collection_fusion_plan`) share ONE batch-contribution
+    computation — the traced-program extension of the eager compute-group
+    dedup from state sharing to update sharing — and the whole body runs
+    under :func:`~metrics_tpu.utilities.checks.shared_input_format_scope`,
+    so the input format/normalization pass executes once per distinct
+    parameterization instead of once per member.
+    """
+    from metrics_tpu.obs.recompile import note_collection_fusion as _obs_collection
+    from metrics_tpu.utilities.checks import shared_input_format_scope
+
+    plan = _collection_fusion_plan(collection, axis_name, with_value)
+    children, groupable = plan["children"], plan["groupable"]
+    subs, local_subs, synced_compute = plan["subs"], plan["local_subs"], plan["synced_compute"]
+    _named, resolve_groups = plan["named"], plan["resolve_groups"]
+    label = plan["label"]
+    _step_label, _compute_label = f"{label}.collection_step", f"{label}.collection_compute"
+    _step_token, _compute_token = object(), object()
 
     def step(state: State, *args: Any, **kwargs: Any) -> Tuple[State, Any]:
-        new_state: State = {}
-        values: Dict[str, Any] = {}
-        for name, ((_, sub_step, _), child) in subs.items():
-            new_state[name], values[name] = sub_step(state[name], *args, **child._filter_kwargs(**kwargs))
-        return new_state, (_named(values) if with_value else None)
+        _obs_note_trace(_step_label, _step_token)
+        with _obs_span(_step_label, category="step"):
+            groups = resolve_groups(args, kwargs)
+            _obs_collection(_step_label, len(children), len(groups))
+            new_state: State = {}
+            values: Dict[str, Any] = {}
+            with shared_input_format_scope():
+                for rep, members in groups:
+                    m_rep = children[rep]
+                    if not groupable[rep]:
+                        _, sub_step, _ = subs[rep]
+                        new_state[rep], values[rep] = sub_step(
+                            state[rep], *args, **m_rep._filter_kwargs(**kwargs)
+                        )
+                        continue
+                    li, ls, _ = local_subs[rep]
+                    batch_state, _ = ls(li(), *args, **m_rep._filter_kwargs(**kwargs))
+                    for name in members:
+                        reds = children[name]._reductions
+                        new_state[name] = {
+                            k: _MERGE_OPS[reds[k]](state[name][k], batch_state[k])
+                            for k in batch_state
+                        }
+                        if with_value:
+                            values[name] = local_subs[name][2](batch_state)
+            return new_state, (_named(values) if with_value else None)
 
     def compute(state: State) -> Dict[str, Any]:
-        return _named({name: sub_compute(state[name]) for name, ((_, _, sub_compute), _) in subs.items()})
+        _obs_note_trace(_compute_label, _compute_token)
+        with _obs_span(_compute_label, category="compute"):
+            return _named(
+                {
+                    name: (
+                        synced_compute[name](state[name])
+                        if groupable[name]
+                        else subs[name][2](state[name])
+                    )
+                    for name in children
+                }
+            )
 
-    return init, step, compute
+    return plan["init"], step, compute
+
+
+def make_collection_step(
+    collection: "MetricCollection",  # noqa: F821
+    *,
+    axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    with_value: bool = True,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Build fused pure ``(init, step, compute)`` functions from a whole
+    :class:`~metrics_tpu.collections.MetricCollection`.
+
+    One ``step(state, *batch)`` updates every member inside a single traced
+    program, with two fusions the per-member eager loop cannot express:
+
+    * **Update dedup** — members whose batch-contribution computation is
+      provably identical (same states/reductions/defaults and the same
+      traced program on these input shapes) share ONE update; the eager
+      compute-group machinery dedupes *state*, this extends the dedup to
+      the *update pass itself*, and the jaxpr-equality test cannot be
+      fooled by a coincidental first batch the way the eager state-equality
+      heuristic can.
+    * **Shared input normalization** — the body runs under
+      :func:`~metrics_tpu.utilities.checks.shared_input_format_scope`, so
+      the classification input format/check pass executes once per distinct
+      parameterization and is reused by every member that shares it.
+
+    Args:
+        collection: a configured ``MetricCollection`` (cloned; accumulated
+            state is not carried over).
+        axis_name: as :func:`make_step`; ``compute`` reduces every member
+            state with its declared ``dist_reduce_fx`` over the mesh axis.
+        with_value: when True (default), ``step`` also returns the
+            batch-local values dict (the eager ``forward`` result).
+
+    Returns:
+        ``init() -> {member: state}``, ``step(state, *batch) ->
+        (state', values)``, ``compute(state) -> {name: value}`` — all pure
+        and trace-safe; member kwargs are filtered per update signature
+        like the eager collection.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Precision, Recall
+        >>> from metrics_tpu.steps import make_collection_step
+        >>> coll = MetricCollection([Precision(num_classes=3, average='macro'),
+        ...                          Recall(num_classes=3, average='macro')])
+        >>> init, step, compute = make_collection_step(coll, with_value=False)
+        >>> state, _ = step(init(), jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+        >>> sorted(compute(state))
+        ['Precision', 'Recall']
+    """
+    from metrics_tpu.collections import MetricCollection
+
+    if not isinstance(collection, MetricCollection):
+        raise TypeError(
+            f"make_collection_step expects a MetricCollection, got {type(collection).__name__};"
+            " use make_step for a single metric."
+        )
+    return _make_collection_step(collection, axis_name=axis_name, with_value=with_value)
+
+
+def make_collection_epoch(
+    collection: "MetricCollection",  # noqa: F821
+    *,
+    axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
+    with_values: bool = False,
+    jit_epoch: bool = True,
+) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
+    """Build ``(init, epoch, compute)`` folding a WHOLE collection's epoch in
+    ONE jitted launch.
+
+    The production eval-loop shape is dozens of metrics over the same
+    predictions: a 12-metric collection driven eagerly pays 12 jitted
+    launches, 12 input normalization passes and 12 state folds per batch.
+    ``epoch(state, *batches)`` (inputs carry a leading
+    ``(num_batches, batch, ...)`` epoch axis, like :func:`make_epoch`)
+    instead lowers the entire collection into one compiled program:
+
+    * members grouped by the fusion plan (identical contribution programs —
+      see :func:`make_collection_step`) share ONE update computation;
+    * across groups the input flatten + format/normalization pass runs
+      exactly once and is reused by every group's fold
+      (:func:`~metrics_tpu.utilities.checks.shared_input_format_scope`);
+    * merge-combinable members collapse to one full-width update over the
+      flattened ``(num_batches * batch, ...)`` inputs, merged into the
+      carry by each state's declared ``dist_reduce_fx`` (the
+      ``_MERGE_OPS``/``_FOLD_OPS`` registries — sum/max/min/sketch and
+      reductions added via
+      :func:`metrics_tpu.metric.register_state_reduction`);
+    * anything else (wrappers, cat/buffer/``mean`` states) rides a
+      ``lax.scan`` over the epoch axis INSIDE the same program;
+    * the returned ``compute`` evaluates the whole collection from the
+      folded states in one further jitted launch (``axis_name=None``; under
+      a mesh axis it stays an open function to call inside the same
+      ``shard_map`` program).
+
+    The carry is donated across folds (``donate_argnums=0``), so epoch N+1
+    reuses epoch N's state buffers.
+
+    Args:
+        collection: a configured ``MetricCollection`` (cloned).
+        axis_name: as :func:`make_epoch`; ``compute`` reduces member states
+            over the mesh axis — call ``epoch`` inside the same
+            ``shard_map`` program (with ``jit_epoch=False``).
+        with_values: when True, ``epoch`` also returns the per-batch values
+            dict (each value stacked over the epoch axis).
+        jit_epoch: wrap ``epoch`` in ``jax.jit`` with the carry donated
+            (default); pass False when composing into an outer jit.
+
+    Exactly-once resume:
+        ``epoch`` accepts the same reserved ``resume_from=`` /
+        ``epoch_index=`` keywords as :func:`make_epoch`; already-folded
+        leading batches are trimmed host-side before the launch, so a
+        preempted sweep resumed from a
+        :class:`~metrics_tpu.ft.BatchJournal` cursor never double-counts.
+
+    Observability:
+        with ``obs`` enabled, each fused fold is ONE tracked launch
+        (``epoch.launches`` / ``runs`` under the
+        ``step=MetricCollection[N].collection_epoch`` label), and the
+        ``collection.members`` / ``collection.update_groups`` gauges record
+        how many update computations the fusion actually pays for; with
+        ``obs.configure(cost_analysis=True)`` the program's FLOPs/bytes
+        land under the same per-collection label.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Precision
+        >>> from metrics_tpu.steps import make_collection_epoch
+        >>> coll = MetricCollection([Accuracy(num_classes=3),
+        ...                          Precision(num_classes=3, average='macro')])
+        >>> init, epoch, compute = make_collection_epoch(coll)
+        >>> preds = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]])  # 2 batches
+        >>> target = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]])
+        >>> state, _ = epoch(init(), preds, target)  # ONE launch
+        >>> float(compute(state)['Accuracy'])
+        0.75
+    """
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.obs.recompile import note_collection_fusion as _obs_collection
+    from metrics_tpu.utilities.checks import shared_input_format_scope
+
+    if not isinstance(collection, MetricCollection):
+        raise TypeError(
+            f"make_collection_epoch expects a MetricCollection, got {type(collection).__name__};"
+            " use make_epoch for a single metric."
+        )
+
+    plan = _collection_fusion_plan(collection, axis_name, with_values)
+    children, groupable = plan["children"], plan["groupable"]
+    subs, local_subs, synced_compute = plan["subs"], plan["local_subs"], plan["synced_compute"]
+    _named, resolve_groups = plan["named"], plan["resolve_groups"]
+    label = plan["label"]
+    _epoch_label = f"{label}.collection_epoch"
+    _compute_label = f"{label}.collection_compute"
+    _epoch_token, _compute_token = object(), object()
+
+    def _flatten_leaf(a: Any) -> Any:
+        return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]) if _is_array(a) else a
+
+    def _group_fold_flat(state, rep, members, flat_args, flat_kwargs, new_state):
+        """Merge-combinable group, no values: ONE update over the flattened
+        epoch, merged into every member's carry (valid by the same invariant
+        the DDP gather-reduce sync relies on)."""
+        m_rep = children[rep]
+        li, ls, _ = local_subs[rep]
+        batch_state, _ = ls(li(), *flat_args, **m_rep._filter_kwargs(**flat_kwargs))
+        for name in members:
+            reds = children[name]._reductions
+            new_state[name] = {
+                k: _MERGE_OPS[reds[k]](state[name][k], batch_state[k]) for k in batch_state
+            }
+        return None
+
+    def _group_fold_vmap(state, rep, members, args, kwargs, new_state, values):
+        """Merge-combinable group with values (or inputs without a sample
+        axis): per-batch contributions under one vmap, folded down the
+        epoch axis by each state's declared reduction."""
+        m_rep = children[rep]
+        li, ls, _ = local_subs[rep]
+        fk = sorted(m_rep._filter_kwargs(**kwargs))
+        leaves = list(args) + [kwargs[k] for k in fk]
+        axes = tuple(0 if _is_array(a) else None for a in leaves)
+        n_pos = len(args)
+
+        def contrib(*flat):
+            s, _ = ls(li(), *flat[:n_pos], **dict(zip(fk, flat[n_pos:])))
+            return s
+
+        batch_states = jax.vmap(contrib, in_axes=axes)(*leaves)
+        for name in members:
+            reds = children[name]._reductions
+            new_state[name] = {
+                k: _MERGE_OPS[reds[k]](state[name][k], _FOLD_OPS[reds[k]](rows))
+                for k, rows in batch_states.items()
+            }
+            if values is not None:
+                values[name] = jax.vmap(local_subs[name][2])(batch_states)
+
+    def _solo_fold_scan(state, name, args, kwargs, new_state, values):
+        """Non-mergeable member: its own sub-step over the epoch axis,
+        inside the same traced program — first batch unrolled (so a
+        CapacityBuffer carry allocates its data buffer, fixing the pytree
+        structure the scan requires to be static), remaining batches
+        scanned."""
+        m = children[name]
+        _, sub_step, _ = subs[name]
+        fk = sorted(m._filter_kwargs(**kwargs))
+        leaves = list(args) + [kwargs[k] for k in fk]
+        n_pos = len(args)
+        scanned_idx = [i for i, a in enumerate(leaves) if _is_array(a)]
+        static = {i: a for i, a in enumerate(leaves) if i not in scanned_idx}
+
+        def _at(batch_index):
+            return [
+                static[i] if i in static else leaves[i][batch_index] for i in range(len(leaves))
+            ]
+
+        first = _at(0)
+        s1, v1 = sub_step(state[name], *first[:n_pos], **dict(zip(fk, first[n_pos:])))
+        n_batches = leaves[scanned_idx[0]].shape[0] if scanned_idx else 1
+        if n_batches <= 1:
+            new_state[name] = s1
+            if values is not None:
+                values[name] = jax.tree_util.tree_map(lambda v: v[None], v1)
+            return
+
+        def body(s, xs):
+            merged = [
+                static[i] if i in static else xs[scanned_idx.index(i)] for i in range(len(leaves))
+            ]
+            s2, value = sub_step(s, *merged[:n_pos], **dict(zip(fk, merged[n_pos:])))
+            return s2, (value if values is not None else None)
+
+        new_state[name], vals = jax.lax.scan(
+            body, s1, tuple(leaves[i][1:] for i in scanned_idx)
+        )
+        if values is not None:
+            values[name] = jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a[None], b], axis=0), v1, vals
+            )
+
+    def epoch_body(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
+        _obs_note_trace(_epoch_label, _epoch_token)
+        with _obs_span(_epoch_label, category="epoch"):
+            leaves = list(batches) + list(kw_batches.values())
+            flatable = all(getattr(a, "ndim", 0) >= 2 for a in leaves if _is_array(a))
+            if flatable and not with_values:
+                # flat path: group on the flattened shapes the contributions
+                # actually run with
+                flat_args = tuple(_flatten_leaf(a) for a in batches)
+                flat_kwargs = {k: _flatten_leaf(v) for k, v in kw_batches.items()}
+                groups = resolve_groups(flat_args, flat_kwargs)
+            else:
+                # vmap path: group on one batch slice — the shapes the
+                # vmapped per-batch contributions see (the slice is dead
+                # code under the trace; XLA DCEs it)
+                flat_args, flat_kwargs = batches, kw_batches
+                groups = resolve_groups(
+                    tuple(a[0] if _is_array(a) and getattr(a, "ndim", 0) >= 1 else a for a in batches),
+                    {
+                        k: (v[0] if _is_array(v) and getattr(v, "ndim", 0) >= 1 else v)
+                        for k, v in kw_batches.items()
+                    },
+                )
+            _obs_collection(_epoch_label, len(children), len(groups))
+            new_state: State = {}
+            values: Optional[Dict[str, Any]] = {} if with_values else None
+            with shared_input_format_scope():
+                for rep, members in groups:
+                    if not groupable[rep]:
+                        _solo_fold_scan(state, rep, batches, kw_batches, new_state, values)
+                    elif not with_values and flatable:
+                        _group_fold_flat(state, rep, members, flat_args, flat_kwargs, new_state)
+                    else:
+                        _group_fold_vmap(state, rep, members, batches, kw_batches, new_state, values)
+            return new_state, (_named(values) if with_values else None)
+
+    def compute_body(state: State) -> Dict[str, Any]:
+        _obs_note_trace(_compute_label, _compute_token)
+        with _obs_span(_compute_label, category="compute"):
+            return _named(
+                {
+                    name: (
+                        synced_compute[name](state[name])
+                        if groupable[name]
+                        else subs[name][2](state[name])
+                    )
+                    for name in children
+                }
+            )
+
+    if jit_epoch:
+        raw_jitted = jax.jit(epoch_body, donate_argnums=0)
+        jitted = _obs_track_compiles(raw_jitted, _epoch_label)
+
+        def epoch(
+            state: State,
+            *batches: Any,
+            resume_from: Any = None,
+            epoch_index: Optional[int] = None,
+            **kw_batches: Any,
+        ) -> Tuple[State, Any]:
+            if resume_from is not None:
+                batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
+                if done:
+                    return state, None
+            leaves = list(batches) + list(kw_batches.values())
+            n_batches = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
+            _obs_epoch_launch(_epoch_label, n_batches)
+            return jitted(state, *batches, **kw_batches)
+
+        epoch.__wrapped__ = raw_jitted
+        for attr in ("lower", "eval_shape", "trace", "clear_cache"):
+            if hasattr(raw_jitted, attr):
+                setattr(epoch, attr, getattr(raw_jitted, attr))
+    else:
+        _inner_epoch = _obs_time_launch(epoch_body, _epoch_label)
+
+        def epoch(  # noqa: F811
+            state: State,
+            *batches: Any,
+            resume_from: Any = None,
+            epoch_index: Optional[int] = None,
+            **kw_batches: Any,
+        ) -> Tuple[State, Any]:
+            if resume_from is not None:
+                batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
+                if done:
+                    return state, None
+            return _inner_epoch(state, *batches, **kw_batches)
+
+    # dynamic-count states (CapacityBuffer, cat lists) need concrete fill
+    # counts at compute time — their compute cannot be jitted blind
+    jit_computable = all(
+        not any(isinstance(d, (CapacityBuffer, list)) for d in m._defaults.values())
+        for m in children.values()
+    )
+    if jit_epoch and axis_name is None and jit_computable:
+        # fused whole-collection compute: one further launch for every
+        # member's final value (per-member eager computes would be N
+        # launches). Not donated: callers keep folding after a mid-sweep
+        # compute. XLA may fuse/reassociate float ops inside a member's
+        # compute differently than the eager op-by-op dispatch, so float
+        # values can differ from the eager path by an ulp; folded STATES
+        # are bitwise-identical.
+        compute = _obs_track_compiles(jax.jit(compute_body), _compute_label)
+    else:
+        # under a mesh axis the collectives must trace inside the caller's
+        # shard_map program (and buffer-state members need eager counts),
+        # so the function stays open
+        compute = compute_body
+
+    return plan["init"], epoch, compute

@@ -18,6 +18,8 @@ retrieval checks :501-606), re-designed for the XLA compilation model:
 The normalized output contract matches the reference: binary int tensors of
 shape ``(N, C)`` or ``(N, C, X)`` plus the resolved ``DataType`` case.
 """
+import threading
+from contextlib import contextmanager
 from typing import Optional, Tuple
 
 import jax
@@ -27,6 +29,59 @@ from metrics_tpu.utilities.data import select_topk, to_onehot
 from metrics_tpu.utilities.enums import DataType
 
 Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# Shared input-format memo (collection fusion)
+# ---------------------------------------------------------------------------
+
+_FORMAT_SCOPE = threading.local()
+
+
+@contextmanager
+def shared_input_format_scope():
+    """Memoize :func:`_input_format_classification` for the enclosed block.
+
+    A ``MetricCollection`` hands the SAME ``preds``/``target`` objects to
+    every member, and each member's ``update`` re-runs the whole input
+    normalization/format-check pass. Inside this scope the pass is keyed by
+    the input identities plus every normalization parameter, so N members
+    sharing one parameterization pay for it once — under a trace this also
+    guarantees ONE normalization subgraph per parameterization by
+    construction, instead of relying on XLA CSE to merge N copies.
+
+    Yields a stats dict (``{"hits": int, "misses": int}``) so callers and
+    tests can assert the reuse. Reentrant: a nested scope shares the outer
+    cache (and the outer scope's stats keep counting). Outputs are consumed
+    read-only by every caller, which is what makes sharing them safe.
+    """
+    cache = getattr(_FORMAT_SCOPE, "cache", None)
+    created = cache is None
+    if created:
+        cache = _FORMAT_SCOPE.cache = {}
+        stats = _FORMAT_SCOPE.stats = {"hits": 0, "misses": 0}
+    else:
+        stats = _FORMAT_SCOPE.stats
+    try:
+        yield stats
+    finally:
+        if created:
+            _FORMAT_SCOPE.cache = None
+            _FORMAT_SCOPE.stats = None
+
+
+def _format_cache_lookup(key):
+    cache = getattr(_FORMAT_SCOPE, "cache", None)
+    if cache is None:
+        return None, None
+    hit = cache.get(key)
+    if hit is not None:
+        _FORMAT_SCOPE.stats["hits"] += 1
+        from metrics_tpu.obs.registry import enabled as _obs_enabled
+        from metrics_tpu.obs.registry import inc as _obs_inc
+
+        if _obs_enabled():
+            _obs_inc("collection.format_reuse")
+    return cache, hit
 
 
 def _is_floating(x: Array) -> bool:
@@ -269,7 +324,19 @@ def _input_format_classification(
     * multi-label: thresholded/top-k, both ``(N, C)`` with trailing dims flattened
       (``multiclass=True`` -> ``(N, 2, C)``)
     * multi-dim multi-class: both ``(N, C, X)`` (``multiclass=False`` -> ``(N, X)``)
+
+    Inside :func:`shared_input_format_scope` the whole pass is memoized by
+    input identity + parameters, so a collection's members sharing one
+    parameterization normalize once.
     """
+    key = (id(preds), id(target), threshold, top_k, num_classes, multiclass, ignore_index, validate_args)
+    cache, hit = _format_cache_lookup(key)
+    if hit is not None:
+        return hit[0]
+    if cache is not None:
+        _FORMAT_SCOPE.stats["misses"] += 1
+        raw_preds, raw_target = preds, target
+
     preds, target = _input_squeeze(preds, target)
     if preds.dtype in (jnp.float16, jnp.bfloat16):
         preds = preds.astype(jnp.float32)
@@ -318,7 +385,12 @@ def _input_format_classification(
     if preds.ndim > 2 and preds.shape[-1] == 1:
         preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
 
-    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+    out = (preds.astype(jnp.int32), target.astype(jnp.int32), case)
+    if cache is not None:
+        # the raw inputs ride in the entry to pin their ids for the scope's
+        # life (the foreign_coercion_scope trick)
+        cache[key] = (out, raw_preds, raw_target)
+    return out
 
 
 # ---------------------------------------------------------------------------

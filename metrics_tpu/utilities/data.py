@@ -231,6 +231,12 @@ def _bincount(x: Array, minlength: int) -> Array:
     counts fall back to the scatter path.
     """
     x = x.reshape(-1)
+    if jax.default_backend() == "tpu" and 0 < x.shape[0] and minlength <= 2048:
+        # streaming pallas tile: bin block VMEM-resident, one input pass
+        # (ops/confusion_bincount; same drop-out-of-range contract)
+        from metrics_tpu.ops.confusion_bincount import bincount_counts
+
+        return bincount_counts(x, minlength)
     if minlength <= _BINCOUNT_ONEHOT_MAX:
         return jnp.sum(
             x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :], axis=0, dtype=jnp.int32

@@ -1,0 +1,459 @@
+"""Whole-collection fusion (``make_collection_epoch`` / ``make_collection_step``).
+
+Pins the round-7 contract: an entire ``MetricCollection`` folds in ONE
+jitted launch per epoch (launch count asserted via obs counters), members
+with provably identical update programs share one update computation, the
+input format pass runs once per parameterization, and the fused results are
+bitwise-identical to the per-metric paths — across dtypes, active compute
+groups, ``axis_name`` mesh sync, and exactly-once journal resume.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Metric,
+    MetricCollection,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+    make_collection_epoch,
+    make_collection_step,
+    make_epoch,
+)
+
+N_CLASSES = 5
+N_BATCHES = 4
+BATCH = 64
+
+
+def _twelve_metric_collection(c=N_CLASSES, **kwargs):
+    return MetricCollection(
+        {
+            "acc": Accuracy(num_classes=c),
+            "prec": Precision(num_classes=c, average="macro"),
+            "rec": Recall(num_classes=c, average="macro"),
+            "f1": F1Score(num_classes=c, average="macro"),
+            "spec": Specificity(num_classes=c, average="macro"),
+            "stat": StatScores(num_classes=c, reduce="macro"),
+            "fbeta": FBetaScore(num_classes=c, beta=2.0, average="macro"),
+            "confmat": ConfusionMatrix(num_classes=c),
+            "kappa": CohenKappa(num_classes=c),
+            "mcc": MatthewsCorrCoef(num_classes=c),
+            "jaccard": JaccardIndex(num_classes=c),
+            "hamming": HammingDistance(),
+        },
+        **kwargs,
+    )
+
+
+def _epoch_data(seed=0, dtype=np.float32, batches=N_BATCHES, batch=BATCH, c=N_CLASSES):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.normal(size=(batches, batch, c)).astype(dtype))
+    target = jnp.asarray(rng.integers(0, c, (batches, batch)))
+    return preds, target
+
+
+def _eager_reference(coll, preds, target, epochs=1):
+    eager = coll.clone()
+    eager.reset()
+    for _ in range(epochs):
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+    return eager
+
+
+def _assert_outputs_match(out, want):
+    """Integer outputs exactly; float outputs to within jit-fusion ulps (the
+    fused one-launch compute lets XLA reassociate float ops inside a
+    member's compute — folded STATES are pinned bitwise separately)."""
+    assert set(out) == set(want)
+    for name in out:
+        got, exp = np.asarray(out[name]), np.asarray(want[name])
+        if np.issubdtype(got.dtype, np.integer):
+            np.testing.assert_array_equal(got, exp, err_msg=name)
+        else:
+            np.testing.assert_allclose(got, exp, rtol=2e-6, atol=1e-7, err_msg=name)
+
+
+class TestFusedCollectionParity:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float16])
+    def test_twelve_metric_bitwise_parity_vs_eager(self, dtype):
+        """The acceptance config: 12 classification metrics. Folded states
+        are bitwise-identical to the eager per-metric loop; outputs are
+        exact for count-valued metrics and within jit-fusion ulps for
+        float computes."""
+        coll = _twelve_metric_collection()
+        preds, target = _epoch_data(seed=0, dtype=dtype)
+        init, epoch, compute = make_collection_epoch(coll)
+        state = init()
+        for _ in range(2):
+            state, _ = epoch(state, preds, target)
+        out = compute(state)
+
+        eager = _eager_reference(coll, preds, target, epochs=2)
+        want = eager.compute()  # aliases group state onto every member first
+        # STATE parity is bitwise, member by member (items() materializes
+        # copies of the representative state post-compute)
+        for name, member in eager.items(keep_base=True):
+            for key, value in member.state_pytree().items():
+                np.testing.assert_array_equal(
+                    np.asarray(state[name][key]), np.asarray(value), err_msg=f"{name}.{key}"
+                )
+        _assert_outputs_match(out, want)
+
+    def test_state_bitwise_parity_vs_per_metric_epoch(self):
+        """Folded member states equal each member's own make_epoch states
+        bitwise — the fused program changes launch count, not arithmetic."""
+        coll = _twelve_metric_collection()
+        preds, target = _epoch_data(seed=1)
+        init, epoch, _ = make_collection_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+
+        for name, member in coll.items(keep_base=True, copy_state=False):
+            mi, me, _ = make_epoch(member.clone())
+            ms, _ = me(mi(), preds, target)
+            for key in ms:
+                np.testing.assert_array_equal(
+                    np.asarray(ms[key]), np.asarray(state[name][key]), err_msg=f"{name}.{key}"
+                )
+
+    def test_bf16_preds_parity(self):
+        """bf16 scores: the fused fold binarizes identically to eager."""
+        rng = np.random.default_rng(2)
+        preds = jnp.asarray(rng.normal(size=(N_BATCHES, BATCH, N_CLASSES)), dtype=jnp.bfloat16)
+        target = jnp.asarray(rng.integers(0, N_CLASSES, (N_BATCHES, BATCH)))
+        coll = _twelve_metric_collection()
+        init, epoch, compute = make_collection_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+        out = compute(state)
+        _assert_outputs_match(out, _eager_reference(coll, preds, target).compute())
+
+    def test_int_label_preds_parity(self):
+        """Integer label predictions (no score axis) fold identically."""
+        rng = np.random.default_rng(3)
+        preds = jnp.asarray(rng.integers(0, N_CLASSES, (N_BATCHES, BATCH)))
+        target = jnp.asarray(rng.integers(0, N_CLASSES, (N_BATCHES, BATCH)))
+        coll = MetricCollection(
+            {
+                # (no ConfusionMatrix here: its update infers num_classes
+                # from label values, which is untraceable — a preexisting
+                # limitation of that metric under jit, not of fusion)
+                "prec": Precision(num_classes=N_CLASSES, average="macro"),
+                "rec": Recall(num_classes=N_CLASSES, average="macro"),
+                "stat": StatScores(num_classes=N_CLASSES, reduce="macro"),
+            }
+        )
+        init, epoch, compute = make_collection_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+        out = compute(state)
+        _assert_outputs_match(out, _eager_reference(coll, preds, target).compute())
+
+    def test_with_values_matches_per_batch_forward(self):
+        coll = MetricCollection(
+            {
+                "acc": Accuracy(num_classes=N_CLASSES),
+                "prec": Precision(num_classes=N_CLASSES, average="macro"),
+                "rec": Recall(num_classes=N_CLASSES, average="macro"),
+            }
+        )
+        preds, target = _epoch_data(seed=4)
+        init, epoch, compute = make_collection_epoch(coll, with_values=True)
+        state, values = epoch(init(), preds, target)
+        assert set(values) == {"acc", "prec", "rec"}
+
+        eager = coll.clone()
+        eager.reset()
+        for b, (p, t) in enumerate(zip(preds, target)):
+            batch_vals = eager(p, t)
+            for name in values:
+                np.testing.assert_allclose(
+                    float(values[name][b]), float(batch_vals[name]), atol=1e-6, err_msg=name
+                )
+        final = compute(state)
+        want = eager.compute()
+        for name in final:
+            np.testing.assert_allclose(float(final[name]), float(want[name]), atol=1e-6)
+
+    def test_non_mergeable_member_scan_fallback(self):
+        """A cat-buffer member (AUROC with sample_capacity) rides a scan
+        INSIDE the same launch; results match eager."""
+        coll = MetricCollection(
+            {
+                "acc": Accuracy(num_classes=None, multiclass=False),
+                "auroc": AUROC(sample_capacity=N_BATCHES * BATCH),
+            }
+        )
+        rng = np.random.default_rng(5)
+        preds = jnp.asarray(rng.uniform(size=(N_BATCHES, BATCH)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, (N_BATCHES, BATCH)))
+        init, epoch, compute = make_collection_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+        out = compute(state)
+        want = _eager_reference(coll, preds, target).compute()
+        for name in out:
+            np.testing.assert_allclose(float(out[name]), float(want[name]), atol=1e-6, err_msg=name)
+
+    def test_collection_step_values_match_forward(self):
+        coll = _twelve_metric_collection()
+        preds, target = _epoch_data(seed=6)
+        init, step, compute = make_collection_step(coll)
+        state = init()
+        eager = coll.clone()
+        eager.reset()
+        for p, t in zip(preds, target):
+            state, values = step(state, p, t)
+            want = eager(p, t)
+            for name in values:
+                np.testing.assert_allclose(
+                    np.asarray(values[name]), np.asarray(want[name]), atol=1e-6, err_msg=name
+                )
+        _assert_outputs_match(compute(state), eager.compute())
+
+    def test_rejects_non_collection(self):
+        with pytest.raises(TypeError, match="MetricCollection"):
+            make_collection_epoch(Accuracy(num_classes=3))
+        with pytest.raises(TypeError, match="MetricCollection"):
+            make_collection_step(Accuracy(num_classes=3))
+
+    def test_make_epoch_routes_collections_to_fusion(self):
+        """make_epoch(collection) IS the fused path (same factory)."""
+        coll = MetricCollection([Accuracy(num_classes=3), Precision(num_classes=3, average="macro")])
+        init, epoch, compute = make_epoch(coll)
+        assert hasattr(epoch, "__wrapped__")  # jitted fused entry
+        preds = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]])
+        target = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]])
+        state, _ = epoch(init(), preds, target)
+        out = compute(state)
+        assert set(out) == {"Accuracy", "Precision"}
+
+
+class TestFusionGroupsAndLaunches:
+    def test_one_launch_per_epoch_and_group_dedup(self):
+        """obs counters pin the fusion: ONE tracked launch per epoch fold,
+        one compile total, and 12 members collapsing to 4 update groups."""
+        import metrics_tpu.obs as obs
+
+        obs.enable()
+        try:
+            obs.reset()
+            coll = _twelve_metric_collection()
+            preds, target = _epoch_data(seed=7)
+            init, epoch, compute = make_collection_epoch(coll)
+            label = "MetricCollection[12].collection_epoch"
+            state = init()
+            for _ in range(3):
+                state, _ = epoch(state, preds, target)
+            assert obs.get_counter("epoch.launches", step=label) == 3
+            assert obs.get_counter("compiles", step=label) == 1
+            assert obs.get_counter("runs", step=label) == 2
+            assert obs.get_counter("epoch.batches_folded", step=label) == 3 * N_BATCHES
+            assert obs.get_gauge("collection.members", step=label) == 12
+            # P/R/F1/Spec/Stat/FBeta share one macro stat-scores update,
+            # the confmat family shares another; Accuracy (micro fast path)
+            # and HammingDistance stand alone
+            assert obs.get_gauge("collection.update_groups", step=label) == 4
+            # the shared input-normalization pass: at least one reuse per
+            # member beyond the first in each parameterization
+            assert obs.get_counter("collection.format_reuse") > 0
+            # fused compute: one more tracked launch for all 12 values
+            compute(state)
+            compute_label = "MetricCollection[12].collection_compute"
+            assert (
+                obs.get_counter("compiles", step=compute_label)
+                + obs.get_counter("runs", step=compute_label)
+                == 1
+            )
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_groups_off_equals_groups_on(self):
+        """compute_groups=False collections fuse identically (grouping is
+        derived from the update programs, not the eager heuristic)."""
+        preds, target = _epoch_data(seed=8)
+        outs = []
+        for flag in (True, False):
+            coll = _twelve_metric_collection(compute_groups=flag)
+            init, epoch, compute = make_collection_epoch(coll)
+            state, _ = epoch(init(), preds, target)
+            outs.append(compute(state))
+        for name in outs[0]:
+            np.testing.assert_array_equal(np.asarray(outs[0][name]), np.asarray(outs[1][name]))
+
+    def test_format_pass_runs_once_per_parameterization(self):
+        """Inside the shared scope the classification input-format pass
+        executes once per distinct parameterization, not once per member."""
+        from metrics_tpu.utilities import checks
+
+        preds, target = _epoch_data(seed=9)
+        p, t = preds[0], target[0]
+        with checks.shared_input_format_scope() as stats:
+            a = checks._input_format_classification(p, t, num_classes=N_CLASSES)
+            b = checks._input_format_classification(p, t, num_classes=N_CLASSES)
+            # a different parameterization is its own entry
+            checks._input_format_classification(p, t, num_classes=N_CLASSES, top_k=2)
+        assert stats == {"hits": 1, "misses": 2}
+        assert a[0] is b[0] and a[1] is b[1]  # the SAME normalized arrays
+
+        # outside any scope: no caching, zero overhead path
+        c = checks._input_format_classification(p, t, num_classes=N_CLASSES)
+        assert c[0] is not a[0]
+
+        # end to end: the eager collection update shares the pass across
+        # members with one parameterization
+        coll = MetricCollection(
+            {
+                "prec": Precision(num_classes=N_CLASSES, average="macro"),
+                "rec": Recall(num_classes=N_CLASSES, average="macro"),
+                "f1": F1Score(num_classes=N_CLASSES, average="macro"),
+            }
+        )
+        with checks.shared_input_format_scope() as outer_stats:
+            coll.update(p, t)
+        assert outer_stats["hits"] >= 2  # rec + f1 reuse prec's pass
+
+
+class TestFusedCollectionMesh:
+    def test_axis_name_sync_parity(self):
+        """Sharded fused epochs: per-device folds + mesh-collective compute
+        equals one global eager accumulation."""
+        n_dev = 8
+        if len(jax.devices()) < n_dev:
+            pytest.skip("needs 8 virtual devices")
+        rng = np.random.default_rng(10)
+        preds = jnp.asarray(rng.normal(size=(n_dev, 2, 16, N_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, N_CLASSES, (n_dev, 2, 16)))
+
+        coll = MetricCollection(
+            {
+                "acc": Accuracy(num_classes=N_CLASSES),
+                "prec": Precision(num_classes=N_CLASSES, average="macro"),
+                "rec": Recall(num_classes=N_CLASSES, average="macro"),
+                "confmat": ConfusionMatrix(num_classes=N_CLASSES),
+            }
+        )
+        init, epoch, compute = make_collection_epoch(coll, axis_name="dp", jit_epoch=False)
+
+        def prog(p, t):
+            state, _ = epoch(init(), p[0], t[0])
+            out = compute(state)
+            return tuple(out[k] for k in sorted(out))
+
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
+        got = jax.jit(
+            jax.shard_map(prog, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds, target)
+
+        eager = coll.clone()
+        eager.reset()
+        eager.update(preds.reshape(-1, N_CLASSES), target.reshape(-1))
+        want = eager.compute()
+        for name, val in zip(sorted(want), got):
+            np.testing.assert_allclose(
+                np.asarray(val), np.asarray(want[name]), atol=1e-6, err_msg=name
+            )
+
+
+class TestFusedCollectionResume:
+    def test_journal_resume_bitwise(self):
+        """resume_from= trims already-folded batches identically for the
+        fused path: a mid-epoch preemption resumed from the journal cursor
+        computes bitwise-identically to an uninterrupted sweep."""
+        from metrics_tpu.ft import BatchJournal, ResumeCursor
+
+        coll = _twelve_metric_collection()
+        preds, target = _epoch_data(seed=11)
+        init, epoch, compute = make_collection_epoch(coll)
+
+        # uninterrupted: 2 epochs
+        full_state = init()
+        for _ in range(2):
+            full_state, _ = epoch(full_state, preds, target)
+        want = compute(full_state)
+
+        # interrupted run: epoch 0 folds fully, then the pre-kill process
+        # folds the first two batches of epoch 1 and records them in the
+        # journal before dying
+        state = init()
+        state, _ = epoch(state, preds, target)
+        state, _ = epoch(state, preds[:2], target[:2])  # what landed before the kill
+        journal = BatchJournal()
+        for b in range(2):
+            journal.record(1, b)
+        # the restarted process replays epoch 1 with the cursor: the two
+        # already-folded leading batches must be trimmed host-side
+        cursor = ResumeCursor(*journal.resume_from)
+        state, _ = epoch(state, preds, target, resume_from=cursor, epoch_index=1)
+        got = compute(state)
+        for name in want:
+            np.testing.assert_array_equal(np.asarray(got[name]), np.asarray(want[name]), err_msg=name)
+
+    def test_fully_folded_epoch_skips_launch(self):
+        from metrics_tpu.ft import ResumeCursor
+
+        coll = MetricCollection([Accuracy(num_classes=3)])
+        preds = jnp.asarray([[0, 1], [2, 1]])
+        target = jnp.asarray([[0, 1], [2, 0]])
+        init, epoch, compute = make_collection_epoch(coll)
+        state, _ = epoch(init(), preds, target)
+        before = jax.tree_util.tree_map(np.asarray, state)
+        state2, values = epoch(state, preds, target, resume_from=ResumeCursor(2, 0), epoch_index=1)
+        assert values is None
+        after = jax.tree_util.tree_map(np.asarray, state2)
+        jax.tree_util.tree_map(np.testing.assert_array_equal, before, after)
+
+
+class TestCustomReductionFusion:
+    def test_registered_reduction_rides_fused_paths(self):
+        """metric.py's register_state_reduction feeds the merge/fold
+        registries end to end: a custom-reduction metric takes the
+        one-launch flat epoch and groups inside a fused collection."""
+        from metrics_tpu import register_state_reduction
+
+        name = "bitor_test"
+        from metrics_tpu import metric as metric_mod
+
+        if name not in metric_mod._CUSTOM_REDUCTIONS:
+            register_state_reduction(name, merge=jnp.bitwise_or)
+
+        class BitsSeen(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("bits", jnp.asarray(0, jnp.int32), dist_reduce_fx=name)
+
+            def update(self, x):
+                self.bits = jnp.bitwise_or(self.bits, jnp.bitwise_or.reduce(x.astype(jnp.int32)))
+
+            def compute(self):
+                return self.bits
+
+        xs = jnp.asarray([[1, 2], [4, 8], [2, 16]])
+        init, epoch, compute = make_epoch(BitsSeen())
+        state, _ = epoch(init(), xs)
+        assert int(compute(state)) == 31
+
+        coll = MetricCollection({"a": BitsSeen(), "b": BitsSeen()})
+        ci, ce, cc = make_collection_epoch(coll)
+        cs, _ = ce(ci(), xs)
+        out = cc(cs)
+        assert int(out["a"]) == 31 and int(out["b"]) == 31
+
+    def test_register_rejects_builtin_override(self):
+        from metrics_tpu import register_state_reduction
+
+        with pytest.raises(ValueError, match="built-in"):
+            register_state_reduction("sum", merge=lambda a, b: a + b)

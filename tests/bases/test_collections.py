@@ -249,3 +249,113 @@ class TestConstructionSafety:
         lst = [Accuracy()]
         MetricCollection(lst, Precision(num_classes=3, average="macro"))
         assert len(lst) == 1
+
+
+class TestGroupDetectionCaching:
+    """Round-7 regression battery: the O(n^2) pairwise group detection runs
+    exactly once — after the first REAL batch, from either entry point —
+    and its verdict is cached."""
+
+    @staticmethod
+    def _counted(monkeypatch):
+        calls = [0]
+        orig = MetricCollection.__dict__["_equal_metric_states"].__func__
+
+        def counting(m1, m2):
+            calls[0] += 1
+            return orig(m1, m2)
+
+        monkeypatch.setattr(MetricCollection, "_equal_metric_states", staticmethod(counting))
+        return calls
+
+    def test_update_path_compares_exactly_once(self, monkeypatch):
+        calls = self._counted(monkeypatch)
+        mc = MetricCollection(
+            [Accuracy(), Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+        )
+        preds, target = _sample()
+        mc.update(preds, target)
+        first = calls[0]
+        assert first > 0  # detection ran on the first real batch
+        for _ in range(10):
+            mc.update(preds, target)
+        assert calls[0] == first  # verdict cached: never compared again
+        assert mc.compute_groups == {0: ["Accuracy"], 1: ["Precision", "Recall"]}
+
+    def test_forward_path_detects_groups_once(self, monkeypatch):
+        """forward() is an update entry point too: groups are discovered
+        after the first real batch, and never re-compared."""
+        calls = self._counted(monkeypatch)
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+        )
+        preds, target = _sample(seed=1)
+        mc(preds, target)
+        assert mc._groups_checked
+        first = calls[0]
+        assert first > 0
+        for _ in range(5):
+            mc(preds, target)
+        assert calls[0] == first
+        assert mc.compute_groups == {0: ["Precision", "Recall"]}
+        # forward-discovered groups dedupe subsequent update() calls
+        mc.update(preds, target)
+        out = mc.compute()
+        eager_p = Precision(num_classes=3, average="macro")
+        for _ in range(7):
+            eager_p.update(preds, target)
+        np.testing.assert_allclose(float(out["Precision"]), float(eager_p.compute()), atol=1e-6)
+
+    def test_all_default_batch_defers_detection(self):
+        """A batch that leaves every state at its default (zero-preserving
+        update) must NOT run detection: all-default same-structure members
+        would falsely merge, silently dropping non-representative updates."""
+
+        class AddX(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("s", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.s = self.s + jnp.sum(x)
+
+            def compute(self):
+                return self.s
+
+        class AddTwiceX(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("s", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.s = self.s + 2 * jnp.sum(x)
+
+            def compute(self):
+                return self.s
+
+        mc = MetricCollection({"a": AddX(), "b": AddTwiceX()})
+        mc.update(jnp.zeros(4))  # states stay at defaults: not a real batch
+        assert not mc._groups_checked
+        mc.update(jnp.ones(4))  # real batch: detect (a and b now differ)
+        assert mc._groups_checked
+        assert mc.compute_groups == {0: ["a"], 1: ["b"]}
+        out = mc.compute()
+        assert float(out["a"]) == 4.0 and float(out["b"]) == 8.0  # no false merge
+
+    def test_forward_after_compute_materializes_aliased_state(self):
+        """compute() aliases group state by reference; a forward right
+        after must materialize copies before members update."""
+        mc = MetricCollection(
+            [Precision(num_classes=3, average="macro"), Recall(num_classes=3, average="macro")]
+        )
+        preds, target = _sample(seed=2)
+        mc.update(preds, target)
+        mc.compute()
+        assert mc._state_is_copy
+        mc(preds, target)  # forward through the aliased state
+        assert not mc._state_is_copy
+        out = mc.compute()
+        eager = Precision(num_classes=3, average="macro")
+        eager.update(preds, target)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(out["Precision"]), float(eager.compute()), atol=1e-6)

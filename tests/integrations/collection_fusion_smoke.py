@@ -1,0 +1,113 @@
+"""CI smoke: whole-collection fusion end-to-end with observability ON.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.collection_fusion_smoke``
+(the CI tier-1 job does; mirrors ``obs_smoke``). Asserts the round-7
+acceptance contract cheaply: the 12-metric classification collection folds
+in ONE tracked launch per epoch, members collapse to 4 update groups, the
+shared input-format pass records reuse, results match the eager per-metric
+loop, the fused whole-collection compute is one further launch, journal
+resume trims identically, and the bench fusion rows plumb through a real
+``--json``-shape record.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    import metrics_tpu.obs as obs
+    from metrics_tpu.ft import ResumeCursor
+    from metrics_tpu.steps import make_collection_epoch
+
+    from benchmarks.bench_collection import fusion_collection
+
+    obs.enable()
+
+    coll = fusion_collection(n_classes=5)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.normal(size=(4, 64, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, (4, 64)))
+
+    init, epoch, compute = make_collection_epoch(coll)
+    label = "MetricCollection[12].collection_epoch"
+    state = init()
+    for _ in range(3):
+        state, _ = epoch(state, preds, target)
+
+    # ONE tracked launch per epoch fold, one compile total, 4 update groups
+    assert obs.get_counter("epoch.launches", step=label) == 3
+    assert obs.get_counter("compiles", step=label) == 1
+    assert obs.get_counter("runs", step=label) == 2
+    assert obs.get_gauge("collection.members", step=label) == 12
+    assert obs.get_gauge("collection.update_groups", step=label) == 4
+    assert obs.get_counter("collection.format_reuse") > 0
+
+    # fused whole-collection compute: one further tracked launch
+    out = compute(state)
+    clabel = "MetricCollection[12].collection_compute"
+    assert obs.get_counter("compiles", step=clabel) + obs.get_counter("runs", step=clabel) == 1
+
+    # eager parity (count metrics exact; float computes within jit fusion ulps)
+    eager = coll.clone()
+    eager.reset()
+    for _ in range(3):
+        for p, t in zip(preds, target):
+            eager.update(p, t)
+    want = eager.compute()
+    assert set(out) == set(want)
+    for name in out:
+        got, exp = np.asarray(out[name]), np.asarray(want[name])
+        if np.issubdtype(got.dtype, np.integer):
+            np.testing.assert_array_equal(got, exp, err_msg=name)
+        else:
+            np.testing.assert_allclose(got, exp, rtol=2e-6, atol=1e-7, err_msg=name)
+
+    # journal resume trims identically for the fused path
+    resumed = init()
+    resumed, _ = epoch(resumed, preds[:2], target[:2])  # pre-kill folds
+    resumed, _ = epoch(resumed, preds, target, resume_from=ResumeCursor(0, 2), epoch_index=0)
+    single = init()
+    single, _ = epoch(single, preds, target)
+    for name in single:
+        for key in single[name]:
+            np.testing.assert_array_equal(
+                np.asarray(resumed[name][key]), np.asarray(single[name][key]), err_msg=f"{name}.{key}"
+            )
+
+    # bench fusion rows plumb through a real record (tiny config)
+    import bench
+    from benchmarks.bench_collection import measure_collection_fusion
+    from benchmarks.compare import rows_by_metric
+
+    tiny = measure_collection_fusion(n=2_000, n_batches=4, reps=1)
+    assert tiny["collection12_launch_count"] == 1.0, tiny
+    rows = [
+        {
+            "metric": name,
+            "value": round(float(v), 3),
+            "unit": "launches" if name.endswith("launch_count") else "ms",
+            "vs_baseline": 1.0,
+        }
+        for name, v in tiny.items()
+    ]
+    record = bench.build_record(rows)
+    parsed = rows_by_metric(record["rows"])
+    assert "collection12_1M_epoch_wallclock" in parsed
+    assert "collection12_launch_count" in parsed
+
+    print(
+        "collection fusion smoke OK:",
+        f"{int(obs.get_gauge('collection.members', step=label))} members ->",
+        f"{int(obs.get_gauge('collection.update_groups', step=label))} update groups,",
+        f"{int(obs.get_counter('epoch.launches', step=label))} epoch launches,",
+        f"format reuse {int(obs.get_counter('collection.format_reuse'))}",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -215,3 +215,37 @@ def test_bench_cli_exposes_compare_flags():
     )
     assert out.returncode == 0, out.stderr
     assert "--compare" in out.stdout and "--compare-threshold" in out.stdout
+
+
+class TestTrendGaps:
+    def test_rounds_missing_a_row_render_as_gaps(self, tmp_path):
+        """Rows added in later rounds (e.g. the round-7 collection_* rows)
+        render as — in earlier columns instead of breaking the table."""
+        old = _fixture_dict()
+        new = copy.deepcopy(old)
+        new["rows"] = new["rows"] + [
+            {"metric": "collection12_1M_epoch_wallclock", "value": 1.5, "unit": "ms"},
+            {"metric": "collection12_launch_count", "value": 1.0, "unit": "launches"},
+        ]
+        p_old = _write(tmp_path, "r01.json", old)
+        p_new = _write(tmp_path, "r02.json", new)
+        table = trend_table([p_old, p_new])
+        assert "collection12_1M_epoch_wallclock | — | 1.500" in table
+        assert "collection12_launch_count | — | 1.000" in table
+
+    def test_bench_cli_trend_mode(self, tmp_path):
+        """bench.py --trend renders the table without running the sweep."""
+        old = _fixture_dict()
+        new = copy.deepcopy(old)
+        new["rows"] = new["rows"] + [
+            {"metric": "collection12_1M_epoch_wallclock", "value": 1.5, "unit": "ms"}
+        ]
+        p_old = _write(tmp_path, "r01.json", old)
+        p_new = _write(tmp_path, "r02.json", new)
+        out = subprocess.run(
+            [sys.executable, "bench.py", "--trend", p_old, p_new],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "# Bench trend" in out.stdout
+        assert "collection12_1M_epoch_wallclock | — | 1.500" in out.stdout

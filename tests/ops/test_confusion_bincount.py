@@ -1,0 +1,101 @@
+"""Pallas confusion-matrix / bincount tiles vs numpy oracle (interpret mode
+on CPU) and the XLA fallbacks; plus the wiring into ``_bincount`` and
+``_confusion_matrix_update``."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops.confusion_bincount import (
+    _bincount_pallas,
+    _confusion_pallas,
+    _confusion_xla,
+    bincount_counts,
+    confusion_counts,
+)
+
+
+def _oracle_confusion(preds, target, c):
+    out = np.zeros((c, c), np.int64)
+    for p, t in zip(preds, target):
+        if 0 <= p < c and 0 <= t < c:
+            out[t, p] += 1
+    return out
+
+
+@pytest.mark.parametrize("n,c", [(16, 3), (1000, 10), (4096, 128), (2048, 2)])
+def test_confusion_xla_matches_oracle(n, c):
+    rng = np.random.default_rng(0)
+    preds = rng.integers(0, c, n).astype(np.int32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    got = _confusion_xla(jnp.asarray(preds), jnp.asarray(target), c)
+    np.testing.assert_array_equal(np.asarray(got), _oracle_confusion(preds, target, c))
+
+
+@pytest.mark.parametrize("n,c", [(16, 3), (1000, 10), (5000, 64), (2048, 128)])
+def test_confusion_pallas_interpret_matches_oracle(n, c):
+    rng = np.random.default_rng(1)
+    preds = rng.integers(0, c, n).astype(np.int32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    got = _confusion_pallas(jnp.asarray(preds), jnp.asarray(target), c, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), _oracle_confusion(preds, target, c))
+
+
+def test_confusion_out_of_range_dropped():
+    """Out-of-range ids (incl. the -1 padding sentinel) contribute nothing."""
+    preds = np.asarray([0, 1, -1, 5, 2], np.int32)
+    target = np.asarray([0, -1, 1, 1, 7], np.int32)
+    want = _oracle_confusion(preds, target, 3)  # only the (0, 0) pair lands
+    got_xla = _confusion_xla(jnp.asarray(preds), jnp.asarray(target), 3)
+    got_pl = _confusion_pallas(jnp.asarray(preds), jnp.asarray(target), 3, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_xla), want)
+    np.testing.assert_array_equal(np.asarray(got_pl), want)
+
+
+def test_confusion_non_block_multiple():
+    """Sample counts that are not a block multiple pad without contributing."""
+    rng = np.random.default_rng(2)
+    n, c = 2048 + 37, 7
+    preds = rng.integers(0, c, n).astype(np.int32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    got = _confusion_pallas(jnp.asarray(preds), jnp.asarray(target), c, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), _oracle_confusion(preds, target, c))
+
+
+@pytest.mark.parametrize("n,m", [(10, 4), (1000, 100), (5000, 513), (2048, 2048)])
+def test_bincount_pallas_interpret_matches_numpy(n, m):
+    rng = np.random.default_rng(3)
+    x = rng.integers(0, m, n).astype(np.int32)
+    got = _bincount_pallas(jnp.asarray(x), m, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.bincount(x, minlength=m))
+
+
+def test_bincount_counts_cpu_fallback_matches_numpy():
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 50, 777).astype(np.int32)
+    got = bincount_counts(jnp.asarray(x), 50)
+    np.testing.assert_array_equal(np.asarray(got), np.bincount(x, minlength=50))
+
+
+def test_confusion_counts_cpu_fallback_matches_oracle():
+    rng = np.random.default_rng(5)
+    preds = rng.integers(0, 9, 333).astype(np.int32)
+    target = rng.integers(0, 9, 333).astype(np.int32)
+    got = confusion_counts(jnp.asarray(preds), jnp.asarray(target), 9)
+    np.testing.assert_array_equal(np.asarray(got), _oracle_confusion(preds, target, 9))
+
+
+def test_confusion_matrix_metric_unchanged():
+    """The metric-level confusion matrix keeps its exact counts through the
+    rewired update (CPU: bincount path below 64 classes, chunk-scanned MXU
+    contraction above)."""
+    from metrics_tpu import ConfusionMatrix
+
+    rng = np.random.default_rng(6)
+    for c in (5, 80):
+        preds = jnp.asarray(rng.normal(size=(500, c)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, c, 500))
+        m = ConfusionMatrix(num_classes=c)
+        m.update(preds, target)
+        want = _oracle_confusion(np.asarray(preds).argmax(1), np.asarray(target), c)
+        np.testing.assert_array_equal(np.asarray(m.compute()), want)
