@@ -558,6 +558,39 @@ def bench_streaming(n: int = N_SAMPLES) -> dict:
     return out
 
 
+def bench_serve(n_clients: int = 1000) -> dict:
+    """Serving-tier sustained aggregation: 1k clients, 3-level tree.
+
+    - ``serve_ingest_merges_per_s`` — client-snapshot merges folded per
+      second across every node of a root + 4 intermediate + 16 leaf
+      :class:`~metrics_tpu.serve.AggregationTree` while 1000 simulated
+      clients ship two cumulative sketch snapshots each (RATE row,
+      ``unit="/s"``: higher is better, the gate inverts).
+    - ``serve_ingest_p99_ms`` — p99 of the per-payload ingest latency
+      (decode + validate + queue wait + dedup + snapshot store) from the
+      ``serve.ingest_ms`` obs histogram.
+
+    Payload encoding happens outside the timed window (client-side cost);
+    the rows measure the aggregation tier. The run folds the same
+    ``run_loadgen`` harness the serve smoke pins bitwise (``verify=True``
+    there; skipped here — verification is correctness, not speed).
+    """
+    from metrics_tpu.serve.loadgen import run_loadgen
+
+    out = run_loadgen(
+        n_clients=n_clients,
+        fan_out=(4, 16),
+        payloads_per_client=2,
+        samples_per_payload=256,
+        num_bins=256,
+        verify=False,
+    )
+    return {
+        "serve_ingest_merges_per_s": out["serve_ingest_merges_per_s"],
+        "serve_ingest_p99_ms": out["serve_ingest_p99_ms"],
+    }
+
+
 def bench_probes() -> dict:
     """Chip-state calibration probes, one per op class.
 
@@ -653,14 +686,23 @@ def bench_probes() -> dict:
 # Shared with the --compare gate so the two can never disagree about a
 # row's calibration class.
 from benchmarks.compare import PROBE_CLASS as _PROBE_CLASS  # noqa: E402
+from benchmarks.compare import is_rate_metric as _is_rate  # noqa: E402
 
 
-def _prior_rounds() -> list:
-    """Per-file {metric: value} dicts from BENCH_r*.json tails, in order."""
+def _prior_rounds() -> tuple:
+    """(per-file {metric: value} dicts in order, names seen with unit "/s").
+
+    Rate-ness must ride along: the per-round dicts drop the row's ``unit``
+    field, and ``is_rate_metric(name)`` alone only knows the ``*_per_s``
+    naming convention — a rate row identified solely by its unit would
+    otherwise get min() (worst prior) in the best-prior scans below,
+    silently disarming the throughput gate.
+    """
     import glob
     import os
 
     rounds = []
+    rate_names: set = set()
     here = os.path.dirname(os.path.abspath(__file__))
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
@@ -679,18 +721,26 @@ def _prior_rounds() -> list:
                 continue
             name, value = row.get("metric"), row.get("value")
             if isinstance(value, (int, float)) and value > 0:
-                rows[name] = min(rows.get(name, float("inf")), float(value))
+                if _is_rate(name, row):  # throughput: best = highest
+                    rate_names.add(name)
+                    rows[name] = max(rows.get(name, 0.0), float(value))
+                else:
+                    rows[name] = min(rows.get(name, float("inf")), float(value))
         if rows:
             rounds.append(rows)
-    return rounds
+    return rounds, rate_names
 
 
 def _best_prior_values() -> dict:
-    """Best (lowest) prior-round value per metric, across BENCH_r*.json."""
+    """Best prior-round value per metric (lowest; highest for rate rows)."""
     best: dict = {}
-    for rows in _prior_rounds():
+    rounds, rate_names = _prior_rounds()
+    for rows in rounds:
         for name, value in rows.items():
-            best[name] = min(best.get(name, float("inf")), value)
+            if name in rate_names or _is_rate(name):
+                best[name] = max(best.get(name, 0.0), value)
+            else:
+                best[name] = min(best.get(name, float("inf")), value)
     return best
 
 
@@ -705,11 +755,17 @@ def _best_prior_normalized() -> dict:
     comparison with the confound note.
     """
     best: dict = {}
-    for rows in _prior_rounds():
+    rounds, rate_names = _prior_rounds()
+    for rows in rounds:
         for name, probe in _PROBE_CLASS.items():
             if name in rows and rows.get(probe, 0) > 0:
-                ratio = rows[name] / rows[probe]
-                best[name] = min(best.get(name, float("inf")), ratio)
+                if name in rate_names or _is_rate(name):
+                    # throughput x probe latency is the chip-invariant
+                    # quantity for a rate row; best = highest
+                    best[name] = max(best.get(name, 0.0), rows[name] * rows[probe])
+                else:
+                    ratio = rows[name] / rows[probe]
+                    best[name] = min(best.get(name, float("inf")), ratio)
     return best
 
 
@@ -784,7 +840,9 @@ def main(
             "metric": name,
             "value": round(ours_ms, 3),
             "unit": unit,
-            "vs_baseline": round(base_ms / ours_ms, 3),
+            # >1 always means "better than baseline": time ratio for
+            # latency rows, value ratio for rate ("/s") rows
+            "vs_baseline": round(ours_ms / base_ms if unit == "/s" else base_ms / ours_ms, 3),
             "baseline": baseline,
         }
         # bimodal-chip protocol (benchmarks/_timing.py): the value IS the
@@ -818,16 +876,37 @@ def main(
         # the best prior ratio whenever a probe-bearing round exists — the
         # chip's per-op-class state cancels out of the ratio. Rounds
         # predating the probes can only be compared raw (confounded).
+        # Rate rows (unit="/s": higher is better) gate INVERTED, on the
+        # throughput x probe-latency product (the chip-invariant quantity).
         probe = _PROBE_CLASS.get(name)
         probe_now = session_probe_values.get(probe)
         norm_best = prior_norm.get(name)
         if probe_now and norm_best is not None:
+            if unit == "/s":
+                product = float(ours_ms) * probe_now
+                if product < norm_best / 1.5:
+                    print(
+                        f"REGRESSION {name}: throughput x probe {product:.1f} vs best prior"
+                        f" {norm_best:.1f} ({norm_best / product:.2f}x lower) — state-invariant"
+                        " comparison, this is NOT chip-mode noise.",
+                        file=sys.stderr,
+                    )
+                return
             ratio = float(ours_ms) / probe_now
             if ratio > 1.5 * norm_best:
                 print(
                     f"REGRESSION {name}: row/probe ratio {ratio:.1f} vs best prior"
                     f" {norm_best:.1f} ({ratio / norm_best:.2f}x) — state-invariant"
                     " comparison, this is NOT chip-mode noise.",
+                    file=sys.stderr,
+                )
+            return
+        if unit == "/s":
+            if ours_ms < best / 1.5:
+                print(
+                    f"REGRESSION {name}: {float(ours_ms):.1f}/s vs best prior round"
+                    f" {best:.1f}/s ({best / float(ours_ms):.2f}x lower). No probe-bearing"
+                    " prior round exists for a state-invariant comparison.",
                     file=sys.stderr,
                 )
             return
@@ -996,6 +1075,27 @@ def main(
         )
     except Exception as err:  # noqa: BLE001 — streaming rows must not kill the sweep
         print(f"SKIPPED streaming rows: {err}", file=sys.stderr)
+
+    # serving tier: 1000 simulated clients shipping sketch snapshots
+    # through a 3-level aggregation tree — sustained merge throughput
+    # (rate row, gate inverted) and per-payload ingest p99
+    try:
+        serve_rows = section(bench_serve)
+        emit(
+            "serve_ingest_merges_per_s",
+            serve_rows["serve_ingest_merges_per_s"],
+            prior.get("serve_ingest_merges_per_s", serve_rows["serve_ingest_merges_per_s"]),
+            baseline="best_prior_self",
+            unit="/s",
+        )
+        emit(
+            "serve_ingest_p99_ms",
+            serve_rows["serve_ingest_p99_ms"],
+            prior.get("serve_ingest_p99_ms", serve_rows["serve_ingest_p99_ms"]),
+            baseline="best_prior_self",
+        )
+    except Exception as err:  # noqa: BLE001 — serve rows must not kill the sweep
+        print(f"SKIPPED serve rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

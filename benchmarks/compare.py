@@ -82,7 +82,23 @@ PROBE_CLASS: Dict[str, str] = {
     # (collection12_launch_count is a COUNT row — no probe; its raw ratio
     # pins fusion at one launch per epoch)
     "collection12_1M_epoch_wallclock": "probe_elementwise_1Mx10",
+    # serving tier (loadgen through a 3-level tree): the jitted stacked
+    # fold is elementwise/reduce dominated; the host-side decode/dedup
+    # share moves with the same chip state only loosely, so these rows
+    # also carry process_count and the rate row gates INVERTED (see
+    # is_rate_metric)
+    "serve_ingest_merges_per_s": "probe_elementwise_1Mx10",
+    "serve_ingest_p99_ms": "probe_elementwise_1Mx10",
 }
+
+
+def is_rate_metric(name: str, *rows: Any) -> bool:
+    """True for throughput rows (``unit="/s"`` / ``*_per_s``): HIGHER is
+    better, so the regression gate, the best-prior scan and the duplicate
+    keep-best rule all invert for them."""
+    if isinstance(name, str) and name.endswith("_per_s"):
+        return True
+    return any(isinstance(r, dict) and r.get("unit") == "/s" for r in rows)
 
 
 class CompareRefused(RuntimeError):
@@ -100,7 +116,9 @@ def rows_by_metric(rows: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
         if not isinstance(name, str) or not isinstance(value, (int, float)) or value <= 0:
             continue
         prev = out.get(name)
-        if prev is None or value < prev["value"]:
+        if prev is None or (
+            value > prev["value"] if is_rate_metric(name, row) else value < prev["value"]
+        ):
             out[name] = row
     return out
 
@@ -257,14 +275,23 @@ def compare_records(
             )
             continue
         old_v, new_v = _row_value(o), _row_value(n)
-        ratio = new_v / old_v
+        # rate rows (throughput, higher better) gate on the INVERSE ratio
+        # so ">threshold = regression" reads the same for every row; their
+        # probe normalization multiplies instead of divides (throughput
+        # and probe latency scale inversely with the same chip state)
+        rate = is_rate_metric(name, o, n)
+        ratio = (old_v / new_v) if rate else (new_v / old_v)
         probe = PROBE_CLASS.get(name)
         norm_ratio = None
         if probe and probe in old.rows and probe in new.rows:
             old_p, new_p = _row_value(old.rows[probe]), _row_value(new.rows[probe])
             if old_p > 0 and new_p > 0:
-                norm_ratio = (new_v / new_p) / (old_v / old_p)
+                norm_ratio = (
+                    (old_v * old_p) / (new_v * new_p) if rate else (new_v / new_p) / (old_v / old_p)
+                )
         note_parts = []
+        if rate:
+            note_parts.append("rate row (higher is better): Δ× is old/new")
         conf = _row_confidence(o, min_n_fast) or _row_confidence(n, min_n_fast)
         effective = norm_ratio if norm_ratio is not None else ratio
         if name.startswith("probe_"):

@@ -114,6 +114,7 @@ from metrics_tpu.text import (  # noqa: E402, F401
 )
 from metrics_tpu import ft  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
+from metrics_tpu import serve  # noqa: E402, F401
 from metrics_tpu import streaming  # noqa: E402, F401
 from metrics_tpu.metric import register_state_reduction  # noqa: E402, F401
 from metrics_tpu.steps import (  # noqa: E402, F401
@@ -197,6 +198,7 @@ __all__ = [
     "debug_checks",
     "ft",
     "obs",
+    "serve",
     "streaming",
     "MultioutputWrapper",
     "MaxMetric",

@@ -42,6 +42,7 @@ from metrics_tpu.ft.retry import (
     AttemptTimeout,
     DegradedSyncError,
     RetryPolicy,
+    backoff_schedule,
     call_with_retries,
     configure_retries,
     get_retry_policy,
@@ -56,6 +57,7 @@ __all__ = [
     "DegradedSyncError",
     "ResumeCursor",
     "RetryPolicy",
+    "backoff_schedule",
     "call_with_retries",
     "configure_retries",
     "faults",

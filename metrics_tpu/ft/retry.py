@@ -13,6 +13,17 @@ handling there at all (``torchmetrics/utilities/distributed.py:102``: one
   to :attr:`RetryPolicy.max_retries` times, sleeping
   ``backoff_s * backoff_factor**attempt`` (capped at ``max_backoff_s``)
   between attempts; every retry bumps the ``ft.retries{op=...}`` counter.
+* **decorrelated jitter** — with :attr:`RetryPolicy.jitter` set to
+  ``"decorrelated"``, each sleep is drawn uniformly from
+  ``[backoff_s, 3 * previous_sleep]`` (capped at ``max_backoff_s``): a
+  thousand clients that lost the same aggregator at the same instant
+  spread their retries across the window instead of thundering back in
+  lockstep at ``backoff_s, 2*backoff_s, ...``. The randomness is
+  **seeded, never wall-clock**: :attr:`RetryPolicy.jitter_seed` plus the
+  op label (plus each caller's distinct identity folded into the seed)
+  fully determines the schedule — :func:`backoff_schedule` exposes it, so
+  tests pin exact sleep sequences and two processes with different seeds
+  provably decorrelate.
 * **timeout** — with :attr:`RetryPolicy.timeout_s` set, each attempt runs
   in a watchdog thread and a hang counts as a failure. The hung attempt's
   thread cannot be cancelled (the collective owns it); it is abandoned as
@@ -37,7 +48,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, replace
-from typing import Any, Callable, Dict, Optional, Set
+from typing import Any, Callable, Dict, Iterator, Optional, Set
 
 from metrics_tpu.ft import faults as _faults
 from metrics_tpu.obs.registry import enabled as _obs_enabled
@@ -49,6 +60,7 @@ __all__ = [
     "DegradedSyncError",
     "RetryPolicy",
     "active_scope_degraded",
+    "backoff_schedule",
     "call_with_retries",
     "collective_fence_armed",
     "configure_retries",
@@ -94,6 +106,20 @@ class RetryPolicy:
             retry identically, and degrading it would silently turn a bug
             into fleet-wide local-only metric values forever). Transport /
             runtime failures stay retryable.
+        jitter: ``"none"`` (pure exponential — attempt N sleeps
+            ``backoff_s * backoff_factor**N``) or ``"decorrelated"`` —
+            each sleep drawn uniformly from ``[backoff_s, 3 * previous]``,
+            capped at ``max_backoff_s``. Use decorrelated whenever MANY
+            callers share one failure (1k serve clients retrying a downed
+            aggregator): synchronized exponential backoff re-arrives in
+            waves exactly ``backoff_factor`` apart, which is the
+            thundering herd with extra steps.
+        jitter_seed: base seed for the jitter stream. The effective
+            per-call stream is ``sha256(jitter_seed, op)`` — deterministic
+            and pinnable in tests (no wall-clock randomness), while
+            distinct seeds (e.g. a hash of the client id) give distinct,
+            decorrelated schedules. ``None`` draws a fresh OS-entropy seed
+            per call: maximal spread, not reproducible.
     """
 
     max_retries: int = 3
@@ -104,6 +130,8 @@ class RetryPolicy:
     degraded_fallback: bool = True
     retry_on_timeout: bool = False
     non_retryable: tuple = (TypeError, ValueError, AssertionError, NotImplementedError)
+    jitter: str = "none"
+    jitter_seed: Optional[int] = None
 
     def __post_init__(self) -> None:
         # a negative count would run ZERO attempts and "degrade" without
@@ -112,6 +140,10 @@ class RetryPolicy:
             raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
         if self.timeout_s is not None and self.timeout_s <= 0:
             raise ValueError(f"timeout_s must be positive (or None), got {self.timeout_s}")
+        if self.jitter not in ("none", "decorrelated"):
+            raise ValueError(
+                f"jitter must be 'none' or 'decorrelated', got {self.jitter!r}"
+            )
 
 
 _policy = RetryPolicy()
@@ -195,6 +227,39 @@ def active_scope_degraded() -> bool:
     return any(box["degraded"] for box in getattr(_scope_tls, "stack", []) or [])
 
 
+def backoff_schedule(policy: RetryPolicy, op: str = "") -> Iterator[float]:
+    """The policy's deterministic sleep schedule, one delay per retry.
+
+    For ``jitter="none"`` this is the plain capped exponential. For
+    ``jitter="decorrelated"`` it is the seeded decorrelated-jitter chain:
+    ``d_0 ~ U[backoff_s, 3*backoff_s]``, ``d_n ~ U[backoff_s, 3*d_{n-1}]``,
+    every draw capped at ``max_backoff_s``. The stream is a pure function
+    of ``(jitter_seed, op)`` — the property the thundering-herd tests pin
+    (same seed → same schedule; different seeds → decorrelated ones). With
+    ``jitter_seed=None`` the stream seeds from OS entropy per call.
+
+    ``call_with_retries`` consumes exactly this generator, so a pinned
+    schedule in a test is the schedule production sleeps.
+    """
+    if policy.jitter == "none":
+        delay = policy.backoff_s
+        while True:
+            yield min(delay, policy.max_backoff_s)
+            delay *= policy.backoff_factor
+    import hashlib
+    import random
+
+    if policy.jitter_seed is None:
+        rng = random.Random()  # OS entropy: spread, not reproducible
+    else:
+        digest = hashlib.sha256(f"{policy.jitter_seed}:{op}".encode()).digest()
+        rng = random.Random(int.from_bytes(digest[:8], "little"))
+    prev = policy.backoff_s
+    while True:
+        prev = min(rng.uniform(policy.backoff_s, 3.0 * prev), policy.max_backoff_s)
+        yield prev
+
+
 def _attempt(fn: Callable[[], Any], timeout_s: Optional[float], op: str) -> Any:
     _faults.maybe_fail(op)
     if timeout_s is None:
@@ -243,7 +308,7 @@ def call_with_retries(
         ``fn()``'s result, or the fallback's degraded result.
     """
     p = policy if policy is not None else _policy
-    delay = p.backoff_s
+    delays = backoff_schedule(p, op)
     last_error: Optional[BaseException] = None
     attempts = 0
     for attempt in range(p.max_retries + 1):
@@ -261,8 +326,7 @@ def call_with_retries(
             if attempt < p.max_retries:
                 if _obs_enabled():
                     _obs_inc("ft.retries", op=op)
-                time.sleep(min(delay, p.max_backoff_s))
-                delay *= p.backoff_factor
+                time.sleep(next(delays))
     assert last_error is not None
     # report the attempts that actually ran — a no-retry timeout breaks out
     # after ONE, and claiming max_retries+1 would mislead incident triage

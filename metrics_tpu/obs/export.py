@@ -173,9 +173,43 @@ def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
 
 
 def to_json(snap: Optional[Dict[str, Any]] = None, path: Optional[str] = None, indent: int = 2) -> str:
-    """Serialize a snapshot to JSON; optionally also write it to ``path``."""
+    """Serialize a snapshot to JSON; optionally also write it to ``path``.
+
+    The file write is atomic (staged sibling temp file + ``os.replace``,
+    the ``atomic_dir_swap`` idiom): a scraper or a restarting process
+    reading ``path`` mid-write sees either the complete previous snapshot
+    or the complete new one, never a truncated JSON document. On error the
+    stage is discarded and any existing ``path`` is untouched.
+    """
     text = json.dumps(snapshot() if snap is None else snap, indent=indent, sort_keys=True)
     if path is not None:
-        with open(path, "w") as f:
-            f.write(text + "\n")
+        import os
+        import tempfile
+
+        final = os.fspath(os.path.abspath(path))
+        parent = os.path.dirname(final) or "."
+        fd, stage = tempfile.mkstemp(prefix=".tmp.obs.", suffix=".json", dir=parent)
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            # mkstemp creates 0600 regardless of umask; installing that over
+            # an existing snapshot would revoke other readers (a scraper
+            # running as a different user). Preserve the target's mode, or
+            # a plain umask-honoring open()-equivalent for a fresh file.
+            try:
+                mode = os.stat(final).st_mode & 0o7777
+            except OSError:
+                umask = os.umask(0)
+                os.umask(umask)
+                mode = 0o666 & ~umask
+            os.chmod(stage, mode)
+            os.replace(stage, final)
+        except BaseException:
+            try:
+                os.unlink(stage)
+            except OSError:
+                pass
+            raise
     return text

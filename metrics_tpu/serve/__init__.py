@@ -1,0 +1,71 @@
+"""``metrics_tpu.serve`` — the multi-tenant metrics-aggregation runtime.
+
+The reference "is a library, not a runtime: there is no scheduler, server,
+or CLI" (PAPER.md §1). This package is the runtime layer built on the
+primitives the library already proved:
+
+* :mod:`~metrics_tpu.serve.wire` — a versioned, forward-compatible wire
+  format for bounded metric-state payloads (tenant id, client id,
+  ``(epoch, step)`` watermark, schema fingerprint, packed states for every
+  reduction kind including ``dist_reduce_fx="sketch"``).
+* :mod:`~metrics_tpu.serve.aggregator` — :class:`Aggregator`: per-tenant
+  registries, a bounded ingest queue, keep-latest dedup on per-client
+  :class:`~metrics_tpu.ft.BatchJournal` watermarks (exactly-once under
+  duplicates, reordering and restarts), one jitted batched fold per
+  flush, and preemption-safe persistence through
+  :class:`~metrics_tpu.ft.CheckpointManager`.
+* :mod:`~metrics_tpu.serve.tree` — hierarchical aggregation: a node is
+  itself a client of its parent, and the tree fold equals a flat fold of
+  every client bitwise (the sketches' fold-order invariance, pinned in
+  ``tests/serve/test_tree.py``).
+* :mod:`~metrics_tpu.serve.endpoints` — a stdlib ``http.server`` surface:
+  ``/metrics`` Prometheus scrape (off :func:`metrics_tpu.obs.to_prometheus`
+  plus per-tenant value gauges), JSON ``/query`` with the streaming
+  metrics' rigorous ``error_bound()`` envelopes, ``/ingest`` and
+  ``/healthz``.
+* :mod:`~metrics_tpu.serve.loadgen` — the 1k-client / 3-level-tree load
+  generator behind the ``serve_*`` bench rows.
+
+See ``docs/serving.md`` for the architecture and the exactly-once
+semantics.
+"""
+from metrics_tpu.serve.aggregator import (
+    Aggregator,
+    BackpressureError,
+    ServeError,
+    UnknownTenantError,
+)
+from metrics_tpu.serve.endpoints import MetricsServer
+from metrics_tpu.serve.tree import AggregationTree, AggregatorNode
+from metrics_tpu.serve.wire import (
+    MAX_WIRE_BYTES,
+    WIRE_MAJOR,
+    WIRE_MINOR,
+    MetricPayload,
+    SchemaMismatchError,
+    WireFormatError,
+    apply_payload,
+    decode_state,
+    encode_state,
+    schema_fingerprint,
+)
+
+__all__ = [
+    "AggregationTree",
+    "Aggregator",
+    "AggregatorNode",
+    "BackpressureError",
+    "MAX_WIRE_BYTES",
+    "MetricPayload",
+    "MetricsServer",
+    "SchemaMismatchError",
+    "ServeError",
+    "UnknownTenantError",
+    "WIRE_MAJOR",
+    "WIRE_MINOR",
+    "WireFormatError",
+    "apply_payload",
+    "decode_state",
+    "encode_state",
+    "schema_fingerprint",
+]

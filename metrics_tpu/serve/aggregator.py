@@ -1,0 +1,796 @@
+"""Multi-tenant metric-state aggregation runtime.
+
+One :class:`Aggregator` is one long-lived node in the serving tier: it
+hosts a **tenant registry** (tenant id → metric collection schema), accepts
+bounded-size wire payloads from thousands of clients, and maintains the
+live merged state every scrape/query reads. The design rests on three
+choices, each inherited from a primitive that already proved it:
+
+* **cumulative snapshots + keep-latest** — a payload carries the client's
+  *whole* folded state up to its ``(epoch, step)`` watermark (see
+  :mod:`metrics_tpu.serve.wire`), and the aggregator keeps exactly the
+  newest snapshot per client. Duplicates and reordered deliveries reduce
+  to a watermark comparison against the client's
+  :class:`~metrics_tpu.ft.journal.BatchJournal` — a stale or repeated
+  payload is *dropped*, not re-merged, so delivery can be at-least-once
+  while aggregation stays exactly-once.
+* **batched jitted folds** — merging is not done per payload. Accepted
+  snapshots mark their tenant dirty; :meth:`Aggregator.flush` stacks every
+  client's state leaves along a leading axis and folds them in ONE jitted
+  launch per tenant (the ``_FOLD_OPS`` shape ``make_epoch`` uses), with
+  client counts padded to power-of-two buckets using the schema's identity
+  state so the number of distinct traces stays logarithmic. Integer-valued
+  ``sum`` leaves and sketch merges make the fold bitwise fold-order
+  invariant — the property the hierarchical tree test pins
+  (``tests/serve/test_tree.py``).
+* **preemption-safe persistence** — :meth:`save` bundles every tenant's
+  client snapshots + watermarks through
+  :class:`~metrics_tpu.ft.CheckpointManager` (atomic stage+rename,
+  rotation, manifest); :meth:`restore` brings them back bitwise and the
+  restored watermarks keep dedup exact across the restart. Clients resend
+  their latest snapshot on their next interval, so payloads that arrived
+  after the last checkpoint are recovered by the at-least-once delivery,
+  never double-counted.
+
+Observability rides the :mod:`metrics_tpu.obs` registry: per-tenant
+``serve.ingests`` / ``serve.merges`` / ``serve.dedup_drops`` counters, the
+``serve.tenants`` / ``serve.clients`` / ``serve.queue_depth`` gauges and
+``serve.ingest_ms`` / ``serve.flush_ms`` latency histograms — all exported
+by the ``/metrics`` endpoint (:mod:`metrics_tpu.serve.endpoints`).
+"""
+import functools
+import queue
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ft.journal import BatchJournal
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.wire import (
+    MetricPayload,
+    SchemaMismatchError,
+    decode_state,
+    schema_diff,
+    schema_fingerprint,
+    schema_of,
+)
+
+__all__ = [
+    "Aggregator",
+    "BackpressureError",
+    "ServeError",
+    "UnknownTenantError",
+]
+
+# reductions the aggregation fold understands: the merge-combinable family
+# (the same set make_epoch's flattened fast path accepts). "mean" needs
+# per-client weights and "cat" is unbounded — both are exactly what the
+# bounded-state serving contract excludes.
+_SERVABLE_REDUCTIONS = ("sum", "min", "max", "sketch")
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-tier errors."""
+
+
+class UnknownTenantError(ServeError):
+    """Payload names a tenant this aggregator has not registered."""
+
+
+class BackpressureError(ServeError):
+    """Ingest queue full and the caller asked not to block."""
+
+
+@functools.partial(jax.jit, static_argnames=("reds",))
+def _fold_stacked(stacked: Tuple[jax.Array, ...], reds: Tuple[str, ...]) -> Tuple[jax.Array, ...]:
+    """ONE launch folding every leaf's leading client axis with its
+    declared reduction — the whole flush amortizes into this call."""
+    ops = {
+        "sum": lambda m: jnp.sum(m, axis=0),
+        "min": lambda m: jnp.min(m, axis=0),
+        "max": lambda m: jnp.max(m, axis=0),
+    }
+    return tuple(ops[r](s) for s, r in zip(stacked, reds))
+
+
+def _tree_get(tree: Dict[str, Any], path: Tuple[str, ...]) -> Any:
+    node: Any = tree
+    for key in path:
+        node = node[key]
+    return node
+
+
+def _tree_set(tree: Dict[str, Any], path: Tuple[str, ...], leaf: Any) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = leaf
+
+
+class _ClientSlot:
+    """Latest accepted snapshot of one client: journal watermark + the
+    spec-ordered state leaves (numpy, ready to stack)."""
+
+    __slots__ = ("journal", "leaves", "consensus")
+
+    def __init__(self) -> None:
+        self.journal = BatchJournal()
+        self.leaves: List[np.ndarray] = []
+        self.consensus: List[np.ndarray] = []
+
+
+class _Tenant:
+    """Registry entry: schema, leaf layout, client snapshots, merged view."""
+
+    def __init__(self, tenant_id: str, collection: Any) -> None:
+        from metrics_tpu.collections import MetricCollection
+        from metrics_tpu.streaming.sketches import Sketch
+        from metrics_tpu.utilities.checkpoint import metric_state_to_tree
+
+        self.tenant_id = tenant_id
+        if not isinstance(collection, MetricCollection):
+            collection = MetricCollection([collection])
+        self.view = collection  # merged state materializes into this
+        self.view.reset()
+        self.schema = schema_of(self.view)
+        self.schema_hash = schema_fingerprint(self.view)
+
+        # leaf layout: folded leaves carry a (path, reduction); consensus
+        # leaves (sketch meta blobs, detected-mode __aux json) must be
+        # byte-identical across clients and are carried, not folded
+        self.spec: List[Tuple[Tuple[str, ...], str]] = []
+        self.consensus_paths: List[Tuple[str, ...]] = []
+        template_trees: Dict[str, Dict[str, Any]] = {}
+        for member, metric in sorted(self.view.items()):
+            bad = {
+                state: red
+                for state, red in metric._reductions.items()
+                if red not in _SERVABLE_REDUCTIONS
+            }
+            if bad:
+                raise ServeError(
+                    f"tenant {tenant_id!r} member {member!r} has non-servable state"
+                    f" reduction(s) {bad}: the aggregation tier folds bounded"
+                    f" {_SERVABLE_REDUCTIONS} states only. Unbounded cat/buffer"
+                    " accumulations should stream through a mergeable sketch"
+                    " (metrics_tpu.streaming) instead."
+                )
+            tree = metric_state_to_tree(metric)
+            template_trees[member] = tree
+            for state, red in metric._reductions.items():
+                default = metric._defaults[state]
+                if isinstance(default, Sketch):
+                    for leaf_name, leaf_red in type(default)._leaf_fields:
+                        self.spec.append(((member, state, f"__sketch_leaf_{leaf_name}"), leaf_red))
+                    self.consensus_paths.append((member, state, "__sketch_meta"))
+                else:
+                    self.spec.append(((member, state), red))
+            self.spec.append(((member, "__update_count"), "sum"))
+            if "__aux" in tree:
+                self.consensus_paths.append((member, "__aux"))
+        self.spec.sort()
+        self.consensus_paths.sort()
+
+        self.template_leaves = [
+            np.asarray(_tree_get(template_trees, path)) for path, _ in self.spec
+        ]
+        self.template_consensus = [
+            np.asarray(_tree_get(template_trees, path)) for path in self.consensus_paths
+        ]
+        self.can_pad = all(
+            _is_identity(leaf, red) for leaf, (_, red) in zip(self.template_leaves, self.spec)
+        )
+
+        self.clients: Dict[str, _ClientSlot] = {}
+        self.dirty = False
+        self.lock = threading.Lock()
+        # serializes view materialization (fold) against view readers
+        # (query / scrape compute): the jitted fold itself runs outside
+        # both locks, so ingest is never blocked on device compute
+        self.view_lock = threading.Lock()
+        self.merged_leaves: Optional[List[np.ndarray]] = None
+
+    # -- ingest-side -----------------------------------------------------
+
+    def flatten_payload(self, payload: MetricPayload) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        """Spec-ordered (folded leaves, consensus leaves) of a payload,
+        shape/dtype-checked against the template (the schema hash already
+        matched, so a mismatch here means a corrupted body)."""
+        leaves: List[np.ndarray] = []
+        for (path, _), template in zip(self.spec, self.template_leaves):
+            try:
+                # KeyError: leaf missing; IndexError/TypeError: the body
+                # collapsed a dict level into an array (indexing an ndarray
+                # with a string) — all of them are a lying body, not a crash
+                leaf = np.asarray(_tree_get(payload.states, path))
+            except (KeyError, IndexError, TypeError) as err:
+                raise ServeError(
+                    f"payload for tenant {self.tenant_id!r} is missing state leaf"
+                    f" {'/'.join(path)} (schema hash matched — body corrupted?)"
+                ) from err
+            if leaf.shape != template.shape or leaf.dtype != template.dtype:
+                raise ServeError(
+                    f"payload leaf {'/'.join(path)} for tenant {self.tenant_id!r} has"
+                    f" shape/dtype {leaf.shape}/{leaf.dtype}, registered schema expects"
+                    f" {template.shape}/{template.dtype}"
+                )
+            leaves.append(leaf)
+        try:
+            consensus = [np.asarray(_tree_get(payload.states, p)) for p in self.consensus_paths]
+        except (KeyError, IndexError, TypeError) as err:
+            raise ServeError(
+                f"payload for tenant {self.tenant_id!r} is missing a consensus leaf"
+                " (schema hash matched — body corrupted?)"
+            ) from err
+        return leaves, consensus
+
+    # -- fold-side -------------------------------------------------------
+
+    def fold(self) -> int:
+        """Materialize the merged view from every client's latest snapshot
+        in one jitted launch; returns the number of snapshots folded."""
+        from metrics_tpu.utilities.checkpoint import load_metric_state_tree
+
+        with self.lock:
+            order = sorted(self.clients)
+            rows = [[self.clients[cid].leaves[i] for cid in order] for i in range(len(self.spec))]
+            consensus_rows = [
+                [self.clients[cid].consensus[i] for cid in order]
+                for i in range(len(self.consensus_paths))
+            ]
+            self.dirty = False
+        k = len(order)
+        if k == 0:
+            merged = list(self.template_leaves)
+            merged_consensus = list(self.template_consensus)
+        else:
+            for path, row in zip(self.consensus_paths, consensus_rows):
+                first = row[0]
+                for other in row[1:]:
+                    if first.shape != other.shape or not np.array_equal(first, other):
+                        raise ServeError(
+                            f"tenant {self.tenant_id!r}: clients disagree on the"
+                            f" non-foldable leaf {'/'.join(path)} (e.g. detected input"
+                            " mode / sketch meta). All clients of a tenant must run"
+                            " the same metric configuration."
+                        )
+            merged_consensus = [row[0] for row in consensus_rows]
+            pad = (_next_pow2(k) - k) if self.can_pad else 0
+            stacked = tuple(
+                jnp.asarray(np.stack(row + [self.template_leaves[i]] * pad))
+                for i, row in enumerate(rows)
+            )
+            folded = _fold_stacked(stacked, reds=tuple(red for _, red in self.spec))
+            merged = [np.asarray(x) for x in folded]
+
+        tree: Dict[str, Any] = {}
+        for (path, _), leaf in zip(self.spec, merged):
+            _tree_set(tree, path, leaf)
+        for path, leaf in zip(self.consensus_paths, merged_consensus):
+            _tree_set(tree, path, leaf)
+        with self.view_lock:
+            self.merged_leaves = merged
+            load_metric_state_tree(self.view, tree)
+        return k
+
+    @property
+    def folded_payloads(self) -> int:
+        # lock: the background worker inserts client slots concurrently and
+        # an unlocked .values() iteration can see the dict resize mid-walk
+        with self.lock:
+            return sum(slot.journal.folded for slot in self.clients.values())
+
+
+def _is_identity(leaf: np.ndarray, red: str) -> bool:
+    """True when ``leaf`` is the neutral element of ``red`` — the padding
+    the power-of-two fold buckets rely on. Sketch leaves satisfy this by
+    the fresh-sketch-is-identity contract; a tenant whose defaults are not
+    neutral folds at exact client counts instead (more retraces, same
+    values)."""
+    if red == "sum":
+        return bool(np.all(leaf == 0))
+    if leaf.size == 0:
+        return True
+    if np.issubdtype(leaf.dtype, np.floating):
+        target = np.inf if red == "min" else -np.inf
+        return bool(np.all(leaf == target))
+    if np.issubdtype(leaf.dtype, np.integer):
+        info = np.iinfo(leaf.dtype)
+        return bool(np.all(leaf == (info.max if red == "min" else info.min)))
+    return False
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class Aggregator:
+    """A multi-tenant aggregation node: registry + queue + fold + state.
+
+    Args:
+        name: node identity (obs labels, checkpoints, tree client ids).
+        max_queue: bounded ingest queue depth; a full queue blocks the
+            producer (or raises :class:`BackpressureError` with
+            ``block=False``) instead of growing without bound.
+        checkpoint_dir: when set, :meth:`save`/:meth:`restore` persist the
+            whole registry (client snapshots + watermarks) through an
+            atomic rotating :class:`~metrics_tpu.ft.CheckpointManager`.
+        keep_last: checkpoint retention (see the manager).
+        checkpoint_every: automatic :meth:`save` every N flushes
+            (``None`` = manual saves only).
+        flush_interval_s: background worker cadence for :meth:`start`.
+
+    Example::
+
+        agg = Aggregator("root", checkpoint_dir="/tmp/agg")
+        agg.register_tenant("search", lambda: MetricCollection(
+            {"auroc": StreamingAUROC(num_bins=2048)}))
+        agg.restore()          # no-op on fresh start
+        agg.ingest(payload_bytes)
+        agg.flush()
+        print(agg.query("search")["values"]["auroc"])
+    """
+
+    def __init__(
+        self,
+        name: str = "root",
+        *,
+        max_queue: int = 4096,
+        checkpoint_dir: Optional[str] = None,
+        keep_last: Optional[int] = 3,
+        checkpoint_every: Optional[int] = None,
+        flush_interval_s: float = 0.05,
+    ) -> None:
+        if checkpoint_every is not None and checkpoint_every < 1:
+            raise ValueError(f"checkpoint_every must be >= 1 (or None), got {checkpoint_every}")
+        self.name = str(name)
+        self._tenants: Dict[str, _Tenant] = {}
+        self._queue: "queue.Queue[Tuple[MetricPayload, float]]" = queue.Queue(maxsize=max_queue)
+        self._flush_lock = threading.Lock()
+        self._registry_lock = threading.Lock()
+        self._flushes = 0
+        self._checkpoint_every = checkpoint_every
+        self._flush_interval_s = float(flush_interval_s)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._manager = None
+        if checkpoint_dir is not None:
+            from metrics_tpu.ft.manager import CheckpointManager
+
+            self._manager = CheckpointManager(checkpoint_dir, keep_last=keep_last)
+
+    # ------------------------------------------------------------------
+    # Tenant registry
+    # ------------------------------------------------------------------
+
+    def register_tenant(self, tenant_id: str, metrics: Any) -> None:
+        """Register a tenant: ``metrics`` is a Metric / MetricCollection
+        (or a zero-arg factory returning one) defining the tenant's schema.
+        Payloads for the tenant must match its schema fingerprint exactly;
+        a changed sketch bin count / threshold grid is a different schema
+        and is rejected loudly at ingest."""
+        from metrics_tpu.collections import MetricCollection
+        from metrics_tpu.metric import Metric
+
+        tenant_id = str(tenant_id)
+        # Metric instances are callable (forward), so "is it a factory"
+        # must be an isinstance check, not callable()
+        is_factory = callable(metrics) and not isinstance(metrics, (Metric, MetricCollection))
+        collection = metrics() if is_factory else metrics
+        with self._registry_lock:
+            if tenant_id in self._tenants:
+                raise ServeError(f"tenant {tenant_id!r} is already registered")
+            self._tenants[tenant_id] = _Tenant(tenant_id, collection)
+        if _obs_enabled():
+            _obs_gauge("serve.tenants", float(len(self._tenants)))
+
+    def tenants(self) -> List[str]:
+        """Registered tenant ids, sorted."""
+        return sorted(self._tenants)
+
+    def schema_hash(self, tenant_id: str) -> str:
+        return self._tenant(tenant_id).schema_hash
+
+    def client_watermark(self, tenant_id: str, client_id: str) -> Optional[Tuple[int, int]]:
+        """Newest accepted ``(epoch, step)`` for a client, or None."""
+        tenant = self._tenant(tenant_id)
+        slot = tenant.clients.get(str(client_id))
+        return None if slot is None else slot.journal.watermark
+
+    def _tenant(self, tenant_id: str) -> _Tenant:
+        tenant = self._tenants.get(str(tenant_id))
+        if tenant is None:
+            raise UnknownTenantError(
+                f"tenant {tenant_id!r} is not registered on aggregator {self.name!r}"
+                f" (registered: {sorted(self._tenants) or 'none'})"
+            )
+        return tenant
+
+    # ------------------------------------------------------------------
+    # Ingestion
+    # ------------------------------------------------------------------
+
+    def ingest(
+        self,
+        payload: Union[bytes, MetricPayload],
+        *,
+        block: bool = True,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Validate and enqueue one payload (bytes or decoded).
+
+        Validation is synchronous — an unknown tenant or schema mismatch
+        raises here, where the producer can still see it; dedup happens at
+        fold time against the client's journal watermark. The bounded
+        queue provides backpressure: full + ``block=False`` raises
+        :class:`BackpressureError`.
+        """
+        t0 = time.perf_counter()
+        if isinstance(payload, (bytes, bytearray, memoryview)):
+            payload = decode_state(bytes(payload))
+        tenant = self._tenant(payload.tenant)
+        if payload.schema_hash != tenant.schema_hash:
+            diffs = schema_diff(tenant.schema, payload.schema)
+            raise SchemaMismatchError(
+                f"payload schema {payload.schema_hash} does not match tenant"
+                f" {payload.tenant!r} schema {tenant.schema_hash};"
+                f" differing: {'; '.join(diffs) or 'fingerprint only'}"
+            )
+        try:
+            self._queue.put((payload, t0), block=block, timeout=timeout)
+        except queue.Full:
+            if _obs_enabled():
+                _obs_inc("serve.rejected", tenant=payload.tenant)
+            raise BackpressureError(
+                f"aggregator {self.name!r} ingest queue is full"
+                f" (max_queue={self._queue.maxsize}); retry with backoff"
+                " (ft.RetryPolicy with decorrelated jitter) or raise max_queue."
+            ) from None
+        if _obs_enabled():
+            _obs_inc("serve.ingests", tenant=payload.tenant)
+            _obs_gauge("serve.queue_depth", float(self._queue.qsize()))
+
+    def _accept(self, payload: MetricPayload, t0: float) -> bool:
+        """Keep-latest dedup: returns True when the payload advanced its
+        client's watermark (snapshot stored), False when dropped."""
+        tenant = self._tenant(payload.tenant)
+        epoch, step = int(payload.watermark[0]), int(payload.watermark[1])
+        if epoch < 0 or step < 0:
+            # decode_state refuses these on the wire; a directly-constructed
+            # payload must hit the same drop-not-crash family (record() would
+            # raise ValueError AFTER the slot insert otherwise)
+            raise ServeError(
+                f"payload watermark must be non-negative, got {(epoch, step)}"
+            )
+        with tenant.lock:
+            slot = tenant.clients.get(payload.client_id)
+            if slot is not None and not slot.journal.should_fold(epoch, step):
+                if _obs_enabled():
+                    kind = "duplicate" if slot.journal.watermark == (epoch, step) else "stale"
+                    _obs_inc("serve.dedup_drops", tenant=payload.tenant, kind=kind)
+                return False
+            # validate the body BEFORE touching the registry: a corrupted
+            # payload (hash matched, leaf missing/misshapen) must not leave
+            # an empty slot behind that every later fold would trip over
+            leaves, consensus = tenant.flatten_payload(payload)
+            if slot is None:
+                slot = tenant.clients[payload.client_id] = _ClientSlot()
+            slot.journal.record(epoch, step)
+            slot.leaves = leaves
+            slot.consensus = consensus
+            tenant.dirty = True
+        if _obs_enabled():
+            _obs_observe("serve.ingest_ms", (time.perf_counter() - t0) * 1000.0, tenant=payload.tenant)
+            _obs_gauge("serve.clients", float(len(tenant.clients)), tenant=payload.tenant)
+        return True
+
+    # ------------------------------------------------------------------
+    # Folding
+    # ------------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain the queue, accept snapshots, fold every dirty tenant in
+        one jitted launch each; returns the number of payloads drained.
+        Thread-safe; the background worker calls exactly this. A payload
+        whose BODY turns out corrupted at accept time (the schema hash
+        matched at ingest, so this is hostile or bit-rotted data) is
+        dropped and counted under ``serve.accept_errors`` — one bad client
+        must not halt aggregation for every tenant on the node."""
+        with self._flush_lock:
+            drained = 0
+            while True:
+                try:
+                    payload, t0 = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                drained += 1
+                try:
+                    self._accept(payload, t0)
+                except ServeError as err:
+                    if _obs_enabled():
+                        _obs_inc("serve.accept_errors", tenant=payload.tenant)
+                    warnings.warn(
+                        f"aggregator {self.name!r} dropped a corrupted payload from"
+                        f" client {payload.client_id!r}: {err}",
+                        stacklevel=2,
+                    )
+            t_fold = time.perf_counter()
+            folded_any = False
+            for tenant in list(self._tenants.values()):
+                if tenant.dirty:
+                    try:
+                        k = tenant.fold()
+                    except ServeError as err:
+                        # same one-bad-client contract as _accept: a tenant
+                        # whose clients disagree on a consensus leaf must
+                        # not abort the fold loop for every OTHER tenant on
+                        # the node (its own view stays stale until a client
+                        # ships a corrected snapshot and re-marks it dirty)
+                        if _obs_enabled():
+                            _obs_inc("serve.fold_errors", tenant=tenant.tenant_id)
+                        warnings.warn(
+                            f"aggregator {self.name!r} could not fold tenant"
+                            f" {tenant.tenant_id!r}: {err}",
+                            stacklevel=2,
+                        )
+                        continue
+                    folded_any = True
+                    if _obs_enabled():
+                        _obs_inc("serve.merges", float(k), tenant=tenant.tenant_id)
+            self._flushes += 1
+            if _obs_enabled():
+                _obs_gauge("serve.queue_depth", float(self._queue.qsize()))
+                if folded_any:
+                    _obs_observe("serve.flush_ms", (time.perf_counter() - t_fold) * 1000.0)
+            want_save = (
+                self._manager is not None
+                and self._checkpoint_every is not None
+                and self._flushes % self._checkpoint_every == 0
+            )
+        # outside _flush_lock: save() re-acquires it (it must serialize
+        # with flushes when called directly), so saving inline above would
+        # self-deadlock on the non-reentrant lock
+        if want_save:
+            self.save()
+        return drained
+
+    # ------------------------------------------------------------------
+    # Background worker
+    # ------------------------------------------------------------------
+
+    def start(self) -> "Aggregator":
+        """Run :meth:`flush` on a daemon worker every ``flush_interval_s``
+        until :meth:`stop`. Idempotent."""
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self._flush_interval_s):
+                try:
+                    self.flush()
+                except Exception as err:  # noqa: BLE001 — a dying worker is a
+                    # silently frozen aggregator (stale /metrics reads as a
+                    # healthy idle fleet); surface the error and keep draining
+                    if _obs_enabled():
+                        _obs_inc("serve.flush_errors")
+                    warnings.warn(
+                        f"aggregator {self.name!r} background flush failed:"
+                        f" {type(err).__name__}: {err}",
+                        stacklevel=2,
+                    )
+
+        self._worker = threading.Thread(target=loop, name=f"serve-agg-{self.name}", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker and run one final drain-and-fold."""
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+        self.flush()
+
+    # ------------------------------------------------------------------
+    # Read side
+    # ------------------------------------------------------------------
+
+    def collection(self, tenant_id: str, *, flush: bool = True):
+        """The tenant's live merged :class:`MetricCollection` view (folded
+        first unless ``flush=False``). Read-only by convention: updates
+        belong on clients."""
+        if flush:
+            self.flush()
+        tenant = self._tenant(tenant_id)
+        if tenant.merged_leaves is None:
+            tenant.fold()
+        return tenant.view
+
+    def query(self, tenant_id: str) -> Dict[str, Any]:
+        """Merged values for one tenant with streaming error envelopes.
+
+        Returns ``{"tenant", "clients", "payloads_folded", "values"}``
+        where each value entry carries ``value`` plus, for streaming
+        metrics that document bounds, ``error_bound`` and ``bounds`` —
+        the rigorous envelope, not a vibe (see ``docs/streaming.md``).
+        """
+        view = self.collection(tenant_id)
+        tenant = self._tenant(tenant_id)
+        values: Dict[str, Any] = {}
+        # view_lock: a concurrent background fold() swaps the view's state
+        # leaves while compute()/bounds() read them — without the lock a
+        # scrape could see half of fold N and half of fold N+1
+        with tenant.view_lock:
+            computed = view.compute()
+            members = dict(view.items())
+            for name, value in computed.items():
+                entry: Dict[str, Any] = {"value": _jsonable(value)}
+                metric = members.get(name)
+                if metric is not None and hasattr(metric, "bounds") and hasattr(metric, "error_bound"):
+                    lo, hi = metric.bounds()
+                    entry["bounds"] = [_jsonable(lo), _jsonable(hi)]
+                    entry["error_bound"] = _jsonable(metric.error_bound())
+                values[name] = entry
+        return {
+            "tenant": tenant.tenant_id,
+            "schema_hash": tenant.schema_hash,
+            "clients": len(tenant.clients),
+            "payloads_folded": tenant.folded_payloads,
+            "values": values,
+        }
+
+    # ------------------------------------------------------------------
+    # Persistence (ft.CheckpointManager)
+    # ------------------------------------------------------------------
+
+    def save(self) -> str:
+        """Atomically checkpoint every tenant's client snapshots and
+        watermarks; returns the checkpoint path. Requires
+        ``checkpoint_dir``."""
+        manager = self._require_manager()
+        proxy, extra = self._registry_state()
+        with self._flush_lock:
+            return manager.save(proxy, extra={"serve": extra})
+
+    def restore(self, path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Restore the newest (or given) checkpoint into the registry.
+
+        Tenants must be re-registered (same schema) BEFORE restoring —
+        factories don't serialize; the manifest's schema hashes verify the
+        re-registration matches what was saved. Returns the manifest, or
+        None on a fresh start. Restored states and watermarks are bitwise
+        the saved ones, so post-restore dedup and folds continue
+        exactly-once (pinned by ``tests/serve/test_aggregator.py``).
+        """
+        manager = self._require_manager()
+        proxy, _ = self._registry_state(empty=True)
+        manifest = manager.restore(proxy, path=path)
+        if manifest is None:
+            return None
+        serve_meta = (manifest.get("extra") or {}).get("serve")
+        if serve_meta is None:
+            raise ServeError(
+                f"checkpoint at {manager.directory} carries no serve registry metadata"
+                " — it was not written by Aggregator.save()"
+            )
+        for tslot, tmeta in serve_meta["tenants"].items():
+            tenant_id = tmeta["id"]
+            tenant = self._tenants.get(tenant_id)
+            if tenant is None:
+                raise UnknownTenantError(
+                    f"checkpoint contains tenant {tenant_id!r} but it is not"
+                    " registered; register_tenant() every tenant (same schema)"
+                    " before restore()."
+                )
+            if tenant.schema_hash != tmeta["schema_hash"]:
+                diffs: List[str] = []
+                if "schema" in tmeta:
+                    diffs = schema_diff(tenant.schema, tmeta["schema"])
+                raise SchemaMismatchError(
+                    f"tenant {tenant_id!r} re-registered with schema"
+                    f" {tenant.schema_hash} but the checkpoint was saved under"
+                    f" {tmeta['schema_hash']}; differing: {'; '.join(diffs) or 'fingerprint only'}"
+                )
+            slots = proxy.tree.get(tslot, {})
+            with tenant.lock:
+                tenant.clients.clear()
+                for idx, client_id in enumerate(serve_meta["clients"][tslot]):
+                    data = slots[f"c{idx:06d}"]
+                    slot = _ClientSlot()
+                    wm = np.asarray(data["wm"]).astype(np.int64)
+                    slot.journal.load_state_dict(
+                        {"watermark": [int(wm[0]), int(wm[1])], "folded": int(np.asarray(data["folded"]))}
+                    )
+                    slot.leaves = [
+                        np.asarray(data["leaves"][f"l{i:06d}"]).astype(t.dtype).reshape(t.shape)
+                        for i, t in enumerate(tenant.template_leaves)
+                    ]
+                    slot.consensus = [
+                        np.asarray(data["consensus"][f"l{i:06d}"]).astype(t.dtype).reshape(t.shape)
+                        for i, t in enumerate(tenant.template_consensus)
+                    ]
+                    tenant.clients[client_id] = slot
+                tenant.dirty = True
+        if _obs_enabled():
+            _obs_gauge("serve.tenants", float(len(self._tenants)))
+        return manifest
+
+    def _require_manager(self):
+        if self._manager is None:
+            raise ServeError(
+                f"aggregator {self.name!r} has no checkpoint_dir; construct with"
+                " Aggregator(..., checkpoint_dir=...) to enable save/restore"
+            )
+        return self._manager
+
+    def _registry_state(self, empty: bool = False) -> Tuple["_RegistryState", Dict[str, Any]]:
+        """(orbax-safe pytree proxy, manifest metadata). Hostile tenant /
+        client ids never become filesystem paths: slots are positional
+        (``t000000``/``c000000``/``l000000``) and the id mapping rides the
+        JSON manifest."""
+        tree: Dict[str, Any] = {}
+        meta: Dict[str, Any] = {"tenants": {}, "clients": {}}
+        if not empty:
+            for t_idx, tenant_id in enumerate(sorted(self._tenants)):
+                tenant = self._tenants[tenant_id]
+                tslot = f"t{t_idx:06d}"
+                meta["tenants"][tslot] = {
+                    "id": tenant_id,
+                    "schema_hash": tenant.schema_hash,
+                    "schema": tenant.schema,
+                }
+                with tenant.lock:
+                    order = sorted(tenant.clients)
+                    meta["clients"][tslot] = order
+                    slots: Dict[str, Any] = {}
+                    for c_idx, client_id in enumerate(order):
+                        slot = tenant.clients[client_id]
+                        wm = slot.journal.watermark or (-1, -1)
+                        slots[f"c{c_idx:06d}"] = {
+                            "wm": np.asarray(wm, dtype=np.int64),
+                            "folded": np.asarray(slot.journal.folded, dtype=np.int64),
+                            "leaves": {f"l{i:06d}": leaf for i, leaf in enumerate(slot.leaves)},
+                            "consensus": {
+                                f"l{i:06d}": leaf for i, leaf in enumerate(slot.consensus)
+                            },
+                        }
+                if slots:
+                    tree[tslot] = slots
+        return _RegistryState(tree), meta
+
+
+def _jsonable(value: Any) -> Any:
+    """Array/scalar -> plain JSON value (lists for non-scalars)."""
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        return arr.item()
+    return arr.tolist()
+
+
+class _RegistryState:
+    """Duck-typed single-"metric" adapter so the whole client-snapshot
+    registry rides :class:`~metrics_tpu.ft.CheckpointManager` unchanged
+    (atomic publish, rotation, manifest, monotonic discovery)."""
+
+    _aux_attrs: Tuple[str, ...] = ()
+
+    def __init__(self, tree: Dict[str, Any]) -> None:
+        self.tree = tree
+        self._update_count = 0
+        self._computed = None
+        self._defaults: Dict[str, Any] = {}
+
+    def state_pytree(self) -> Dict[str, Any]:
+        return self.tree
+
+    def load_state_pytree(self, state: Dict[str, Any]) -> None:
+        self.tree = state

@@ -1,0 +1,240 @@
+"""Scrape / query / ingest HTTP surface for an aggregator node.
+
+Stdlib-only (``http.server``): the serving tier must not grow dependencies
+the container doesn't bake. One :class:`MetricsServer` wraps one
+:class:`~metrics_tpu.serve.Aggregator` with four routes:
+
+* ``GET /metrics`` — Prometheus text exposition. The body is
+  :func:`metrics_tpu.obs.to_prometheus` over the process-wide obs
+  registry — the per-tenant ``serve.ingests`` / ``serve.merges`` /
+  ``serve.dedup_drops`` counters, ``serve.ingest_ms`` latency histograms
+  and queue/tenant gauges land there at ingest/fold time — plus
+  per-tenant **value gauges** (``serve.value{tenant=,metric=}``) refreshed
+  from the merged state at scrape time (scalar values only; structured
+  values ride ``/query``).
+* ``GET /query?tenant=ID`` — JSON merged values with the streaming
+  metrics' rigorous ``error_bound`` / ``bounds`` envelopes, plus client
+  and watermark accounting (:meth:`Aggregator.query`).
+* ``POST /ingest`` — the wire payload as the request body; 200 on accept,
+  400 on malformed/schema-mismatched payloads, 404 for unknown tenants,
+  503 on queue backpressure. Tree nodes cross process boundaries by
+  pointing :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
+  this route — the bytes are identical to the in-process path.
+* ``GET /healthz`` — liveness JSON (tenant/client/queue counts).
+
+The server arms the obs layer by default (``arm_obs=True``): a scrape
+endpoint over a disabled registry would export silence, which reads as
+"healthy fleet, zero traffic" — the failure mode observability exists to
+prevent.
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from metrics_tpu.serve.aggregator import (
+    Aggregator,
+    BackpressureError,
+    UnknownTenantError,
+)
+from metrics_tpu.serve.wire import MAX_WIRE_BYTES, SchemaMismatchError, WireFormatError
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Serve one aggregator over HTTP (scrape / query / ingest / health).
+
+    Args:
+        aggregator: the node to expose.
+        host / port: bind address; ``port=0`` picks a free port (read it
+            back from :attr:`port` — the pattern tests and the in-process
+            tree smoke use).
+        arm_obs: enable the obs registry so serve counters/histograms are
+            actually recorded and exported (default True; pass False when
+            the operator manages ``obs.enable`` globally).
+
+    Example::
+
+        server = MetricsServer(agg, port=0).start()
+        print(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics").read().decode())
+        server.stop()
+    """
+
+    def __init__(
+        self,
+        aggregator: Aggregator,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        arm_obs: bool = True,
+    ) -> None:
+        self.aggregator = aggregator
+        if arm_obs:
+            from metrics_tpu import obs
+
+            obs.enable()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "MetricsServer":
+        """Serve on a daemon thread; returns self for chaining."""
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"serve-http-{self.aggregator.name}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------------
+    # Route bodies (also the in-process API the handler delegates to)
+    # ------------------------------------------------------------------
+
+    def render_metrics(self) -> str:
+        """The ``/metrics`` body: refresh per-tenant value gauges from the
+        merged state, then export the whole obs registry."""
+        from metrics_tpu import obs
+
+        agg = self.aggregator
+        agg.flush()
+        if obs.enabled():
+            for tenant_id in agg.tenants():
+                view = agg.collection(tenant_id, flush=False)
+                try:
+                    # view_lock: a concurrent background fold() must not swap
+                    # state leaves mid-compute (same torn-read hazard query()
+                    # guards against)
+                    with agg._tenant(tenant_id).view_lock:
+                        computed = view.compute()
+                except Exception:  # noqa: BLE001 — a tenant with no data yet must not kill the scrape
+                    continue
+                for name, value in computed.items():
+                    arr = np.asarray(value)
+                    if arr.ndim == 0 and np.issubdtype(arr.dtype, np.number):
+                        obs.set_gauge(
+                            "serve.value", float(arr), tenant=tenant_id, metric=name
+                        )
+        return obs.to_prometheus()
+
+    def render_query(self, tenant: str) -> Dict[str, Any]:
+        return self.aggregator.query(tenant)
+
+    def render_health(self) -> Dict[str, Any]:
+        agg = self.aggregator
+        return {
+            "node": agg.name,
+            "tenants": len(agg.tenants()),
+            "clients": {t: len(agg._tenant(t).clients) for t in agg.tenants()},
+            "queue_depth": agg._queue.qsize(),
+        }
+
+
+def _make_handler(server: MetricsServer):
+    class Handler(BaseHTTPRequestHandler):
+        # socket timeout: a client that declares Content-Length N but sends
+        # fewer bytes (and keeps the connection open) would otherwise pin
+        # this handler's thread in rfile.read() forever — N such clients
+        # exhaust the pool and starve scrapes. On timeout the connection is
+        # closed (handle_one_request treats it as an error), never hung.
+        timeout = 30.0
+
+        # quiet: request logging at scrape cadence would drown real logs
+        def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
+            pass
+
+        def _reply(self, status: int, body: bytes, content_type: str) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply_json(self, status: int, obj: Dict[str, Any]) -> None:
+            self._reply(status, (json.dumps(obj) + "\n").encode(), "application/json")
+
+        def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+            parsed = urlparse(self.path)
+            try:
+                if parsed.path == "/metrics":
+                    body = server.render_metrics().encode()
+                    self._reply(200, body, "text/plain; version=0.0.4; charset=utf-8")
+                elif parsed.path == "/query":
+                    tenant = (parse_qs(parsed.query).get("tenant") or [None])[0]
+                    if tenant is None:
+                        self._reply_json(400, {"error": "missing ?tenant= parameter"})
+                        return
+                    self._reply_json(200, server.render_query(tenant))
+                elif parsed.path == "/healthz":
+                    self._reply_json(200, server.render_health())
+                else:
+                    self._reply_json(404, {"error": f"no route {parsed.path!r}"})
+            except UnknownTenantError as err:
+                self._reply_json(404, {"error": str(err)})
+            except Exception as err:  # noqa: BLE001 — the server must answer, not die
+                self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
+
+        def do_POST(self) -> None:  # noqa: N802
+            parsed = urlparse(self.path)
+            if parsed.path != "/ingest":
+                self._reply_json(404, {"error": f"no route {parsed.path!r}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                # refuse before buffering: the bounded-payload contract is a
+                # memory-safety property here — ThreadingHTTPServer buffers
+                # one body per thread, so oversized POSTs would OOM the node.
+                # Drain a bounded amount in chunks (never holding the body)
+                # so a well-behaved client can still read the 413; anything
+                # larger gets the connection cut instead.
+                if length < 0 or length > MAX_WIRE_BYTES:
+                    remaining = min(max(length, 0), 8 * MAX_WIRE_BYTES)
+                    while remaining > 0:
+                        chunk = self.rfile.read(min(65536, remaining))
+                        if not chunk:
+                            break
+                        remaining -= len(chunk)
+                    self.close_connection = True
+                    self._reply_json(
+                        413,
+                        {
+                            "error": f"Content-Length {length} exceeds the"
+                            f" {MAX_WIRE_BYTES}-byte wire payload cap"
+                        },
+                    )
+                    return
+                data = self.rfile.read(length)
+                server.aggregator.ingest(data, block=False)
+                self._reply_json(200, {"accepted": True})
+            except UnknownTenantError as err:
+                self._reply_json(404, {"error": str(err)})
+            except (WireFormatError, SchemaMismatchError, ValueError) as err:
+                self._reply_json(400, {"error": str(err)})
+            except BackpressureError as err:
+                self._reply_json(503, {"error": str(err)})
+            except Exception as err:  # noqa: BLE001
+                self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
+
+    return Handler

@@ -1,0 +1,162 @@
+"""Load generator: 1k+ simulated clients through a multi-level tree.
+
+The serving tier's bench instrument (and the acceptance harness for the
+ROADMAP "metrics-as-a-service" lane): simulate ``n_clients`` independent
+clients, each folding its own score/label stream into a bounded sketch
+collection and shipping cumulative snapshots into a leaf of an in-process
+:class:`~metrics_tpu.serve.tree.AggregationTree`; pump the tree after each
+ship round; read the sustained throughput off the obs counters the
+aggregators already maintain:
+
+* ``serve_ingest_merges_per_s`` — client-snapshot merges folded per
+  second, summed over every node of the tree (the ``serve.merges``
+  counter family delta over the timed window).
+* ``serve_ingest_p99_ms`` — p99 of the per-payload ingest latency
+  histogram (``serve.ingest_ms``: decode + validate + queue wait + dedup
+  + snapshot store).
+
+Payload bytes are pre-encoded outside the timed window — the client-side
+fold/encode cost is a *client* budget; the rows measure the aggregation
+tier. ``verify=True`` (tests/smoke) additionally pins the whole run
+against a flat single-aggregator merge of every client's final snapshot,
+bitwise on the merged state leaves — the tree invariant end to end.
+
+Bench rows ride ``bench.py --json`` with ``process_count`` attached and
+participate in the ``--compare`` gate as a **rate row** (higher is
+better; ``benchmarks/compare.py`` inverts the gate direction for ``/s``
+units and normalizes by the elementwise chip probe).
+"""
+import time
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["run_loadgen"]
+
+
+def _client_stream(rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
+    preds = rng.uniform(0.0, 1.0, n).astype(np.float32)
+    target = (rng.uniform(0.0, 1.0, n) < 0.25 + 0.5 * preds).astype(np.int32)
+    return {"preds": preds, "target": target}
+
+
+def run_loadgen(
+    n_clients: int = 1000,
+    fan_out: Sequence[int] = (4, 16),
+    payloads_per_client: int = 2,
+    samples_per_payload: int = 256,
+    num_bins: int = 256,
+    seed: int = 0,
+    verify: bool = False,
+    tenant: str = "loadgen",
+) -> Dict[str, Any]:
+    """Drive the tree and return the ``serve_*`` row values.
+
+    Returns a dict with ``serve_ingest_merges_per_s``,
+    ``serve_ingest_p99_ms`` and run accounting (clients, payload counts,
+    tree shape, elapsed seconds). With ``verify=True`` the merged root
+    state is additionally compared bitwise against a flat fold of every
+    client's final snapshot (raises on any mismatch).
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import obs
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.serve.aggregator import Aggregator
+    from metrics_tpu.serve.tree import AggregationTree
+    from metrics_tpu.serve.wire import encode_state
+    from metrics_tpu.streaming import StreamingAUROC
+
+    def factory() -> MetricCollection:
+        return MetricCollection({"auroc": StreamingAUROC(num_bins=num_bins)})
+
+    # pre-encode every ship round for every client (client-side cost,
+    # outside the timed aggregation window)
+    rng = np.random.default_rng(seed)
+    rounds: list = [[] for _ in range(payloads_per_client)]
+    final_payloads = []
+    for c in range(n_clients):
+        client = factory()
+        client_id = f"client-{c:05d}"
+        for r in range(payloads_per_client):
+            batch = _client_stream(rng, samples_per_payload)
+            client.update(jnp.asarray(batch["preds"]), jnp.asarray(batch["target"]))
+            payload = encode_state(client, tenant=tenant, client_id=client_id, watermark=(0, r))
+            rounds[r].append((c, payload))
+        final_payloads.append(payload)
+
+    tree = AggregationTree(fan_out=fan_out, tenants={tenant: factory})
+    was_enabled = obs.enable()
+    merges_before = obs.sum_counter("serve.merges")
+    try:
+        t0 = time.perf_counter()
+        for round_payloads in rounds:
+            for c, payload in round_payloads:
+                tree.leaf_for(c).ingest(payload)
+            tree.pump()
+        elapsed = time.perf_counter() - t0
+        merges = obs.sum_counter("serve.merges") - merges_before
+        hist = obs.get_histogram("serve.ingest_ms", tenant=tenant)
+        p99 = hist.p99 if hist is not None else None
+    finally:
+        obs.enable(was_enabled)
+
+    out: Dict[str, Any] = {
+        "serve_ingest_merges_per_s": merges / elapsed if elapsed > 0 else float("nan"),
+        "serve_ingest_p99_ms": float("nan") if p99 is None else float(p99),
+        "clients": int(n_clients),
+        "payloads": int(n_clients * payloads_per_client),
+        "merges": float(merges),
+        "tree_levels": len(tuple(fan_out)) + 1,
+        "elapsed_s": elapsed,
+    }
+
+    if verify:
+        flat = Aggregator("flat-reference")
+        flat.register_tenant(tenant, factory)
+        for payload in final_payloads:
+            flat.ingest(payload)
+        flat.flush()
+        root_tenant = tree.root.aggregator._tenant(tenant)
+        flat_tenant = flat._tenant(tenant)
+        tree.root.aggregator.flush()
+        if root_tenant.merged_leaves is None:
+            root_tenant.fold()
+        if flat_tenant.merged_leaves is None:
+            flat_tenant.fold()
+        for (path, _), a, b in zip(
+            root_tenant.spec, root_tenant.merged_leaves, flat_tenant.merged_leaves
+        ):
+            if not np.array_equal(np.asarray(a), np.asarray(b)):
+                raise AssertionError(
+                    f"tree fold != flat fold at leaf {'/'.join(path)}"
+                )
+        out["verified_bitwise"] = True
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: ``python -m metrics_tpu.serve.loadgen [--clients N] ...``"""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--clients", type=int, default=1000)
+    parser.add_argument("--fan-out", type=int, nargs="*", default=[4, 16])
+    parser.add_argument("--payloads-per-client", type=int, default=2)
+    parser.add_argument("--num-bins", type=int, default=256)
+    parser.add_argument("--verify", action="store_true")
+    args = parser.parse_args(argv)
+    result = run_loadgen(
+        n_clients=args.clients,
+        fan_out=tuple(args.fan_out),
+        payloads_per_client=args.payloads_per_client,
+        num_bins=args.num_bins,
+        verify=args.verify,
+    )
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,209 @@
+"""Hierarchical aggregation topology: a node is a client of its parent.
+
+Scaling past one aggregator is structural, not algorithmic: because
+payloads are cumulative snapshots and the fold is an exact monoid over
+sketch / integer-count leaves, an :class:`~metrics_tpu.serve.Aggregator`'s
+merged state is itself a valid client snapshot. A node therefore ships its
+merged state **upward with the same wire format clients use** — client id
+= node name, watermark = a per-node monotonic ship sequence — and the
+parent's keep-latest dedup works unchanged. Any depth and any fan-in
+compose this way (process → host → pod → global), and the **pinned
+invariant** is:
+
+    folding the tree bottom-up produces bitwise the same root state as one
+    flat fold over every client's latest snapshot,
+
+for sketch states and integer-valued ``sum`` / all ``min``/``max`` leaves
+(``tests/serve/test_tree.py`` pins it across arities and fan-ins; see
+``docs/serving.md`` for why non-integer float sums are the one exception —
+ordinary float summation is not associative bitwise).
+
+The in-process :class:`AggregationTree` helper wires N levels together for
+tests, smokes and the load generator; a production deployment runs the
+same :class:`AggregatorNode.forward` loop against a parent's ``/ingest``
+endpoint instead of an in-memory parent.
+"""
+import itertools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from metrics_tpu.serve.aggregator import Aggregator
+from metrics_tpu.serve.wire import encode_state
+
+__all__ = ["AggregationTree", "AggregatorNode"]
+
+
+class AggregatorNode:
+    """One tree position: an aggregator plus the upward client identity.
+
+    Args:
+        aggregator: this node's :class:`~metrics_tpu.serve.Aggregator`.
+        parent: the node to ship merged state to (None = root).
+        send: override the upward transport — a callable taking the
+            encoded payload bytes (default: in-process
+            ``parent.aggregator.ingest``). Point it at an HTTP client to
+            cross process boundaries; the payload bytes are identical.
+    """
+
+    def __init__(
+        self,
+        aggregator: Aggregator,
+        parent: Optional["AggregatorNode"] = None,
+        send: Optional[Callable[[bytes], None]] = None,
+    ) -> None:
+        self.aggregator = aggregator
+        self.parent = parent
+        self._send = send
+        self._ship_seq: Optional["itertools.count"] = None
+
+    @property
+    def name(self) -> str:
+        return self.aggregator.name
+
+    def _resume_seq(self) -> int:
+        """First ship sequence number: one past whatever the parent last
+        accepted from this node identity.
+
+        A restarted node (or a fresh node shipping into a parent that
+        RESTORED older watermarks) that restarted its sequence at 0 would
+        have every ship dropped as stale until the count crawled past the
+        parent's recorded watermark — a silently frozen subtree. In-process
+        the parent is queryable; across an HTTP boundary the operator's
+        transport should recover the watermark the same way (the parent's
+        ``/query`` accounting exposes it) or simply use a restart-unique
+        high epoch. Tested by the serve smoke's kill-and-restore arm.
+        """
+        if self.parent is None:
+            return 0
+        last = -1
+        for tenant_id in self.aggregator.tenants():
+            try:
+                wm = self.parent.aggregator.client_watermark(tenant_id, f"node:{self.name}")
+            except Exception:  # noqa: BLE001 — tenant not registered upstream (yet)
+                continue
+            if wm is not None:
+                last = max(last, wm[1])
+        return last + 1
+
+    def forward(self) -> int:
+        """Flush, then ship one cumulative snapshot per tenant upward.
+
+        The ship sequence number is this node's upward watermark — each
+        forward supersedes the previous at the parent (keep-latest), so a
+        lost or duplicated ship is repaired by the next interval. Returns
+        the number of payloads shipped (0 at the root).
+        """
+        if self.parent is None and self._send is None:
+            return 0
+        self.aggregator.flush()
+        if self._ship_seq is None:
+            self._ship_seq = itertools.count(self._resume_seq())
+        seq = next(self._ship_seq)
+        shipped = 0
+        for tenant_id in self.aggregator.tenants():
+            view = self.aggregator.collection(tenant_id, flush=False)
+            # view_lock: this node's background worker (if start()ed) may
+            # fold concurrently; encoding leaf-by-leaf without the lock
+            # could ship a snapshot mixing two folds' states upward
+            with self.aggregator._tenant(tenant_id).view_lock:
+                payload = encode_state(
+                    view,
+                    tenant=tenant_id,
+                    client_id=f"node:{self.name}",
+                    watermark=(0, seq),
+                    meta={"node": self.name, "clients": len(self.aggregator._tenant(tenant_id).clients)},
+                )
+            if self._send is not None:
+                self._send(payload)
+            else:
+                self.parent.aggregator.ingest(payload)
+            shipped += 1
+        return shipped
+
+
+class AggregationTree:
+    """An in-process client → leaf → … → root hierarchy.
+
+    Args:
+        fan_out: nodes per level below the root, top-down — ``(4, 16)``
+            builds 1 root, 4 intermediates, 16 leaves (clients attach to
+            leaves round-robin via :meth:`leaf_for`).
+        tenants: ``{tenant_id: collection factory}`` registered on every
+            node (each node folds independently, so each needs its own
+            collection instance).
+        checkpoint_root: when set, the ROOT aggregator checkpoints under
+            this directory (the root is the state of record; interior
+            nodes are reconstructable from their children's next ships).
+
+    Example::
+
+        tree = AggregationTree(
+            fan_out=(2, 4),
+            tenants={"search": lambda: MetricCollection(
+                {"auroc": StreamingAUROC(num_bins=256)})},
+        )
+        tree.leaf_for(client_index).ingest(payload_bytes)
+        tree.pump()                       # fold + forward every level
+        tree.root.query("search")
+    """
+
+    def __init__(
+        self,
+        fan_out: Sequence[int],
+        tenants: Dict[str, Callable[[], Any]],
+        *,
+        checkpoint_root: Optional[str] = None,
+        max_queue: int = 65536,
+    ) -> None:
+        if any(int(n) < 1 for n in fan_out):
+            raise ValueError(f"fan_out entries must be >= 1, got {tuple(fan_out)}")
+        root_agg = Aggregator("root", checkpoint_dir=checkpoint_root, max_queue=max_queue)
+        self.root = AggregatorNode(root_agg)
+        self.levels: List[List[AggregatorNode]] = [[self.root]]
+        for depth, width in enumerate(fan_out):
+            parents = self.levels[-1]
+            level = []
+            for i in range(int(width)):
+                agg = Aggregator(f"L{depth + 1}.{i}", max_queue=max_queue)
+                level.append(AggregatorNode(agg, parent=parents[i % len(parents)]))
+            self.levels.append(level)
+        for tenant_id, factory in tenants.items():
+            for level in self.levels:
+                for node in level:
+                    node.aggregator.register_tenant(tenant_id, factory)
+
+    @property
+    def leaves(self) -> List[AggregatorNode]:
+        return self.levels[-1]
+
+    @property
+    def nodes(self) -> List[AggregatorNode]:
+        return [node for level in self.levels for node in level]
+
+    def leaf_for(self, client_index: int) -> Aggregator:
+        """The leaf aggregator client ``client_index`` ingests into."""
+        return self.leaves[client_index % len(self.leaves)].aggregator
+
+    def pump(self, rounds: int = 1) -> int:
+        """Propagate state bottom-up: flush + forward every non-root level
+        (deepest first), then flush the root; returns payloads shipped."""
+        shipped = 0
+        for _ in range(int(rounds)):
+            for level in reversed(self.levels[1:]):
+                for node in level:
+                    shipped += node.forward()
+            self.root.aggregator.flush()
+        return shipped
+
+    def save(self) -> str:
+        """Checkpoint the root (the state of record); see
+        :meth:`~metrics_tpu.serve.Aggregator.save`."""
+        return self.root.aggregator.save()
+
+    def restore(self, path: Optional[str] = None):
+        """Restore the root from its newest checkpoint. Interior nodes are
+        NOT restored — they rebuild from their children's next ships, and
+        their first :meth:`AggregatorNode.forward` resumes the ship
+        sequence above the root's restored watermark so the rebuilt
+        subtree is never dropped as stale. Call BEFORE the first
+        :meth:`pump`."""
+        return self.root.aggregator.restore(path)

@@ -1,0 +1,398 @@
+"""Versioned wire format for metric-state payloads.
+
+The serving tier moves **metric state**, not samples: a client folds its
+local stream into bounded state (a few KB of sketch/count leaves) and ships
+one self-describing payload per interval. This module is that payload —
+the contract every :class:`~metrics_tpu.serve.aggregator.Aggregator` hop
+(client → leaf → intermediate → root) speaks:
+
+* **framing** — ``MAGIC | major | minor | header_len | header JSON | raw
+  leaf bytes``. The header carries tenant / collection / client identity,
+  the ``(epoch, step)`` watermark of the snapshot, the schema fingerprint,
+  free-form ``meta``, and a leaf directory (dtype / shape / byte extents);
+  the body is the concatenated little-endian leaf buffers. Everything is
+  length-checked, so truncation is detected, never silently decoded.
+* **versioning** — a payload from a *newer minor* decodes fine (unknown
+  header and ``meta`` keys are preserved, not rejected): minors add
+  optional fields. A different **major** is rejected loudly — majors may
+  change framing, and guessing would corrupt tenant state.
+* **schema fingerprint** — :func:`schema_fingerprint` hashes the metric
+  *configuration* (member names, per-state reduction kinds, default
+  dtype/shape, sketch class + static config). Two parties merge only when
+  their fingerprints match; a changed bin count or threshold grid is a
+  **different schema** and the aggregator rejects it with the exact
+  differing path (:func:`schema_diff`) instead of silently merging
+  incompatible histograms.
+* **state packing** — member states ride the same
+  ``utilities.checkpoint`` packing orbax checkpoints use
+  (:func:`~metrics_tpu.utilities.checkpoint.metric_state_to_tree`), so
+  every reduction kind round-trips: plain ``sum``/``max``/``min`` leaves,
+  ``cat`` lists (length sentinel), ``CapacityBuffer`` contents and
+  ``dist_reduce_fx="sketch"`` states (class + static config + leaves).
+
+Payloads are **cumulative snapshots**: the watermark names the last
+``(epoch, step)`` folded in, and a later snapshot supersedes an earlier
+one from the same client. That choice is what makes the aggregation tier's
+exactly-once story simple — duplicates and reordering reduce to a
+watermark comparison (see ``docs/serving.md``).
+"""
+import hashlib
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MAX_WIRE_BYTES",
+    "WIRE_MAJOR",
+    "WIRE_MINOR",
+    "MetricPayload",
+    "SchemaMismatchError",
+    "WireFormatError",
+    "apply_payload",
+    "decode_state",
+    "encode_state",
+    "schema_diff",
+    "schema_fingerprint",
+    "schema_of",
+]
+
+WIRE_MAGIC = b"MTSV"
+WIRE_MAJOR = 1
+WIRE_MINOR = 0
+# bounded-size payloads are the design contract (sketches are <=64KB by
+# construction); the default cap leaves headroom for multi-member
+# collections while still refusing an unbounded cat state that would turn
+# the aggregation tier back into a sample mover
+MAX_WIRE_BYTES = 1 << 20
+
+_PREAMBLE = struct.Struct("<4sHHI")
+
+
+class WireFormatError(ValueError):
+    """Malformed, truncated or incompatible-major payload bytes."""
+
+
+class SchemaMismatchError(ValueError):
+    """Payload schema fingerprint differs from the registered tenant's."""
+
+
+def _members(obj: Any) -> Dict[str, Any]:
+    """Normalize a Metric or MetricCollection to ``{member_name: metric}``.
+
+    A bare metric gets its class name — the same key
+    ``MetricCollection([m])`` would give it, so a client shipping one
+    metric and a tenant registered as a one-member collection agree.
+    """
+    if hasattr(obj, "items") and not hasattr(obj, "state_pytree"):  # MetricCollection
+        return dict(obj.items())
+    return {type(obj).__name__: obj}
+
+
+def _default_spec(default: Any) -> Dict[str, Any]:
+    """Schema entry for one state default — exactly the configuration that
+    must match for a merge to be meaningful."""
+    from metrics_tpu.streaming.sketches import Sketch
+    from metrics_tpu.utilities.buffers import CapacityBuffer
+
+    if isinstance(default, Sketch):
+        return {"kind": "sketch", "class": type(default).__name__, "config": default.config()}
+    if isinstance(default, CapacityBuffer):
+        return {"kind": "buffer", "capacity": int(default.capacity)}
+    if isinstance(default, list):
+        return {"kind": "cat"}
+    arr = np.asarray(default)
+    return {"kind": "array", "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def schema_of(obj: Any) -> Dict[str, Any]:
+    """The canonical schema dict for a Metric / MetricCollection: per
+    member, per state, the reduction kind and the default's configuration.
+    This is what :func:`schema_fingerprint` hashes and what
+    :func:`schema_diff` compares for the loud mismatch message."""
+    schema: Dict[str, Any] = {}
+    for name, metric in sorted(_members(obj).items()):
+        states = {}
+        for state, red in metric._reductions.items():
+            red_name = red if isinstance(red, str) or red is None else f"callable:{getattr(red, '__name__', 'fn')}"
+            states[state] = {"reduction": red_name, **_default_spec(metric._defaults[state])}
+        schema[name] = {"type": type(metric).__name__, "states": states}
+    return schema
+
+
+def _fingerprint_of_schema(schema: Dict[str, Any]) -> str:
+    blob = json.dumps(schema, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def schema_fingerprint(obj: Any) -> str:
+    """Stable hex fingerprint of :func:`schema_of` — the merge
+    compatibility key carried in every payload header."""
+    return _fingerprint_of_schema(schema_of(obj))
+
+
+def schema_diff(a: Dict[str, Any], b: Dict[str, Any], path: str = "") -> List[str]:
+    """Human-readable paths where two schema dicts differ (both directions),
+    so a fingerprint rejection can name the exact bin count / threshold /
+    member that changed instead of just "hash mismatch"."""
+    diffs: List[str] = []
+    for key in sorted(set(a) | set(b)):
+        here = f"{path}.{key}" if path else str(key)
+        if key not in a:
+            diffs.append(f"{here}: only in payload ({b[key]!r})")
+        elif key not in b:
+            diffs.append(f"{here}: only in registered schema ({a[key]!r})")
+        elif isinstance(a[key], dict) and isinstance(b[key], dict):
+            diffs.extend(schema_diff(a[key], b[key], here))
+        elif a[key] != b[key]:
+            diffs.append(f"{here}: registered {a[key]!r} != payload {b[key]!r}")
+    return diffs
+
+
+@dataclass
+class MetricPayload:
+    """One decoded wire payload: identity, watermark, schema and states.
+
+    ``states`` maps member name -> the member's packed state tree (the
+    :func:`~metrics_tpu.utilities.checkpoint.metric_state_to_tree` shape:
+    state leaves plus ``__update_count`` and optional ``__aux``), with
+    numpy array leaves. ``meta`` is the free-form forward-compatible side
+    channel; unknown keys survive the round trip untouched.
+    """
+
+    tenant: str
+    collection: str
+    client_id: str
+    watermark: Tuple[int, int]
+    schema_hash: str
+    schema: Dict[str, Any]
+    states: Dict[str, Dict[str, Any]]
+    meta: Dict[str, Any] = field(default_factory=dict)
+    wire_version: Tuple[int, int] = (WIRE_MAJOR, WIRE_MINOR)
+
+    @property
+    def nbytes(self) -> int:
+        """Total state bytes carried (leaf buffers only)."""
+        total = 0
+        for tree in self.states.values():
+            for leaf in _iter_leaves(tree):
+                total += leaf[1].nbytes
+        return total
+
+
+def _iter_leaves(tree: Any, path: Tuple[str, ...] = ()) -> List[Tuple[Tuple[str, ...], np.ndarray]]:
+    """Depth-first ``(path, numpy leaf)`` pairs of a packed state tree."""
+    out: List[Tuple[Tuple[str, ...], np.ndarray]] = []
+    if isinstance(tree, dict):
+        for key in sorted(tree):
+            out.extend(_iter_leaves(tree[key], path + (str(key),)))
+        return out
+    out.append((path, np.asarray(tree)))
+    return out
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Resolve a dtype name, falling back to the ml_dtypes extended family
+    (bfloat16 et al.) that plain ``np.dtype`` does not know by name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _set_path(tree: Dict[str, Any], path: List[str], value: np.ndarray) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def encode_state(
+    obj: Any,
+    *,
+    tenant: str,
+    client_id: str,
+    watermark: Tuple[int, int],
+    collection: Optional[str] = None,
+    meta: Optional[Dict[str, Any]] = None,
+    max_bytes: Optional[int] = MAX_WIRE_BYTES,
+) -> bytes:
+    """Serialize a Metric / MetricCollection snapshot into one payload.
+
+    Args:
+        obj: the metric or collection whose *current* state to ship.
+        tenant: tenant id the state belongs to.
+        client_id: stable identity of the shipping process (or tree node);
+            the aggregator keys its exactly-once watermark on it.
+        watermark: ``(epoch, step)`` of the LAST batch folded into this
+            snapshot (a :class:`~metrics_tpu.ft.journal.BatchJournal`
+            watermark, or any per-client monotonic counter).
+        collection: logical collection name (defaults to ``tenant``).
+        meta: free-form JSON-safe side data (forward-compatible: decoders
+            keep keys they don't understand).
+        max_bytes: refuse to build a payload larger than this (``None``
+            disables the check). Bounded payloads are the serving-tier
+            contract — an unbounded ``cat`` state should stream through a
+            sketch instead (see ``metrics_tpu.streaming``).
+    """
+    from metrics_tpu.utilities.checkpoint import metric_state_to_tree
+
+    epoch, step = int(watermark[0]), int(watermark[1])
+    if epoch < 0 or step < 0:
+        raise ValueError(f"watermark must be non-negative, got {(epoch, step)}")
+    states = {name: metric_state_to_tree(m) for name, m in _members(obj).items()}
+
+    directory: List[Dict[str, Any]] = []
+    buffers: List[bytes] = []
+    offset = 0
+    for member in sorted(states):
+        for path, leaf in _iter_leaves(states[member]):
+            raw = np.ascontiguousarray(leaf).tobytes()
+            directory.append(
+                {
+                    "member": member,
+                    "path": list(path),
+                    # dtype NAME, not .str: extended dtypes (bfloat16 via
+                    # ml_dtypes) stringify as opaque void records, but their
+                    # names resolve on both ends (_dtype_from_name)
+                    "dtype": np.asarray(leaf).dtype.name,
+                    "shape": list(np.asarray(leaf).shape),
+                    "offset": offset,
+                    "nbytes": len(raw),
+                }
+            )
+            buffers.append(raw)
+            offset += len(raw)
+
+    schema = schema_of(obj)
+    header = {
+        "tenant": str(tenant),
+        "collection": str(collection if collection is not None else tenant),
+        "client": str(client_id),
+        "watermark": [epoch, step],
+        "schema_hash": _fingerprint_of_schema(schema),
+        "schema": schema,
+        "meta": dict(meta or {}),
+        "leaves": directory,
+    }
+    header_bytes = json.dumps(header, sort_keys=True, default=str).encode()
+    payload = _PREAMBLE.pack(WIRE_MAGIC, WIRE_MAJOR, WIRE_MINOR, len(header_bytes)) + header_bytes + b"".join(buffers)
+    if max_bytes is not None and len(payload) > max_bytes:
+        raise WireFormatError(
+            f"payload for tenant {tenant!r} client {client_id!r} is {len(payload)} bytes"
+            f" (> max_bytes={max_bytes}). The serving tier moves BOUNDED state; an"
+            " unbounded cat/buffer accumulation should stream through a bounded"
+            " sketch (metrics_tpu.streaming) before shipping."
+        )
+    return payload
+
+
+def decode_state(data: bytes, *, max_bytes: Optional[int] = MAX_WIRE_BYTES) -> MetricPayload:
+    """Parse payload bytes back into a :class:`MetricPayload`.
+
+    Raises :class:`WireFormatError` on truncation, bad magic, an
+    incompatible **major** version or an oversized payload — the bounded
+    contract is enforced on BOTH ends (a hostile sender does not run our
+    ``encode_state``, so the decode side must refuse too; ``max_bytes=None``
+    disables for trusted offline tooling). A newer **minor** version
+    decodes: unknown header keys are ignored and unknown ``meta`` keys
+    preserved — that asymmetry (minor adds, major breaks) is the whole
+    versioning contract, pinned by ``tests/serve/test_wire.py``.
+    """
+    if max_bytes is not None and len(data) > max_bytes:
+        raise WireFormatError(
+            f"payload is {len(data)} bytes (> max_bytes={max_bytes}); the serving"
+            " tier moves BOUNDED state — refusing to decode"
+        )
+    if len(data) < _PREAMBLE.size:
+        raise WireFormatError(f"payload truncated: {len(data)} bytes < {_PREAMBLE.size}-byte preamble")
+    magic, major, minor, header_len = _PREAMBLE.unpack_from(data)
+    if magic != WIRE_MAGIC:
+        raise WireFormatError(f"bad magic {magic!r}: not a metrics_tpu serve payload")
+    if major != WIRE_MAJOR:
+        raise WireFormatError(
+            f"incompatible wire major version {major} (this build speaks {WIRE_MAJOR})."
+            " Majors may change framing; refusing to guess. Upgrade the"
+            f" {'aggregator' if major > WIRE_MAJOR else 'client'} so both ends agree."
+        )
+    body_start = _PREAMBLE.size + header_len
+    if len(data) < body_start:
+        raise WireFormatError(f"payload truncated inside header ({len(data)} < {body_start} bytes)")
+    try:
+        header = json.loads(data[_PREAMBLE.size : body_start].decode())
+    except (UnicodeDecodeError, ValueError) as err:
+        raise WireFormatError(f"payload header is not valid JSON: {err}") from err
+    for required in ("tenant", "collection", "client", "watermark", "schema_hash", "leaves"):
+        if required not in header:
+            raise WireFormatError(f"payload header missing required key {required!r}")
+
+    body = data[body_start:]
+    states: Dict[str, Dict[str, Any]] = {}
+    try:
+        entries = list(header["leaves"])
+        wm = header["watermark"]
+        epoch, step = int(wm[0]), int(wm[1])
+    except (TypeError, IndexError, KeyError, ValueError) as err:
+        raise WireFormatError(f"malformed payload header: {err}") from err
+    if epoch < 0 or step < 0:
+        raise WireFormatError(f"payload watermark must be non-negative, got {(epoch, step)}")
+    for entry in entries:
+        try:
+            offset, nbytes = int(entry["offset"]), int(entry["nbytes"])
+        except (TypeError, KeyError, ValueError) as err:
+            raise WireFormatError(f"malformed leaf directory entry {entry!r}: {err}") from err
+        if offset < 0 or offset + nbytes > len(body):
+            raise WireFormatError(
+                f"payload truncated: leaf {entry.get('member')}/{'/'.join(entry.get('path', []))}"
+                f" spans bytes [{offset}, {offset + nbytes}) of a {len(body)}-byte body"
+            )
+        try:
+            leaf = np.frombuffer(body[offset : offset + nbytes], dtype=_dtype_from_name(str(entry["dtype"])))
+            leaf = leaf.reshape([int(s) for s in entry["shape"]])
+            member = str(entry["member"])
+            path = [str(p) for p in entry["path"]]
+        except (ValueError, TypeError, KeyError, AttributeError) as err:
+            raise WireFormatError(
+                f"leaf directory entry {entry.get('member') if isinstance(entry, dict) else entry!r}"
+                f" is inconsistent (dtype/shape/nbytes/path disagree): {err}"
+            ) from err
+        if not path:
+            raise WireFormatError(f"leaf directory entry for member {member!r} has an empty path")
+        _set_path(states.setdefault(member, {}), path, leaf)
+
+    return MetricPayload(
+        tenant=str(header["tenant"]),
+        collection=str(header["collection"]),
+        client_id=str(header["client"]),
+        watermark=(epoch, step),
+        schema_hash=str(header["schema_hash"]),
+        schema=header.get("schema", {}),
+        states=states,
+        meta=dict(header.get("meta", {})),
+        wire_version=(int(major), int(minor)),
+    )
+
+
+def apply_payload(obj: Any, payload: MetricPayload) -> Any:
+    """Load a payload's member states INTO a compatible metric/collection
+    (offline consumer path: rebuild a client's snapshot for inspection or a
+    flat reference merge). Returns ``obj``. Aggregators never need this —
+    they fold packed trees directly — but tests and tooling do."""
+    from metrics_tpu.utilities.checkpoint import load_metric_state_tree
+
+    ours, theirs = schema_fingerprint(obj), payload.schema_hash
+    if ours != theirs:
+        diffs = schema_diff(schema_of(obj), payload.schema)
+        raise SchemaMismatchError(
+            f"payload schema {theirs} != target schema {ours};"
+            f" differing: {'; '.join(diffs) or 'fingerprint only (schema summary absent)'}"
+        )
+    members = _members(obj)
+    for name, metric in members.items():
+        if name in payload.states:
+            load_metric_state_tree(metric, payload.states[name])
+    return obj

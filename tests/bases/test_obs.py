@@ -370,6 +370,41 @@ class TestExport:
         assert loaded["counters"]["demo.counter{kind=x}"] == 2.5
         assert json.loads(path.read_text()) == loaded
 
+    def test_to_json_write_is_atomic(self, tmp_path, monkeypatch):
+        """A crash mid-write must never leave a truncated snapshot at
+        ``path``: the dump is staged in a sibling temp file and published
+        with one ``os.replace``, so a concurrent scrape (or a restart
+        reading the file back) sees the complete old document or the
+        complete new one."""
+        import json
+        import os
+
+        obs.enable()
+        obs.inc("atomic.probe", 1.0)
+        path = tmp_path / "obs.json"
+        obs.to_json(path=str(path))
+        before = path.read_text()
+
+        # crash at the publish step: the staged bytes never replace path
+        def boom(*args, **kwargs):
+            raise OSError("disk full mid-write")
+
+        monkeypatch.setattr("os.replace", boom)
+        obs.inc("atomic.probe", 1.0)
+        with pytest.raises(OSError, match="disk full"):
+            obs.to_json(path=str(path))
+        monkeypatch.undo()
+
+        # the published file is byte-identical to the pre-crash snapshot
+        # (never truncated, never half-new), and no stage litter remains
+        assert path.read_text() == before
+        assert json.loads(path.read_text())["counters"]["atomic.probe"] == 1.0
+        assert [n for n in os.listdir(tmp_path) if n.startswith(".tmp.obs.")] == []
+
+        # a clean retry publishes the new snapshot whole
+        obs.to_json(path=str(path))
+        assert json.loads(path.read_text())["counters"]["atomic.probe"] == 2.0
+
     def test_reset_clears_but_keeps_enabled(self):
         obs.enable()
         obs.inc("x")

@@ -130,6 +130,63 @@ class TestCallWithRetries:
             call_with_retries(lambda: None, op="op_h", policy=policy, fallback=lambda e: None)
         assert sleeps == [1.0, 2.0, 3.0]  # third capped at max_backoff_s
 
+    def test_decorrelated_jitter_schedule_is_pinned_for_a_seed(self, monkeypatch):
+        """The decorrelated-jitter schedule is a pure function of
+        (jitter_seed, op) — NO wall-clock randomness: the exact sleeps a
+        production retry performs are the ones a test can pin. d_0 ~
+        U[base, 3*base], d_n ~ U[base, 3*d_{n-1}], capped at
+        max_backoff_s."""
+        from metrics_tpu.ft import backoff_schedule
+
+        policy = RetryPolicy(
+            max_retries=4, backoff_s=0.1, max_backoff_s=1.0,
+            jitter="decorrelated", jitter_seed=1234,
+        )
+        expected = [next_d for next_d, _ in zip(backoff_schedule(policy, "op_j"), range(4))]
+        # the generator is deterministic: a second instantiation replays it
+        again = [next_d for next_d, _ in zip(backoff_schedule(policy, "op_j"), range(4))]
+        assert expected == again
+        # every delay respects the decorrelated-jitter envelope
+        prev = policy.backoff_s
+        for d in expected:
+            assert policy.backoff_s <= d <= min(3.0 * max(prev, policy.backoff_s), policy.max_backoff_s)
+            prev = d
+
+        # call_with_retries sleeps EXACTLY that schedule
+        sleeps = []
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.sleep", sleeps.append)
+        with faults.inject("op_j", count=99):
+            call_with_retries(lambda: None, op="op_j", policy=policy, fallback=lambda e: None)
+        assert sleeps == expected
+
+    def test_decorrelated_jitter_decorrelates_across_seeds(self):
+        """Distinct seeds (distinct clients) must produce distinct
+        schedules — the whole point: 1k clients retrying a downed
+        aggregator spread out instead of thundering back together. Also
+        pins that schedules differ across OPS under one seed."""
+        from metrics_tpu.ft import backoff_schedule
+
+        def schedule(seed, op="gather"):
+            p = RetryPolicy(backoff_s=0.1, max_backoff_s=30.0, jitter="decorrelated", jitter_seed=seed)
+            return tuple(d for d, _ in zip(backoff_schedule(p, op), range(3)))
+
+        schedules = {schedule(seed) for seed in range(64)}
+        assert len(schedules) == 64  # no two clients share a schedule
+        assert schedule(7, "gather") != schedule(7, "ingest")
+
+    def test_jitter_none_keeps_legacy_exponential(self):
+        """jitter='none' (the default) must preserve the exact capped
+        exponential the pre-jitter tests pinned — adding the option cannot
+        shift existing fleets' behavior."""
+        from metrics_tpu.ft import backoff_schedule
+
+        policy = RetryPolicy(max_retries=3, backoff_s=1.0, backoff_factor=2.0, max_backoff_s=3.0)
+        assert [d for d, _ in zip(backoff_schedule(policy, "x"), range(4))] == [1.0, 2.0, 3.0, 3.0]
+
+    def test_jitter_validation(self):
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter="full")
+
     def test_non_retryable_errors_fail_fast(self):
         """Deterministic programming errors (bad dtype, shape bug) must
         raise immediately — retrying fails identically, and degrading would

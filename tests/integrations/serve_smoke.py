@@ -1,0 +1,235 @@
+"""CI smoke: the serving tier end to end, through a REAL SIGKILL.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.serve_smoke``
+(the CI test job does, mirroring ``obs_smoke``/``streaming_smoke``). The
+cheap end-to-end arm of ``tests/serve/``:
+
+* a 2-level :class:`~metrics_tpu.serve.AggregationTree` (root + leaf
+  aggregators) boots in-process and ingests from 8 simulated clients —
+  every payload delivered TWICE and half the clients' intervals delivered
+  OUT OF ORDER (at-least-once delivery, hostile network);
+* the worker subprocess checkpoints the root and **SIGKILLs itself
+  mid-stream** (after the save, with undelivered payloads in flight — a
+  real preemption, no atexit/finally cleanup);
+* the relaunch restores the root BITWISE (verified against a flat offline
+  merge of the pre-kill snapshots), rebuilds the interior nodes from
+  their children's re-ships (the resumed ship sequence must clear the
+  restored watermarks), and finishes the stream;
+* the final ``/query`` answer over HTTP matches a single flat offline
+  merge of each client's LAST snapshot exactly once — BITWISE on every
+  state leaf — and the ``/metrics`` scrape parses line by line as
+  Prometheus text exposition.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_CLIENTS = 8
+N_INTERVALS = 3
+SAMPLES = 96
+TENANT = "smoke"
+FAN_OUT = (3,)  # 2-level tree: 1 root + 3 leaf aggregators
+
+
+def _factory():
+    from metrics_tpu import MaxMetric, SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=128), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def _client_snapshots():
+    """Deterministic cumulative snapshots: ``{client_id: [bytes per
+    interval]}`` — identical bytes in every process that calls this, which
+    is what lets the killed worker and the verifying parent agree."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for c in range(N_CLIENTS):
+        cid = f"client-{c:02d}"
+        rng = np.random.default_rng(1000 + c)
+        coll = _factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            target = jnp.asarray((rng.uniform(0, 1, SAMPLES) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32))
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+            coll["peak"].update(preds)
+            blobs.append(encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval)))
+        out[cid] = blobs
+    return out
+
+
+def _deliver(tree, snapshots, upto_interval: int) -> None:
+    """At-least-once hostile delivery: every snapshot twice, intervals
+    reversed for odd clients."""
+    for c, (cid, blobs) in enumerate(sorted(snapshots.items())):
+        order = blobs[: upto_interval + 1]
+        if c % 2 == 1:
+            order = list(reversed(order))
+        for blob in order:
+            tree.leaf_for(c).ingest(blob)
+            tree.leaf_for(c).ingest(blob)  # duplicate delivery
+
+
+def _flat_leaves(snapshots, interval: int):
+    """Reference: one flat aggregator folding each client's snapshot at
+    ``interval`` exactly once. Returns (spec, numpy leaves)."""
+    import numpy as np
+
+    from metrics_tpu.serve import Aggregator
+
+    flat = Aggregator("flat-reference")
+    flat.register_tenant(TENANT, _factory)
+    for cid, blobs in snapshots.items():
+        flat.ingest(blobs[interval])
+    flat.flush()
+    t = flat._tenant(TENANT)
+    if t.merged_leaves is None:
+        t.fold()
+    return t.spec, [np.asarray(x) for x in t.merged_leaves]
+
+
+def _root_leaves(tree):
+    import numpy as np
+
+    tree.root.aggregator.flush()
+    t = tree.root.aggregator._tenant(TENANT)
+    if t.merged_leaves is None:
+        t.fold()
+    return t.spec, [np.asarray(x) for x in t.merged_leaves]
+
+
+def _assert_bitwise(spec, ours, reference, label: str) -> None:
+    import numpy as np
+
+    for (path, _), a, b in zip(spec, ours, reference):
+        assert a.dtype == b.dtype and a.shape == b.shape, (label, path)
+        assert np.array_equal(a, b, equal_nan=True), (
+            f"{label}: leaf {'/'.join(path)} differs from the flat offline merge"
+        )
+
+
+def worker(ckpt_root: str) -> None:
+    """Ingest intervals 0..1, checkpoint the root, SIGKILL mid-stream."""
+    from metrics_tpu.serve import AggregationTree
+
+    snapshots = _client_snapshots()
+    tree = AggregationTree(fan_out=FAN_OUT, tenants={TENANT: _factory}, checkpoint_root=ckpt_root)
+    _deliver(tree, snapshots, upto_interval=1)
+    tree.pump(rounds=2)
+    tree.save()
+    # interval-2 payloads land in leaf queues but are NEVER pumped or
+    # checkpointed — in-flight work a preemption genuinely loses; the
+    # at-least-once redelivery after restore must recover it
+    _deliver(tree, snapshots, upto_interval=2)
+    print("worker: checkpointed through interval 1, dying mid-stream", flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def main() -> None:
+    from metrics_tpu import obs
+    from metrics_tpu.serve import AggregationTree, MetricsServer
+
+    tmp = tempfile.mkdtemp(prefix="serve_smoke.")
+    ckpt_root = os.path.join(tmp, "root-ckpts")
+
+    rc = subprocess.run(
+        [sys.executable, "-m", "tests.integrations.serve_smoke", "--worker", ckpt_root],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=600,
+    ).returncode
+    assert rc == -signal.SIGKILL, f"worker should die by SIGKILL, got rc={rc}"
+    assert os.path.isdir(ckpt_root) and os.listdir(ckpt_root), "worker must have checkpointed"
+
+    snapshots = _client_snapshots()
+    obs.enable()
+
+    # relaunch: restore the root, interior nodes rebuild from re-ships
+    tree = AggregationTree(fan_out=FAN_OUT, tenants={TENANT: _factory}, checkpoint_root=ckpt_root)
+    manifest = tree.restore()
+    assert manifest is not None, "restore() found no checkpoint"
+
+    # the restored root state IS the pre-kill state: bitwise equal to a
+    # flat offline merge of every client's interval-1 snapshot
+    spec, restored = _root_leaves(tree)
+    flat_spec, flat_pre = _flat_leaves(snapshots, interval=1)
+    assert spec == flat_spec
+    _assert_bitwise(spec, restored, flat_pre, "restored root")
+    print("serve smoke: SIGKILL-restore bitwise vs flat merge of pre-kill snapshots OK", flush=True)
+
+    # finish the stream: hostile redelivery of EVERYTHING (dups included),
+    # several pump rounds so re-ships clear the restored watermarks
+    _deliver(tree, snapshots, upto_interval=2)
+    tree.pump(rounds=3)
+    spec, final = _root_leaves(tree)
+    _, flat_final = _flat_leaves(snapshots, interval=2)
+    _assert_bitwise(spec, final, flat_final, "final root")
+    drops = obs.sum_counter("serve.dedup_drops")
+    assert drops > 0, "duplicate/out-of-order deliveries must be dropped, not re-merged"
+
+    # HTTP surface over the restored root: /query matches the flat offline
+    # merge through JSON, /metrics parses as Prometheus exposition
+    server = MetricsServer(tree.root.aggregator, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        q = json.load(urllib.request.urlopen(f"{base}/query?tenant={TENANT}", timeout=10))
+        # the root's clients are its leaf-node identities, not end clients
+        assert q["clients"] == len(tree.leaves)
+        offline = tree.root.aggregator.query(TENANT)
+        assert q == json.loads(json.dumps(offline)), "HTTP /query != in-process query"
+        auroc = q["values"]["auroc"]
+        assert auroc["bounds"][0] <= auroc["value"] <= auroc["bounds"][1]
+        assert q["values"]["seen"]["value"] == float(N_CLIENTS * N_INTERVALS * SAMPLES)
+
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        families = set()
+        for line in body.splitlines():
+            if line.startswith("# TYPE"):
+                families.add(line.split()[2])
+                continue
+            if not line or line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            float(line.rsplit(" ", 1)[1])  # every sample line parses
+            assert name.startswith("metrics_tpu_"), line
+        for family in (
+            "metrics_tpu_serve_ingests",
+            "metrics_tpu_serve_merges",
+            "metrics_tpu_serve_dedup_drops",
+            "metrics_tpu_serve_value",
+            "metrics_tpu_serve_ingest_ms",
+        ):
+            assert family in families, f"scrape missing family {family}"
+        health = json.load(urllib.request.urlopen(f"{base}/healthz", timeout=10))
+        assert health["tenants"] == 1
+    finally:
+        server.stop()
+
+    print(
+        f"serve smoke OK: {N_CLIENTS} clients x {N_INTERVALS} intervals through a"
+        f" {len(FAN_OUT) + 1}-level tree, duplicated + reordered + SIGKILL-restored,"
+        f" final query bitwise-equal to the flat offline merge"
+        f" ({int(drops)} hostile deliveries dropped)"
+    )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker(sys.argv[2])
+    else:
+        main()

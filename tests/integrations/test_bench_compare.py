@@ -122,6 +122,62 @@ class TestGate:
         assert row["norm_ratio"] == pytest.approx(3.0)
         assert probed in result["regressions"]
 
+    def test_rate_row_gate_is_inverted(self, tmp_path):
+        """Throughput rows (unit="/s" / *_per_s) regress when they go DOWN:
+        halved merges/sec must fail the gate, doubled must read improved."""
+        data = _fixture_dict()
+        data["rows"].append(
+            {"metric": "serve_ingest_merges_per_s", "value": 10000.0, "unit": "/s", "vs_baseline": 1.0}
+        )
+        old = load_record(_write(tmp_path, "rate_old.json", data))
+
+        halved = copy.deepcopy(data)
+        next(r for r in halved["rows"] if r["metric"] == "serve_ingest_merges_per_s")["value"] = 5000.0
+        result = compare_records(old, load_record(_write(tmp_path, "rate_half.json", halved)))
+        assert "serve_ingest_merges_per_s" in result["regressions"]
+        row = next(r for r in result["rows"] if r["metric"] == "serve_ingest_merges_per_s")
+        assert row["ratio"] == pytest.approx(2.0)  # gate ratio: old/new for rates
+        assert "higher is better" in row["note"]
+
+        doubled = copy.deepcopy(data)
+        next(r for r in doubled["rows"] if r["metric"] == "serve_ingest_merges_per_s")["value"] = 20000.0
+        result = compare_records(old, load_record(_write(tmp_path, "rate_double.json", doubled)))
+        row = next(r for r in result["rows"] if r["metric"] == "serve_ingest_merges_per_s")
+        assert row["verdict"] == "improved"
+        assert result["exit_code"] == EXIT_OK
+
+    def test_rate_row_probe_normalization_cancels_chip_state(self, tmp_path):
+        """Throughput halved while the class probe's LATENCY doubled is the
+        same chip state, not code: throughput x probe latency is the
+        invariant, and the normalized ratio must read 1.0."""
+        probe = PROBE_CLASS["serve_ingest_merges_per_s"]
+        data = _fixture_dict()
+        data["rows"].append(
+            {"metric": "serve_ingest_merges_per_s", "value": 10000.0, "unit": "/s", "vs_baseline": 1.0}
+        )
+        old = load_record(_write(tmp_path, "rn_old.json", data))
+        chipslow = _slowed(copy.deepcopy(data), 2.0, metrics={probe})
+        next(r for r in chipslow["rows"] if r["metric"] == "serve_ingest_merges_per_s")["value"] = 5000.0
+        result = compare_records(old, load_record(_write(tmp_path, "rn_new.json", chipslow)))
+        row = next(r for r in result["rows"] if r["metric"] == "serve_ingest_merges_per_s")
+        assert row["norm_ratio"] == pytest.approx(1.0)
+        assert row["verdict"] == "ok"
+
+    def test_rate_row_duplicates_keep_the_highest(self):
+        """rows_by_metric keeps the BEST value per duplicate metric — for a
+        rate row that is the highest, not the lowest."""
+        from benchmarks.compare import rows_by_metric
+
+        rows = [
+            {"metric": "x_per_s", "value": 100.0, "unit": "/s"},
+            {"metric": "x_per_s", "value": 300.0, "unit": "/s"},
+            {"metric": "y_ms", "value": 3.0, "unit": "ms"},
+            {"metric": "y_ms", "value": 1.0, "unit": "ms"},
+        ]
+        out = rows_by_metric(rows)
+        assert out["x_per_s"]["value"] == 300.0
+        assert out["y_ms"]["value"] == 1.0
+
     def test_threshold_is_configurable(self, tmp_path):
         old = load_record(FIXTURE)
         new = load_record(_write(tmp_path, "slow13.json", _slowed(_fixture_dict(), 1.3)))
@@ -161,6 +217,30 @@ class TestCrossDevice:
         result = compare_records(rec, rec)
         assert result["exit_code"] == EXIT_OK
         assert "WARNING" in render_report(result)
+
+
+class TestPriorRounds:
+    def test_rate_row_identified_by_unit_alone_keeps_highest(self, tmp_path, monkeypatch):
+        """bench.py's best-prior scans drop the row's ``unit`` field, so a
+        rate row whose name does NOT end in ``_per_s`` must still invert to
+        max() via the rate-name set _prior_rounds now returns (regression:
+        the gate silently compared against the WORST prior round)."""
+        import glob
+
+        import bench
+
+        paths = []
+        for i, value in enumerate((3.0, 5.0)):
+            row = {"metric": "serve_throughput", "value": value, "unit": "/s"}
+            path = tmp_path / f"BENCH_r9{i}.json"
+            path.write_text(json.dumps({"tail": json.dumps(row)}))
+            paths.append(str(path))
+        monkeypatch.setattr(glob, "glob", lambda pattern: paths)
+        rounds, rate_names = bench._prior_rounds()
+        assert "serve_throughput" in rate_names
+        assert [r["serve_throughput"] for r in rounds] == [3.0, 5.0]
+        # best prior = HIGHEST throughput, despite the non-_per_s name
+        assert bench._best_prior_values()["serve_throughput"] == 5.0
 
 
 class TestTrend:

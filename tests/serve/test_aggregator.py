@@ -1,0 +1,494 @@
+"""Aggregator contract: exactly-once under churn, bitwise persistence.
+
+The acceptance property for the serving tier: replay DUPLICATED and
+REORDERED client payloads, kill and restore the aggregator mid-stream, and
+the final per-tenant ``compute()`` must be BITWISE identical to one flat
+offline merge of each client's state exactly once. Sketch fold-order
+invariance and integer count leaves make that provable, so it is pinned,
+not approximated.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import CatMetric, MaxMetric, MinMetric, SumMetric, obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import (
+    Aggregator,
+    BackpressureError,
+    ServeError,
+    UnknownTenantError,
+)
+from metrics_tpu.serve.wire import SchemaMismatchError, encode_state
+from metrics_tpu.streaming import StreamingAUROC, StreamingQuantile
+
+
+def factory(num_bins: int = 64) -> MetricCollection:
+    return MetricCollection(
+        {
+            "auroc": StreamingAUROC(num_bins=num_bins),
+            "q": StreamingQuantile(num_bins=num_bins),
+            "seen": SumMetric(),
+            "peak": MaxMetric(),
+            "floor": MinMetric(),
+        }
+    )
+
+
+def fill(coll: MetricCollection, rng: np.random.Generator, n: int = 128) -> MetricCollection:
+    preds = jnp.asarray(rng.uniform(0, 1, n).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, n) < 0.6).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    coll["q"].update(preds)
+    coll["seen"].update(jnp.asarray(float(n)))
+    coll["peak"].update(preds)
+    coll["floor"].update(preds)
+    return coll
+
+
+def snapshot_bytes(client: MetricCollection, client_id: str, watermark) -> bytes:
+    return encode_state(client, tenant="t", client_id=client_id, watermark=watermark)
+
+
+def merged_leaves(agg: Aggregator, tenant: str = "t"):
+    t = agg._tenant(tenant)
+    agg.flush()
+    if t.merged_leaves is None:
+        t.fold()
+    return [np.asarray(x) for x in t.merged_leaves]
+
+
+def assert_bitwise_equal(agg_a: Aggregator, agg_b: Aggregator, tenant: str = "t"):
+    a, b = merged_leaves(agg_a, tenant), merged_leaves(agg_b, tenant)
+    spec = agg_a._tenant(tenant).spec
+    assert len(a) == len(b)
+    for (path, _), la, lb in zip(spec, a, b):
+        assert la.dtype == lb.dtype and la.shape == lb.shape, path
+        assert np.array_equal(la, lb, equal_nan=True), f"leaf {'/'.join(path)} differs"
+
+
+class TestRegistry:
+    def test_unknown_tenant_raises(self):
+        agg = Aggregator("n")
+        with pytest.raises(UnknownTenantError, match="not registered"):
+            agg.ingest(snapshot_bytes(fill(factory(), np.random.default_rng(0)), "c", (0, 0)))
+
+    def test_duplicate_registration_rejected(self):
+        agg = Aggregator("n")
+        agg.register_tenant("t", factory)
+        with pytest.raises(ServeError, match="already registered"):
+            agg.register_tenant("t", factory)
+
+    def test_unbounded_cat_state_rejected_at_registration(self):
+        """The serving tier folds BOUNDED states only: a cat accumulation
+        would turn the aggregation tree back into a sample mover."""
+        agg = Aggregator("n")
+        with pytest.raises(ServeError, match="sketch"):
+            agg.register_tenant("bad", MetricCollection({"cat": CatMetric()}))
+
+    def test_schema_mismatch_names_the_config_diff(self):
+        agg = Aggregator("n")
+        agg.register_tenant("t", lambda: factory(num_bins=64))
+        other = fill(factory(num_bins=128), np.random.default_rng(0))
+        with pytest.raises(SchemaMismatchError) as err:
+            agg.ingest(encode_state(other, tenant="t", client_id="c", watermark=(0, 0)))
+        assert "num_bins" in str(err.value) or "config" in str(err.value)
+
+
+class TestExactlyOnce:
+    def test_duplicates_and_reordering_fold_exactly_once(self):
+        """At-least-once delivery with duplicates and reordering must
+        produce the same merged state as each client's LATEST snapshot
+        folded exactly once (flat offline reference)."""
+        rng = np.random.default_rng(1)
+        clients = {}
+        snapshots = {}  # client -> [bytes per interval]
+        for c in range(6):
+            cid = f"c{c}"
+            client = factory()
+            blobs = []
+            for interval in range(3):
+                fill(client, rng)
+                blobs.append(snapshot_bytes(client, cid, (0, interval)))
+            clients[cid] = client
+            snapshots[cid] = blobs
+
+        agg = Aggregator("churn")
+        obs.enable()
+        obs.reset()
+        agg.register_tenant("t", factory)
+        # hostile delivery: each snapshot delivered TWICE, intervals
+        # reversed for half the clients (stale arrives after newer)
+        for c, (cid, blobs) in enumerate(snapshots.items()):
+            order = blobs if c % 2 == 0 else list(reversed(blobs))
+            for blob in order:
+                agg.ingest(blob)
+                agg.ingest(blob)  # duplicate delivery
+            agg.flush()
+
+        # reference: one flat aggregator seeing each FINAL snapshot once
+        ref = Aggregator("ref")
+        ref.register_tenant("t", factory)
+        for cid, blobs in snapshots.items():
+            ref.ingest(blobs[-1])
+
+        assert_bitwise_equal(agg, ref)
+        q = agg.query("t")
+        assert q["clients"] == 6
+        # watermark advances: in-order clients accept all 3 intervals,
+        # reversed clients accept only the newest (stale ones are dropped)
+        assert q["payloads_folded"] == 3 * 3 + 3 * 1
+        assert obs.sum_counter("serve.dedup_drops") > 0
+
+    def test_keep_latest_semantics(self):
+        """A newer cumulative snapshot REPLACES the older one — values must
+        track the latest, not double-fold."""
+        rng = np.random.default_rng(2)
+        client = factory()
+        agg = Aggregator("kl")
+        agg.register_tenant("t", factory)
+
+        fill(client, rng)
+        agg.ingest(snapshot_bytes(client, "c0", (0, 0)))
+        agg.flush()
+        seen_1 = agg.query("t")["values"]["seen"]["value"]
+
+        fill(client, rng)  # client folds MORE data into the same state
+        agg.ingest(snapshot_bytes(client, "c0", (0, 1)))
+        agg.flush()
+        seen_2 = agg.query("t")["values"]["seen"]["value"]
+        assert seen_1 == 128.0 and seen_2 == 256.0  # cumulative, not 384
+
+    def test_watermark_is_per_client(self):
+        rng = np.random.default_rng(3)
+        agg = Aggregator("pc")
+        agg.register_tenant("t", factory)
+        agg.ingest(snapshot_bytes(fill(factory(), rng), "a", (0, 5)))
+        agg.ingest(snapshot_bytes(fill(factory(), rng), "b", (0, 0)))  # lower wm, DIFFERENT client
+        agg.flush()
+        assert agg.query("t")["clients"] == 2
+        assert agg.client_watermark("t", "a") == (0, 5)
+        assert agg.client_watermark("t", "b") == (0, 0)
+
+
+class TestBackpressureAndWorker:
+    def test_bounded_queue_raises_when_full(self):
+        rng = np.random.default_rng(4)
+        agg = Aggregator("bp", max_queue=2)
+        agg.register_tenant("t", factory)
+        blob = snapshot_bytes(fill(factory(), rng), "c", (0, 0))
+        agg.ingest(blob, block=False)
+        agg.ingest(blob, block=False)
+        with pytest.raises(BackpressureError, match="queue is full"):
+            agg.ingest(blob, block=False)
+        agg.flush()  # drains; next ingest succeeds
+        agg.ingest(blob, block=False)
+
+    def test_background_worker_folds(self):
+        rng = np.random.default_rng(5)
+        agg = Aggregator("bg", flush_interval_s=0.01).start()
+        try:
+            agg.register_tenant("t", factory)
+            agg.ingest(snapshot_bytes(fill(factory(), rng), "c", (0, 0)))
+            import time
+
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if agg._tenant("t").merged_leaves is not None:
+                    break
+                time.sleep(0.01)
+        finally:
+            agg.stop()
+        assert agg.query("t")["payloads_folded"] == 1
+
+
+class TestPersistence:
+    def test_save_restore_bitwise_with_exact_dedup(self, tmp_path):
+        """Restart restores tenants, client states and watermarks BITWISE:
+        the restored merged state equals the pre-kill one leaf for leaf,
+        and a stale replay after restore is still dropped."""
+        rng = np.random.default_rng(6)
+        snaps = {}
+        for c in range(4):
+            cid = f"c{c}"
+            client = factory()
+            snaps[cid] = [
+                snapshot_bytes(fill(client, rng), cid, (0, 0)),
+                snapshot_bytes(fill(client, rng), cid, (0, 1)),
+            ]
+
+        agg = Aggregator("live", checkpoint_dir=str(tmp_path))
+        agg.register_tenant("t", factory)
+        for cid, blobs in snaps.items():
+            for blob in blobs:
+                agg.ingest(blob)
+        agg.flush()
+        before = merged_leaves(agg)
+        agg.save()
+
+        # "kill": a brand-new process object; tenants re-registered first
+        revived = Aggregator("revived", checkpoint_dir=str(tmp_path))
+        revived.register_tenant("t", factory)
+        assert revived.restore() is not None
+        assert_bitwise_equal(agg, revived)
+        after = merged_leaves(revived)
+        for a, b in zip(before, after):
+            assert np.array_equal(a, b, equal_nan=True)
+
+        # watermarks survived: the stale interval-0 replay is DROPPED
+        obs.enable()
+        obs.reset()
+        for cid, blobs in snaps.items():
+            revived.ingest(blobs[0])
+        revived.flush()
+        assert obs.sum_counter("serve.dedup_drops") == 4.0
+        # the restored journals kept their full accounting (2 accepted
+        # deliveries per client) and the stale replays added NOTHING
+        assert revived.query("t")["payloads_folded"] == 8
+        assert_bitwise_equal(agg, revived)
+
+    def test_restore_requires_reregistration(self, tmp_path):
+        rng = np.random.default_rng(7)
+        agg = Aggregator("a", checkpoint_dir=str(tmp_path))
+        agg.register_tenant("t", factory)
+        agg.ingest(snapshot_bytes(fill(factory(), rng), "c", (0, 0)))
+        agg.flush()
+        agg.save()
+
+        fresh = Aggregator("b", checkpoint_dir=str(tmp_path))
+        with pytest.raises(UnknownTenantError, match="register_tenant"):
+            fresh.restore()
+
+    def test_restore_rejects_changed_schema(self, tmp_path):
+        rng = np.random.default_rng(8)
+        agg = Aggregator("a", checkpoint_dir=str(tmp_path))
+        agg.register_tenant("t", lambda: factory(num_bins=64))
+        agg.ingest(snapshot_bytes(fill(factory(64), rng), "c", (0, 0)))
+        agg.flush()
+        agg.save()
+
+        fresh = Aggregator("b", checkpoint_dir=str(tmp_path))
+        fresh.register_tenant("t", lambda: factory(num_bins=128))
+        with pytest.raises(SchemaMismatchError):
+            fresh.restore()
+
+    def test_save_without_dir_raises(self):
+        with pytest.raises(ServeError, match="checkpoint_dir"):
+            Aggregator("x").save()
+
+
+class TestQuery:
+    def test_query_carries_error_envelopes(self):
+        rng = np.random.default_rng(9)
+        agg = Aggregator("q")
+        agg.register_tenant("t", factory)
+        agg.ingest(snapshot_bytes(fill(factory(), rng), "c", (0, 0)))
+        q = agg.query("t")
+        auroc = q["values"]["auroc"]
+        assert "error_bound" in auroc and "bounds" in auroc
+        lo, hi = auroc["bounds"]
+        assert lo <= auroc["value"] <= hi
+        assert auroc["error_bound"] >= 0
+        # plain reductions have values but no envelope
+        assert "error_bound" not in q["values"]["seen"]
+        assert q["values"]["seen"]["value"] == 128.0
+
+    def test_multi_tenant_isolation(self):
+        rng = np.random.default_rng(10)
+        agg = Aggregator("iso")
+        agg.register_tenant("t1", factory)
+        agg.register_tenant("t2", factory)
+        c = fill(factory(), rng)
+        agg.ingest(encode_state(c, tenant="t1", client_id="c", watermark=(0, 0)))
+        agg.flush()
+        assert agg.query("t1")["payloads_folded"] == 1
+        assert agg.query("t2")["payloads_folded"] == 0
+        assert agg.query("t2")["values"]["seen"]["value"] == 0.0
+
+
+class TestHardening:
+    """Regressions for review findings: the node must survive its own
+    checkpoint cadence, hostile bodies and concurrent scrapes."""
+
+    def test_auto_checkpoint_flush_does_not_deadlock(self, tmp_path):
+        """checkpoint_every triggers save() from inside flush(); save()
+        re-acquires the non-reentrant flush lock, so the call must happen
+        after flush releases it (regression: self-deadlock on first flush)."""
+        import threading
+
+        rng = np.random.default_rng(11)
+        agg = Aggregator("auto", checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        agg.register_tenant("t", factory)
+        agg.ingest(snapshot_bytes(fill(factory(), rng), "c", (0, 0)))
+        worker = threading.Thread(target=agg.flush, daemon=True)
+        worker.start()
+        worker.join(timeout=60.0)
+        assert not worker.is_alive(), "flush() deadlocked on auto-checkpoint"
+        # and the checkpoint is real: a fresh process restores from it
+        revived = Aggregator("revived", checkpoint_dir=str(tmp_path))
+        revived.register_tenant("t", factory)
+        assert revived.restore() is not None
+        assert_bitwise_equal(agg, revived)
+
+    def _corrupt(self, blob: bytes):
+        """Decode a valid payload and gut one member's state: the header
+        schema hash still matches (it is sender-declared), the BODY lies."""
+        from metrics_tpu.serve.wire import decode_state
+
+        payload = decode_state(blob)
+        del payload.states["seen"]
+        return payload
+
+    def test_corrupted_body_neither_poisons_tenant_nor_raises_from_flush(self):
+        rng = np.random.default_rng(12)
+        agg = Aggregator("poison")
+        obs.enable()
+        obs.reset()
+        agg.register_tenant("t", factory)
+        agg.ingest(self._corrupt(snapshot_bytes(fill(factory(), rng), "bad", (0, 0))))
+        with pytest.warns(UserWarning, match="corrupted payload"):
+            agg.flush()  # must drop, not raise (regression: empty slot inserted)
+        assert obs.sum_counter("serve.accept_errors") == 1.0
+        assert "bad" not in agg._tenant("t").clients
+
+        good = fill(factory(), rng)
+        agg.ingest(snapshot_bytes(good, "good", (0, 0)))
+        agg.flush()  # regression: IndexError forever once a slot was empty
+        ref = Aggregator("ref")
+        ref.register_tenant("t", factory)
+        ref.ingest(snapshot_bytes(good, "good", (0, 0)))
+        assert_bitwise_equal(agg, ref)
+        assert agg.query("t")["clients"] == 1
+
+    def test_corrupted_body_does_not_kill_background_worker(self):
+        import time
+        import warnings as _warnings
+
+        rng = np.random.default_rng(13)
+        agg = Aggregator("worker", flush_interval_s=0.01)
+        agg.register_tenant("t", factory)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", UserWarning)
+            agg.start()
+            try:
+                agg.ingest(self._corrupt(snapshot_bytes(fill(factory(), rng), "bad", (0, 0))))
+                agg.ingest(snapshot_bytes(fill(factory(), rng), "good", (0, 0)))
+                deadline = time.time() + 10.0
+                while time.time() < deadline:
+                    if agg._tenant("t").clients.get("good") is not None and not agg._tenant("t").dirty:
+                        break
+                    time.sleep(0.01)
+                assert agg._worker.is_alive(), "one bad payload killed the worker"
+            finally:
+                agg.stop()
+        assert agg.query("t")["clients"] == 1
+
+    def test_concurrent_scrape_query_while_worker_folds(self):
+        """query() must never observe a half-materialized view while the
+        background worker folds (torn read across view_lock)."""
+        import time
+
+        rng = np.random.default_rng(14)
+        agg = Aggregator("tear", flush_interval_s=0.001).start()
+        try:
+            agg.register_tenant("t", factory)
+            client = factory()
+            stop_at = time.time() + 1.0
+            step = 0
+            while time.time() < stop_at:
+                fill(client, rng, n=32)
+                agg.ingest(snapshot_bytes(client, "c", (0, step)))
+                step += 1
+                q = agg.query("t")  # raced the worker before view_lock
+                seen = q["values"]["seen"]["value"]
+                assert seen == 0.0 or seen % 32.0 == 0.0, q
+        finally:
+            agg.stop()
+        assert agg.query("t")["values"]["seen"]["value"] == 32.0 * step
+
+    def test_collapsed_tree_level_is_dropped_not_raised(self):
+        """A hash-copying payload that collapses a dict level into a leaf
+        (indexing an ndarray with a string inside _tree_get) is the same
+        lying-body family as a missing leaf: dropped + counted, never an
+        IndexError out of flush()."""
+        rng = np.random.default_rng(15)
+        agg = Aggregator("collapse")
+        obs.enable()
+        obs.reset()
+        agg.register_tenant("t", factory)
+        from metrics_tpu.serve.wire import decode_state
+
+        payload = decode_state(snapshot_bytes(fill(factory(), rng), "bad", (0, 0)))
+        member = sorted(payload.states)[0]
+        state = sorted(payload.states[member])[0]
+        payload.states[member][state] = np.zeros(4, np.float32)  # dict level -> leaf
+        agg.ingest(payload)
+        with pytest.warns(UserWarning, match="corrupted payload"):
+            agg.flush()
+        assert obs.sum_counter("serve.accept_errors") == 1.0
+        assert agg.query("t")["clients"] == 0
+
+    def test_register_bare_metric_instance(self):
+        """Metric instances are callable (forward), so the is-it-a-factory
+        probe must not call them (regression: TypeError from update())."""
+        rng = np.random.default_rng(16)
+        agg = Aggregator("bare")
+        agg.register_tenant("t", StreamingAUROC(num_bins=32))
+
+        client = StreamingAUROC(num_bins=32)
+        preds = jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32))
+        target = jnp.asarray((rng.uniform(0, 1, 64) < 0.5).astype(np.int32))
+        client.update(preds, target)
+        agg.ingest(
+            encode_state(
+                MetricCollection([client]), tenant="t", client_id="c", watermark=(0, 0)
+            )
+        )
+        agg.flush()
+        q = agg.query("t")
+        assert q["clients"] == 1
+        ref = StreamingAUROC(num_bins=32)
+        ref.update(preds, target)
+        vals = list(q["values"].values())
+        assert np.float64(vals[0]["value"]).tobytes() == np.asarray(
+            ref.compute(), np.float64
+        ).tobytes()
+
+    def test_consensus_mismatch_does_not_abort_fold_loop(self):
+        """Clients disagreeing on a consensus leaf (sketch meta bytes) must
+        stale that ONE tenant, not raise out of flush() past every other
+        tenant on the node (regression: fold loop aborted mid-iteration)."""
+        rng = np.random.default_rng(17)
+        agg = Aggregator("consensus")
+        obs.enable()
+        obs.reset()
+        agg.register_tenant("a", factory)
+        agg.register_tenant("b", factory)
+        from metrics_tpu.serve.wire import decode_state
+
+        good_a = decode_state(
+            encode_state(fill(factory(), rng), tenant="a", client_id="c0", watermark=(0, 0))
+        )
+        evil_a = decode_state(
+            encode_state(fill(factory(), rng), tenant="a", client_id="c1", watermark=(0, 0))
+        )
+        meta = np.array(evil_a.states["auroc"]["sketch"]["__sketch_meta"], copy=True)
+        meta[0] ^= 0xFF  # same shape/dtype, different bytes -> consensus mismatch
+        evil_a.states["auroc"]["sketch"]["__sketch_meta"] = meta
+        fill_b = fill(factory(), rng)
+        agg.ingest(good_a)
+        agg.ingest(evil_a)
+        agg.ingest(encode_state(fill_b, tenant="b", client_id="c0", watermark=(0, 0)))
+        with pytest.warns(UserWarning, match="could not fold tenant 'a'"):
+            agg.flush()  # must not raise
+        assert obs.sum_counter("serve.fold_errors") == 1.0
+        # tenant b folded despite a's poison and reads back bitwise
+        ref = Aggregator("ref")
+        ref.register_tenant("b", factory)
+        ref.ingest(encode_state(fill_b, tenant="b", client_id="c0", watermark=(0, 0)))
+        q, qr = agg.query("b"), ref.query("b")
+        assert q["values"] == qr["values"]
+        # tenant a still surfaces the error on a direct query
+        with pytest.raises(ServeError, match="disagree"):
+            agg.query("a")

@@ -1,0 +1,196 @@
+"""HTTP surface: scrape parses, query matches, ingest status codes.
+
+The `/metrics` body is re-parsed with the same exposition-format checks
+the obs Prometheus tests use — a scrape that Prometheus cannot parse is an
+outage, not a formatting nit.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import Aggregator, MetricsServer
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "scrapeme"
+
+
+def factory() -> MetricCollection:
+    return MetricCollection({"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric()})
+
+
+def snapshot(cid: str, wm, seed: int = 0) -> bytes:
+    rng = np.random.default_rng(seed)
+    coll = factory()
+    preds = jnp.asarray(rng.uniform(0, 1, 100).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, 100) < preds).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    coll["seen"].update(jnp.asarray(100.0))
+    return encode_state(coll, tenant=TENANT, client_id=cid, watermark=wm)
+
+
+@pytest.fixture()
+def server():
+    agg = Aggregator("http-test")
+    agg.register_tenant(TENANT, factory)
+    srv = MetricsServer(agg, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(server, path):
+    return urllib.request.urlopen(f"http://127.0.0.1:{server.port}{path}", timeout=10)
+
+
+def _post(server, path, data):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}", data=data, method="POST"
+    )
+    return urllib.request.urlopen(req, timeout=10)
+
+
+class TestScrape:
+    def test_metrics_parses_and_carries_serve_families(self, server):
+        server.aggregator.ingest(snapshot("c0", (0, 0)))
+        body = _get(server, "/metrics").read().decode()
+        # exposition format sanity: every non-comment line is `name{...} value`
+        seen_families = set()
+        for line in body.splitlines():
+            if not line or line.startswith("#"):
+                if line.startswith("# TYPE"):
+                    seen_families.add(line.split()[2])
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            value = line.rsplit(" ", 1)[1]
+            float(value)  # parses as a number
+            assert name.startswith("metrics_tpu_")
+        assert "metrics_tpu_serve_ingests" in seen_families
+        assert "metrics_tpu_serve_value" in seen_families
+        # the per-tenant value gauge names tenant AND metric
+        assert f'metrics_tpu_serve_value{{metric="auroc",tenant="{TENANT}"}}' in body
+
+    def test_scrape_histogram_buckets_are_cumulative(self, server):
+        server.aggregator.ingest(snapshot("c0", (0, 0)))
+        server.aggregator.flush()
+        body = _get(server, "/metrics").read().decode()
+        # the obs registry is process-global, so the scrape may carry
+        # ingest histograms for OTHER tests' tenants too: cumulativity is
+        # a per-series property — group the buckets by label set sans `le`
+        series = {}
+        for line in body.splitlines():
+            if not line.startswith("metrics_tpu_serve_ingest_ms_bucket"):
+                continue
+            labels, value = line.split("{", 1)[1].rsplit("}", 1)
+            key = ",".join(p for p in labels.split(",") if not p.startswith("le="))
+            series.setdefault(key, []).append(float(value))
+        ours = [v for k, v in series.items() if f'tenant="{TENANT}"' in k]
+        assert ours, "ingest latency histogram for our tenant missing from scrape"
+        for buckets in series.values():
+            assert buckets == sorted(buckets)  # cumulative counts never decrease
+
+
+class TestQuery:
+    def test_query_matches_aggregator(self, server):
+        server.aggregator.ingest(snapshot("c0", (0, 0)))
+        got = json.load(_get(server, f"/query?tenant={TENANT}"))
+        want = server.aggregator.query(TENANT)
+        assert got == json.loads(json.dumps(want))  # identical through JSON
+        assert got["values"]["auroc"]["error_bound"] >= 0
+
+    def test_query_missing_tenant_param_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/query")
+        assert err.value.code == 400
+
+    def test_query_unknown_tenant_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/query?tenant=nope")
+        assert err.value.code == 404
+        assert "not registered" in json.load(err.value)["error"]
+
+    def test_unknown_route_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/wrong")
+        assert err.value.code == 404
+
+
+class TestIngest:
+    def test_ingest_accepts_and_is_queryable(self, server):
+        resp = _post(server, "/ingest", snapshot("c-http", (0, 0)))
+        assert json.load(resp) == {"accepted": True}
+        got = json.load(_get(server, f"/query?tenant={TENANT}"))
+        assert got["clients"] == 1
+
+    def test_ingest_malformed_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server, "/ingest", b"not a payload")
+        assert err.value.code == 400
+
+    def test_ingest_unknown_tenant_404(self, server):
+        coll = factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        blob = encode_state(coll, tenant="ghost", client_id="c", watermark=(0, 0))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server, "/ingest", blob)
+        assert err.value.code == 404
+
+    def test_ingest_backpressure_503(self):
+        agg = Aggregator("tiny", max_queue=1)
+        agg.register_tenant(TENANT, factory)
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            _post(srv, "/ingest", snapshot("a", (0, 0)))
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv, "/ingest", snapshot("b", (0, 0)))
+            assert err.value.code == 503
+        finally:
+            srv.stop()
+
+    def test_post_wrong_route_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server, "/metrics", b"x")
+        assert err.value.code == 404
+
+
+class TestHealth:
+    def test_healthz(self, server):
+        server.aggregator.ingest(snapshot("c0", (0, 0)))
+        server.aggregator.flush()
+        h = json.load(_get(server, "/healthz"))
+        assert h["node"] == "http-test"
+        assert h["tenants"] == 1
+        assert h["clients"] == {TENANT: 1}
+
+
+class TestIngestSizeCap:
+    def test_oversized_post_rejected_before_reading_body(self, server):
+        """A Content-Length past the wire cap answers 413 without buffering
+        the body (ThreadingHTTPServer buffers per thread — unbounded reads
+        are an OOM, not a parse error)."""
+        from metrics_tpu.serve.wire import MAX_WIRE_BYTES
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server, "/ingest", b"\x00" * (MAX_WIRE_BYTES + 1))
+        assert err.value.code == 413
+        assert "cap" in json.loads(err.value.read())["error"]
+        # the server is still healthy afterwards
+        _post(server, "/ingest", snapshot("c-after", (0, 0)))
+        with _get(server, f"/query?tenant={TENANT}") as r:
+            assert json.loads(r.read())["clients"] == 1
+
+    def test_handler_has_socket_timeout(self):
+        """A client declaring Content-Length N but sending < N bytes must
+        not pin a handler thread forever: the handler class sets a socket
+        timeout so rfile.read() can never block unbounded (regression)."""
+        from metrics_tpu.serve.endpoints import _make_handler
+
+        handler_cls = _make_handler(object())
+        assert isinstance(handler_cls.timeout, (int, float))
+        assert 0 < handler_cls.timeout <= 120

@@ -1,0 +1,40 @@
+"""Loadgen plumbing at tiny config: rows exist, verify arm is bitwise.
+
+The full 1k-client / 3-level run is the bench's job (`bench.py` serve
+section and the CI serve smoke); this pins the harness itself — row names
+the sweep publishes, accounting fields the `--compare` gate relies on, and
+the `verify=True` flat-merge cross-check — at a seconds-scale config.
+"""
+import json
+
+from metrics_tpu.serve.loadgen import main, run_loadgen
+
+
+class TestLoadgen:
+    def test_rows_and_accounting(self):
+        out = run_loadgen(
+            n_clients=12,
+            fan_out=(2, 3),
+            payloads_per_client=2,
+            samples_per_payload=32,
+            num_bins=32,
+            verify=True,
+        )
+        assert out["verified_bitwise"] is True
+        assert out["clients"] == 12
+        assert out["payloads"] == 24
+        assert out["tree_levels"] == 3
+        assert out["serve_ingest_merges_per_s"] > 0
+        assert out["serve_ingest_p99_ms"] > 0
+        # every accepted payload folds through its leaf, each leaf ships to
+        # its intermediate, each intermediate to the root: merges >= payloads
+        assert out["merges"] >= out["payloads"]
+
+    def test_cli_json(self, capsys):
+        code = main(
+            ["--clients", "6", "--fan-out", "2", "--payloads-per-client", "1", "--num-bins", "16", "--verify"]
+        )
+        assert code == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["clients"] == 6
+        assert out["verified_bitwise"] is True

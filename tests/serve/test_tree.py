@@ -1,0 +1,191 @@
+"""The hierarchical-aggregation invariant, pinned.
+
+The serving tier's scaling story rests on ONE claim: because payloads are
+cumulative snapshots and the fold is an exact monoid over sketch /
+integer-count leaves, folding bottom-up through ANY tree shape produces
+bitwise the same root state as one flat fold over every client. These
+tests pin that claim across arities, fan-ins and depths — if it ever
+breaks, hierarchical deployment silently stops being exact and every
+`/query` answer at the root becomes topology-dependent.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, MinMetric, SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import AggregationTree, Aggregator
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC, StreamingQuantile
+
+TENANT = "t"
+
+
+def factory() -> MetricCollection:
+    return MetricCollection(
+        {
+            "auroc": StreamingAUROC(num_bins=64),
+            "q": StreamingQuantile(num_bins=32),
+            "seen": SumMetric(),
+            "peak": MaxMetric(),
+            "floor": MinMetric(),
+        }
+    )
+
+
+def client_snapshot(c: int, rng: np.random.Generator) -> bytes:
+    coll = factory()
+    n = 64 + 16 * (c % 3)  # uneven stream lengths across clients
+    preds = jnp.asarray(rng.uniform(0, 1, n).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, n) < 0.5).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    coll["q"].update(preds)
+    coll["seen"].update(jnp.asarray(float(n)))
+    coll["peak"].update(preds)
+    coll["floor"].update(preds)
+    return encode_state(coll, tenant=TENANT, client_id=f"client-{c:04d}", watermark=(0, 0))
+
+
+def root_leaves(tree: AggregationTree):
+    tree.root.aggregator.flush()
+    t = tree.root.aggregator._tenant(TENANT)
+    if t.merged_leaves is None:
+        t.fold()
+    return t.spec, [np.asarray(x) for x in t.merged_leaves]
+
+
+def flat_leaves(snapshots):
+    flat = Aggregator("flat")
+    flat.register_tenant(TENANT, factory)
+    for blob in snapshots:
+        flat.ingest(blob)
+    flat.flush()
+    t = flat._tenant(TENANT)
+    if t.merged_leaves is None:
+        t.fold()
+    return t.spec, [np.asarray(x) for x in t.merged_leaves]
+
+
+class TestTreeEqualsFlatBitwise:
+    @pytest.mark.parametrize(
+        "fan_out,n_clients",
+        [
+            ((1,), 3),        # degenerate chain
+            ((2,), 7),        # one level, uneven leaf loads
+            ((3, 2), 11),     # pair fan-in under odd arity
+            ((2, 4), 16),     # the docs' example shape
+            ((2, 2, 2), 13),  # 4-level tree, prime client count
+        ],
+    )
+    def test_tree_fold_equals_flat_fold(self, fan_out, n_clients):
+        rng = np.random.default_rng(hash((fan_out, n_clients)) % (2**32))
+        snapshots = [client_snapshot(c, rng) for c in range(n_clients)]
+
+        tree = AggregationTree(fan_out=fan_out, tenants={TENANT: factory})
+        for c, blob in enumerate(snapshots):
+            tree.leaf_for(c).ingest(blob)
+        tree.pump()
+
+        spec_t, leaves_t = root_leaves(tree)
+        spec_f, leaves_f = flat_leaves(snapshots)
+        assert spec_t == spec_f
+        for (path, _), a, b in zip(spec_t, leaves_t, leaves_f):
+            assert a.dtype == b.dtype and a.shape == b.shape, path
+            assert np.array_equal(a, b, equal_nan=True), f"leaf {'/'.join(path)}: tree != flat"
+
+    def test_repeated_pumps_are_idempotent(self):
+        """Interior nodes re-ship cumulative snapshots every pump; the
+        keep-latest dedup at each parent must make extra pumps a no-op."""
+        rng = np.random.default_rng(42)
+        snapshots = [client_snapshot(c, rng) for c in range(8)]
+        tree = AggregationTree(fan_out=(2, 4), tenants={TENANT: factory})
+        for c, blob in enumerate(snapshots):
+            tree.leaf_for(c).ingest(blob)
+        tree.pump()
+        _, once = root_leaves(tree)
+        tree.pump(rounds=3)
+        _, thrice = root_leaves(tree)
+        for a, b in zip(once, thrice):
+            assert np.array_equal(a, b, equal_nan=True)
+
+    def test_incremental_arrival_converges_to_flat(self):
+        """Clients arriving across pump rounds (some updating their
+        snapshot between rounds) still converge to the flat fold of the
+        latest snapshot per client."""
+        rng = np.random.default_rng(7)
+        tree = AggregationTree(fan_out=(2, 3), tenants={TENANT: factory})
+
+        # round 1: first 5 clients
+        finals = {}
+        for c in range(5):
+            coll = factory()
+            preds = jnp.asarray(rng.uniform(0, 1, 50).astype(np.float32))
+            target = jnp.asarray((rng.uniform(0, 1, 50) < 0.5).astype(np.int32))
+            coll["auroc"].update(preds, target)
+            coll["q"].update(preds)
+            coll["seen"].update(jnp.asarray(50.0))
+            coll["peak"].update(preds)
+            coll["floor"].update(preds)
+            blob = encode_state(coll, tenant=TENANT, client_id=f"c{c}", watermark=(0, 0))
+            tree.leaf_for(c).ingest(blob)
+            finals[c] = (coll, blob)
+        tree.pump()
+
+        # round 2: clients 0-2 fold more data and re-ship; clients 5-6 join
+        for c in list(range(3)) + [5, 6]:
+            coll = finals[c][0] if c in finals else factory()
+            preds = jnp.asarray(rng.uniform(0, 1, 30).astype(np.float32))
+            target = jnp.asarray((rng.uniform(0, 1, 30) < 0.5).astype(np.int32))
+            coll["auroc"].update(preds, target)
+            coll["q"].update(preds)
+            coll["seen"].update(jnp.asarray(30.0))
+            coll["peak"].update(preds)
+            coll["floor"].update(preds)
+            wm = (0, 1) if c in finals else (0, 0)
+            blob = encode_state(coll, tenant=TENANT, client_id=f"c{c}", watermark=wm)
+            tree.leaf_for(c).ingest(blob)
+            finals[c] = (coll, blob)
+        tree.pump()
+
+        spec_t, leaves_t = root_leaves(tree)
+        _, leaves_f = flat_leaves([blob for _, blob in finals.values()])
+        for (path, _), a, b in zip(spec_t, leaves_t, leaves_f):
+            assert np.array_equal(a, b, equal_nan=True), f"leaf {'/'.join(path)}"
+
+
+class TestTopology:
+    def test_fan_out_validation(self):
+        with pytest.raises(ValueError, match="fan_out"):
+            AggregationTree(fan_out=(2, 0), tenants={TENANT: factory})
+
+    def test_shapes(self):
+        tree = AggregationTree(fan_out=(4, 16), tenants={TENANT: factory})
+        assert len(tree.levels) == 3
+        assert len(tree.levels[1]) == 4
+        assert len(tree.leaves) == 16
+        assert len(tree.nodes) == 21
+        # leaves round-robin over clients
+        assert tree.leaf_for(0) is tree.leaf_for(16)
+
+    def test_forward_returns_zero_at_root(self):
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        assert tree.root.forward() == 0
+
+    def test_custom_send_transport(self):
+        """AggregatorNode.send carries the SAME bytes the in-process path
+        ingests — the HTTP-boundary contract."""
+        rng = np.random.default_rng(3)
+        shipped = []
+        parent = Aggregator("parent")
+        parent.register_tenant(TENANT, factory)
+        from metrics_tpu.serve.tree import AggregatorNode
+
+        child_agg = Aggregator("child")
+        child_agg.register_tenant(TENANT, factory)
+        node = AggregatorNode(child_agg, send=lambda data: (shipped.append(data), parent.ingest(data)))
+        child_agg.ingest(client_snapshot(0, rng))
+        assert node.forward() == 1
+        parent.flush()
+        assert isinstance(shipped[0], bytes)
+        assert parent.query(TENANT)["clients"] == 1

@@ -1,0 +1,263 @@
+"""Wire-format contract: round trip, truncation, version evolution.
+
+The serving tier's compatibility story lives here:
+
+* a payload from a NEWER MINOR (optional additions) must decode on this
+  build, preserving unknown ``meta`` keys and ignoring unknown header
+  keys — minors add, they never break;
+* a different MAJOR is refused loudly (majors may change framing);
+* a changed metric CONFIGURATION (sketch bin count, threshold grid) is a
+  different schema fingerprint and must be rejected with the exact
+  differing path — never merged silently into incompatible histograms.
+"""
+import json
+import struct
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve.wire import (
+    MAX_WIRE_BYTES,
+    WIRE_MAGIC,
+    WIRE_MAJOR,
+    WIRE_MINOR,
+    SchemaMismatchError,
+    WireFormatError,
+    apply_payload,
+    decode_state,
+    encode_state,
+    schema_diff,
+    schema_fingerprint,
+    schema_of,
+)
+from metrics_tpu.streaming import StreamingAUROC, StreamingQuantile
+
+_PREAMBLE = struct.Struct("<4sHHI")
+
+
+def _collection(num_bins: int = 64) -> MetricCollection:
+    return MetricCollection(
+        {
+            "auroc": StreamingAUROC(num_bins=num_bins),
+            "quantile": StreamingQuantile(num_bins=num_bins),
+            "seen": SumMetric(),
+            "peak": MaxMetric(),
+        }
+    )
+
+
+def _filled(seed: int = 0, num_bins: int = 64) -> MetricCollection:
+    rng = np.random.default_rng(seed)
+    coll = _collection(num_bins)
+    preds = jnp.asarray(rng.uniform(0, 1, 200).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, 200) < 0.5).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    coll["quantile"].update(preds)
+    coll["seen"].update(jnp.asarray(200.0))
+    coll["peak"].update(preds)
+    return coll
+
+
+def _reframe(data: bytes, *, minor=None, major=None, extra_header=None, extra_meta=None) -> bytes:
+    """Rebuild payload bytes with a bumped version and/or injected unknown
+    keys — the shape a FUTURE-minor encoder would emit."""
+    magic, maj, mino, header_len = _PREAMBLE.unpack_from(data)
+    header = json.loads(data[_PREAMBLE.size : _PREAMBLE.size + header_len].decode())
+    body = data[_PREAMBLE.size + header_len :]
+    if extra_header:
+        header.update(extra_header)
+    if extra_meta:
+        header.setdefault("meta", {}).update(extra_meta)
+    raw = json.dumps(header, sort_keys=True).encode()
+    return (
+        _PREAMBLE.pack(
+            magic, maj if major is None else major, mino if minor is None else minor, len(raw)
+        )
+        + raw
+        + body
+    )
+
+
+class TestRoundTrip:
+    def test_every_reduction_kind_round_trips(self):
+        coll = _filled()
+        blob = encode_state(coll, tenant="t", client_id="c0", watermark=(3, 17), meta={"host": "h1"})
+        payload = decode_state(blob)
+        assert payload.tenant == "t"
+        assert payload.client_id == "c0"
+        assert payload.watermark == (3, 17)
+        assert payload.meta == {"host": "h1"}
+        assert payload.schema_hash == schema_fingerprint(coll)
+        assert payload.wire_version == (WIRE_MAJOR, WIRE_MINOR)
+        assert set(payload.states) == {"auroc", "quantile", "seen", "peak"}
+
+        clone = _collection()
+        apply_payload(clone, payload)
+        ours, theirs = coll.compute(), clone.compute()
+        for name in ours:
+            assert np.array_equal(np.asarray(ours[name]), np.asarray(theirs[name])), name
+
+    def test_bare_metric_matches_one_member_collection(self):
+        """A client shipping a bare metric and a tenant registered as a
+        one-member collection must agree on member naming and schema."""
+        metric = SumMetric()
+        metric.update(jnp.asarray(5.0))
+        assert schema_fingerprint(metric) == schema_fingerprint(MetricCollection([SumMetric()]))
+        payload = decode_state(encode_state(metric, tenant="t", client_id="c", watermark=(0, 0)))
+        assert list(payload.states) == ["SumMetric"]
+
+    def test_bounded_payload_contract(self):
+        coll = _filled()
+        with pytest.raises(WireFormatError, match="BOUNDED"):
+            encode_state(coll, tenant="t", client_id="c", watermark=(0, 0), max_bytes=64)
+        blob = encode_state(coll, tenant="t", client_id="c", watermark=(0, 0))
+        assert len(blob) <= MAX_WIRE_BYTES
+
+    def test_negative_watermark_rejected(self):
+        with pytest.raises(ValueError, match="non-negative"):
+            encode_state(_collection(), tenant="t", client_id="c", watermark=(0, -1))
+
+
+class TestTruncationAndFraming:
+    def test_truncated_preamble(self):
+        with pytest.raises(WireFormatError, match="truncated"):
+            decode_state(b"MTS")
+
+    def test_bad_magic(self):
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        with pytest.raises(WireFormatError, match="magic"):
+            decode_state(b"NOPE" + blob[4:])
+
+    def test_truncated_header_and_body(self):
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        _, _, _, header_len = _PREAMBLE.unpack_from(blob)
+        with pytest.raises(WireFormatError, match="truncated"):
+            decode_state(blob[: _PREAMBLE.size + header_len // 2])
+        with pytest.raises(WireFormatError, match="truncated"):
+            decode_state(blob[:-8])  # last leaf's extent exceeds the body
+
+    def test_header_not_json(self):
+        raw = b"\x00" * 32
+        blob = _PREAMBLE.pack(WIRE_MAGIC, WIRE_MAJOR, WIRE_MINOR, len(raw)) + raw
+        with pytest.raises(WireFormatError, match="JSON"):
+            decode_state(blob)
+
+    def test_missing_required_header_key(self):
+        header = json.dumps({"tenant": "t"}).encode()
+        blob = _PREAMBLE.pack(WIRE_MAGIC, WIRE_MAJOR, WIRE_MINOR, len(header)) + header
+        with pytest.raises(WireFormatError, match="missing required key"):
+            decode_state(blob)
+
+
+class TestVersionEvolution:
+    """The forward-compat satellite: minors add, majors break, config
+    changes are a different schema — all three pinned."""
+
+    def test_newer_minor_with_unknown_keys_decodes(self):
+        """A payload serialized by a FUTURE minor — bumped version, unknown
+        header keys, unknown meta keys — must decode on this build: the
+        values we understand are intact and the unknown meta survives."""
+        coll = _filled()
+        blob = encode_state(coll, tenant="t", client_id="c0", watermark=(1, 5), meta={"known": 1})
+        future = _reframe(
+            blob,
+            minor=WIRE_MINOR + 3,
+            extra_header={"compression_hint": "zstd-someday", "shard_of": [0, 8]},
+            extra_meta={"future_field": {"nested": True}},
+        )
+        payload = decode_state(future)
+        assert payload.wire_version == (WIRE_MAJOR, WIRE_MINOR + 3)
+        assert payload.watermark == (1, 5)
+        # unknown meta keys are PRESERVED, not dropped
+        assert payload.meta == {"known": 1, "future_field": {"nested": True}}
+        # and the states still apply cleanly
+        clone = _collection()
+        apply_payload(clone, payload)
+        assert np.array_equal(
+            np.asarray(clone.compute()["auroc"]), np.asarray(coll.compute()["auroc"])
+        )
+
+    def test_different_major_rejected_loudly(self):
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        with pytest.raises(WireFormatError, match="major"):
+            decode_state(_reframe(blob, major=WIRE_MAJOR + 1))
+        with pytest.raises(WireFormatError, match="major"):
+            decode_state(_reframe(blob, major=0))
+
+    def test_changed_bin_count_is_a_different_schema(self):
+        """num_bins=64 vs 128 sketches must NOT merge: the fingerprints
+        differ and the rejection names the differing config path."""
+        a, b = _collection(num_bins=64), _collection(num_bins=128)
+        assert schema_fingerprint(a) != schema_fingerprint(b)
+        diffs = schema_diff(schema_of(a), schema_of(b))
+        assert any("config" in d or "num_bins" in d for d in diffs), diffs
+
+        payload = decode_state(
+            encode_state(_filled(num_bins=128), tenant="t", client_id="c", watermark=(0, 0))
+        )
+        with pytest.raises(SchemaMismatchError) as err:
+            apply_payload(a, payload)
+        # the loud part: the message names WHAT differs, not just the hash
+        assert "num_bins" in str(err.value) or "config" in str(err.value)
+
+    def test_member_rename_is_a_different_schema(self):
+        a = MetricCollection({"x": SumMetric()})
+        b = MetricCollection({"y": SumMetric()})
+        assert schema_fingerprint(a) != schema_fingerprint(b)
+        assert any("only in" in d for d in schema_diff(schema_of(a), schema_of(b)))
+
+
+class TestDecodeSizeCap:
+    def test_oversized_payload_refused_at_decode(self):
+        """The bounded contract is enforced on BOTH ends: a hostile sender
+        does not run our encode_state, so decode must refuse too."""
+        from metrics_tpu.serve.wire import MAX_WIRE_BYTES, WireFormatError, decode_state
+
+        blob = b"\x00" * (MAX_WIRE_BYTES + 1)
+        with pytest.raises(WireFormatError, match="max_bytes"):
+            decode_state(blob)
+        # trusted offline tooling can opt out (and then hit the magic check)
+        with pytest.raises(WireFormatError, match="magic"):
+            decode_state(blob, max_bytes=None)
+
+
+class TestMalformedLeafDirectory:
+    def test_inconsistent_shape_nbytes_is_wire_format_error(self):
+        """A directory entry whose dtype/shape/nbytes disagree must raise
+        the documented WireFormatError, not a bare reshape ValueError."""
+        import json as _json
+        import struct as _struct
+
+        from metrics_tpu.serve.wire import WireFormatError, decode_state
+
+        header = {
+            "tenant": "t", "collection": "t", "client": "c",
+            "watermark": [0, 0], "schema_hash": "x",
+            "leaves": [{"member": "m", "path": ["s"], "dtype": "float32",
+                        "shape": [3], "offset": 0, "nbytes": 8}],
+        }
+        hb = _json.dumps(header).encode()
+        blob = _struct.pack("<4sHHI", b"MTSV", 1, 0, len(hb)) + hb + b"\x00" * 8
+        with pytest.raises(WireFormatError, match="inconsistent"):
+            decode_state(blob)
+
+    def test_empty_leaf_path_is_wire_format_error(self):
+        import json as _json
+        import struct as _struct
+
+        from metrics_tpu.serve.wire import WireFormatError, decode_state
+
+        header = {
+            "tenant": "t", "collection": "t", "client": "c",
+            "watermark": [0, 0], "schema_hash": "x",
+            "leaves": [{"member": "m", "path": [], "dtype": "float32",
+                        "shape": [2], "offset": 0, "nbytes": 8}],
+        }
+        hb = _json.dumps(header).encode()
+        blob = _struct.pack("<4sHHI", b"MTSV", 1, 0, len(hb)) + hb + b"\x00" * 8
+        with pytest.raises(WireFormatError, match="empty path"):
+            decode_state(blob)
