@@ -591,6 +591,33 @@ def bench_serve(n_clients: int = 1000) -> dict:
     }
 
 
+def bench_serve_degraded(n_clients: int = 1000) -> dict:
+    """Serving-tier throughput UNDER FAULTS: the self-healing overhead row.
+
+    ``serve_ingest_degraded_merges_per_s`` — the same 1k-client / 3-level
+    run as :func:`bench_serve` but with a 10% seeded fault schedule
+    (:class:`~metrics_tpu.ft.faults.WireChaos`: drops, duplicates,
+    reordering, crc-refused corruption) against resilience-armed nodes
+    (per-client circuit breakers, poison firewall, shed watermark). A RATE
+    row (``unit="/s"``, gate inverted): a regression here means the
+    firewall/chaos path got more expensive relative to the clean row —
+    exactly the hot-path tax the opt-in design promises to bound.
+    """
+    from metrics_tpu.serve.loadgen import run_loadgen
+
+    out = run_loadgen(
+        n_clients=n_clients,
+        fan_out=(4, 16),
+        payloads_per_client=2,
+        samples_per_payload=256,
+        num_bins=256,
+        verify=False,
+        fault_rate=0.10,
+        seed=7,
+    )
+    return {"serve_ingest_degraded_merges_per_s": out["serve_ingest_merges_per_s"]}
+
+
 def bench_probes() -> dict:
     """Chip-state calibration probes, one per op class.
 
@@ -1093,6 +1120,17 @@ def main(
             serve_rows["serve_ingest_p99_ms"],
             prior.get("serve_ingest_p99_ms", serve_rows["serve_ingest_p99_ms"]),
             baseline="best_prior_self",
+        )
+        degraded_rows = section(bench_serve_degraded)
+        emit(
+            "serve_ingest_degraded_merges_per_s",
+            degraded_rows["serve_ingest_degraded_merges_per_s"],
+            prior.get(
+                "serve_ingest_degraded_merges_per_s",
+                degraded_rows["serve_ingest_degraded_merges_per_s"],
+            ),
+            baseline="best_prior_self",
+            unit="/s",
         )
     except Exception as err:  # noqa: BLE001 — serve rows must not kill the sweep
         print(f"SKIPPED serve rows: {err}", file=sys.stderr)

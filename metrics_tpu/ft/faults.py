@@ -18,25 +18,46 @@ injection points that the production seams consult:
   so ordering-by-timestamp bugs (NTP drift across hosts) become testable;
   discovery must order by monotonic sequence number instead.
 
+**Serve-level chaos** (the :mod:`metrics_tpu.serve` self-healing harness):
+:class:`WireChaos` is a *seeded* per-payload fault schedule for the
+serving tier's delivery path — drop / duplicate / reorder / corrupt /
+delay decisions drawn from one ``random.Random(seed)`` so an entire chaos
+run is reproducible bit for bit and the harness can compute the exact
+oracle set of accepted snapshots; :func:`corrupt_payload` flips a body
+byte so the wire format's per-leaf crc32 must refuse it;
+:func:`partition` severs tree nodes' uplinks for the duration of a
+``with`` block (the subtree heals by cumulative re-ship on exit); and
+:func:`kill_node` hard-kills a tree node the way a SIGKILL would (state
+gone, no cleanup) for a :class:`~metrics_tpu.serve.resilience.Supervisor`
+to detect and rebuild. Every injected event is counted under
+``chaos.injected{kind=}`` when the obs layer is armed, so a chaos run's
+fault budget is auditable from the same snapshot as its effects.
+
 Production cost when nothing is armed: :func:`maybe_fail` is a single
 dict read per seam hit (the module rides the normal ``metrics_tpu.ft``
 import; seams in ``utilities/`` import it deferred only to avoid the
 module-level cycle with ``ft.manager``).
 """
+import random
+import struct
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, Optional, Type
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple, Type
 
 __all__ = [
     "FaultInjected",
     "SimulatedPreemption",
+    "WireChaos",
     "armed",
     "clock_skew",
+    "corrupt_payload",
     "crash_mid_save",
     "inject",
+    "kill_node",
     "maybe_fail",
     "now",
+    "partition",
     "transient_gather_failures",
 ]
 
@@ -150,3 +171,185 @@ def armed(point: Optional[str] = None) -> bool:
     if point is None:
         return bool(_armed)
     return point in _armed
+
+
+# ----------------------------------------------------------------------
+# Serve-level chaos: seeded wire-delivery faults, partitions, node kills
+# ----------------------------------------------------------------------
+
+# the serve wire preamble (metrics_tpu/serve/wire.py _PREAMBLE): magic,
+# major, minor, header length — duplicated here rather than imported so the
+# ft layer never pulls the serve package in at import time
+_WIRE_PREAMBLE = struct.Struct("<4sHHI")
+
+
+def _chaos_inc(kind: str) -> None:
+    from metrics_tpu.obs.registry import enabled as _obs_enabled
+    from metrics_tpu.obs.registry import inc as _obs_inc
+
+    if _obs_enabled():
+        _obs_inc("chaos.injected", kind=kind)
+
+
+def corrupt_payload(data: bytes, rng: random.Random) -> bytes:
+    """Flip one random byte of a wire payload's LEAF BODY.
+
+    The returned bytes frame and parse — the corruption is in a leaf's
+    extent, so ``decode_state`` must refuse it via the per-leaf crc32,
+    naming the leaf (the integrity contract the minor-1 wire bump added).
+    Payloads too short to carry a body get a header byte flipped instead
+    (refused as malformed JSON / bad framing — still refused, just
+    unattributable)."""
+    if not data:
+        return data
+    body_start = _WIRE_PREAMBLE.size
+    if len(data) >= _WIRE_PREAMBLE.size:
+        body_start += _WIRE_PREAMBLE.unpack_from(data)[3]
+    at = rng.randrange(min(body_start, len(data) - 1), len(data))
+    flipped = bytearray(data)
+    flipped[at] ^= rng.randrange(1, 256)
+    return bytes(flipped)
+
+
+class WireChaos:
+    """Seeded per-payload fault schedule for serve-tier delivery.
+
+    One ``random.Random(seed)`` drives every decision, so a chaos run is
+    reproducible and the harness can derive the exact **oracle**: a
+    payload whose fate is ``drop`` or ``corrupt`` is never accepted
+    (corruption is refused by the wire crc32); every other fate delivers
+    the original bytes at least once eventually, and under the
+    aggregator's keep-latest dedup contributes iff it carries the
+    client's highest delivered watermark.
+
+    The harness drives it payload by payload::
+
+        chaos = WireChaos(seed=7, p_drop=0.03, p_corrupt=0.02, ...)
+        for blob in round_payloads:
+            fate, now_blobs = chaos.plan(blob)
+            deliver(now_blobs)                  # [] for drop/reorder/delay
+        deliver(chaos.end_round())              # reorders (shuffled) + held delays
+        ...
+        deliver(chaos.flush())                  # stream end: everything still held
+
+    ``reorder`` re-delivers within the same round in shuffled order;
+    ``delay`` holds the payload until the NEXT round boundary. For
+    cumulative keep-latest snapshots both reduce to out-of-order delivery
+    — exactly the hostility the watermark dedup must absorb. ``counts``
+    tallies every fate; each non-``deliver`` fate also bumps the
+    ``chaos.injected{kind=}`` obs counter when the layer is armed.
+    """
+
+    FATES = ("drop", "duplicate", "reorder", "corrupt", "delay")
+
+    def __init__(
+        self,
+        seed: int,
+        *,
+        p_drop: float = 0.02,
+        p_duplicate: float = 0.03,
+        p_reorder: float = 0.05,
+        p_corrupt: float = 0.02,
+        p_delay: float = 0.03,
+    ) -> None:
+        probs = dict(
+            drop=p_drop, duplicate=p_duplicate, reorder=p_reorder, corrupt=p_corrupt, delay=p_delay
+        )
+        for kind, p in probs.items():
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"p_{kind} must be in [0, 1], got {p}")
+        if sum(probs.values()) > 1.0:
+            raise ValueError(f"fault probabilities sum to {sum(probs.values())} > 1")
+        self._rng = random.Random(seed)
+        self._probs = probs
+        self.counts: Dict[str, int] = {kind: 0 for kind in self.FATES}
+        self.counts["deliver"] = 0
+        self._reordered: List[bytes] = []
+        self._delayed: List[bytes] = []
+
+    def plan(self, payload: bytes) -> Tuple[str, List[bytes]]:
+        """Draw this payload's fate; returns ``(fate, deliver_now)``."""
+        draw = self._rng.random()
+        fate = "deliver"
+        upto = 0.0
+        for kind in self.FATES:
+            upto += self._probs[kind]
+            if draw < upto:
+                fate = kind
+                break
+        self.counts[fate] += 1
+        if fate != "deliver":
+            _chaos_inc(fate)
+        if fate == "drop":
+            return fate, []
+        if fate == "duplicate":
+            return fate, [payload, payload]
+        if fate == "corrupt":
+            return fate, [corrupt_payload(payload, self._rng)]
+        if fate == "reorder":
+            self._reordered.append(payload)
+            return fate, []
+        if fate == "delay":
+            self._delayed.append(payload)
+            return fate, []
+        return fate, [payload]
+
+    def end_round(self) -> List[bytes]:
+        """Payloads due at this round boundary: the round's reordered
+        payloads (shuffled) plus anything delayed from earlier rounds."""
+        due, self._reordered = self._reordered, []
+        self._rng.shuffle(due)
+        delayed, self._delayed = self._delayed, []
+        return delayed + due
+
+    def flush(self) -> List[bytes]:
+        """Everything still held (stream end — nothing may be lost that
+        chaos did not explicitly drop, or the oracle would be wrong)."""
+        return self.end_round()
+
+    def shuffle(self, items: Sequence[Any]) -> List[Any]:
+        """Seeded shuffle from the same stream (harness-side ordering)."""
+        out = list(items)
+        self._rng.shuffle(out)
+        return out
+
+    def choice(self, items: Sequence[Any]) -> Any:
+        """Seeded pick from the same stream (e.g. WHICH node to kill)."""
+        return items[self._rng.randrange(len(items))]
+
+
+@contextmanager
+def partition(*nodes: Any) -> Iterator[None]:
+    """Sever the uplink of serve tree nodes for the ``with`` block.
+
+    Every :meth:`~metrics_tpu.serve.tree.AggregatorNode.forward` ship from
+    a partitioned node is silently dropped (counted under
+    ``chaos.injected{kind=partition}``) — the network-partition half of
+    the self-healing contract. On exit the original transport is restored
+    (the heal); the next forward ships the node's **cumulative** snapshot,
+    so the parent's view converges with nothing replayed. The parent-side
+    symptom during the partition is a growing child ship age — the
+    ``stale_child`` condition :class:`~metrics_tpu.serve.resilience.Supervisor`
+    flags."""
+
+    def _drop(_payload: bytes) -> None:
+        _chaos_inc("partition")
+
+    saved = [(node, node._send) for node in nodes]
+    for node in nodes:
+        node._send = _drop
+    try:
+        yield
+    finally:
+        for node, send in saved:
+            node._send = send
+
+
+def kill_node(node: Any) -> None:
+    """Hard-kill a serve tree node (``AggregatorNode.hard_kill``): its
+    in-memory state vanishes with no cleanup, the in-process analogue of
+    SIGKILL (the real-signal arm lives in the preemption/serve smokes).
+    Counted under ``chaos.injected{kind=kill}``; detection and rebuild are
+    the :class:`~metrics_tpu.serve.resilience.Supervisor`'s job."""
+    _chaos_inc("kill")
+    node.hard_kill()

@@ -72,6 +72,18 @@ class CheckpointManager:
             each successful save. ``None`` keeps everything.
         async_save: persist off-thread; :meth:`save` returns after the
             main-thread state snapshot.
+        save_timeout_s: watchdog budget for one async persist. Without it
+            a hung persist thread (wedged filesystem, dead NFS mount) is
+            INVISIBLE — the loop keeps training, no checkpoint ever lands,
+            and the next preemption loses everything since the last good
+            one. With it, a persist that overruns is warned about once,
+            counted under ``ft.save_timeouts``, and abandoned by the next
+            :meth:`save`/:meth:`wait` (which surface an
+            :class:`~metrics_tpu.ft.retry.AttemptTimeout` instead of
+            joining a hung thread forever). The writer thread itself
+            cannot be cancelled — it is left as a daemon, exactly like a
+            timed-out retry attempt. Sync saves are not watched (the hang
+            is visible: the caller is inside it).
 
     Example::
 
@@ -94,15 +106,26 @@ class CheckpointManager:
         directory: "os.PathLike | str",
         keep_last: Optional[int] = 3,
         async_save: bool = False,
+        save_timeout_s: Optional[float] = None,
     ) -> None:
         if keep_last is not None and keep_last < 1:
             raise ValueError(f"keep_last must be >= 1 (or None to keep all), got {keep_last}")
+        if save_timeout_s is not None and save_timeout_s <= 0:
+            raise ValueError(f"save_timeout_s must be positive (or None), got {save_timeout_s}")
         self.directory = os.fspath(os.path.abspath(directory))
         self.keep_last = keep_last
         self.async_save = bool(async_save)
+        self.save_timeout_s = save_timeout_s
         self._worker: Optional[threading.Thread] = None
         self._worker_error: Optional[BaseException] = None
         self._lock = threading.Lock()
+        # per-save watchdog record: {"done": Event, "noted": bool, "final": path}
+        self._pending: Optional[Dict[str, Any]] = None
+        self._warned_timeout = False
+        # floor for the next sequence number: an abandoned hung save is
+        # unpublished (invisible to checkpoints()) but its writer may still
+        # land ckpt-<seq> later — the next save must never reuse that seq
+        self._next_seq = 0
 
     # ------------------------------------------------------------------
     # Discovery
@@ -168,7 +191,9 @@ class CheckpointManager:
         """
         self._drain(reraise=True)
         existing = self.checkpoints()
-        seq = existing[-1][0] + 1 if existing else 0
+        with self._lock:
+            seq = max(existing[-1][0] + 1 if existing else 0, self._next_seq)
+            self._next_seq = seq + 1
         final = os.path.join(self.directory, f"ckpt-{seq:08d}")
         tree = metric_state_to_tree(metric)
         manifest = self._build_manifest(seq, journal, logger, epoch, step, extra)
@@ -183,38 +208,118 @@ class CheckpointManager:
             tree = jax.tree_util.tree_map(
                 lambda x: jax.numpy.array(x) if isinstance(x, jax.Array) else x, tree
             )
+            pending = {
+                "done": threading.Event(),
+                "noted": False,
+                "abandoned": False,
+                "final": final,
+                "timer": None,
+            }
+            if self.save_timeout_s is not None:
+                # the watchdog fires even if nobody ever calls wait(): a hung
+                # persist must be loud on its own, not only when joined. The
+                # worker cancels it on completion, else every fast save would
+                # leave an idle timer thread alive for the whole budget.
+                timer = threading.Timer(self.save_timeout_s, self._note_save_timeout, args=(pending,))
+                timer.daemon = True
+                pending["timer"] = timer
             with self._lock:
+                self._pending = pending
                 self._worker = threading.Thread(
                     target=self._persist_guarded,
-                    args=(tree, manifest, final),
+                    args=(tree, manifest, final, pending),
                     name=f"ft-ckpt-save-{seq}",
                     daemon=True,
                 )
                 self._worker.start()
+            if pending["timer"] is not None:
+                pending["timer"].start()
         else:
             self._persist(tree, manifest, final)
         return final
 
     def wait(self) -> None:
-        """Block until a pending async save completes; re-raise its error."""
+        """Block until a pending async save completes; re-raise its error.
+        With ``save_timeout_s`` set, a persist still running past the
+        budget is abandoned (daemon thread) and surfaces as
+        :class:`~metrics_tpu.ft.retry.AttemptTimeout`."""
         self._drain(reraise=True)
 
     def _drain(self, reraise: bool) -> None:
         with self._lock:
             worker = self._worker
+            pending = self._pending
         if worker is not None:
-            worker.join()
-            with self._lock:
-                self._worker = None
+            worker.join(self.save_timeout_s)
+            if worker.is_alive():
+                # hung past the watchdog budget: abandon the daemon thread
+                # (it cannot be cancelled) and record the hang as THIS
+                # save's failure so the caller sees it like any other
+                # background save error
+                if pending is not None:
+                    self._note_save_timeout(pending)
+                with self._lock:
+                    if pending is not None:
+                        # the hung writer keeps running (daemon, uncancellable);
+                        # once abandoned it must not touch shared state — a late
+                        # failure would otherwise be misattributed to the NEXT
+                        # save via _worker_error
+                        pending["abandoned"] = True
+                    if self._worker is worker:
+                        self._worker = None
+                        self._pending = None
+                    if self._worker_error is None:
+                        from metrics_tpu.ft.retry import AttemptTimeout
+
+                        self._worker_error = AttemptTimeout(
+                            f"async checkpoint save to {pending['final'] if pending else self.directory}"
+                            f" exceeded save_timeout_s={self.save_timeout_s}; the writer thread was"
+                            " abandoned and the checkpoint must be assumed missing"
+                        )
+            else:
+                with self._lock:
+                    self._worker = None
+                    self._pending = None
         if reraise and self._worker_error is not None:
             error, self._worker_error = self._worker_error, None
             raise error
 
-    def _persist_guarded(self, tree: Any, manifest: Dict[str, Any], final: str) -> None:
+    def _note_save_timeout(self, pending: Dict[str, Any]) -> None:
+        """One ``ft.save_timeouts`` bump + one-shot warn per hung save."""
+        with self._lock:
+            if pending["done"].is_set() or pending["noted"]:
+                return
+            pending["noted"] = True
+            first = not self._warned_timeout
+            self._warned_timeout = True
+        if _obs_enabled():
+            _obs_inc("ft.save_timeouts")
+        if first:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"Async checkpoint save to {pending['final']} has run past"
+                f" save_timeout_s={self.save_timeout_s}s and may be hung (wedged"
+                " filesystem?). The writer thread cannot be cancelled; the next"
+                " save()/wait() will stop waiting on it after the same budget and"
+                " raise AttemptTimeout. Further hung saves are counted under"
+                " ft.save_timeouts without warning again.",
+                RuntimeWarning,
+            )
+
+    def _persist_guarded(
+        self, tree: Any, manifest: Dict[str, Any], final: str, pending: Dict[str, Any]
+    ) -> None:
         try:
             self._persist(tree, manifest, final)
         except BaseException as err:  # noqa: BLE001 — surfaced on next save()/wait()
-            self._worker_error = err
+            with self._lock:
+                if not pending["abandoned"]:
+                    self._worker_error = err
+        finally:
+            pending["done"].set()
+            if pending["timer"] is not None:
+                pending["timer"].cancel()
 
     def _persist(self, tree: Any, manifest: Dict[str, Any], final: str) -> None:
         import orbax.checkpoint as ocp

@@ -30,6 +30,23 @@ Conditions (each independently armable):
 * ``degraded_sync`` — any ``ft.degraded_syncs`` series fired: some host
   computed over local-only state and cross-host values are no longer
   comparable.
+
+Serve-fleet conditions (default disarmed — arm them on processes hosting a
+:class:`~metrics_tpu.serve.Aggregator`; node-level supervision with a
+repair arm lives in :class:`metrics_tpu.serve.resilience.Supervisor`,
+these are the registry-only verdicts):
+
+* ``queue_saturation`` — the worst ``serve.queue_depth`` gauge series
+  (one per aggregator node) at/over ``queue_depth_threshold``: ingest is
+  outrunning the fold and backpressure/shedding is imminent.
+* ``quarantine`` — the ``serve.clients_quarantined`` gauges report a
+  client currently locked out for poisoned state, pending operator
+  action. Current state, not the cumulative ``serve.quarantined``
+  counter: a lifted quarantine stops firing.
+* ``circuit_open`` — the ``serve.circuits_open`` gauges report a circuit
+  currently open: some client is being refused for repeated invalid
+  payloads. Current state, not the cumulative open-transition counter: a
+  circuit that probes back closed reads healthy again.
 """
 from typing import Any, Dict, List, Optional
 
@@ -51,6 +68,13 @@ class HealthMonitor:
             ``recompile_warn_threshold`` at check time.
         clamp_risk: arm the buffer ``clamp_risk`` condition.
         degraded_syncs: arm the ``degraded_sync`` condition.
+        queue_depth_threshold: arm the serving-tier ``queue_saturation``
+            condition at this ``serve.queue_depth`` gauge value, read as
+            the worst node's series (``None`` disarms).
+        quarantine: arm the serving-tier ``quarantine`` condition
+            (a ``serve.clients_quarantined`` gauge is currently nonzero).
+        circuit_open: arm the serving-tier ``circuit_open`` condition
+            (a ``serve.circuits_open`` gauge is currently nonzero).
         name: label on the ``health.*`` counter series.
         warn: emit a one-shot ``rank_zero_warn`` per condition kind.
 
@@ -68,6 +92,9 @@ class HealthMonitor:
         recompile_threshold: Optional[int] = None,
         clamp_risk: bool = True,
         degraded_syncs: bool = True,
+        queue_depth_threshold: Optional[float] = None,
+        quarantine: bool = False,
+        circuit_open: bool = False,
         name: str = "default",
         warn: bool = True,
     ) -> None:
@@ -76,6 +103,9 @@ class HealthMonitor:
         self.recompile_threshold = recompile_threshold
         self.clamp_risk = bool(clamp_risk)
         self.degraded_syncs = bool(degraded_syncs)
+        self.queue_depth_threshold = queue_depth_threshold
+        self.quarantine = bool(quarantine)
+        self.circuit_open = bool(circuit_open)
         self.name = str(name)
         self.warn = bool(warn)
         self._warned_kinds: set = set()
@@ -153,6 +183,58 @@ class HealthMonitor:
             )
         return None
 
+    @staticmethod
+    def _gauge_series(name: str) -> List[float]:
+        """Every current value of gauge ``name`` across its label series
+        (one series per aggregator node in a serving tree — a single
+        unlabeled read would be last-writer-wins and an idle node could
+        mask a saturated one)."""
+        prefix = name + "{"
+        return [
+            value
+            for key, value in _reg.gauges().items()
+            if key == name or key.startswith(prefix)
+        ]
+
+    def _check_queue_saturation(self) -> Optional[str]:
+        if self.queue_depth_threshold is None:
+            return None
+        depths = self._gauge_series("serve.queue_depth")
+        worst = max(depths, default=None)
+        if worst is not None and worst >= self.queue_depth_threshold:
+            return (
+                f"serve ingest queue depth {worst:.0f} >= {self.queue_depth_threshold:.0f} —"
+                " ingest is outrunning the fold; backpressure/shedding imminent"
+            )
+        return None
+
+    def _check_quarantine(self) -> Optional[str]:
+        if not self.quarantine:
+            return None
+        # the CURRENT-state gauge, not the cumulative serve.quarantined
+        # counter: an incident resolved by unquarantine() must stop firing
+        quarantined = sum(self._gauge_series("serve.clients_quarantined"))
+        if quarantined:
+            return (
+                f"{int(quarantined)} client(s) quarantined for shipping poisoned"
+                " (NaN/Inf) state — locked out pending operator unquarantine()"
+            )
+        return None
+
+    def _check_circuit_open(self) -> Optional[str]:
+        if not self.circuit_open:
+            return None
+        # current-state gauge (serve.circuits_open), not the cumulative
+        # open-transition counter: a circuit that probed back to closed
+        # must read healthy again
+        opened = sum(self._gauge_series("serve.circuits_open"))
+        if opened:
+            return (
+                f"{int(opened)} ingest circuit(s) currently open: some client is"
+                " being refused for repeated invalid payloads (serve.circuits_open)"
+            )
+        return None
+
     # ------------------------------------------------------------------
 
     def check(self) -> Dict[str, Any]:
@@ -170,6 +252,9 @@ class HealthMonitor:
             ("recompile_storm", self._check_recompile_storm),
             ("clamp_risk", self._check_clamp_risk),
             ("degraded_sync", self._check_degraded_sync),
+            ("queue_saturation", self._check_queue_saturation),
+            ("quarantine", self._check_quarantine),
+            ("circuit_open", self._check_circuit_open),
         )
         warnings: List[Dict[str, str]] = []
         for kind, probe in probes:
@@ -209,6 +294,9 @@ class HealthMonitor:
                 ("recompile_threshold", self.recompile_threshold),
                 ("clamp_risk", self.clamp_risk or None),
                 ("degraded_syncs", self.degraded_syncs or None),
+                ("queue_depth_threshold", self.queue_depth_threshold),
+                ("quarantine", self.quarantine or None),
+                ("circuit_open", self.circuit_open or None),
             )
             if v is not None
         }

@@ -24,10 +24,16 @@ primitives the library already proved:
   metrics' rigorous ``error_bound()`` envelopes, ``/ingest`` and
   ``/healthz``.
 * :mod:`~metrics_tpu.serve.loadgen` — the 1k-client / 3-level-tree load
-  generator behind the ``serve_*`` bench rows.
+  generator behind the ``serve_*`` bench rows (``fault_rate=`` runs it
+  under a seeded chaos schedule for the degraded-throughput row).
+* :mod:`~metrics_tpu.serve.resilience` — self-healing: per-client circuit
+  breakers and the poisoned-state quarantine firewall
+  (``Aggregator(resilience=...)``), plus the :class:`Supervisor` that
+  detects dead/hung nodes and workers via traffic-implied heartbeats and
+  rebuilds them from checkpoints with a resumed ship sequence.
 
-See ``docs/serving.md`` for the architecture and the exactly-once
-semantics.
+See ``docs/serving.md`` for the architecture, the exactly-once semantics
+and the self-healing guarantees.
 """
 from metrics_tpu.serve.aggregator import (
     Aggregator,
@@ -36,6 +42,14 @@ from metrics_tpu.serve.aggregator import (
     UnknownTenantError,
 )
 from metrics_tpu.serve.endpoints import MetricsServer
+from metrics_tpu.serve.resilience import (
+    CircuitOpenError,
+    ClientFirewall,
+    NodeDownError,
+    QuarantinedClientError,
+    ResilienceConfig,
+    Supervisor,
+)
 from metrics_tpu.serve.tree import AggregationTree, AggregatorNode
 from metrics_tpu.serve.wire import (
     MAX_WIRE_BYTES,
@@ -47,6 +61,7 @@ from metrics_tpu.serve.wire import (
     apply_payload,
     decode_state,
     encode_state,
+    peek_header,
     schema_fingerprint,
 )
 
@@ -55,11 +70,17 @@ __all__ = [
     "Aggregator",
     "AggregatorNode",
     "BackpressureError",
+    "CircuitOpenError",
+    "ClientFirewall",
     "MAX_WIRE_BYTES",
     "MetricPayload",
     "MetricsServer",
+    "NodeDownError",
+    "QuarantinedClientError",
+    "ResilienceConfig",
     "SchemaMismatchError",
     "ServeError",
+    "Supervisor",
     "UnknownTenantError",
     "WIRE_MAJOR",
     "WIRE_MINOR",
@@ -67,5 +88,6 @@ __all__ = [
     "apply_payload",
     "decode_state",
     "encode_state",
+    "peek_header",
     "schema_fingerprint",
 ]

@@ -57,7 +57,9 @@ from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.serve.wire import (
     MetricPayload,
     SchemaMismatchError,
+    WireFormatError,
     decode_state,
+    peek_header,
     schema_diff,
     schema_fingerprint,
     schema_of,
@@ -86,7 +88,13 @@ class UnknownTenantError(ServeError):
 
 
 class BackpressureError(ServeError):
-    """Ingest queue full and the caller asked not to block."""
+    """Ingest queue full and the caller asked not to block (or its wait
+    timed out). :attr:`retry_after_s` is the node's suggested backoff —
+    the ``Retry-After`` the HTTP surface answers with."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @functools.partial(jax.jit, static_argnames=("reds",))
@@ -117,14 +125,17 @@ def _tree_set(tree: Dict[str, Any], path: Tuple[str, ...], leaf: Any) -> None:
 
 class _ClientSlot:
     """Latest accepted snapshot of one client: journal watermark + the
-    spec-ordered state leaves (numpy, ready to stack)."""
+    spec-ordered state leaves (numpy, ready to stack). ``last_accept_s``
+    (monotonic) is the implicit heartbeat supervision reads — for a tree
+    node's ``node:*`` clients, its age IS the child's ship-sequence age."""
 
-    __slots__ = ("journal", "leaves", "consensus")
+    __slots__ = ("journal", "leaves", "consensus", "last_accept_s")
 
     def __init__(self) -> None:
         self.journal = BatchJournal()
         self.leaves: List[np.ndarray] = []
         self.consensus: List[np.ndarray] = []
+        self.last_accept_s = time.monotonic()
 
 
 class _Tenant:
@@ -327,6 +338,12 @@ class Aggregator:
         checkpoint_every: automatic :meth:`save` every N flushes
             (``None`` = manual saves only).
         flush_interval_s: background worker cadence for :meth:`start`.
+        resilience: a :class:`~metrics_tpu.serve.resilience.ResilienceConfig`
+            (or ``True`` for defaults) arming the per-client ingest
+            firewall — circuit breakers on validation failures, quarantine
+            of poisoned (NaN/Inf) state, and duplicate-watermark load
+            shedding under queue pressure. ``None`` (default) constructs
+            nothing and changes nothing.
 
     Example::
 
@@ -348,6 +365,7 @@ class Aggregator:
         keep_last: Optional[int] = 3,
         checkpoint_every: Optional[int] = None,
         flush_interval_s: float = 0.05,
+        resilience: Any = None,
     ) -> None:
         if checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1 (or None), got {checkpoint_every}")
@@ -361,11 +379,25 @@ class Aggregator:
         self._flush_interval_s = float(flush_interval_s)
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._last_flush_s: Optional[float] = None
+        self._firewall = None
+        if resilience is not None and resilience is not False:
+            # deferred import: resilience.py imports ServeError from here
+            from metrics_tpu.serve.resilience import ClientFirewall, ResilienceConfig
+
+            config = ResilienceConfig() if resilience is True else resilience
+            self._firewall = ClientFirewall(config, node=self.name)
         self._manager = None
         if checkpoint_dir is not None:
             from metrics_tpu.ft.manager import CheckpointManager
 
             self._manager = CheckpointManager(checkpoint_dir, keep_last=keep_last)
+
+    @property
+    def firewall(self):
+        """The armed :class:`~metrics_tpu.serve.resilience.ClientFirewall`,
+        or None when ``resilience=`` was not given."""
+        return self._firewall
 
     # ------------------------------------------------------------------
     # Tenant registry
@@ -424,39 +456,152 @@ class Aggregator:
         *,
         block: bool = True,
         timeout: Optional[float] = None,
-    ) -> None:
+    ) -> bool:
         """Validate and enqueue one payload (bytes or decoded).
 
         Validation is synchronous — an unknown tenant or schema mismatch
         raises here, where the producer can still see it; dedup happens at
         fold time against the client's journal watermark. The bounded
         queue provides backpressure: full + ``block=False`` raises
-        :class:`BackpressureError`.
+        :class:`BackpressureError`, and a ``block=True`` wait is watched
+        against a dead background flush worker (a queue nothing drains
+        must raise, not park the producer forever). With ``resilience=``
+        armed, quarantined/circuit-open clients are refused off the cheap
+        header peek before any body work, and under queue pressure
+        (above the config's ``shed_watermark``) duplicate-watermark
+        payloads are shed at the door — they would be dedup-dropped at
+        fold anyway. Returns True when enqueued, False when shed.
         """
         t0 = time.perf_counter()
+        firewall = self._firewall
+        identity: Optional[Tuple[str, str]] = None
         if isinstance(payload, (bytes, bytearray, memoryview)):
-            payload = decode_state(bytes(payload))
-        tenant = self._tenant(payload.tenant)
-        if payload.schema_hash != tenant.schema_hash:
-            diffs = schema_diff(tenant.schema, payload.schema)
-            raise SchemaMismatchError(
-                f"payload schema {payload.schema_hash} does not match tenant"
-                f" {payload.tenant!r} schema {tenant.schema_hash};"
-                f" differing: {'; '.join(diffs) or 'fingerprint only'}"
-            )
+            data = bytes(payload)
+            peeked = None
+            if firewall is not None:
+                try:
+                    peeked = peek_header(data)
+                    header = peeked[1]
+                    identity = (str(header.get("tenant")), str(header.get("client")))
+                except WireFormatError:
+                    identity = None  # unframed garbage: nothing to attribute
+                if identity is not None:
+                    firewall.admit(*identity)
+            try:
+                # _peeked: the firewall already parsed the header; decode
+                # must not pay that JSON parse a second time per payload
+                payload = decode_state(data, _peeked=peeked)
+            except WireFormatError:
+                # corrupt-in-flight (crc) or lying directory: an error strike
+                # against the named client — repeated strikes open its
+                # circuit. Gated on a REGISTERED tenant: strikes keyed off an
+                # unvalidated header must not let spoofed identities grow the
+                # firewall's tracking table.
+                if firewall is not None and identity is not None:
+                    if _obs_enabled():
+                        _obs_inc("serve.wire_errors", tenant=identity[0])
+                    if identity[0] in self._tenants:
+                        firewall.record_error(*identity)
+                raise
+        elif firewall is not None:
+            identity = (payload.tenant, payload.client_id)
+            firewall.admit(*identity)
         try:
-            self._queue.put((payload, t0), block=block, timeout=timeout)
-        except queue.Full:
-            if _obs_enabled():
-                _obs_inc("serve.rejected", tenant=payload.tenant)
-            raise BackpressureError(
-                f"aggregator {self.name!r} ingest queue is full"
-                f" (max_queue={self._queue.maxsize}); retry with backoff"
-                " (ft.RetryPolicy with decorrelated jitter) or raise max_queue."
-            ) from None
+            tenant = self._tenant(payload.tenant)
+            if payload.schema_hash != tenant.schema_hash:
+                if firewall is not None and identity is not None:
+                    firewall.record_error(*identity)
+                diffs = schema_diff(tenant.schema, payload.schema)
+                raise SchemaMismatchError(
+                    f"payload schema {payload.schema_hash} does not match tenant"
+                    f" {payload.tenant!r} schema {tenant.schema_hash};"
+                    f" differing: {'; '.join(diffs) or 'fingerprint only'}"
+                )
+            if firewall is not None and self._shed_duplicate(tenant, payload):
+                # the payload validated — a shed duplicate is a HEALTHY
+                # client (and must resolve a pending half-open probe)
+                firewall.record_ok(*identity)
+                return False
+            try:
+                self._put_payload(payload, t0, block=block, timeout=timeout)
+            except queue.Full:
+                if _obs_enabled():
+                    _obs_inc("serve.rejected", tenant=payload.tenant)
+                raise BackpressureError(
+                    f"aggregator {self.name!r} ingest queue is full"
+                    f" (max_queue={self._queue.maxsize}); retry with backoff"
+                    " (ft.RetryPolicy with decorrelated jitter) or raise max_queue.",
+                    retry_after_s=max(self._flush_interval_s * 2.0, 0.05),
+                ) from None
+        except SchemaMismatchError:
+            raise  # the strike above already resolved any half-open probe
+        except Exception:
+            # unknown tenant, backpressure, dead worker, ...: the payload
+            # was never JUDGED, so a half-open probe admitted above must be
+            # released — a probe whose outcome is never recorded would pin
+            # the circuit half-open (= refused) forever
+            if firewall is not None and identity is not None:
+                firewall.abandon_probe(*identity)
+            raise
         if _obs_enabled():
             _obs_inc("serve.ingests", tenant=payload.tenant)
-            _obs_gauge("serve.queue_depth", float(self._queue.qsize()))
+            # labeled per node: a tree hosts several aggregators in one
+            # process, and an unlabeled gauge would be last-writer-wins —
+            # an idle leaf masking a saturated root from HealthMonitor
+            _obs_gauge("serve.queue_depth", float(self._queue.qsize()), node=self.name)
+        return True
+
+    def _shed_duplicate(self, tenant: "_Tenant", payload: MetricPayload) -> bool:
+        """Load shedding: above the shed watermark, a payload whose
+        watermark does not advance its client is dropped at the door
+        (``serve.shed``) — fold-time dedup would discard it anyway, and
+        during an incident the queue slots are the scarce resource."""
+        watermark = self._firewall.config.shed_watermark
+        maxsize = self._queue.maxsize
+        # watermark 1.0 is the documented off switch — a full queue must
+        # NOT silently shed then, it falls through to normal backpressure
+        if watermark >= 1.0 or maxsize <= 0 or self._queue.qsize() < watermark * maxsize:
+            return False
+        epoch, step = int(payload.watermark[0]), int(payload.watermark[1])
+        with tenant.lock:
+            slot = tenant.clients.get(payload.client_id)
+            fresh = slot is None or slot.journal.should_fold(epoch, step)
+        if fresh:
+            return False
+        if _obs_enabled():
+            _obs_inc("serve.shed", tenant=payload.tenant, reason="duplicate_watermark")
+        return True
+
+    def _put_payload(
+        self, payload: MetricPayload, t0: float, *, block: bool, timeout: Optional[float]
+    ) -> None:
+        """Enqueue, never parking forever on a queue whose worker died."""
+        if not block or self._worker is None:
+            # manual-flush mode keeps the plain blocking contract: the
+            # caller owns draining and may be about to from another thread
+            self._queue.put((payload, t0), block=block, timeout=timeout)
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            worker = self._worker
+            if worker is not None and not worker.is_alive() and not self._stop.is_set():
+                raise ServeError(
+                    f"aggregator {self.name!r}: the background flush worker has DIED"
+                    " (not stopped) — ingest(block=True) would wait forever on a"
+                    " queue nothing drains. Restart it with start() (or let a"
+                    " serve.resilience.Supervisor heal it) and retry."
+                )
+            wait = 0.05
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise queue.Full
+                wait = min(wait, remaining)
+            try:
+                self._queue.put((payload, t0), timeout=wait)
+                return
+            except queue.Full:
+                continue
 
     def _accept(self, payload: MetricPayload, t0: float) -> bool:
         """Keep-latest dedup: returns True when the payload advanced its
@@ -476,16 +621,37 @@ class Aggregator:
                 if _obs_enabled():
                     kind = "duplicate" if slot.journal.watermark == (epoch, step) else "stale"
                     _obs_inc("serve.dedup_drops", tenant=payload.tenant, kind=kind)
+                if self._firewall is not None:
+                    # at-least-once redelivery is healthy behavior, not an
+                    # error strike — it must reset the breaker, not feed it
+                    self._firewall.record_ok(payload.tenant, payload.client_id)
                 return False
             # validate the body BEFORE touching the registry: a corrupted
             # payload (hash matched, leaf missing/misshapen) must not leave
             # an empty slot behind that every later fold would trip over
-            leaves, consensus = tenant.flatten_payload(payload)
+            try:
+                leaves, consensus = tenant.flatten_payload(payload)
+            except ServeError:
+                if self._firewall is not None:
+                    self._firewall.record_error(payload.tenant, payload.client_id)
+                raise
+            if self._firewall is not None:
+                from metrics_tpu.serve.resilience import check_poisoned
+
+                detail = check_poisoned(tenant.spec, leaves)
+                if detail is not None:
+                    # poisoned-state firewall: drop the snapshot and
+                    # quarantine the client INSTEAD of folding NaN into the
+                    # tenant view (which every healthy client then inherits)
+                    self._firewall.record_poison(payload.tenant, payload.client_id, detail)
+                    return False
+                self._firewall.record_ok(payload.tenant, payload.client_id)
             if slot is None:
                 slot = tenant.clients[payload.client_id] = _ClientSlot()
             slot.journal.record(epoch, step)
             slot.leaves = leaves
             slot.consensus = consensus
+            slot.last_accept_s = time.monotonic()
             tenant.dirty = True
         if _obs_enabled():
             _obs_observe("serve.ingest_ms", (time.perf_counter() - t0) * 1000.0, tenant=payload.tenant)
@@ -546,8 +712,9 @@ class Aggregator:
                     if _obs_enabled():
                         _obs_inc("serve.merges", float(k), tenant=tenant.tenant_id)
             self._flushes += 1
+            self._last_flush_s = time.monotonic()
             if _obs_enabled():
-                _obs_gauge("serve.queue_depth", float(self._queue.qsize()))
+                _obs_gauge("serve.queue_depth", float(self._queue.qsize()), node=self.name)
                 if folded_any:
                     _obs_observe("serve.flush_ms", (time.perf_counter() - t_fold) * 1000.0)
             want_save = (
@@ -599,6 +766,39 @@ class Aggregator:
             self._worker.join()
             self._worker = None
         self.flush()
+
+    # ------------------------------------------------------------------
+    # Liveness surface (read by /healthz and serve.resilience.Supervisor)
+    # ------------------------------------------------------------------
+
+    def worker_alive(self) -> Optional[bool]:
+        """None when no background worker is running by design (never
+        started, or cleanly stopped); otherwise the worker thread's
+        liveness — False means it DIED and the queue drains nothing."""
+        worker = self._worker
+        if worker is None:
+            return None
+        return worker.is_alive()
+
+    def last_flush_age_s(self) -> Optional[float]:
+        """Seconds since the last completed :meth:`flush`, or None before
+        the first — the freshness signal readiness probes gate on."""
+        last = self._last_flush_s
+        return None if last is None else max(0.0, time.monotonic() - last)
+
+    def client_ages(self) -> Dict[str, float]:
+        """Age (s) of each client's newest accepted snapshot, minimized
+        across tenants. For ``node:*`` clients this is the child node's
+        ship-sequence age — the parent-side heartbeat supervision reads."""
+        now = time.monotonic()
+        ages: Dict[str, float] = {}
+        for tenant in list(self._tenants.values()):
+            with tenant.lock:
+                for client_id, slot in tenant.clients.items():
+                    age = max(0.0, now - slot.last_accept_s)
+                    if client_id not in ages or age < ages[client_id]:
+                        ages[client_id] = age
+        return ages
 
     # ------------------------------------------------------------------
     # Read side

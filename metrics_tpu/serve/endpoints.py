@@ -20,7 +20,14 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   503 on queue backpressure. Tree nodes cross process boundaries by
   pointing :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
   this route — the bytes are identical to the in-process path.
-* ``GET /healthz`` — liveness JSON (tenant/client/queue counts).
+* ``GET /healthz`` — full health JSON (tenant/client/queue counts plus the
+  readiness detail). Kubernetes-style split probes:
+  ``GET /healthz/live`` — pure liveness (the process answers); and
+  ``GET /healthz/ready`` — readiness, 200/503 off queue saturation,
+  flush-worker liveness and last-flush age, reporting queue depth,
+  last-flush age and the firewall's open-circuit / quarantined clients. A
+  node that is alive but drowning answers live=200 / ready=503 — restart
+  nothing, route traffic elsewhere.
 
 The server arms the obs layer by default (``arm_obs=True``): a scrape
 endpoint over a disabled registry would export silence, which reads as
@@ -40,6 +47,7 @@ from metrics_tpu.serve.aggregator import (
     BackpressureError,
     UnknownTenantError,
 )
+from metrics_tpu.serve.resilience import CircuitOpenError, QuarantinedClientError
 from metrics_tpu.serve.wire import MAX_WIRE_BYTES, SchemaMismatchError, WireFormatError
 
 __all__ = ["MetricsServer"]
@@ -56,6 +64,13 @@ class MetricsServer:
         arm_obs: enable the obs registry so serve counters/histograms are
             actually recorded and exported (default True; pass False when
             the operator manages ``obs.enable`` globally).
+        ready_max_queue_frac: ``/healthz/ready`` flips to 503 when the
+            ingest queue is at or above this fill fraction.
+        ready_max_flush_age_s: ``/healthz/ready`` flips to 503 when the
+            last completed flush is older than this (None derives
+            ``max(1.0, 20 * flush_interval_s)`` for nodes with a
+            background worker — a worker that stopped folding is not
+            ready even while its thread is technically alive).
 
     Example::
 
@@ -72,8 +87,12 @@ class MetricsServer:
         port: int = 0,
         *,
         arm_obs: bool = True,
+        ready_max_queue_frac: float = 0.9,
+        ready_max_flush_age_s: Optional[float] = None,
     ) -> None:
         self.aggregator = aggregator
+        self.ready_max_queue_frac = float(ready_max_queue_frac)
+        self.ready_max_flush_age_s = ready_max_flush_age_s
         if arm_obs:
             from metrics_tpu import obs
 
@@ -144,11 +163,54 @@ class MetricsServer:
 
     def render_health(self) -> Dict[str, Any]:
         agg = self.aggregator
-        return {
+        health = {
             "node": agg.name,
             "tenants": len(agg.tenants()),
             "clients": {t: len(agg._tenant(t).clients) for t in agg.tenants()},
             "queue_depth": agg._queue.qsize(),
+        }
+        health.update(self.render_ready())
+        return health
+
+    def render_live(self) -> Dict[str, Any]:
+        """Pure liveness: if this executes, the process is up. Worker
+        liveness is REPORTED here but gates only readiness — restarting a
+        process to fix a dead thread the Supervisor can restart in place
+        would throw away every client snapshot for nothing."""
+        return {"live": True, "node": self.aggregator.name, "worker_alive": self.aggregator.worker_alive()}
+
+    def render_ready(self) -> Dict[str, Any]:
+        """Readiness verdict + the signals behind it (queue depth, last
+        flush age, worker liveness, circuit/quarantine states)."""
+        agg = self.aggregator
+        queue_depth = agg._queue.qsize()
+        max_queue = agg._queue.maxsize
+        flush_age = agg.last_flush_age_s()
+        worker = agg.worker_alive()
+        firewall = agg.firewall
+        status = firewall.status() if firewall is not None else {"open_circuits": [], "quarantined": []}
+        max_flush_age = self.ready_max_flush_age_s
+        if max_flush_age is None and worker is not None:
+            max_flush_age = max(1.0, 20.0 * agg._flush_interval_s)
+        reasons = []
+        if worker is False:
+            reasons.append("background flush worker died (Supervisor heal / start() restarts it)")
+        if max_queue > 0 and queue_depth >= self.ready_max_queue_frac * max_queue:
+            reasons.append(
+                f"ingest queue at {queue_depth}/{max_queue}"
+                f" (>= {self.ready_max_queue_frac:.0%} watermark)"
+            )
+        if worker is True and max_flush_age is not None and flush_age is not None and flush_age > max_flush_age:
+            reasons.append(f"last flush completed {flush_age:.1f}s ago (> {max_flush_age:.1f}s)")
+        return {
+            "ready": not reasons,
+            "reasons": reasons,
+            "queue_depth": queue_depth,
+            "max_queue": max_queue,
+            "worker_alive": worker,
+            "last_flush_age_s": flush_age,
+            "open_circuits": status["open_circuits"],
+            "quarantined": status["quarantined"],
         }
 
 
@@ -165,15 +227,25 @@ def _make_handler(server: MetricsServer):
         def log_message(self, fmt: str, *args: Any) -> None:  # noqa: A003
             pass
 
-        def _reply(self, status: int, body: bytes, content_type: str) -> None:
+        def _reply(
+            self,
+            status: int,
+            body: bytes,
+            content_type: str,
+            headers: Optional[Dict[str, str]] = None,
+        ) -> None:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
             self.end_headers()
             self.wfile.write(body)
 
-        def _reply_json(self, status: int, obj: Dict[str, Any]) -> None:
-            self._reply(status, (json.dumps(obj) + "\n").encode(), "application/json")
+        def _reply_json(
+            self, status: int, obj: Dict[str, Any], headers: Optional[Dict[str, str]] = None
+        ) -> None:
+            self._reply(status, (json.dumps(obj) + "\n").encode(), "application/json", headers)
 
         def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
             parsed = urlparse(self.path)
@@ -187,6 +259,11 @@ def _make_handler(server: MetricsServer):
                         self._reply_json(400, {"error": "missing ?tenant= parameter"})
                         return
                     self._reply_json(200, server.render_query(tenant))
+                elif parsed.path == "/healthz/live":
+                    self._reply_json(200, server.render_live())
+                elif parsed.path == "/healthz/ready":
+                    ready = server.render_ready()
+                    self._reply_json(200 if ready["ready"] else 503, ready)
                 elif parsed.path == "/healthz":
                     self._reply_json(200, server.render_health())
                 else:
@@ -226,14 +303,30 @@ def _make_handler(server: MetricsServer):
                     )
                     return
                 data = self.rfile.read(length)
-                server.aggregator.ingest(data, block=False)
-                self._reply_json(200, {"accepted": True})
+                accepted = server.aggregator.ingest(data, block=False)
+                # shed (False) still answers 200: the payload was a
+                # duplicate watermark — a retry would only re-shed it
+                self._reply_json(200, {"accepted": bool(accepted), "shed": not accepted})
             except UnknownTenantError as err:
                 self._reply_json(404, {"error": str(err)})
+            except QuarantinedClientError as err:
+                # 403, not 5xx: retrying cannot help a quarantined client
+                self._reply_json(403, {"error": str(err)})
             except (WireFormatError, SchemaMismatchError, ValueError) as err:
                 self._reply_json(400, {"error": str(err)})
+            except CircuitOpenError as err:
+                self._reply_json(
+                    503,
+                    {"error": str(err)},
+                    headers={"Retry-After": str(max(1, int(err.retry_after_s + 0.999)))},
+                )
             except BackpressureError as err:
-                self._reply_json(503, {"error": str(err)})
+                retry_after = err.retry_after_s or 1.0
+                self._reply_json(
+                    503,
+                    {"error": str(err)},
+                    headers={"Retry-After": str(max(1, int(retry_after + 0.999)))},
+                )
             except Exception as err:  # noqa: BLE001
                 self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
 

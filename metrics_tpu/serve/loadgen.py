@@ -21,6 +21,17 @@ tier. ``verify=True`` (tests/smoke) additionally pins the whole run
 against a flat single-aggregator merge of every client's final snapshot,
 bitwise on the merged state leaves — the tree invariant end to end.
 
+``fault_rate > 0`` runs the same stream under a **seeded chaos schedule**
+(:class:`metrics_tpu.ft.faults.WireChaos`: the rate split evenly across
+drop / duplicate / reorder / corrupt, tree nodes armed with the
+resilience firewall) — the ``serve_ingest_degraded_merges_per_s`` bench
+row, and the row the chaos smoke pins bitwise: with ``verify=True`` the
+oracle is a flat merge of **exactly the accepted snapshots** — per
+client, the highest-watermark payload that was delivered uncorrupted
+(corrupt payloads are refused by the wire crc32 and never accepted;
+dropped ones never arrive; duplicates and reorders are absorbed by
+keep-latest dedup).
+
 Bench rows ride ``bench.py --json`` with ``process_count`` attached and
 participate in the ``--compare`` gate as a **rate row** (higher is
 better; ``benchmarks/compare.py`` inverts the gate direction for ``/s``
@@ -49,6 +60,7 @@ def run_loadgen(
     seed: int = 0,
     verify: bool = False,
     tenant: str = "loadgen",
+    fault_rate: float = 0.0,
 ) -> Dict[str, Any]:
     """Drive the tree and return the ``serve_*`` row values.
 
@@ -56,43 +68,114 @@ def run_loadgen(
     ``serve_ingest_p99_ms`` and run accounting (clients, payload counts,
     tree shape, elapsed seconds). With ``verify=True`` the merged root
     state is additionally compared bitwise against a flat fold of every
-    client's final snapshot (raises on any mismatch).
+    client's final ACCEPTED snapshot (raises on any mismatch). With
+    ``fault_rate > 0`` delivery runs under a seeded
+    :class:`~metrics_tpu.ft.faults.WireChaos` schedule (rate split evenly
+    over drop/duplicate/reorder/corrupt) against resilience-armed nodes;
+    the refused/dropped accounting rides the returned dict.
     """
     import jax.numpy as jnp
 
     from metrics_tpu import obs
-    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.ft.faults import WireChaos
     from metrics_tpu.serve.aggregator import Aggregator
+    from metrics_tpu.serve.resilience import ResilienceConfig
     from metrics_tpu.serve.tree import AggregationTree
-    from metrics_tpu.serve.wire import encode_state
-    from metrics_tpu.streaming import StreamingAUROC
+    from metrics_tpu.serve.wire import WireFormatError, encode_state
 
-    def factory() -> MetricCollection:
+    if not 0.0 <= fault_rate <= 1.0:
+        raise ValueError(f"fault_rate must be in [0, 1], got {fault_rate}")
+
+    def factory():
+        from metrics_tpu.collections import MetricCollection
+        from metrics_tpu.streaming import StreamingAUROC
+
         return MetricCollection({"auroc": StreamingAUROC(num_bins=num_bins)})
 
     # pre-encode every ship round for every client (client-side cost,
     # outside the timed aggregation window)
     rng = np.random.default_rng(seed)
     rounds: list = [[] for _ in range(payloads_per_client)]
-    final_payloads = []
+    payloads_by_client: Dict[str, list] = {}
+    # blob -> (client_id, step, leaf index): identities are known at encode
+    # time, so the timed window never parses a header for bookkeeping —
+    # the degraded bench row must measure the serving tier, not the harness
+    identity: Dict[bytes, tuple] = {}
     for c in range(n_clients):
         client = factory()
         client_id = f"client-{c:05d}"
+        payloads_by_client[client_id] = []
         for r in range(payloads_per_client):
             batch = _client_stream(rng, samples_per_payload)
             client.update(jnp.asarray(batch["preds"]), jnp.asarray(batch["target"]))
             payload = encode_state(client, tenant=tenant, client_id=client_id, watermark=(0, r))
             rounds[r].append((c, payload))
-        final_payloads.append(payload)
+            payloads_by_client[client_id].append(payload)
+            identity[payload] = (client_id, r, c)
 
-    tree = AggregationTree(fan_out=fan_out, tenants={tenant: factory})
+    chaos = None if fault_rate <= 0 else WireChaos(
+        seed=seed + 1,
+        p_drop=fault_rate / 4,
+        p_duplicate=fault_rate / 4,
+        p_reorder=fault_rate / 4,
+        p_corrupt=fault_rate / 4,
+        p_delay=0.0,
+    )
+    # oracle bookkeeping (chaos only): the set of (client, step) payloads
+    # delivered UNCORRUPTED at least once — keep-latest makes the highest
+    # step per client the accepted snapshot. A successfully ingested blob
+    # is always an original (corruption is refused by the crc32), so its
+    # identity comes from the pre-encoded map — no header parse in the
+    # timed window, for the clean OR the degraded row.
+    delivered: set = set()
+    refused = 0
+    refused_circuit = 0
+
+    def deliver(blobs, c: int) -> None:
+        nonlocal refused, refused_circuit
+        from metrics_tpu.serve.resilience import CircuitOpenError
+
+        for blob in blobs:
+            try:
+                tree.leaf_for(c).ingest(blob)
+            except WireFormatError:
+                refused += 1  # corrupt-in-flight, refused by the crc32
+            except CircuitOpenError:
+                # a client unlucky enough to draw consecutive corruptions
+                # opened its circuit — its next CLEAN payload is refused
+                # too. A refusal is a non-delivery (consistent with the
+                # oracle), never a harness crash.
+                refused_circuit += 1
+            else:
+                client_id, step, _ = identity[blob]
+                delivered.add((client_id, step))
+
+    tree = AggregationTree(
+        fan_out=fan_out,
+        tenants={tenant: factory},
+        resilience=None if chaos is None else ResilienceConfig(),
+    )
     was_enabled = obs.enable()
     merges_before = obs.sum_counter("serve.merges")
     try:
         t0 = time.perf_counter()
         for round_payloads in rounds:
             for c, payload in round_payloads:
-                tree.leaf_for(c).ingest(payload)
+                if chaos is None:
+                    tree.leaf_for(c).ingest(payload)
+                else:
+                    _, now_blobs = chaos.plan(payload)
+                    deliver(now_blobs, c)
+            if chaos is not None:
+                # round boundary: reordered payloads land shuffled; held
+                # blobs are always originals, so routing comes off the
+                # identity map too
+                for blob in chaos.end_round():
+                    deliver([blob], identity[blob][2])
+            tree.pump()
+        if chaos is not None:
+            for blob in chaos.flush():
+                deliver([blob], identity[blob][2])
             tree.pump()
         elapsed = time.perf_counter() - t0
         merges = obs.sum_counter("serve.merges") - merges_before
@@ -110,12 +193,26 @@ def run_loadgen(
         "tree_levels": len(tuple(fan_out)) + 1,
         "elapsed_s": elapsed,
     }
+    if chaos is not None:
+        out["chaos_counts"] = dict(chaos.counts)
+        out["refused_corrupt"] = int(refused)
+        out["refused_circuit"] = int(refused_circuit)
 
     if verify:
+        # the oracle: per client, the highest-watermark snapshot that was
+        # delivered uncorrupted — EXACTLY the set keep-latest accepted.
+        # Fault-free, that is simply every client's final snapshot.
+        accepted: Dict[str, int] = {}
+        if chaos is None:
+            accepted = {cid: payloads_per_client - 1 for cid in payloads_by_client}
+        else:
+            for client_id, step in delivered:
+                if client_id not in accepted or step > accepted[client_id]:
+                    accepted[client_id] = step
         flat = Aggregator("flat-reference")
         flat.register_tenant(tenant, factory)
-        for payload in final_payloads:
-            flat.ingest(payload)
+        for client_id, step in sorted(accepted.items()):
+            flat.ingest(payloads_by_client[client_id][step])
         flat.flush()
         root_tenant = tree.root.aggregator._tenant(tenant)
         flat_tenant = flat._tenant(tenant)
@@ -146,6 +243,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--payloads-per-client", type=int, default=2)
     parser.add_argument("--num-bins", type=int, default=256)
     parser.add_argument("--verify", action="store_true")
+    parser.add_argument("--fault-rate", type=float, default=0.0)
     args = parser.parse_args(argv)
     result = run_loadgen(
         n_clients=args.clients,
@@ -153,6 +251,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         payloads_per_client=args.payloads_per_client,
         num_bins=args.num_bins,
         verify=args.verify,
+        fault_rate=args.fault_rate,
     )
     print(json.dumps(result, indent=2, sort_keys=True))
     return 0

@@ -1,0 +1,611 @@
+"""Self-healing for the serving fleet: isolate bad clients, revive dead nodes.
+
+The aggregation tier's failure modes split by blast radius, and this module
+gives each one a containment mechanism smaller than "the fleet degrades":
+
+* **one flaky client** (corrupt bytes, schema churn, hostile payloads) —
+  a per-client **circuit breaker** on ingest: after
+  :attr:`ResilienceConfig.error_threshold` consecutive validation failures
+  the circuit *opens* and further payloads are refused immediately
+  (:class:`CircuitOpenError`, HTTP 503 + ``Retry-After``) instead of paying
+  decode + validation per garbage payload. After a cooldown drawn from the
+  **seeded decorrelated-jitter** schedule of
+  :attr:`ResilienceConfig.probe_policy` (the
+  :func:`metrics_tpu.ft.retry.backoff_schedule` chain — a thousand refused
+  clients do not thunder back in lockstep), the circuit goes *half-open*:
+  exactly one probe payload is admitted; success closes the circuit,
+  failure re-opens it with the next backoff delay. Every open transition
+  counts ``serve.circuit_open{tenant=}``.
+* **one poisoned client** (NaN/Inf-bearing state that would fold into the
+  tenant view and stick — ``NaN + x = NaN`` survives every later merge of
+  OTHER clients) — the **poisoned-state firewall**: a cheap finite-leaf
+  check (:func:`check_poisoned`) runs before any snapshot reaches a slot,
+  and an offending client is **quarantined** — its snapshot dropped, its
+  future ingests refused (:class:`QuarantinedClientError`), one one-shot
+  warning, ``serve.quarantined{tenant=}`` counted — while the tenant keeps
+  folding every healthy client. The wire layer's per-leaf crc32
+  (:mod:`metrics_tpu.serve.wire`, minor 1) is the in-flight half of the
+  same firewall; this is the semantic half a *correctly transmitted* bad
+  state needs.
+* **a dead or hung node / worker** — :class:`Supervisor`: liveness over an
+  :class:`~metrics_tpu.serve.tree.AggregationTree` via the heartbeats the
+  traffic already implies (a parent tracks the **age of each child's last
+  accepted ship**; children probe parent reachability), plus direct
+  flush-worker liveness and last-flush age. :meth:`Supervisor.check`
+  classifies into one-shot-warned conditions counted under
+  ``health.checks{monitor=}`` / ``health.alerts{monitor=,kind=}`` (the
+  :class:`~metrics_tpu.obs.health.HealthMonitor` pattern);
+  :meth:`Supervisor.heal` restarts a dead flush worker in place and
+  rebuilds a dead node — restoring the root from its
+  :class:`~metrics_tpu.ft.CheckpointManager` checkpoint, re-registering
+  tenants, and resetting the node's ship sequence so
+  :meth:`~metrics_tpu.serve.tree.AggregatorNode._resume_seq` re-runs and
+  the healed subtree's ships are not dropped as stale by the parent.
+
+Everything here is **opt-in and off the hot path when off**: an
+:class:`~metrics_tpu.serve.Aggregator` without ``resilience=`` does not
+construct a firewall and pays nothing; the chaos harness
+(:mod:`metrics_tpu.ft.faults` + ``tests/integrations/chaos_smoke.py``)
+pins that with the firewall *on* and a seeded fault schedule, the root
+``/query`` stays bitwise-equal to a flat oracle merge of exactly the
+accepted snapshots. See ``docs/serving.md`` §"Self-healing".
+"""
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.ft.retry import RetryPolicy, backoff_schedule
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.aggregator import ServeError
+
+__all__ = [
+    "CircuitOpenError",
+    "ClientFirewall",
+    "NodeDownError",
+    "QuarantinedClientError",
+    "ResilienceConfig",
+    "Supervisor",
+    "check_poisoned",
+]
+
+
+class CircuitOpenError(ServeError):
+    """Client's ingest circuit is open; retry after :attr:`retry_after_s`."""
+
+    def __init__(self, message: str, retry_after_s: float) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class QuarantinedClientError(ServeError):
+    """Client is quarantined for shipping poisoned state; operator action
+    (``ClientFirewall.unquarantine``) required — time does not heal a bug."""
+
+
+class NodeDownError(ServeError):
+    """The aggregator behind this tree node is dead (killed, not stopped)."""
+
+
+@dataclass(frozen=True)
+class ResilienceConfig:
+    """Policy for :class:`ClientFirewall` (pass to ``Aggregator(resilience=)``).
+
+    Args:
+        error_threshold: consecutive validation failures (wire corruption,
+            schema mismatch, lying body) that open a client's circuit.
+        probe_policy: the cooldown schedule between open and half-open —
+            consumed through :func:`metrics_tpu.ft.retry.backoff_schedule`,
+            so ``jitter="decorrelated"`` + a seed gives every client a
+            distinct, reproducible probe schedule (no thundering probe
+            herd, pinnable in tests).
+        poison_strikes: poisoned snapshots (NaN/Inf leaves) before the
+            client is quarantined. Default 1: a single NaN is never a
+            transient — it is a client-side bug, and the firewall exists
+            so that bug cannot stale the tenant.
+        shed_watermark: ingest-queue fill fraction above which
+            duplicate-watermark payloads are shed at the door (they would
+            be dedup-dropped at fold anyway; under pressure the queue
+            slots are the scarce resource). ``1.0`` disables shedding.
+        max_tracked_clients: bound on the breaker/quarantine records one
+            firewall keeps. Strikes for identities taken off an
+            unvalidated wire header must not be a memory-exhaustion
+            vector (a sender spraying unique spoofed client ids would
+            otherwise grow the table one record per id); past the cap,
+            NEW identities' strikes are counted under
+            ``serve.firewall_untracked`` but not tracked — already-
+            tracked offenders (the repeat clients breakers exist for)
+            keep their records.
+    """
+
+    error_threshold: int = 3
+    probe_policy: RetryPolicy = field(
+        default_factory=lambda: RetryPolicy(
+            backoff_s=0.5, max_backoff_s=30.0, jitter="decorrelated", jitter_seed=0
+        )
+    )
+    poison_strikes: int = 1
+    shed_watermark: float = 0.75
+    max_tracked_clients: int = 10_000
+
+    def __post_init__(self) -> None:
+        if self.error_threshold < 1:
+            raise ValueError(f"error_threshold must be >= 1, got {self.error_threshold}")
+        if self.poison_strikes < 1:
+            raise ValueError(f"poison_strikes must be >= 1, got {self.poison_strikes}")
+        if not 0.0 < self.shed_watermark <= 1.0:
+            raise ValueError(
+                f"shed_watermark must be in (0, 1] (1.0 disables shedding), got {self.shed_watermark}"
+            )
+        if self.max_tracked_clients < 1:
+            raise ValueError(
+                f"max_tracked_clients must be >= 1, got {self.max_tracked_clients}"
+            )
+
+
+def check_poisoned(
+    spec: List[Tuple[Tuple[str, ...], str]], leaves: List[np.ndarray]
+) -> Optional[str]:
+    """Cheap pre-fold poison check; returns a detail string or None.
+
+    ``sum`` leaves must be fully finite (an Inf or NaN addend survives
+    every later merge); ``min``/``max`` leaves may legitimately be ±Inf
+    (their no-data identity) but never NaN (NaN wins/loses comparisons
+    unpredictably and never washes out). Integer and sketch-count leaves
+    cannot encode either. One vectorized pass over a ≤64KB payload —
+    orders cheaper than the fold it protects.
+    """
+    for (path, red), leaf in zip(spec, leaves):
+        if not np.issubdtype(leaf.dtype, np.floating):
+            continue
+        if red == "sum":
+            if not bool(np.all(np.isfinite(leaf))):
+                return f"sum-reduced leaf {'/'.join(path)} carries non-finite values"
+        elif bool(np.any(np.isnan(leaf))):
+            return f"{red}-reduced leaf {'/'.join(path)} carries NaN values"
+    return None
+
+
+class _Circuit:
+    """Per-(tenant, client) breaker record. States: closed → open →
+    half-open → closed (probe ok) or back to open (probe failed)."""
+
+    __slots__ = ("errors", "state", "open_until", "delays", "poison", "quarantined")
+
+    def __init__(self) -> None:
+        self.errors = 0
+        self.state = "closed"
+        self.open_until = 0.0
+        self.delays: Optional[Iterator[float]] = None
+        self.poison = 0
+        self.quarantined = False
+
+
+class ClientFirewall:
+    """Per-client circuit breakers + quarantine for one aggregator node.
+
+    Constructed by :class:`~metrics_tpu.serve.Aggregator` when
+    ``resilience=`` is given; all methods are thread-safe (ingest threads
+    and the background flush worker both consult it).
+
+    Args:
+        config: the :class:`ResilienceConfig` policy.
+        node: owning aggregator's name (warning/labels context).
+        clock: monotonic time source (injectable for tests).
+    """
+
+    def __init__(
+        self,
+        config: ResilienceConfig,
+        *,
+        node: str = "",
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.config = config
+        self._node = str(node)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._circuits: Dict[Tuple[str, str], _Circuit] = {}
+        self._warned: set = set()
+
+    # -- admission -------------------------------------------------------
+
+    def admit(self, tenant: str, client: str) -> None:
+        """Gate one ingest attempt; raises :class:`QuarantinedClientError`
+        or :class:`CircuitOpenError` when the client may not pass. An open
+        circuit whose cooldown has elapsed admits exactly ONE half-open
+        probe; concurrent attempts during the probe stay refused."""
+        key = (str(tenant), str(client))
+        with self._lock:
+            circuit = self._circuits.get(key)
+            if circuit is None:
+                return
+            if circuit.quarantined:
+                if _obs_enabled():
+                    _obs_inc("serve.quarantine_drops", tenant=key[0])
+                raise QuarantinedClientError(
+                    f"client {key[1]!r} of tenant {key[0]!r} is quarantined on"
+                    f" aggregator {self._node!r} for shipping poisoned state;"
+                    " fix the client and unquarantine() it — retrying will not help."
+                )
+            if circuit.state == "open":
+                now = self._clock()
+                if now >= circuit.open_until:
+                    circuit.state = "half_open"  # this caller is the probe
+                    return
+                self._refuse_open(key, circuit.open_until - now)
+            elif circuit.state == "half_open":
+                # a probe is already in flight; its outcome decides
+                self._refuse_open(key, self.config.probe_policy.backoff_s)
+
+    def _refuse_open(self, key: Tuple[str, str], retry_after: float) -> None:
+        if _obs_enabled():
+            _obs_inc("serve.circuit_drops", tenant=key[0])
+        raise CircuitOpenError(
+            f"ingest circuit for client {key[1]!r} of tenant {key[0]!r} is open on"
+            f" aggregator {self._node!r} after repeated invalid payloads;"
+            f" retry in {retry_after:.2f}s",
+            retry_after_s=retry_after,
+        )
+
+    # -- outcomes --------------------------------------------------------
+
+    def record_ok(self, tenant: str, client: str) -> None:
+        """A payload validated clean (accepted or dedup-dropped): reset the
+        error streak; a half-open probe success closes the circuit."""
+        key = (str(tenant), str(client))
+        with self._lock:
+            circuit = self._circuits.get(key)
+            if circuit is None or circuit.quarantined:
+                return
+            circuit.errors = 0
+            if circuit.state != "closed":
+                circuit.state = "closed"
+                circuit.delays = None  # a fresh incident gets a fresh schedule
+                self._gauge_open_locked()
+
+    def abandon_probe(self, tenant: str, client: str) -> None:
+        """A half-open probe whose outcome will never be known (the
+        payload was shed unjudged, hit queue backpressure, or died on an
+        unrelated error). The circuit returns to ``open`` with its
+        original expiry — already in the past — so the NEXT attempt
+        becomes the probe; without this the circuit would sit in
+        ``half_open`` forever, refusing a client nobody ever judged."""
+        with self._lock:
+            circuit = self._circuits.get((str(tenant), str(client)))
+            if circuit is not None and circuit.state == "half_open":
+                circuit.state = "open"
+
+    def _tracked(self, key: Tuple[str, str]) -> Optional[_Circuit]:
+        """Existing record, or a new one if under the tracking cap (must
+        be called with the lock held). Past the cap, None: the strike is
+        counted but a spoofed-identity flood cannot grow the table."""
+        circuit = self._circuits.get(key)
+        if circuit is None:
+            if len(self._circuits) >= self.config.max_tracked_clients:
+                if _obs_enabled():
+                    _obs_inc("serve.firewall_untracked", tenant=key[0])
+                return None
+            circuit = self._circuits[key] = _Circuit()
+        return circuit
+
+    def record_error(self, tenant: str, client: str) -> None:
+        """A validation failure attributed to this client. Opens the
+        circuit at ``error_threshold`` consecutive failures (or instantly
+        re-opens a failed half-open probe) with the next seeded-jitter
+        cooldown."""
+        key = (str(tenant), str(client))
+        with self._lock:
+            circuit = self._tracked(key)
+            if circuit is None:
+                return
+            if circuit.quarantined:
+                return
+            circuit.errors += 1
+            failed_probe = circuit.state == "half_open"
+            if failed_probe or (
+                circuit.state == "closed" and circuit.errors >= self.config.error_threshold
+            ):
+                delay = self._open_locked(key, circuit)
+                errors = circuit.errors
+                first = ("circuit", key) not in self._warned
+                self._warned.add(("circuit", key))
+            else:
+                return
+        if first:
+            import warnings
+
+            warnings.warn(
+                f"aggregator {self._node!r} opened the ingest circuit for client"
+                f" {key[1]!r} of tenant {key[0]!r} after {errors} consecutive"
+                f" invalid payload(s); refusing for {delay:.2f}s, then admitting"
+                " one half-open probe. Re-opens of this circuit are counted under"
+                " serve.circuit_open without warning again.",
+                stacklevel=3,
+            )
+
+    def _open_locked(self, key: Tuple[str, str], circuit: _Circuit) -> float:
+        """Transition ``circuit`` to open with the next seeded-jitter
+        cooldown (lock held); returns the cooldown drawn."""
+        if circuit.delays is None:
+            # the op label folds the client identity into the seed, so
+            # every client's probe schedule is distinct AND reproducible
+            circuit.delays = backoff_schedule(
+                self.config.probe_policy, op=f"{self._node}:{key[0]}:{key[1]}"
+            )
+        delay = next(circuit.delays)
+        circuit.state = "open"
+        circuit.open_until = self._clock() + delay
+        if _obs_enabled():
+            _obs_inc("serve.circuit_open", tenant=key[0])
+            self._gauge_open_locked()
+        return delay
+
+    def record_poison(self, tenant: str, client: str, detail: str) -> bool:
+        """A structurally-valid snapshot carried poisoned (NaN/Inf) state.
+        Returns True when this strike quarantined the client."""
+        key = (str(tenant), str(client))
+        with self._lock:
+            circuit = self._tracked(key)
+            if circuit is None:
+                return False
+            circuit.poison += 1
+            if _obs_enabled():
+                _obs_inc("serve.poisoned", tenant=key[0])
+            if circuit.quarantined or circuit.poison < self.config.poison_strikes:
+                if not circuit.quarantined and circuit.state == "half_open":
+                    # the probe WAS judged and it failed (poisoned, just below
+                    # the quarantine threshold): re-open like any failed probe,
+                    # else the circuit would sit half_open refusing forever
+                    self._open_locked(key, circuit)
+                return circuit.quarantined
+            circuit.quarantined = True
+            first = ("quarantine", key) not in self._warned
+            self._warned.add(("quarantine", key))
+            if _obs_enabled():
+                _obs_inc("serve.quarantined", tenant=key[0])
+                self._gauge_open_locked()
+        if first:
+            import warnings
+
+            warnings.warn(
+                f"aggregator {self._node!r} QUARANTINED client {key[1]!r} of tenant"
+                f" {key[0]!r}: {detail}. The snapshot was dropped (the tenant keeps"
+                " folding its healthy clients), further ingests from this client are"
+                " refused, and serve.quarantined counts the event. Quarantine does"
+                " not expire — fix the client and call unquarantine().",
+                stacklevel=3,
+            )
+        return True
+
+    # -- operator surface ------------------------------------------------
+
+    def is_quarantined(self, tenant: str, client: str) -> bool:
+        circuit = self._circuits.get((str(tenant), str(client)))
+        return circuit is not None and circuit.quarantined
+
+    def unquarantine(self, tenant: str, client: str) -> bool:
+        """Operator override: lift a quarantine (returns True if one was
+        lifted). The error/poison counters restart from zero."""
+        key = (str(tenant), str(client))
+        with self._lock:
+            circuit = self._circuits.get(key)
+            if circuit is None or not circuit.quarantined:
+                return False
+            self._circuits[key] = _Circuit()
+            self._warned.discard(("quarantine", key))
+            self._gauge_open_locked()
+        return True
+
+    def status(self) -> Dict[str, List[str]]:
+        """Snapshot for ``/healthz``: open circuits and quarantined clients
+        as ``"tenant/client"`` strings."""
+        with self._lock:
+            return {
+                "open_circuits": sorted(
+                    f"{t}/{c}"
+                    for (t, c), circuit in self._circuits.items()
+                    if circuit.state != "closed" and not circuit.quarantined
+                ),
+                "quarantined": sorted(
+                    f"{t}/{c}" for (t, c), circuit in self._circuits.items() if circuit.quarantined
+                ),
+            }
+
+    def _gauge_open_locked(self) -> None:
+        # labeled per node: several aggregators in one process (a tree)
+        # must not clobber each other's current-state gauges — health
+        # conditions aggregate across the series
+        if _obs_enabled():
+            _obs_gauge(
+                "serve.circuits_open",
+                float(sum(1 for c in self._circuits.values() if c.state != "closed" and not c.quarantined)),
+                node=self._node,
+            )
+            _obs_gauge(
+                "serve.clients_quarantined",
+                float(sum(1 for c in self._circuits.values() if c.quarantined)),
+                node=self._node,
+            )
+
+
+class Supervisor:
+    """Liveness + supervision over an :class:`~metrics_tpu.serve.tree.AggregationTree`.
+
+    Heartbeats are derived from the traffic itself — no extra RPCs: every
+    accepted payload stamps its client slot, so a parent's view of a child
+    node is "age of the last accepted ``node:<child>`` ship", and a child's
+    view of its parent is :meth:`~metrics_tpu.serve.tree.AggregatorNode.parent_reachable`.
+    Call :meth:`check` on the operator's cadence and :meth:`heal` when it
+    reports findings (or unconditionally — healing a healthy tree is a
+    no-op).
+
+    Conditions:
+
+    * ``dead_node`` — the node was hard-killed (its in-memory aggregator is
+      gone; in production: the process died).
+    * ``dead_worker`` — the node's background flush worker thread died
+      (the silent-freeze failure: the queue fills, ``/metrics`` goes stale,
+      nothing raises).
+    * ``hung_flush`` — the worker is alive but no flush has completed
+      within ``flush_hang_s`` (a wedged fold / device hang).
+    * ``stale_child`` — a child node's last accepted ship is older than
+      ``heartbeat_timeout_s`` (dead child, or a network partition — the
+      signal is the same and so is the repair: the child's next cumulative
+      ship).
+    * ``parent_unreachable`` — the child-side probe of the uplink failed.
+
+    :meth:`heal` repairs what it can locally: a dead worker is restarted in
+    place (state is intact — the thread died, not the process); a dead node
+    is rebuilt through :meth:`AggregationTree.revive` — fresh aggregator,
+    tenants re-registered, the root restored from its latest checkpoint,
+    and the node's ship sequence reset so ``_resume_seq`` re-derives it
+    above the parent's recorded watermark (a healed subtree that restarted
+    its sequence at 0 would have every ship dropped as stale — a silently
+    frozen subtree, the exact failure supervision exists to end).
+    ``stale_child``/``parent_unreachable`` have no local repair: they heal
+    when the named peer is healed (possibly by another Supervisor).
+    """
+
+    _KINDS = ("dead_node", "dead_worker", "hung_flush", "stale_child", "parent_unreachable")
+
+    def __init__(
+        self,
+        tree: Any,
+        *,
+        heartbeat_timeout_s: float = 5.0,
+        flush_hang_s: Optional[float] = None,
+        name: str = "supervisor",
+        warn: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if heartbeat_timeout_s <= 0:
+            raise ValueError(f"heartbeat_timeout_s must be positive, got {heartbeat_timeout_s}")
+        if flush_hang_s is not None and flush_hang_s <= 0:
+            raise ValueError(f"flush_hang_s must be positive (or None), got {flush_hang_s}")
+        self.tree = tree
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.flush_hang_s = flush_hang_s
+        self.name = str(name)
+        self.warn = bool(warn)
+        self._clock = clock
+        self._warned_kinds: set = set()
+
+    # ------------------------------------------------------------------
+
+    def check(self) -> Dict[str, Any]:
+        """Classify the tree's current state; returns
+        ``{"healthy": bool, "findings": [{"kind", "node", "detail"}, ...]}``
+        and counts ``health.checks{monitor=}`` /
+        ``health.alerts{monitor=,kind=}`` (one-shot warn per kind)."""
+        findings: List[Dict[str, str]] = []
+        for node in self.tree.nodes:
+            if node.is_dead:
+                findings.append(
+                    {
+                        "kind": "dead_node",
+                        "node": node.name,
+                        "detail": f"node {node.name!r} is down (in-memory state lost); heal() rebuilds it",
+                    }
+                )
+                continue
+            agg = node.aggregator
+            alive = agg.worker_alive()
+            if alive is False:
+                findings.append(
+                    {
+                        "kind": "dead_worker",
+                        "node": node.name,
+                        "detail": (
+                            f"background flush worker of {node.name!r} died — the queue"
+                            " fills and nothing folds; heal() restarts it in place"
+                        ),
+                    }
+                )
+            elif alive and self.flush_hang_s is not None:
+                age = agg.last_flush_age_s()
+                if age is not None and age > self.flush_hang_s:
+                    findings.append(
+                        {
+                            "kind": "hung_flush",
+                            "node": node.name,
+                            "detail": (
+                                f"{node.name!r}: worker alive but last completed flush was"
+                                f" {age:.1f}s ago (> {self.flush_hang_s:.1f}s) — a wedged fold?"
+                            ),
+                        }
+                    )
+            for child_id, age in agg.client_ages().items():
+                if child_id.startswith("node:") and age > self.heartbeat_timeout_s:
+                    findings.append(
+                        {
+                            "kind": "stale_child",
+                            "node": node.name,
+                            "detail": (
+                                f"{node.name!r} last accepted a ship from {child_id!r}"
+                                f" {age:.1f}s ago (> {self.heartbeat_timeout_s:.1f}s):"
+                                " the child is dead or partitioned; its next cumulative"
+                                " ship repairs the view either way"
+                            ),
+                        }
+                    )
+            if node.parent is not None and not node.parent_reachable():
+                findings.append(
+                    {
+                        "kind": "parent_unreachable",
+                        "node": node.name,
+                        "detail": f"{node.name!r} cannot reach its parent; ships are being dropped",
+                    }
+                )
+        if _obs_enabled():
+            _obs_inc("health.checks", monitor=self.name)
+            for finding in findings:
+                _obs_inc("health.alerts", monitor=self.name, kind=finding["kind"])
+        if self.warn:
+            for finding in findings:
+                if finding["kind"] in self._warned_kinds:
+                    continue
+                self._warned_kinds.add(finding["kind"])
+                import warnings
+
+                warnings.warn(
+                    f"Supervisor {self.name!r} [{finding['kind']}]: {finding['detail']}."
+                    " Further findings of this kind are counted under health.alerts"
+                    f"{{monitor={self.name}}} without warning again.",
+                    stacklevel=2,
+                )
+        return {"healthy": not findings, "findings": findings}
+
+    def heal(self) -> List[Dict[str, Any]]:
+        """Repair every locally-repairable finding; returns the actions
+        taken (``restart_worker`` / ``rebuild_node`` entries). Idempotent:
+        a healthy tree yields no actions."""
+        actions: List[Dict[str, Any]] = []
+        for node in self.tree.nodes:
+            if node.is_dead:
+                manifest = self.tree.revive(node)
+                if _obs_enabled():
+                    _obs_inc("serve.heals", kind="rebuild_node")
+                actions.append(
+                    {"action": "rebuild_node", "node": node.name, "restored": manifest is not None}
+                )
+            elif node.aggregator.worker_alive() is False:
+                node.aggregator.start()
+                if _obs_enabled():
+                    _obs_inc("serve.heals", kind="restart_worker")
+                actions.append({"action": "restart_worker", "node": node.name})
+        return actions
+
+    def reset_warnings(self) -> None:
+        """Re-arm the one-shot warning per condition kind."""
+        self._warned_kinds.clear()
+
+    def __repr__(self) -> str:
+        return (
+            f"Supervisor(name={self.name!r}, heartbeat_timeout_s={self.heartbeat_timeout_s},"
+            f" flush_hang_s={self.flush_hang_s})"
+        )

@@ -24,12 +24,49 @@ same :class:`AggregatorNode.forward` loop against a parent's ``/ingest``
 endpoint instead of an in-memory parent.
 """
 import itertools
+import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
-from metrics_tpu.serve.aggregator import Aggregator
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.serve.aggregator import Aggregator, BackpressureError
+from metrics_tpu.serve.resilience import (
+    CircuitOpenError,
+    NodeDownError,
+    QuarantinedClientError,
+)
 from metrics_tpu.serve.wire import encode_state
 
 __all__ = ["AggregationTree", "AggregatorNode"]
+
+# send/flush failures forward() survives: the transport (or the peer) is
+# down or refusing — transient by contract, repaired by the next interval's
+# cumulative ship. Anything else (a bug in OUR encode/fold) still raises.
+_TRANSPORT_ERRORS = (
+    NodeDownError,
+    BackpressureError,
+    CircuitOpenError,
+    QuarantinedClientError,
+    ConnectionError,
+    OSError,
+)
+
+
+class _DeadAggregator:
+    """Tombstone behind a hard-killed node: every operation raises
+    :class:`~metrics_tpu.serve.resilience.NodeDownError`, exactly like the
+    RPCs against a SIGKILLed process would fail — until a Supervisor heal
+    swaps a rebuilt :class:`Aggregator` back in."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __getattr__(self, item: str) -> Any:
+        raise NodeDownError(
+            f"aggregator node {self.name!r} is down (hard-killed); a Supervisor"
+            " heal() (AggregationTree.revive) must rebuild it before use"
+        )
 
 
 class AggregatorNode:
@@ -42,6 +79,10 @@ class AggregatorNode:
             encoded payload bytes (default: in-process
             ``parent.aggregator.ingest``). Point it at an HTTP client to
             cross process boundaries; the payload bytes are identical.
+        probe: override the parent-reachability probe (zero-arg callable
+            returning bool) — across an HTTP boundary, a cheap
+            ``GET /healthz/live``. Default: the in-process parent is
+            reachable unless hard-killed.
     """
 
     def __init__(
@@ -49,15 +90,68 @@ class AggregatorNode:
         aggregator: Aggregator,
         parent: Optional["AggregatorNode"] = None,
         send: Optional[Callable[[bytes], None]] = None,
+        probe: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.aggregator = aggregator
         self.parent = parent
         self._send = send
+        self._probe = probe
         self._ship_seq: Optional["itertools.count"] = None
+        self._killed_with_worker = False
 
     @property
     def name(self) -> str:
         return self.aggregator.name
+
+    # -- liveness --------------------------------------------------------
+
+    @property
+    def is_dead(self) -> bool:
+        """True after :meth:`hard_kill` and before :meth:`revive`."""
+        return isinstance(self.aggregator, _DeadAggregator)
+
+    def hard_kill(self) -> None:
+        """Simulate a SIGKILL of this node's process: the in-memory
+        aggregator (client snapshots, queue, tenant views) vanishes with
+        no cleanup — only on-disk checkpoints survive. The chaos harness's
+        in-process analogue of the real-signal arm in
+        ``tests/integrations/serve_smoke.py``; children's ships now fail
+        with ``NodeDownError`` until a Supervisor heal rebuilds the node.
+        """
+        agg = self.aggregator
+        if isinstance(agg, _DeadAggregator):
+            return
+        # remember whether the node ran a background flush worker, so a
+        # heal rebuilds the node in the SAME drain mode it died in — a
+        # revived aggregator nobody start()s would silently re-freeze
+        self._killed_with_worker = agg.worker_alive() is True
+        # the orphaned worker thread must not keep folding a zombie — a
+        # real SIGKILL takes every thread with the process
+        agg._stop.set()
+        self.aggregator = _DeadAggregator(agg.name)
+
+    def revive(self, aggregator: Aggregator) -> None:
+        """Swap a rebuilt aggregator in and RESET the ship sequence so the
+        next :meth:`forward` re-runs :meth:`_resume_seq` — without this the
+        healed node ships below the parent's recorded watermark and the
+        whole subtree is dropped as stale forever. A node that was running
+        a background flush worker when killed gets one started on the
+        rebuilt aggregator — without it nothing would drain the healed
+        node's queue and the silent freeze would be reintroduced by the
+        repair itself."""
+        self.aggregator = aggregator
+        self._ship_seq = None
+        if self._killed_with_worker and aggregator.worker_alive() is None:
+            aggregator.start()
+        self._killed_with_worker = False
+
+    def parent_reachable(self) -> bool:
+        """Child-side uplink heartbeat; True at the root."""
+        if self._probe is not None:
+            return bool(self._probe())
+        if self.parent is None:
+            return True
+        return not self.parent.is_dead
 
     def _resume_seq(self) -> int:
         """First ship sequence number: one past whatever the parent last
@@ -91,10 +185,22 @@ class AggregatorNode:
         forward supersedes the previous at the parent (keep-latest), so a
         lost or duplicated ship is repaired by the next interval. Returns
         the number of payloads shipped (0 at the root).
+
+        Transport failures (dead/partitioned parent, backpressure, an open
+        circuit upstream, socket errors — and this node itself being
+        hard-killed) are SURVIVED, not raised: the drop is counted under
+        ``serve.forward_errors{node=}`` with a one-shot warning, and the
+        next interval's cumulative snapshot repairs the parent's view —
+        raising here would let one dead hop abort the whole pump loop,
+        turning a one-node failure into a fleet-wide one.
         """
         if self.parent is None and self._send is None:
             return 0
-        self.aggregator.flush()
+        try:
+            self.aggregator.flush()
+        except NodeDownError:
+            self._note_forward_error("flush")
+            return 0
         if self._ship_seq is None:
             self._ship_seq = itertools.count(self._resume_seq())
         seq = next(self._ship_seq)
@@ -112,12 +218,29 @@ class AggregatorNode:
                     watermark=(0, seq),
                     meta={"node": self.name, "clients": len(self.aggregator._tenant(tenant_id).clients)},
                 )
-            if self._send is not None:
-                self._send(payload)
-            else:
-                self.parent.aggregator.ingest(payload)
+            try:
+                if self._send is not None:
+                    self._send(payload)
+                else:
+                    self.parent.aggregator.ingest(payload)
+            except _TRANSPORT_ERRORS as err:
+                self._note_forward_error(f"send:{type(err).__name__}")
+                continue
             shipped += 1
         return shipped
+
+    def _note_forward_error(self, reason: str) -> None:
+        if _obs_enabled():
+            _obs_inc("serve.forward_errors", node=self.name)
+        if not getattr(self, "_warned_forward", False):
+            self._warned_forward = True
+            warnings.warn(
+                f"tree node {self.name!r} could not ship upward ({reason}); the"
+                " next interval's cumulative snapshot repairs the parent's view."
+                " Further drops are counted under serve.forward_errors without"
+                " warning again.",
+                stacklevel=3,
+            )
 
 
 class AggregationTree:
@@ -153,17 +276,26 @@ class AggregationTree:
         *,
         checkpoint_root: Optional[str] = None,
         max_queue: int = 65536,
+        resilience: Any = None,
     ) -> None:
         if any(int(n) < 1 for n in fan_out):
             raise ValueError(f"fan_out entries must be >= 1, got {tuple(fan_out)}")
-        root_agg = Aggregator("root", checkpoint_dir=checkpoint_root, max_queue=max_queue)
+        # retained so a Supervisor heal (revive) can rebuild a dead node
+        # with the same registration and policy the original carried
+        self.tenant_factories = dict(tenants)
+        self._checkpoint_root = checkpoint_root
+        self._max_queue = int(max_queue)
+        self._resilience = resilience
+        root_agg = Aggregator(
+            "root", checkpoint_dir=checkpoint_root, max_queue=max_queue, resilience=resilience
+        )
         self.root = AggregatorNode(root_agg)
         self.levels: List[List[AggregatorNode]] = [[self.root]]
         for depth, width in enumerate(fan_out):
             parents = self.levels[-1]
             level = []
             for i in range(int(width)):
-                agg = Aggregator(f"L{depth + 1}.{i}", max_queue=max_queue)
+                agg = Aggregator(f"L{depth + 1}.{i}", max_queue=max_queue, resilience=resilience)
                 level.append(AggregatorNode(agg, parent=parents[i % len(parents)]))
             self.levels.append(level)
         for tenant_id, factory in tenants.items():
@@ -191,7 +323,12 @@ class AggregationTree:
             for level in reversed(self.levels[1:]):
                 for node in level:
                     shipped += node.forward()
-            self.root.aggregator.flush()
+            try:
+                self.root.aggregator.flush()
+            except NodeDownError:
+                # a dead root must not abort the pump: the rest of the tree
+                # keeps folding, and the heal's restore + re-ships catch up
+                continue
         return shipped
 
     def save(self) -> str:
@@ -207,3 +344,27 @@ class AggregationTree:
         subtree is never dropped as stale. Call BEFORE the first
         :meth:`pump`."""
         return self.root.aggregator.restore(path)
+
+    def revive(self, node: AggregatorNode):
+        """Rebuild a hard-killed node in place (the Supervisor heal path):
+        a fresh :class:`Aggregator` with the tree's retained tenant
+        factories / queue bound / resilience policy, restored from its
+        latest checkpoint when it has one (the root), and the node's ship
+        sequence reset so ``_resume_seq`` re-derives it above the parent's
+        watermark. Interior nodes come back EMPTY by design — their state
+        is reconstructed by their children's next cumulative ships.
+        Returns the restore manifest (None when nothing was restored)."""
+        is_root = node is self.root
+        agg = Aggregator(
+            node.name,
+            checkpoint_dir=self._checkpoint_root if is_root else None,
+            max_queue=self._max_queue,
+            resilience=self._resilience,
+        )
+        for tenant_id, factory in self.tenant_factories.items():
+            agg.register_tenant(tenant_id, factory)
+        manifest = None
+        if is_root and self._checkpoint_root is not None:
+            manifest = agg.restore()
+        node.revive(agg)
+        return manifest

@@ -39,6 +39,7 @@ watermark comparison (see ``docs/serving.md``).
 import hashlib
 import json
 import struct
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -54,6 +55,7 @@ __all__ = [
     "apply_payload",
     "decode_state",
     "encode_state",
+    "peek_header",
     "schema_diff",
     "schema_fingerprint",
     "schema_of",
@@ -61,7 +63,12 @@ __all__ = [
 
 WIRE_MAGIC = b"MTSV"
 WIRE_MAJOR = 1
-WIRE_MINOR = 0
+# minor 1: every leaf-directory entry carries a crc32 of its raw bytes
+# (integrity firewall — a bit-flipped body is refused at decode instead of
+# silently folding garbage into tenant state). Minor-0 decoders ignore the
+# unknown entry key; minor-0 payloads (no crc32) still decode here — the
+# forward/backward asymmetry the versioning contract promises.
+WIRE_MINOR = 1
 # bounded-size payloads are the design contract (sketches are <=64KB by
 # construction); the default cap leaves headroom for multi-member
 # collections while still refusing an unbounded cat state that would turn
@@ -263,6 +270,9 @@ def encode_state(
                     "shape": list(np.asarray(leaf).shape),
                     "offset": offset,
                     "nbytes": len(raw),
+                    # minor-1 integrity firewall: a bit flip anywhere in this
+                    # leaf's extent is refused at decode instead of folded
+                    "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
                 }
             )
             buffers.append(raw)
@@ -291,17 +301,16 @@ def encode_state(
     return payload
 
 
-def decode_state(data: bytes, *, max_bytes: Optional[int] = MAX_WIRE_BYTES) -> MetricPayload:
-    """Parse payload bytes back into a :class:`MetricPayload`.
+def peek_header(data: bytes, *, max_bytes: Optional[int] = MAX_WIRE_BYTES) -> Tuple[Tuple[int, int], Dict[str, Any]]:
+    """Parse only the preamble + header JSON of a payload — no body work.
 
-    Raises :class:`WireFormatError` on truncation, bad magic, an
-    incompatible **major** version or an oversized payload — the bounded
-    contract is enforced on BOTH ends (a hostile sender does not run our
-    ``encode_state``, so the decode side must refuse too; ``max_bytes=None``
-    disables for trusted offline tooling). A newer **minor** version
-    decodes: unknown header keys are ignored and unknown ``meta`` keys
-    preserved — that asymmetry (minor adds, major breaks) is the whole
-    versioning contract, pinned by ``tests/serve/test_wire.py``.
+    Returns ``((major, minor), header_dict)``. This is the cheap
+    identity/routing read the ingest firewall needs: a quarantined client's
+    payload is refused off the header alone, and a payload whose BODY fails
+    its crc can still be attributed to the tenant/client the header names.
+    Raises :class:`WireFormatError` exactly where :func:`decode_state`
+    would (size cap, truncation, magic, major, header JSON) — the header
+    contract is shared; only the leaf work is skipped.
     """
     if max_bytes is not None and len(data) > max_bytes:
         raise WireFormatError(
@@ -326,6 +335,34 @@ def decode_state(data: bytes, *, max_bytes: Optional[int] = MAX_WIRE_BYTES) -> M
         header = json.loads(data[_PREAMBLE.size : body_start].decode())
     except (UnicodeDecodeError, ValueError) as err:
         raise WireFormatError(f"payload header is not valid JSON: {err}") from err
+    if not isinstance(header, dict):
+        raise WireFormatError(f"payload header must be a JSON object, got {type(header).__name__}")
+    return (int(major), int(minor)), header
+
+
+def decode_state(
+    data: bytes,
+    *,
+    max_bytes: Optional[int] = MAX_WIRE_BYTES,
+    _peeked: Optional[Tuple[Tuple[int, int], Dict[str, Any]]] = None,
+) -> MetricPayload:
+    """Parse payload bytes back into a :class:`MetricPayload`.
+
+    Raises :class:`WireFormatError` on truncation, bad magic, an
+    incompatible **major** version or an oversized payload — the bounded
+    contract is enforced on BOTH ends (a hostile sender does not run our
+    ``encode_state``, so the decode side must refuse too; ``max_bytes=None``
+    disables for trusted offline tooling). A newer **minor** version
+    decodes: unknown header keys are ignored and unknown ``meta`` keys
+    preserved — that asymmetry (minor adds, major breaks) is the whole
+    versioning contract, pinned by ``tests/serve/test_wire.py``.
+
+    ``_peeked`` hands in a prior :func:`peek_header` result for these same
+    bytes so callers that already peeked (the ingest firewall's identity
+    read) do not pay the header JSON parse twice per payload.
+    """
+    (major, minor), header = _peeked if _peeked is not None else peek_header(data, max_bytes=max_bytes)
+    body_start = _PREAMBLE.size + _PREAMBLE.unpack_from(data)[3]
     for required in ("tenant", "collection", "client", "watermark", "schema_hash", "leaves"):
         if required not in header:
             raise WireFormatError(f"payload header missing required key {required!r}")
@@ -350,6 +387,21 @@ def decode_state(data: bytes, *, max_bytes: Optional[int] = MAX_WIRE_BYTES) -> M
                 f"payload truncated: leaf {entry.get('member')}/{'/'.join(entry.get('path', []))}"
                 f" spans bytes [{offset}, {offset + nbytes}) of a {len(body)}-byte body"
             )
+        # crc is optional on the wire (minor-0 senders don't emit it) but
+        # verified whenever present: refusing a flipped bit HERE, naming the
+        # exact leaf, is what keeps one corrupt client from poisoning a
+        # tenant's merged state three folds later where nothing can say whose
+        # bytes were bad
+        declared_crc = entry.get("crc32")
+        if declared_crc is not None:
+            actual_crc = zlib.crc32(body[offset : offset + nbytes]) & 0xFFFFFFFF
+            if actual_crc != int(declared_crc):
+                raise WireFormatError(
+                    f"leaf {entry.get('member')}/{'/'.join(str(p) for p in entry.get('path', []))}"
+                    f" failed its crc32 integrity check (header declares"
+                    f" {int(declared_crc):#010x}, body bytes hash to {actual_crc:#010x}):"
+                    " the payload was corrupted in flight — refusing to fold it"
+                )
         try:
             leaf = np.frombuffer(body[offset : offset + nbytes], dtype=_dtype_from_name(str(entry["dtype"])))
             leaf = leaf.reshape([int(s) for s in entry["shape"]])

@@ -86,6 +86,42 @@ class TestHealthMonitor:
         calm = HealthMonitor(clamp_risk=False, degraded_syncs=False, warn=False).check()
         assert calm["healthy"] is True
 
+    def test_serve_fleet_conditions(self):
+        """The serving-tier probes (default DISARMED — they read series a
+        non-serving process never writes) classify queue saturation,
+        quarantines and circuit opens off the registry alone — from the
+        CURRENT-state gauges the firewall exports, so a resolved incident
+        stops firing."""
+        obs.enable()
+        # per-node series: the idle leaf must not mask the saturated root
+        obs.set_gauge("serve.queue_depth", 900.0, node="root")
+        obs.set_gauge("serve.queue_depth", 0.0, node="leaf")
+        obs.set_gauge("serve.clients_quarantined", 1.0, node="root")
+        obs.set_gauge("serve.circuits_open", 2.0, node="root")
+        # the cumulative event counters alone must NOT fire the conditions
+        obs.inc("serve.quarantined", tenant="t")
+        obs.inc("serve.circuit_open", tenant="t")
+        # disarmed by default: the same registry state reads healthy
+        assert HealthMonitor(warn=False).check()["healthy"] is True
+        armed = HealthMonitor(
+            queue_depth_threshold=512.0, quarantine=True, circuit_open=True, warn=False
+        ).check()
+        assert {w["kind"] for w in armed["warnings"]} == {
+            "queue_saturation",
+            "quarantine",
+            "circuit_open",
+        }
+        # incident over: queue drained, quarantine lifted, circuits closed —
+        # the gauges go to zero and every condition clears, even though the
+        # cumulative counters above latched forever
+        obs.set_gauge("serve.queue_depth", 10.0, node="root")
+        obs.set_gauge("serve.clients_quarantined", 0.0, node="root")
+        obs.set_gauge("serve.circuits_open", 0.0, node="root")
+        calm = HealthMonitor(
+            queue_depth_threshold=512.0, quarantine=True, circuit_open=True, warn=False
+        ).check()
+        assert calm["healthy"] is True
+
     def test_disabled_layer_still_classifies_but_does_not_count(self):
         obs.enable()
         obs.set_gauge("sync.arrival_skew_ms", 5000.0)

@@ -1,0 +1,118 @@
+"""Seeded wire-chaos schedule: reproducible, conservative, crc-refusable.
+
+The chaos harness is only trustworthy if (a) a seed fully determines the
+fault schedule (the smoke's bitwise oracle depends on replaying the exact
+same fates), (b) no payload is lost that chaos did not explicitly drop
+(held reorders/delays all drain), and (c) a corrupted payload is refused
+by the wire layer's per-leaf crc32 — naming the leaf — rather than folded.
+"""
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.ft.faults import WireChaos, corrupt_payload, partition
+from metrics_tpu.serve.wire import WireFormatError, decode_state, encode_state, peek_header
+
+
+def _blob(step: int = 0) -> bytes:
+    coll = MetricCollection({"seen": SumMetric()})
+    coll["seen"].update(jnp.asarray(float(step + 1)))
+    return encode_state(coll, tenant="t", client_id=f"c{step:03d}", watermark=(0, step))
+
+
+class TestWireChaosSchedule:
+    def test_seed_fully_determines_fates_and_corruption(self):
+        blobs = [_blob(i) for i in range(64)]
+
+        def run(seed):
+            chaos = WireChaos(seed, p_drop=0.1, p_duplicate=0.1, p_reorder=0.1, p_corrupt=0.1, p_delay=0.1)
+            out = [chaos.plan(b) for b in blobs]
+            out.append(("end", chaos.flush()))
+            return out
+
+        assert run(5) == run(5)
+        fates_a = [fate for fate, _ in run(5)]
+        fates_b = [fate for fate, _ in run(6)]
+        assert fates_a != fates_b  # different seeds decorrelate
+
+    def test_conservation_nothing_lost_but_drops_and_corruptions(self):
+        """Every planned payload is either delivered verbatim (possibly
+        late, possibly twice), delivered corrupted, or explicitly dropped —
+        the accounting identity the oracle is computed from."""
+        blobs = [_blob(i) for i in range(200)]
+        chaos = WireChaos(1, p_drop=0.1, p_duplicate=0.1, p_reorder=0.15, p_corrupt=0.1, p_delay=0.15)
+        delivered = []
+        for i, blob in enumerate(blobs):
+            fate, now = chaos.plan(blob)
+            delivered.extend(now)
+            if i % 50 == 49:
+                delivered.extend(chaos.end_round())
+        delivered.extend(chaos.flush())
+        counts = chaos.counts
+        assert sum(counts.values()) == len(blobs)
+        verbatim = {b for b in blobs}
+        n_verbatim = sum(1 for b in delivered if b in verbatim)
+        assert n_verbatim == counts["deliver"] + 2 * counts["duplicate"] + counts["reorder"] + counts["delay"]
+        assert len(delivered) - n_verbatim == counts["corrupt"]
+        for kind in ("drop", "duplicate", "reorder", "corrupt", "delay"):
+            assert counts[kind] > 0, f"schedule never drew {kind} — probabilities too low for the test"
+
+    def test_probability_validation(self):
+        with pytest.raises(ValueError, match="p_drop"):
+            WireChaos(0, p_drop=1.5)
+        with pytest.raises(ValueError, match="sum"):
+            WireChaos(0, p_drop=0.5, p_duplicate=0.5, p_reorder=0.5)
+
+    def test_delay_crosses_a_round_boundary(self):
+        chaos = WireChaos(0, p_drop=0, p_duplicate=0, p_reorder=0, p_corrupt=0, p_delay=1.0)
+        fate, now = chaos.plan(_blob(0))
+        assert fate == "delay" and now == []
+        held = chaos.end_round()
+        assert held == [_blob(0)]
+        assert chaos.flush() == []
+
+
+class TestCorruptPayload:
+    def test_corruption_is_refused_by_the_crc_naming_the_leaf(self):
+        blob = _blob()
+        rng = random.Random(3)
+        for _ in range(16):  # every draw lands in the body; all must refuse
+            bad = corrupt_payload(blob, rng)
+            assert bad != blob
+            with pytest.raises(WireFormatError, match="crc32|truncated|not valid"):
+                decode_state(bad)
+
+    def test_corruption_preserves_header_attribution(self):
+        """The header survives so the firewall can attribute the strike —
+        the whole point of corrupting the BODY specifically."""
+        bad = corrupt_payload(_blob(7), random.Random(0))
+        _, header = peek_header(bad)
+        assert header["client"] == "c007"
+
+    def test_clean_payload_round_trips(self):
+        payload = decode_state(_blob(2))
+        assert payload.client_id == "c002"
+        assert np.asarray(payload.states["seen"]["value"]) == 3.0
+
+
+class TestPartition:
+    def test_partition_severs_and_heals_the_uplink(self):
+        from metrics_tpu.serve import AggregationTree
+
+        tree = AggregationTree(
+            fan_out=(2,), tenants={"t": lambda: MetricCollection({"seen": SumMetric()})}
+        )
+        leaf = tree.leaves[0]
+        leaf.aggregator.ingest(_blob(0))
+        with partition(leaf):
+            tree.pump()
+            root_tenant = tree.root.aggregator._tenant("t")
+            assert f"node:{leaf.name}" not in root_tenant.clients  # ship dropped
+        tree.pump()  # healed: cumulative ship arrives
+        assert f"node:{leaf.name}" in tree.root.aggregator._tenant("t").clients
+        assert leaf._send is None  # transport restored

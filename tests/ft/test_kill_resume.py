@@ -11,8 +11,12 @@ be exact (``assert_array_equal``), not approximate.
 
 Covered state shapes: scalar monoid states (MeanMetric), a
 MetricCollection with ACTIVE compute groups (Precision/Recall sharing one
-stat-scores pipeline), and a ``CapacityBuffer``-backed cat-state metric
-(AUROC with ``sample_capacity``).
+stat-scores pipeline), a ``CapacityBuffer``-backed cat-state metric
+(AUROC with ``sample_capacity``), and the streaming wrappers restored
+MID-WINDOW — a ``WindowedMetric`` killed around a ring-rotation boundary
+(the ``_pos``/``_in_slot``/``_slot_filled`` aux state must resume the ring
+exactly, expiries included) and a ``DecayedMetric`` whose decay chain
+order must survive the restart bitwise.
 """
 import numpy as np
 import pytest
@@ -22,9 +26,10 @@ import jax.numpy as jnp
 
 pytest.importorskip("orbax.checkpoint")
 
-from metrics_tpu import AUROC, MeanMetric, MetricCollection, Precision, Recall  # noqa: E402
+from metrics_tpu import AUROC, Accuracy, MeanMetric, MetricCollection, Precision, Recall  # noqa: E402
 from metrics_tpu.ft import BatchJournal, CheckpointManager, ResumeCursor, faults  # noqa: E402
 from metrics_tpu.steps import make_epoch  # noqa: E402
+from metrics_tpu.streaming import DecayedMetric, WindowedMetric  # noqa: E402
 
 N_BATCHES = 12
 
@@ -147,6 +152,78 @@ class TestKillResumeUpdateCount:
                 m.update(b)
                 journal.record(0, step)
         assert m._update_count == N_BATCHES
+
+
+class TestKillResumeMidWindow:
+    """Ring-rotation boundaries were never exercised by the kill-resume
+    battery: a ``WindowedMetric(window=3, updates_per_slot=2)`` rotates
+    lazily at updates 2, 4, 6, ... and first EXPIRES a filled shard at
+    update 7 — killing just before the boundary, exactly on it, and right
+    after the first expiry must all resume bitwise (the ring's aux state
+    rides the checkpoint; a resume that re-zeroed ``_in_slot`` would
+    rotate at the wrong update forever after)."""
+
+    @staticmethod
+    def _make_windowed():
+        return WindowedMetric(Accuracy(), window=3, updates_per_slot=2)
+
+    # kill_at=5: mid-slot, one update before a rotation; 6: the update ON
+    # the rotation boundary (rotation happens lazily inside it); 7: right
+    # after the ring wrapped and expired its first shard
+    @pytest.mark.parametrize("kill_at", [5, 6, 7])
+    def test_windowed_metric_resumes_ring_bitwise(self, tmp_path, kill_at):
+        batches = _classification_batches(seed=11)
+        ref = self._make_windowed()
+        for p, t in batches:
+            ref.update(p, t)
+        expected = np.asarray(ref.compute())
+        # the window must actually have expired shards by the end, or this
+        # test would pass on a wrapper that never rotates
+        assert ref._pos != 0 or ref._slot_filled != [1, 0, 0]
+
+        mgr = _run_until_preempted(
+            self._make_windowed, lambda m, b: m.update(*b), batches, kill_at, tmp_path
+        )
+        # the restored ring position/in-slot count must be the pre-kill one
+        probe = self._make_windowed()
+        mgr.restore(probe, journal=BatchJournal())
+        # the kill fires BEFORE batch kill_at folds, so the newest
+        # checkpoint holds exactly batches 0..kill_at-1
+        reference_ring = self._make_windowed()
+        for p, t in batches[:kill_at]:
+            reference_ring.update(p, t)
+        assert (probe._pos, probe._in_slot, probe._slot_filled) == (
+            reference_ring._pos,
+            reference_ring._in_slot,
+            reference_ring._slot_filled,
+        )
+
+        resumed_value = _resume_and_finish(
+            self._make_windowed, lambda m, b: m.update(*b), lambda m: m.compute(), batches, mgr
+        )
+        np.testing.assert_array_equal(np.asarray(resumed_value), expected)
+
+    @pytest.mark.parametrize("kill_at", [3, 8])
+    def test_decayed_metric_resumes_decay_chain_bitwise(self, tmp_path, kill_at):
+        """decay*state + batch is order-sensitive float math — identical
+        batch order on both sides makes bitwise equality the right bar."""
+        batches = _classification_batches(seed=12)
+
+        def make_decayed():
+            return DecayedMetric(Accuracy(), half_life=2.0)
+
+        ref = make_decayed()
+        for p, t in batches:
+            ref.update(p, t)
+        expected = np.asarray(ref.compute())
+
+        mgr = _run_until_preempted(
+            make_decayed, lambda m, b: m.update(*b), batches, kill_at, tmp_path
+        )
+        resumed_value = _resume_and_finish(
+            make_decayed, lambda m, b: m.update(*b), lambda m: m.compute(), batches, mgr
+        )
+        np.testing.assert_array_equal(np.asarray(resumed_value), expected)
 
 
 class TestKillResumeFusedEpoch:

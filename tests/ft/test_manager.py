@@ -288,6 +288,125 @@ class TestAsyncSave:
         assert mgr.checkpoints() == []
 
 
+class TestSaveWatchdog:
+    """A hung async persist (wedged filesystem) used to be INVISIBLE: the
+    loop kept training, no checkpoint ever landed, and wait()/the next
+    save() joined the hung thread forever. With ``save_timeout_s`` the
+    hang is warned once, counted under ``ft.save_timeouts``, and
+    surfaced as AttemptTimeout instead of a silent forever-join."""
+
+    def _hang_persist(self, mgr, release):
+        import threading
+
+        original = mgr._persist
+
+        def hung(tree, manifest, final):
+            release.wait(30.0)  # wedged until the test lets go
+            original(tree, manifest, final)
+
+        mgr._persist = hung
+        return threading
+
+    def test_hung_save_warns_counts_and_surfaces_timeout(self, tmp_path, recwarn):
+        import threading
+
+        obs.reset()
+        obs.enable()
+        release = threading.Event()
+        try:
+            mgr = CheckpointManager(tmp_path / "wd", async_save=True, save_timeout_s=0.2)
+            self._hang_persist(mgr, release)
+            mgr.save(_mean_with([1.0]))
+            from metrics_tpu.ft.retry import AttemptTimeout
+
+            with pytest.raises(AttemptTimeout, match="save_timeout_s"):
+                mgr.wait()
+            assert obs.get_counter("ft.save_timeouts") == 1
+            assert any("may be hung" in str(w.message) for w in recwarn.list)
+            # one-shot: the counter keeps counting, the warning does not repeat
+        finally:
+            release.set()
+            obs.enable(False)
+            obs.reset()
+
+    def test_watchdog_timer_fires_without_wait(self, tmp_path):
+        """The hang must be loud ON ITS OWN — a loop that never calls
+        wait() (save-and-forget) still gets the warning and the counter."""
+        import threading
+        import time
+
+        obs.reset()
+        obs.enable()
+        release = threading.Event()
+        try:
+            mgr = CheckpointManager(tmp_path / "wd2", async_save=True, save_timeout_s=0.1)
+            self._hang_persist(mgr, release)
+            with pytest.warns(RuntimeWarning, match="may be hung"):
+                mgr.save(_mean_with([1.0]))
+                deadline = time.monotonic() + 5.0
+                while obs.get_counter("ft.save_timeouts") < 1 and time.monotonic() < deadline:
+                    time.sleep(0.01)
+            assert obs.get_counter("ft.save_timeouts") == 1
+        finally:
+            release.set()
+            obs.enable(False)
+            obs.reset()
+
+    def test_abandoned_writer_cannot_poison_later_saves(self, tmp_path):
+        """An abandoned hung writer keeps running (daemon, uncancellable).
+        Its late failure must NOT land in _worker_error — the next healthy
+        save would re-raise it, misattributed — and its unpublished seq
+        must never be handed to a later save (two writers racing to rename
+        onto the same ckpt-<seq> directory)."""
+        import threading
+
+        release = threading.Event()
+        mgr = CheckpointManager(tmp_path / "wd4", async_save=True, save_timeout_s=0.1)
+        original = mgr._persist
+
+        def hung_then_failing(tree, manifest, final):
+            release.wait(30.0)
+            raise OSError("NFS came back angry")
+
+        mgr._persist = hung_then_failing
+        with pytest.warns(RuntimeWarning, match="may be hung"):
+            mgr.save(_mean_with([1.0]))
+            from metrics_tpu.ft.retry import AttemptTimeout
+
+            with pytest.raises(AttemptTimeout, match="save_timeout_s"):
+                mgr.wait()
+        abandoned = [t for t in threading.enumerate() if t.name.startswith("ft-ckpt-save-")]
+        # let the abandoned writer fail late, AFTER its save was written off
+        release.set()
+        for t in abandoned:
+            t.join(5.0)
+        mgr._persist = original
+        # the late failure stayed off the books ...
+        path = mgr.save(_mean_with([2.0]))
+        mgr.wait()  # would re-raise the stale OSError without the guard
+        # ... and the healthy save took a FRESH seq even though the hung
+        # save (seq 0) never published anything discovery can see
+        assert path.endswith("ckpt-00000001")
+        assert [seq for seq, _ in mgr.checkpoints()] == [1]
+
+    def test_fast_save_never_trips_the_watchdog(self, tmp_path):
+        obs.reset()
+        obs.enable()
+        try:
+            mgr = CheckpointManager(tmp_path / "wd3", async_save=True, save_timeout_s=30.0)
+            mgr.save(_mean_with([1.0, 2.0]))
+            mgr.wait()
+            assert obs.get_counter("ft.save_timeouts") == 0
+            assert len(mgr.checkpoints()) == 1
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="save_timeout_s"):
+            CheckpointManager(tmp_path, save_timeout_s=0)
+
+
 class TestManifestFile:
     def test_manifest_is_valid_json_on_disk(self, tmp_path):
         mgr = CheckpointManager(tmp_path / "mf")

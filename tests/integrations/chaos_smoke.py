@@ -1,0 +1,309 @@
+"""CI smoke: the serving fleet self-heals under a seeded chaos schedule.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.chaos_smoke`` (the
+CI test job does, mirroring ``serve_smoke``). Two arms:
+
+* **loadgen arm** — the 1k-client / 3-level loadgen under a 10% seeded
+  fault schedule (drops, duplicates, reordering, payload corruption
+  refused by the wire crc32), ``verify=True``: the root state must be
+  BITWISE equal to a flat oracle merge of exactly the accepted snapshots.
+* **orchestrated arm** — a smaller tree under the full fault set at once:
+  the seeded :class:`~metrics_tpu.ft.faults.WireChaos` delivery schedule,
+  PLUS one hard-killed node (seeded pick among root/intermediates — the
+  in-process SIGKILL analogue; the real-signal arm is ``serve_smoke``)
+  detected and rebuilt by the :class:`~metrics_tpu.serve.Supervisor`
+  (root restored from its checkpoint, ship sequences resumed above the
+  parent's watermarks), PLUS a leaf subtree partitioned mid-stream and
+  healed, PLUS a NaN-poisoning client (quarantined) and a
+  corrupt-byte-spewing client (circuit opened). The final root ``/query``
+  over HTTP must be bitwise-equal to the flat oracle merge of the
+  accepted snapshots, and EVERY injected fault must be visible in obs
+  counters (``chaos.injected{kind=}``, ``serve.quarantined``,
+  ``serve.circuit_open``, ``health.alerts{monitor=supervisor,kind=}``).
+
+Why the kill targets an interior node or the root, never a leaf: interior
+state is reconstructable from the children's next cumulative ships (and
+the root additionally from its checkpoint), so the oracle stays an exact
+function of the delivery schedule. A killed LEAF loses end-client
+snapshots until the at-least-once redelivery — recoverable in production,
+but the oracle would then depend on the redelivery schedule too.
+"""
+import json
+import os
+import random
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260803
+N_CLIENTS = 64
+N_INTERVALS = 3
+SAMPLES = 64
+TENANT = "chaos"
+FAN_OUT = (2, 4)
+HEARTBEAT_S = 0.3
+
+
+def _factory():
+    from metrics_tpu import MaxMetric, SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=128), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def _client_snapshots():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for c in range(N_CLIENTS):
+        cid = f"client-{c:03d}"
+        rng = np.random.default_rng(4000 + c)
+        coll = _factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            target = jnp.asarray(
+                (rng.uniform(0, 1, SAMPLES) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32)
+            )
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+            coll["peak"].update(preds)
+            blobs.append(encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval)))
+        out[cid] = blobs
+    return out
+
+
+def _poisoned_blob():
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    coll = _factory()
+    coll["seen"].update(jnp.asarray(1.0))
+    coll["seen"].value = jnp.asarray(float("nan"))  # a buggy client's 0/0
+    return encode_state(coll, tenant=TENANT, client_id="poison-client", watermark=(0, 0))
+
+
+def _loadgen_arm():
+    from metrics_tpu.serve.loadgen import run_loadgen
+
+    out = run_loadgen(
+        n_clients=1000,
+        fan_out=(4, 16),
+        payloads_per_client=2,
+        samples_per_payload=128,
+        num_bins=128,
+        seed=SEED,
+        verify=True,
+        fault_rate=0.10,
+    )
+    assert out["verified_bitwise"] is True
+    counts = out["chaos_counts"]
+    for kind in ("drop", "duplicate", "reorder", "corrupt"):
+        assert counts[kind] > 0, f"10% schedule over 2000 payloads never drew {kind}"
+    assert out["refused_corrupt"] == counts["corrupt"]
+    print(
+        f"chaos smoke [loadgen]: 1000 clients x 2 snapshots at 10% faults"
+        f" ({ {k: v for k, v in counts.items() if k != 'deliver'} }) — root bitwise-equal"
+        " to the accepted-snapshot oracle",
+        flush=True,
+    )
+
+
+def _orchestrated_arm(tmp: str) -> None:
+    import time
+
+    import numpy as np
+
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.serve import (
+        AggregationTree,
+        Aggregator,
+        CircuitOpenError,
+        MetricsServer,
+        ResilienceConfig,
+        Supervisor,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, peek_header
+
+    obs.reset()  # the loadgen arm's counters share the process-global registry
+    obs.enable()
+    snapshots = _client_snapshots()
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.03, p_duplicate=0.03, p_reorder=0.03, p_corrupt=0.03, p_delay=0.03
+    )
+    tree = AggregationTree(
+        fan_out=FAN_OUT,
+        tenants={TENANT: _factory},
+        checkpoint_root=os.path.join(tmp, "root-ckpt"),
+        resilience=ResilienceConfig(error_threshold=3),
+    )
+    supervisor = Supervisor(tree, heartbeat_timeout_s=HEARTBEAT_S, name="supervisor", warn=False)
+
+    delivered = set()  # (client_id, interval) delivered uncorrupted
+
+    def client_index(blob: bytes) -> int:
+        _, header = peek_header(blob)
+        return int(str(header["client"]).rsplit("-", 1)[1])
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            c = client_index(blob)
+            try:
+                tree.leaf_for(c).ingest(blob)
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32, counted below
+            else:
+                _, header = peek_header(blob)
+                delivered.add((str(header["client"]), int(header["watermark"][1])))
+
+    def deliver_interval(interval: int) -> None:
+        for cid in sorted(snapshots):
+            _, now_blobs = chaos.plan(snapshots[cid][interval])
+            deliver(now_blobs)
+        deliver(chaos.end_round())
+
+    # ---- interval 0, then checkpoint and hard-kill a seeded node --------
+    deliver_interval(0)
+    tree.pump()
+    tree.save()
+    victim = chaos.choice([tree.root] + tree.levels[1])  # root or an intermediate
+    faults.kill_node(victim)
+    report = supervisor.check()
+    kinds = {f["kind"] for f in report["findings"]}
+    assert "dead_node" in kinds, report
+    actions = supervisor.heal()
+    assert any(a["action"] == "rebuild_node" and a["node"] == victim.name for a in actions)
+    assert not victim.is_dead
+    # re-populate the healed node's view from its children's cumulative
+    # re-ships (and every parent's child slots — the slot-age heartbeat
+    # only watches children it has heard from at least once)
+    tree.pump()
+
+    # ---- interval 1 under a leaf partition, healed afterwards -----------
+    partitioned_leaf = tree.leaves[-1]
+    with faults.partition(partitioned_leaf):
+        deliver_interval(1)
+        tree.pump()
+        time.sleep(HEARTBEAT_S + 0.1)
+        tree.pump()  # other children refresh; the partitioned ship drops
+        report = supervisor.check()
+        stale = [f for f in report["findings"] if f["kind"] == "stale_child"]
+        assert any(f"node:{partitioned_leaf.name}" in f["detail"] for f in stale), report
+
+    # ---- hostile clients: poison (quarantine) and corruption (breaker) --
+    poison_leaf = tree.leaf_for(0)
+    poison_leaf.ingest(_poisoned_blob())
+    poison_leaf.flush()
+    assert poison_leaf.firewall.is_quarantined(TENANT, "poison-client")
+
+    from metrics_tpu.serve.wire import encode_state
+
+    flaky_leaf = tree.leaf_for(1)
+    flaky_rng = random.Random(SEED + 1)
+    circuit_opened = False
+    flaky_coll = _factory()
+    for i in range(4):
+        # a DISTINCT identity (never in the oracle set) that only ever
+        # ships corrupt bytes — its circuit must open, nobody else's
+        bad = faults.corrupt_payload(
+            encode_state(flaky_coll, tenant=TENANT, client_id="flaky-client", watermark=(0, i)),
+            flaky_rng,
+        )
+        try:
+            flaky_leaf.ingest(bad)
+        except WireFormatError:
+            continue
+        except CircuitOpenError:
+            circuit_opened = True
+            break
+    assert circuit_opened and obs.sum_counter("serve.circuit_open") > 0
+
+    # ---- interval 2, drain everything chaos still holds, converge -------
+    deliver_interval(2)
+    deliver(chaos.flush())
+    tree.pump(rounds=3)
+
+    # ---- oracle: flat merge of exactly the accepted snapshots -----------
+    accepted = {}
+    for cid, interval in delivered:
+        if cid not in accepted or interval > accepted[cid]:
+            accepted[cid] = interval
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(TENANT, _factory)
+    for cid, interval in sorted(accepted.items()):
+        flat.ingest(snapshots[cid][interval])
+    flat.flush()
+    flat_tenant = flat._tenant(TENANT)
+    if flat_tenant.merged_leaves is None:
+        flat_tenant.fold()
+
+    tree.root.aggregator.flush()
+    root_tenant = tree.root.aggregator._tenant(TENANT)
+    if root_tenant.merged_leaves is None:
+        root_tenant.fold()
+    assert root_tenant.spec == flat_tenant.spec
+    for (path, _), ours, oracle in zip(
+        root_tenant.spec, root_tenant.merged_leaves, flat_tenant.merged_leaves
+    ):
+        assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+            f"root leaf {'/'.join(path)} differs from the accepted-snapshot oracle"
+        )
+
+    # ---- every injected fault is visible in obs counters ----------------
+    for kind, count in chaos.counts.items():
+        if kind == "deliver" or count == 0:
+            continue
+        assert obs.get_counter("chaos.injected", kind=kind) == count, kind
+    assert obs.get_counter("chaos.injected", kind="kill") == 1
+    assert obs.get_counter("chaos.injected", kind="partition") > 0
+    assert obs.sum_counter("serve.quarantined") >= 1
+    assert obs.sum_counter("serve.circuit_open") >= 1
+    assert obs.get_counter("health.alerts", monitor="supervisor", kind="dead_node") >= 1
+    assert obs.get_counter("health.alerts", monitor="supervisor", kind="stale_child") >= 1
+    assert obs.sum_counter("serve.wire_errors") > 0
+
+    # ---- the HTTP surface agrees and reports itself ready ---------------
+    server = MetricsServer(tree.root.aggregator, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        q = json.load(urllib.request.urlopen(f"{base}/query?tenant={TENANT}", timeout=10))
+        offline = tree.root.aggregator.query(TENANT)
+        assert q == json.loads(json.dumps(offline)), "HTTP /query != in-process query"
+        live = json.load(urllib.request.urlopen(f"{base}/healthz/live", timeout=10))
+        assert live["live"] is True
+        ready = json.load(urllib.request.urlopen(f"{base}/healthz/ready", timeout=10))
+        assert ready["ready"] is True, ready
+    finally:
+        server.stop()
+
+    injected = sum(v for k, v in chaos.counts.items() if k != "deliver") + 2  # + kill + partition
+    print(
+        f"chaos smoke [orchestrated]: {N_CLIENTS} clients x {N_INTERVALS} intervals,"
+        f" {injected}+ injected faults (incl. {victim.name} hard-kill + supervised"
+        f" rebuild, {partitioned_leaf.name} partition + heal, 1 quarantine, 1 open"
+        " circuit) — root /query bitwise-equal to the accepted-snapshot oracle,"
+        " every fault visible in obs counters",
+        flush=True,
+    )
+
+
+def main() -> None:
+    import tempfile
+
+    _loadgen_arm()
+    with tempfile.TemporaryDirectory(prefix="chaos_smoke.") as tmp:
+        _orchestrated_arm(tmp)
+    print("chaos smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

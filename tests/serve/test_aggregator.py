@@ -202,6 +202,48 @@ class TestBackpressureAndWorker:
             agg.stop()
         assert agg.query("t")["payloads_folded"] == 1
 
+    def test_blocking_ingest_raises_when_worker_died(self):
+        """Regression: ingest(block=True) on a full queue used to park the
+        producer FOREVER when the background flush worker had died (nothing
+        drains, nobody is told). A dead worker must raise, promptly and by
+        name."""
+        import time
+
+        rng = np.random.default_rng(6)
+        agg = Aggregator("dw", max_queue=1, flush_interval_s=0.01).start()
+        agg.register_tenant("t", factory)
+        # kill the worker thread: a BaseException the per-flush Exception
+        # guard does not swallow (models any bug that escapes the loop)
+        agg.flush = lambda: (_ for _ in ()).throw(SystemExit)
+        deadline = time.monotonic() + 5.0
+        while agg.worker_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        del agg.flush  # restore the real method for the assertions below
+        assert agg.worker_alive() is False
+        blob = snapshot_bytes(fill(factory(), rng), "c", (0, 0))
+        agg.ingest(blob, block=False)  # fills the queue
+        t0 = time.monotonic()
+        with pytest.raises(ServeError, match="worker has DIED"):
+            agg.ingest(blob, block=True)  # would previously hang here
+        assert time.monotonic() - t0 < 2.0, "the dead-worker check must be prompt"
+
+    def test_blocking_ingest_with_live_worker_still_blocks_through(self):
+        """The fix must not break the healthy case: with the worker alive
+        and draining, a blocking ingest on a momentarily-full queue waits
+        and succeeds."""
+        rng = np.random.default_rng(7)
+        agg = Aggregator("lw", max_queue=1, flush_interval_s=0.01).start()
+        try:
+            agg.register_tenant("t", factory)
+            blob = snapshot_bytes(fill(factory(), rng), "c", (0, 0))
+            for i in range(5):
+                agg.ingest(
+                    snapshot_bytes(fill(factory(), rng), "c", (0, i + 1)), block=True, timeout=10.0
+                )
+            assert blob  # reached: no hang, no spurious raise
+        finally:
+            agg.stop()
+
 
 class TestPersistence:
     def test_save_restore_bitwise_with_exact_dedup(self, tmp_path):

@@ -124,7 +124,7 @@ class TestQuery:
 class TestIngest:
     def test_ingest_accepts_and_is_queryable(self, server):
         resp = _post(server, "/ingest", snapshot("c-http", (0, 0)))
-        assert json.load(resp) == {"accepted": True}
+        assert json.load(resp) == {"accepted": True, "shed": False}
         got = json.load(_get(server, f"/query?tenant={TENANT}"))
         assert got["clients"] == 1
 
@@ -141,7 +141,7 @@ class TestIngest:
             _post(server, "/ingest", blob)
         assert err.value.code == 404
 
-    def test_ingest_backpressure_503(self):
+    def test_ingest_backpressure_503_with_retry_after(self):
         agg = Aggregator("tiny", max_queue=1)
         agg.register_tenant(TENANT, factory)
         srv = MetricsServer(agg, port=0).start()
@@ -150,6 +150,40 @@ class TestIngest:
             with pytest.raises(urllib.error.HTTPError) as err:
                 _post(srv, "/ingest", snapshot("b", (0, 0)))
             assert err.value.code == 503
+            # a refused producer must be told WHEN to come back, or a
+            # thousand of them retry immediately and in lockstep
+            assert int(err.value.headers["Retry-After"]) >= 1
+        finally:
+            srv.stop()
+
+    def test_ingest_quarantined_client_403(self):
+        from metrics_tpu.serve import ResilienceConfig
+
+        agg = Aggregator("fw", resilience=ResilienceConfig())
+        agg.register_tenant(TENANT, factory)
+        agg.firewall.record_poison(TENANT, "poisoner", "test quarantine")
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv, "/ingest", snapshot("poisoner", (0, 0)))
+            assert err.value.code == 403
+            assert "quarantined" in json.load(err.value)["error"]
+        finally:
+            srv.stop()
+
+    def test_ingest_open_circuit_503_with_retry_after(self):
+        from metrics_tpu.serve import ResilienceConfig
+
+        agg = Aggregator("cb", resilience=ResilienceConfig(error_threshold=1))
+        agg.register_tenant(TENANT, factory)
+        agg.firewall.record_error(TENANT, "flaky")  # threshold 1: opens now
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv, "/ingest", snapshot("flaky", (0, 0)))
+            assert err.value.code == 503
+            assert int(err.value.headers["Retry-After"]) >= 1
+            assert "circuit" in json.load(err.value)["error"]
         finally:
             srv.stop()
 
@@ -167,6 +201,51 @@ class TestHealth:
         assert h["node"] == "http-test"
         assert h["tenants"] == 1
         assert h["clients"] == {TENANT: 1}
+        # the full probe also carries the readiness detail
+        assert h["ready"] is True and h["reasons"] == []
+        assert h["queue_depth"] == 0 and h["last_flush_age_s"] >= 0
+
+    def test_liveness_is_not_readiness(self, server):
+        """/healthz/live answers 200 whenever the process answers — a
+        drowning-but-alive node must stay live (restart solves nothing)
+        while /healthz/ready routes traffic away."""
+        live = json.load(_get(server, "/healthz/live"))
+        assert live["live"] is True and live["node"] == "http-test"
+        ready = json.load(_get(server, "/healthz/ready"))
+        assert ready["ready"] is True
+        assert {"queue_depth", "last_flush_age_s", "open_circuits", "quarantined"} <= set(ready)
+
+    def test_readiness_503_when_queue_saturated(self):
+        agg = Aggregator("drowning", max_queue=2)
+        agg.register_tenant(TENANT, factory)
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            _post(srv, "/ingest", snapshot("a", (0, 0)))
+            _post(srv, "/ingest", snapshot("b", (0, 0)))  # queue full (no flush)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(srv, "/healthz/ready")
+            assert err.value.code == 503
+            body = json.load(err.value)
+            assert body["ready"] is False and any("queue" in r for r in body["reasons"])
+            # liveness is unaffected: the process answers
+            assert json.load(_get(srv, "/healthz/live"))["live"] is True
+        finally:
+            srv.stop()
+
+    def test_readiness_reports_firewall_states(self):
+        from metrics_tpu.serve import ResilienceConfig
+
+        agg = Aggregator("fw-health", resilience=ResilienceConfig(error_threshold=1))
+        agg.register_tenant(TENANT, factory)
+        agg.firewall.record_error(TENANT, "flaky")
+        agg.firewall.record_poison(TENANT, "poisoner", "test")
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            ready = json.load(_get(srv, "/healthz/ready"))
+            assert ready["open_circuits"] == [f"{TENANT}/flaky"]
+            assert ready["quarantined"] == [f"{TENANT}/poisoner"]
+        finally:
+            srv.stop()
 
 
 class TestIngestSizeCap:

@@ -30,6 +30,49 @@ class TestLoadgen:
         # its intermediate, each intermediate to the root: merges >= payloads
         assert out["merges"] >= out["payloads"]
 
+    def test_degraded_run_is_bitwise_vs_accepted_snapshot_oracle(self):
+        """fault_rate>0: delivery runs under the seeded chaos schedule and
+        the verify arm's oracle is a flat merge of EXACTLY the accepted
+        snapshots (per client, the highest watermark delivered
+        uncorrupted) — dropped and corrupted payloads excluded."""
+        out = run_loadgen(
+            n_clients=24,
+            fan_out=(2,),
+            payloads_per_client=3,
+            samples_per_payload=32,
+            num_bins=32,
+            seed=5,
+            verify=True,
+            fault_rate=0.3,
+        )
+        assert out["verified_bitwise"] is True
+        counts = out["chaos_counts"]
+        # at 30%/72 payloads the schedule must actually have injected
+        # something of each wired kind, or the run proved nothing
+        assert counts["drop"] > 0 and counts["corrupt"] > 0
+        assert counts["duplicate"] + counts["reorder"] > 0
+        assert out["refused_corrupt"] == counts["corrupt"]
+
+    def test_degraded_seed_reproduces_exactly(self):
+        kwargs = dict(
+            n_clients=10,
+            fan_out=(2,),
+            payloads_per_client=2,
+            samples_per_payload=16,
+            num_bins=16,
+            seed=9,
+            fault_rate=0.4,
+        )
+        a, b = run_loadgen(**kwargs), run_loadgen(**kwargs)
+        assert a["chaos_counts"] == b["chaos_counts"]
+        assert a["merges"] == b["merges"]
+
+    def test_fault_rate_validation(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="fault_rate"):
+            run_loadgen(n_clients=1, fault_rate=1.5)
+
     def test_cli_json(self, capsys):
         code = main(
             ["--clients", "6", "--fan-out", "2", "--payloads-per-client", "1", "--num-bins", "16", "--verify"]

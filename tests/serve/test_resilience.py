@@ -1,0 +1,628 @@
+"""Self-healing contract: breakers isolate, quarantine contains, Supervisor revives.
+
+Three blast radii, three containment proofs:
+
+* a flaky client's circuit opens after the error threshold, refuses with a
+  SEEDED decorrelated-jitter cooldown (the exact schedule is pinned
+  against :func:`metrics_tpu.ft.retry.backoff_schedule` — production
+  sleeps, not approximations), half-opens for one probe, and closes on a
+  clean payload;
+* a NaN-poisoned snapshot is dropped and its client quarantined while the
+  tenant keeps folding every healthy client — the view is NEVER staled;
+* a hard-killed node (the in-process SIGKILL analogue) is detected by the
+  Supervisor through traffic-implied heartbeats and rebuilt — the root
+  restored bitwise from its checkpoint, the ship sequence resumed above
+  the parent's watermark so the healed subtree is not dropped as stale.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, MinMetric, SumMetric, obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.ft import faults
+from metrics_tpu.ft.retry import RetryPolicy, backoff_schedule
+from metrics_tpu.serve import (
+    AggregationTree,
+    Aggregator,
+    BackpressureError,
+    CircuitOpenError,
+    QuarantinedClientError,
+    ResilienceConfig,
+    Supervisor,
+)
+from metrics_tpu.serve.resilience import ClientFirewall, NodeDownError, check_poisoned
+from metrics_tpu.serve.wire import WireFormatError, encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "t"
+
+
+def factory(num_bins: int = 64) -> MetricCollection:
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=num_bins), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def fill(coll: MetricCollection, rng: np.random.Generator, n: int = 64) -> MetricCollection:
+    preds = jnp.asarray(rng.uniform(0, 1, n).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, n) < 0.6).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    coll["seen"].update(jnp.asarray(float(n)))
+    coll["peak"].update(preds)
+    return coll
+
+
+def snapshot(client_id: str, watermark, seed: int = 0) -> bytes:
+    coll = fill(factory(), np.random.default_rng(seed))
+    return encode_state(coll, tenant=TENANT, client_id=client_id, watermark=watermark)
+
+
+class _FakeClock:
+    def __init__(self) -> None:
+        self.now = 100.0
+
+    def __call__(self) -> float:
+        return self.now
+
+
+# ----------------------------------------------------------------------
+# Config + poison predicate
+# ----------------------------------------------------------------------
+
+
+class TestResilienceConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="error_threshold"):
+            ResilienceConfig(error_threshold=0)
+        with pytest.raises(ValueError, match="poison_strikes"):
+            ResilienceConfig(poison_strikes=0)
+        with pytest.raises(ValueError, match="shed_watermark"):
+            ResilienceConfig(shed_watermark=0.0)
+        with pytest.raises(ValueError, match="shed_watermark"):
+            ResilienceConfig(shed_watermark=1.5)
+
+
+class TestCheckPoisoned:
+    SPEC = [
+        (("m", "total"), "sum"),
+        (("m", "peak"), "max"),
+        (("m", "floor"), "min"),
+        (("m", "counts"), "sum"),
+    ]
+
+    def _leaves(self, total, peak, floor, counts):
+        return [
+            np.asarray(total, np.float32),
+            np.asarray(peak, np.float32),
+            np.asarray(floor, np.float32),
+            np.asarray(counts, np.int64),
+        ]
+
+    def test_clean_state_passes(self):
+        assert check_poisoned(self.SPEC, self._leaves(1.0, 2.0, -1.0, [3, 4])) is None
+
+    def test_identity_infinities_are_legal_on_min_max(self):
+        """A no-data max state IS -inf (and min +inf): the firewall must
+        not quarantine every freshly-reset client."""
+        assert check_poisoned(self.SPEC, self._leaves(0.0, -np.inf, np.inf, [0, 0])) is None
+
+    def test_nan_on_any_float_leaf_is_poison(self):
+        detail = check_poisoned(self.SPEC, self._leaves(np.nan, 1.0, 0.0, [1, 1]))
+        assert detail is not None and "m/total" in detail
+        detail = check_poisoned(self.SPEC, self._leaves(0.0, np.nan, 0.0, [1, 1]))
+        assert detail is not None and "m/peak" in detail
+
+    def test_inf_on_sum_leaf_is_poison(self):
+        """Inf survives every later sum (and Inf - Inf births NaN); on
+        min/max it is the identity and washes out."""
+        assert check_poisoned(self.SPEC, self._leaves(np.inf, 1.0, 0.0, [1, 1])) is not None
+        assert check_poisoned(self.SPEC, self._leaves(0.0, np.inf, -np.inf, [1, 1])) is None
+
+    def test_integer_leaves_cannot_poison(self):
+        assert check_poisoned([(("m", "counts"), "sum")], [np.asarray([9], np.int64)]) is None
+
+
+# ----------------------------------------------------------------------
+# Circuit breaker
+# ----------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def _firewall(self, clock, **cfg):
+        defaults = dict(
+            error_threshold=3,
+            probe_policy=RetryPolicy(
+                backoff_s=0.5, max_backoff_s=30.0, jitter="decorrelated", jitter_seed=7
+            ),
+        )
+        defaults.update(cfg)
+        return ClientFirewall(ResilienceConfig(**defaults), node="n", clock=clock)
+
+    def test_opens_at_threshold_with_pinned_jitter_schedule(self):
+        clock = _FakeClock()
+        fw = self._firewall(clock)
+        fw.record_error(TENANT, "c")
+        fw.record_error(TENANT, "c")
+        fw.admit(TENANT, "c")  # two strikes: still closed
+        fw.record_error(TENANT, "c")  # third: open
+        with pytest.raises(CircuitOpenError) as err:
+            fw.admit(TENANT, "c")
+        # the cooldown IS the seeded decorrelated schedule's first delay —
+        # the same generator production consumes, so the test pins the
+        # exact sleep, not a range
+        expected = next(backoff_schedule(fw.config.probe_policy, op=f"n:{TENANT}:c"))
+        assert err.value.retry_after_s == pytest.approx(expected, abs=1e-6)
+
+    def test_half_open_probe_success_closes(self):
+        clock = _FakeClock()
+        fw = self._firewall(clock, error_threshold=1)
+        fw.record_error(TENANT, "c")
+        with pytest.raises(CircuitOpenError):
+            fw.admit(TENANT, "c")
+        clock.now += 31.0  # past any capped delay
+        fw.admit(TENANT, "c")  # the half-open probe is admitted
+        fw.record_ok(TENANT, "c")
+        fw.admit(TENANT, "c")  # closed again
+        assert fw.status()["open_circuits"] == []
+
+    def test_half_open_probe_failure_reopens_with_next_delay(self):
+        clock = _FakeClock()
+        fw = self._firewall(clock, error_threshold=1)
+        fw.record_error(TENANT, "c")
+        schedule = backoff_schedule(fw.config.probe_policy, op=f"n:{TENANT}:c")
+        first, second = next(schedule), next(schedule)
+        clock.now += first + 1e-3
+        fw.admit(TENANT, "c")  # probe
+        fw.record_error(TENANT, "c")  # probe failed
+        with pytest.raises(CircuitOpenError) as err:
+            fw.admit(TENANT, "c")
+        assert err.value.retry_after_s == pytest.approx(second - 1e-3, abs=1e-2)
+
+    def test_concurrent_attempt_during_probe_is_refused(self):
+        clock = _FakeClock()
+        fw = self._firewall(clock, error_threshold=1)
+        fw.record_error(TENANT, "c")
+        clock.now += 31.0
+        fw.admit(TENANT, "c")  # probe in flight
+        with pytest.raises(CircuitOpenError):
+            fw.admit(TENANT, "c")  # not a second probe
+
+    def test_poisoned_probe_below_quarantine_threshold_reopens(self):
+        """A half-open probe judged POISONED but below poison_strikes used
+        to resolve nothing: not an ok, not an error, not an abandon — the
+        circuit sat half_open refusing the client forever. It must re-open
+        like any failed probe, so the next cooldown admits a fresh probe."""
+        clock = _FakeClock()
+        fw = self._firewall(clock, error_threshold=1, poison_strikes=2)
+        fw.record_error(TENANT, "c")
+        clock.now += 31.0
+        fw.admit(TENANT, "c")  # half-open probe admitted
+        quarantined = fw.record_poison(TENANT, "c", "nan leaf")  # strike 1 of 2
+        assert quarantined is False
+        # judged-failed: open again (refusing with a finite retry_after) ...
+        with pytest.raises(CircuitOpenError) as err:
+            fw.admit(TENANT, "c")
+        assert err.value.retry_after_s > 0
+        # ... and after that cooldown the NEXT probe is admitted — the
+        # client is recoverable, not pinned half_open forever
+        clock.now += err.value.retry_after_s + 1e-3
+        fw.admit(TENANT, "c")
+        fw.record_ok(TENANT, "c")
+        assert fw.status()["open_circuits"] == []
+
+    def test_success_resets_the_error_streak(self):
+        fw = self._firewall(_FakeClock())
+        fw.record_error(TENANT, "c")
+        fw.record_error(TENANT, "c")
+        fw.record_ok(TENANT, "c")
+        fw.record_error(TENANT, "c")
+        fw.record_error(TENANT, "c")
+        fw.admit(TENANT, "c")  # 2 < threshold after the reset: still closed
+
+    def test_distinct_clients_get_decorrelated_schedules(self):
+        """Two clients of the same node must not probe in lockstep: the op
+        label folds the client id into the seed."""
+        fw = self._firewall(_FakeClock())
+        sched_a = [next(backoff_schedule(fw.config.probe_policy, op=f"n:{TENANT}:a"))]
+        sched_b = [next(backoff_schedule(fw.config.probe_policy, op=f"n:{TENANT}:b"))]
+        assert sched_a != sched_b
+
+    def test_obs_counters(self):
+        obs.reset()
+        obs.enable()
+        try:
+            fw = self._firewall(_FakeClock(), error_threshold=1)
+            fw.record_error(TENANT, "c")
+            with pytest.raises(CircuitOpenError):
+                fw.admit(TENANT, "c")
+            assert obs.get_counter("serve.circuit_open", tenant=TENANT) == 1
+            assert obs.get_counter("serve.circuit_drops", tenant=TENANT) == 1
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+
+# ----------------------------------------------------------------------
+# Quarantine firewall through the aggregator
+# ----------------------------------------------------------------------
+
+
+class TestQuarantine:
+    def _poisoned_bytes(self, client_id: str, watermark=(0, 0)) -> bytes:
+        # the threat model is a BUGGY client whose folded state is NaN —
+        # update()'s own nan_strategy guards cannot see that, so poison the
+        # state directly (what a client-side 0/0 would leave behind)
+        coll = factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        coll["seen"].value = jnp.asarray(float("nan"))
+        return encode_state(coll, tenant=TENANT, client_id=client_id, watermark=watermark)
+
+    def test_poisoned_snapshot_quarantines_without_staling_the_tenant(self, recwarn):
+        obs.reset()
+        obs.enable()
+        try:
+            agg = Aggregator("fw", resilience=ResilienceConfig())
+            agg.register_tenant(TENANT, factory)
+            agg.ingest(snapshot("healthy", (0, 0), seed=1))
+            agg.ingest(self._poisoned_bytes("poisoner"))
+            agg.flush()
+            # the healthy client's data folded; the poisoned snapshot did not
+            q = agg.query(TENANT)
+            assert q["clients"] == 1
+            assert q["values"]["seen"]["value"] == 64.0
+            assert not np.isnan(q["values"]["seen"]["value"])
+            assert obs.get_counter("serve.quarantined", tenant=TENANT) == 1
+            assert obs.get_counter("serve.poisoned", tenant=TENANT) == 1
+            assert any("QUARANTINED" in str(w.message) for w in recwarn.list)
+            # further ingests from the quarantined client are refused cheaply
+            with pytest.raises(QuarantinedClientError, match="quarantined"):
+                agg.ingest(snapshot("poisoner", (0, 1), seed=2))
+            assert obs.get_counter("serve.quarantine_drops", tenant=TENANT) == 1
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_quarantine_keeps_the_clients_prior_healthy_state(self):
+        """Quarantine refuses the POISONED snapshot and future ingests; the
+        client's previously-accepted healthy snapshot keeps folding (it was
+        validated when accepted — dropping it would lose good data)."""
+        agg = Aggregator("fw", resilience=ResilienceConfig())
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(snapshot("c", (0, 0), seed=3))
+        agg.flush()
+        before = agg.query(TENANT)["values"]["seen"]["value"]
+        # a FRESH watermark, so the poison reaches the firewall rather than
+        # the duplicate-dedup drop
+        agg.ingest(self._poisoned_bytes("c", watermark=(0, 1)))
+        agg.flush()
+        after = agg.query(TENANT)
+        assert after["values"]["seen"]["value"] == before
+        assert after["clients"] == 1
+
+    def test_unquarantine_readmits(self):
+        agg = Aggregator("fw", resilience=ResilienceConfig())
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(self._poisoned_bytes("c"))
+        agg.flush()
+        with pytest.raises(QuarantinedClientError):
+            agg.ingest(snapshot("c", (0, 1), seed=4))
+        assert agg.firewall.unquarantine(TENANT, "c") is True
+        assert agg.firewall.unquarantine(TENANT, "c") is False  # idempotent
+        agg.ingest(snapshot("c", (0, 1), seed=4))
+        agg.flush()
+        assert agg.query(TENANT)["values"]["seen"]["value"] == 64.0
+
+    def test_without_resilience_nothing_changes(self):
+        """The firewall is opt-in: an unarmed aggregator accepts the same
+        payloads it always did (poison included — the pre-existing
+        behavior), pays no peek, and has no firewall object."""
+        agg = Aggregator("plain")
+        agg.register_tenant(TENANT, factory)
+        assert agg.firewall is None
+        agg.ingest(self._poisoned_bytes("c"))
+        agg.flush()
+        assert agg._tenant(TENANT).clients  # accepted, as before this PR
+
+
+# ----------------------------------------------------------------------
+# Corrupt-wire attribution and breaker integration
+# ----------------------------------------------------------------------
+
+
+class TestCorruptWireStrikes:
+    def test_corrupt_payloads_open_the_circuit(self):
+        import random
+
+        agg = Aggregator("fw", resilience=ResilienceConfig(error_threshold=2))
+        agg.register_tenant(TENANT, factory)
+        rng = random.Random(0)
+        for i in range(2):
+            bad = faults.corrupt_payload(snapshot("flaky", (0, i), seed=i), rng)
+            with pytest.raises(WireFormatError):
+                agg.ingest(bad)
+        # attribution came from the intact header; the circuit is now open
+        with pytest.raises(CircuitOpenError):
+            agg.ingest(snapshot("flaky", (0, 9), seed=9))
+
+    def test_clean_payload_resets_the_streak(self):
+        import random
+
+        agg = Aggregator("fw", resilience=ResilienceConfig(error_threshold=2))
+        agg.register_tenant(TENANT, factory)
+        rng = random.Random(0)
+        with pytest.raises(WireFormatError):
+            agg.ingest(faults.corrupt_payload(snapshot("c", (0, 0)), rng))
+        agg.ingest(snapshot("c", (0, 1), seed=1))
+        agg.flush()  # accept validates → record_ok
+        with pytest.raises(WireFormatError):
+            agg.ingest(faults.corrupt_payload(snapshot("c", (0, 2), seed=2), rng))
+        # 1 < threshold after the reset: still admitted
+        agg.ingest(snapshot("c", (0, 3), seed=3))
+
+
+# ----------------------------------------------------------------------
+# Load shedding
+# ----------------------------------------------------------------------
+
+
+class TestLoadShedding:
+    def test_duplicate_watermarks_shed_under_pressure(self):
+        obs.reset()
+        obs.enable()
+        try:
+            agg = Aggregator(
+                "shed", max_queue=4, resilience=ResilienceConfig(shed_watermark=0.5)
+            )
+            agg.register_tenant(TENANT, factory)
+            assert agg.ingest(snapshot("c", (0, 0))) is True
+            agg.flush()  # c's watermark is now (0, 0)
+            # refill the queue past the 50% watermark
+            assert agg.ingest(snapshot("other-a", (0, 0), seed=1)) is True
+            assert agg.ingest(snapshot("other-b", (0, 0), seed=2)) is True
+            # a duplicate of c's watermark is shed at the door...
+            assert agg.ingest(snapshot("c", (0, 0))) is False
+            assert obs.get_counter("serve.shed", tenant=TENANT, reason="duplicate_watermark") == 1
+            # ...but a FRESH watermark still gets a slot
+            assert agg.ingest(snapshot("c", (0, 1), seed=3)) is True
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_no_shedding_below_watermark(self):
+        agg = Aggregator("calm", max_queue=100, resilience=ResilienceConfig())
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(snapshot("c", (0, 0)))
+        agg.flush()
+        # same watermark again, queue nearly empty: enqueued (fold-time
+        # dedup handles it; shedding is a pressure valve, not a dedup)
+        assert agg.ingest(snapshot("c", (0, 0))) is True
+
+    def test_watermark_one_is_the_documented_off_switch(self):
+        """shed_watermark=1.0 disables shedding per the config contract —
+        even a FULL queue must not silently shed a duplicate (qsize ==
+        1.0 * maxsize satisfied the old guard and shed anyway); it takes
+        the normal backpressure path instead."""
+        agg = Aggregator(
+            "off", max_queue=2, resilience=ResilienceConfig(shed_watermark=1.0)
+        )
+        agg.register_tenant(TENANT, factory)
+        assert agg.ingest(snapshot("c", (0, 0))) is True
+        agg.flush()  # c's watermark recorded; queue empty again
+        assert agg.ingest(snapshot("other-a", (0, 0), seed=1)) is True
+        assert agg.ingest(snapshot("other-b", (0, 0), seed=2)) is True
+        # queue is FULL and this duplicates c's watermark: with shedding
+        # disabled it must surface as backpressure, not a silent False
+        with pytest.raises(BackpressureError):
+            agg.ingest(snapshot("c", (0, 0)), block=False)
+
+
+# ----------------------------------------------------------------------
+# Supervisor: heartbeats, kill, heal
+# ----------------------------------------------------------------------
+
+
+def _tree(tmp_path=None, fan_out=(2,), heartbeat=5.0):
+    tree = AggregationTree(
+        fan_out=fan_out,
+        tenants={TENANT: factory},
+        checkpoint_root=None if tmp_path is None else str(tmp_path / "root-ckpt"),
+    )
+    return tree, Supervisor(tree, heartbeat_timeout_s=heartbeat, warn=False)
+
+
+class TestSupervisor:
+    def test_healthy_tree_reports_healthy(self):
+        tree, sup = _tree()
+        report = sup.check()
+        assert report["healthy"] and report["findings"] == []
+        assert sup.heal() == []
+
+    def test_dead_worker_detected_and_restarted_in_place(self):
+        tree, sup = _tree()
+        leaf = tree.leaves[0].aggregator
+        leaf.start()
+        # kill the worker thread the hard way: a BaseException the loop's
+        # per-flush Exception guard does not swallow
+        original_flush = leaf.flush
+        leaf.flush = lambda: (_ for _ in ()).throw(SystemExit)
+        deadline = time.monotonic() + 5.0
+        while leaf.worker_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        leaf.flush = original_flush
+        assert leaf.worker_alive() is False
+        report = sup.check()
+        assert [f["kind"] for f in report["findings"]] == ["dead_worker"]
+        actions = sup.heal()
+        assert actions == [{"action": "restart_worker", "node": leaf.name}]
+        assert leaf.worker_alive() is True
+        leaf.stop()
+
+    def test_hard_killed_node_detected_rebuilt_and_resumes_ship_seq(self, tmp_path):
+        rng = np.random.default_rng(0)
+        tree, sup = _tree(tmp_path)
+        leaf = tree.leaves[0]
+        # some traffic so the parent records a watermark for the leaf
+        for i in range(3):
+            leaf.aggregator.ingest(
+                encode_state(fill(factory(), rng), tenant=TENANT, client_id="c", watermark=(0, i))
+            )
+            tree.pump()
+        root_wm = tree.root.aggregator.client_watermark(TENANT, f"node:{leaf.name}")
+        assert root_wm is not None and root_wm[1] >= 2
+
+        faults.kill_node(leaf)
+        assert leaf.is_dead
+        with pytest.raises(NodeDownError):
+            leaf.aggregator.flush()
+        report = sup.check()
+        assert "dead_node" in [f["kind"] for f in report["findings"]]
+
+        actions = sup.heal()
+        assert {"action": "rebuild_node", "node": leaf.name, "restored": False} in actions
+        assert not leaf.is_dead
+        # the healed node's FIRST ship must clear the parent's recorded
+        # watermark — a sequence restarted at 0 would stale the subtree
+        leaf.aggregator.ingest(
+            encode_state(fill(factory(), rng), tenant=TENANT, client_id="c", watermark=(1, 0))
+        )
+        tree.pump()
+        new_wm = tree.root.aggregator.client_watermark(TENANT, f"node:{leaf.name}")
+        assert new_wm is not None and new_wm[1] > root_wm[1]
+
+    def test_heal_restarts_the_flush_worker_of_a_killed_started_node(self):
+        """A node running a background flush worker when hard-killed must
+        come back DRAINING: revive() without a start() would rebuild an
+        aggregator nobody flushes — blocking producers park, the queue
+        fills, and the silent freeze returns via the repair path itself."""
+        tree, sup = _tree()
+        leaf = tree.leaves[0]
+        leaf.aggregator.start()
+        assert leaf.aggregator.worker_alive() is True
+        faults.kill_node(leaf)
+        sup.heal()
+        assert not leaf.is_dead
+        try:
+            assert leaf.aggregator.worker_alive() is True
+        finally:
+            leaf.aggregator.stop()
+        # a node killed WITHOUT a worker heals back into manual-flush mode
+        leaf2 = tree.leaves[1]
+        assert leaf2.aggregator.worker_alive() is None
+        faults.kill_node(leaf2)
+        sup.heal()
+        assert not leaf2.is_dead and leaf2.aggregator.worker_alive() is None
+
+    def test_killed_root_restores_bitwise_from_checkpoint(self, tmp_path):
+        rng = np.random.default_rng(1)
+        tree, sup = _tree(tmp_path)
+        blobs = [
+            encode_state(fill(factory(), rng), tenant=TENANT, client_id=f"c{i}", watermark=(0, 0))
+            for i in range(4)
+        ]
+        for i, blob in enumerate(blobs):
+            tree.leaf_for(i).ingest(blob)
+        tree.pump(rounds=2)
+        tree.save()
+        root_tenant = tree.root.aggregator._tenant(TENANT)
+        if root_tenant.merged_leaves is None:
+            root_tenant.fold()
+        before = [np.asarray(x).copy() for x in root_tenant.merged_leaves]
+
+        faults.kill_node(tree.root)
+        assert sup.check()["healthy"] is False
+        actions = sup.heal()
+        assert {"action": "rebuild_node", "node": "root", "restored": True} in actions
+        restored_tenant = tree.root.aggregator._tenant(TENANT)
+        restored_tenant.fold()
+        for a, b in zip(before, restored_tenant.merged_leaves):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # and children keep shipping into the restored root (their ships
+        # must clear the RESTORED watermarks — the resume contract again)
+        tree.pump(rounds=2)
+        assert sup.check()["healthy"] is True
+
+    def test_partitioned_child_shows_as_stale_then_heals(self):
+        rng = np.random.default_rng(2)
+        tree, sup = _tree(heartbeat=0.05)
+        leaf = tree.leaves[0]
+        other = tree.leaves[1]
+        blob = encode_state(fill(factory(), rng), tenant=TENANT, client_id="c", watermark=(0, 0))
+        leaf.aggregator.ingest(blob)
+        tree.pump()
+        with faults.partition(leaf):
+            time.sleep(0.1)
+            tree.pump()  # leaf's ship is dropped; other children refresh
+            report = sup.check()
+            stale = [f for f in report["findings"] if f["kind"] == "stale_child"]
+            assert any(f"node:{leaf.name}" in f["detail"] for f in stale)
+        # healed: the next cumulative ship repairs the parent's view
+        leaf.aggregator.ingest(
+            encode_state(fill(factory(), rng), tenant=TENANT, client_id="c", watermark=(0, 1))
+        )
+        tree.pump()
+        report = sup.check()
+        assert not [f for f in report["findings"] if f["kind"] == "stale_child"]
+        assert other.parent_reachable()
+
+    def test_forward_survives_dead_parent(self):
+        tree, sup = _tree()
+        mid_parent = tree.leaves[0].parent
+        faults.kill_node(mid_parent)
+        # pump must not raise: the leaf's ship drop is counted, not fatal
+        tree.pump()
+        report = sup.check()
+        kinds = {f["kind"] for f in report["findings"]}
+        assert "dead_node" in kinds and "parent_unreachable" in kinds
+        sup.heal()
+        assert sup.check()["healthy"] is True or "stale_child" in {
+            f["kind"] for f in sup.check()["findings"]
+        }
+
+    def test_health_alert_counters(self):
+        obs.reset()
+        obs.enable()
+        try:
+            tree, sup = _tree()
+            faults.kill_node(tree.leaves[0])
+            sup.check()
+            assert obs.get_counter("health.checks", monitor="supervisor") == 1
+            assert obs.get_counter("health.alerts", monitor="supervisor", kind="dead_node") == 1
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_validation(self):
+        tree, _ = _tree()
+        with pytest.raises(ValueError, match="heartbeat_timeout_s"):
+            Supervisor(tree, heartbeat_timeout_s=0)
+        with pytest.raises(ValueError, match="flush_hang_s"):
+            Supervisor(tree, flush_hang_s=-1)
+
+
+class TestLivenessAccessors:
+    def test_worker_alive_states(self):
+        agg = Aggregator("w")
+        assert agg.worker_alive() is None  # never started
+        agg.start()
+        assert agg.worker_alive() is True
+        agg.stop()
+        assert agg.worker_alive() is None  # stopped by design, not dead
+
+    def test_last_flush_age(self):
+        agg = Aggregator("w")
+        assert agg.last_flush_age_s() is None
+        agg.flush()
+        age = agg.last_flush_age_s()
+        assert age is not None and 0 <= age < 5.0
+
+    def test_client_ages_track_accepts(self):
+        agg = Aggregator("w")
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(snapshot("c", (0, 0)))
+        agg.flush()
+        ages = agg.client_ages()
+        assert set(ages) == {"c"} and ages["c"] < 5.0

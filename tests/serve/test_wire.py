@@ -211,6 +211,107 @@ class TestVersionEvolution:
         assert any("only in" in d for d in schema_diff(schema_of(a), schema_of(b)))
 
 
+def _map_header(data: bytes, fn) -> bytes:
+    """Rebuild payload bytes with ``fn(header_dict)`` applied (same body)."""
+    magic, major, minor, header_len = _PREAMBLE.unpack_from(data)
+    header = json.loads(data[_PREAMBLE.size : _PREAMBLE.size + header_len].decode())
+    body = data[_PREAMBLE.size + header_len :]
+    fn(header)
+    raw = json.dumps(header, sort_keys=True).encode()
+    return _PREAMBLE.pack(magic, major, minor, len(raw)) + raw + body
+
+
+class TestChecksumFirewall:
+    """The minor-1 integrity contract: crc32 per leaf, verified when
+    present, absent-means-unchecked (minor-0 senders), corruption refused
+    loudly naming the exact leaf path."""
+
+    def test_minor1_payloads_carry_per_leaf_crc(self):
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        assert WIRE_MINOR >= 1
+        hdr = json.loads(blob[_PREAMBLE.size : _PREAMBLE.size + _PREAMBLE.unpack_from(blob)[3]].decode())
+        assert hdr["leaves"] and all("crc32" in e for e in hdr["leaves"])
+
+    def test_minor0_payload_without_crc_still_decodes(self):
+        """An OLD (minor-0) encoder emits no crc32 entries: the new decoder
+        must accept the payload unchecked — minors add, never require."""
+        coll = _filled()
+        blob = encode_state(coll, tenant="t", client_id="c0", watermark=(2, 9))
+        old = _map_header(blob, lambda h: [e.pop("crc32") for e in h["leaves"]])
+        old = _reframe(old, minor=0)
+        payload = decode_state(old)
+        assert payload.wire_version == (WIRE_MAJOR, 0)
+        clone = _collection()
+        apply_payload(clone, payload)
+        assert np.array_equal(
+            np.asarray(clone.compute()["auroc"]), np.asarray(coll.compute()["auroc"])
+        )
+
+    def test_checksum_bearing_header_decodes_under_ignore_unknown_rule(self):
+        """The forward-compat half of the satellite: an old decoder sees
+        crc32 as just another unknown leaf-entry key. Pin the rule it relies
+        on — unknown entry keys (and future sibling keys) are ignored, so a
+        checksum-bearing header round-trips on builds that predate it."""
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        future = _map_header(
+            blob,
+            lambda h: [e.update({"blake3": "someday", "codec": None}) for e in h["leaves"]],
+        )
+        payload = decode_state(future)
+        assert set(payload.states) == {"auroc", "quantile", "seen", "peak"}
+
+    def test_corrupted_leaf_refused_loudly_naming_the_path(self):
+        """A single flipped bit in a leaf's extent must raise WireFormatError
+        naming that leaf's member/path — never decode into a lying state."""
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        header_len = _PREAMBLE.unpack_from(blob)[3]
+        hdr = json.loads(blob[_PREAMBLE.size : _PREAMBLE.size + header_len].decode())
+        victim = hdr["leaves"][len(hdr["leaves"]) // 2]
+        body_start = _PREAMBLE.size + header_len
+        flip_at = body_start + victim["offset"] + victim["nbytes"] // 2
+        corrupt = bytearray(blob)
+        corrupt[flip_at] ^= 0x40
+        with pytest.raises(WireFormatError, match="crc32") as err:
+            decode_state(bytes(corrupt))
+        msg = str(err.value)
+        assert victim["member"] in msg and "/".join(victim["path"]) in msg
+        assert "refusing" in msg
+
+    def test_truncation_checked_before_crc(self):
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        with pytest.raises(WireFormatError, match="truncated"):
+            decode_state(blob[:-3])
+
+
+class TestPeekHeader:
+    def test_peek_matches_decode_identity(self):
+        from metrics_tpu.serve.wire import peek_header
+
+        blob = encode_state(_filled(), tenant="ten", client_id="cli", watermark=(4, 2))
+        version, header = peek_header(blob)
+        payload = decode_state(blob)
+        assert version == payload.wire_version
+        assert header["tenant"] == payload.tenant == "ten"
+        assert header["client"] == payload.client_id == "cli"
+        assert tuple(header["watermark"]) == payload.watermark == (4, 2)
+
+    def test_peek_shares_the_framing_refusals(self):
+        from metrics_tpu.serve.wire import peek_header
+
+        blob = encode_state(_filled(), tenant="t", client_id="c", watermark=(0, 0))
+        with pytest.raises(WireFormatError, match="magic"):
+            peek_header(b"NOPE" + blob[4:])
+        with pytest.raises(WireFormatError, match="major"):
+            peek_header(_reframe(blob, major=WIRE_MAJOR + 1))
+        with pytest.raises(WireFormatError, match="truncated"):
+            peek_header(blob[:6])
+        # but a corrupted BODY peeks fine — attribution is the whole point
+        corrupt = bytearray(blob)
+        corrupt[-1] ^= 0xFF
+        _, header = peek_header(bytes(corrupt))
+        assert header["client"] == "c"
+
+
 class TestDecodeSizeCap:
     def test_oversized_payload_refused_at_decode(self):
         """The bounded contract is enforced on BOTH ends: a hostile sender
