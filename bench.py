@@ -569,6 +569,13 @@ def bench_serve(n_clients: int = 1000) -> dict:
     - ``serve_ingest_p99_ms`` — p99 of the per-payload ingest latency
       (decode + validate + queue wait + dedup + snapshot store) from the
       ``serve.ingest_ms`` obs histogram.
+    - ``serve_e2e_freshness_ms`` — p99 end-to-end freshness: client encode
+      wall time -> state queryable at the ROOT after 3 hops, from the
+      per-hop trace context every armed payload carries
+      (``serve.e2e_freshness_ms{node=root}``).
+    - ``serve_hop_fold_p99_ms`` — p99 of the root's per-flush fold latency
+      (``serve.hop_fold_ms{node=root}``) — where a fleet-wide freshness
+      regression is usually hiding.
 
     Payload encoding happens outside the timed window (client-side cost);
     the rows measure the aggregation tier. The run folds the same
@@ -588,6 +595,8 @@ def bench_serve(n_clients: int = 1000) -> dict:
     return {
         "serve_ingest_merges_per_s": out["serve_ingest_merges_per_s"],
         "serve_ingest_p99_ms": out["serve_ingest_p99_ms"],
+        "serve_e2e_freshness_ms": out["serve_e2e_freshness_ms"],
+        "serve_hop_fold_p99_ms": out["serve_hop_fold_p99_ms"],
     }
 
 
@@ -1121,6 +1130,16 @@ def main(
             prior.get("serve_ingest_p99_ms", serve_rows["serve_ingest_p99_ms"]),
             baseline="best_prior_self",
         )
+        # fleet-observability rows (PR 10): end-to-end freshness at the
+        # root and the root's fold latency, both off the per-hop trace
+        # context — ms rows, lower is better, gated like any latency row
+        for row_name in ("serve_e2e_freshness_ms", "serve_hop_fold_p99_ms"):
+            emit(
+                row_name,
+                serve_rows[row_name],
+                prior.get(row_name, serve_rows[row_name]),
+                baseline="best_prior_self",
+            )
         degraded_rows = section(bench_serve_degraded)
         emit(
             "serve_ingest_degraded_merges_per_s",

@@ -37,10 +37,18 @@ uninstrumented build — pinned by ``tests/bases/test_obs.py``):
    (see :mod:`metrics_tpu.obs.health`).
 6. **Export** — :func:`snapshot` (plain dict), :func:`to_prometheus`
    (counters, gauges, and ``histogram`` families with
-   ``_bucket``/``_sum``/``_count``), :func:`to_json`; ``MetricLogger``
-   archives a snapshot per epoch, ``bench.py --json`` splits compile from
-   run time per row, and ``bench.py --compare OLD.json`` gates new rounds
-   against prior records (``benchmarks/compare.py``).
+   ``_bucket``/``_sum``/``_count``), :func:`to_json`,
+   :func:`to_chrome_trace` (host spans + serving-tier payload hops as
+   Perfetto-loadable JSON); ``MetricLogger`` archives a snapshot per
+   epoch, ``bench.py --json`` splits compile from run time per row, and
+   ``bench.py --compare OLD.json`` gates new rounds against prior records
+   (``benchmarks/compare.py``).
+7. **Federation** — snapshots carry node identity + capture time;
+   :func:`merge_snapshots` combines fleets (counters sum, gauges keep
+   per-node labels, histograms merge bucketwise-exact over the shared
+   :data:`HISTOGRAM_EDGES`), and the serving tree piggybacks per-node
+   snapshots upward so a root's ``/metrics`` renders the whole fleet
+   (:mod:`metrics_tpu.obs.federation`; see ``docs/observability.md`` §9).
 
 Quick start::
 
@@ -54,7 +62,20 @@ Quick start::
 See ``docs/observability.md`` for the full guide.
 """
 from metrics_tpu.obs import registry as _registry  # noqa: F401
-from metrics_tpu.obs.export import snapshot, to_json, to_prometheus
+from metrics_tpu.obs.export import (
+    merge_snapshots,
+    snapshot,
+    to_chrome_trace,
+    to_json,
+    to_prometheus,
+)
+from metrics_tpu.obs.federation import (
+    accept_snapshot,
+    federated_snapshot,
+    node_ages,
+    remote_snapshots,
+    wire_snapshots,
+)
 from metrics_tpu.obs.health import HealthMonitor
 from metrics_tpu.obs.profile import instrument, profile, record_cost_analysis, time_launch
 from metrics_tpu.obs.recompile import (
@@ -75,9 +96,14 @@ from metrics_tpu.obs.registry import (
     get_gauge,
     get_histogram,
     histograms,
+    hops,
     inc,
+    new_trace_id,
+    node_identity,
     observe,
+    record_hop,
     set_gauge,
+    set_node_identity,
     spans,
     sum_counter,
 )
@@ -87,42 +113,59 @@ __all__ = [
     "HISTOGRAM_EDGES",
     "HealthMonitor",
     "HistogramSnapshot",
+    "accept_snapshot",
     "compile_listener_installed",
     "configure",
     "counters",
     "enable",
     "enabled",
+    "federated_snapshot",
     "gauges",
     "get_counter",
     "get_gauge",
     "get_histogram",
     "histograms",
+    "hops",
     "inc",
     "install_compile_listener",
     "instrument",
+    "merge_snapshots",
+    "new_trace_id",
+    "node_ages",
+    "node_identity",
     "note_trace",
     "observe",
     "profile",
     "pytree_nbytes",
     "record_cost_analysis",
+    "record_hop",
+    "remote_snapshots",
     "reset",
     "set_gauge",
+    "set_node_identity",
     "snapshot",
     "spans",
     "sum_counter",
     "time_launch",
+    "to_chrome_trace",
     "to_json",
     "to_prometheus",
     "trace_span",
     "track_compiles",
+    "wire_snapshots",
 ]
 
 
 def reset() -> None:
-    """Clear all counters/gauges/spans and re-arm the one-shot storm warning
-    (the enabled flag and config survive — this separates measurement
-    windows, it doesn't disarm the layer)."""
+    """Clear all counters/gauges/spans/hop records, the federation table's
+    per-node snapshots, and re-arm the one-shot storm warning (the enabled
+    flag, config and node identity survive — this separates measurement
+    windows, it doesn't disarm the layer). Clearing the trace/federation
+    state here is what keeps back-to-back bench rounds and tests from
+    bleeding fleet state into each other."""
+    from metrics_tpu.obs import federation as _federation
     from metrics_tpu.obs import recompile as _recompile
 
     _registry.reset()
+    _federation.reset()
     _recompile.reset_storm_warnings()

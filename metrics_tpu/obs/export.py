@@ -25,11 +25,12 @@ corrupting neighbouring labels.
 """
 import json
 import re
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.obs import registry as _reg
 
-__all__ = ["snapshot", "to_json", "to_prometheus"]
+__all__ = ["merge_snapshots", "snapshot", "to_chrome_trace", "to_json", "to_prometheus"]
 
 _KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$", re.DOTALL)
 _NAME_SAFE = re.compile(r"[^a-zA-Z0-9_]")
@@ -46,6 +47,11 @@ def snapshot(spans: bool = True) -> Dict[str, Any]:
     """
     out = {
         "enabled": _reg.enabled(),
+        # federation identity + freshness: the per-node table in
+        # metrics_tpu.obs.federation keys on "node" and keep-latests on
+        # "captured_at" (wall clock — snapshots cross process boundaries)
+        "node": _reg.node_identity(),
+        "captured_at": time.time(),
         "counters": _reg.counters(),
         "gauges": _reg.gauges(),
         "histograms": _reg.histograms(),
@@ -54,9 +60,11 @@ def snapshot(spans: bool = True) -> Dict[str, Any]:
             for k in (
                 "recompile_warn_threshold",
                 "max_spans",
+                "max_hops",
                 "device_timing",
                 "cost_analysis",
                 "arrival_skew_probe",
+                "max_series_per_family",
             )
         },
     }
@@ -170,6 +178,224 @@ def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
             lines.append(f"# TYPE {base} histogram")
         _prom_histogram(key, snap["histograms"][key], lines)
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _merge_hist(into: Dict[str, Any], new: Dict[str, Any], key: str) -> Dict[str, Any]:
+    """Bucketwise-exact merge of two histogram dicts sharing the fixed
+    :data:`~metrics_tpu.obs.registry.HISTOGRAM_EDGES` — counts add per
+    bucket, ``sum``/``count`` add, ``min``/``max`` combine. Exact because
+    every histogram in the package uses the same static edges; a bucket
+    count mismatch means the snapshots came from incompatible builds and
+    is refused rather than guessed at."""
+    a, b = list(into.get("buckets") or []), list(new.get("buckets") or [])
+    if len(a) != len(b):
+        raise ValueError(
+            f"histogram {key!r}: bucket counts differ ({len(a)} vs {len(b)}) —"
+            " snapshots were built against different HISTOGRAM_EDGES"
+        )
+    x, y = _reg.HistogramSnapshot.from_dict(into), _reg.HistogramSnapshot.from_dict(new)
+    snap = _reg.HistogramSnapshot(
+        [i + j for i, j in zip(x.counts, y.counts)],
+        x.sum + y.sum,
+        x.count + y.count,
+        min((h.min for h in (x, y) if h.count), default=float("inf")),
+        max((h.max for h in (x, y) if h.count), default=float("-inf")),
+    )
+    return snap.to_dict()
+
+
+def merge_snapshots(*snaps: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge obs snapshots from different nodes into one fleet view.
+
+    The algebra (commutative and associative over distinct-node inputs,
+    pinned by ``tests/bases/test_obs_federation.py``):
+
+    * **counters** sum on identical series keys — fleet totals
+      (per-node attribution stays available in the federation table's
+      per-node snapshots, and in series that already carry ``node=``
+      labels at the source, like ``serve.hop_*_ms{node=}``).
+    * **gauges** keep per-node labels: a gauge without a ``node=`` label is
+      tagged with its source snapshot's node identity (last-value semantics
+      do not sum — ``serve.tenants`` from two nodes must stay two series);
+      one already labeled (``serve.queue_depth{node=}``) passes through —
+      aggregator node names are fleet-unique by the tree's client-identity
+      contract.
+    * **histograms** merge bucketwise — EXACT because
+      :data:`~metrics_tpu.obs.registry.HISTOGRAM_EDGES` is shared by every
+      histogram, so fleet percentiles are computed from true fleet bucket
+      counts, not averaged per-node percentiles.
+
+    Multiple snapshots carrying the SAME node identity are deduplicated to
+    the newest ``captured_at`` first (snapshots are cumulative, so
+    keep-latest is exact — summing two generations of one node would
+    double-count). A plain snapshot that is NEWER than its node's
+    contribution already summed inside a federated input cannot be excised
+    exactly and is refused with ``ValueError`` — merge from per-node
+    originals instead (the federation table always does).
+
+    Returns a snapshot-shaped dict with ``federated: True`` and a
+    ``nodes: {identity: captured_at}`` roster; :func:`to_prometheus` /
+    :func:`to_json` render it unchanged.
+    """
+    plain: Dict[str, Dict[str, Any]] = {}
+    federated: List[Dict[str, Any]] = []
+    for snap in snaps:
+        if snap.get("federated"):
+            federated.append(snap)
+            continue
+        node = str(snap.get("node", ""))
+        held = plain.get(node)
+        if held is None or _snap_order(snap) > _snap_order(held):
+            plain[node] = snap
+    fed_rosters: Dict[str, float] = {}
+    for fed in federated:
+        for node in fed.get("nodes") or {}:
+            if node in fed_rosters:
+                # two federated inputs both already SUMMED this node's
+                # counters; neither contribution can be excised, so a
+                # silent merge would double-count — refuse, same as the
+                # plain-vs-federated conflict below
+                raise ValueError(
+                    f"cannot merge: node {node!r} appears inside two already-"
+                    "federated inputs — its counters would double-count."
+                    " Merge from per-node originals (metrics_tpu.obs.federation"
+                    " does)."
+                )
+            fed_rosters[node] = 1.0
+    for fed in federated:
+        for node, captured in (fed.get("nodes") or {}).items():
+            held = plain.get(node)
+            if held is None:
+                continue
+            if float(held.get("captured_at", 0.0)) > float(captured):
+                raise ValueError(
+                    f"cannot merge: node {node!r} has a newer standalone snapshot"
+                    " than its contribution inside an already-federated input —"
+                    " its old counters cannot be excised exactly. Merge from"
+                    " per-node originals (metrics_tpu.obs.federation does)."
+                )
+            del plain[node]
+
+    ordered = federated + [plain[k] for k in sorted(plain)]
+    ordered.sort(key=_snap_order)
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, Dict[str, Any]] = {}
+    nodes: Dict[str, float] = {}
+    enabled = False
+    for snap in ordered:
+        enabled = enabled or bool(snap.get("enabled"))
+        if snap.get("federated"):
+            nodes.update(snap.get("nodes") or {})
+        else:
+            nodes[str(snap.get("node", ""))] = float(snap.get("captured_at", 0.0))
+        for key, value in (snap.get("counters") or {}).items():
+            counters[key] = counters.get(key, 0.0) + float(value)
+        identity = None if snap.get("federated") else str(snap.get("node", ""))
+        for key, value in (snap.get("gauges") or {}).items():
+            gauges[_tag_node(key, identity)] = float(value)
+        for key, hist in (snap.get("histograms") or {}).items():
+            held = histograms.get(key)
+            histograms[key] = _merge_hist(held, hist, key) if held is not None else _hist_dict(hist)
+    return {
+        "federated": True,
+        "enabled": enabled,
+        "nodes": nodes,
+        "captured_at": max(nodes.values(), default=0.0),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+    }
+
+
+def _snap_order(snap: Dict[str, Any]) -> Tuple[float, str]:
+    """Deterministic, argument-order-independent processing order for the
+    merge: by capture time, ties broken by node identity — so last-writer-
+    wins gauge collisions resolve the same way however the call was
+    parenthesized or ordered."""
+    return (float(snap.get("captured_at", 0.0)), str(snap.get("node", "")))
+
+
+def _tag_node(key: str, identity: Optional[str]) -> str:
+    """Add ``node=identity`` to a flat series key unless it already carries
+    a ``node=`` label (source-labeled serve series keep their fleet-unique
+    aggregator node names)."""
+    if identity is None:
+        return key
+    m = _KEY_RE.match(key)
+    labels = (m.group("labels") or "") if m else ""
+    if any(k == "node" for k, _ in _parse_labels(labels)):
+        return key
+    name = m.group("name") if m else key
+    pairs = _parse_labels(labels) + [("node", identity)]
+    inner = ",".join(f"{k}={_reg._fmt_label_value(v)}" for k, v in sorted(pairs))
+    return f"{name}{{{inner}}}"
+
+
+def _hist_dict(hist: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize a (possibly edge-stripped wire-compact) histogram dict to
+    the full :meth:`~metrics_tpu.obs.registry.HistogramSnapshot.to_dict`
+    shape, recomputing the headline percentiles."""
+    return _reg.HistogramSnapshot.from_dict(hist).to_dict()
+
+
+def to_chrome_trace(path: Optional[str] = None) -> str:
+    """Export the span log and hop ring as Chrome-trace JSON (the
+    ``traceEvents`` array format Perfetto / ``chrome://tracing`` load).
+
+    Two tracks: **host spans** (pid 1, one thread per nesting depth) and
+    **payload lifecycles** (pid 2, one thread per trace id, events named by
+    hop phase with the node in ``args``) — both on the wall clock, so a
+    payload's client-encode → leaf-fold → root-queryable path lines up
+    against the host work that produced it. Served by the root's
+    ``/trace`` debug route (:class:`metrics_tpu.serve.MetricsServer`).
+    """
+    events: List[Dict[str, Any]] = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": f"host spans ({_reg.node_identity()})"}},
+        {"ph": "M", "pid": 2, "name": "process_name", "args": {"name": "payload lifecycles"}},
+    ]
+    for span in _reg.spans():
+        dur_us = max(0.0, span["wall_ms"] * 1000.0)
+        events.append(
+            {
+                "name": span["name"],
+                "cat": span.get("category") or "host",
+                "ph": "X",
+                "pid": 1,
+                "tid": int(span.get("depth", 0)) + 1,
+                "ts": (span["t"] - span["wall_ms"] / 1000.0) * 1e6,
+                "dur": dur_us,
+                "args": {"depth": span.get("depth", 0)},
+            }
+        )
+    tids: Dict[str, int] = {}
+    for hop in _reg.hops():
+        tid = tids.get(hop["trace"])
+        if tid is None:
+            tid = tids[hop["trace"]] = len(tids) + 1
+            events.append(
+                {"ph": "M", "pid": 2, "tid": tid, "name": "thread_name",
+                 "args": {"name": f"trace {hop['trace']}"}}
+            )
+        dur_us = max(0.0, hop["dur_ms"] * 1000.0)
+        events.append(
+            {
+                "name": f"{hop['phase']}@{hop['node']}",
+                "cat": "hop",
+                "ph": "X",
+                "pid": 2,
+                "tid": tid,
+                "ts": (hop["ts"] - hop["dur_ms"] / 1000.0) * 1e6,
+                "dur": dur_us,
+                "args": {k: v for k, v in hop.items() if k not in ("ts", "dur_ms")},
+            }
+        )
+    text = json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+    return text
 
 
 def to_json(snap: Optional[Dict[str, Any]] = None, path: Optional[str] = None, indent: int = 2) -> str:

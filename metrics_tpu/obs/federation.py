@@ -1,0 +1,208 @@
+"""Obs-snapshot federation: the per-node table behind the fleet view.
+
+A serving tree spans processes, but each :mod:`metrics_tpu.obs` registry
+ends at its process boundary — the root's ``/metrics`` used to show the
+root's counters and nothing of the leaves where the latency actually
+lives. Federation closes that gap with the same design the serving tier
+already trusts end to end: **cumulative snapshots + keep-latest per
+identity**.
+
+* Every node's :func:`metrics_tpu.obs.snapshot` carries its process
+  ``node`` identity and a ``captured_at`` wall timestamp.
+* On each upward ship, a tree node piggybacks its current snapshot (plus
+  every remote snapshot it has already collected — so leaves' telemetry
+  transits intermediates) in the payload's forward-compatible ``meta``
+  side-channel (``meta["obs_nodes"]``, wire minor 2). Unarmed, nothing is
+  attached: zero wire bytes.
+* A receiving aggregator stores each snapshot in this process-global
+  table, keep-latest by ``captured_at`` per node identity. Snapshots are
+  cumulative (counters monotone), so keep-latest is exact — no delta
+  arithmetic, idempotent under duplicated or reordered delivery, exactly
+  the watermark argument ``docs/serving.md`` makes for metric state.
+* :func:`federated_snapshot` merges the local registry with every stored
+  remote through :func:`metrics_tpu.obs.export.merge_snapshots` (counters
+  sum, gauges keep per-node labels, histograms merge bucketwise-exact) —
+  the view the root's ``/metrics`` scrape and ``/healthz/ready`` render,
+  and the input :class:`~metrics_tpu.obs.health.HealthMonitor` fleet
+  conditions read.
+
+Snapshots from this process's own identity are ignored on accept (the
+live registry is always fresher), which is also what keeps the in-process
+:class:`~metrics_tpu.serve.tree.AggregationTree` emulation exact: all its
+nodes share one registry *and one identity*, so the piggyback loop never
+double-counts.
+
+:func:`metrics_tpu.obs.reset` clears the table along with the registry so
+back-to-back bench rounds and tests cannot bleed fleet state.
+"""
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from metrics_tpu.obs import export as _export
+from metrics_tpu.obs import registry as _reg
+
+__all__ = [
+    "accept_snapshot",
+    "federated_snapshot",
+    "node_ages",
+    "remote_count",
+    "remote_snapshots",
+    "reset",
+    "wire_snapshots",
+]
+
+_lock = threading.Lock()
+# node identity -> newest accepted snapshot (cumulative; keep-latest exact)
+_remote: Dict[str, Dict[str, Any]] = {}
+
+# hard cap on DISTINCT node identities the table will hold: snapshot
+# identities arrive in client-controlled payload meta, so without a cap a
+# hostile client minting a fresh identity per payload would grow this
+# process-global table (and every /metrics render) without bound — the
+# same cardinality class max_series_per_family guards in the registry.
+# Far above any real tree's node count; overflow counts
+# obs.federation_nodes_dropped so a genuinely huge fleet is visible.
+MAX_FEDERATION_NODES = 1024
+
+# reject captured_at stamps further in the future than this: keep-latest
+# can never evict a forged-future entry (every sane snapshot compares
+# older), so one hostile timestamp would pin a poisoned snapshot in the
+# table forever. Generous enough for real cross-host clock skew.
+MAX_FUTURE_SKEW_S = 3600.0
+
+
+def _valid_series(snap: Dict[str, Any]) -> bool:
+    """Shallow shape validation before a snapshot may enter the table.
+
+    One malformed entry (version-skewed histogram bucket layout, non-dict
+    or non-numeric series values) would otherwise be stored and make EVERY
+    later ``federated_snapshot()`` — and therefore every ``/metrics``
+    scrape and federated health check — raise until a process-wide reset:
+    the merge is exact precisely because it refuses to guess, so the
+    gatekeeping has to happen here, where the one bad sender can be
+    dropped without costing the fleet view."""
+    n_buckets = len(_reg.HISTOGRAM_EDGES) + 1
+    for family in ("counters", "gauges"):
+        for value in (snap.get(family) or {}).values():
+            if not isinstance(value, (int, float)):
+                return False
+    for hist in (snap.get("histograms") or {}).values():
+        if not isinstance(hist, dict):
+            return False
+        buckets = hist.get("buckets")
+        if not isinstance(buckets, list) or len(buckets) != n_buckets:
+            return False
+        if not all(isinstance(b, (int, float)) for b in buckets):
+            return False
+        if not isinstance(hist.get("sum", 0.0), (int, float)):
+            return False
+        if not isinstance(hist.get("count", 0), (int, float)):
+            return False
+    return True
+
+
+def accept_snapshot(snap: Dict[str, Any]) -> bool:
+    """Store one remote node snapshot, keep-latest by ``captured_at``.
+
+    Returns True when the table advanced (new node, or fresher capture).
+    Snapshots without a node identity, with malformed series (non-dict
+    maps, non-numeric values, a histogram whose bucket layout does not
+    match this build's :data:`HISTOGRAM_EDGES` — merging would raise on
+    every later render), with a ``captured_at`` forged further than
+    :data:`MAX_FUTURE_SKEW_S` into the future (keep-latest could never
+    evict it), from this process's own identity (the live registry is
+    always fresher), or older than what is already held are dropped —
+    at-least-once piggyback delivery reduces to a timestamp comparison,
+    the same way payload dedup reduces to a watermark comparison. New
+    identities past :data:`MAX_FEDERATION_NODES` are refused (counted
+    under ``obs.federation_nodes_dropped``).
+    """
+    if not isinstance(snap, dict):
+        return False
+    node = snap.get("node")
+    if not node or snap.get("federated"):
+        return False
+    for family in ("counters", "gauges", "histograms"):
+        if not isinstance(snap.get(family, {}), dict):
+            return False
+    if not _valid_series(snap):
+        return False
+    node = str(node)
+    if node == _reg.node_identity():
+        return False
+    try:
+        captured = float(snap.get("captured_at", 0.0))
+    except (TypeError, ValueError):
+        return False
+    if captured > time.time() + MAX_FUTURE_SKEW_S:
+        return False
+    with _lock:
+        held = _remote.get(node)
+        if held is None and len(_remote) >= MAX_FEDERATION_NODES:
+            _reg.inc("obs.federation_nodes_dropped")
+            return False
+        if held is not None and float(held.get("captured_at", 0.0)) >= captured:
+            return False
+        _remote[node] = snap
+    return True
+
+
+def remote_snapshots() -> Dict[str, Dict[str, Any]]:
+    """A copy of the per-node table (identity -> newest snapshot)."""
+    with _lock:
+        return dict(_remote)
+
+
+def remote_count() -> int:
+    """Number of remote nodes in the table — the cheap has-any-remotes
+    probe for hot paths (a scrape-rate full-table copy just to test
+    truthiness would be waste)."""
+    with _lock:
+        return len(_remote)
+
+
+def wire_snapshots() -> List[Dict[str, Any]]:
+    """What a tree node piggybacks on its next ship: its own compact local
+    snapshot plus every remote one it holds, so telemetry from the whole
+    subtree transits each hop. Histogram ``edges`` are stripped from the
+    local capture (they are the shared :data:`HISTOGRAM_EDGES` constant —
+    dead weight on the wire; :func:`merge_snapshots` re-derives them)."""
+    local = _export.snapshot(spans=False)
+    for hist in local["histograms"].values():
+        hist.pop("edges", None)
+    with _lock:
+        return [local] + list(_remote.values())
+
+
+def federated_snapshot() -> Dict[str, Any]:
+    """The fleet view: local registry merged with every stored remote
+    snapshot. With an empty table this is exactly the plain local
+    :func:`metrics_tpu.obs.snapshot` (no relabeling a single-process
+    deployment never asked for)."""
+    with _lock:
+        remotes = list(_remote.values())
+    if not remotes:
+        return _export.snapshot(spans=False)
+    return _export.merge_snapshots(_export.snapshot(spans=False), *remotes)
+
+
+def node_ages(now: Optional[float] = None) -> Dict[str, float]:
+    """Seconds since each federated node's snapshot was captured (the
+    local node reads 0.0) — the staleness signal the
+    :class:`~metrics_tpu.obs.health.HealthMonitor` ``stale_node``
+    condition and ``/healthz/ready`` fleet detail read. Wall-clock
+    cross-process, so severe clock skew shows up here rather than hiding."""
+    now = time.time() if now is None else float(now)
+    ages = {_reg.node_identity(): 0.0}
+    with _lock:
+        for node, snap in _remote.items():
+            ages[node] = max(0.0, now - float(snap.get("captured_at", 0.0)))
+    return ages
+
+
+def reset() -> None:
+    """Clear the per-node table (:func:`metrics_tpu.obs.reset` calls this
+    alongside the registry clear)."""
+    with _lock:
+        _remote.clear()

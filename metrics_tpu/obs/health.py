@@ -47,7 +47,22 @@ these are the registry-only verdicts):
   currently open: some client is being refused for repeated invalid
   payloads. Current state, not the cumulative open-transition counter: a
   circuit that probes back closed reads healthy again.
+
+**Fleet mode** (``federated=True``): every condition reads the FEDERATED
+view (:func:`metrics_tpu.obs.federated_snapshot` — the local registry
+merged with every remote node snapshot the serving tree piggybacked up)
+instead of local registry state, so the root's monitor sees a straggler
+leaf's skew gauge, the deepest queue anywhere in the tree, and recompile
+storms per node (the ``recompile_storm`` probe walks the per-node
+snapshots and names the worst node). One extra condition exists only
+there:
+
+* ``stale_node`` — some federated node's snapshot is older than
+  ``node_staleness_s``: a subtree stopped reporting (partitioned, hung or
+  dead) and its metrics are silently aging while the merged view still
+  renders them.
 """
+import threading
 from typing import Any, Dict, List, Optional
 
 from metrics_tpu.obs import registry as _reg
@@ -75,6 +90,13 @@ class HealthMonitor:
             (a ``serve.clients_quarantined`` gauge is currently nonzero).
         circuit_open: arm the serving-tier ``circuit_open`` condition
             (a ``serve.circuits_open`` gauge is currently nonzero).
+        federated: read every condition off the federated fleet view
+            (local registry merged with the piggybacked per-node
+            snapshots) instead of local registry state — the root-of-tree
+            monitor configuration.
+        node_staleness_s: arm the ``stale_node`` condition when some
+            federated node's snapshot is older than this many seconds
+            (``None`` disarms; implies reading the federation table).
         name: label on the ``health.*`` counter series.
         warn: emit a one-shot ``rank_zero_warn`` per condition kind.
 
@@ -95,6 +117,8 @@ class HealthMonitor:
         queue_depth_threshold: Optional[float] = None,
         quarantine: bool = False,
         circuit_open: bool = False,
+        federated: bool = False,
+        node_staleness_s: Optional[float] = None,
         name: str = "default",
         warn: bool = True,
     ) -> None:
@@ -106,9 +130,52 @@ class HealthMonitor:
         self.queue_depth_threshold = queue_depth_threshold
         self.quarantine = bool(quarantine)
         self.circuit_open = bool(circuit_open)
+        self.federated = bool(federated)
+        self.node_staleness_s = node_staleness_s
         self.name = str(name)
         self.warn = bool(warn)
         self._warned_kinds: set = set()
+        # per-check read surface: the live registry, or (federated) the
+        # merged fleet snapshot — set at the top of check() so every probe
+        # in one check reads ONE consistent view. check() holds _check_lock
+        # while the views are staged and probed: one monitor wired into
+        # both an HTTP health route and a supervisor loop must not have a
+        # concurrent check() swap the view mid-probe (checks are cheap, so
+        # serializing them costs nothing)
+        self._check_lock = threading.Lock()
+        self._counters_view: Optional[Dict[str, float]] = None
+        self._gauges_view: Optional[Dict[str, float]] = None
+        self._hists_view: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------
+    # read surface (local registry or federated fleet view)
+    # ------------------------------------------------------------------
+
+    def _counters(self) -> Dict[str, float]:
+        return self._counters_view if self._counters_view is not None else _reg.counters()
+
+    def _gauges(self) -> Dict[str, float]:
+        return self._gauges_view if self._gauges_view is not None else _reg.gauges()
+
+    def _gauge(self, name: str) -> Optional[float]:
+        if self._gauges_view is None:
+            return _reg.get_gauge(name)
+        # federated gauges carry node= labels; a point read becomes the
+        # worst (max) across the fleet's series of that family
+        series = self._gauge_series(name)
+        return max(series, default=None)
+
+    def _counter_sum(self, name: str) -> float:
+        if self._counters_view is None:
+            return _reg.sum_counter(name)
+        prefix = name + "{"
+        return sum(v for k, v in self._counters_view.items() if k == name or k.startswith(prefix))
+
+    def _histogram(self, name: str, **labels: Any):
+        if self._hists_view is None:
+            return _reg.get_histogram(name, **labels)
+        hist = self._hists_view.get(_reg._key(name, labels))
+        return None if hist is None else _reg.HistogramSnapshot.from_dict(hist)
 
     # ------------------------------------------------------------------
     # individual condition probes (each returns a detail string or None)
@@ -117,18 +184,22 @@ class HealthMonitor:
     def _check_straggler(self) -> Optional[str]:
         if self.skew_threshold_ms is None:
             return None
-        skew = _reg.get_gauge("sync.arrival_skew_ms")
+        skew = self._gauge("sync.arrival_skew_ms")
         if skew is not None and skew > self.skew_threshold_ms:
             return (
                 f"cross-host arrival skew {skew:.0f} ms > {self.skew_threshold_ms:.0f} ms —"
-                " this host reaches sync points far ahead of the slowest peer"
+                + (
+                    " some fleet node reaches sync points far ahead of its slowest peer"
+                    if self.federated
+                    else " this host reaches sync points far ahead of the slowest peer"
+                )
             )
         return None
 
     def _check_sync_latency(self) -> Optional[str]:
         if self.sync_p95_ms is None:
             return None
-        hist = _reg.get_histogram("sync.latency_ms", op="gather_all_tensors")
+        hist = self._histogram("sync.latency_ms", op="gather_all_tensors")
         if hist is not None and hist.count and hist.p95 > self.sync_p95_ms:
             return (
                 f"eager DCN gather p95 {hist.p95:.0f} ms > {self.sync_p95_ms:.0f} ms"
@@ -142,10 +213,34 @@ class HealthMonitor:
             threshold = _reg.get_config("recompile_warn_threshold")
         if not threshold:
             return None
+        if self.federated:
+            # PER-NODE: fleet counters are summed in the merged view, which
+            # would read 16 healthy nodes' one-trace steps as one storming
+            # step — walk the per-node snapshots (local + federation table)
+            # so the verdict names the node actually storming
+            from metrics_tpu.obs import federation as _fed
+
+            per_node = {_reg.node_identity(): {"counters": _reg.counters()}}
+            per_node.update(_fed.remote_snapshots())
+            worst_detail = None
+            storming_nodes = 0
+            for node in sorted(per_node):
+                detail = self._storm_in(per_node[node].get("counters") or {}, threshold)
+                if detail is not None:
+                    storming_nodes += 1
+                    if worst_detail is None:
+                        worst_detail = f"node {node}: {detail}"
+            if worst_detail:
+                return f"{storming_nodes} fleet node(s) storming — {worst_detail}"
+            return None
+        return self._storm_in(_reg.counters(), threshold)
+
+    @staticmethod
+    def _storm_in(counters: Dict[str, float], threshold: int) -> Optional[str]:
         prefix = "step.traces{"
         storming = {
             key[len(prefix):-1]: int(count)
-            for key, count in _reg.counters().items()
+            for key, count in counters.items()
             if key.startswith(prefix) and count >= threshold
         }
         if storming:
@@ -157,11 +252,32 @@ class HealthMonitor:
             )
         return None
 
+    def _check_stale_node(self) -> Optional[str]:
+        if self.node_staleness_s is None:
+            return None
+        from metrics_tpu.obs import federation as _fed
+
+        stale = {
+            node: age
+            for node, age in _fed.node_ages().items()
+            if age > self.node_staleness_s
+        }
+        if stale:
+            worst = max(stale, key=stale.get)
+            return (
+                f"{len(stale)} federated node(s) have not reported within"
+                f" {self.node_staleness_s:.0f}s (worst: {worst},"
+                f" {stale[worst]:.0f}s ago) — a partitioned/hung/dead subtree's"
+                " metrics are silently aging in the merged view"
+            )
+        return None
+
     def _check_clamp_risk(self) -> Optional[str]:
         if not self.clamp_risk:
             return None
-        clamps = _reg.get_counter("capacity_buffer.clamp_risk_appends")
-        overflows = _reg.get_counter("capacity_buffer.eager_overflows")
+        counters = self._counters()
+        clamps = counters.get("capacity_buffer.clamp_risk_appends", 0.0)
+        overflows = counters.get("capacity_buffer.eager_overflows", 0.0)
         if clamps or overflows:
             return (
                 f"capacity-buffer overflow pressure: {int(clamps)} clamp-risk traced"
@@ -174,7 +290,7 @@ class HealthMonitor:
     def _check_degraded_sync(self) -> Optional[str]:
         if not self.degraded_syncs:
             return None
-        degraded = _reg.sum_counter("ft.degraded_syncs")
+        degraded = self._counter_sum("ft.degraded_syncs")
         if degraded:
             return (
                 f"{int(degraded)} degraded sync(s): some host fell back to local-only"
@@ -183,16 +299,17 @@ class HealthMonitor:
             )
         return None
 
-    @staticmethod
-    def _gauge_series(name: str) -> List[float]:
+    def _gauge_series(self, name: str) -> List[float]:
         """Every current value of gauge ``name`` across its label series
         (one series per aggregator node in a serving tree — a single
         unlabeled read would be last-writer-wins and an idle node could
-        mask a saturated one)."""
+        mask a saturated one). In federated mode the series span the whole
+        fleet (remote gauges arrive node-labeled), so "deepest queue" is
+        the deepest queue ANYWHERE in the tree."""
         prefix = name + "{"
         return [
             value
-            for key, value in _reg.gauges().items()
+            for key, value in self._gauges().items()
             if key == name or key.startswith(prefix)
         ]
 
@@ -250,6 +367,7 @@ class HealthMonitor:
             ("straggler", self._check_straggler),
             ("sync_latency", self._check_sync_latency),
             ("recompile_storm", self._check_recompile_storm),
+            ("stale_node", self._check_stale_node),
             ("clamp_risk", self._check_clamp_risk),
             ("degraded_sync", self._check_degraded_sync),
             ("queue_saturation", self._check_queue_saturation),
@@ -257,10 +375,20 @@ class HealthMonitor:
             ("circuit_open", self._check_circuit_open),
         )
         warnings: List[Dict[str, str]] = []
-        for kind, probe in probes:
-            detail = probe()
-            if detail is not None:
-                warnings.append({"kind": kind, "detail": detail})
+        with self._check_lock:
+            if self.federated:
+                from metrics_tpu.obs import federation as _fed
+
+                snap = _fed.federated_snapshot()
+                self._counters_view = snap.get("counters", {})
+                self._gauges_view = snap.get("gauges", {})
+                self._hists_view = snap.get("histograms", {})
+            else:
+                self._counters_view = self._gauges_view = self._hists_view = None
+            for kind, probe in probes:
+                detail = probe()
+                if detail is not None:
+                    warnings.append({"kind": kind, "detail": detail})
         if _reg.enabled():
             _reg.inc("health.checks", monitor=self.name)
             for w in warnings:
@@ -297,6 +425,8 @@ class HealthMonitor:
                 ("queue_depth_threshold", self.queue_depth_threshold),
                 ("quarantine", self.quarantine or None),
                 ("circuit_open", self.circuit_open or None),
+                ("federated", self.federated or None),
+                ("node_staleness_s", self.node_staleness_s),
             )
             if v is not None
         }

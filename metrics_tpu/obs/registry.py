@@ -63,11 +63,16 @@ __all__ = [
     "get_gauge",
     "get_histogram",
     "histograms",
+    "hops",
     "inc",
+    "new_trace_id",
+    "node_identity",
     "observe",
+    "record_hop",
     "record_span",
     "reset",
     "set_gauge",
+    "set_node_identity",
     "spans",
     "sum_counter",
 ]
@@ -83,6 +88,17 @@ _histograms: Dict[str, Dict[str, Any]] = {}
 # the most recent activity (a keep-oldest cap would freeze the log on
 # run-start warmup forever); evictions are counted under obs.spans_dropped
 _spans: Deque[Dict[str, Any]] = deque(maxlen=4096)
+# per-hop payload lifecycle records from the serving tier (queue-wait /
+# fold / ship / e2e per trace id) — same ring semantics as the span log,
+# evictions counted under obs.hops_dropped. The unbounded accounting lives
+# in the serve.hop_*_ms histograms; this ring feeds the Chrome-trace export
+_hops: Deque[Dict[str, Any]] = deque(maxlen=4096)
+# distinct-series count per (store kind, metric family) — the label-
+# cardinality guard's O(1) read (see max_series_per_family below)
+_family_counts: Dict[Tuple[str, str], int] = {}
+# node identity stamped onto snapshots (obs federation keys its per-node
+# table on it); None = derive "<hostname>:<pid>" lazily
+_node_identity: Optional[str] = None
 
 _config: Dict[str, Any] = {
     # warn when one jitted step has been traced this many times (shape/dtype
@@ -107,6 +123,15 @@ _config: Dict[str, Any] = {
     # process, and an ad-hoc obs.enable() on one host must never be able
     # to deadlock the fleet's next sync.
     "arrival_skew_probe": False,
+    # label-cardinality guard: max distinct series per metric FAMILY per
+    # store kind (counter/gauge/histogram). A hostile or buggy label
+    # source (per-client ids, per-hop trace ids) must not grow the
+    # registry without bound; writes past the cap are dropped and counted
+    # under obs.series_dropped{family=}. None disables the guard.
+    "max_series_per_family": 4096,
+    # per-hop payload-lifecycle ring size (see record_hop); evictions
+    # increment obs.hops_dropped
+    "max_hops": 4096,
 }
 
 # thread-local nesting depth for the span recorder
@@ -129,18 +154,23 @@ def enabled() -> bool:
 
 def configure(**kwargs: Any) -> Dict[str, Any]:
     """Update config knobs (``recompile_warn_threshold``, ``max_spans``,
-    ``device_timing``, ``cost_analysis``, ``arrival_skew_probe``); returns
-    the previous values of the keys that changed."""
-    global _spans
+    ``max_hops``, ``device_timing``, ``cost_analysis``,
+    ``arrival_skew_probe``, ``max_series_per_family``); returns the
+    previous values of the keys that changed."""
+    global _spans, _hops
     previous = {}
     with _lock:
         for key, value in kwargs.items():
             if key not in _config:
                 raise ValueError(f"Unknown obs config key {key!r}; valid: {sorted(_config)}")
-            if key == "max_spans":
+            if key in ("max_spans", "max_hops"):
                 value = int(value)
                 if value < 1:
-                    raise ValueError(f"max_spans must be >= 1, got {value}")
+                    raise ValueError(f"{key} must be >= 1, got {value}")
+            if key == "max_series_per_family" and value is not None:
+                value = int(value)
+                if value < 1:
+                    raise ValueError(f"max_series_per_family must be >= 1 (or None), got {value}")
             previous[key] = _config[key]
             _config[key] = value
             if key == "max_spans":
@@ -151,11 +181,45 @@ def configure(**kwargs: Any) -> Dict[str, Any]:
                 if evicted > 0:
                     _counters["obs.spans_dropped"] = _counters.get("obs.spans_dropped", 0.0) + evicted
                 _spans = deque(_spans, maxlen=value)
+            if key == "max_hops":
+                evicted = len(_hops) - value
+                if evicted > 0:
+                    _counters["obs.hops_dropped"] = _counters.get("obs.hops_dropped", 0.0) + evicted
+                _hops = deque(_hops, maxlen=value)
     return previous
 
 
 def get_config(key: str) -> Any:
     return _config[key]
+
+
+def node_identity() -> str:
+    """This process's identity on obs snapshots — the key the federation
+    table (:mod:`metrics_tpu.obs.federation`) stores per-node snapshots
+    under. Defaults to ``<hostname>:<pid>``; override with
+    :func:`set_node_identity` (one identity per PROCESS: two aggregators in
+    one process share a registry and therefore one identity — that is what
+    keeps the in-process tree emulation from double-counting)."""
+    global _node_identity
+    if _node_identity is None:
+        import socket
+
+        _node_identity = f"{socket.gethostname()}:{os.getpid()}"
+    return _node_identity
+
+
+def set_node_identity(name: Optional[str]) -> Optional[str]:
+    """Set (or with ``None``, re-derive lazily) the snapshot node identity;
+    returns the previous explicit value."""
+    global _node_identity
+    previous = _node_identity
+    _node_identity = None if name is None else str(name)
+    return previous
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char trace id for wire payload provenance."""
+    return os.urandom(8).hex()
 
 
 _LABEL_UNSAFE = re.compile(r'[,={}"\\\n]')
@@ -193,17 +257,44 @@ def _key(name: str, labels: Dict[str, Any]) -> str:
     return f"{name}{{{inner}}}"
 
 
+def _admit_series(kind: str, store: Dict[str, Any], key: str, name: str) -> bool:
+    """Label-cardinality guard (call under ``_lock``): True when a write to
+    ``key`` may proceed. An existing series always may; a NEW series is
+    admitted while its family holds fewer than ``max_series_per_family``
+    distinct series, else the write is dropped and counted under
+    ``obs.series_dropped{family=}`` (written directly — the drop counter
+    itself must never be refused or recurse into the guard)."""
+    if key in store:
+        return True
+    cap = _config["max_series_per_family"]
+    if cap is None:
+        _family_counts[(kind, name)] = _family_counts.get((kind, name), 0) + 1
+        return True
+    count = _family_counts.get((kind, name), 0)
+    if count >= cap:
+        drop_key = _key("obs.series_dropped", {"family": name})
+        _counters[drop_key] = _counters.get(drop_key, 0.0) + 1.0
+        return False
+    _family_counts[(kind, name)] = count + 1
+    return True
+
+
 def inc(name: str, value: float = 1.0, **labels: Any) -> None:
     """Add ``value`` to counter ``name`` (labels become part of the series key)."""
     key = _key(name, labels)
     with _lock:
+        if not _admit_series("counter", _counters, key, name):
+            return
         _counters[key] = _counters.get(key, 0.0) + value
 
 
 def set_gauge(name: str, value: float, **labels: Any) -> None:
     """Set gauge ``name`` to its latest observed ``value``."""
+    key = _key(name, labels)
     with _lock:
-        _gauges[_key(name, labels)] = float(value)
+        if not _admit_series("gauge", _gauges, key, name):
+            return
+        _gauges[key] = float(value)
 
 
 def get_counter(name: str, **labels: Any) -> float:
@@ -279,6 +370,20 @@ class HistogramSnapshot:
     def mean(self) -> Optional[float]:
         return self.sum / self.count if self.count else None
 
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "HistogramSnapshot":
+        """Rebuild a snapshot from the :meth:`to_dict` shape (tolerating the
+        wire-compact form with ``edges`` stripped) — the ONE inverse every
+        consumer (federation merge, federated health reads) shares, so the
+        dict shape can never drift between hand-rolled copies."""
+        return cls(
+            list(data.get("buckets") or []),
+            float(data.get("sum", 0.0)),
+            int(data.get("count", 0)),
+            float(data.get("min", math.inf)),
+            float(data.get("max", -math.inf)),
+        )
+
     def to_dict(self) -> Dict[str, Any]:
         """Plain-dict form for :func:`metrics_tpu.obs.snapshot` / JSON: raw
         bucket counts plus the shared edges (self-describing) and the three
@@ -313,6 +418,8 @@ def observe(name: str, value: float, **labels: Any) -> None:
     key = _key(name, labels)
     idx = bisect_left(HISTOGRAM_EDGES, v)
     with _lock:
+        if not _admit_series("histogram", _histograms, key, name):
+            return
         h = _histograms.get(key)
         if h is None:
             h = _histograms[key] = {
@@ -360,16 +467,64 @@ def sum_counter(name: str) -> float:
         return sum(v for k, v in _counters.items() if k == name or k.startswith(prefix))
 
 
-def record_span(name: str, wall_ms: float, depth: int, category: Optional[str] = None) -> None:
+def record_span(
+    name: str,
+    wall_ms: float,
+    depth: int,
+    category: Optional[str] = None,
+    start_s: Optional[float] = None,
+) -> None:
     """Append one finished host-side span to the ring (evicting the oldest
-    when ``max_spans`` is reached, so the log always covers recent work)."""
-    span = {"name": name, "wall_ms": wall_ms, "depth": depth, "t": time.time()}
+    when ``max_spans`` is reached, so the log always covers recent work).
+
+    ``start_s`` is the span's start on the MONOTONIC clock
+    (``time.perf_counter()``); the stored span carries ``start_ms`` /
+    ``end_ms`` on that clock (span ordering/nesting survives wall-clock
+    steps) plus the wall-clock ``t`` at completion, which is what the
+    Chrome-trace export uses so host spans and cross-process payload hops
+    share one timeline (:func:`metrics_tpu.obs.export.to_chrome_trace`)."""
+    if start_s is None:
+        start_s = time.perf_counter() - wall_ms / 1000.0
+    span = {
+        "name": name,
+        "wall_ms": wall_ms,
+        "depth": depth,
+        "t": time.time(),
+        "start_ms": start_s * 1000.0,
+        "end_ms": start_s * 1000.0 + wall_ms,
+    }
     if category is not None:
         span["category"] = category
     with _lock:
         if len(_spans) == _spans.maxlen:
             _counters["obs.spans_dropped"] = _counters.get("obs.spans_dropped", 0.0) + 1.0
         _spans.append(span)
+
+
+def record_hop(trace_id: str, node: str, phase: str, dur_ms: float, **extra: Any) -> None:
+    """Append one per-hop payload-lifecycle record (``phase`` in
+    ``queue_wait`` / ``fold`` / ``ship`` / ``e2e``) to the hop ring.
+
+    ``ts`` (wall-clock seconds, stamped here at completion) is shared with
+    the trace context's ``encoded_at`` / ``accept_ts`` stamps, so a
+    payload's lifecycle renders as one coherent track per trace id in the
+    Chrome-trace export. The ring is capped (``max_hops``); the unbounded
+    accounting lives in the ``serve.hop_*_ms{node=}`` histograms."""
+    hop = {"trace": str(trace_id), "node": str(node), "phase": str(phase),
+           "dur_ms": float(dur_ms), "ts": time.time()}
+    if extra:
+        hop.update(extra)
+    with _lock:
+        if len(_hops) == _hops.maxlen:
+            _counters["obs.hops_dropped"] = _counters.get("obs.hops_dropped", 0.0) + 1.0
+        _hops.append(hop)
+
+
+def hops() -> List[Dict[str, Any]]:
+    """A copy of the per-hop payload-lifecycle ring (serving tier only —
+    empty unless payloads carried trace context through an aggregator)."""
+    with _lock:
+        return [dict(h) for h in _hops]
 
 
 def _span_depth() -> int:
@@ -406,11 +561,15 @@ def spans() -> List[Dict[str, Any]]:
 
 
 def reset() -> None:
-    """Clear all counters, gauges, histograms and spans (the enabled flag
-    and config survive — reset separates measurement windows, it doesn't
-    disarm)."""
+    """Clear all counters, gauges, histograms, spans, hop records and the
+    cardinality-guard bookkeeping (the enabled flag, config and node
+    identity survive — reset separates measurement windows, it doesn't
+    disarm). The federation table is cleared by :func:`metrics_tpu.obs.reset`,
+    which wraps this."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _histograms.clear()
         _spans.clear()
+        _hops.clear()
+        _family_counts.clear()

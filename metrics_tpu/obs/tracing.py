@@ -44,7 +44,7 @@ def _active_span(name: str, category: Optional[str]) -> Iterator[None]:
             yield
     finally:
         _reg._pop_span()
-        _reg.record_span(name, (time.perf_counter() - t0) * 1000.0, depth, category)
+        _reg.record_span(name, (time.perf_counter() - t0) * 1000.0, depth, category, start_s=t0)
 
 
 def trace_span(name: str, category: Optional[str] = None, annotate_always: bool = False):

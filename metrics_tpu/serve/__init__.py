@@ -20,9 +20,10 @@ primitives the library already proved:
   ``tests/serve/test_tree.py``).
 * :mod:`~metrics_tpu.serve.endpoints` — a stdlib ``http.server`` surface:
   ``/metrics`` Prometheus scrape (off :func:`metrics_tpu.obs.to_prometheus`
-  plus per-tenant value gauges), JSON ``/query`` with the streaming
-  metrics' rigorous ``error_bound()`` envelopes, ``/ingest`` and
-  ``/healthz``.
+  plus per-tenant value gauges; the fleet-federated view on roots holding
+  remote node snapshots), JSON ``/query`` with the streaming metrics'
+  rigorous ``error_bound()`` envelopes, ``/trace`` Chrome-trace export of
+  host spans + per-hop payload lifecycles, ``/ingest`` and ``/healthz``.
 * :mod:`~metrics_tpu.serve.loadgen` — the 1k-client / 3-level-tree load
   generator behind the ``serve_*`` bench rows (``fault_rate=`` runs it
   under a seeded chaos schedule for the degraded-throughput row).

@@ -53,6 +53,7 @@ from metrics_tpu.ft.journal import BatchJournal
 from metrics_tpu.obs.registry import enabled as _obs_enabled
 from metrics_tpu.obs.registry import inc as _obs_inc
 from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import record_hop as _obs_record_hop
 from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.serve.wire import (
     MetricPayload,
@@ -127,26 +128,41 @@ class _ClientSlot:
     """Latest accepted snapshot of one client: journal watermark + the
     spec-ordered state leaves (numpy, ready to stack). ``last_accept_s``
     (monotonic) is the implicit heartbeat supervision reads — for a tree
-    node's ``node:*`` clients, its age IS the child's ship-sequence age."""
+    node's ``node:*`` clients, its age IS the child's ship-sequence age.
 
-    __slots__ = ("journal", "leaves", "consensus", "last_accept_s")
+    ``trace`` is the payload's wire trace context (id, client encode
+    timestamp, upstream hop chain) extended with THIS node's accept
+    stamp/queue wait; ``trace_fresh`` marks it as not yet folded, so the
+    fold records each accepted payload's e2e freshness exactly once."""
+
+    __slots__ = ("journal", "leaves", "consensus", "last_accept_s", "trace", "trace_fresh")
 
     def __init__(self) -> None:
         self.journal = BatchJournal()
         self.leaves: List[np.ndarray] = []
         self.consensus: List[np.ndarray] = []
         self.last_accept_s = time.monotonic()
+        self.trace: Optional[Dict[str, Any]] = None
+        self.trace_fresh = False
 
 
 class _Tenant:
     """Registry entry: schema, leaf layout, client snapshots, merged view."""
 
-    def __init__(self, tenant_id: str, collection: Any) -> None:
+    def __init__(self, tenant_id: str, collection: Any, node: str = "?") -> None:
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.streaming.sketches import Sketch
         from metrics_tpu.utilities.checkpoint import metric_state_to_tree
 
         self.tenant_id = tenant_id
+        # hosting aggregator's name: the node= label on the per-hop
+        # provenance histograms this tenant's fold/accept path records
+        self.node = str(node)
+        # newest completed fold's latency + the oldest (stalest-encode)
+        # live trace context — what AggregatorNode.forward stamps into the
+        # upward payload's hop record so provenance follows the critical path
+        self.last_fold_ms: Optional[float] = None
+        self.oldest_trace: Optional[Dict[str, Any]] = None
         if not isinstance(collection, MetricCollection):
             collection = MetricCollection([collection])
         self.view = collection  # merged state materializes into this
@@ -250,6 +266,9 @@ class _Tenant:
         in one jitted launch; returns the number of snapshots folded."""
         from metrics_tpu.utilities.checkpoint import load_metric_state_tree
 
+        t_fold = time.perf_counter()
+        armed = _obs_enabled()
+        fresh_traces: List[Dict[str, Any]] = []
         with self.lock:
             order = sorted(self.clients)
             rows = [[self.clients[cid].leaves[i] for cid in order] for i in range(len(self.spec))]
@@ -258,6 +277,13 @@ class _Tenant:
                 for i in range(len(self.consensus_paths))
             ]
             self.dirty = False
+            if armed:
+                traced = [s.trace for s in self.clients.values() if s.trace is not None]
+                self.oldest_trace = min(traced, key=lambda t: t["encoded_at"], default=None)
+                for slot in self.clients.values():
+                    if slot.trace_fresh and slot.trace is not None:
+                        fresh_traces.append(slot.trace)
+                        slot.trace_fresh = False
         k = len(order)
         if k == 0:
             merged = list(self.template_leaves)
@@ -290,6 +316,23 @@ class _Tenant:
         with self.view_lock:
             self.merged_leaves = merged
             load_metric_state_tree(self.view, tree)
+        if armed:
+            # the accepted snapshots just became queryable AT THIS NODE:
+            # fold latency is one hop-provenance histogram sample, and each
+            # not-yet-folded trace contributes one end-to-end freshness
+            # sample (client encode wall time -> queryable here; the root's
+            # node= series is the fleet's headline freshness)
+            fold_ms = (time.perf_counter() - t_fold) * 1000.0
+            self.last_fold_ms = fold_ms
+            _obs_observe("serve.hop_fold_ms", fold_ms, node=self.node)
+            now = time.time()
+            for trace in fresh_traces:
+                _obs_observe(
+                    "serve.e2e_freshness_ms",
+                    max(0.0, (now - trace["encoded_at"]) * 1000.0),
+                    node=self.node,
+                )
+                _obs_record_hop(trace["id"], self.node, "fold", fold_ms)
         return k
 
     @property
@@ -420,7 +463,7 @@ class Aggregator:
         with self._registry_lock:
             if tenant_id in self._tenants:
                 raise ServeError(f"tenant {tenant_id!r} is already registered")
-            self._tenants[tenant_id] = _Tenant(tenant_id, collection)
+            self._tenants[tenant_id] = _Tenant(tenant_id, collection, node=self.name)
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
 
@@ -606,6 +649,19 @@ class Aggregator:
     def _accept(self, payload: MetricPayload, t0: float) -> bool:
         """Keep-latest dedup: returns True when the payload advanced its
         client's watermark (snapshot stored), False when dropped."""
+        if _obs_enabled():
+            # obs federation piggyback (wire minor 2): a shipping tree node
+            # attaches its per-node snapshot table; accept into this
+            # process's table BEFORE dedup — snapshots are keep-latest by
+            # capture time themselves, so even a watermark-stale payload
+            # may carry fresher telemetry
+            piggyback = payload.meta.get("obs_nodes")
+            if isinstance(piggyback, (list, tuple)):
+                from metrics_tpu.obs import federation as _federation
+
+                for snap in piggyback:
+                    if _federation.accept_snapshot(snap):
+                        _obs_inc("obs.federation_accepts", node=self.name)
         tenant = self._tenant(payload.tenant)
         epoch, step = int(payload.watermark[0]), int(payload.watermark[1])
         if epoch < 0 or step < 0:
@@ -652,6 +708,23 @@ class Aggregator:
             slot.leaves = leaves
             slot.consensus = consensus
             slot.last_accept_s = time.monotonic()
+            if _obs_enabled():
+                trace = payload.meta.get("trace")
+                if isinstance(trace, dict) and "id" in trace:
+                    # per-hop provenance: extend the wire trace context with
+                    # THIS node's accept stamp; queue wait covers ingest ->
+                    # accepted (decode + validate + queue residency + dedup)
+                    queue_wait_ms = (time.perf_counter() - t0) * 1000.0
+                    slot.trace = {
+                        "id": str(trace["id"]),
+                        "encoded_at": float(trace.get("encoded_at", time.time())),
+                        "hops": list(trace.get("hops", [])),
+                        "accept_ts": time.time(),
+                        "queue_wait_ms": queue_wait_ms,
+                    }
+                    slot.trace_fresh = True
+                    _obs_observe("serve.hop_queue_wait_ms", queue_wait_ms, node=self.name)
+                    _obs_record_hop(slot.trace["id"], self.name, "queue_wait", queue_wait_ms)
             tenant.dirty = True
         if _obs_enabled():
             _obs_observe("serve.ingest_ms", (time.perf_counter() - t0) * 1000.0, tenant=payload.tenant)

@@ -20,6 +20,10 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   503 on queue backpressure. Tree nodes cross process boundaries by
   pointing :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
   this route — the bytes are identical to the in-process path.
+* ``GET /trace`` — Chrome-trace JSON (:func:`metrics_tpu.obs.to_chrome_trace`):
+  host spans plus per-hop payload lifecycles (queue-wait / fold / ship /
+  e2e per trace id), loadable in Perfetto — the debug view behind the
+  ``serve.hop_*_ms`` histograms.
 * ``GET /healthz`` — full health JSON (tenant/client/queue counts plus the
   readiness detail). Kubernetes-style split probes:
   ``GET /healthz/live`` — pure liveness (the process answers); and
@@ -134,9 +138,16 @@ class MetricsServer:
 
     def render_metrics(self) -> str:
         """The ``/metrics`` body: refresh per-tenant value gauges from the
-        merged state, then export the whole obs registry."""
+        merged state, then export the obs registry — FEDERATED when remote
+        node snapshots have arrived (the root of a multi-process tree
+        renders the whole fleet: counters summed, gauges per-node-labeled,
+        histograms merged bucketwise), plain local otherwise. The scrape
+        observes itself into the ``obs.scrape_ms`` histogram."""
+        import time as _time
+
         from metrics_tpu import obs
 
+        t0 = _time.perf_counter()
         agg = self.aggregator
         agg.flush()
         if obs.enabled():
@@ -156,10 +167,33 @@ class MetricsServer:
                         obs.set_gauge(
                             "serve.value", float(arr), tenant=tenant_id, metric=name
                         )
-        return obs.to_prometheus()
+        # federated_snapshot() already degrades to the plain local snapshot
+        # when the table is empty — one table read either way
+        body = obs.to_prometheus(obs.federated_snapshot())
+        if obs.enabled():
+            # self-metrics land AFTER this body was rendered (an exporter
+            # cannot include its own in-flight sample); the next scrape
+            # exports them — the observability plane observes itself
+            obs.observe("obs.scrape_ms", (_time.perf_counter() - t0) * 1000.0)
+        return body
 
     def render_query(self, tenant: str) -> Dict[str, Any]:
-        return self.aggregator.query(tenant)
+        import time as _time
+
+        from metrics_tpu import obs
+
+        t0 = _time.perf_counter()
+        out = self.aggregator.query(tenant)
+        if obs.enabled():
+            obs.observe("serve.query_ms", (_time.perf_counter() - t0) * 1000.0, tenant=tenant)
+        return out
+
+    def render_trace(self) -> str:
+        """The ``/trace`` body: host spans + per-hop payload lifecycles as
+        Chrome-trace JSON (load it in Perfetto / ``chrome://tracing``)."""
+        from metrics_tpu import obs
+
+        return obs.to_chrome_trace()
 
     def render_health(self) -> Dict[str, Any]:
         agg = self.aggregator
@@ -202,7 +236,7 @@ class MetricsServer:
             )
         if worker is True and max_flush_age is not None and flush_age is not None and flush_age > max_flush_age:
             reasons.append(f"last flush completed {flush_age:.1f}s ago (> {max_flush_age:.1f}s)")
-        return {
+        out = {
             "ready": not reasons,
             "reasons": reasons,
             "queue_depth": queue_depth,
@@ -212,6 +246,14 @@ class MetricsServer:
             "open_circuits": status["open_circuits"],
             "quarantined": status["quarantined"],
         }
+        from metrics_tpu.obs import federation as _federation
+
+        if _federation.remote_count():
+            # fleet detail (federated roots only): which nodes have reported
+            # and how stale each snapshot is — a silent subtree shows up
+            # here as a growing age, not as a missing line nobody notices
+            out["fleet_nodes"] = {k: round(v, 3) for k, v in _federation.node_ages().items()}
+        return out
 
 
 def _make_handler(server: MetricsServer):
@@ -253,6 +295,8 @@ def _make_handler(server: MetricsServer):
                 if parsed.path == "/metrics":
                     body = server.render_metrics().encode()
                     self._reply(200, body, "text/plain; version=0.0.4; charset=utf-8")
+                elif parsed.path == "/trace":
+                    self._reply(200, server.render_trace().encode(), "application/json")
                 elif parsed.path == "/query":
                     tenant = (parse_qs(parsed.query).get("tenant") or [None])[0]
                     if tenant is None:

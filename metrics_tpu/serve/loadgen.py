@@ -14,10 +14,18 @@ aggregators already maintain:
 * ``serve_ingest_p99_ms`` — p99 of the per-payload ingest latency
   histogram (``serve.ingest_ms``: decode + validate + queue wait + dedup
   + snapshot store).
+* ``serve_e2e_freshness_ms`` — p99 end-to-end freshness at the ROOT
+  (client encode wall time -> queryable after every hop), off the wire
+  trace context armed payloads carry; ``serve_hop_fold_p99_ms`` is the
+  root's fold-latency p99 (``serve.hop_fold_ms{node=root}``). The obs
+  layer is armed for the whole run (including the pre-encode) so every
+  payload carries trace provenance.
 
-Payload bytes are pre-encoded outside the timed window — the client-side
-fold/encode cost is a *client* budget; the rows measure the aggregation
-tier. ``verify=True`` (tests/smoke) additionally pins the whole run
+Each round's payload bytes are encoded immediately before that round's
+delivery, outside the timed segments — the client-side fold/encode cost
+is a *client* budget; the rows measure the aggregation tier, and the
+freshness row's ``encoded_at`` anchor reflects real staleness (delivery +
+folds + hops), not harness staging time. ``verify=True`` (tests/smoke) additionally pins the whole run
 against a flat single-aggregator merge of every client's final snapshot,
 bitwise on the merged state leaves — the tree invariant end to end.
 
@@ -92,74 +100,84 @@ def run_loadgen(
 
         return MetricCollection({"auroc": StreamingAUROC(num_bins=num_bins)})
 
-    # pre-encode every ship round for every client (client-side cost,
-    # outside the timed aggregation window)
-    rng = np.random.default_rng(seed)
-    rounds: list = [[] for _ in range(payloads_per_client)]
-    payloads_by_client: Dict[str, list] = {}
-    # blob -> (client_id, step, leaf index): identities are known at encode
-    # time, so the timed window never parses a header for bookkeeping —
-    # the degraded bench row must measure the serving tier, not the harness
-    identity: Dict[bytes, tuple] = {}
-    for c in range(n_clients):
-        client = factory()
-        client_id = f"client-{c:05d}"
-        payloads_by_client[client_id] = []
-        for r in range(payloads_per_client):
-            batch = _client_stream(rng, samples_per_payload)
-            client.update(jnp.asarray(batch["preds"]), jnp.asarray(batch["target"]))
-            payload = encode_state(client, tenant=tenant, client_id=client_id, watermark=(0, r))
-            rounds[r].append((c, payload))
-            payloads_by_client[client_id].append(payload)
-            identity[payload] = (client_id, r, c)
-
-    chaos = None if fault_rate <= 0 else WireChaos(
-        seed=seed + 1,
-        p_drop=fault_rate / 4,
-        p_duplicate=fault_rate / 4,
-        p_reorder=fault_rate / 4,
-        p_corrupt=fault_rate / 4,
-        p_delay=0.0,
-    )
-    # oracle bookkeeping (chaos only): the set of (client, step) payloads
-    # delivered UNCORRUPTED at least once — keep-latest makes the highest
-    # step per client the accepted snapshot. A successfully ingested blob
-    # is always an original (corruption is refused by the crc32), so its
-    # identity comes from the pre-encoded map — no header parse in the
-    # timed window, for the clean OR the degraded row.
-    delivered: set = set()
-    refused = 0
-    refused_circuit = 0
-
-    def deliver(blobs, c: int) -> None:
-        nonlocal refused, refused_circuit
-        from metrics_tpu.serve.resilience import CircuitOpenError
-
-        for blob in blobs:
-            try:
-                tree.leaf_for(c).ingest(blob)
-            except WireFormatError:
-                refused += 1  # corrupt-in-flight, refused by the crc32
-            except CircuitOpenError:
-                # a client unlucky enough to draw consecutive corruptions
-                # opened its circuit — its next CLEAN payload is refused
-                # too. A refusal is a non-delivery (consistent with the
-                # oracle), never a harness crash.
-                refused_circuit += 1
-            else:
-                client_id, step, _ = identity[blob]
-                delivered.add((client_id, step))
-
-    tree = AggregationTree(
-        fan_out=fan_out,
-        tenants={tenant: factory},
-        resilience=None if chaos is None else ResilienceConfig(),
-    )
+    # the obs layer is armed for the WHOLE run — including client encodes,
+    # so every payload carries wire trace context; the try/finally covers
+    # setup too, so a failed run can never leak an enabled registry into
+    # later bench rows in the same process
     was_enabled = obs.enable()
-    merges_before = obs.sum_counter("serve.merges")
     try:
-        t0 = time.perf_counter()
-        for round_payloads in rounds:
+        rng = np.random.default_rng(seed)
+        # persistent per-client collections: each ship round folds a fresh
+        # batch into its client and encodes JUST BEFORE delivery, so the
+        # trace context's encoded_at anchors the freshness row to the
+        # serving tier (delivery + folds + hops), not to harness staging —
+        # a globally pre-encoded round would charge every earlier round's
+        # run time to the later rounds' freshness.
+        clients = [(f"client-{c:05d}", factory()) for c in range(n_clients)]
+        payloads_by_client: Dict[str, list] = {cid: [] for cid, _ in clients}
+        # blob -> (client_id, step, leaf index): identities are known at
+        # encode time, so the timed window never parses a header for
+        # bookkeeping — the degraded bench row must measure the serving
+        # tier, not the harness
+        identity: Dict[bytes, tuple] = {}
+
+        chaos = None if fault_rate <= 0 else WireChaos(
+            seed=seed + 1,
+            p_drop=fault_rate / 4,
+            p_duplicate=fault_rate / 4,
+            p_reorder=fault_rate / 4,
+            p_corrupt=fault_rate / 4,
+            p_delay=0.0,
+        )
+        # oracle bookkeeping (chaos only): the set of (client, step)
+        # payloads delivered UNCORRUPTED at least once — keep-latest makes
+        # the highest step per client the accepted snapshot. A successfully
+        # ingested blob is always an original (corruption is refused by the
+        # crc32), so its identity comes off the pre-built map.
+        delivered: set = set()
+        refused = 0
+        refused_circuit = 0
+
+        def deliver(blobs, c: int) -> None:
+            nonlocal refused, refused_circuit
+            from metrics_tpu.serve.resilience import CircuitOpenError
+
+            for blob in blobs:
+                try:
+                    tree.leaf_for(c).ingest(blob)
+                except WireFormatError:
+                    refused += 1  # corrupt-in-flight, refused by the crc32
+                except CircuitOpenError:
+                    # a client unlucky enough to draw consecutive
+                    # corruptions opened its circuit — its next CLEAN
+                    # payload is refused too. A refusal is a non-delivery
+                    # (consistent with the oracle), never a harness crash.
+                    refused_circuit += 1
+                else:
+                    client_id, step, _ = identity[blob]
+                    delivered.add((client_id, step))
+
+        tree = AggregationTree(
+            fan_out=fan_out,
+            tenants={tenant: factory},
+            resilience=None if chaos is None else ResilienceConfig(),
+        )
+        merges_before = obs.sum_counter("serve.merges")
+        # elapsed sums only the DELIVERY + PUMP segments; the per-round
+        # client fold/encode between them is client-side budget
+        elapsed = 0.0
+        for r in range(payloads_per_client):
+            round_payloads = []
+            for c, (client_id, client) in enumerate(clients):
+                batch = _client_stream(rng, samples_per_payload)
+                client.update(jnp.asarray(batch["preds"]), jnp.asarray(batch["target"]))
+                payload = encode_state(
+                    client, tenant=tenant, client_id=client_id, watermark=(0, r)
+                )
+                round_payloads.append((c, payload))
+                payloads_by_client[client_id].append(payload)
+                identity[payload] = (client_id, r, c)
+            t0 = time.perf_counter()
             for c, payload in round_payloads:
                 if chaos is None:
                     tree.leaf_for(c).ingest(payload)
@@ -173,23 +191,43 @@ def run_loadgen(
                 for blob in chaos.end_round():
                     deliver([blob], identity[blob][2])
             tree.pump()
+            elapsed += time.perf_counter() - t0
         if chaos is not None:
+            t0 = time.perf_counter()
             for blob in chaos.flush():
                 deliver([blob], identity[blob][2])
             tree.pump()
-        elapsed = time.perf_counter() - t0
+            elapsed += time.perf_counter() - t0
         merges = obs.sum_counter("serve.merges") - merges_before
         hist = obs.get_histogram("serve.ingest_ms", tenant=tenant)
         p99 = hist.p99 if hist is not None else None
+        # per-hop provenance rows, read at the ROOT: end-to-end freshness
+        # (client encode wall time -> state queryable at the root) and the
+        # root's fold latency — the two new fleet-observability bench rows
+        fresh_hist = obs.get_histogram("serve.e2e_freshness_ms", node="root")
+        fold_hist = obs.get_histogram("serve.hop_fold_ms", node="root")
+        freshness_p99 = fresh_hist.p99 if fresh_hist is not None else None
+        fold_p99 = fold_hist.p99 if fold_hist is not None else None
     finally:
         obs.enable(was_enabled)
+
+    # per-hop provenance accounting (outside the timed window): total
+    # payloads ACCEPTED (watermark-advancing) across every tree node — the
+    # number the serve.hop_queue_wait_ms{node=} histograms must account
+    # for exactly, chaos or no chaos (tests/serve/test_trace.py pins it)
+    accepted_payloads = sum(
+        node.aggregator._tenant(tenant).folded_payloads for node in tree.nodes
+    )
 
     out: Dict[str, Any] = {
         "serve_ingest_merges_per_s": merges / elapsed if elapsed > 0 else float("nan"),
         "serve_ingest_p99_ms": float("nan") if p99 is None else float(p99),
+        "serve_e2e_freshness_ms": float("nan") if freshness_p99 is None else float(freshness_p99),
+        "serve_hop_fold_p99_ms": float("nan") if fold_p99 is None else float(fold_p99),
         "clients": int(n_clients),
         "payloads": int(n_clients * payloads_per_client),
         "merges": float(merges),
+        "accepted_payloads": int(accepted_payloads),
         "tree_levels": len(tuple(fan_out)) + 1,
         "elapsed_s": elapsed,
     }

@@ -30,13 +30,16 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from metrics_tpu.obs.registry import enabled as _obs_enabled
 from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import new_trace_id as _new_trace_id
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import record_hop as _obs_record_hop
 from metrics_tpu.serve.aggregator import Aggregator, BackpressureError
 from metrics_tpu.serve.resilience import (
     CircuitOpenError,
     NodeDownError,
     QuarantinedClientError,
 )
-from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.serve.wire import WireFormatError, encode_state
 
 __all__ = ["AggregationTree", "AggregatorNode"]
 
@@ -98,6 +101,10 @@ class AggregatorNode:
         self._probe = probe
         self._ship_seq: Optional["itertools.count"] = None
         self._killed_with_worker = False
+        # previous forward's send latency: a hop record is built BEFORE its
+        # own send runs, so the wire carries the last completed measurement
+        # (the serve.hop_ship_ms{node=} histogram carries every one)
+        self._last_ship_ms: Optional[float] = None
 
     @property
     def name(self) -> str:
@@ -205,19 +212,78 @@ class AggregatorNode:
             self._ship_seq = itertools.count(self._resume_seq())
         seq = next(self._ship_seq)
         shipped = 0
-        for tenant_id in self.aggregator.tenants():
+        armed = _obs_enabled()
+        for index, tenant_id in enumerate(self.aggregator.tenants()):
             view = self.aggregator.collection(tenant_id, flush=False)
+            tenant = self.aggregator._tenant(tenant_id)
+            meta = {"node": self.name, "clients": len(tenant.clients)}
+            if armed:
+                # trace context for the upward hop: follow the CRITICAL PATH
+                # — the stalest-encode contribution's id and hop chain, plus
+                # this node's own provenance record. e2e freshness at the
+                # root then measures the worst client, not the luckiest.
+                oldest = tenant.oldest_trace
+                hop = {
+                    "node": self.name,
+                    "accept_ts": oldest["accept_ts"] if oldest else None,
+                    "queue_wait_ms": oldest["queue_wait_ms"] if oldest else None,
+                    "fold_ms": tenant.last_fold_ms,
+                    "ship_ms": self._last_ship_ms,
+                }
+                meta["trace"] = {
+                    "id": oldest["id"] if oldest else _new_trace_id(),
+                    "encoded_at": oldest["encoded_at"] if oldest else time.time(),
+                    "hops": (list(oldest["hops"]) if oldest else []) + [hop],
+                }
+                if index == 0 and self._send is not None:
+                    # obs federation piggyback, once per forward: this
+                    # node's snapshot plus every remote one it holds, so
+                    # subtree telemetry transits each hop. Armed-only — the
+                    # unarmed wire stays byte-for-byte free of it — and
+                    # cross-process-only: an in-process parent shares this
+                    # registry and identity, so it would discard the copy
+                    # anyway (metrics_tpu.obs.federation).
+                    from metrics_tpu.obs import federation as _federation
+
+                    meta["obs_nodes"] = _federation.wire_snapshots()
             # view_lock: this node's background worker (if start()ed) may
             # fold concurrently; encoding leaf-by-leaf without the lock
             # could ship a snapshot mixing two folds' states upward
-            with self.aggregator._tenant(tenant_id).view_lock:
-                payload = encode_state(
-                    view,
-                    tenant=tenant_id,
-                    client_id=f"node:{self.name}",
-                    watermark=(0, seq),
-                    meta={"node": self.name, "clients": len(self.aggregator._tenant(tenant_id).clients)},
-                )
+            with tenant.view_lock:
+                try:
+                    payload = encode_state(
+                        view,
+                        tenant=tenant_id,
+                        client_id=f"node:{self.name}",
+                        watermark=(0, seq),
+                        meta=meta,
+                    )
+                except WireFormatError:
+                    if "obs_nodes" not in meta:
+                        # the DATA path overflowed the wire cap — a real
+                        # contract violation; survive it like a transport
+                        # failure, the next interval retries
+                        self._note_forward_error("encode:WireFormatError")
+                        continue
+                    # the telemetry piggyback pushed the payload over the
+                    # wire cap: drop the TELEMETRY, never the metric state
+                    # — the side-channel must not take down the data path
+                    # it observes. Counted so a fleet too big to piggyback
+                    # is visible rather than silently unfederated.
+                    meta.pop("obs_nodes")
+                    _obs_inc("obs.federation_oversized", node=self.name)
+                    try:
+                        payload = encode_state(
+                            view,
+                            tenant=tenant_id,
+                            client_id=f"node:{self.name}",
+                            watermark=(0, seq),
+                            meta=meta,
+                        )
+                    except WireFormatError:
+                        self._note_forward_error("encode:WireFormatError")
+                        continue
+            t_send = time.perf_counter()
             try:
                 if self._send is not None:
                     self._send(payload)
@@ -226,6 +292,11 @@ class AggregatorNode:
             except _TRANSPORT_ERRORS as err:
                 self._note_forward_error(f"send:{type(err).__name__}")
                 continue
+            if armed:
+                ship_ms = (time.perf_counter() - t_send) * 1000.0
+                self._last_ship_ms = ship_ms
+                _obs_observe("serve.hop_ship_ms", ship_ms, node=self.name)
+                _obs_record_hop(meta["trace"]["id"], self.name, "ship", ship_ms)
             shipped += 1
         return shipped
 

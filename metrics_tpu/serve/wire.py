@@ -39,6 +39,7 @@ watermark comparison (see ``docs/serving.md``).
 import hashlib
 import json
 import struct
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -68,7 +69,14 @@ WIRE_MAJOR = 1
 # silently folding garbage into tenant state). Minor-0 decoders ignore the
 # unknown entry key; minor-0 payloads (no crc32) still decode here — the
 # forward/backward asymmetry the versioning contract promises.
-WIRE_MINOR = 1
+# minor 2: observability side-channel in ``meta`` — ``meta["trace"]``
+# (trace id, client encode timestamp, per-hop provenance records) and
+# ``meta["obs_nodes"]`` (piggybacked per-node obs snapshots for the fleet
+# federation table). Both are attached ONLY while the obs layer is armed:
+# an unarmed fleet ships byte-identical minor-2 payloads with empty meta.
+# Older decoders preserve the unknown meta keys untouched — additive, per
+# the minor contract.
+WIRE_MINOR = 2
 # bounded-size payloads are the design contract (sketches are <=64KB by
 # construction); the default cap leaves headroom for multi-member
 # collections while still refusing an unbounded cat state that would turn
@@ -251,6 +259,17 @@ def encode_state(
     epoch, step = int(watermark[0]), int(watermark[1])
     if epoch < 0 or step < 0:
         raise ValueError(f"watermark must be non-negative, got {(epoch, step)}")
+    meta = dict(meta or {})
+    if "trace" not in meta:
+        # armed-only trace context (wire minor 2): a fresh trace id plus the
+        # encode wall timestamp the root's serve.e2e_freshness_ms measures
+        # against, and an empty hop list each aggregator hop appends its
+        # provenance record to. Unarmed, the key is absent — zero wire bytes.
+        from metrics_tpu.obs.registry import enabled as _obs_enabled
+        from metrics_tpu.obs.registry import new_trace_id as _new_trace_id
+
+        if _obs_enabled():
+            meta["trace"] = {"id": _new_trace_id(), "encoded_at": time.time(), "hops": []}
     states = {name: metric_state_to_tree(m) for name, m in _members(obj).items()}
 
     directory: List[Dict[str, Any]] = []
@@ -286,7 +305,7 @@ def encode_state(
         "watermark": [epoch, step],
         "schema_hash": _fingerprint_of_schema(schema),
         "schema": schema,
-        "meta": dict(meta or {}),
+        "meta": meta,
         "leaves": directory,
     }
     header_bytes = json.dumps(header, sort_keys=True, default=str).encode()

@@ -1,0 +1,394 @@
+"""Obs federation: the snapshot-merge algebra, cardinality guard, span/hop
+export and the reset contract.
+
+The fleet view is only trustworthy if ``merge_snapshots`` is a real monoid
+over distinct-node snapshots: commutative, associative, bucketwise-exact
+on histograms (the shared ``HISTOGRAM_EDGES`` make per-bucket sums the
+TRUE fleet distribution, not an average of percentiles). These tests pin
+that algebra, the label-cardinality guard that makes per-node/per-hop
+labels safe to add, and that ``obs.reset()`` clears the new trace and
+federation state so bench rounds cannot bleed into each other.
+"""
+import copy
+import json
+
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.obs import registry as _reg
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    was = obs.enable(True)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.configure(max_series_per_family=4096)
+    obs.set_node_identity(None)
+    obs.enable(was)
+
+
+def make_node_snapshot(node: str, captured_at: float, *, scale: int = 1) -> dict:
+    """A synthetic per-node snapshot with counters, gauges and histograms
+    built through the REAL registry (so key quoting, bucket layout and
+    to_dict shape can never drift from production snapshots)."""
+    obs.reset()
+    obs.set_node_identity(node)
+    obs.inc("serve.ingests", 3.0 * scale, tenant="t")
+    obs.inc("step.traces", 2.0 * scale, step="epoch")
+    obs.set_gauge("serve.tenants", 1.0 * scale)
+    obs.set_gauge("serve.queue_depth", 5.0 * scale, node=node)
+    for i in range(4 * scale):
+        obs.observe("serve.hop_fold_ms", 0.5 + 0.25 * i, node=node)
+        obs.observe("serve.ingest_ms", 1.0 + 0.5 * i, tenant="t")
+    snap = obs.snapshot(spans=False)
+    snap["captured_at"] = captured_at
+    obs.reset()
+    obs.set_node_identity(None)
+    return snap
+
+
+class TestMergeAlgebra:
+    def test_counters_sum_gauges_tagged_histograms_bucketwise(self):
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0, scale=2)
+        merged = obs.merge_snapshots(a, b)
+        assert merged["federated"] is True
+        assert set(merged["nodes"]) == {"nodeA", "nodeB"}
+        # counters: fleet totals
+        assert merged["counters"]["serve.ingests{tenant=t}"] == pytest.approx(9.0)
+        # gauges: per-node labels — unlabeled ones get tagged, node-labeled
+        # ones (fleet-unique aggregator names) pass through
+        assert merged["gauges"]["serve.tenants{node=nodeA}"] == 1.0
+        assert merged["gauges"]["serve.tenants{node=nodeB}"] == 2.0
+        assert merged["gauges"]["serve.queue_depth{node=nodeA}"] == 5.0
+        assert merged["gauges"]["serve.queue_depth{node=nodeB}"] == 10.0
+        # histograms: bucketwise-exact — same-key series sum per bucket,
+        # node-labeled source series stay distinct
+        shared = merged["histograms"]["serve.ingest_ms{tenant=t}"]
+        assert shared["count"] == 4 + 8
+        assert sum(shared["buckets"]) == 12
+        assert "serve.hop_fold_ms{node=nodeA}" in merged["histograms"]
+        assert "serve.hop_fold_ms{node=nodeB}" in merged["histograms"]
+
+    def test_commutative(self):
+        snaps = [
+            make_node_snapshot("nodeA", 100.0),
+            make_node_snapshot("nodeB", 101.0, scale=2),
+            make_node_snapshot("nodeC", 99.0, scale=3),
+        ]
+        forward = obs.merge_snapshots(*snaps)
+        backward = obs.merge_snapshots(*reversed(snaps))
+        assert forward == backward
+
+    def test_associative_across_fold_orders(self):
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0, scale=2)
+        c = make_node_snapshot("nodeC", 99.0, scale=3)
+        left = obs.merge_snapshots(obs.merge_snapshots(a, b), c)
+        right = obs.merge_snapshots(a, obs.merge_snapshots(b, c))
+        flat = obs.merge_snapshots(a, b, c)
+        assert left["counters"] == right["counters"] == flat["counters"]
+        assert left["gauges"] == right["gauges"] == flat["gauges"]
+        for key in flat["histograms"]:
+            assert left["histograms"][key]["buckets"] == flat["histograms"][key]["buckets"]
+            assert right["histograms"][key]["buckets"] == flat["histograms"][key]["buckets"]
+            assert left["histograms"][key]["sum"] == pytest.approx(flat["histograms"][key]["sum"])
+
+    def test_bucketwise_sums_exact_and_percentile_monotone(self):
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0, scale=4)
+        ha = a["histograms"]["serve.ingest_ms{tenant=t}"]
+        hb = b["histograms"]["serve.ingest_ms{tenant=t}"]
+        merged = obs.merge_snapshots(a, b)["histograms"]["serve.ingest_ms{tenant=t}"]
+        assert merged["buckets"] == [x + y for x, y in zip(ha["buckets"], hb["buckets"])]
+        assert merged["count"] == ha["count"] + hb["count"]
+        assert merged["sum"] == pytest.approx(ha["sum"] + hb["sum"])
+        assert merged["min"] == min(ha["min"], hb["min"])
+        assert merged["max"] == max(ha["max"], hb["max"])
+        # percentiles recomputed from the merged buckets stay monotone and
+        # inside the observed envelope
+        snap = _reg.HistogramSnapshot(
+            merged["buckets"], merged["sum"], merged["count"], merged["min"], merged["max"]
+        )
+        qs = [snap.percentile(q) for q in (0.0, 0.25, 0.5, 0.75, 0.95, 0.99, 1.0)]
+        assert all(x is not None for x in qs)
+        assert qs == sorted(qs)
+        assert merged["min"] <= qs[0] and qs[-1] <= merged["max"]
+
+    def test_same_node_dedups_keep_latest_not_sum(self):
+        old = make_node_snapshot("nodeA", 100.0)
+        new = make_node_snapshot("nodeA", 200.0, scale=2)
+        merged = obs.merge_snapshots(old, new)
+        # cumulative snapshots: two generations of one node must NOT sum
+        assert merged["counters"]["serve.ingests{tenant=t}"] == pytest.approx(6.0)
+        assert merged["nodes"]["nodeA"] == 200.0
+
+    def test_newer_standalone_vs_federated_contribution_refused(self):
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0)
+        fed = obs.merge_snapshots(a, b)
+        newer_a = make_node_snapshot("nodeA", 300.0, scale=2)
+        with pytest.raises(ValueError, match="cannot be excised"):
+            obs.merge_snapshots(fed, newer_a)
+
+    def test_overlapping_federated_rosters_refused(self):
+        """Two already-federated inputs sharing a node have both SUMMED its
+        counters; a silent merge would double-count — refused loudly."""
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0)
+        c = make_node_snapshot("nodeC", 102.0)
+        fed_ab = obs.merge_snapshots(a, b)
+        fed_bc = obs.merge_snapshots(b, c)
+        with pytest.raises(ValueError, match="double-count"):
+            obs.merge_snapshots(fed_ab, fed_bc)
+        # disjoint federated inputs still merge fine
+        merged = obs.merge_snapshots(fed_ab, obs.merge_snapshots(c))
+        assert set(merged["nodes"]) == {"nodeA", "nodeB", "nodeC"}
+
+    def test_mismatched_bucket_layout_refused(self):
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0)
+        b["histograms"]["serve.ingest_ms{tenant=t}"]["buckets"] = [1, 2, 3]
+        with pytest.raises(ValueError, match="bucket counts differ"):
+            obs.merge_snapshots(a, b)
+
+    def test_wire_compact_histograms_merge(self):
+        """Piggybacked snapshots strip the shared ``edges`` list; the merge
+        must re-derive the full shape (what transits the tree is the wire-
+        compact form)."""
+        a = make_node_snapshot("nodeA", 100.0)
+        b = make_node_snapshot("nodeB", 101.0)
+        for hist in b["histograms"].values():
+            hist.pop("edges", None)
+        merged = obs.merge_snapshots(a, b)
+        h = merged["histograms"]["serve.ingest_ms{tenant=t}"]
+        assert h["count"] == 8 and len(h["edges"]) == len(obs.HISTOGRAM_EDGES)
+
+    def test_three_node_federated_prometheus_reparse(self):
+        """Full exposition-format round trip of a 3-node federated
+        snapshot: every line parses, node= labels survive, histogram
+        buckets stay cumulative-monotone."""
+        import re
+
+        merged = obs.merge_snapshots(
+            make_node_snapshot("nodeA", 100.0),
+            make_node_snapshot("nodeB", 101.0, scale=2),
+            make_node_snapshot("nodeC", 102.0, scale=3),
+        )
+        text = obs.to_prometheus(merged)
+        line_re = re.compile(
+            r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+            r" (?P<value>[^ ]+)$"
+        )
+        series: dict = {}
+        for line in text.strip().splitlines():
+            if line.startswith("# TYPE"):
+                continue
+            m = line_re.match(line)
+            assert m is not None, f"unparseable exposition line: {line!r}"
+            series[m.group("name") + "{" + (m.group("labels") or "") + "}"] = float(
+                m.group("value")
+            )
+        # per-node gauge series all present
+        for node in ("nodeA", "nodeB", "nodeC"):
+            assert f'metrics_tpu_serve_tenants{{node="{node}"}}' in series
+            assert any(f'node="{node}"' in k and "hop_fold_ms_bucket" in k for k in series)
+        # counters summed across the fleet
+        assert series['metrics_tpu_serve_ingests{tenant="t"}'] == pytest.approx(18.0)
+        # histogram buckets cumulative and ending at _count
+        bucket_keys = sorted(
+            (k for k in series if k.startswith("metrics_tpu_serve_ingest_ms_bucket")),
+            key=lambda k: float("inf") if 'le="+Inf"' in k else float(
+                re.search(r'le="([^"]+)"', k).group(1)
+            ),
+        )
+        values = [series[k] for k in bucket_keys]
+        assert values == sorted(values)
+        assert values[-1] == series['metrics_tpu_serve_ingest_ms_count{tenant="t"}'] == 24
+
+
+class TestFederationTable:
+    def test_keep_latest_and_own_identity_skip(self):
+        # build every snapshot FIRST: the helper resets obs (including the
+        # federation table) while staging its synthetic registry
+        old = make_node_snapshot("remote", 100.0)
+        new = make_node_snapshot("remote", 200.0, scale=2)
+        own = make_node_snapshot("local", 999.0)
+        obs.set_node_identity("local")
+        assert obs.accept_snapshot(new) is True
+        assert obs.accept_snapshot(old) is False  # stale redelivery drops
+        assert obs.accept_snapshot(copy.deepcopy(new)) is False  # duplicate drops
+        assert obs.accept_snapshot(own) is False  # live registry is fresher
+        assert set(obs.remote_snapshots()) == {"remote"}
+
+    def test_federated_snapshot_merges_local_and_remote(self):
+        obs.set_node_identity("local")
+        remote = make_node_snapshot("remote", 100.0)
+        obs.set_node_identity("local")
+        obs.inc("serve.ingests", 1.0, tenant="t")
+        obs.accept_snapshot(remote)
+        fed = obs.federated_snapshot()
+        assert set(fed["nodes"]) == {"local", "remote"}
+        assert fed["counters"]["serve.ingests{tenant=t}"] == pytest.approx(4.0)
+
+    def test_federated_snapshot_without_remotes_is_plain(self):
+        obs.inc("x", 1.0)
+        fed = obs.federated_snapshot()
+        assert "federated" not in fed
+        assert fed["node"] == obs.node_identity()
+
+    def test_table_caps_distinct_node_identities(self, monkeypatch):
+        """Snapshot identities arrive in client-controlled payload meta —
+        a hostile client minting fresh identities must not grow the
+        process-global table without bound."""
+        from metrics_tpu.obs import federation
+
+        monkeypatch.setattr(federation, "MAX_FEDERATION_NODES", 3)
+        base = {"counters": {}, "gauges": {}, "histograms": {}}
+        for i in range(6):
+            federation.accept_snapshot({"node": f"n{i}", "captured_at": 1.0, **base})
+        assert len(obs.remote_snapshots()) == 3
+        assert obs.get_counter("obs.federation_nodes_dropped") == 3.0
+        # held identities still refresh past the cap
+        assert federation.accept_snapshot({"node": "n0", "captured_at": 2.0, **base})
+
+    def test_malformed_series_maps_rejected(self):
+        assert not obs.accept_snapshot(
+            {"node": "x", "captured_at": 1.0, "counters": ["not", "a", "dict"]}
+        )
+        assert obs.remote_snapshots() == {}
+
+    def test_poisoned_snapshot_cannot_break_federated_render(self):
+        """One malformed piggyback (foreign bucket layout, non-numeric
+        values) must be refused at the door — stored, it would make EVERY
+        later federated_snapshot()/scrape raise until a process reset."""
+        base = {"captured_at": 1.0, "counters": {}, "gauges": {}}
+        assert not obs.accept_snapshot(
+            {"node": "skewed", **base, "histograms": {"h": {"buckets": [1, 2], "sum": 3.0, "count": 3}}}
+        )
+        assert not obs.accept_snapshot(
+            {"node": "hostile", **base, "histograms": {"h": "lies"}}
+        )
+        assert not obs.accept_snapshot(
+            {"node": "stringy", "captured_at": 1.0, "counters": {"c": "NaNaNaN"},
+             "gauges": {}, "histograms": {}}
+        )
+        assert obs.remote_snapshots() == {}
+        obs.to_prometheus(obs.federated_snapshot())  # must not raise
+
+    def test_forged_future_captured_at_refused(self):
+        """keep-latest could never evict a far-future timestamp, so a
+        forged one would pin a snapshot in the table forever."""
+        base = {"counters": {}, "gauges": {}, "histograms": {}}
+        assert not obs.accept_snapshot({"node": "liar", "captured_at": 9e18, **base})
+        assert obs.remote_snapshots() == {}
+        # modest real clock skew is tolerated
+        import time as _time
+
+        assert obs.accept_snapshot(
+            {"node": "slightly-ahead", "captured_at": _time.time() + 60.0, **base}
+        )
+
+    def test_reset_clears_federation_and_hops(self):
+        """The PR-10 regression fix: back-to-back bench rounds/tests must
+        not inherit the previous round's fleet state."""
+        obs.accept_snapshot(make_node_snapshot("remote", 100.0))
+        obs.record_hop("deadbeef", "root", "fold", 1.0)
+        assert obs.remote_snapshots() and obs.hops()
+        obs.reset()
+        assert obs.remote_snapshots() == {}
+        assert obs.hops() == []
+        assert "federated" not in obs.federated_snapshot()
+
+
+class TestCardinalityGuard:
+    def test_counter_gauge_histogram_families_capped(self):
+        obs.configure(max_series_per_family=4)
+        for i in range(10):
+            obs.inc("fam.c", client=i)
+            obs.set_gauge("fam.g", float(i), client=i)
+            obs.observe("fam.h", 1.0, client=i)
+        assert sum(1 for k in obs.counters() if k.startswith("fam.c")) == 4
+        assert sum(1 for k in obs.gauges() if k.startswith("fam.g")) == 4
+        assert sum(1 for k in obs.histograms() if k.startswith("fam.h")) == 4
+        assert obs.get_counter("obs.series_dropped", family="fam.c") == 6.0
+        assert obs.get_counter("obs.series_dropped", family="fam.g") == 6.0
+        assert obs.get_counter("obs.series_dropped", family="fam.h") == 6.0
+
+    def test_existing_series_keep_updating_past_cap(self):
+        obs.configure(max_series_per_family=2)
+        obs.inc("fam.c", client=0)
+        obs.inc("fam.c", client=1)
+        obs.inc("fam.c", client=2)  # dropped
+        obs.inc("fam.c", client=0)  # existing: must still count
+        assert obs.get_counter("fam.c", client=0) == 2.0
+        assert obs.get_counter("fam.c", client=2) == 0.0
+
+    def test_families_independent_and_none_disables(self):
+        obs.configure(max_series_per_family=2)
+        for i in range(4):
+            obs.inc("fam.a", k=i)
+            obs.inc("fam.b", k=i)
+        assert sum(1 for k in obs.counters() if k.startswith("fam.a{")) == 2
+        assert sum(1 for k in obs.counters() if k.startswith("fam.b{")) == 2
+        obs.configure(max_series_per_family=None)
+        for i in range(10, 20):
+            obs.inc("fam.a", k=i)
+        assert sum(1 for k in obs.counters() if k.startswith("fam.a{")) == 12
+
+    def test_invalid_cap_rejected(self):
+        with pytest.raises(ValueError, match="max_series_per_family"):
+            obs.configure(max_series_per_family=0)
+
+    def test_reset_reopens_families(self):
+        obs.configure(max_series_per_family=1)
+        obs.inc("fam.c", k=0)
+        obs.inc("fam.c", k=1)  # dropped
+        obs.reset()
+        obs.inc("fam.c", k=1)
+        assert obs.get_counter("fam.c", k=1) == 1.0
+
+
+class TestSpanAndHopExport:
+    def test_spans_carry_monotonic_start_end(self):
+        with obs.trace_span("phase.a"):
+            pass
+        span = obs.spans()[-1]
+        assert span["end_ms"] >= span["start_ms"]
+        assert span["end_ms"] - span["start_ms"] == pytest.approx(span["wall_ms"], abs=1e-6)
+
+    def test_hop_ring_caps_and_counts_evictions(self):
+        obs.configure(max_hops=3)
+        try:
+            for i in range(5):
+                obs.record_hop(f"t{i}", "root", "fold", 1.0)
+            assert len(obs.hops()) == 3
+            assert obs.get_counter("obs.hops_dropped") == 2.0
+            assert [h["trace"] for h in obs.hops()] == ["t2", "t3", "t4"]
+        finally:
+            obs.configure(max_hops=4096)
+
+    def test_chrome_trace_loads_and_covers_spans_and_hops(self, tmp_path):
+        with obs.trace_span("phase.a"):
+            with obs.trace_span("phase.b"):
+                pass
+        obs.record_hop("cafe01", "L1.0", "queue_wait", 2.0)
+        obs.record_hop("cafe01", "root", "fold", 3.0)
+        path = tmp_path / "trace.json"
+        text = obs.to_chrome_trace(path=str(path))
+        doc = json.loads(text)
+        assert json.loads(path.read_text()) == doc
+        events = doc["traceEvents"]
+        names = [e["name"] for e in events]
+        assert "phase.a" in names and "phase.b" in names
+        assert "queue_wait@L1.0" in names and "fold@root" in names
+        for e in events:
+            assert {"name", "ph", "pid"} <= set(e)
+            if e["ph"] == "X":
+                assert e["dur"] >= 0.0 and "ts" in e
+        # one payload-lifecycle thread per trace id
+        hop_tids = {e["tid"] for e in events if e.get("cat") == "hop"}
+        assert len(hop_tids) == 1

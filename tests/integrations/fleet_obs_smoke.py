@@ -1,0 +1,156 @@
+"""CI smoke: the distributed-observability plane, end to end.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.fleet_obs_smoke``
+(the CI tier-1 job does). The cheap end-to-end arm of
+``tests/serve/test_trace.py`` + ``tests/bases/test_obs_federation.py``:
+
+1. an 8-client 2-level tree with obs armed — every node's hop histograms
+   (queue-wait / fold / ship) are non-empty and labeled by node, the
+   root's ``serve.e2e_freshness_ms`` recorded one sample per accepted
+   upward payload, and the root's federated snapshot contains every
+   node's counters;
+2. the root's ``/trace`` route serves valid Chrome-trace JSON (loadable
+   in Perfetto): host spans + one payload-lifecycle thread per trace id;
+3. the chaos arm: the 10%-fault seeded loadgen's hop records account for
+   EXACTLY every accepted payload, fleet-wide, and the new bench rows
+   (``serve_e2e_freshness_ms`` / ``serve_hop_fold_p99_ms``) come out
+   finite so the ``--json`` sweep and ``--compare`` gate have real values;
+4. the zero-cost pin: an unarmed encode ships byte-identical payloads
+   with no trace/obs meta.
+"""
+import json
+import os
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+TENANT = "fleet"
+
+
+def factory():
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection({"auroc": StreamingAUROC(num_bins=64)})
+
+
+def client_blob(c: int, rng: np.random.Generator, step: int = 0) -> bytes:
+    from metrics_tpu.serve.wire import encode_state
+
+    coll = factory()
+    preds = jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, 64) < 0.5).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    return encode_state(coll, tenant=TENANT, client_id=f"client-{c:04d}", watermark=(0, step))
+
+
+def main() -> None:
+    import metrics_tpu.obs as obs
+    from metrics_tpu.serve import AggregationTree, MetricsServer
+    from metrics_tpu.serve.loadgen import run_loadgen
+    from metrics_tpu.serve.wire import decode_state
+
+    obs.reset()
+    obs.enable()
+
+    # -- 1: 8 clients, 2-level tree, hop provenance at every node --------
+    tree = AggregationTree(fan_out=(4,), tenants={TENANT: factory})
+    rng = np.random.default_rng(0)
+    for c in range(8):
+        tree.leaf_for(c).ingest(client_blob(c, rng))
+    tree.pump()
+
+    for node in tree.nodes:
+        accepted = sum(
+            node.aggregator._tenant(t).folded_payloads for t in node.aggregator.tenants()
+        )
+        qw = obs.get_histogram("serve.hop_queue_wait_ms", node=node.name)
+        assert qw is not None and qw.count == accepted > 0, (
+            f"node {node.name}: queue-wait histogram must hold one sample per"
+            f" accepted payload (got {qw and qw.count} vs {accepted})"
+        )
+        fold = obs.get_histogram("serve.hop_fold_ms", node=node.name)
+        assert fold is not None and fold.count > 0, f"node {node.name}: empty fold histogram"
+    for leaf in tree.leaves:
+        ship = obs.get_histogram("serve.hop_ship_ms", node=leaf.name)
+        assert ship is not None and ship.count > 0, f"leaf {leaf.name}: empty ship histogram"
+    fresh = obs.get_histogram("serve.e2e_freshness_ms", node="root")
+    assert fresh is not None and fresh.count == 4 and fresh.min >= 0.0, fresh
+
+    # the root's federated snapshot (local registry here — the in-process
+    # tree shares one; remote snapshots merge identically, pinned by the
+    # unit tests) contains every node's hop series and the fleet counters
+    fed = obs.federated_snapshot()
+    for node in tree.nodes:
+        key = "serve.hop_queue_wait_ms{node=" + node.name + "}"
+        assert key in fed["histograms"], f"federated snapshot missing {key}"
+    assert fed["counters"]["serve.ingests{tenant=" + TENANT + "}"] >= 8.0
+
+    # -- 2: /trace serves Perfetto-loadable Chrome-trace JSON ------------
+    server = MetricsServer(tree.root.aggregator, port=0).start()
+    try:
+        raw = urllib.request.urlopen(f"http://127.0.0.1:{server.port}/trace").read()
+        doc = json.loads(raw)
+        events = doc["traceEvents"]
+        assert isinstance(events, list) and events, "empty Chrome trace"
+        for event in events:
+            assert "name" in event and "ph" in event and "pid" in event, event
+            if event["ph"] == "X":
+                assert "ts" in event and event["dur"] >= 0.0, event
+        hop_events = [e for e in events if e.get("cat") == "hop"]
+        assert hop_events, "no payload-lifecycle events in /trace"
+        phases = {e["name"].split("@")[0] for e in hop_events}
+        assert {"queue_wait", "fold", "ship"} <= phases, phases
+        # scrape self-metric: the /metrics route observes itself
+        urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics").read()
+        body = urllib.request.urlopen(f"http://127.0.0.1:{server.port}/metrics").read().decode()
+        assert "metrics_tpu_obs_scrape_ms_bucket" in body
+    finally:
+        server.stop()
+
+    # -- 3: chaos arm + bench-row plumbing -------------------------------
+    obs.reset()
+    out = run_loadgen(
+        n_clients=64,
+        fan_out=(2, 4),
+        payloads_per_client=2,
+        samples_per_payload=64,
+        num_bins=64,
+        seed=11,
+        verify=True,
+        fault_rate=0.10,
+    )
+    assert out["verified_bitwise"] is True
+    assert np.isfinite(out["serve_e2e_freshness_ms"]), out
+    assert np.isfinite(out["serve_hop_fold_p99_ms"]), out
+    total_hops = sum(
+        hist["count"]
+        for key, hist in obs.histograms().items()
+        if key.startswith("serve.hop_queue_wait_ms{") and "flat-reference" not in key
+    )
+    assert total_hops == out["accepted_payloads"] > 0, (
+        f"hop records ({total_hops}) must account for every accepted payload"
+        f" ({out['accepted_payloads']}) under 10% seeded faults"
+    )
+
+    # -- 4: zero-cost pin -------------------------------------------------
+    obs.enable(False)
+    blob = client_blob(99, np.random.default_rng(99))
+    meta = decode_state(blob).meta
+    assert "trace" not in meta and "obs_nodes" not in meta, meta
+    assert blob == client_blob(99, np.random.default_rng(99)), "unarmed encode not deterministic"
+
+    print(
+        "fleet obs smoke OK: 8-client 2-level tree fully hop-attributed,"
+        f" root e2e freshness p99 {fresh.p99:.2f}ms, /trace serves"
+        f" {len(events)} Chrome-trace events, chaos arm accounted"
+        f" {total_hops} accepted payloads at 10% faults, unarmed wire clean"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,372 @@
+"""Wire trace context and per-hop provenance through the serving tree.
+
+Pins the PR-10 distributed-observability contract: armed payloads carry a
+trace id + encode timestamp + hop chain in the forward-compatible ``meta``
+side-channel (wire minor 2), every aggregator hop stamps queue-wait /
+fold / ship histograms labeled by node, the root records end-to-end
+freshness per accepted payload — and the UNARMED wire is byte-for-byte
+free of all of it (the zero-cost contract the serving tier was built on).
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.obs as obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import AggregationTree, Aggregator, MetricsServer
+from metrics_tpu.serve.wire import decode_state, encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "t"
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    was = obs.enabled()
+    obs.enable(False)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.enable(was)
+
+
+def factory() -> MetricCollection:
+    return MetricCollection({"auroc": StreamingAUROC(num_bins=64)})
+
+
+def client_blob(c: int, rng: np.random.Generator, step: int = 0) -> bytes:
+    coll = factory()
+    preds = jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32))
+    target = jnp.asarray((rng.uniform(0, 1, 64) < 0.5).astype(np.int32))
+    coll["auroc"].update(preds, target)
+    return encode_state(coll, tenant=TENANT, client_id=f"client-{c:04d}", watermark=(0, step))
+
+
+def accepted_payloads(agg: Aggregator) -> int:
+    return sum(agg._tenant(t).folded_payloads for t in agg.tenants())
+
+
+def hop_count(name: str, node: str) -> int:
+    hist = obs.get_histogram(name, node=node)
+    return 0 if hist is None else hist.count
+
+
+class TestWireTraceContext:
+    def test_armed_payload_carries_trace(self):
+        obs.enable(True)
+        blob = client_blob(0, np.random.default_rng(0))
+        trace = decode_state(blob).meta["trace"]
+        assert set(trace) >= {"id", "encoded_at", "hops"}
+        assert trace["hops"] == [] and len(trace["id"]) == 16
+
+    def test_unarmed_wire_is_byte_identical(self):
+        """The zero-cost pin: with obs off, the PR-10 wire is bitwise the
+        pre-PR wire — no trace key, no obs piggyback, zero extra bytes."""
+        rng_a, rng_b = np.random.default_rng(7), np.random.default_rng(7)
+        unarmed = client_blob(0, rng_a)
+        obs.enable(True)
+        armed = client_blob(0, rng_b)
+        obs.enable(False)
+        payload = decode_state(unarmed)
+        assert "trace" not in payload.meta and "obs_nodes" not in payload.meta
+        assert len(armed) > len(unarmed)  # the armed trace context is real
+        # and re-encoding unarmed reproduces the exact same bytes
+        assert client_blob(0, np.random.default_rng(7)) == unarmed
+
+    def test_caller_supplied_trace_not_overwritten(self):
+        obs.enable(True)
+        coll = factory()
+        blob = encode_state(
+            coll,
+            tenant=TENANT,
+            client_id="c",
+            watermark=(0, 0),
+            meta={"trace": {"id": "f" * 16, "encoded_at": 1.0, "hops": []}},
+        )
+        assert decode_state(blob).meta["trace"]["id"] == "f" * 16
+
+
+class TestHopProvenance:
+    def test_tree_records_hops_and_e2e_freshness(self):
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        for c in range(6):
+            tree.leaf_for(c).ingest(client_blob(c, rng))
+        tree.pump()
+        # leaves: one queue-wait per accepted client payload, one fold, one ship
+        for node in ("L1.0", "L1.1"):
+            assert hop_count("serve.hop_queue_wait_ms", node) == 3
+            assert hop_count("serve.hop_fold_ms", node) == 1
+            assert hop_count("serve.hop_ship_ms", node) == 1
+        # root: one queue-wait per node ship, a fold, e2e freshness per
+        # accepted upward payload
+        assert hop_count("serve.hop_queue_wait_ms", "root") == 2
+        assert hop_count("serve.hop_fold_ms", "root") == 1
+        assert hop_count("serve.e2e_freshness_ms", "root") == 2
+        fresh = obs.get_histogram("serve.e2e_freshness_ms", node="root")
+        assert fresh.min >= 0.0
+
+    def test_upward_payload_carries_critical_path_hop_chain(self):
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        shipped: list = []
+        tree.leaves[0]._send = shipped.append  # capture the leaf's upward bytes
+        encode_before = __import__("time").time()
+        for c in (0, 2):  # both land on leaf L1.0
+            tree.leaf_for(c).ingest(client_blob(c, rng))
+        tree.pump()
+        assert shipped
+        trace = decode_state(shipped[-1]).meta["trace"]
+        # the upward trace follows the stalest client: its encode timestamp
+        # is carried, and exactly one hop record (this leaf) was appended
+        assert trace["encoded_at"] >= encode_before - 1.0
+        assert len(trace["hops"]) == 1
+        hop = trace["hops"][0]
+        assert hop["node"] == "L1.0"
+        assert hop["queue_wait_ms"] >= 0.0
+        assert hop["fold_ms"] is None or hop["fold_ms"] >= 0.0
+
+    def test_hop_records_account_for_every_accepted_payload(self):
+        """The acceptance invariant: per node, the queue-wait histogram
+        holds EXACTLY one sample per accepted (watermark-advancing)
+        payload — duplicates and stale replays leave no hop record."""
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        blobs = [client_blob(c, rng) for c in range(4)]
+        for c, blob in enumerate(blobs):
+            tree.leaf_for(c).ingest(blob)
+            tree.leaf_for(c).ingest(blob)  # duplicate: dedup-dropped
+        tree.pump()
+        for node in tree.nodes:
+            assert hop_count("serve.hop_queue_wait_ms", node.name) == accepted_payloads(
+                node.aggregator
+            )
+
+    def test_hop_accounting_under_chaos(self):
+        """Chaos-arm acceptance: at 10% seeded faults the hop records still
+        account for every ACCEPTED payload at every node — drops never
+        arrive, corruption is refused before accept, duplicates are
+        dedup-dropped without a hop record."""
+        from metrics_tpu.serve.loadgen import run_loadgen
+
+        obs.enable(True)
+        out = run_loadgen(
+            n_clients=48,
+            fan_out=(2, 4),
+            payloads_per_client=2,
+            samples_per_payload=64,
+            num_bins=64,
+            seed=3,
+            verify=True,
+            fault_rate=0.10,
+        )
+        assert out["verified_bitwise"] is True
+        assert np.isfinite(out["serve_e2e_freshness_ms"])
+        assert np.isfinite(out["serve_hop_fold_p99_ms"])
+        total_hops = 0.0
+        for key, hist in obs.histograms().items():
+            if key.startswith("serve.hop_queue_wait_ms{"):
+                total_hops += hist["count"]
+        # loadgen runs a flat-reference aggregator for the oracle; its hop
+        # records are labeled node=flat-reference and excluded here
+        flat = obs.get_histogram("serve.hop_queue_wait_ms", node="flat-reference")
+        total_hops -= 0 if flat is None else flat.count
+        # EXACT accounting: one hop record per accepted payload, fleet-wide
+        assert total_hops == out["accepted_payloads"] > 0
+
+
+class TestFederationPiggyback:
+    def test_ship_carries_obs_nodes_and_fresh_aggregator_accepts(self):
+        obs.enable(True)
+        obs.set_node_identity("leaf-proc")
+        try:
+            tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+            rng = np.random.default_rng(0)
+            shipped: list = []
+            tree.leaves[0]._send = shipped.append
+            tree.leaf_for(0).ingest(client_blob(0, rng))
+            tree.pump()
+            meta = decode_state(shipped[-1]).meta
+            snaps = meta["obs_nodes"]
+            assert snaps and snaps[0]["node"] == "leaf-proc"
+            assert "captured_at" in snaps[0]
+            # histograms transit wire-compact (shared edges stripped)
+            assert all("edges" not in h for h in snaps[0]["histograms"].values())
+            # a receiving "process" (fresh identity + empty table) files the
+            # piggybacked snapshot into its federation table
+            obs.set_node_identity("root-proc")
+            from metrics_tpu.obs import federation
+
+            federation.reset()
+            root = Aggregator("remote-root")
+            root.register_tenant(TENANT, factory)
+            root.ingest(shipped[-1])
+            root.flush()
+            assert "leaf-proc" in obs.remote_snapshots()
+            fed = obs.federated_snapshot()
+            assert {"leaf-proc", "root-proc"} <= set(fed["nodes"])
+            # the leaf's hop histograms render in the ROOT's fleet view
+            assert any(k.startswith("serve.hop_queue_wait_ms{node=L1.0") for k in fed["histograms"])
+        finally:
+            obs.set_node_identity(None)
+
+    def test_in_process_forward_skips_piggyback(self):
+        """An in-process parent shares this registry and identity, so the
+        piggyback copy would always be discarded — it is never built."""
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        captured: list = []
+        original = tree.leaves[0].parent.aggregator.ingest
+        tree.leaves[0].parent.aggregator.ingest = lambda b, **kw: (captured.append(b), original(b, **kw))[1]
+        tree.leaf_for(0).ingest(client_blob(0, rng))
+        tree.pump()
+        meta = decode_state(captured[-1]).meta
+        assert "trace" in meta and "obs_nodes" not in meta
+
+    def test_oversized_piggyback_drops_telemetry_not_state(self, monkeypatch):
+        """A federation table too big for the wire cap must cost the
+        TELEMETRY side-channel, never the metric-state ship."""
+        from metrics_tpu.obs import federation
+
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(1,), tenants={TENANT: factory})
+        shipped: list = []
+        tree.leaves[0]._send = shipped.append
+        monkeypatch.setattr(
+            federation,
+            "wire_snapshots",
+            lambda: [{"node": "huge", "captured_at": 1.0, "blob": "x" * (2 << 20)}],
+        )
+        tree.leaf_for(0).ingest(client_blob(0, np.random.default_rng(0)))
+        tree.pump()
+        assert shipped, "metric state must still ship"
+        meta = decode_state(shipped[-1]).meta
+        assert "obs_nodes" not in meta and "trace" in meta
+        assert obs.get_counter("obs.federation_oversized", node="L1.0") >= 1.0
+
+    def test_unarmed_forward_ships_no_obs_meta(self):
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        shipped: list = []
+        tree.leaves[0]._send = shipped.append
+        tree.leaf_for(0).ingest(client_blob(0, rng))
+        tree.pump()
+        meta = decode_state(shipped[-1]).meta
+        assert "obs_nodes" not in meta and "trace" not in meta
+
+
+class TestEndpoints:
+    def test_trace_route_serves_chrome_trace(self):
+        obs.enable(True)
+        tree = AggregationTree(fan_out=(2,), tenants={TENANT: factory})
+        rng = np.random.default_rng(0)
+        for c in range(4):
+            tree.leaf_for(c).ingest(client_blob(c, rng))
+        tree.pump()
+        server = MetricsServer(tree.root.aggregator, port=0).start()
+        try:
+            doc = json.loads(
+                urllib.request.urlopen(f"http://127.0.0.1:{server.port}/trace").read()
+            )
+            events = doc["traceEvents"]
+            assert any(e.get("cat") == "hop" for e in events)
+            assert all("name" in e and "ph" in e for e in events)
+        finally:
+            server.stop()
+
+    def test_scrape_and_query_self_metrics(self):
+        obs.enable(True)
+        agg = Aggregator("root")
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(client_blob(0, np.random.default_rng(0)))
+        server = MetricsServer(agg, port=0)
+        server.render_metrics()
+        assert obs.get_histogram("obs.scrape_ms").count == 1
+        server.render_query(TENANT)
+        assert obs.get_histogram("serve.query_ms", tenant=TENANT).count == 1
+        # the NEXT scrape exports the previous one's self-sample
+        body = server.render_metrics()
+        assert "metrics_tpu_obs_scrape_ms_bucket" in body
+        assert "metrics_tpu_serve_query_ms_bucket" in body
+
+    def test_ready_reports_fleet_nodes_when_federated(self):
+        obs.enable(True)
+        agg = Aggregator("root")
+        agg.register_tenant(TENANT, factory)
+        server = MetricsServer(agg, port=0)
+        assert "fleet_nodes" not in server.render_ready()
+        obs.accept_snapshot(
+            {"node": "remote-1", "captured_at": 1.0, "counters": {}, "gauges": {}, "histograms": {}}
+        )
+        ready = server.render_ready()
+        assert "remote-1" in ready["fleet_nodes"]
+
+
+class TestFleetHealth:
+    def test_stale_node_condition(self):
+        obs.enable(True)
+        obs.accept_snapshot(
+            {"node": "remote-1", "captured_at": 1.0, "counters": {}, "gauges": {}, "histograms": {}}
+        )
+        monitor = obs.HealthMonitor(
+            skew_threshold_ms=None,
+            clamp_risk=False,
+            degraded_syncs=False,
+            node_staleness_s=60.0,
+            warn=False,
+        )
+        report = monitor.check()
+        kinds = {w["kind"] for w in report["warnings"]}
+        assert "stale_node" in kinds
+
+    def test_deepest_queue_reads_federated_view(self):
+        obs.enable(True)
+        # local queues shallow; a REMOTE node's gauge reports depth 900
+        obs.set_gauge("serve.queue_depth", 3.0, node="root")
+        obs.accept_snapshot(
+            {
+                "node": "remote-1",
+                "captured_at": __import__("time").time(),
+                "counters": {},
+                "gauges": {"serve.queue_depth{node=far-leaf}": 900.0},
+                "histograms": {},
+            }
+        )
+        local = obs.HealthMonitor(
+            skew_threshold_ms=None, clamp_risk=False, degraded_syncs=False,
+            queue_depth_threshold=500.0, warn=False,
+        )
+        assert local.check()["healthy"] is True
+        fleet = obs.HealthMonitor(
+            skew_threshold_ms=None, clamp_risk=False, degraded_syncs=False,
+            queue_depth_threshold=500.0, federated=True, warn=False,
+        )
+        report = fleet.check()
+        assert {w["kind"] for w in report["warnings"]} == {"queue_saturation"}
+
+    def test_per_node_recompile_storm_names_the_node(self):
+        obs.enable(True)
+        obs.accept_snapshot(
+            {
+                "node": "stormy-leaf",
+                "captured_at": __import__("time").time(),
+                "counters": {"step.traces{step=epoch}": 64.0},
+                "gauges": {},
+                "histograms": {},
+            }
+        )
+        monitor = obs.HealthMonitor(
+            skew_threshold_ms=None, clamp_risk=False, degraded_syncs=False,
+            recompile_threshold=8, federated=True, warn=False,
+        )
+        report = monitor.check()
+        storm = [w for w in report["warnings"] if w["kind"] == "recompile_storm"]
+        assert storm and "stormy-leaf" in storm[0]["detail"]
