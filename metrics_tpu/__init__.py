@@ -112,6 +112,7 @@ from metrics_tpu.text import (  # noqa: E402, F401
     WordInfoLost,
     WordInfoPreserved,
 )
+from metrics_tpu import engine  # noqa: E402, F401
 from metrics_tpu import ft  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
 from metrics_tpu import serve  # noqa: E402, F401
@@ -196,6 +197,7 @@ __all__ = [
     "make_stream_step",
     "register_state_reduction",
     "debug_checks",
+    "engine",
     "ft",
     "obs",
     "serve",

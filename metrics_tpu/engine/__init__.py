@@ -1,0 +1,54 @@
+"""Ahead-of-time metric programs, persistent executables, warm revival.
+
+The execution-engine subsystem (``docs/execution_engine.md``): one metric
+definition runs behind a pluggable :class:`ExecutionEngine` — eager CPU
+(the reference's no-compile semantics), ``jax.jit`` (today's default), or
+AOT with executables serialized through a :class:`ProgramStore` keyed by
+:class:`ProgramKey` (tenant schema fingerprint x input shapes/dtypes x
+static config x backend x jax version x topology). The serving tier uses
+it to eliminate cold starts: ``Aggregator(engine="aot")`` pre-lowers its
+per-tenant stacked-fold programs at registration, checkpoints carry a
+warmup manifest, and a revived node's ``warmup()`` restores states AND
+executables together — first fold, zero backend compiles.
+"""
+from metrics_tpu.engine.engine import (
+    AotEngine,
+    CompiledProgram,
+    EagerEngine,
+    ExecutionEngine,
+    JitEngine,
+    compile_program,
+    configure,
+    default_store,
+    environment_manifest,
+    get_engine,
+    reset_memory_cache,
+)
+from metrics_tpu.engine.keys import (
+    ProgramKey,
+    abstractify,
+    environment_mismatches,
+    input_signature,
+    topology_fingerprint,
+)
+from metrics_tpu.engine.store import ProgramStore
+
+__all__ = [
+    "AotEngine",
+    "CompiledProgram",
+    "EagerEngine",
+    "ExecutionEngine",
+    "JitEngine",
+    "ProgramKey",
+    "ProgramStore",
+    "abstractify",
+    "compile_program",
+    "configure",
+    "default_store",
+    "environment_manifest",
+    "environment_mismatches",
+    "get_engine",
+    "input_signature",
+    "reset_memory_cache",
+    "topology_fingerprint",
+]

@@ -1,0 +1,265 @@
+"""The execution-engine layer: one metric definition, pluggable backends.
+
+PAPER.md's reference runs eager torch ops — no compile step, no cold
+start. The tracing/XLA pipeline buys this repo its ~800x hot-path wins but
+introduces a latency class the reference never had: the first call of
+every distinct program pays trace + lower + backend compile. This module
+makes that cost a *managed artifact* instead of an ambient tax:
+
+* :class:`ExecutionEngine` — the protocol. An engine takes a lowerable
+  target (a jitted callable, or the ``make_epoch`` family's epoch wrapper,
+  which re-exports ``.lower``) plus a :class:`~metrics_tpu.engine.keys.ProgramKey`
+  and returns the callable to execute with. The split follows PAPER.md's
+  L1/L2 cut: the stateful class API stays eager (L1), the pure kernels
+  (L2) are what engines compile and cache.
+* :class:`EagerEngine` — no compile ever: the target's Python body runs
+  op-by-op. The reference semantics, for debugging and tiny-workload CPU
+  serving.
+* :class:`JitEngine` — today's behavior: ``jax.jit`` with its in-process
+  cache. First call per signature compiles.
+* :class:`AotEngine` — ahead-of-time: programs are lowered on
+  ``ShapeDtypeStruct``s, compiled once, and **serialized through a
+  persistent** :class:`~metrics_tpu.engine.ProgramStore`. A later process
+  (a revived serving node, a fresh autoscale replica) loads the executable
+  with zero backend compiles. :func:`compile_program` is the engine's
+  heart and is also usable standalone.
+
+Every :func:`compile_program` resolution is counted — ``compile.cache_hits
+{step=,tier=memory|disk}`` / ``compile.cache_misses{step=}`` — through the
+same registry the jax.monitoring listener feeds, so warm-start efficacy is
+a first-class observable (``obs.snapshot()`` / ``/metrics``).
+"""
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu.engine.keys import ProgramKey, abstractify
+from metrics_tpu.engine.store import ProgramStore
+from metrics_tpu.obs.registry import inc as _obs_inc
+
+__all__ = [
+    "AotEngine",
+    "CompiledProgram",
+    "EagerEngine",
+    "ExecutionEngine",
+    "JitEngine",
+    "compile_program",
+    "configure",
+    "default_store",
+    "get_engine",
+    "reset_memory_cache",
+]
+
+_ENV_STORE = "METRICS_TPU_PROGRAM_CACHE"
+
+_lock = threading.Lock()
+_config: Dict[str, Any] = {"store_dir": os.environ.get(_ENV_STORE) or None}
+_default_store: Optional[ProgramStore] = None
+# process-level registry of already-resolved programs: digest -> program.
+# The memory tier exists so a node asks the disk exactly once per program.
+_programs: Dict[str, "CompiledProgram"] = {}
+
+
+def configure(store_dir: "os.PathLike | str | None" = None) -> Dict[str, Any]:
+    """Set the default :class:`ProgramStore` directory (None disables the
+    disk tier for engines that don't carry their own store). Returns the
+    live config."""
+    global _default_store
+    with _lock:
+        _config["store_dir"] = None if store_dir is None else os.fspath(store_dir)
+        _default_store = None
+    return dict(_config)
+
+
+def default_store() -> Optional[ProgramStore]:
+    """The configured default store (lazily constructed), or None."""
+    global _default_store
+    with _lock:
+        if _default_store is None and _config["store_dir"] is not None:
+            _default_store = ProgramStore(_config["store_dir"])
+        return _default_store
+
+
+def reset_memory_cache() -> int:
+    """Drop every in-memory resolved program (the disk store is untouched);
+    returns the number dropped. Tests and cold-vs-warm benchmarks use this
+    to re-measure the disk tier inside one process."""
+    with _lock:
+        n = len(_programs)
+        _programs.clear()
+    return n
+
+
+class CompiledProgram:
+    """One resolved executable: ``key`` + the callable + where it came from
+    (``"memory"`` / ``"disk"`` / ``"compiled"``)."""
+
+    __slots__ = ("key", "source", "_call")
+
+    def __init__(self, key: ProgramKey, call: Callable, source: str) -> None:
+        self.key = key
+        self.source = source
+        self._call = call
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self._call(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        return f"CompiledProgram(step={self.key.step!r}, source={self.source!r})"
+
+
+def compile_program(
+    target: Any,
+    key: ProgramKey,
+    *args: Any,
+    store: Optional[ProgramStore] = None,
+    use_default_store: bool = True,
+    **kwargs: Any,
+) -> CompiledProgram:
+    """Resolve the executable for calling ``target`` with ``(args, kwargs)``.
+
+    Resolution order — each tier counted under its own label:
+
+    1. **memory** (``compile.cache_hits{tier=memory}``): this process
+       already resolved the digest.
+    2. **disk** (``compile.cache_hits{tier=disk}``): the store holds a
+       valid serialized executable — deserialized straight into the
+       runtime, zero backend compiles.
+    3. **compile** (``compile.cache_misses``): AOT trace+lower+compile on
+       ``ShapeDtypeStruct``s (concrete/donated buffers are never read),
+       then serialize into the store for the next process.
+
+    ``target`` must expose ``.lower`` (a ``jax.jit`` result or the
+    ``make_epoch`` family's epoch wrapper). ``args``/``kwargs`` may be
+    concrete arrays or ``ShapeDtypeStruct``s — only metadata is used.
+    """
+    digest = key.digest()
+    with _lock:
+        hit = _programs.get(digest)
+    if hit is not None:
+        _obs_inc("compile.cache_hits", step=key.step, tier="memory")
+        return hit
+    if store is None and use_default_store:
+        store = default_store()
+    if store is not None:
+        loaded = store.load(key)
+        if loaded is not None:
+            program = CompiledProgram(key, loaded, "disk")
+            _obs_inc("compile.cache_hits", step=key.step, tier="disk")
+            with _lock:
+                _programs[digest] = program
+            return program
+    _obs_inc("compile.cache_misses", step=key.step)
+    from metrics_tpu.obs.recompile import suppress_note_trace
+
+    lower = getattr(target, "lower", None)
+    if lower is None:
+        raise TypeError(
+            f"compile_program target for {key.step!r} has no .lower — pass a"
+            " jax.jit result or a make_epoch/make_stream_step/"
+            "make_collection_epoch epoch (jit_epoch=True)"
+        )
+    aval_args, aval_kwargs = abstractify(args, kwargs)
+    with suppress_note_trace():
+        compiled = lower(*aval_args, **aval_kwargs).compile()
+    if store is not None:
+        store.save(key, compiled)
+    program = CompiledProgram(key, compiled, "compiled")
+    with _lock:
+        _programs[digest] = program
+    return program
+
+
+class ExecutionEngine:
+    """Protocol-ish base: an engine resolves (target, key, call signature)
+    to the callable the hot path executes. Subclasses override
+    :meth:`prepare`; ``name`` selects them by string."""
+
+    name = "abstract"
+
+    def prepare(self, target: Any, key: ProgramKey, *args: Any, **kwargs: Any) -> Callable:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+class EagerEngine(ExecutionEngine):
+    """No compilation: execute the target's eager/Python form. ``target``
+    here is the UN-jitted body (callers pass the right form — e.g.
+    ``make_epoch(..., engine="eager")`` keeps the epoch un-jitted)."""
+
+    name = "eager"
+
+    def prepare(self, target: Any, key: ProgramKey, *args: Any, **kwargs: Any) -> Callable:
+        return getattr(target, "__eager__", target)
+
+
+class JitEngine(ExecutionEngine):
+    """Status quo: the jitted target itself (in-process jit cache, first
+    call per signature compiles)."""
+
+    name = "jit"
+
+    def prepare(self, target: Any, key: ProgramKey, *args: Any, **kwargs: Any) -> Callable:
+        return target
+
+
+class AotEngine(ExecutionEngine):
+    """Ahead-of-time with a persistent executable store.
+
+    Args:
+        store: the :class:`ProgramStore` to load/save serialized
+            executables through. ``None`` uses the module default
+            (:func:`configure`); if that is also unset the engine still
+            AOT-compiles (memory tier only) — correct, just not
+            persistent.
+    """
+
+    name = "aot"
+
+    def __init__(self, store: Optional[ProgramStore] = None) -> None:
+        self.store = store
+
+    def prepare(self, target: Any, key: ProgramKey, *args: Any, **kwargs: Any) -> Callable:
+        return compile_program(target, key, *args, store=self.store, **kwargs)
+
+    def __repr__(self) -> str:
+        return f"AotEngine(store={self.store!r})"
+
+
+_ENGINES: Dict[str, Callable[[], ExecutionEngine]] = {
+    "eager": EagerEngine,
+    "jit": JitEngine,
+    "aot": AotEngine,
+}
+
+
+def get_engine(spec: Any) -> Optional[ExecutionEngine]:
+    """Resolve an engine spec: None -> None (caller keeps its default
+    path), an :class:`ExecutionEngine` -> itself, ``"eager"``/``"jit"``/
+    ``"aot"`` -> a fresh instance (``"aot"`` with the default store)."""
+    if spec is None or isinstance(spec, ExecutionEngine):
+        return spec
+    try:
+        factory = _ENGINES[str(spec)]
+    except KeyError:
+        raise ValueError(
+            f"unknown execution engine {spec!r}; expected one of"
+            f" {sorted(_ENGINES)} or an ExecutionEngine instance"
+        ) from None
+    return factory()
+
+
+def environment_manifest() -> Dict[str, Any]:
+    """The live compile environment as a warmup-manifest header — what
+    restore paths validate before trusting recorded program keys."""
+    import jax
+
+    from metrics_tpu.engine.keys import topology_fingerprint
+
+    return {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "topology": topology_fingerprint(),
+    }

@@ -1,0 +1,209 @@
+"""Program cache keys: WHAT makes two compiled metric programs the same.
+
+A serialized executable is only reusable when everything that shaped it is
+identical — the traced computation (the metric/tenant schema), the input
+shapes and dtypes, the static configuration baked into the trace, and the
+environment that compiled it (backend, device topology, jax version: XLA
+serialization is not portable across any of them). :class:`ProgramKey`
+captures exactly that tuple and nothing else; its :meth:`~ProgramKey.digest`
+names the cache entry.
+
+The **schema fingerprint is the data half of the key**: two tenants whose
+sketches differ only in bin count have different
+:func:`~metrics_tpu.serve.wire.schema_fingerprint` values, therefore
+different keys — a collision there would fold one tenant's payloads with
+the other's executable, which is why the fingerprint (not the tenant id,
+which is operator-chosen and reusable) keys the program
+(``tests/engine/test_engine.py`` pins the discipline).
+"""
+import hashlib
+import json
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, Tuple
+
+__all__ = [
+    "ProgramKey",
+    "abstractify",
+    "environment_mismatches",
+    "input_signature",
+    "topology_fingerprint",
+]
+
+
+def environment_mismatches(recorded: Dict[str, Any]) -> Dict[str, Tuple[Any, Any]]:
+    """``{field: (recorded, live)}`` for every compile-environment field
+    (jax version / backend / topology) in ``recorded`` that differs from
+    the live process — the ONE comparison every validation site shares
+    (store loads, warmup manifests, :meth:`ProgramKey.environment_mismatches`).
+    Fields absent from ``recorded`` are not mismatches."""
+    import jax
+
+    live = {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "topology": topology_fingerprint(),
+    }
+    return {
+        field: (recorded.get(field), now)
+        for field, now in live.items()
+        if recorded.get(field) is not None and recorded.get(field) != now
+    }
+
+
+_topology_cache: "str | None" = None
+
+
+def topology_fingerprint() -> str:
+    """The live process's compile environment: platform, device kind,
+    device count, process count — everything a serialized executable is
+    pinned to besides the jax version. Computed once per process (key
+    construction sits near dispatch paths); configure the backend/mesh
+    BEFORE the first engine use, like every other jax platform setting."""
+    global _topology_cache
+    if _topology_cache is None:
+        import jax
+
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", str(dev))
+        _topology_cache = (
+            f"{dev.platform}:{kind}:d{jax.device_count()}:p{jax.process_count()}"
+        )
+    return _topology_cache
+
+
+def _leaf_sig(leaf: Any) -> Any:
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        return [str(leaf.dtype), list(leaf.shape)]
+    return ["py", repr(leaf)]
+
+
+def input_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Tuple[Any, ...]:
+    """Canonical (shape, dtype) signature of a call: every array-like leaf
+    of the flattened ``(args, kwargs)`` in tree order, non-arrays by repr.
+    JSON-serializable (the key digest and the warmup manifest both carry
+    it)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, dict(kwargs)))
+    return (str(treedef), tuple(json.dumps(_leaf_sig(leaf)) for leaf in leaves))
+
+
+def abstractify(args: Tuple[Any, ...], kwargs: Dict[str, Any]):
+    """Replace every array-like leaf with a ``ShapeDtypeStruct`` — the
+    zero-materialization call signature AOT lowering runs on (donated or
+    device-resident buffers are never touched, only their metadata)."""
+    import jax
+
+    def _abs(leaf: Any) -> Any:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(_abs, (tuple(args), dict(kwargs)))
+
+
+@dataclass(frozen=True)
+class ProgramKey:
+    """Identity of one compiled metric program.
+
+    Args:
+        step: the program's step label (``"Accuracy.epoch"``,
+            ``"serve.fold_stacked"`` ...) — the ``step=`` label on the
+            cache-hit/miss counters and the manifest's human handle.
+        fingerprint: the data-schema half — a
+            :func:`~metrics_tpu.serve.wire.schema_fingerprint` (tenant or
+            metric template). Two programs over different schemas must
+            never share an executable even if their traced shapes collide.
+        input_sig: canonical input signature (:func:`input_signature`).
+        static_sig: static configuration baked into the trace (e.g. the
+            fold's reduction tuple) as a stable string.
+        backend: jax platform the program was (or will be) compiled for.
+        jax_version: serialized executables are not portable across jax
+            releases; the version rides the key so an upgraded process
+            computes different digests and recompiles instead of loading
+            a stale artifact.
+        topology: :func:`topology_fingerprint` of the compiling process.
+    """
+
+    step: str
+    fingerprint: str
+    input_sig: Tuple[Any, ...]
+    static_sig: str = ""
+    backend: str = ""
+    jax_version: str = ""
+    topology: str = ""
+
+    @classmethod
+    def build(
+        cls,
+        step: str,
+        fingerprint: str,
+        args: Tuple[Any, ...] = (),
+        kwargs: Dict[str, Any] = None,
+        static_sig: str = "",
+    ) -> "ProgramKey":
+        """Key for calling a program with ``(args, kwargs)`` in the LIVE
+        process (backend/jax version/topology filled in from it)."""
+        import jax
+
+        return cls(
+            step=str(step),
+            fingerprint=str(fingerprint),
+            input_sig=input_signature(tuple(args), dict(kwargs or {})),
+            static_sig=str(static_sig),
+            backend=jax.default_backend(),
+            jax_version=jax.__version__,
+            topology=topology_fingerprint(),
+        )
+
+    def digest(self) -> str:
+        blob = json.dumps(asdict(self), sort_keys=True, default=str).encode()
+        return hashlib.sha256(blob).hexdigest()[:32]
+
+    def to_manifest(self) -> Dict[str, Any]:
+        """JSON-ready form for a warmup manifest entry."""
+        entry = asdict(self)
+        entry["input_sig"] = [self.input_sig[0], list(self.input_sig[1])]
+        entry["digest"] = self.digest()
+        return entry
+
+    @classmethod
+    def from_manifest(cls, entry: Dict[str, Any]) -> "ProgramKey":
+        return cls(
+            step=entry["step"],
+            fingerprint=entry["fingerprint"],
+            input_sig=(entry["input_sig"][0], tuple(entry["input_sig"][1])),
+            static_sig=entry.get("static_sig", ""),
+            backend=entry.get("backend", ""),
+            jax_version=entry.get("jax_version", ""),
+            topology=entry.get("topology", ""),
+        )
+
+    def environment_mismatches(self) -> Dict[str, Tuple[str, str]]:
+        """``{field: (recorded, live)}`` for every environment field that
+        differs from the live process — the loud-warn-then-recompile
+        validation restore paths run (never a crash, never a silently
+        wrong executable)."""
+        return environment_mismatches(
+            {
+                "jax_version": self.jax_version or None,
+                "backend": self.backend or None,
+                "topology": self.topology or None,
+            }
+        )
+
+    def rekeyed_to_live(self) -> "ProgramKey":
+        """The same program identity with the environment fields replaced
+        by the live process's — what a mismatched manifest entry warms
+        instead (fresh compile under the correct key)."""
+        import jax
+
+        return ProgramKey(
+            step=self.step,
+            fingerprint=self.fingerprint,
+            input_sig=self.input_sig,
+            static_sig=self.static_sig,
+            backend=jax.default_backend(),
+            jax_version=jax.__version__,
+            topology=topology_fingerprint(),
+        )

@@ -1,0 +1,198 @@
+"""Persistent serialized-executable store: compile once, revive warm.
+
+One :class:`ProgramStore` is a directory of XLA executables serialized by
+``jax.experimental.serialize_executable`` and keyed by
+:class:`~metrics_tpu.engine.keys.ProgramKey` digests. Loading an entry
+deserializes the compiled artifact directly into the runtime — **zero
+tracing, zero lowering, zero backend compiles** (the compile-listener
+assertion in ``tests/integrations/aot_smoke.py`` pins exactly that) —
+which is what turns a revived or freshly autoscaled serving node's
+minutes-of-degraded-freshness cold start into a sub-millisecond load.
+
+Trust and validity:
+
+* Entries are **pickle-based** (that is what jax's serializer emits).
+  A store directory is therefore as trusted as a checkpoint directory —
+  point it only at paths this deployment writes. It is NOT a transport
+  format; the wire layer never carries executables.
+* Every entry has a JSON **sidecar** recording the environment it was
+  compiled under (jax version, backend, topology). A load validates the
+  sidecar against the live process and the requested key; any mismatch —
+  or any deserialization failure — is a loud one-shot-warned MISS, never
+  a crash and never a silently wrong executable (a spoofed/stale entry
+  falls back to a fresh compile).
+* Writes are atomic: payload first, sidecar last via ``os.replace`` — a
+  kill mid-write leaves an entry without a sidecar, which loads ignore
+  and the next :meth:`ProgramStore.save` overwrites.
+"""
+import json
+import os
+import pickle
+import time
+import uuid
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from metrics_tpu.engine.keys import ProgramKey, environment_mismatches
+from metrics_tpu.obs.registry import inc as _obs_inc
+
+__all__ = ["ProgramStore"]
+
+_PAYLOAD_SUFFIX = ".prog"
+_SIDECAR_SUFFIX = ".json"
+
+
+class ProgramStore:
+    """Directory-backed cache of serialized compiled programs.
+
+    Args:
+        directory: root for ``<digest>.prog`` / ``<digest>.json`` entry
+            pairs (created lazily on first save).
+
+    Thread-safety: saves are atomic renames and loads read published pairs
+    only, so concurrent readers/writers see complete entries or nothing.
+    """
+
+    def __init__(self, directory: "os.PathLike | str") -> None:
+        self.directory = os.fspath(os.path.abspath(directory))
+        self._warned_invalid = False
+
+    def __repr__(self) -> str:
+        return f"ProgramStore({self.directory!r})"
+
+    def _paths(self, digest: str) -> Tuple[str, str]:
+        base = os.path.join(self.directory, digest)
+        return base + _PAYLOAD_SUFFIX, base + _SIDECAR_SUFFIX
+
+    def entries(self) -> Dict[str, Dict[str, Any]]:
+        """``{digest: sidecar}`` of every complete (sidecar-bearing) entry."""
+        out: Dict[str, Dict[str, Any]] = {}
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if not name.endswith(_SIDECAR_SUFFIX):
+                continue
+            digest = name[: -len(_SIDECAR_SUFFIX)]
+            payload, sidecar = self._paths(digest)
+            if not os.path.isfile(payload):
+                continue
+            try:
+                with open(sidecar) as f:
+                    out[digest] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    # ------------------------------------------------------------------
+
+    def save(self, key: ProgramKey, compiled: Any) -> str:
+        """Serialize ``compiled`` under ``key``; returns the payload path.
+        Failures (an unserializable backend executable) warn once and
+        return "" — the in-memory program still serves this process."""
+        from jax.experimental import serialize_executable as _se
+
+        digest = key.digest()
+        payload_path, sidecar_path = self._paths(digest)
+        os.makedirs(self.directory, exist_ok=True)
+        try:
+            blob, in_tree, out_tree = _se.serialize(compiled)
+            payload = pickle.dumps(
+                {"blob": blob, "in_tree": in_tree, "out_tree": out_tree},
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        except Exception as err:  # noqa: BLE001 — backend-specific serializers
+            self._warn_invalid(f"could not serialize program {key.step!r}: {err}")
+            _obs_inc("compile.store_errors", step=key.step, kind="serialize")
+            return ""
+        sidecar = dict(key.to_manifest())
+        sidecar["created_unix"] = time.time()
+        sidecar["nbytes"] = len(payload)
+        # per-writer unique staging names: a shared store directory means
+        # two cold-starting processes can save the same digest
+        # concurrently, and a FIXED tmp name would interleave their writes
+        # into a corrupt published payload
+        suffix = f".tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        tmp = payload_path + suffix
+        tmp_side = sidecar_path + suffix
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, payload_path)
+            with open(tmp_side, "w") as f:
+                json.dump(sidecar, f, indent=2, sort_keys=True)
+            os.replace(tmp_side, sidecar_path)
+        except OSError as err:
+            for leftover in (tmp, tmp_side):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+            self._warn_invalid(f"could not persist program {key.step!r}: {err}")
+            _obs_inc("compile.store_errors", step=key.step, kind="write")
+            return ""
+        return payload_path
+
+    def load(self, key: ProgramKey) -> Optional[Any]:
+        """The deserialized executable for ``key``, or None (miss).
+
+        A hit is only served when the sidecar's recorded jax version /
+        backend / topology match BOTH the requested key and the live
+        process — a stale or spoofed entry (e.g. a manifest carried over
+        from another jax release) is refused with a one-shot warning and
+        the caller compiles fresh.
+        """
+        from jax.experimental import serialize_executable as _se
+
+        digest = key.digest()
+        payload_path, sidecar_path = self._paths(digest)
+        if not (os.path.isfile(payload_path) and os.path.isfile(sidecar_path)):
+            return None
+        try:
+            with open(sidecar_path) as f:
+                sidecar = json.load(f)
+        except (OSError, ValueError) as err:
+            self._warn_invalid(f"unreadable sidecar for {key.step!r} ({err}); recompiling")
+            _obs_inc("compile.store_errors", step=key.step, kind="sidecar")
+            return None
+        mismatches = environment_mismatches(sidecar)
+        # a sidecar MISSING an environment field is as untrusted as a
+        # mismatching one (environment_mismatches skips absent fields)
+        missing = [
+            f for f in ("jax_version", "backend", "topology") if sidecar.get(f) is None
+        ]
+        for field in missing:
+            mismatches[field] = (None, "<required>")
+        if mismatches:
+            field, (recorded, now) = sorted(mismatches.items())[0]
+            self._warn_invalid(
+                f"stored program {key.step!r} was compiled under {field}="
+                f"{recorded!r} but this process runs {now!r}; refusing the"
+                " cached executable and compiling fresh"
+            )
+            for field in mismatches:
+                _obs_inc("compile.store_invalid", step=key.step, field=field)
+            return None
+        try:
+            with open(payload_path, "rb") as f:
+                entry = pickle.loads(f.read())
+            return _se.deserialize_and_load(entry["blob"], entry["in_tree"], entry["out_tree"])
+        except Exception as err:  # noqa: BLE001 — a corrupt entry must be a miss
+            self._warn_invalid(
+                f"could not deserialize stored program {key.step!r} ({err}); recompiling"
+            )
+            _obs_inc("compile.store_errors", step=key.step, kind="deserialize")
+            return None
+
+    def _warn_invalid(self, message: str) -> None:
+        if self._warned_invalid:
+            return
+        self._warned_invalid = True
+        warnings.warn(
+            f"ProgramStore({self.directory}): {message}. Further store"
+            " faults are counted under compile.store_invalid /"
+            " compile.store_errors without warning again.",
+            RuntimeWarning,
+            stacklevel=3,
+        )

@@ -48,7 +48,7 @@ from metrics_tpu.ft.retry import (
     get_retry_policy,
     reset_degraded_warnings,
 )
-from metrics_tpu.ft.manager import CheckpointManager
+from metrics_tpu.ft.manager import CheckpointManager, validate_manifest_environment
 
 __all__ = [
     "AttemptTimeout",
@@ -64,4 +64,5 @@ __all__ = [
     "get_retry_policy",
     "reset_degraded_warnings",
     "trim_epoch_batches",
+    "validate_manifest_environment",
 ]

@@ -55,12 +55,70 @@ from metrics_tpu.utilities.checkpoint import (
     metric_state_to_tree,
 )
 
-__all__ = ["CheckpointManager", "MANIFEST_NAME", "STATE_DIR"]
+__all__ = [
+    "CheckpointManager",
+    "MANIFEST_NAME",
+    "STATE_DIR",
+    "validate_manifest_environment",
+]
 
 MANIFEST_NAME = "manifest.json"
 STATE_DIR = "state"
 _CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
 _MANIFEST_SCHEMA = 1
+
+# one-shot guard for the restore-time environment validation warning
+_warned_env_mismatch = False
+
+
+def validate_manifest_environment(manifest: Dict[str, Any], context: str = "restore") -> Dict[str, Any]:
+    """Compare a checkpoint manifest's recorded jax version / backend /
+    process topology against the live process.
+
+    Returns ``{field: {"recorded": ..., "live": ...}}`` for every
+    mismatching field (empty = clean). A mismatch is a LOUD one-shot
+    ``rank_zero_warn`` plus ``ft.manifest_env_mismatches{field=}`` counters
+    — never an exception: states restore fine across jax versions (orbax
+    arrays are portable), but anything derived from the compile
+    environment (cached executables, AOT warmup manifests, topology-
+    dependent shards) must be rebuilt fresh, and the operator must see
+    why their revival ran cold."""
+    import jax
+
+    live: Dict[str, Any] = {
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "process_count": jax.process_count(),
+        "device_count": jax.device_count(),
+    }
+    mismatches: Dict[str, Any] = {}
+    for field, now in live.items():
+        recorded = manifest.get(field)
+        if recorded is not None and recorded != now:
+            mismatches[field] = {"recorded": recorded, "live": now}
+    if mismatches:
+        if _obs_enabled():
+            for field in mismatches:
+                _obs_inc("ft.manifest_env_mismatches", field=field)
+        global _warned_env_mismatch
+        if not _warned_env_mismatch:
+            _warned_env_mismatch = True
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            detail = "; ".join(
+                f"{field}: checkpoint={m['recorded']!r} live={m['live']!r}"
+                for field, m in sorted(mismatches.items())
+            )
+            rank_zero_warn(
+                f"Checkpoint {context}: the manifest was recorded under a different"
+                f" environment ({detail}). States restore fine, but cached"
+                " executables / AOT warmup manifests from that environment are"
+                " invalid here and will be recompiled fresh (cold first fold)."
+                " Further mismatches are counted under ft.manifest_env_mismatches"
+                " without warning again.",
+                RuntimeWarning,
+            )
+    return mismatches
 
 
 class CheckpointManager:
@@ -351,6 +409,7 @@ class CheckpointManager:
 
         from metrics_tpu import obs
 
+        dev = jax.devices()[0]
         manifest: Dict[str, Any] = {
             "schema": _MANIFEST_SCHEMA,
             "seq": seq,
@@ -360,6 +419,8 @@ class CheckpointManager:
             # discovery never reads this field (seq order only)
             "recorded_unix": _faults.now(),
             "jax_version": jax.__version__,
+            "backend": jax.default_backend(),
+            "device_kind": getattr(dev, "device_kind", str(dev)),
             "process_index": jax.process_index(),
             "process_count": jax.process_count(),
             "device_count": jax.device_count(),
@@ -436,6 +497,10 @@ class CheckpointManager:
             return None
         with open(os.path.join(path, MANIFEST_NAME)) as f:
             manifest = json.load(f)
+        # loud one-shot validation of the recorded jax version / topology
+        # against the live process — a mismatch restores states but warns
+        # that compile-environment-derived artifacts must be rebuilt
+        validate_manifest_environment(manifest, context=f"restore from {path}")
         with ocp.PyTreeCheckpointer() as ckptr:
             tree = ckptr.restore(os.path.join(os.fspath(os.path.abspath(path)), STATE_DIR))
         load_metric_state_tree(metric, tree)

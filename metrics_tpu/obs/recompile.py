@@ -19,8 +19,15 @@ because jax retraces without a word. Three hooks make it visible:
 * :func:`install_compile_listener` — registers a ``jax.monitoring``
   duration listener so EVERY backend compile in the process (not just ones
   routed through ``make_step``) lands in ``jax.compile_seconds`` /
-  ``jax.compiles``. Best-effort: silently unavailable on jax builds
-  without the listener API.
+  ``jax.compiles``, plus (same call, same opt-in) an event listener for
+  jax's persistent-compilation-cache hits and misses —
+  ``compile.cache_hits{tier=jax_persistent}`` /
+  ``compile.cache_misses{tier=jax_persistent}``. Together with the
+  :mod:`metrics_tpu.engine` program-store counters (same families,
+  ``step=``/``tier=`` labels) they make warm-start efficacy observable:
+  a revived serving node that really started warm shows cache hits and
+  ZERO ``jax.compiles`` growth on its first fold. Best-effort: silently
+  unavailable on jax builds without the listener API.
 
 All three are inert unless the registry is enabled; ``note_trace`` in a
 traced body adds zero operations to the program (a Python-level counter
@@ -269,8 +276,22 @@ def install_compile_listener() -> bool:
             _reg.inc("jax.compile_seconds", duration)
             _reg.inc("jax.compiles")
 
+    def _on_event(event: str, **kwargs: Any) -> None:
+        # jax's persistent compilation cache (jax_compilation_cache_dir)
+        # emits one event per compile request it resolves: a hit means the
+        # backend compile was skipped (an executable deserialized from the
+        # cache dir), a miss means it was paid and the result stored.
+        # Counted under the same compile.cache_* families the engine's
+        # program store uses, distinguished by tier=.
+        if event.endswith("/compilation_cache/cache_hits"):
+            _reg.inc("compile.cache_hits", tier="jax_persistent")
+        elif event.endswith("/compilation_cache/cache_misses"):
+            _reg.inc("compile.cache_misses", tier="jax_persistent")
+
     try:
         monitoring.register_event_duration_secs_listener(_on_duration)
+        if hasattr(monitoring, "register_event_listener"):
+            monitoring.register_event_listener(_on_event)
     except Exception:
         return False
     _listener_installed = True

@@ -147,9 +147,25 @@ class _ClientSlot:
 
 
 class _Tenant:
-    """Registry entry: schema, leaf layout, client snapshots, merged view."""
+    """Registry entry: schema, leaf layout, client snapshots, merged view.
 
-    def __init__(self, tenant_id: str, collection: Any, node: str = "?") -> None:
+    ``engine`` (an :class:`~metrics_tpu.engine.ExecutionEngine` resolving
+    AOT programs) and ``eager_fold`` select the fold backend: with an
+    engine, every fold bucket runs through ONE pre-resolvable executable
+    keyed by the tenant's schema fingerprint (the cache-key discipline:
+    two tenants differing only in sketch bin count have different
+    fingerprints, therefore different programs); with ``eager_fold`` the
+    fold is plain numpy (no compile ever — tiny-fleet CPU serving);
+    neither keeps the default jitted ``_fold_stacked`` path."""
+
+    def __init__(
+        self,
+        tenant_id: str,
+        collection: Any,
+        node: str = "?",
+        engine: Any = None,
+        eager_fold: bool = False,
+    ) -> None:
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.streaming.sketches import Sketch
         from metrics_tpu.utilities.checkpoint import metric_state_to_tree
@@ -216,6 +232,15 @@ class _Tenant:
             _is_identity(leaf, red) for leaf, (_, red) in zip(self.template_leaves, self.spec)
         )
 
+        self.engine = engine
+        self.eager_fold = bool(eager_fold)
+        # bucket (padded client count) -> resolved executable; warm_buckets
+        # records every bucket this tenant ever folded or pre-lowered — the
+        # warmup manifest the checkpoint carries so a revived node replays
+        # exactly the programs its predecessor ran
+        self.fold_programs: Dict[int, Any] = {}
+        self.warm_buckets: set = set()
+
         self.clients: Dict[str, _ClientSlot] = {}
         self.dirty = False
         self.lock = threading.Lock()
@@ -261,6 +286,45 @@ class _Tenant:
 
     # -- fold-side -------------------------------------------------------
 
+    def fold_program(self, bucket: int) -> Any:
+        """Resolve (or reuse) the stacked-fold executable for a ``bucket``
+        of client rows — the per-tenant AOT program ``register_tenant``
+        pre-lowers and :meth:`Aggregator.warmup` replays. The key is
+        (schema fingerprint, stacked shapes/dtypes, reduction tuple,
+        backend, jax version, topology): the schema fingerprint makes a
+        bin-count change a different program, never a collision."""
+        program = self.fold_programs.get(bucket)
+        if program is None:
+            from metrics_tpu.engine.keys import ProgramKey
+
+            reds = tuple(red for _, red in self.spec)
+            sds = tuple(
+                jax.ShapeDtypeStruct((int(bucket),) + t.shape, t.dtype)
+                for t in self.template_leaves
+            )
+            key = ProgramKey.build(
+                "serve.fold_stacked", self.schema_hash, (sds,), static_sig=repr(reds)
+            )
+            program = self.engine.prepare(_fold_stacked, key, sds, reds=reds)
+            self.fold_programs[bucket] = program
+        # under the lock: _warmup_manifest (a checkpoint save) sorts this
+        # set concurrently with worker folds adding to it
+        with self.lock:
+            self.warm_buckets.add(int(bucket))
+        return program
+
+    def prime_program(self, bucket: int) -> None:
+        """Resolve the bucket's executable AND run it once on identity
+        (template) rows: primes host->device transfer paths and proves the
+        (possibly disk-loaded) executable actually executes — a corrupt
+        cached program must fail at warmup, not under traffic."""
+        program = self.fold_program(bucket)
+        stacked = tuple(
+            jnp.asarray(np.stack([t] * int(bucket)))
+            for t in self.template_leaves
+        )
+        jax.block_until_ready(program(stacked))
+
     def fold(self) -> int:
         """Materialize the merged view from every client's latest snapshot
         in one jitted launch; returns the number of snapshots folded."""
@@ -300,13 +364,34 @@ class _Tenant:
                             " the same metric configuration."
                         )
             merged_consensus = [row[0] for row in consensus_rows]
-            pad = (_next_pow2(k) - k) if self.can_pad else 0
-            stacked = tuple(
-                jnp.asarray(np.stack(row + [self.template_leaves[i]] * pad))
-                for i, row in enumerate(rows)
-            )
-            folded = _fold_stacked(stacked, reds=tuple(red for _, red in self.spec))
-            merged = [np.asarray(x) for x in folded]
+            reds = tuple(red for _, red in self.spec)
+            if self.eager_fold:
+                # no-compile CPU backend: plain numpy reductions. Matches
+                # the jitted fold bitwise for integer/sketch-count leaves
+                # (the classes the tree invariant pins); float sums may
+                # reassociate differently — document, don't mix backends
+                # across nodes of one tree.
+                ops = {"sum": np.sum, "min": np.min, "max": np.max}
+                # pin the template dtype: np.sum silently widens int32
+                # accumulations to the platform int, and a dtype drift here
+                # would fail the payload shape/dtype check on re-encode
+                merged = [
+                    np.asarray(ops[red](np.stack(row), axis=0)).astype(
+                        template.dtype, copy=False
+                    )
+                    for red, row, template in zip(reds, rows, self.template_leaves)
+                ]
+            else:
+                pad = (_next_pow2(k) - k) if self.can_pad else 0
+                stacked = tuple(
+                    jnp.asarray(np.stack(row + [self.template_leaves[i]] * pad))
+                    for i, row in enumerate(rows)
+                )
+                if self.engine is not None:
+                    folded = self.fold_program(k + pad)(stacked)
+                else:
+                    folded = _fold_stacked(stacked, reds=reds)
+                merged = [np.asarray(x) for x in folded]
 
         tree: Dict[str, Any] = {}
         for (path, _), leaf in zip(self.spec, merged):
@@ -387,6 +472,19 @@ class Aggregator:
             of poisoned (NaN/Inf) state, and duplicate-watermark load
             shedding under queue pressure. ``None`` (default) constructs
             nothing and changes nothing.
+        engine: execution backend for the per-tenant stacked folds (see
+            :mod:`metrics_tpu.engine`). ``None``/``"jit"`` keep today's
+            jitted path; ``"eager"`` folds in plain numpy (no compile
+            ever); ``"aot"`` or an :class:`~metrics_tpu.engine.AotEngine`
+            resolves one executable per (schema fingerprint, bucket)
+            through the persistent program store — ``register_tenant``
+            pre-lowers the ``prewarm_buckets`` programs, every fold
+            bucket is recorded in the checkpoint's warmup manifest, and
+            :meth:`warmup` replays that manifest so a revived node's
+            first fold performs ZERO backend compiles
+            (``tests/integrations/aot_smoke.py`` pins it).
+        prewarm_buckets: fold bucket sizes (padded client counts)
+            ``register_tenant`` pre-lowers when an AOT engine is armed.
 
     Example::
 
@@ -409,10 +507,23 @@ class Aggregator:
         checkpoint_every: Optional[int] = None,
         flush_interval_s: float = 0.05,
         resilience: Any = None,
+        engine: Any = None,
+        prewarm_buckets: Tuple[int, ...] = (1, 2),
     ) -> None:
         if checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1 (or None), got {checkpoint_every}")
         self.name = str(name)
+        from metrics_tpu.engine import get_engine
+
+        resolved = get_engine(engine)
+        # "jit" is the default fold path already; "eager" selects the
+        # numpy fold; anything else (AotEngine / custom) resolves programs
+        self._eager_fold = resolved is not None and resolved.name == "eager"
+        self._engine = None if (resolved is None or resolved.name in ("jit", "eager")) else resolved
+        self._prewarm_buckets = tuple(int(b) for b in (prewarm_buckets or ()))
+        if any(b < 1 for b in self._prewarm_buckets):
+            raise ValueError(f"prewarm_buckets must be >= 1, got {prewarm_buckets}")
+        self._warned_warmup_mismatch = False
         self._tenants: Dict[str, _Tenant] = {}
         self._queue: "queue.Queue[Tuple[MetricPayload, float]]" = queue.Queue(maxsize=max_queue)
         self._flush_lock = threading.Lock()
@@ -463,7 +574,19 @@ class Aggregator:
         with self._registry_lock:
             if tenant_id in self._tenants:
                 raise ServeError(f"tenant {tenant_id!r} is already registered")
-            self._tenants[tenant_id] = _Tenant(tenant_id, collection, node=self.name)
+            tenant = self._tenants[tenant_id] = _Tenant(
+                tenant_id,
+                collection,
+                node=self.name,
+                engine=self._engine,
+                eager_fold=self._eager_fold,
+            )
+        if self._engine is not None:
+            # AOT: the tenant's stacked-fold programs exist BEFORE the
+            # first payload — registration is the natural pre-lower point
+            # (the schema is known, traffic has not started)
+            for bucket in self._prewarm_buckets:
+                tenant.fold_program(bucket)
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
 
@@ -997,6 +1120,108 @@ class Aggregator:
             _obs_gauge("serve.tenants", float(len(self._tenants)))
         return manifest
 
+    # ------------------------------------------------------------------
+    # Warm start (metrics_tpu.engine)
+    # ------------------------------------------------------------------
+
+    def _warmup_manifest(self) -> Optional[Dict[str, Any]]:
+        """The warmup half of a checkpoint manifest: the compile
+        environment plus every fold bucket each tenant ever resolved —
+        enough for :meth:`warmup` in a fresh process to replay exactly the
+        programs this node ran (program keys are re-derived from the
+        registered schemas, so the manifest stays small and carries no
+        executables)."""
+        if self._engine is None:
+            return None
+        from metrics_tpu.engine import environment_manifest
+
+        tenants: Dict[str, List[int]] = {}
+        for tenant_id, tenant in sorted(self._tenants.items()):
+            # snapshot under the tenant lock: a concurrent worker fold
+            # adds its bucket via fold_program() and a set mutated during
+            # sorted()'s iteration raises
+            with tenant.lock:
+                tenants[tenant_id] = sorted(tenant.warm_buckets)
+        return {"environment": environment_manifest(), "tenants": tenants}
+
+    def warmup(self, path: Optional[str] = None) -> int:
+        """Resolve and prime every fold executable BEFORE accepting traffic.
+
+        Replays the warmup manifest of the newest (or given) checkpoint —
+        tenants must be re-registered first, exactly like :meth:`restore` —
+        falling back to ``prewarm_buckets`` for tenants the manifest does
+        not name (or when no checkpoint exists). With a warm
+        :class:`~metrics_tpu.engine.ProgramStore` every program
+        deserializes straight into the runtime: the revived node's first
+        fold performs zero backend compiles. Each program is also executed
+        once on identity rows, so transfer paths are hot and a corrupt
+        cached executable fails HERE, not under traffic.
+
+        The manifest's recorded jax version / backend / topology are
+        validated against the live process: a mismatch is a loud one-shot
+        warning plus a fresh compile under the live keys (the recorded
+        keys would name executables this process must not load) — never a
+        crash, never a silently wrong executable.
+
+        Returns the number of programs resolved. No-op (0) unless the
+        aggregator was constructed with an AOT ``engine=``.
+        """
+        if self._engine is None:
+            return 0
+        warm: Dict[str, set] = {
+            tenant_id: set(self._prewarm_buckets) for tenant_id in self._tenants
+        }
+        manifest = None
+        if self._manager is not None:
+            try:
+                manifest = self._manager.read_manifest(path)
+            except (OSError, ValueError):
+                manifest = None
+        if manifest is not None:
+            serve_meta = (manifest.get("extra") or {}).get("serve") or {}
+            recorded = serve_meta.get("warmup") or {}
+            env = recorded.get("environment") or {}
+            if env:
+                from metrics_tpu.engine import environment_mismatches
+
+                mismatches = environment_mismatches(env)
+                if mismatches:
+                    if _obs_enabled():
+                        for field in mismatches:
+                            _obs_inc("compile.warmup_mismatches", field=field)
+                    if not self._warned_warmup_mismatch:
+                        self._warned_warmup_mismatch = True
+                        detail = "; ".join(
+                            f"{field}: checkpoint={old!r} live={new!r}"
+                            for field, (old, new) in sorted(mismatches.items())
+                        )
+                        warnings.warn(
+                            f"aggregator {self.name!r} warmup: the checkpoint was"
+                            f" saved under a different compile environment ({detail})."
+                            " Cached executables from that environment will NOT be"
+                            " loaded; programs are compiled fresh under the live"
+                            " keys — correct, just cold.",
+                            RuntimeWarning,
+                            stacklevel=2,
+                        )
+            for tenant_id, buckets in (recorded.get("tenants") or {}).items():
+                if tenant_id in warm:
+                    warm[tenant_id].update(int(b) for b in buckets)
+        for buckets in warm.values():
+            if not buckets:
+                # neither prewarm config nor manifest names a bucket: warm
+                # the single-client program as the minimal useful floor
+                buckets.add(1)
+        warmed = 0
+        for tenant_id, buckets in sorted(warm.items()):
+            tenant = self._tenants[tenant_id]
+            for bucket in sorted(buckets):
+                tenant.prime_program(bucket)
+                warmed += 1
+        if _obs_enabled():
+            _obs_gauge("serve.warmed_programs", float(warmed), node=self.name)
+        return warmed
+
     def _require_manager(self):
         if self._manager is None:
             raise ServeError(
@@ -1012,6 +1237,9 @@ class Aggregator:
         JSON manifest."""
         tree: Dict[str, Any] = {}
         meta: Dict[str, Any] = {"tenants": {}, "clients": {}}
+        warmup = self._warmup_manifest()
+        if warmup is not None:
+            meta["warmup"] = warmup
         if not empty:
             for t_idx, tenant_id in enumerate(sorted(self._tenants)):
                 tenant = self._tenants[tenant_id]

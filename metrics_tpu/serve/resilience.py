@@ -591,7 +591,15 @@ class Supervisor:
                 if _obs_enabled():
                     _obs_inc("serve.heals", kind="rebuild_node")
                 actions.append(
-                    {"action": "rebuild_node", "node": node.name, "restored": manifest is not None}
+                    {
+                        "action": "rebuild_node",
+                        "node": node.name,
+                        "restored": manifest is not None,
+                        # AOT-armed trees restore executables WITH state:
+                        # how many fold programs the revive warmed before
+                        # the node re-entered traffic (0 = no engine)
+                        "warmed_programs": getattr(node, "last_warmup_programs", 0),
+                    }
                 )
             elif node.aggregator.worker_alive() is False:
                 node.aggregator.start()

@@ -101,6 +101,8 @@ class AggregatorNode:
         self._probe = probe
         self._ship_seq: Optional["itertools.count"] = None
         self._killed_with_worker = False
+        # programs resolved by the last revive's warmup (0 = no AOT engine)
+        self.last_warmup_programs = 0
         # previous forward's send latency: a hop record is built BEFORE its
         # own send runs, so the wire carries the last completed measurement
         # (the serve.hop_ship_ms{node=} histogram carries every one)
@@ -327,6 +329,14 @@ class AggregationTree:
         checkpoint_root: when set, the ROOT aggregator checkpoints under
             this directory (the root is the state of record; interior
             nodes are reconstructable from their children's next ships).
+        engine: execution backend every node's aggregator folds with (see
+            :class:`~metrics_tpu.serve.Aggregator`). An engine spec is
+            resolved ONCE so all nodes share one
+            :class:`~metrics_tpu.engine.ProgramStore` — and since the
+            tenants share schemas, the whole tree shares each bucket's
+            executable. :meth:`revive` then restores a killed node's
+            states AND executables together (``warmup()`` before the node
+            re-enters traffic).
 
     Example::
 
@@ -348,17 +358,27 @@ class AggregationTree:
         checkpoint_root: Optional[str] = None,
         max_queue: int = 65536,
         resilience: Any = None,
+        engine: Any = None,
     ) -> None:
         if any(int(n) < 1 for n in fan_out):
             raise ValueError(f"fan_out entries must be >= 1, got {tuple(fan_out)}")
+        from metrics_tpu.engine import get_engine
+
         # retained so a Supervisor heal (revive) can rebuild a dead node
-        # with the same registration and policy the original carried
+        # with the same registration and policy the original carried;
+        # the engine is resolved ONCE so every node (and every revival)
+        # shares the same program store and in-memory executables
         self.tenant_factories = dict(tenants)
         self._checkpoint_root = checkpoint_root
         self._max_queue = int(max_queue)
         self._resilience = resilience
+        self._engine = get_engine(engine)
         root_agg = Aggregator(
-            "root", checkpoint_dir=checkpoint_root, max_queue=max_queue, resilience=resilience
+            "root",
+            checkpoint_dir=checkpoint_root,
+            max_queue=max_queue,
+            resilience=resilience,
+            engine=self._engine,
         )
         self.root = AggregatorNode(root_agg)
         self.levels: List[List[AggregatorNode]] = [[self.root]]
@@ -366,7 +386,12 @@ class AggregationTree:
             parents = self.levels[-1]
             level = []
             for i in range(int(width)):
-                agg = Aggregator(f"L{depth + 1}.{i}", max_queue=max_queue, resilience=resilience)
+                agg = Aggregator(
+                    f"L{depth + 1}.{i}",
+                    max_queue=max_queue,
+                    resilience=resilience,
+                    engine=self._engine,
+                )
                 level.append(AggregatorNode(agg, parent=parents[i % len(parents)]))
             self.levels.append(level)
         for tenant_id, factory in tenants.items():
@@ -419,11 +444,20 @@ class AggregationTree:
     def revive(self, node: AggregatorNode):
         """Rebuild a hard-killed node in place (the Supervisor heal path):
         a fresh :class:`Aggregator` with the tree's retained tenant
-        factories / queue bound / resilience policy, restored from its
-        latest checkpoint when it has one (the root), and the node's ship
-        sequence reset so ``_resume_seq`` re-derives it above the parent's
-        watermark. Interior nodes come back EMPTY by design — their state
-        is reconstructed by their children's next cumulative ships.
+        factories / queue bound / resilience policy / execution engine,
+        restored from its latest checkpoint when it has one (the root),
+        and the node's ship sequence reset so ``_resume_seq`` re-derives
+        it above the parent's watermark. Interior nodes come back EMPTY by
+        design — their state is reconstructed by their children's next
+        cumulative ships.
+
+        With an AOT engine armed the rebuilt node is also **warmed before
+        it re-enters traffic**: ``warmup()`` replays the checkpoint's
+        warmup manifest (falling back to the pre-warm buckets for interior
+        nodes) so states and executables are restored together and the
+        healed node's first fold performs zero backend compiles. The
+        program count lands on ``node.last_warmup_programs`` (what
+        :meth:`~metrics_tpu.serve.resilience.Supervisor.heal` reports).
         Returns the restore manifest (None when nothing was restored)."""
         is_root = node is self.root
         agg = Aggregator(
@@ -431,9 +465,12 @@ class AggregationTree:
             checkpoint_dir=self._checkpoint_root if is_root else None,
             max_queue=self._max_queue,
             resilience=self._resilience,
+            engine=self._engine,
         )
         for tenant_id, factory in self.tenant_factories.items():
             agg.register_tenant(tenant_id, factory)
+        # warm BEFORE restore: executables are ready the moment states land
+        node.last_warmup_programs = agg.warmup()
         manifest = None
         if is_root and self._checkpoint_root is not None:
             manifest = agg.restore()

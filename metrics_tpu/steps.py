@@ -92,6 +92,74 @@ __all__ = [
 ]
 
 
+def _metric_fingerprint(metric: Any) -> str:
+    """Stable data-schema fingerprint for program cache keys (the serve
+    tier's schema fingerprint; falls back to the type name for anything
+    the wire schema walker cannot describe)."""
+    try:
+        from metrics_tpu.serve.wire import schema_fingerprint
+
+        return schema_fingerprint(metric)
+    except Exception:  # noqa: BLE001 — a key fallback, never a crash
+        return f"type:{type(metric).__name__}"
+
+
+def _engine_dispatch(raw_jitted: Callable, label: str, fingerprint: str, engine_obj: Any) -> Callable:
+    """Route calls of a jitted program through an ExecutionEngine.
+
+    Per distinct input signature the engine resolves ONE executable
+    (memory -> persistent store -> AOT compile for
+    :class:`~metrics_tpu.engine.AotEngine`) and later calls reuse it. The
+    returned callable also exposes ``precompile(*args, **kwargs)`` — args
+    may be ``ShapeDtypeStruct``s — so a warmup path can resolve programs
+    before the first real batch arrives.
+    """
+    from metrics_tpu.engine.keys import ProgramKey, abstractify
+
+    prepared: Dict[Any, Callable] = {}
+
+    def _sig_of(args: tuple, kwargs: dict) -> Any:
+        # cheap per-call lookup key (PyTreeDefs are hashable); the full
+        # ProgramKey — json canonicalization, environment fields — is only
+        # built on a miss, so the steady-state dispatch stays a flatten +
+        # dict hit rather than a per-call key serialization
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return treedef, tuple(
+            (tuple(a.shape), str(a.dtype)) if hasattr(a, "shape") and hasattr(a, "dtype")
+            else ("py", repr(a))
+            for a in leaves
+        )
+
+    def resolve(*args: Any, **kwargs: Any) -> Callable:
+        sig = _sig_of(args, kwargs)
+        fn = prepared.get(sig)
+        if fn is None:
+            # a cached executable (engine memory or the persistent store)
+            # skips tracing entirely, so trace-time side effects never run
+            # against THIS factory's worker — in particular update-derived
+            # aux attrs (the detected classification input mode) that
+            # compute() relies on. One abstract eval_shape re-runs the
+            # Python body on ShapeDtypeStructs before resolution: worker
+            # state matches a traced process on every cache tier, still
+            # zero backend compiles (a fresh compile pays one redundant
+            # abstract trace, ms against its compile).
+            from metrics_tpu.obs.recompile import suppress_note_trace
+
+            aval_args, aval_kwargs = abstractify(args, kwargs)
+            with suppress_note_trace():
+                jax.eval_shape(raw_jitted, *aval_args, **aval_kwargs)
+            key = ProgramKey.build(label, fingerprint, args, kwargs)
+            fn = engine_obj.prepare(raw_jitted, key, *args, **kwargs)
+            prepared[sig] = fn
+        return fn
+
+    def run(*args: Any, **kwargs: Any) -> Any:
+        return resolve(*args, **kwargs)(*args, **kwargs)
+
+    run.precompile = resolve
+    return run
+
+
 def _fresh_copy(state: State) -> State:
     """Copy leaves on the eager path so a donated init() can never delete
     arrays later traces embed as constants; a no-op under a trace (jnp.array
@@ -350,6 +418,7 @@ def make_epoch(
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     with_values: bool = False,
     jit_epoch: bool = True,
+    engine: Any = None,
     **init_kwargs: Any,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, epoch, compute)``: a WHOLE epoch of batches per launch.
@@ -390,6 +459,16 @@ def make_epoch(
         jit_epoch: wrap ``epoch`` in ``jax.jit`` with the carry donated
             (default). Pass False when composing it inside an outer jit /
             ``shard_map`` yourself.
+        engine: execution backend (see :mod:`metrics_tpu.engine`):
+            ``None``/``"jit"`` keep today's jitted path; ``"eager"`` runs
+            the epoch un-jitted (no compile ever — the reference's L1
+            semantics); ``"aot"`` or an
+            :class:`~metrics_tpu.engine.AotEngine` resolves one executable
+            per input signature through the persistent program store —
+            a warm store means the first epoch of a fresh process pays
+            zero backend compiles. The returned ``epoch`` then also
+            exposes ``precompile(state, *batches)`` (``ShapeDtypeStruct``
+            leaves accepted) for ahead-of-traffic warmup.
 
     Exactly-once resume:
         ``epoch`` accepts two reserved keyword arguments, ``resume_from``
@@ -424,7 +503,7 @@ def make_epoch(
         if init_args or init_kwargs:
             raise TypeError("make_epoch(collection) takes no extra args; configure the collection itself")
         return make_collection_epoch(
-            metric, axis_name=axis_name, with_values=with_values, jit_epoch=jit_epoch
+            metric, axis_name=axis_name, with_values=with_values, jit_epoch=jit_epoch, engine=engine
         )
 
     # construct a class argument ONCE and hand the instance to make_step
@@ -501,6 +580,15 @@ def make_epoch(
     _epoch_label = f"{obs_name}.epoch"
     _epoch_token = object()
 
+    # execution-engine resolution: "eager" forces the un-jitted path (no
+    # compile ever); "aot"/an AotEngine routes the jitted program through
+    # the persistent executable store; None/"jit" keep the default path
+    from metrics_tpu.engine import EagerEngine, get_engine
+
+    engine_obj = get_engine(engine)
+    if isinstance(engine_obj, EagerEngine):
+        jit_epoch, engine_obj = False, None
+
     def epoch(state: State, *batches: Any, **kw_batches: Any) -> Tuple[State, Any]:
         _obs_note_trace(_epoch_label, _epoch_token)
         with _obs_span(_epoch_label, category="epoch"):
@@ -517,7 +605,12 @@ def make_epoch(
 
     if jit_epoch:
         raw_jitted = jax.jit(epoch, donate_argnums=0)
-        jitted = _obs_track_compiles(raw_jitted, _epoch_label)
+        if engine_obj is not None and engine_obj.name != "jit":
+            jitted = _engine_dispatch(
+                raw_jitted, _epoch_label, _metric_fingerprint(metric), engine_obj
+            )
+        else:
+            jitted = _obs_track_compiles(raw_jitted, _epoch_label)
 
         def epoch(  # noqa: F811
             state: State,
@@ -544,6 +637,8 @@ def make_epoch(
         for attr in ("lower", "eval_shape", "trace", "clear_cache"):
             if hasattr(raw_jitted, attr):
                 setattr(epoch, attr, getattr(raw_jitted, attr))
+        if hasattr(jitted, "precompile"):
+            epoch.precompile = jitted.precompile
     else:
         # un-jitted epochs still get per-launch device timing at the eager
         # entry (trace-transparent when composed into an outer jit)
@@ -573,6 +668,7 @@ def make_stream_step(
     *,
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     jit_step: bool = True,
+    engine: Any = None,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, stream_step, compute)`` from a windowed/decayed metric:
     one launch folds a batch AND emits the current window value.
@@ -597,6 +693,10 @@ def make_stream_step(
             ``stream_step`` inside the same ``shard_map`` program.
         jit_step: wrap ``stream_step`` in ``jax.jit`` with the carry
             donated (default). Pass False when composing into an outer jit.
+        engine: execution backend as :func:`make_epoch` — ``"eager"``
+            forces the un-jitted step, ``"aot"`` resolves the step through
+            the persistent program store (``stream_step.precompile`` is
+            then exposed for ahead-of-traffic warmup).
 
     The carry is a plain state pytree (ring position and in-slot counter
     ride as traced int32 scalars), so a monitoring loop can checkpoint it
@@ -643,11 +743,25 @@ def make_stream_step(
         with _obs_span(_step_label, category="step"):
             return step(state, *args, **kwargs)
 
-    inner = (
-        _obs_track_compiles(jax.jit(traced_step, donate_argnums=0), _step_label)
-        if jit_step
-        else _obs_time_launch(traced_step, _step_label)
-    )
+    from metrics_tpu.engine import EagerEngine, get_engine
+
+    engine_obj = get_engine(engine)
+    if isinstance(engine_obj, EagerEngine):
+        jit_step, engine_obj = False, None
+
+    _precompile = None
+    if not jit_step:
+        inner = _obs_time_launch(traced_step, _step_label)
+    elif engine_obj is not None and engine_obj.name != "jit":
+        inner = _engine_dispatch(
+            jax.jit(traced_step, donate_argnums=0),
+            _step_label,
+            _metric_fingerprint(metric),
+            engine_obj,
+        )
+        _precompile = inner.precompile
+    else:
+        inner = _obs_track_compiles(jax.jit(traced_step, donate_argnums=0), _step_label)
 
     if isinstance(metric, WindowedMetric):
         # host-side ring-expiry accounting at the EAGER entry (the
@@ -673,6 +787,8 @@ def make_stream_step(
 
     else:
         stream_step = inner
+    if _precompile is not None:
+        stream_step.precompile = _precompile
     return init, stream_step, compute
 
 
@@ -1403,6 +1519,7 @@ def make_collection_epoch(
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     with_values: bool = False,
     jit_epoch: bool = True,
+    engine: Any = None,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, epoch, compute)`` folding a WHOLE collection's epoch in
     ONE jitted launch.
@@ -1444,6 +1561,10 @@ def make_collection_epoch(
             dict (each value stacked over the epoch axis).
         jit_epoch: wrap ``epoch`` in ``jax.jit`` with the carry donated
             (default); pass False when composing into an outer jit.
+        engine: execution backend as :func:`make_epoch` — ``"eager"``
+            forces the un-jitted path, ``"aot"`` resolves the fused epoch
+            (and the fused compute) through the persistent program store;
+            ``epoch.precompile`` is then exposed for warmup.
 
     Exactly-once resume:
         ``epoch`` accepts the same reserved ``resume_from=`` /
@@ -1492,6 +1613,13 @@ def make_collection_epoch(
     _epoch_label = f"{label}.collection_epoch"
     _compute_label = f"{label}.collection_compute"
     _epoch_token, _compute_token = object(), object()
+
+    from metrics_tpu.engine import EagerEngine, get_engine
+
+    engine_obj = get_engine(engine)
+    if isinstance(engine_obj, EagerEngine):
+        jit_epoch, engine_obj = False, None
+    _collection_fingerprint = _metric_fingerprint(plan["template"]) if engine_obj is not None else ""
 
     def _flatten_leaf(a: Any) -> Any:
         return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]) if _is_array(a) else a
@@ -1630,7 +1758,10 @@ def make_collection_epoch(
 
     if jit_epoch:
         raw_jitted = jax.jit(epoch_body, donate_argnums=0)
-        jitted = _obs_track_compiles(raw_jitted, _epoch_label)
+        if engine_obj is not None and engine_obj.name != "jit":
+            jitted = _engine_dispatch(raw_jitted, _epoch_label, _collection_fingerprint, engine_obj)
+        else:
+            jitted = _obs_track_compiles(raw_jitted, _epoch_label)
 
         def epoch(
             state: State,
@@ -1652,6 +1783,8 @@ def make_collection_epoch(
         for attr in ("lower", "eval_shape", "trace", "clear_cache"):
             if hasattr(raw_jitted, attr):
                 setattr(epoch, attr, getattr(raw_jitted, attr))
+        if hasattr(jitted, "precompile"):
+            epoch.precompile = jitted.precompile
     else:
         _inner_epoch = _obs_time_launch(epoch_body, _epoch_label)
 
@@ -1682,7 +1815,12 @@ def make_collection_epoch(
         # compute differently than the eager op-by-op dispatch, so float
         # values can differ from the eager path by an ulp; folded STATES
         # are bitwise-identical.
-        compute = _obs_track_compiles(jax.jit(compute_body), _compute_label)
+        if engine_obj is not None and engine_obj.name != "jit":
+            compute = _engine_dispatch(
+                jax.jit(compute_body), _compute_label, _collection_fingerprint, engine_obj
+            )
+        else:
+            compute = _obs_track_compiles(jax.jit(compute_body), _compute_label)
     else:
         # under a mesh axis the collectives must trace inside the caller's
         # shard_map program (and buffer-state members need eager counts),

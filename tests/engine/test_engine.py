@@ -1,0 +1,316 @@
+"""Execution-engine contract: cache-key discipline, store validity, tiers.
+
+The property that must never break: a cached executable is served ONLY for
+the exact (schema fingerprint, input signature, static config, backend,
+jax version, topology) it was compiled for. A collision — two tenants
+whose sketches differ only in bin count sharing a fold program, or a
+cross-jax-version artifact loading — would fold real data with the wrong
+executable, which is strictly worse than being slow.
+"""
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import engine as eng
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.obs.registry import get_counter
+from metrics_tpu.streaming import StreamingAUROC
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory_cache():
+    eng.reset_memory_cache()
+    yield
+    eng.reset_memory_cache()
+
+
+def _jit_add():
+    return jax.jit(lambda s, x: {"a": s["a"] + x.sum()})
+
+
+def _args():
+    return {"a": jnp.float32(0.0)}, jnp.arange(8, dtype=jnp.float32)
+
+
+class TestProgramKey:
+    def test_digest_stable_and_sensitive(self):
+        state, x = _args()
+        key = eng.ProgramKey.build("s", "fp", (state, x))
+        assert key.digest() == eng.ProgramKey.build("s", "fp", (state, x)).digest()
+        # every identity axis moves the digest
+        assert key.digest() != eng.ProgramKey.build("s", "OTHER", (state, x)).digest()
+        assert key.digest() != eng.ProgramKey.build("s2", "fp", (state, x)).digest()
+        assert key.digest() != eng.ProgramKey.build("s", "fp", (state, x), static_sig="r").digest()
+        y = jnp.arange(16, dtype=jnp.float32)
+        assert key.digest() != eng.ProgramKey.build("s", "fp", (state, y)).digest()
+
+    def test_sds_and_concrete_agree(self):
+        state, x = _args()
+        sds_state = {"a": jax.ShapeDtypeStruct((), jnp.float32)}
+        sds_x = jax.ShapeDtypeStruct((8,), jnp.float32)
+        assert (
+            eng.ProgramKey.build("s", "fp", (state, x)).digest()
+            == eng.ProgramKey.build("s", "fp", (sds_state, sds_x)).digest()
+        )
+
+    def test_manifest_round_trip(self):
+        key = eng.ProgramKey.build("s", "fp", _args(), static_sig="reds")
+        entry = key.to_manifest()
+        back = eng.ProgramKey.from_manifest(json.loads(json.dumps(entry)))
+        assert back == key
+        assert back.digest() == entry["digest"]
+
+    def test_environment_mismatch_rekeys(self):
+        key = eng.ProgramKey.build("s", "fp", _args())
+        assert key.environment_mismatches() == {}
+        spoofed = eng.ProgramKey.from_manifest(
+            {**key.to_manifest(), "jax_version": "0.0.1"}
+        )
+        mismatches = spoofed.environment_mismatches()
+        assert "jax_version" in mismatches
+        live = spoofed.rekeyed_to_live()
+        assert live.environment_mismatches() == {}
+        # the cross-version key can never name the live entry
+        assert live.digest() != spoofed.digest()
+
+    def test_tenant_bin_count_distinct_keys(self, tmp_path):
+        """The cache-key discipline: two tenants whose sketches differ only
+        in bin count get DISTINCT fold programs (schema fingerprint keys
+        the program — a collision would fold with the wrong executable)."""
+        from metrics_tpu.serve.aggregator import Aggregator
+
+        agg = Aggregator(
+            "keys", engine=eng.AotEngine(eng.ProgramStore(tmp_path)), prewarm_buckets=(1,)
+        )
+        agg.register_tenant("a", lambda: MetricCollection({"m": StreamingAUROC(num_bins=64)}))
+        agg.register_tenant("b", lambda: MetricCollection({"m": StreamingAUROC(num_bins=128)}))
+        key_a = agg._tenants["a"].fold_programs[1].key
+        key_b = agg._tenants["b"].fold_programs[1].key
+        assert key_a.fingerprint != key_b.fingerprint
+        assert key_a.digest() != key_b.digest()
+
+
+class TestProgramStore:
+    def test_round_trip_bitwise(self, tmp_path):
+        store = eng.ProgramStore(tmp_path)
+        f = _jit_add()
+        state, x = _args()
+        key = eng.ProgramKey.build("rt", "fp", (state, x))
+        compiled = f.lower(*eng.abstractify((state, x), {})[0]).compile()
+        assert store.save(key, compiled)
+        loaded = store.load(key)
+        assert loaded is not None
+        a = compiled(state, x)["a"]
+        b = loaded(state, x)["a"]
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_missing_entry_is_miss(self, tmp_path):
+        store = eng.ProgramStore(tmp_path)
+        assert store.load(eng.ProgramKey.build("none", "fp", _args())) is None
+
+    def test_spoofed_sidecar_refused_with_warning(self, tmp_path):
+        store = eng.ProgramStore(tmp_path)
+        f = _jit_add()
+        state, x = _args()
+        key = eng.ProgramKey.build("spoof", "fp", (state, x))
+        store.save(key, f.lower(*eng.abstractify((state, x), {})[0]).compile())
+        (sidecar,) = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+        path = os.path.join(tmp_path, sidecar)
+        meta = json.load(open(path))
+        meta["jax_version"] = "0.0.1"
+        json.dump(meta, open(path, "w"))
+        before = get_counter("compile.store_invalid", step="spoof", field="jax_version")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            assert store.load(key) is None
+        assert any("compiled under" in str(w.message) for w in caught)
+        assert get_counter("compile.store_invalid", step="spoof", field="jax_version") == before + 1
+
+    def test_corrupt_payload_is_miss_not_crash(self, tmp_path):
+        store = eng.ProgramStore(tmp_path)
+        f = _jit_add()
+        state, x = _args()
+        key = eng.ProgramKey.build("corrupt", "fp", (state, x))
+        payload = store.save(key, f.lower(*eng.abstractify((state, x), {})[0]).compile())
+        with open(payload, "wb") as fh:
+            fh.write(b"not a pickle")
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            assert store.load(key) is None
+
+
+class TestCompileProgram:
+    def test_tiers_and_counters(self, tmp_path):
+        store = eng.ProgramStore(tmp_path)
+        f = _jit_add()
+        state, x = _args()
+        key = eng.ProgramKey.build("tiers", "fp", (state, x))
+        miss0 = get_counter("compile.cache_misses", step="tiers")
+        prog = eng.compile_program(f, key, state, x, store=store)
+        assert prog.source == "compiled"
+        assert get_counter("compile.cache_misses", step="tiers") == miss0 + 1
+        mem0 = get_counter("compile.cache_hits", step="tiers", tier="memory")
+        assert eng.compile_program(f, key, state, x, store=store).source == "compiled"
+        assert get_counter("compile.cache_hits", step="tiers", tier="memory") == mem0 + 1
+        # fresh process: memory cleared, the disk tier serves it with zero
+        # backend compiles (the compile-listener assertion aot_smoke pins
+        # across a REAL process boundary)
+        eng.reset_memory_cache()
+        from metrics_tpu import obs
+
+        obs.install_compile_listener()
+        compiles0 = get_counter("jax.compiles")
+        disk0 = get_counter("compile.cache_hits", step="tiers", tier="disk")
+        prog3 = eng.compile_program(f, key, state, x, store=store)
+        assert prog3.source == "disk"
+        out = prog3(state, x)["a"]
+        assert get_counter("jax.compiles") == compiles0
+        assert float(out) == float(sum(range(8)))
+        assert get_counter("compile.cache_hits", step="tiers", tier="disk") == disk0 + 1
+
+    def test_cross_jax_version_key_miss(self, tmp_path):
+        """A warmup manifest recorded under another jax release must MISS:
+        its recorded key names an entry this process must not load, and the
+        rekeyed live key names one that does not exist yet."""
+        store = eng.ProgramStore(tmp_path)
+        f = _jit_add()
+        state, x = _args()
+        live_key = eng.ProgramKey.build("xver", "fp", (state, x))
+        store.save(live_key, f.lower(*eng.abstractify((state, x), {})[0]).compile())
+        spoofed = eng.ProgramKey.from_manifest(
+            {**live_key.to_manifest(), "jax_version": "0.0.1"}
+        )
+        assert store.load(spoofed) is None  # digest differs: no entry
+        eng.reset_memory_cache()
+        prog = eng.compile_program(f, spoofed.rekeyed_to_live(), state, x, store=store)
+        assert prog.source == "disk"  # rekeying recovers the live entry
+
+    def test_requires_lowerable_target(self):
+        key = eng.ProgramKey.build("bad", "fp", _args())
+        with pytest.raises(TypeError, match="no .lower"):
+            eng.compile_program(lambda s, x: s, key, *_args())
+
+
+class TestEngines:
+    def test_get_engine(self):
+        assert eng.get_engine(None) is None
+        assert isinstance(eng.get_engine("eager"), eng.EagerEngine)
+        assert isinstance(eng.get_engine("jit"), eng.JitEngine)
+        assert isinstance(eng.get_engine("aot"), eng.AotEngine)
+        inst = eng.AotEngine()
+        assert eng.get_engine(inst) is inst
+        with pytest.raises(ValueError, match="unknown execution engine"):
+            eng.get_engine("warp")
+
+
+class TestStepsIntegration:
+    PREDS = jnp.asarray([[0, 1, 2, 2], [1, 1, 0, 2]])
+    TARGET = jnp.asarray([[0, 1, 1, 2], [0, 1, 0, 2]])
+
+    def test_epoch_aot_bitwise_vs_jit(self, tmp_path):
+        from metrics_tpu import Accuracy
+        from metrics_tpu.steps import make_epoch
+
+        init, epoch, compute = make_epoch(Accuracy, num_classes=3)
+        ref_state, _ = epoch(init(), self.PREDS, self.TARGET)
+        aot = eng.AotEngine(eng.ProgramStore(tmp_path))
+        init2, epoch2, compute2 = make_epoch(Accuracy, num_classes=3, engine=aot)
+        state, _ = epoch2(init2(), self.PREDS, self.TARGET)
+        for name in ref_state:
+            assert np.asarray(ref_state[name]).tobytes() == np.asarray(state[name]).tobytes()
+        assert float(compute2(state)) == float(compute(ref_state))
+
+    def test_epoch_precompile_then_zero_compiles(self, tmp_path):
+        from metrics_tpu import Accuracy, obs
+        from metrics_tpu.steps import make_epoch
+
+        obs.install_compile_listener()
+        aot = eng.AotEngine(eng.ProgramStore(tmp_path))
+        init, epoch, _ = make_epoch(Accuracy, num_classes=3, engine=aot)
+        state = init()
+        sds = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), (state, self.PREDS, self.TARGET)
+        )
+        epoch.precompile(*sds)  # resolve ahead of traffic, on SDS only
+        before = get_counter("jax.compiles")
+        epoch(state, self.PREDS, self.TARGET)
+        assert get_counter("jax.compiles") == before
+
+    def test_disk_hit_replays_trace_side_effects(self, tmp_path):
+        """A fresh process whose epoch comes entirely from the disk store
+        never traces — but update-derived worker aux attrs (Accuracy's
+        detected input mode) are trace-time side effects compute() needs.
+        The dispatcher must replay them with an abstract eval_shape (zero
+        backend compiles) on a disk hit."""
+        from metrics_tpu import Accuracy, obs
+        from metrics_tpu.steps import make_epoch
+
+        obs.install_compile_listener()
+        store = eng.ProgramStore(tmp_path)
+        init, epoch, compute = make_epoch(Accuracy, num_classes=5, engine=eng.AotEngine(store))
+        state, _ = epoch(init(), self.PREDS, self.TARGET)
+        ref = float(compute(state))
+        # fresh process: new factory (its own never-updated worker), engine
+        # memory cleared, the program comes from DISK
+        eng.reset_memory_cache()
+        init2, epoch2, compute2 = make_epoch(Accuracy, num_classes=5, engine=eng.AotEngine(store))
+        before = get_counter("jax.compiles")
+        state2, _ = epoch2(init2(), self.PREDS, self.TARGET)
+        assert float(compute2(state2)) == ref  # raised "determined mode" before the fix
+        assert get_counter("jax.compiles") == before  # eval_shape never compiles
+
+    def test_epoch_eager_engine(self):
+        from metrics_tpu import Accuracy
+        from metrics_tpu.steps import make_epoch
+
+        init, epoch, compute = make_epoch(Accuracy, num_classes=3, engine="eager")
+        state, _ = epoch(init(), self.PREDS, self.TARGET)
+        assert float(compute(state)) == 0.75
+
+    def test_collection_epoch_aot(self, tmp_path):
+        from metrics_tpu import Accuracy, Precision
+        from metrics_tpu.steps import make_collection_epoch
+
+        coll = MetricCollection(
+            [Accuracy(num_classes=3), Precision(num_classes=3, average="macro")]
+        )
+        init, epoch, compute = make_collection_epoch(coll)
+        ref_state, _ = epoch(init(), self.PREDS, self.TARGET)
+        ref = compute(ref_state)
+        aot = eng.AotEngine(eng.ProgramStore(tmp_path))
+        init2, epoch2, compute2 = make_collection_epoch(coll, engine=aot)
+        state, _ = epoch2(init2(), self.PREDS, self.TARGET)
+        out = compute2(state)
+        for name, member_state in ref_state.items():
+            for leaf in member_state:
+                assert (
+                    np.asarray(member_state[leaf]).tobytes()
+                    == np.asarray(state[name][leaf]).tobytes()
+                )
+        assert sorted(out) == sorted(ref)
+
+    def test_stream_step_aot(self, tmp_path):
+        from metrics_tpu.steps import make_stream_step
+        from metrics_tpu.streaming import StreamingAUROC, WindowedMetric
+
+        def build(engine=None):
+            return make_stream_step(
+                WindowedMetric(StreamingAUROC(num_bins=32), window=2, updates_per_slot=1),
+                engine=engine,
+            )
+
+        preds = jnp.asarray([0.2, 0.9, 0.4, 0.7])
+        target = jnp.asarray([0, 1, 0, 1])
+        init, step, _ = build()
+        ref, ref_v = step(init(), preds, target)
+        aot = eng.AotEngine(eng.ProgramStore(tmp_path))
+        init2, step2, _ = build(engine=aot)
+        assert hasattr(step2, "precompile")
+        state, v = step2(init2(), preds, target)
+        assert float(v) == float(ref_v)

@@ -418,3 +418,79 @@ class TestManifestFile:
         assert manifest["journal"]["watermark"] == [2, 41]
         assert manifest["schema"] == 1
         assert manifest["seq"] == 0
+
+
+class TestManifestEnvironmentValidation:
+    """Restore-time validation of the manifest's recorded jax version /
+    topology against the live process: a mismatch restores states fine but
+    warns LOUDLY (one-shot) that compile-environment-derived artifacts
+    (cached executables, AOT warmup manifests) must be rebuilt."""
+
+    def _spoof(self, path, field, value):
+        manifest_path = os.path.join(path, "manifest.json")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        manifest[field] = value
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+
+    def test_manifest_records_environment(self, tmp_path):
+        import jax
+
+        mgr = CheckpointManager(tmp_path / "env")
+        path = mgr.save(_mean_with([1.0]))
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["jax_version"] == jax.__version__
+        assert manifest["backend"] == jax.default_backend()
+        assert "device_kind" in manifest
+
+    def test_mismatch_warns_once_restores_state(self, tmp_path):
+        import warnings as _warnings
+
+        from metrics_tpu.ft import manager as _manager
+
+        mgr = CheckpointManager(tmp_path / "mismatch")
+        path = mgr.save(_mean_with([3.0]))
+        self._spoof(path, "jax_version", "0.0.1")
+        _manager._warned_env_mismatch = False  # re-arm the one-shot
+        restored = _mean_with([])
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            manifest = mgr.restore(restored)
+        assert manifest is not None
+        assert float(restored.compute()) == 3.0  # states restore fine
+        assert any("different" in str(w.message) and "environment" in str(w.message) for w in caught)
+        # one-shot: the second mismatched restore stays quiet
+        with _warnings.catch_warnings(record=True) as caught2:
+            _warnings.simplefilter("always")
+            mgr.restore(_mean_with([]))
+        assert not any("environment" in str(w.message) for w in caught2)
+
+    def test_mismatch_counted_when_obs_enabled(self, tmp_path):
+        from metrics_tpu.ft import manager as _manager
+        from metrics_tpu.ft.manager import validate_manifest_environment
+
+        _manager._warned_env_mismatch = False
+        obs.enable()
+        try:
+            before = obs.get_counter("ft.manifest_env_mismatches", field="jax_version")
+            mismatches = validate_manifest_environment({"jax_version": "0.0.1"})
+            assert "jax_version" in mismatches
+            assert (
+                obs.get_counter("ft.manifest_env_mismatches", field="jax_version") == before + 1
+            )
+        finally:
+            obs.enable(False)
+            obs.reset()
+        _manager._warned_env_mismatch = False
+
+    def test_clean_manifest_no_warning(self, tmp_path):
+        import warnings as _warnings
+
+        mgr = CheckpointManager(tmp_path / "clean")
+        mgr.save(_mean_with([1.0]))
+        with _warnings.catch_warnings(record=True) as caught:
+            _warnings.simplefilter("always")
+            mgr.restore(_mean_with([]))
+        assert not any("environment" in str(w.message) for w in caught)

@@ -1,0 +1,192 @@
+"""CI smoke: warm node revival with ZERO backend compiles, bitwise /query.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.aot_smoke`` (the
+CI test job does, mirroring ``serve_smoke``). The cold-start-elimination
+acceptance with a REAL process boundary:
+
+* the parent builds an AOT-armed :class:`~metrics_tpu.serve.Aggregator`
+  (persistent :class:`~metrics_tpu.engine.ProgramStore` + checkpoint
+  dir), folds payloads from 5 clients, records the ``/query`` answer over
+  HTTP plus the merged state leaves byte-for-byte, and checkpoints —
+  the warmup manifest (program keys + shapes) rides the manifest;
+* a FRESH python process (no shared jit caches, no shared engine memory)
+  re-registers the tenants, ``warmup()``s off the checkpoint manifest —
+  executables deserialize from the store — then ``restore()``s and runs
+  its FIRST FOLD under the jax.monitoring compile listener: the listener
+  must record **zero backend compiles** (the whole point of the engine
+  subsystem), the warm fold must also be >= 10x faster than the parent's
+  measured cold fold, and the HTTP ``/query`` answer must be BITWISE
+  equal to the pre-kill oracle (state leaves compared as raw bytes, the
+  JSON values exactly).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_CLIENTS = 5
+SAMPLES = 128
+TENANT = "aot"
+
+
+def _factory():
+    from metrics_tpu import MaxMetric, SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=128), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def _payloads():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = []
+    for c in range(N_CLIENTS):
+        rng = np.random.default_rng(100 + c)
+        coll = _factory()
+        preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+        target = jnp.asarray(
+            (rng.uniform(0, 1, SAMPLES) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32)
+        )
+        coll["auroc"].update(preds, target)
+        coll["seen"].update(jnp.asarray(float(SAMPLES)))
+        coll["peak"].update(preds)
+        out.append(encode_state(coll, tenant=TENANT, client_id=f"client-{c}", watermark=(0, 0)))
+    return out
+
+
+def _build_aggregator(root: str):
+    from metrics_tpu import engine as eng
+    from metrics_tpu.serve.aggregator import Aggregator
+
+    store = eng.ProgramStore(os.path.join(root, "store"))
+    return Aggregator(
+        "root",
+        checkpoint_dir=os.path.join(root, "ckpt"),
+        engine=eng.AotEngine(store),
+        prewarm_buckets=(),
+    )
+
+
+def _http_query(agg) -> dict:
+    from metrics_tpu.serve.endpoints import MetricsServer
+
+    server = MetricsServer(agg, port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/query?tenant={TENANT}", timeout=10
+        ) as resp:
+            return json.loads(resp.read().decode())
+    finally:
+        server.stop()
+
+
+def _leaf_hexes(agg) -> list:
+    import numpy as np
+
+    tenant = agg._tenants[TENANT]
+    return [np.asarray(leaf).tobytes().hex() for leaf in tenant.merged_leaves]
+
+
+def parent(root: str) -> None:
+    agg = _build_aggregator(root)
+    agg.register_tenant(TENANT, _factory)
+    for blob in _payloads():
+        assert agg.ingest(blob)
+    # cold first fold: measured for the >=10x acceptance the warm child
+    # must beat (trace + lower + backend compile + execute)
+    t0 = time.perf_counter()
+    agg.flush()
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    oracle = _http_query(agg)
+    assert oracle["clients"] == N_CLIENTS, oracle
+    agg.save()
+    manifest = agg._manager.read_manifest()
+    warm_meta = manifest["extra"]["serve"]["warmup"]
+    assert warm_meta["tenants"][TENANT], "warmup manifest must record fold buckets"
+    assert warm_meta["environment"]["jax_version"], "warmup manifest must record the environment"
+    with open(os.path.join(root, "oracle.json"), "w") as f:
+        json.dump({"query": oracle, "leaves": _leaf_hexes(agg), "cold_ms": cold_ms}, f)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tests.integrations.aot_smoke", "--revive", root],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"revive process failed with {proc.returncode}"
+    print(f"aot_smoke OK: cold first fold {cold_ms:.1f}ms; warm revival verified in a fresh process")
+
+
+def revive(root: str) -> None:
+    """The fresh process: warmup + restore, then the assertions."""
+    from metrics_tpu import obs
+    from metrics_tpu.obs.registry import get_counter
+
+    assert obs.install_compile_listener(), "compile listener unavailable — cannot assert"
+    with open(os.path.join(root, "oracle.json")) as f:
+        oracle = json.load(f)
+
+    agg = _build_aggregator(root)
+    agg.register_tenant(TENANT, _factory)
+    compiles_before = get_counter("jax.compiles")
+    warmed = agg.warmup()
+    assert warmed >= 1, "warmup resolved no programs"
+    assert get_counter("jax.compiles") == compiles_before, (
+        "warmup paid backend compiles — the program store did not serve the"
+        " executables (stale keys? corrupted store?)"
+    )
+    agg.restore()
+    tenant = agg._tenants[TENANT]
+    compiles_before = get_counter("jax.compiles")
+    t0 = time.perf_counter()
+    folded = tenant.fold()
+    warm_ms = (time.perf_counter() - t0) * 1000.0
+    compiled = get_counter("jax.compiles") - compiles_before
+    assert folded == N_CLIENTS, f"first fold saw {folded} clients, wanted {N_CLIENTS}"
+    assert compiled == 0, (
+        f"the revived node's FIRST FOLD performed {compiled} backend"
+        " compile(s) — warm revival must be compile-free"
+    )
+    assert warm_ms * 10.0 <= oracle["cold_ms"], (
+        f"warm first fold {warm_ms:.2f}ms is not >=10x faster than the cold"
+        f" {oracle['cold_ms']:.2f}ms"
+    )
+    # bitwise: merged state leaves as raw bytes, and the HTTP /query JSON
+    assert _leaf_hexes(agg) == oracle["leaves"], "merged leaves differ from the pre-kill oracle"
+    query = _http_query(agg)
+    assert query == oracle["query"], (
+        f"/query diverged from the pre-kill oracle:\n{query}\nvs\n{oracle['query']}"
+    )
+    hits = get_counter("compile.cache_hits", step="serve.fold_stacked", tier="disk")
+    assert hits >= 1, "warm start left no disk-tier cache-hit telemetry"
+    print(
+        f"revive OK: {warmed} programs warmed, first fold {warm_ms:.2f}ms"
+        f" (cold was {oracle['cold_ms']:.2f}ms), zero backend compiles,"
+        " /query bitwise"
+    )
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--revive":
+        revive(sys.argv[2])
+        return 0
+    with tempfile.TemporaryDirectory(prefix="aot_smoke.") as root:
+        parent(root)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -482,7 +482,9 @@ class TestSupervisor:
         assert "dead_node" in [f["kind"] for f in report["findings"]]
 
         actions = sup.heal()
-        assert {"action": "rebuild_node", "node": leaf.name, "restored": False} in actions
+        assert {
+            "action": "rebuild_node", "node": leaf.name, "restored": False, "warmed_programs": 0
+        } in actions
         assert not leaf.is_dead
         # the healed node's FIRST ship must clear the parent's recorded
         # watermark — a sequence restarted at 0 would stale the subtree
@@ -535,7 +537,9 @@ class TestSupervisor:
         faults.kill_node(tree.root)
         assert sup.check()["healthy"] is False
         actions = sup.heal()
-        assert {"action": "rebuild_node", "node": "root", "restored": True} in actions
+        assert {
+            "action": "rebuild_node", "node": "root", "restored": True, "warmed_programs": 0
+        } in actions
         restored_tenant = tree.root.aggregator._tenant(TENANT)
         restored_tenant.fold()
         for a, b in zip(before, restored_tenant.merged_leaves):

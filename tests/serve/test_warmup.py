@@ -1,0 +1,218 @@
+"""Warm revival of the serving tier: states AND executables together.
+
+Pins the cold-start-elimination contract end to end in-process (the real
+process boundary rides ``tests/integrations/aot_smoke.py``):
+
+* an AOT-armed :class:`Aggregator` pre-lowers its per-tenant stacked-fold
+  programs at ``register_tenant`` time and folds bitwise-identically to
+  the default jitted path;
+* the checkpoint manifest carries the warmup manifest (every fold bucket
+  the node ever ran) and ``warmup()`` replays it with ZERO backend
+  compiles when the program store is warm;
+* a mismatched recorded environment (jax version churn) is a loud one-shot
+  warning plus a fresh compile — never a crash, never a stale executable;
+* ``AggregationTree.revive`` / ``Supervisor.heal`` warm the rebuilt node
+  before it re-enters traffic.
+"""
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, SumMetric, engine as eng, obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.obs.registry import get_counter
+from metrics_tpu.serve.aggregator import Aggregator
+from metrics_tpu.serve.resilience import Supervisor
+from metrics_tpu.serve.tree import AggregationTree
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory_cache():
+    eng.reset_memory_cache()
+    yield
+    eng.reset_memory_cache()
+
+
+def factory():
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def payload(client_id: str, step: int, seed: int, tenant: str = "t") -> bytes:
+    rng = np.random.default_rng(seed)
+    coll = factory()
+    for _ in range(step + 1):
+        preds = jnp.asarray(rng.uniform(0, 1, 96).astype(np.float32))
+        target = jnp.asarray((rng.uniform(0, 1, 96) < 0.5).astype(np.int32))
+        coll["auroc"].update(preds, target)
+        coll["seen"].update(jnp.asarray(96.0))
+        coll["peak"].update(preds)
+    return encode_state(coll, tenant=tenant, client_id=client_id, watermark=(0, step))
+
+
+class TestAggregatorEngine:
+    def test_register_prelowers(self, tmp_path):
+        agg = Aggregator(
+            "pre", engine=eng.AotEngine(eng.ProgramStore(tmp_path)), prewarm_buckets=(1, 2)
+        )
+        agg.register_tenant("t", factory)
+        tenant = agg._tenants["t"]
+        assert sorted(tenant.fold_programs) == [1, 2]
+        assert tenant.warm_buckets == {1, 2}
+
+    def test_fold_bitwise_vs_default_path(self, tmp_path):
+        default = Aggregator("default")
+        aot = Aggregator("aot", engine=eng.AotEngine(eng.ProgramStore(tmp_path)))
+        for agg in (default, aot):
+            agg.register_tenant("t", factory)
+            for i in range(3):
+                agg.ingest(payload(f"c{i}", 0, seed=i))
+            agg.flush()
+        for a, b in zip(
+            default._tenants["t"].merged_leaves, aot._tenants["t"].merged_leaves
+        ):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_eager_fold_integer_leaves_match(self, tmp_path):
+        default = Aggregator("default2")
+        eager = Aggregator("eager", engine="eager")
+        for agg in (default, eager):
+            agg.register_tenant("t", factory)
+            for i in range(3):
+                agg.ingest(payload(f"c{i}", 0, seed=i))
+            agg.flush()
+        td, te = default._tenants["t"], eager._tenants["t"]
+        for (path, red), a, b in zip(td.spec, td.merged_leaves, te.merged_leaves):
+            if not np.issubdtype(np.asarray(a).dtype, np.floating) or red in ("min", "max"):
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), path
+            else:
+                assert np.allclose(np.asarray(a), np.asarray(b)), path
+
+    def test_warm_revival_zero_backend_compiles(self, tmp_path):
+        obs.install_compile_listener()
+        store = eng.ProgramStore(tmp_path / "store")
+        ckpt = str(tmp_path / "ckpt")
+        agg = Aggregator("root", checkpoint_dir=ckpt, engine=eng.AotEngine(store))
+        agg.register_tenant("t", factory)
+        for i in range(3):
+            agg.ingest(payload(f"c{i}", 0, seed=i))
+        agg.flush()
+        oracle = agg.query("t")
+        agg.save()
+        manifest = agg._manager.read_manifest()
+        warm_meta = manifest["extra"]["serve"]["warmup"]
+        assert 4 in warm_meta["tenants"]["t"]  # 3 clients pad to 4
+        assert warm_meta["environment"]["jax_version"]
+
+        eng.reset_memory_cache()  # simulated fresh process
+        revived = Aggregator(
+            "root", checkpoint_dir=ckpt, engine=eng.AotEngine(store), prewarm_buckets=()
+        )
+        revived.register_tenant("t", factory)
+        before = get_counter("jax.compiles")
+        warmed = revived.warmup()
+        assert warmed >= 2  # bucket 1 (fallback floor) + manifest buckets
+        revived.restore()
+        revived._tenants["t"].fold()
+        assert get_counter("jax.compiles") == before  # THE acceptance pin
+        result = revived.query("t")
+        assert result["values"] == oracle["values"]
+        for a, b in zip(
+            agg._tenants["t"].merged_leaves, revived._tenants["t"].merged_leaves
+        ):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    def test_warmup_environment_mismatch_warns_and_recompiles(self, tmp_path):
+        store = eng.ProgramStore(tmp_path / "store")
+        ckpt = str(tmp_path / "ckpt")
+        agg = Aggregator("root", checkpoint_dir=ckpt, engine=eng.AotEngine(store))
+        agg.register_tenant("t", factory)
+        agg.ingest(payload("c0", 0, seed=0))
+        agg.flush()
+        agg.save()
+        path = agg._manager.latest()
+        manifest_path = os.path.join(path, "manifest.json")
+        manifest = json.load(open(manifest_path))
+        manifest["extra"]["serve"]["warmup"]["environment"]["jax_version"] = "0.0.1"
+        json.dump(manifest, open(manifest_path, "w"))
+
+        eng.reset_memory_cache()
+        revived = Aggregator("root", checkpoint_dir=ckpt, engine=eng.AotEngine(store))
+        revived.register_tenant("t", factory)
+        mism0 = get_counter("compile.warmup_mismatches", field="jax_version")
+        was = obs.enable()
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                warmed = revived.warmup()
+        finally:
+            obs.enable(was)
+        assert warmed >= 1  # fresh compile under live keys, not a crash
+        assert any("different compile environment" in str(w.message) for w in caught)
+        assert get_counter("compile.warmup_mismatches", field="jax_version") == mism0 + 1
+        # one-shot: a second warmup stays quiet
+        with warnings.catch_warnings(record=True) as caught2:
+            warnings.simplefilter("always")
+            revived.warmup()
+        assert not any("different compile environment" in str(w.message) for w in caught2)
+
+    def test_warmup_without_engine_is_noop(self):
+        agg = Aggregator("plain")
+        agg.register_tenant("t", factory)
+        assert agg.warmup() == 0
+
+    def test_warmup_without_checkpoint_uses_prewarm(self, tmp_path):
+        agg = Aggregator(
+            "fresh", engine=eng.AotEngine(eng.ProgramStore(tmp_path)), prewarm_buckets=(1,)
+        )
+        agg.register_tenant("t", factory)
+        assert agg.warmup() == 1
+
+
+class TestTreeWarmRevival:
+    def _fill(self, tree, n=6, tenant="t"):
+        for i in range(n):
+            tree.leaves[i % len(tree.leaves)].aggregator.ingest(payload(f"c{i}", 0, seed=i))
+        tree.pump()
+
+    def test_revive_warms_before_traffic(self, tmp_path):
+        obs.install_compile_listener()
+        tree = AggregationTree(
+            fan_out=(2,),
+            tenants={"t": factory},
+            checkpoint_root=str(tmp_path / "ckpt"),
+            engine=eng.AotEngine(eng.ProgramStore(tmp_path / "store")),
+        )
+        self._fill(tree)
+        oracle = tree.root.aggregator.query("t")["values"]
+        tree.save()
+        tree.root.hard_kill()
+        eng.reset_memory_cache()
+        before = get_counter("jax.compiles")
+        actions = Supervisor(tree, warn=False).heal()
+        assert actions and actions[0]["action"] == "rebuild_node"
+        assert actions[0]["warmed_programs"] >= 1
+        assert get_counter("jax.compiles") == before
+        tree.pump()
+        assert get_counter("jax.compiles") == before
+        assert tree.root.aggregator.query("t")["values"] == oracle
+
+    def test_unarmed_tree_revive_reports_zero_warmed(self, tmp_path):
+        tree = AggregationTree(
+            fan_out=(2,), tenants={"t": factory}, checkpoint_root=str(tmp_path / "ckpt")
+        )
+        self._fill(tree)
+        tree.save()
+        tree.root.hard_kill()
+        actions = Supervisor(tree, warn=False).heal()
+        assert actions[0]["warmed_programs"] == 0
+        tree.pump()
+        assert tree.root.aggregator.query("t")["clients"] >= 1
