@@ -628,6 +628,36 @@ def bench_serve_degraded(n_clients: int = 1000) -> dict:
     return {"serve_ingest_degraded_merges_per_s": out["serve_ingest_merges_per_s"]}
 
 
+def bench_serve_churn(n_clients: int = 1000) -> dict:
+    """Serving-tier throughput UNDER TOPOLOGY CHURN: the elasticity row.
+
+    ``serve_churn_merges_per_s`` — the 1k-client run routed through the
+    consistent-hash :class:`~metrics_tpu.serve.elastic.Router` (clients
+    consult it per ship) with three snapshot rounds, while **one node
+    JOINS** (full admission protocol: build, warm, readiness probe, ring
+    re-homing) after round one and **one intermediate is HARD-KILLED and
+    supervisor-healed** after round two — both inside the timed window. A
+    RATE row (``unit="/s"``, gate inverted): a regression means a
+    rebalance or heal got more expensive relative to steady-state — the
+    membership-change tax ``docs/serving.md`` §7 promises to bound. The
+    ``elastic_smoke`` CI step pins the same run's root bitwise-equal to
+    the flat oracle; this row only times it.
+    """
+    from metrics_tpu.serve.loadgen import run_loadgen
+
+    out = run_loadgen(
+        n_clients=n_clients,
+        fan_out=(4, 16),
+        payloads_per_client=3,
+        samples_per_payload=256,
+        num_bins=256,
+        verify=False,
+        churn=True,
+        seed=11,
+    )
+    return {"serve_churn_merges_per_s": out["serve_churn_merges_per_s"]}
+
+
 def bench_aot() -> dict:
     """Cold-vs-warm first fold: the execution-engine acceptance rows.
 
@@ -1267,6 +1297,20 @@ def main(
             prior.get(
                 "serve_ingest_degraded_merges_per_s",
                 degraded_rows["serve_ingest_degraded_merges_per_s"],
+            ),
+            baseline="best_prior_self",
+            unit="/s",
+        )
+        # elasticity row (round 13): merges/s sustained while one node
+        # joins and one intermediate dies mid-window — rate row, inverted
+        # gate, like the other /s rows (TPU sweep supplies acceptance)
+        churn_rows = section(bench_serve_churn)
+        emit(
+            "serve_churn_merges_per_s",
+            churn_rows["serve_churn_merges_per_s"],
+            prior.get(
+                "serve_churn_merges_per_s",
+                churn_rows["serve_churn_merges_per_s"],
             ),
             baseline="best_prior_self",
             unit="/s",

@@ -53,11 +53,14 @@ __all__ = [
     "clock_skew",
     "corrupt_payload",
     "crash_mid_save",
+    "drain_node",
     "inject",
+    "join_node",
     "kill_node",
     "maybe_fail",
     "now",
     "partition",
+    "split_node",
     "transient_gather_failures",
 ]
 
@@ -353,3 +356,41 @@ def kill_node(node: Any) -> None:
     the :class:`~metrics_tpu.serve.resilience.Supervisor`'s job."""
     _chaos_inc("kill")
     node.hard_kill()
+
+
+# -- topology-churn injectors (the elastic_smoke harness's levers) ---------
+#
+# Thin seams over the real membership operations of
+# :class:`~metrics_tpu.serve.elastic.ElasticFleet` — the chaos harness does
+# not get a private rebalance implementation, it drives the production one
+# (exactly one correctness mechanism), and every churn event it injects is
+# auditable from the same ``chaos.injected{kind=}`` family as the wire
+# faults, alongside the production ``serve.rebalances{kind=}`` counters.
+
+
+def join_node(fleet: Any, name: Optional[str] = None, parent: Any = None) -> Any:
+    """Inject a live node JOIN mid-run (``chaos.injected{kind=join}``):
+    the full admission protocol — build, warm, readiness probe, ring
+    admission, client re-homing — runs under whatever wire faults are
+    armed. Returns the admitted node."""
+    _chaos_inc("join")
+    return fleet.join_node(name, parent)
+
+
+def drain_node(fleet: Any, node: Any, **kwargs: Any) -> Any:
+    """Inject a live node DRAIN mid-run (``chaos.injected{kind=drain}``):
+    ring exit, queue folded to empty, final cumulative ship, client
+    handoff, subtree re-parenting, tombstoned retirement — nothing the
+    node accepted may be lost, which the smoke's bitwise oracle checks.
+    Returns the drain summary."""
+    _chaos_inc("drain")
+    return fleet.drain_node(node, **kwargs)
+
+
+def split_node(fleet: Any, node: Any, name: Optional[str] = None) -> Any:
+    """Inject a live SPLIT of an overloaded node mid-run
+    (``chaos.injected{kind=split}``): a sibling joins under the same
+    parent and the ring hands it its share of keys. Returns the new
+    node."""
+    _chaos_inc("split")
+    return fleet.split_node(node, name)

@@ -47,6 +47,13 @@ these are the registry-only verdicts):
   currently open: some client is being refused for repeated invalid
   payloads. Current state, not the cumulative open-transition counter: a
   circuit that probes back closed reads healthy again.
+* ``rebalance_stuck`` — a ``serve.rebalance_started_ts`` gauge (stamped
+  by :class:`metrics_tpu.serve.elastic.ElasticFleet` for the duration of
+  every join/drain/split/merge, cleared on completion; the ``node=``
+  label names the node being rebalanced, so the alert is actionable) has
+  been nonzero for longer than ``rebalance_stuck_s``: a topology mutation is wedged
+  mid-flight — clients may be split between their old and new homes until
+  it finishes, so a stuck one deserves a page, not patience.
 
 **Fleet mode** (``federated=True``): every condition reads the FEDERATED
 view (:func:`metrics_tpu.obs.federated_snapshot` — the local registry
@@ -90,6 +97,10 @@ class HealthMonitor:
             (a ``serve.clients_quarantined`` gauge is currently nonzero).
         circuit_open: arm the serving-tier ``circuit_open`` condition
             (a ``serve.circuits_open`` gauge is currently nonzero).
+        rebalance_stuck_s: arm the serving-tier ``rebalance_stuck``
+            condition when an elastic rebalance has been in flight (its
+            ``serve.rebalance_started_ts`` gauge nonzero) for more than
+            this many seconds (``None`` disarms).
         federated: read every condition off the federated fleet view
             (local registry merged with the piggybacked per-node
             snapshots) instead of local registry state — the root-of-tree
@@ -117,6 +128,7 @@ class HealthMonitor:
         queue_depth_threshold: Optional[float] = None,
         quarantine: bool = False,
         circuit_open: bool = False,
+        rebalance_stuck_s: Optional[float] = None,
         federated: bool = False,
         node_staleness_s: Optional[float] = None,
         name: str = "default",
@@ -130,6 +142,7 @@ class HealthMonitor:
         self.queue_depth_threshold = queue_depth_threshold
         self.quarantine = bool(quarantine)
         self.circuit_open = bool(circuit_open)
+        self.rebalance_stuck_s = rebalance_stuck_s
         self.federated = bool(federated)
         self.node_staleness_s = node_staleness_s
         self.name = str(name)
@@ -352,6 +365,34 @@ class HealthMonitor:
             )
         return None
 
+    def _check_rebalance_stuck(self) -> Optional[str]:
+        if self.rebalance_stuck_s is None:
+            return None
+        import time
+
+        # serve.rebalance_started_ts carries the WALL-CLOCK start of an
+        # in-flight elastic rebalance (0 = idle); nonzero-and-old means a
+        # topology mutation is wedged with clients possibly split between
+        # their old and new homes. Wall clock because the gauge federates
+        # across processes (same tradeoff as the federation captured_at).
+        now = time.time()
+        stuck = {}
+        prefix = "serve.rebalance_started_ts{"
+        for key, started in self._gauges().items():
+            if not (key == "serve.rebalance_started_ts" or key.startswith(prefix)):
+                continue
+            if started and now - started > self.rebalance_stuck_s:
+                stuck[key] = now - started
+        if stuck:
+            worst = max(stuck, key=stuck.get)
+            return (
+                f"{len(stuck)} elastic rebalance(s) in flight for longer than"
+                f" {self.rebalance_stuck_s:.0f}s (worst: {worst},"
+                f" {stuck[worst]:.0f}s) — a join/drain/split/merge is wedged and"
+                " clients may be split between their old and new homes"
+            )
+        return None
+
     # ------------------------------------------------------------------
 
     def check(self) -> Dict[str, Any]:
@@ -373,6 +414,7 @@ class HealthMonitor:
             ("queue_saturation", self._check_queue_saturation),
             ("quarantine", self._check_quarantine),
             ("circuit_open", self._check_circuit_open),
+            ("rebalance_stuck", self._check_rebalance_stuck),
         )
         warnings: List[Dict[str, str]] = []
         with self._check_lock:
@@ -425,6 +467,7 @@ class HealthMonitor:
                 ("queue_depth_threshold", self.queue_depth_threshold),
                 ("quarantine", self.quarantine or None),
                 ("circuit_open", self.circuit_open or None),
+                ("rebalance_stuck_s", self.rebalance_stuck_s),
                 ("federated", self.federated or None),
                 ("node_staleness_s", self.node_staleness_s),
             )

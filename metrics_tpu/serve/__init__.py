@@ -32,6 +32,12 @@ primitives the library already proved:
   (``Aggregator(resilience=...)``), plus the :class:`Supervisor` that
   detects dead/hung nodes and workers via traffic-implied heartbeats and
   rebuilds them from checkpoints with a resumed ship sequence.
+* :mod:`~metrics_tpu.serve.elastic` — live membership: a seeded
+  consistent-hash :class:`Router` clients consult per ship, the
+  :class:`ElasticFleet` join/drain/split/merge protocols whose
+  handoff + tombstone rebalance keeps the root bitwise-equal to the flat
+  oracle through topology churn, and the queue-pressure
+  :class:`Autoscaler` reading the federated fleet signals.
 
 See ``docs/serving.md`` for the architecture, the exactly-once semantics
 and the self-healing guarantees.
@@ -39,8 +45,16 @@ and the self-healing guarantees.
 from metrics_tpu.serve.aggregator import (
     Aggregator,
     BackpressureError,
+    DrainingError,
     ServeError,
     UnknownTenantError,
+)
+from metrics_tpu.serve.elastic import (
+    Autoscaler,
+    ElasticFleet,
+    HashRing,
+    RebalancePreconditionError,
+    Router,
 )
 from metrics_tpu.serve.endpoints import MetricsServer
 from metrics_tpu.serve.resilience import (
@@ -70,15 +84,21 @@ __all__ = [
     "AggregationTree",
     "Aggregator",
     "AggregatorNode",
+    "Autoscaler",
     "BackpressureError",
     "CircuitOpenError",
     "ClientFirewall",
+    "DrainingError",
+    "ElasticFleet",
+    "HashRing",
     "MAX_WIRE_BYTES",
     "MetricPayload",
     "MetricsServer",
     "NodeDownError",
     "QuarantinedClientError",
+    "RebalancePreconditionError",
     "ResilienceConfig",
+    "Router",
     "SchemaMismatchError",
     "ServeError",
     "Supervisor",

@@ -69,6 +69,7 @@ from metrics_tpu.serve.wire import (
 __all__ = [
     "Aggregator",
     "BackpressureError",
+    "DrainingError",
     "ServeError",
     "UnknownTenantError",
 ]
@@ -78,6 +79,16 @@ __all__ = [
 # per-client weights and "cat" is unbounded — both are exactly what the
 # bounded-state serving contract excludes.
 _SERVABLE_REDUCTIONS = ("sum", "min", "max", "sketch")
+
+# per-tenant bound on retired-identity tombstones: under sustained elastic
+# churn every re-homed client leaves one behind at its old home, and an
+# unbounded table (plus its copy in every checkpoint manifest) would grow
+# monotonically with clients-moved x rebalances. Eviction is
+# least-recently-retired and COUNTED (serve.tombstones_evicted) — the
+# worst case of an evicted tombstone is a sufficiently ancient duplicate
+# of a final ship being re-accepted, which the bound makes ~impossible in
+# practice and the counter makes visible in any case.
+MAX_RETIRED_TOMBSTONES = 10_000
 
 
 class ServeError(RuntimeError):
@@ -96,6 +107,14 @@ class BackpressureError(ServeError):
     def __init__(self, message: str, retry_after_s: Optional[float] = None) -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class DrainingError(ServeError):
+    """The node is draining (:meth:`Aggregator.drain`): it no longer admits
+    payloads. Unlike backpressure this is not transient for THIS node — the
+    client should re-resolve its route (the elastic
+    :class:`~metrics_tpu.serve.elastic.Router` already points its next ship
+    at the new home)."""
 
 
 @functools.partial(jax.jit, static_argnames=("reds",))
@@ -242,6 +261,12 @@ class _Tenant:
         self.warm_buckets: set = set()
 
         self.clients: Dict[str, _ClientSlot] = {}
+        # watermark TOMBSTONES of retired clients (state re-homed by an
+        # elastic rebalance): dedup keeps working against them, so a late
+        # duplicate of a drained node's final ship cannot resurrect state
+        # the rebalance already moved; a re-joining identity resumes its
+        # watermark chain from here (and _resume_seq derives above it)
+        self.retired: Dict[str, BatchJournal] = {}
         self.dirty = False
         self.lock = threading.Lock()
         # serializes view materialization (fold) against view readers
@@ -420,6 +445,19 @@ class _Tenant:
                 _obs_record_hop(trace["id"], self.node, "fold", fold_ms)
         return k
 
+    def tombstone(self, client_id: str, journal: "BatchJournal") -> None:
+        """(``self.lock`` held) record a retirement tombstone, bounded by
+        ``MAX_RETIRED_TOMBSTONES``: the pop-reinsert keeps the dict in
+        least-recently-retired order so eviction drops the oldest, and
+        every eviction is counted — never a silent cap."""
+        self.retired.pop(client_id, None)
+        self.retired[client_id] = journal
+        while len(self.retired) > MAX_RETIRED_TOMBSTONES:
+            evicted = next(iter(self.retired))
+            del self.retired[evicted]
+            if _obs_enabled():
+                _obs_inc("serve.tombstones_evicted", tenant=self.tenant_id)
+
     @property
     def folded_payloads(self) -> int:
         # lock: the background worker inserts client slots concurrently and
@@ -533,6 +571,9 @@ class Aggregator:
         self._flush_interval_s = float(flush_interval_s)
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._draining = False
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self._last_flush_s: Optional[float] = None
         self._firewall = None
         if resilience is not None and resilience is not False:
@@ -598,10 +639,120 @@ class Aggregator:
         return self._tenant(tenant_id).schema_hash
 
     def client_watermark(self, tenant_id: str, client_id: str) -> Optional[Tuple[int, int]]:
-        """Newest accepted ``(epoch, step)`` for a client, or None."""
+        """Newest accepted ``(epoch, step)`` for a client, or None. A
+        RETIRED client answers from its tombstone: a re-joining node's
+        ``_resume_seq`` must derive its ship sequence above the watermark
+        its predecessor identity left behind, or every post-rejoin ship
+        would be dropped as a retired duplicate."""
         tenant = self._tenant(tenant_id)
         slot = tenant.clients.get(str(client_id))
-        return None if slot is None else slot.journal.watermark
+        if slot is not None:
+            return slot.journal.watermark
+        ghost = tenant.retired.get(str(client_id))
+        return None if ghost is None else ghost.watermark
+
+    def retire_client(self, client_id: str, tenant_id: Optional[str] = None) -> int:
+        """Remove a client's snapshot from the fold, leaving a watermark
+        **tombstone** (the elastic rebalance primitive — see
+        :mod:`metrics_tpu.serve.elastic`).
+
+        The state leaves are dropped and the next fold excludes the client;
+        the journal watermark is kept as a tombstone the dedup keeps
+        enforcing: a late duplicate of the retired identity's final ship
+        drops (``serve.dedup_drops{kind=retired}``), and so does a
+        STALE-ROUTED end-client ship that advances past the tombstone
+        (``kind=stale_route`` — accepting it would double-count the client
+        at the root forever, while the drop is repaired by its next
+        correctly-routed cumulative ship). Only an elastic handoff
+        (``meta["rehomed_from"]``, watermark >= tombstone) or a rejoined
+        ``node:*`` identity advancing its ship sequence re-admits the
+        identity. A retired END client must therefore always be handed
+        off to its new home — the elastic protocols do; a bare
+        ``retire_client`` without a handoff orphans the identity HERE
+        until a handoff pops the tombstone. Returns the number of tenant
+        slots retired (``tenant_id=None`` retires across all tenants)."""
+        client_id = str(client_id)
+        tenants = [self._tenant(tenant_id)] if tenant_id is not None else list(self._tenants.values())
+        retired = 0
+        for tenant in tenants:
+            with tenant.lock:
+                slot = tenant.clients.pop(client_id, None)
+                if slot is None:
+                    continue
+                tenant.tombstone(client_id, slot.journal)
+                tenant.dirty = True
+                retired += 1
+            if _obs_enabled():
+                _obs_inc("serve.retired_clients", tenant=tenant.tenant_id)
+                _obs_gauge("serve.clients", float(len(tenant.clients)), tenant=tenant.tenant_id)
+        return retired
+
+    def _slot_payload(
+        self, tenant: "_Tenant", client_id: str, wm, leaves, consensus
+    ) -> MetricPayload:
+        tree: Dict[str, Any] = {}
+        for (path, _), leaf in zip(tenant.spec, leaves):
+            _tree_set(tree, path, leaf)
+        for path, leaf in zip(tenant.consensus_paths, consensus):
+            _tree_set(tree, path, leaf)
+        return MetricPayload(
+            tenant=tenant.tenant_id,
+            collection=tenant.tenant_id,
+            client_id=str(client_id),
+            watermark=(int(wm[0]), int(wm[1])),
+            schema_hash=tenant.schema_hash,
+            schema=tenant.schema,
+            states=tree,
+            meta={"rehomed_from": self.name},
+        )
+
+    def client_snapshot(self, tenant_id: str, client_id: str) -> MetricPayload:
+        """Re-materialize one client's latest ACCEPTED snapshot as a
+        :class:`~metrics_tpu.serve.wire.MetricPayload` — identity and
+        watermark preserved, so handing it to another aggregator is
+        indistinguishable from the client having shipped there itself (the
+        elastic handoff path: the client's own next cumulative ship to the
+        new home dedups against exactly this watermark). Read-only; the
+        handoff itself uses the atomic :meth:`takeout_client`."""
+        tenant = self._tenant(tenant_id)
+        with tenant.lock:
+            slot = tenant.clients.get(str(client_id))
+            if slot is None:
+                raise ServeError(
+                    f"tenant {tenant.tenant_id!r} on aggregator {self.name!r} holds no"
+                    f" snapshot for client {client_id!r}"
+                )
+            wm = slot.journal.watermark or (0, 0)
+            leaves = list(slot.leaves)
+            consensus = list(slot.consensus)
+        return self._slot_payload(tenant, str(client_id), wm, leaves, consensus)
+
+    def takeout_client(self, tenant_id: str, client_id: str) -> Optional[MetricPayload]:
+        """ATOMICALLY remove + tombstone one client slot and return its
+        snapshot — the elastic handoff's read side. Snapshot and retire
+        happen under ONE tenant-lock hold: a separate read-then-retire
+        would race a live flush worker accepting a newer ship in between,
+        tombstoning a watermark whose state was never captured (the
+        accepted snapshot would exist nowhere). Returns ``None`` when the
+        tenant holds no slot for the client. If delivering the returned
+        payload fails, re-accepting it HERE restores the slot (the
+        tombstone it left matches the payload's watermark, and the
+        ``rehomed_from`` meta re-admits it)."""
+        tenant = self._tenant(tenant_id)
+        client_id = str(client_id)
+        with tenant.lock:
+            slot = tenant.clients.pop(client_id, None)
+            if slot is None:
+                return None
+            tenant.tombstone(client_id, slot.journal)
+            tenant.dirty = True
+            wm = slot.journal.watermark or (0, 0)
+            leaves = list(slot.leaves)
+            consensus = list(slot.consensus)
+        if _obs_enabled():
+            _obs_inc("serve.retired_clients", tenant=tenant.tenant_id)
+            _obs_gauge("serve.clients", float(len(tenant.clients)), tenant=tenant.tenant_id)
+        return self._slot_payload(tenant, client_id, wm, leaves, consensus)
 
     def _tenant(self, tenant_id: str) -> _Tenant:
         tenant = self._tenants.get(str(tenant_id))
@@ -638,6 +789,39 @@ class Aggregator:
         payloads are shed at the door — they would be dedup-dropped at
         fold anyway. Returns True when enqueued, False when shed.
         """
+        # in-flight admission window: drain() waits for this count to reach
+        # zero before trusting queue-empty, closing the acknowledged-then-
+        # stranded TOCTOU between the draining gate and the queue put. The
+        # count is taken BEFORE the gate is read: checked first, a producer
+        # preempted between gate and increment would be invisible to the
+        # drain and could still strand a payload behind its final flush —
+        # incremented first, every producer is either visible to the
+        # drain's wait or sees _draining set and refuses.
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            if self._draining:
+                # refused BEFORE any decode/firewall work: a draining
+                # node's whole contract is that nothing new is admitted
+                # after the drain's final flush — if this node is part of
+                # an elastic fleet, the Router already points the client's
+                # next ship at its new home
+                raise DrainingError(
+                    f"aggregator {self.name!r} is draining and no longer admits"
+                    " payloads; re-resolve the route and ship to the new home"
+                )
+            return self._ingest(payload, block=block, timeout=timeout)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _ingest(
+        self,
+        payload: Union[bytes, MetricPayload],
+        *,
+        block: bool,
+        timeout: Optional[float],
+    ) -> bool:
         t0 = time.perf_counter()
         firewall = self._firewall
         identity: Optional[Tuple[str, str]] = None
@@ -749,6 +933,13 @@ class Aggregator:
             return
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if self._draining:
+                # a producer parked in this loop when drain() began must
+                # abort, not land a payload behind the drain's final flush
+                raise DrainingError(
+                    f"aggregator {self.name!r} began draining while this ingest"
+                    " was waiting for queue space; re-resolve the route"
+                )
             worker = self._worker
             if worker is not None and not worker.is_alive() and not self._stop.is_set():
                 raise ServeError(
@@ -805,6 +996,47 @@ class Aggregator:
                     # error strike — it must reset the breaker, not feed it
                     self._firewall.record_ok(payload.tenant, payload.client_id)
                 return False
+            rehome_readmit = False
+            if slot is None:
+                ghost = tenant.retired.get(payload.client_id)
+                if ghost is not None:
+                    is_rehome = payload.meta.get("rehomed_from") is not None
+                    is_node = payload.client_id.startswith("node:")
+                    advancing = ghost.should_fold(epoch, step)
+                    if is_rehome and (advancing or ghost.watermark == (epoch, step)):
+                        # an elastic HANDOFF delivering the tombstone's
+                        # successor state (the client's assignment bounced
+                        # away and back): re-admit it rather than orphaning
+                        # the state between homes. The tombstone itself is
+                        # popped only at slot creation, AFTER the body
+                        # validates: popping here would destroy it even when
+                        # the body turns out corrupt or poisoned and nothing
+                        # is admitted.
+                        rehome_readmit = ghost.watermark == (epoch, step)
+                    elif is_node and advancing:
+                        pass  # a REJOINED node resuming above its tombstone
+                        # (_resume_seq derived the sequence from it): live
+                        # again, fall through to accept with the chain intact
+                    else:
+                        # everything else a tombstone sees is wrong-home
+                        # traffic: a late duplicate/stale delivery of the
+                        # retired identity's final ship, or a STALE-ROUTED
+                        # end-client ship racing the rebalance (route
+                        # resolved before the membership change). Accepting
+                        # either would resurrect state the rebalance already
+                        # re-homed — a permanent double count at the root
+                        # that nothing ever reconciles; dropping is SAFE by
+                        # the cumulative contract: the client's next
+                        # correctly-routed ship carries everything. (Every
+                        # legitimate return of an identity to this node goes
+                        # through a tombstone-popping handoff or, for node:*
+                        # rejoins, advances the chain — handled above.)
+                        if _obs_enabled():
+                            kind = "stale_route" if advancing else "retired"
+                            _obs_inc("serve.dedup_drops", tenant=payload.tenant, kind=kind)
+                        if self._firewall is not None:
+                            self._firewall.record_ok(payload.tenant, payload.client_id)
+                        return False
             # validate the body BEFORE touching the registry: a corrupted
             # payload (hash matched, leaf missing/misshapen) must not leave
             # an empty slot behind that every later fold would trip over
@@ -827,6 +1059,16 @@ class Aggregator:
                 self._firewall.record_ok(payload.tenant, payload.client_id)
             if slot is None:
                 slot = tenant.clients[payload.client_id] = _ClientSlot()
+                ghost = tenant.retired.pop(payload.client_id, None)
+                if ghost is not None and not rehome_readmit:
+                    # a retired identity legitimately advanced past its
+                    # tombstone (a re-joined node:* resuming its sequence, or
+                    # an advancing handoff): it is live again — continue its
+                    # watermark chain so dedup stays exact across the gap.
+                    # (The equal-watermark rehome re-admit keeps the fresh
+                    # journal instead: record() on the adopted journal would
+                    # refuse the non-advance.)
+                    slot.journal = ghost
             slot.journal.record(epoch, step)
             slot.leaves = leaves
             slot.consensus = consensus
@@ -963,6 +1205,69 @@ class Aggregator:
             self._worker = None
         self.flush()
 
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` has begun: ingest refuses new payloads."""
+        return self._draining
+
+    def resume_admission(self) -> None:
+        """Roll back a FAILED :meth:`drain`: re-open admission (and clear
+        the ``/healthz/ready`` draining reason). The elastic drain protocol
+        uses this when the queue could not be emptied in time and the node
+        must re-enter the ring — a node left out of the ring while still
+        refusing ingest would be a permanent blackhole for ~1/n of the
+        keyspace. Meaningless after a COMPLETED drain (state handed off,
+        worker stopped); the elastic layer never calls it then."""
+        self._draining = False
+
+    def drain(self, timeout_s: float = 30.0) -> int:
+        """Graceful counterpart to :meth:`stop`: stop admitting, fold the
+        ingest queue **to empty**, then stop the worker.
+
+        :meth:`stop` runs one final flush, which drains whatever is queued
+        at that instant — but a producer blocked in a full-queue ``put``
+        can land a payload right after that flush's drain loop broke, and
+        the payload is then stranded forever (queued, never folded).
+        ``drain`` closes that window: admission is refused FIRST
+        (:class:`DrainingError`), so the queue can only shrink, and the
+        flush loop runs until it is actually empty — bounded by
+        ``timeout_s``, raising :class:`ServeError` (never silently
+        stranding) if the queue cannot be emptied in time. Idempotent: a
+        second call finds nothing to drain and returns 0. Returns the
+        number of payloads drained."""
+        self._draining = True
+        deadline = time.monotonic() + float(timeout_s)
+        drained = self.flush()
+        while True:
+            with self._inflight_lock:
+                inflight = self._inflight
+            # queue-empty alone is not enough: a producer that passed the
+            # admission gate before _draining was set may still be between
+            # validation and its queue put — an acknowledged payload landing
+            # behind the final flush would be stranded forever. Spin the
+            # flush until the queue is empty AND no admitted ingest is still
+            # in flight (blocked full-queue puts unblock as the flush frees
+            # slots, then abort on the draining re-check).
+            if inflight == 0 and self._queue.empty():
+                break
+            if time.monotonic() > deadline:
+                raise ServeError(
+                    f"aggregator {self.name!r} drain timed out after {timeout_s}s"
+                    f" with {self._queue.qsize()} payload(s) still queued and"
+                    f" {inflight} ingest(s) in flight — a producer is wedged or"
+                    " a fold is stuck; nothing was stranded silently, retry drain()"
+                )
+            flushed = self.flush()
+            drained += flushed
+            if not flushed and inflight:
+                time.sleep(0.001)  # yield to the in-flight producer
+        # the worker's own final flush (inside stop) catches a payload a
+        # pre-draining put() raced in between our last flush and here
+        self.stop()
+        if _obs_enabled():
+            _obs_inc("serve.drains", node=self.name)
+        return drained
+
     # ------------------------------------------------------------------
     # Liveness surface (read by /healthz and serve.resilience.Supervisor)
     # ------------------------------------------------------------------
@@ -1097,8 +1402,18 @@ class Aggregator:
                     f" {tmeta['schema_hash']}; differing: {'; '.join(diffs) or 'fingerprint only'}"
                 )
             slots = proxy.tree.get(tslot, {})
+            # retired-identity tombstones ride the manifest (tiny: id ->
+            # watermark+folded): a restore that dropped them would let a
+            # healed node resurrect a drained child's frozen final ship as
+            # a live client — re-homed state counted twice, forever
+            retired_meta = (serve_meta.get("retired") or {}).get(tslot, {})
             with tenant.lock:
                 tenant.clients.clear()
+                tenant.retired.clear()
+                for client_id, (r_epoch, r_step, r_folded) in retired_meta.items():
+                    tenant.retired[client_id] = BatchJournal().load_state_dict(
+                        {"watermark": [int(r_epoch), int(r_step)], "folded": int(r_folded)}
+                    )
                 for idx, client_id in enumerate(serve_meta["clients"][tslot]):
                     data = slots[f"c{idx:06d}"]
                     slot = _ClientSlot()
@@ -1236,7 +1551,7 @@ class Aggregator:
         (``t000000``/``c000000``/``l000000``) and the id mapping rides the
         JSON manifest."""
         tree: Dict[str, Any] = {}
-        meta: Dict[str, Any] = {"tenants": {}, "clients": {}}
+        meta: Dict[str, Any] = {"tenants": {}, "clients": {}, "retired": {}}
         warmup = self._warmup_manifest()
         if warmup is not None:
             meta["warmup"] = warmup
@@ -1252,6 +1567,10 @@ class Aggregator:
                 with tenant.lock:
                     order = sorted(tenant.clients)
                     meta["clients"][tslot] = order
+                    meta["retired"][tslot] = {
+                        client_id: [*(journal.watermark or (0, 0)), journal.folded]
+                        for client_id, journal in sorted(tenant.retired.items())
+                    }
                     slots: Dict[str, Any] = {}
                     for c_idx, client_id in enumerate(order):
                         slot = tenant.clients[client_id]

@@ -1,0 +1,926 @@
+"""Elastic fleet membership: live join / drain / split / merge, invisibly.
+
+The aggregation tree (:mod:`metrics_tpu.serve.tree`) composes to any depth,
+but until this module its topology was hand-built and frozen at
+construction. At millions-of-clients scale the fleet must grow, shrink and
+rebalance **while traffic flows**, and a rebalance must be provably
+invisible at the root. Three pieces deliver that:
+
+* **Consistent-hash routing** — :class:`HashRing` (seeded, virtual nodes)
+  behind a :class:`Router` that clients and the load generator consult
+  *per ship*. Membership change moves only the clients whose ring
+  assignment actually changed (≈ ``moved/total ~ 1/n`` per join), never
+  reshuffles the fleet.
+* **The rebalance protocol** — every client→leaf move is a
+  **handoff + tombstone-retire + cumulative re-ship**:
+
+  1. the old home re-materializes the client's latest *accepted* snapshot
+     (identity and watermark preserved —
+     :meth:`~metrics_tpu.serve.Aggregator.client_snapshot`) and ingests it
+     into the new home, so nothing accepted is ever lost even if the
+     client never ships again;
+  2. the old home **retires** the slot, leaving a watermark tombstone
+     (:meth:`~metrics_tpu.serve.Aggregator.retire_client`): its next fold
+     excludes the client (no double count), while a late duplicate of a
+     final ship is dropped against the tombstone instead of resurrecting
+     re-homed state;
+  3. the client's own next cumulative ship — routed to the new home by the
+     ring — dedups against exactly the handed-off watermark, so the
+     overlap between handoff and live traffic is safe **by construction**
+     (the same exactly-once argument the tree invariant already rests on).
+
+  Because every (tenant, client) snapshot lives in exactly one slot at
+  every step, the root fold stays **bitwise-equal to the flat oracle
+  merge** of the accepted snapshots throughout membership change — the
+  ``elastic_smoke`` CI step pins it under seeded churn at 10% wire faults.
+* **Admission / drain** — a joining node registers tenants, warms its fold
+  executables through the :mod:`metrics_tpu.engine` store, and is admitted
+  to the ring only after a readiness probe; a draining node stops
+  admitting (:class:`~metrics_tpu.serve.aggregator.DrainingError`), folds
+  its queue **to empty** (:meth:`~metrics_tpu.serve.Aggregator.drain` —
+  nothing accepted may be stranded), ships one final cumulative snapshot,
+  hands its clients off, and retires its ``node:*`` identity upstream.
+  **Split and merge are compositions** of exactly these two operations
+  (split = join a sibling; merge = drain the underloaded node), so there
+  is one correctness mechanism, not four.
+
+:class:`Autoscaler` closes the loop: it reads the fleet's scaling signals
+— the ``serve.queue_depth{node=}`` worst series and the per-node
+``serve.hop_queue_wait_ms`` p99 — off the **federated** obs snapshot
+(:mod:`metrics_tpu.obs.federation`, so a multi-process root sees the whole
+fleet) and executes split/merge through the fleet, one action per step
+with a cooldown.
+
+Every rebalance is observable: ``serve.rebalances{kind=join|drain|split|merge}``
+counters, the ``serve.rebalance_ms{kind=}`` latency histogram, and a
+``serve.rebalance_started_ts{node=}`` gauge the
+:class:`~metrics_tpu.obs.health.HealthMonitor`'s ``rebalance_stuck``
+condition watches — all federated to the root's ``/metrics`` like any
+other series. See ``docs/serving.md`` §7 "Elasticity".
+"""
+import bisect
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.aggregator import Aggregator, ServeError
+from metrics_tpu.serve.tree import AggregationTree, AggregatorNode
+
+__all__ = [
+    "Autoscaler",
+    "ElasticFleet",
+    "HashRing",
+    "RebalancePreconditionError",
+    "Router",
+]
+
+
+class RebalancePreconditionError(ServeError):
+    """A rebalance was refused because its preconditions do not hold
+    (draining the root / the last ring member / a dead node / a node under
+    a dead parent). NOT retryable as-is — the operator must change the
+    fleet's state first (heal, grow, pick another node); the HTTP surface
+    answers 409, distinct from a genuine drain timeout's 500."""
+
+
+class HashRing:
+    """Seeded consistent-hash ring with virtual nodes.
+
+    Each member owns ``vnodes`` points on a 64-bit ring (sha256 of
+    ``seed|member#i``); a key is assigned to the owner of the first point
+    clockwise from its own hash. The properties the rebalance protocol
+    relies on, pinned by ``tests/serve/test_elastic.py``:
+
+    * **deterministic** — same seed, same members ⇒ same assignment, on
+      every process (clients and aggregators can compute routes
+      independently);
+    * **minimal movement** — adding a member reassigns only the keys whose
+      clockwise-first point now belongs to the new member (≈ ``1/n`` of
+      them); removing a member reassigns only *its* keys. Every other
+      key's assignment is untouched, which is what bounds a rebalance's
+      blast radius.
+
+    Args:
+        vnodes: virtual nodes per member (more ⇒ smoother balance,
+            bigger ring; 64 keeps the max/min leaf load within ~2x).
+        seed: folded into every hash so distinct fleets get distinct,
+            reproducible rings.
+    """
+
+    def __init__(self, *, vnodes: int = 64, seed: int = 0) -> None:
+        if int(vnodes) < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self._vnodes = int(vnodes)
+        self._seed = int(seed)
+        self._points: List[Tuple[int, str]] = []  # sorted (hash, member)
+        self._members: set = set()
+
+    def _hash(self, key: str) -> int:
+        digest = hashlib.sha256(f"{self._seed}|{key}".encode()).digest()
+        return int.from_bytes(digest[:8], "big")
+
+    def add(self, member: str) -> None:
+        member = str(member)
+        if member in self._members:
+            raise ValueError(f"ring member {member!r} already present")
+        self._members.add(member)
+        for i in range(self._vnodes):
+            bisect.insort(self._points, (self._hash(f"{member}#{i}"), member))
+
+    def remove(self, member: str) -> None:
+        member = str(member)
+        if member not in self._members:
+            raise ValueError(f"ring member {member!r} not present")
+        self._members.remove(member)
+        self._points = [p for p in self._points if p[1] != member]
+
+    def assign(self, key: str) -> str:
+        """The member owning ``key`` under the current membership."""
+        if not self._points:
+            raise ServeError("hash ring is empty: no members to assign to")
+        h = self._hash(str(key))
+        idx = bisect.bisect_right(self._points, (h, "￿")) % len(self._points)
+        return self._points[idx][1]
+
+    def members(self) -> List[str]:
+        return sorted(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def __contains__(self, member: object) -> bool:
+        return member in self._members
+
+
+class Router:
+    """The client→leaf assignment surface clients consult **per ship**.
+
+    A thin, thread-safe view over a :class:`HashRing` plus the live
+    name → :class:`~metrics_tpu.serve.tree.AggregatorNode` map:
+    ``route(client_id)`` answers "which aggregator do I ingest into right
+    now". :attr:`version` bumps on every membership change, so a caller
+    caching a route can cheaply detect staleness — but the contract is to
+    consult the router per ship; a stale route during a rebalance is
+    exactly the overlap the handoff watermarks absorb.
+    """
+
+    def __init__(self, *, vnodes: int = 64, seed: int = 0) -> None:
+        self._ring = HashRing(vnodes=vnodes, seed=seed)
+        self._nodes: Dict[str, AggregatorNode] = {}
+        self._lock = threading.Lock()
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Monotonic membership-change counter."""
+        return self._version
+
+    def add(self, name: str, node: AggregatorNode) -> None:
+        with self._lock:
+            self._ring.add(name)
+            self._nodes[str(name)] = node
+            self._version += 1
+
+    def remove(self, name: str) -> AggregatorNode:
+        with self._lock:
+            self._ring.remove(name)
+            node = self._nodes.pop(str(name))
+            self._version += 1
+            return node
+
+    def assign(self, client_id: str) -> str:
+        """Ring member (leaf name) owning ``client_id``."""
+        with self._lock:
+            return self._ring.assign(client_id)
+
+    def node(self, client_id: str) -> AggregatorNode:
+        with self._lock:
+            return self._nodes[self._ring.assign(client_id)]
+
+    def route(self, client_id: str) -> Aggregator:
+        """The aggregator ``client_id`` ships to under current membership."""
+        return self.node(client_id).aggregator
+
+    def member_node(self, name: str) -> AggregatorNode:
+        with self._lock:
+            node = self._nodes.get(str(name))
+        if node is None:
+            raise ServeError(f"{name!r} is not a ring member")
+        return node
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return self._ring.members()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def __contains__(self, name: object) -> bool:
+        with self._lock:
+            return name in self._ring
+
+
+class ElasticFleet:
+    """Live membership operations over an :class:`~metrics_tpu.serve.AggregationTree`.
+
+    Wraps a tree with a seeded :class:`Router` over its leaves and
+    executes the four rebalance kinds — **join**, **drain**, **split**,
+    **merge** — as compositions of the admission and drain protocols (one
+    correctness mechanism). Operations are serialized under one lock: a
+    rebalance is a topology mutation, and two racing mutations could
+    each hand the same client off.
+
+    Example::
+
+        tree = AggregationTree(fan_out=(2, 4), tenants={"t": factory})
+        fleet = ElasticFleet(tree, seed=7)
+        fleet.router.route(client_id).ingest(payload)   # per ship
+        fleet.join_node()                               # grow
+        fleet.drain_node("L2.1")                        # shrink, invisibly
+        fleet.pump()
+
+    Args:
+        tree: the tree to manage (its leaves seed the ring).
+        vnodes / seed: ring parameters (see :class:`HashRing`).
+        drain_timeout_s: bound on a draining node's queue-to-empty flush.
+    """
+
+    def __init__(
+        self,
+        tree: AggregationTree,
+        *,
+        vnodes: int = 64,
+        seed: int = 0,
+        drain_timeout_s: float = 30.0,
+    ) -> None:
+        self.tree = tree
+        self.router = Router(vnodes=vnodes, seed=seed)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._lock = threading.RLock()
+        self._split_counter = 0
+        for leaf in tree.leaves:
+            self.router.add(leaf.name, leaf)
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    @property
+    def root(self) -> AggregatorNode:
+        return self.tree.root
+
+    def pump(self, rounds: int = 1) -> int:
+        return self.tree.pump(rounds)
+
+    def _resolve(self, node_or_name: Union[str, AggregatorNode]) -> AggregatorNode:
+        if isinstance(node_or_name, AggregatorNode):
+            return node_or_name
+        return self.tree.node_by_name(str(node_or_name))
+
+    def _with_rebalance(self, kind: str, target: str, fn: Callable[[], Any]) -> Any:
+        """Run one rebalance under the telemetry contract: the
+        ``serve.rebalance_started_ts{node=}`` gauge — labeled with the
+        node being rebalanced, so a firing ``rebalance_stuck`` alert names
+        the wedged operation's target, not just "something is stuck" — is
+        set for the duration (what ``HealthMonitor(rebalance_stuck_s=...)``
+        watches), and a completed rebalance lands one
+        ``serve.rebalance_ms{kind=}`` sample plus a
+        ``serve.rebalances{kind=}`` count — federated to the root's
+        ``/metrics`` like every other series."""
+        # the whole span — telemetry stamp included — runs under the fleet
+        # lock (reentrant, so _join/_drain's own acquire is free): a second
+        # rebalance queued behind a wedged one must BLOCK before stamping,
+        # or it would overwrite the wedged rebalance's start timestamp and
+        # reset the very clock rebalance_stuck pages on
+        with self._lock:
+            armed = _obs_enabled()
+            t0 = time.perf_counter()
+            if armed:
+                _obs_gauge("serve.rebalance_started_ts", time.time(), node=target)
+            try:
+                result = fn()
+            finally:
+                if armed:
+                    _obs_gauge("serve.rebalance_started_ts", 0.0, node=target)
+            if armed:
+                _obs_observe("serve.rebalance_ms", (time.perf_counter() - t0) * 1000.0, kind=kind)
+                _obs_inc("serve.rebalances", kind=kind)
+            return result
+
+    def _handoff_client(
+        self, src: AggregatorNode, client_id: str, targets: Optional[set] = None
+    ) -> int:
+        """Move one end client's accepted snapshots to its ring-assigned
+        home, tenant by tenant. The read side is the ATOMIC
+        :meth:`~metrics_tpu.serve.Aggregator.takeout_client` (snapshot +
+        tombstone-retire under one lock hold — a separate read-then-retire
+        would let the source's live flush worker accept a newer ship in
+        between and tombstone state that was never captured), so every
+        (tenant, client) slot lives in exactly one place at every step —
+        the invariant the bitwise root equality rests on. ``targets``
+        collects the receiving nodes; the caller flushes them once so the
+        rebalance completes with every moved snapshot ACCEPTED, not merely
+        queued."""
+        from metrics_tpu.serve.aggregator import BackpressureError
+        from metrics_tpu.serve.resilience import CircuitOpenError, QuarantinedClientError
+
+        moved = 0
+        for tenant_id in src.aggregator.tenants():
+            payload = src.aggregator.takeout_client(tenant_id, client_id)
+            if payload is None:
+                continue  # this tenant holds no slot for the client
+            target = self.router.node(client_id)
+            try:
+                try:
+                    target.aggregator.ingest(payload, block=False)
+                except (BackpressureError, CircuitOpenError, QuarantinedClientError):
+                    # control-plane override of the target's ADMISSION
+                    # gates: this snapshot was already accepted and
+                    # validated once, and aborting a rebalance midway would
+                    # leave the fleet double-counting (old ships frozen
+                    # upstream, new homes filling). The bounded queue
+                    # guards unbounded producers and the firewall judges
+                    # live wire traffic — neither describes a slot-sized
+                    # handoff of vetted state, so accept it synchronously.
+                    # (_accept still runs the poison check, so a NaN can
+                    # not ride the override into the fold.)
+                    target.aggregator._accept(payload, time.perf_counter())
+            except Exception:
+                # delivery failed outright (a bug-level surprise): put the
+                # state back where it came from — the takeout's tombstone
+                # matches the payload's watermark, so this re-admits it —
+                # and let the rebalance raise with nothing lost
+                src.aggregator._accept(payload, time.perf_counter())
+                raise
+            if targets is not None:
+                targets.add(target)
+            moved += 1
+        return moved
+
+    def _end_clients(self, node: AggregatorNode) -> List[str]:
+        """End-client ids with a live slot on ``node`` (``node:*`` child
+        identities excluded — subtrees re-home by re-parenting + cumulative
+        re-ship, not by handoff)."""
+        out: set = set()
+        agg = node.aggregator
+        for tenant_id in agg.tenants():
+            tenant = agg._tenant(tenant_id)
+            with tenant.lock:
+                out.update(c for c in tenant.clients if not c.startswith("node:"))
+        return sorted(out)
+
+    def _rehome_into(self, target: AggregatorNode, targets: Optional[set] = None) -> int:
+        """Hand every OTHER ring member's end clients that the ring now
+        assigns to ``target`` over to it, converging under live traffic:
+        each source is FLUSHED first (a client whose accepted payload still
+        sits queued-but-unfolded has no slot yet — skipping it would leave
+        a frozen copy behind once the flush lands it), and the sweep
+        repeats until a pass moves nothing, so ships that land at a source
+        mid-sweep are caught by the next pass. Returns clients moved."""
+        rehomed = 0
+        max_passes = 10  # converges in 1-2 passes; bound it regardless
+        for attempt in range(max_passes):
+            moved_this_pass = 0
+            for member in self.router.members():
+                if member == target.name:
+                    continue
+                src = self.router.member_node(member)
+                src.aggregator.flush()
+                for client_id in self._end_clients(src):
+                    if self.router.assign(client_id) == target.name:
+                        moved_this_pass += 1 if self._handoff_client(src, client_id, targets) else 0
+            rehomed += moved_this_pass
+            if not moved_this_pass:
+                break
+        else:
+            # no silent caps: falling out with work still moving means NEW
+            # slots kept appearing at sources faster than the sweep drained
+            # them; returning "success" would leave stragglers' old slots
+            # folding next to their new homes — a double count nobody sees.
+            # Raising hands control to the caller's rollback (join) or the
+            # operator (retry when ingest pressure subsides).
+            raise ServeError(
+                f"re-homing into {target.name!r} did not converge after"
+                f" {max_passes} sweep passes ({rehomed} clients moved and new"
+                " slots kept appearing) — ingest pressure is outrunning the"
+                " rebalance; retry when it subsides"
+            )
+        return rehomed
+
+    # ------------------------------------------------------------------
+    # readiness
+    # ------------------------------------------------------------------
+
+    def node_ready(self, node: AggregatorNode) -> Tuple[bool, List[str]]:
+        """The admission probe: a node enters the ring only when it (a) is
+        alive, (b) carries every fleet tenant at the exact fleet schema,
+        (c) is not draining, (d) runs a flush worker iff the fleet does,
+        and (e) completes a probe flush. Returns ``(ready, reasons)``."""
+        reasons: List[str] = []
+        if node.is_dead:
+            return False, ["node is dead (hard-killed)"]
+        agg = node.aggregator
+        root_agg = self.tree.root.aggregator
+        if agg.tenants() != root_agg.tenants():
+            reasons.append(
+                f"tenant registry mismatch: node has {agg.tenants()}, fleet has {root_agg.tenants()}"
+            )
+        else:
+            for tenant_id in agg.tenants():
+                if agg.schema_hash(tenant_id) != root_agg.schema_hash(tenant_id):
+                    reasons.append(f"schema hash mismatch for tenant {tenant_id!r}")
+        if getattr(agg, "draining", False):
+            reasons.append("node is draining")
+        if not node.parent_reachable():
+            # admitting a node whose uplink is down would blackhole its
+            # keyspace share at the root until a heal — every forward()
+            # would drop (serve.forward_errors) while it keeps accepting
+            reasons.append("parent unreachable (dead or partitioned uplink)")
+        if root_agg.worker_alive() is not None and agg.worker_alive() is not True:
+            reasons.append("fleet runs background flush workers but this node's is not alive")
+        try:
+            agg.flush()
+        except Exception as err:  # noqa: BLE001 — the probe judges, never raises
+            reasons.append(f"probe flush failed: {type(err).__name__}: {err}")
+        return (not reasons), reasons
+
+    # ------------------------------------------------------------------
+    # join / drain / split / merge
+    # ------------------------------------------------------------------
+
+    def join_node(
+        self,
+        name: Optional[str] = None,
+        parent: Optional[AggregatorNode] = None,
+        *,
+        _kind: str = "join",
+    ) -> AggregatorNode:
+        """Admit a new leaf while traffic flows.
+
+        The join protocol: build the node with the tree's retained
+        factories/policy/engine (tenants registered), **warm** its fold
+        executables through the :mod:`metrics_tpu.engine` store
+        (``warmup()`` — zero backend compiles on the first fold when the
+        store is hot), start a flush worker iff the fleet runs them, run
+        the **readiness probe** — and only then admit it to the ring. Ring
+        admission triggers the rebalance: exactly the clients whose
+        assignment moved to the new node are handed off from their old
+        homes (snapshot + tombstone, watermarks preserved). A node that
+        fails its probe is detached again and the join raises — a
+        half-ready node must never own keys. Returns the admitted node."""
+        # label the in-flight gauge with the joining node when its name is
+        # known (splits always name the sibling); an anonymous join falls
+        # back to the coordinator's (root's) identity
+        target = str(name) if name is not None else self.tree.root.name
+        return self._with_rebalance(_kind, target, lambda: self._join(name, parent))
+
+    def _join(self, name: Optional[str], parent: Optional[AggregatorNode]) -> AggregatorNode:
+        with self._lock:
+            node = self.tree.add_node(name, parent)
+            try:
+                node.last_warmup_programs = node.aggregator.warmup()
+                if self.tree.root.aggregator.worker_alive() is not None:
+                    # the fleet drains queues with background workers; a
+                    # joining node nobody start()s would silently freeze
+                    node.aggregator.start()
+                ready, reasons = self.node_ready(node)
+                if not ready:
+                    raise ServeError(
+                        f"joining node {node.name!r} failed its readiness probe"
+                        f" ({'; '.join(reasons)}); it was NOT admitted to the ring"
+                    )
+            except Exception:
+                # a failed admission must not leak the worker started above:
+                # the detached aggregator's daemon thread would keep waking
+                # per flush interval forever (one orphan per failed join)
+                try:
+                    node.aggregator.stop()
+                except Exception:  # noqa: BLE001 — rollback must not mask the probe failure
+                    pass
+                self.tree.remove_node(node)
+                raise
+            self.router.add(node.name, node)
+            try:
+                # re-home exactly the clients the ring moved to the new node
+                # (sources flushed first; sweep repeats until dry — see
+                # _rehome_into for why both matter under live traffic)
+                self._rehome_into(node)
+                # the join completes with every moved snapshot ACCEPTED at
+                # the new node (watermark queryable), not merely queued
+                node.aggregator.flush()
+            except Exception:
+                # roll the ADMISSION back, mirroring the drain's failure
+                # path: a node left in the ring with the re-home incomplete
+                # would keep receiving its share of ships while the
+                # not-yet-moved clients' old slots fold on — a permanent
+                # double count, and the join would not even be retryable
+                # (the name is taken). Leave the ring, hand everything that
+                # already moved in back to its restored old homes, detach.
+                self.router.remove(node.name)
+                # FLUSH before enumerating: snapshots already handed off sit
+                # in this node's ingest queue until folded — enumerating the
+                # slot table alone would miss (and then discard) them
+                node.aggregator.flush()
+                targets: set = set()
+                for client_id in self._end_clients(node):
+                    self._handoff_client(node, client_id, targets)
+                for target in targets:
+                    target.aggregator.flush()
+                try:
+                    node.aggregator.stop()
+                except Exception:  # noqa: BLE001 — rollback must not mask the cause
+                    pass
+                self.tree.remove_node(node)
+                raise
+            if _obs_enabled():
+                _obs_gauge("serve.ring_members", float(len(self.router)), node=self.tree.root.name)
+            return node
+
+    def drain_node(
+        self,
+        node_or_name: Union[str, AggregatorNode],
+        *,
+        timeout_s: Optional[float] = None,
+        _kind: str = "drain",
+    ) -> Dict[str, Any]:
+        """Remove a node while traffic flows, losing nothing it accepted.
+
+        The drain protocol, in order: (1) leave the ring — the router
+        stops assigning new ships here; (2)
+        :meth:`~metrics_tpu.serve.Aggregator.drain` — admission refused,
+        the ingest queue folded **to empty** (bounded by the timeout; a
+        queued-but-unfolded payload is never stranded), worker stopped;
+        (3) one final cumulative ship upward, so the parent's view stays
+        complete while re-homed state is in flight; (4) every end client
+        handed off to its new ring home (snapshot + tombstone-retire);
+        (5) child subtrees re-parented to a peer (ship sequence reset so
+        ``_resume_seq`` re-derives against the new parent — the heal
+        mechanism, reused); (6) the node's ``node:*`` identity retired at
+        its parent, tombstoned so a late duplicate of the final ship
+        cannot resurrect the moved state; (7) the node detached. Returns
+        an action summary dict."""
+        node = self._resolve(node_or_name)
+        # coerce BEFORE any mutation: a malformed timeout must fail here,
+        # not after the ring exit (which would roll back for nothing)
+        timeout_s = None if timeout_s is None else float(timeout_s)
+        return self._with_rebalance(_kind, node.name, lambda: self._drain(node, timeout_s))
+
+    def _drain(self, node: AggregatorNode, timeout_s: Optional[float]) -> Dict[str, Any]:
+        with self._lock:
+            if node is self.tree.root:
+                raise RebalancePreconditionError("cannot drain the root: it is the state of record")
+            if node.is_dead:
+                raise RebalancePreconditionError(
+                    f"node {node.name!r} is dead; drain needs a live node —"
+                    " heal it first (Supervisor.heal) or leave it to supervision"
+                )
+            if node.parent is not None and node.parent.is_dead:
+                # without a live parent the final ship drops AND the
+                # node:* tombstone-retire is impossible — a parent healed
+                # later from a pre-drain checkpoint would resurrect the
+                # drained child's frozen state next to the re-homed live
+                # clients, forever. Same rule as add_node: heal first.
+                raise RebalancePreconditionError(
+                    f"cannot drain {node.name!r}: its parent {node.parent.name!r} is"
+                    " dead, so the final ship and the tombstoned retirement have"
+                    " nowhere to land — heal the parent first (Supervisor.heal)"
+                )
+            in_ring = node.name in self.router
+            if in_ring and len(self.router) <= 1:
+                raise RebalancePreconditionError("cannot drain the last ring member: clients need a home")
+            if in_ring:
+                self.router.remove(node.name)
+            try:
+                drained = node.aggregator.drain(
+                    self.drain_timeout_s if timeout_s is None else float(timeout_s)
+                )
+            except Exception:
+                # none of THIS node's slots moved yet: RE-OPEN admission and
+                # re-admit to the ring, so a node left out of it while still
+                # refusing ingest cannot blackhole ~1/n of the keyspace.
+                # But traffic did not stop during the wedged drain — clients
+                # this node owns were routed to OTHER leaves meanwhile, and
+                # the restored ring points their future ships back here:
+                # those interim copies must be handed back (not frozen at
+                # the detour leaves forever, a permanent double count)
+                node.aggregator.resume_admission()
+                if in_ring:
+                    self.router.add(node.name, node)
+                    self._rehome_into(node)
+                    node.aggregator.flush()
+                raise
+            # final cumulative ship: everything this node ever accepted is
+            # at the parent BEFORE the handoffs start — the no-loss half of
+            # the protocol (forward() survives transport failures by
+            # contract, so from here the drain runs to completion; the
+            # handoffs themselves absorb target backpressure rather than
+            # abort, because a half-rebalanced fleet double-counts)
+            node.forward()
+            # DETACH under the forward lock: a concurrent pump's in-flight
+            # forward either completed before this (its ship is folded by
+            # the parent flush below and retired with the rest) or starts
+            # after and no-ops — without this, a late ship landing after
+            # the retire would ADVANCE the tombstone and be re-admitted as
+            # a rejoined node, resurrecting the frozen state forever
+            # (caught by the concurrent-pump verify drive)
+            with node._forward_lock:
+                node.detached = True
+            # drain() folded the queue to empty with admission closed, so
+            # the slot table is complete and frozen — one enumeration pass
+            # suffices here (unlike the live-source join sweep)
+            clients = self._end_clients(node)
+            targets: set = set()
+            for client_id in clients:
+                self._handoff_client(node, client_id, targets)
+            for target in targets:
+                # same acceptance guarantee as the join: when drain_node
+                # returns, every re-homed client's watermark is queryable
+                # at its new home — the no-loss check the smoke asserts
+                target.aggregator.flush()
+            kids = self.tree.children(node)
+            if kids:
+                peers = [
+                    n
+                    for lvl in self.tree.levels
+                    if node in lvl
+                    for n in lvl
+                    if n is not node and not n.is_dead
+                ] or [node.parent]
+                for i, child in enumerate(kids):
+                    self.tree.reparent(child, peers[i % len(peers)])
+            if node.parent is not None and not node.parent.is_dead:
+                # tombstone the upward identity: the parent stops folding
+                # the frozen final ship (its content now lives in the new
+                # homes), and a chaos-duplicated copy of that ship drops
+                # against the tombstone instead of double counting forever.
+                # The parent must FLUSH first — the final ship may still sit
+                # in its ingest queue, and a retire that runs before the
+                # acceptance would tombstone nothing, letting the next flush
+                # resurrect the slot (caught by the drain bitwise tests).
+                node.parent.aggregator.flush()
+                node.parent.aggregator.retire_client(f"node:{node.name}")
+                if node.parent.aggregator._manager is not None:
+                    # make the retirement DURABLE: a checkpointing parent
+                    # (the root) healed from its newest checkpoint must come
+                    # back post-drain — tombstones ride the manifest, but
+                    # only a checkpoint taken after the retire carries them;
+                    # reviving a pre-drain one would resurrect the drained
+                    # child's frozen final ship as a live client forever
+                    node.parent.aggregator.save()
+            self.tree.remove_node(node)
+            if _obs_enabled():
+                _obs_gauge("serve.ring_members", float(len(self.router)), node=self.tree.root.name)
+            return {
+                "node": node.name,
+                "drained": int(drained),
+                "rehomed_clients": len(clients),
+                "reparented": [k.name for k in kids],
+            }
+
+    def split_node(
+        self,
+        node_or_name: Union[str, AggregatorNode],
+        name: Optional[str] = None,
+    ) -> AggregatorNode:
+        """Relieve an overloaded leaf by **joining a sibling** under the
+        same parent — a pure composition of the join protocol (counted as
+        ``kind=split``). The ring hands the new sibling its share of keys,
+        including part of the overloaded node's; nothing else moves."""
+        victim = self._resolve(node_or_name)
+        if victim.name not in self.router:
+            raise ServeError(
+                f"{victim.name!r} is not a ring member; split applies to leaves"
+            )
+        if name is None:
+            with self._lock:
+                self._split_counter += 1
+                name = f"{victim.name}.s{self._split_counter}"
+        return self.join_node(name, victim.parent, _kind="split")
+
+    def merge_node(
+        self,
+        node_or_name: Union[str, AggregatorNode],
+        *,
+        timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Fold an underloaded leaf back into the fleet — a pure
+        composition of the drain protocol (counted as ``kind=merge``):
+        its keys redistribute to the surviving ring members."""
+        return self.drain_node(node_or_name, timeout_s=timeout_s, _kind="merge")
+
+
+def _series_by_node(table: Dict[str, Any], family: str) -> Dict[str, Any]:
+    """Per-node values of one series family out of a snapshot table
+    (``family{node=...}`` keys, quoted labels handled by the exposition
+    parser). Multi-label series keep the worst (max) value per node."""
+    from metrics_tpu.obs.export import _parse_labels
+
+    out: Dict[str, Any] = {}
+    prefix = family + "{"
+    for key, value in table.items():
+        if not key.startswith(prefix) or not key.endswith("}"):
+            continue
+        labels = dict(_parse_labels(key[len(prefix) : -1]))
+        node = labels.get("node")
+        if node is None:
+            continue
+        if isinstance(value, (int, float)):
+            out[node] = max(float(value), out.get(node, float("-inf")))
+        else:
+            # histogram snapshots cannot be max()ed directly: keep the
+            # BUSIEST series per node, so if a family ever grows a second
+            # label (tenant=, like serve.dedup_drops) dict order cannot
+            # silently shadow a saturated series with an idle one
+            prev = out.get(node)
+            count = float(value.get("count", 0)) if isinstance(value, dict) else 0.0
+            prev_count = float(prev.get("count", 0)) if isinstance(prev, dict) else -1.0
+            if count >= prev_count:
+                out[node] = value
+    return out
+
+
+class Autoscaler:
+    """Queue-pressure-driven split/merge policy over an :class:`ElasticFleet`.
+
+    Reads the scaling signals the serving tier already exports — the
+    ``serve.queue_depth{node=}`` gauge series and the per-node
+    ``serve.hop_queue_wait_ms`` histogram p99 — off the **federated** obs
+    snapshot (:func:`metrics_tpu.obs.federation.federated_snapshot`, which
+    degrades to the local registry on a single-process fleet), so the
+    root's autoscaler sees the deepest queue anywhere in the tree.
+    :meth:`evaluate` returns decisions without acting (testable policy);
+    :meth:`step` executes at most ONE decision per call, rate-limited by
+    ``cooldown_s`` — autoscaling oscillation is a failure mode, and one
+    bounded action per cooldown window keeps every step auditable
+    (``serve.autoscaler_decisions{action=}``).
+
+    Args:
+        fleet: the :class:`ElasticFleet` to act on.
+        split_queue_depth: split the worst leaf when its queue depth
+            gauge reaches this (``None`` disarms the depth trigger).
+        split_queue_wait_p99_ms: split when the worst leaf's
+            ``serve.hop_queue_wait_ms`` p99 exceeds this (``None``
+            disarms).
+        merge_queue_depth: merge the least-loaded leaf when EVERY leaf's
+            queue depth is at or below this (``None`` disarms merging).
+        min_leaves / max_leaves: hard bounds on ring membership.
+        cooldown_s: minimum seconds between executed actions.
+    """
+
+    def __init__(
+        self,
+        fleet: ElasticFleet,
+        *,
+        split_queue_depth: Optional[float] = None,
+        split_queue_wait_p99_ms: Optional[float] = None,
+        merge_queue_depth: Optional[float] = None,
+        min_leaves: int = 1,
+        max_leaves: int = 64,
+        cooldown_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if min_leaves < 1:
+            raise ValueError(f"min_leaves must be >= 1, got {min_leaves}")
+        if max_leaves < min_leaves:
+            raise ValueError(f"max_leaves must be >= min_leaves, got {max_leaves}")
+        self.fleet = fleet
+        self.split_queue_depth = split_queue_depth
+        self.split_queue_wait_p99_ms = split_queue_wait_p99_ms
+        self.merge_queue_depth = merge_queue_depth
+        self.min_leaves = int(min_leaves)
+        self.max_leaves = int(max_leaves)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._last_action_s: Optional[float] = None
+
+    def _signals(self) -> Tuple[Dict[str, float], Dict[str, float], set]:
+        """(queue depth, queue-wait p99 ms, members-with-a-live-depth-series)
+        per ring member, off the federated snapshot. Missing series read
+        as 0 for the SPLIT triggers (0 never exceeds a threshold — fails
+        safe); the returned presence set lets the merge trigger refuse to
+        act on absent telemetry, which would otherwise read a cold/disarmed
+        obs registry as a uniformly idle fleet."""
+        from metrics_tpu.obs import federation as _federation
+        from metrics_tpu.obs.registry import HistogramSnapshot
+
+        snap = _federation.federated_snapshot()
+        depths = _series_by_node(snap.get("gauges", {}) or {}, "serve.queue_depth")
+        waits_raw = _series_by_node(snap.get("histograms", {}) or {}, "serve.hop_queue_wait_ms")
+        members = self.fleet.router.members()
+        depth = {m: float(depths.get(m, 0.0)) for m in members}
+        present = {m for m in members if m in depths}
+        wait: Dict[str, float] = {}
+        for m in members:
+            hist = waits_raw.get(m)
+            if isinstance(hist, dict):
+                try:
+                    hist = HistogramSnapshot.from_dict(hist)
+                except (TypeError, ValueError, KeyError):
+                    hist = None
+            wait[m] = float(hist.p99) if hist is not None and hist.count else 0.0
+        return depth, wait, present
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """Policy verdicts under the current signals (no side effects):
+        a list of ``{"action": "split"|"merge", "node", "reason"}``."""
+        members = self.fleet.router.members()
+        if not members:
+            return []
+        depth, wait, present = self._signals()
+        decisions: List[Dict[str, Any]] = []
+        # each trigger judges ITS OWN worst node: the deepest-queue leaf
+        # and the slowest-wait leaf need not be the same one, and testing
+        # the wait threshold against the deepest queue would let a
+        # saturated-but-shallow leaf starve forever
+        worst_depth = max(members, key=lambda m: (depth[m], m))
+        worst_wait = max(members, key=lambda m: (wait[m], m))
+        over_depth = (
+            self.split_queue_depth is not None
+            and depth[worst_depth] >= self.split_queue_depth
+        )
+        over_wait = (
+            self.split_queue_wait_p99_ms is not None
+            and wait[worst_wait] >= self.split_queue_wait_p99_ms
+        )
+        if (over_depth or over_wait) and len(members) < self.max_leaves:
+            if over_depth:
+                target = worst_depth
+                signal = f"queue_depth={depth[worst_depth]:.0f}"
+            else:
+                target = worst_wait
+                signal = f"hop_queue_wait_p99={wait[worst_wait]:.1f}ms"
+            decisions.append(
+                {
+                    "action": "split",
+                    "node": target,
+                    "reason": f"overloaded: {signal} at/over the split threshold",
+                }
+            )
+        elif (
+            self.merge_queue_depth is not None
+            and len(members) > self.min_leaves
+            # every member must have a LIVE depth series: absent telemetry
+            # (obs disarmed, registry reset, a node not yet scraped) must
+            # be inert, not read as "idle" — the split triggers fail safe
+            # on missing data, but merging on it would drain a loaded
+            # fleet down to min_leaves one cooldown window at a time
+            and present == set(members)
+            and all(depth[m] <= self.merge_queue_depth for m in members)
+        ):
+            idlest = min(members, key=lambda m: (depth[m], wait[m], m))
+            decisions.append(
+                {
+                    "action": "merge",
+                    "node": idlest,
+                    "reason": (
+                        f"underloaded fleet: every leaf's queue_depth <="
+                        f" {self.merge_queue_depth:.0f}; folding the idlest leaf back in"
+                    ),
+                }
+            )
+        return decisions
+
+    def step(self) -> List[Dict[str, Any]]:
+        """Evaluate and execute at most one decision (cooldown-gated);
+        returns the executed decisions (empty when idle or cooling down)."""
+        now = self._clock()
+        if (
+            self._last_action_s is not None
+            and self.cooldown_s > 0
+            and now - self._last_action_s < self.cooldown_s
+        ):
+            return []
+        decisions = self.evaluate()
+        if not decisions:
+            return []
+        decision = decisions[0]
+        # the ATTEMPT arms the cooldown, success or not: a wedged merge
+        # that raised after its 30s drain timeout must not be re-attempted
+        # on the very next tick with zero backoff — that would defeat the
+        # anti-oscillation rate limit this class exists to provide
+        self._last_action_s = self._clock()
+        try:
+            if decision["action"] == "split":
+                node = self.fleet.split_node(decision["node"])
+                decision["joined"] = node.name
+            else:
+                summary = self.fleet.merge_node(decision["node"])
+                decision["rehomed_clients"] = summary["rehomed_clients"]
+        except ServeError as err:
+            # a failed action is REPORTED, not raised: a periodic policy
+            # tick must keep ticking (the fleet's own rollback already left
+            # the topology consistent), and the failure is visible both in
+            # the returned decision and in obs
+            decision["error"] = str(err)
+            if _obs_enabled():
+                _obs_inc("serve.autoscaler_errors", action=decision["action"])
+            return [decision]
+        if _obs_enabled():
+            _obs_inc("serve.autoscaler_decisions", action=decision["action"])
+        return [decision]

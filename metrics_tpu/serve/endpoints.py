@@ -24,6 +24,18 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   host spans plus per-hop payload lifecycles (queue-wait / fold / ship /
   e2e per trace id), loadable in Perfetto — the debug view behind the
   ``serve.hop_*_ms`` histograms.
+* ``POST /admin/drain`` — run the drain protocol on this node. With a
+  ``fleet=`` wired (an :class:`~metrics_tpu.serve.elastic.ElasticFleet`
+  member) the FULL protocol runs — ring exit, queue folded to empty,
+  client handoff, tombstoned retirement; otherwise the node-local half
+  (:meth:`Aggregator.drain`): admission refused from the first byte, the
+  ingest queue folded to empty, the worker stopped; ``/healthz/ready``
+  answers 503 from then on so load balancers route away. Optional JSON
+  body ``{"timeout_s": N}``. ``POST /admin/unquarantine`` — lift a
+  poisoned-state quarantine (JSON body ``{"tenant": ..., "client": ...}``;
+  400 on a malformed body or unarmed firewall, 404 for an unknown tenant
+  — consistent with ``/ingest``). Operator levers, deliberately narrow:
+  they change *this node's* admission state, never tenant data.
 * ``GET /healthz`` — full health JSON (tenant/client/queue counts plus the
   readiness detail). Kubernetes-style split probes:
   ``GET /healthz/live`` — pure liveness (the process answers); and
@@ -49,6 +61,8 @@ import numpy as np
 from metrics_tpu.serve.aggregator import (
     Aggregator,
     BackpressureError,
+    DrainingError,
+    ServeError,
     UnknownTenantError,
 )
 from metrics_tpu.serve.resilience import CircuitOpenError, QuarantinedClientError
@@ -75,6 +89,12 @@ class MetricsServer:
             ``max(1.0, 20 * flush_interval_s)`` for nodes with a
             background worker — a worker that stopped folding is not
             ready even while its thread is technically alive).
+        fleet: the :class:`~metrics_tpu.serve.elastic.ElasticFleet` this
+            aggregator is a member of, when it is. ``POST /admin/drain``
+            then runs the FULL fleet drain protocol (ring exit, client
+            handoff, tombstoned retirement) instead of only closing local
+            admission — draining a ring member without re-homing its keys
+            would blackhole ~1/n of the keyspace behind 503s.
 
     Example::
 
@@ -93,8 +113,10 @@ class MetricsServer:
         arm_obs: bool = True,
         ready_max_queue_frac: float = 0.9,
         ready_max_flush_age_s: Optional[float] = None,
+        fleet: Optional[Any] = None,
     ) -> None:
         self.aggregator = aggregator
+        self.fleet = fleet
         self.ready_max_queue_frac = float(ready_max_queue_frac)
         self.ready_max_flush_age_s = ready_max_flush_age_s
         if arm_obs:
@@ -213,6 +235,72 @@ class MetricsServer:
         would throw away every client snapshot for nothing."""
         return {"live": True, "node": self.aggregator.name, "worker_alive": self.aggregator.worker_alive()}
 
+    def admin_drain(self, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """The ``POST /admin/drain`` body. With a ``fleet=`` wired and this
+        aggregator a live member of its tree, the FULL fleet drain protocol
+        runs (:meth:`~metrics_tpu.serve.elastic.ElasticFleet.drain_node` —
+        ring exit, queue folded to empty, final ship, client handoff,
+        tombstoned retirement): a ring member whose admission merely closed
+        would blackhole its share of the keyspace behind 503s, since the
+        router would keep assigning it clients nothing re-homes. Without a
+        fleet, the node-local half runs
+        (:meth:`~metrics_tpu.serve.Aggregator.drain` — admission refused,
+        queue folded to empty, worker stopped; the coordinator watching
+        ``/healthz/ready`` owns the re-homing). Either way the node answers
+        ``/healthz/ready`` 503 from the first call on."""
+        # validate BEFORE any topology mutation: a malformed timeout must be
+        # a 400, never a ring exit + rollback churn
+        timeout_s = None if timeout_s is None else float(timeout_s)
+        if self.fleet is not None:
+            # resolve by NAME, not object identity: a Supervisor heal swaps
+            # a fresh Aggregator into the node, and an identity miss that
+            # silently fell back to the local drain would close admission
+            # while the name stayed in the ring — the keyspace blackhole
+            # the fleet path exists to prevent, reported as success
+            node = next(
+                (n for n in self.fleet.tree.nodes if n.name == self.aggregator.name),
+                None,
+            )
+            if node is None:
+                raise ValueError(
+                    f"aggregator {self.aggregator.name!r} is not a member of the"
+                    " wired fleet's tree; refusing a local-only drain that would"
+                    " leave a ring member refusing ingest"
+                )
+            summary = self.fleet.drain_node(node, timeout_s=timeout_s)
+            return {
+                "node": summary["node"],
+                "draining": True,
+                "drained": summary["drained"],
+                "rehomed_clients": summary["rehomed_clients"],
+                "reparented": summary["reparented"],
+                "protocol": "fleet",
+            }
+        kwargs = {} if timeout_s is None else {"timeout_s": timeout_s}
+        drained = self.aggregator.drain(**kwargs)
+        return {
+            "node": self.aggregator.name,
+            "draining": True,
+            "drained": int(drained),
+            "queue_depth": self.aggregator._queue.qsize(),
+            "protocol": "local",
+        }
+
+    def admin_unquarantine(self, tenant: str, client: str) -> Dict[str, Any]:
+        """The ``POST /admin/unquarantine`` body: lift a poisoned-state
+        quarantine (:meth:`~metrics_tpu.serve.resilience.ClientFirewall.unquarantine`
+        — the operator lever; quarantine never expires on its own).
+        Raises for an unknown tenant (404) or an unarmed firewall (400)."""
+        agg = self.aggregator
+        agg._tenant(tenant)  # unknown tenant -> UnknownTenantError -> 404
+        if agg.firewall is None:
+            raise ValueError(
+                f"aggregator {agg.name!r} has no resilience firewall armed"
+                " (Aggregator(resilience=...)); nothing can be quarantined here"
+            )
+        lifted = agg.firewall.unquarantine(tenant, client)
+        return {"node": agg.name, "tenant": str(tenant), "client": str(client), "lifted": bool(lifted)}
+
     def render_ready(self) -> Dict[str, Any]:
         """Readiness verdict + the signals behind it (queue depth, last
         flush age, worker liveness, circuit/quarantine states)."""
@@ -227,6 +315,10 @@ class MetricsServer:
         if max_flush_age is None and worker is not None:
             max_flush_age = max(1.0, 20.0 * agg._flush_interval_s)
         reasons = []
+        if getattr(agg, "draining", False):
+            # a draining node refuses ingest by contract — load balancers
+            # must route away NOW, before clients see DrainingError
+            reasons.append("node is draining (admission closed; clients re-route)")
         if worker is False:
             reasons.append("background flush worker died (Supervisor heal / start() restarts it)")
         if max_queue > 0 and queue_depth >= self.ready_max_queue_frac * max_queue:
@@ -317,8 +409,63 @@ def _make_handler(server: MetricsServer):
             except Exception as err:  # noqa: BLE001 — the server must answer, not die
                 self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
 
+        def _read_json_body(self, max_len: int = 65536) -> Dict[str, Any]:
+            """Small-JSON POST body (admin routes). Empty body -> {};
+            malformed JSON / non-object / oversized raises ValueError
+            (mapped to 400, consistent with /ingest's malformed-payload
+            handling)."""
+            length = int(self.headers.get("Content-Length", "0"))
+            if length < 0 or length > max_len:
+                raise ValueError(f"admin request body of {length} bytes refused (cap {max_len})")
+            if length == 0:
+                return {}
+            raw = self.rfile.read(length)
+            obj = json.loads(raw.decode())
+            if not isinstance(obj, dict):
+                raise ValueError(f"admin request body must be a JSON object, got {type(obj).__name__}")
+            return obj
+
         def do_POST(self) -> None:  # noqa: N802
             parsed = urlparse(self.path)
+            if parsed.path == "/admin/drain":
+                from metrics_tpu.serve.elastic import RebalancePreconditionError
+
+                try:
+                    body = self._read_json_body()
+                    timeout_s = body.get("timeout_s")
+                    self._reply_json(200, server.admin_drain(timeout_s))
+                except (ValueError, TypeError) as err:
+                    self._reply_json(400, {"error": str(err)})
+                except RebalancePreconditionError as err:
+                    # NOT retryable as-is (root / last ring member / dead
+                    # node or parent): 409, so automation keying on 5xx
+                    # does not hammer an operation that can never succeed
+                    self._reply_json(409, {"error": str(err)})
+                except ServeError as err:
+                    # the drain TIMED OUT with payloads still queued: nothing
+                    # was stranded silently, the operator retries
+                    self._reply_json(500, {"error": str(err)})
+                except Exception as err:  # noqa: BLE001
+                    self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
+                return
+            if parsed.path == "/admin/unquarantine":
+                try:
+                    body = self._read_json_body()
+                    tenant, client = body.get("tenant"), body.get("client")
+                    if not tenant or not client:
+                        self._reply_json(
+                            400,
+                            {"error": 'body must be {"tenant": ..., "client": ...}'},
+                        )
+                        return
+                    self._reply_json(200, server.admin_unquarantine(str(tenant), str(client)))
+                except UnknownTenantError as err:
+                    self._reply_json(404, {"error": str(err)})
+                except (ValueError, TypeError) as err:
+                    self._reply_json(400, {"error": str(err)})
+                except Exception as err:  # noqa: BLE001
+                    self._reply_json(500, {"error": f"{type(err).__name__}: {err}"})
+                return
             if parsed.path != "/ingest":
                 self._reply_json(404, {"error": f"no route {parsed.path!r}"})
                 return
@@ -356,6 +503,10 @@ def _make_handler(server: MetricsServer):
             except QuarantinedClientError as err:
                 # 403, not 5xx: retrying cannot help a quarantined client
                 self._reply_json(403, {"error": str(err)})
+            except DrainingError as err:
+                # 503 WITHOUT Retry-After: this node never comes back — the
+                # client's fix is to re-resolve its route, not to wait
+                self._reply_json(503, {"error": str(err)})
             except (WireFormatError, SchemaMismatchError, ValueError) as err:
                 self._reply_json(400, {"error": str(err)})
             except CircuitOpenError as err:

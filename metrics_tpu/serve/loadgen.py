@@ -72,6 +72,7 @@ def run_loadgen(
     verify: bool = False,
     tenant: str = "loadgen",
     fault_rate: float = 0.0,
+    churn: bool = False,
 ) -> Dict[str, Any]:
     """Drive the tree and return the ``serve_*`` row values.
 
@@ -84,6 +85,17 @@ def run_loadgen(
     :class:`~metrics_tpu.ft.faults.WireChaos` schedule (rate split evenly
     over drop/duplicate/reorder/corrupt) against resilience-armed nodes;
     the refused/dropped accounting rides the returned dict.
+
+    With ``churn=True`` the tree runs under an
+    :class:`~metrics_tpu.serve.elastic.ElasticFleet`: clients consult the
+    consistent-hash :class:`~metrics_tpu.serve.elastic.Router` **per
+    ship**, one node JOINS after the first round and one intermediate is
+    HARD-KILLED (and supervisor-healed) after the second — all inside the
+    timed window, so the returned ``serve_churn_merges_per_s`` rate is
+    throughput *sustained through topology churn* (the inverted-gate
+    bench row). Use ``payloads_per_client >= 3`` so both churn events
+    land mid-window; ``verify=True`` still pins the root bitwise (the
+    rebalance must be invisible, which is the point).
     """
     import jax.numpy as jnp
 
@@ -140,6 +152,7 @@ def run_loadgen(
         delivered: set = set()
         refused = 0
         refused_circuit = 0
+        churn_events: Dict[str, Any] = {}
 
         def deliver(blobs, c: int) -> None:
             nonlocal refused, refused_circuit
@@ -147,7 +160,7 @@ def run_loadgen(
 
             for blob in blobs:
                 try:
-                    tree.leaf_for(c).ingest(blob)
+                    _ingest_for(c, identity[blob][0] if blob in identity else None, blob)
                 except WireFormatError:
                     refused += 1  # corrupt-in-flight, refused by the crc32
                 except CircuitOpenError:
@@ -165,6 +178,20 @@ def run_loadgen(
             tenants={tenant: factory},
             resilience=None if chaos is None else ResilienceConfig(),
         )
+        fleet = None
+        if churn:
+            from metrics_tpu.serve.elastic import ElasticFleet
+
+            fleet = ElasticFleet(tree, seed=seed + 2)
+
+        def _ingest_for(c: int, client_id, blob: bytes) -> None:
+            # elastic mode routes by the ring (the per-ship Router consult
+            # the elasticity contract requires); static mode keeps the
+            # round-robin leaf so the established rows stay comparable
+            if fleet is not None and client_id is not None:
+                fleet.router.route(client_id).ingest(blob)
+            else:
+                tree.leaf_for(c).ingest(blob)
         # UNTIMED warmup flush: one identity (freshly-reset) snapshot from a
         # throwaway client through leaf 0 and a full pump. The cold cost —
         # the first fold's trace+compile chain down every level — is its own
@@ -199,7 +226,7 @@ def run_loadgen(
             t0 = time.perf_counter()
             for c, payload in round_payloads:
                 if chaos is None:
-                    tree.leaf_for(c).ingest(payload)
+                    _ingest_for(c, identity[payload][0], payload)
                 else:
                     _, now_blobs = chaos.plan(payload)
                     deliver(now_blobs, c)
@@ -210,6 +237,23 @@ def run_loadgen(
                 for blob in chaos.end_round():
                     deliver([blob], identity[blob][2])
             tree.pump()
+            if fleet is not None and r == 0:
+                # churn event 1, INSIDE the timed window: a node joins —
+                # admission protocol + ring re-homing all count against the
+                # sustained rate (that is what the churn row measures)
+                churn_events["joined"] = fleet.join_node().name
+            elif fleet is not None and r == 1 and len(tree.levels) > 2:
+                # churn event 2: an intermediate is hard-killed and healed
+                # by supervision; its state reconstructs from the children's
+                # next cumulative ships on the pump below
+                from metrics_tpu.ft import faults
+                from metrics_tpu.serve.resilience import Supervisor
+
+                victim = tree.levels[1][len(tree.levels[1]) // 2]
+                faults.kill_node(victim)
+                Supervisor(tree, warn=False).heal()
+                churn_events["killed"] = victim.name
+                tree.pump()
             elapsed += time.perf_counter() - t0
         if chaos is not None:
             t0 = time.perf_counter()
@@ -255,6 +299,11 @@ def run_loadgen(
         out["chaos_counts"] = dict(chaos.counts)
         out["refused_corrupt"] = int(refused)
         out["refused_circuit"] = int(refused_circuit)
+    if churn:
+        # the same sustained rate, named as the churn row: merges/s held
+        # while a node joined and an intermediate died mid-window
+        out["serve_churn_merges_per_s"] = out["serve_ingest_merges_per_s"]
+        out["churn_events"] = dict(churn_events)
 
     if verify:
         # the oracle: per client, the highest-watermark snapshot that was
@@ -302,6 +351,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--num-bins", type=int, default=256)
     parser.add_argument("--verify", action="store_true")
     parser.add_argument("--fault-rate", type=float, default=0.0)
+    parser.add_argument("--churn", action="store_true")
     args = parser.parse_args(argv)
     result = run_loadgen(
         n_clients=args.clients,
@@ -310,6 +360,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         num_bins=args.num_bins,
         verify=args.verify,
         fault_rate=args.fault_rate,
+        churn=args.churn,
     )
     print(json.dumps(result, indent=2, sort_keys=True))
     return 0

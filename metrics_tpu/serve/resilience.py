@@ -60,6 +60,7 @@ import numpy as np
 from metrics_tpu.ft.retry import RetryPolicy, backoff_schedule
 from metrics_tpu.obs.registry import enabled as _obs_enabled
 from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
 from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.serve.aggregator import ServeError
 
@@ -587,9 +588,16 @@ class Supervisor:
         actions: List[Dict[str, Any]] = []
         for node in self.tree.nodes:
             if node.is_dead:
+                t0 = time.perf_counter()
                 manifest = self.tree.revive(node)
                 if _obs_enabled():
                     _obs_inc("serve.heals", kind="rebuild_node")
+                    # per-action repair latency: how long the fleet ran with
+                    # this node dark — the churn headline /metrics renders
+                    # next to serve.rebalance_ms (federated like any histogram)
+                    _obs_observe(
+                        "serve.heal_ms", (time.perf_counter() - t0) * 1000.0, kind="rebuild_node"
+                    )
                 actions.append(
                     {
                         "action": "rebuild_node",
@@ -602,9 +610,13 @@ class Supervisor:
                     }
                 )
             elif node.aggregator.worker_alive() is False:
+                t0 = time.perf_counter()
                 node.aggregator.start()
                 if _obs_enabled():
                     _obs_inc("serve.heals", kind="restart_worker")
+                    _obs_observe(
+                        "serve.heal_ms", (time.perf_counter() - t0) * 1000.0, kind="restart_worker"
+                    )
                 actions.append({"action": "restart_worker", "node": node.name})
         return actions
 

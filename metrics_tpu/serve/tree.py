@@ -24,6 +24,7 @@ same :class:`AggregatorNode.forward` loop against a parent's ``/ingest``
 endpoint instead of an in-memory parent.
 """
 import itertools
+import threading
 import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -33,7 +34,7 @@ from metrics_tpu.obs.registry import inc as _obs_inc
 from metrics_tpu.obs.registry import new_trace_id as _new_trace_id
 from metrics_tpu.obs.registry import observe as _obs_observe
 from metrics_tpu.obs.registry import record_hop as _obs_record_hop
-from metrics_tpu.serve.aggregator import Aggregator, BackpressureError
+from metrics_tpu.serve.aggregator import Aggregator, BackpressureError, DrainingError
 from metrics_tpu.serve.resilience import (
     CircuitOpenError,
     NodeDownError,
@@ -46,10 +47,15 @@ __all__ = ["AggregationTree", "AggregatorNode"]
 # send/flush failures forward() survives: the transport (or the peer) is
 # down or refusing — transient by contract, repaired by the next interval's
 # cumulative ship. Anything else (a bug in OUR encode/fold) still raises.
+# DrainingError belongs here too: a parent mid-drain refuses ingest until
+# the elastic protocol reparents this child, whose next cumulative ship
+# then lands at the NEW parent — one draining hop must not abort the
+# whole pump sweep.
 _TRANSPORT_ERRORS = (
     NodeDownError,
     BackpressureError,
     CircuitOpenError,
+    DrainingError,
     QuarantinedClientError,
     ConnectionError,
     OSError,
@@ -101,6 +107,14 @@ class AggregatorNode:
         self._probe = probe
         self._ship_seq: Optional["itertools.count"] = None
         self._killed_with_worker = False
+        # set (under _forward_lock) by the elastic drain after the final
+        # ship: a detached node's forward() is a no-op. Without this, a
+        # pump thread's in-flight forward could land AFTER the parent
+        # tombstone-retired this identity — and, advancing the watermark,
+        # be re-admitted under the node-rejoin rule, resurrecting the
+        # drained node's frozen state next to its re-homed clients forever
+        self.detached = False
+        self._forward_lock = threading.Lock()
         # programs resolved by the last revive's warmup (0 = no AOT engine)
         self.last_warmup_programs = 0
         # previous forward's send latency: a hop record is built BEFORE its
@@ -203,6 +217,17 @@ class AggregatorNode:
         raising here would let one dead hop abort the whole pump loop,
         turning a one-node failure into a fleet-wide one.
         """
+        with self._forward_lock:
+            return self._forward_locked()
+
+    def _forward_locked(self) -> int:
+        # the lock is what makes an elastic drain's detach ATOMIC against
+        # in-flight forwards: a forward holding it completes (its ship is
+        # folded by the parent before the retire); one starting after the
+        # detach no-ops. It also serializes concurrent pumps per node,
+        # which the ship-sequence counter wants anyway.
+        if self.detached:
+            return 0
         if self.parent is None and self._send is None:
             return 0
         try:
@@ -373,31 +398,15 @@ class AggregationTree:
         self._max_queue = int(max_queue)
         self._resilience = resilience
         self._engine = get_engine(engine)
-        root_agg = Aggregator(
-            "root",
-            checkpoint_dir=checkpoint_root,
-            max_queue=max_queue,
-            resilience=resilience,
-            engine=self._engine,
-        )
-        self.root = AggregatorNode(root_agg)
+        self.root = AggregatorNode(self._build_aggregator("root", checkpoint_dir=checkpoint_root))
         self.levels: List[List[AggregatorNode]] = [[self.root]]
         for depth, width in enumerate(fan_out):
             parents = self.levels[-1]
             level = []
             for i in range(int(width)):
-                agg = Aggregator(
-                    f"L{depth + 1}.{i}",
-                    max_queue=max_queue,
-                    resilience=resilience,
-                    engine=self._engine,
-                )
+                agg = self._build_aggregator(f"L{depth + 1}.{i}")
                 level.append(AggregatorNode(agg, parent=parents[i % len(parents)]))
             self.levels.append(level)
-        for tenant_id, factory in tenants.items():
-            for level in self.levels:
-                for node in level:
-                    node.aggregator.register_tenant(tenant_id, factory)
 
     @property
     def leaves(self) -> List[AggregatorNode]:
@@ -406,6 +415,143 @@ class AggregationTree:
     @property
     def nodes(self) -> List[AggregatorNode]:
         return [node for level in self.levels for node in level]
+
+    def children(self, node: AggregatorNode) -> List[AggregatorNode]:
+        """Nodes currently shipping into ``node``."""
+        return [n for level in self.levels for n in level if n.parent is node]
+
+    def node_by_name(self, name: str) -> AggregatorNode:
+        for node in self.nodes:
+            if node.name == str(name):
+                return node
+        raise ValueError(f"no node named {name!r} in this tree")
+
+    # ------------------------------------------------------------------
+    # Live membership (the primitives serve.elastic composes)
+    # ------------------------------------------------------------------
+
+    def add_node(
+        self,
+        name: Optional[str] = None,
+        parent: Optional[AggregatorNode] = None,
+        *,
+        level: Optional[int] = None,
+    ) -> AggregatorNode:
+        """Build a NEW node with the tree's retained tenant factories /
+        queue bound / resilience policy / execution engine and attach it
+        under ``parent`` (default: the least-loaded node of the level
+        above the leaves). This is construction + attachment ONLY — ring
+        admission, warmup and the readiness probe are the elastic join
+        protocol's job (:meth:`metrics_tpu.serve.elastic.ElasticFleet.join_node`)."""
+        if parent is not None:
+            if parent.is_dead:
+                raise ValueError(
+                    f"parent {parent.name!r} is dead (hard-killed); heal it before"
+                    " attaching a new node — its children's ships would all drop"
+                )
+            for depth_idx, lvl in enumerate(self.levels):
+                if parent in lvl:
+                    depth = depth_idx + 1
+                    break
+            else:
+                raise ValueError(f"parent {parent.name!r} is not in this tree")
+            if level is not None and int(level) != depth:
+                raise ValueError(
+                    f"level={level} contradicts parent {parent.name!r} at depth {depth - 1}"
+                )
+            if depth >= len(self.levels):
+                raise ValueError(
+                    f"parent {parent.name!r} is a leaf; the tree does not grow new levels"
+                )
+        else:
+            depth = (len(self.levels) - 1) if level is None else int(level)
+            if not 1 <= depth < len(self.levels):
+                raise ValueError(f"level must be in [1, {len(self.levels) - 1}], got {depth}")
+            # dead nodes are not attachment candidates: a new leaf under an
+            # unhealed hard-killed intermediate would have every ship drop
+            parents = [p for p in self.levels[depth - 1] if not p.is_dead]
+            if not parents:
+                raise ValueError(
+                    f"level {depth - 1} has no live node to attach under; heal first"
+                )
+            load = {id(p): 0 for p in parents}
+            for n in self.levels[depth]:
+                if id(n.parent) in load:
+                    load[id(n.parent)] += 1
+            parent = min(parents, key=lambda p: load[id(p)])
+        existing = {n.name for n in self.nodes}
+        if name is None:
+            i = len(self.levels[depth])
+            while f"L{depth}.{i}" in existing:
+                i += 1
+            name = f"L{depth}.{i}"
+        elif str(name) in existing:
+            raise ValueError(f"node name {name!r} already exists in this tree")
+        node = AggregatorNode(self._build_aggregator(str(name)), parent=parent)
+        self.levels[depth].append(node)
+        return node
+
+    def _build_aggregator(self, name: str, *, checkpoint_dir: Optional[str] = None) -> Aggregator:
+        """ONE recipe for building a node's aggregator from the tree's
+        retained configuration — shared by construction-time levels,
+        :meth:`add_node` (elastic join) and :meth:`revive` (heal), so a
+        future policy knob cannot drift between joined and healed nodes."""
+        agg = Aggregator(
+            name,
+            checkpoint_dir=checkpoint_dir,
+            max_queue=self._max_queue,
+            resilience=self._resilience,
+            engine=self._engine,
+        )
+        for tenant_id, factory in self.tenant_factories.items():
+            agg.register_tenant(tenant_id, factory)
+        return agg
+
+    def remove_node(self, node: AggregatorNode) -> None:
+        """Detach ``node`` from the tree. Refuses the root and any node
+        that still has children (reparent them first) — the elastic drain
+        protocol handles both, plus re-homing the node's clients and
+        retiring its ``node:*`` identity at the parent."""
+        if node is self.root:
+            raise ValueError("cannot remove the root (it is the state of record)")
+        kids = self.children(node)
+        if kids:
+            raise ValueError(
+                f"node {node.name!r} still has children"
+                f" {[k.name for k in kids]}; reparent them first"
+            )
+        for lvl in self.levels:
+            if node in lvl:
+                lvl.remove(node)
+                if not lvl and lvl is not self.levels[0]:
+                    # an emptied interior level (every intermediate drained,
+                    # children re-parented upward) is pruned so `leaves`
+                    # keeps naming the level end clients actually ship to
+                    self.levels.remove(lvl)
+                return
+        raise ValueError(f"node {node.name!r} is not in this tree")
+
+    def reparent(self, node: AggregatorNode, new_parent: AggregatorNode) -> None:
+        """Move a subtree under a new parent and RESET its ship sequence so
+        the next :meth:`AggregatorNode.forward` re-derives it via
+        ``_resume_seq`` against the NEW parent's watermarks — the exact
+        mechanism a healed node uses, reused for rebalancing (one
+        correctness mechanism, not two). The caller (the elastic drain
+        protocol) retires the ``node:*`` slot at the OLD parent; without
+        that the old parent would keep folding a frozen copy of the
+        subtree forever. In-process transport only: a node with a custom
+        ``send`` hook keeps it, so HTTP-wired nodes must re-point it."""
+        if node is self.root:
+            raise ValueError("cannot reparent the root")
+        cursor: Optional[AggregatorNode] = new_parent
+        while cursor is not None:
+            if cursor is node:
+                raise ValueError(
+                    f"reparenting {node.name!r} under {new_parent.name!r} would create a cycle"
+                )
+            cursor = cursor.parent
+        node.parent = new_parent
+        node._ship_seq = None
 
     def leaf_for(self, client_index: int) -> Aggregator:
         """The leaf aggregator client ``client_index`` ingests into."""
@@ -460,15 +606,9 @@ class AggregationTree:
         :meth:`~metrics_tpu.serve.resilience.Supervisor.heal` reports).
         Returns the restore manifest (None when nothing was restored)."""
         is_root = node is self.root
-        agg = Aggregator(
-            node.name,
-            checkpoint_dir=self._checkpoint_root if is_root else None,
-            max_queue=self._max_queue,
-            resilience=self._resilience,
-            engine=self._engine,
+        agg = self._build_aggregator(
+            node.name, checkpoint_dir=self._checkpoint_root if is_root else None
         )
-        for tenant_id, factory in self.tenant_factories.items():
-            agg.register_tenant(tenant_id, factory)
         # warm BEFORE restore: executables are ready the moment states land
         node.last_warmup_programs = agg.warmup()
         manifest = None

@@ -1,0 +1,299 @@
+"""CI smoke: topology churn is bitwise-invisible at the root.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.elastic_smoke``
+(the CI step does, mirroring ``chaos_smoke``). One orchestrated arm plus a
+loadgen arm:
+
+* **orchestrated arm** — 64 clients ship 4 cumulative snapshot intervals
+  through a (2, 4) :class:`~metrics_tpu.serve.ElasticFleet`, consulting
+  the consistent-hash :class:`~metrics_tpu.serve.Router` **per ship**,
+  under a seeded 10% :class:`~metrics_tpu.ft.faults.WireChaos` schedule
+  (drop / duplicate / reorder / corrupt / delay). Between intervals the
+  topology churns through every rebalance kind via the seeded chaos
+  injectors: a node **JOINS** (admission protocol: warm, readiness probe,
+  ring re-homing), a leaf **DRAINS** (queue folded to empty, final
+  cumulative ship, client handoff, tombstoned retirement — no payload it
+  accepted may be lost), a leaf **SPLITS** (sibling join), and an
+  intermediate is **HARD-KILLED** mid-run and rebuilt by the Supervisor.
+  The final root ``/query`` over HTTP must be **bitwise-equal to the flat
+  oracle merge of exactly the accepted snapshots**, every rebalance must
+  be visible in ``serve.rebalances{kind=}`` / ``chaos.injected{kind=}`` /
+  ``serve.rebalance_ms`` / ``serve.heal_ms``, and every client the
+  drained node held must be re-homed at a watermark >= the one it had
+  there (the no-loss half, asserted directly).
+* **loadgen arm** — the churn bench row's harness at 1k clients
+  (``churn=True``, join + intermediate kill inside the timed window) with
+  ``verify=True``: the root stays bitwise-equal while the rate row is
+  measured.
+
+Why the hard-kill targets an intermediate, never a leaf: same argument as
+``chaos_smoke`` — interior state reconstructs from the children's next
+cumulative ships, so the oracle stays an exact function of the delivery
+schedule. Drains may target leaves precisely BECAUSE the drain protocol's
+handoff preserves accepted end-client state; that asymmetry (kill loses
+nothing interior, drain loses nothing at all) is the contract this smoke
+pins.
+"""
+import json
+import os
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260804
+N_CLIENTS = 64
+N_INTERVALS = 4
+SAMPLES = 64
+TENANT = "elastic"
+FAN_OUT = (2, 4)
+
+
+def _factory():
+    from metrics_tpu import MaxMetric, SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=128), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def _client_snapshots():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for c in range(N_CLIENTS):
+        cid = f"client-{c:03d}"
+        rng = np.random.default_rng(7000 + c)
+        coll = _factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            target = jnp.asarray(
+                (rng.uniform(0, 1, SAMPLES) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32)
+            )
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+            coll["peak"].update(preds)
+            blobs.append(encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval)))
+        out[cid] = blobs
+    return out
+
+
+def _orchestrated_arm() -> None:
+    import numpy as np
+
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.serve import (
+        AggregationTree,
+        Aggregator,
+        ElasticFleet,
+        MetricsServer,
+        ResilienceConfig,
+        Supervisor,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, peek_header
+
+    obs.reset()
+    obs.enable()
+    snapshots = _client_snapshots()
+    # 10% total wire-fault budget, split over all five fates
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.02, p_duplicate=0.02, p_reorder=0.02, p_corrupt=0.02, p_delay=0.02
+    )
+    tree = AggregationTree(
+        fan_out=FAN_OUT,
+        tenants={TENANT: _factory},
+        resilience=ResilienceConfig(error_threshold=3),
+    )
+    fleet = ElasticFleet(tree, seed=SEED)
+    supervisor = Supervisor(tree, heartbeat_timeout_s=5.0, name="supervisor", warn=False)
+
+    delivered = set()  # (client_id, interval) delivered uncorrupted + admitted
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                # corruption mangled the framing itself: route it anywhere
+                # live, it is refused either way
+                try:
+                    fleet.router.route("garbage").ingest(blob)
+                except WireFormatError:
+                    pass
+                continue
+            cid = str(header["client"])
+            try:
+                # the elasticity contract: consult the Router PER SHIP
+                fleet.router.route(cid).ingest(blob)
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+            else:
+                delivered.add((cid, int(header["watermark"][1])))
+
+    def deliver_interval(interval: int) -> None:
+        for cid in sorted(snapshots):
+            _, now_blobs = chaos.plan(snapshots[cid][interval])
+            deliver(now_blobs)
+        deliver(chaos.end_round())
+
+    # ---- interval 0, then a node JOINS (admission protocol) -------------
+    deliver_interval(0)
+    fleet.pump()
+    joined = faults.join_node(fleet)
+    assert joined.name in fleet.router.members()
+
+    # ---- interval 1, then a seeded leaf DRAINS --------------------------
+    deliver_interval(1)
+    fleet.pump()
+    victim_name = chaos.choice(sorted(fleet.router.members()))
+    victim = tree.node_by_name(victim_name)
+    # capture what the draining node holds: every one of these must exist
+    # somewhere in the fleet at >= this watermark after the drain (the
+    # "no payload accepted by a draining node is lost" acceptance check)
+    held = {
+        cid: victim.aggregator.client_watermark(TENANT, cid)
+        for cid in sorted(victim.aggregator._tenant(TENANT).clients)
+        if not cid.startswith("node:")
+    }
+    summary = faults.drain_node(fleet, victim)
+    assert summary["rehomed_clients"] == len(held), summary
+    for cid, wm in held.items():
+        new_home = fleet.router.route(cid)
+        rehomed_wm = new_home.client_watermark(TENANT, cid)
+        assert rehomed_wm is not None and rehomed_wm >= wm, (
+            f"client {cid} (watermark {wm} on drained {victim_name}) not re-homed:"
+            f" {new_home.name} holds {rehomed_wm}"
+        )
+    fleet.pump()
+
+    # ---- interval 2, then a SPLIT and an intermediate HARD-KILL ---------
+    deliver_interval(2)
+    fleet.pump()
+    split_victim = chaos.choice(sorted(fleet.router.members()))
+    sibling = faults.split_node(fleet, split_victim)
+    assert sibling.name in fleet.router.members()
+    kill_victim = chaos.choice(tree.levels[1])
+    faults.kill_node(kill_victim)
+    report = supervisor.check()
+    assert "dead_node" in {f["kind"] for f in report["findings"]}, report
+    actions = supervisor.heal()
+    assert any(a["action"] == "rebuild_node" and a["node"] == kill_victim.name for a in actions)
+    fleet.pump()
+
+    # ---- interval 3, drain everything chaos still holds, converge -------
+    deliver_interval(3)
+    deliver(chaos.flush())
+    fleet.pump(rounds=3)
+
+    # ---- oracle: flat merge of exactly the accepted snapshots -----------
+    accepted = {}
+    for cid, interval in delivered:
+        if cid not in accepted or interval > accepted[cid]:
+            accepted[cid] = interval
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(TENANT, _factory)
+    for cid, interval in sorted(accepted.items()):
+        flat.ingest(snapshots[cid][interval])
+    flat.flush()
+    flat_tenant = flat._tenant(TENANT)
+    if flat_tenant.merged_leaves is None:
+        flat_tenant.fold()
+    tree.root.aggregator.flush()
+    root_tenant = tree.root.aggregator._tenant(TENANT)
+    if root_tenant.merged_leaves is None:
+        root_tenant.fold()
+    assert root_tenant.spec == flat_tenant.spec
+    for (path, _), ours, oracle in zip(
+        root_tenant.spec, root_tenant.merged_leaves, flat_tenant.merged_leaves
+    ):
+        assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+            f"root leaf {'/'.join(path)} differs from the accepted-snapshot oracle"
+            " after join+drain+split+kill churn"
+        )
+
+    # ---- every churn event and fault is visible in obs ------------------
+    for kind in ("join", "drain", "split", "kill"):
+        assert obs.get_counter("chaos.injected", kind=kind) >= 1, kind
+    for kind, count in chaos.counts.items():
+        if kind == "deliver" or count == 0:
+            continue
+        assert obs.get_counter("chaos.injected", kind=kind) == count, kind
+    # split runs AS a join composition but is counted as its own kind
+    assert obs.get_counter("serve.rebalances", kind="join") == 1
+    assert obs.get_counter("serve.rebalances", kind="drain") == 1
+    assert obs.get_counter("serve.rebalances", kind="split") == 1
+    rebalance_hist = obs.get_histogram("serve.rebalance_ms", kind="drain")
+    assert rebalance_hist is not None and rebalance_hist.count == 1
+    heal_hist = obs.get_histogram("serve.heal_ms", kind="rebuild_node")
+    assert heal_hist is not None and heal_hist.count >= 1
+    assert obs.get_counter("serve.drains", node=victim_name) == 1
+    assert obs.get_counter("health.alerts", monitor="supervisor", kind="dead_node") >= 1
+
+    # ---- the HTTP surface agrees and reports itself ready ---------------
+    server = MetricsServer(tree.root.aggregator, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        q = json.load(urllib.request.urlopen(f"{base}/query?tenant={TENANT}", timeout=10))
+        offline = tree.root.aggregator.query(TENANT)
+        assert q == json.loads(json.dumps(offline)), "HTTP /query != in-process query"
+        ready = json.load(urllib.request.urlopen(f"{base}/healthz/ready", timeout=10))
+        assert ready["ready"] is True, ready
+        metrics = urllib.request.urlopen(f"{base}/metrics", timeout=10).read().decode()
+        assert "serve_rebalances" in metrics, "churn counters missing from /metrics"
+    finally:
+        server.stop()
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    print(
+        f"elastic smoke [orchestrated]: {N_CLIENTS} clients x {N_INTERVALS} intervals at"
+        f" 10% wire faults ({faults_injected} injected) through join({joined.name}) +"
+        f" drain({victim_name}, {len(held)} clients re-homed, none lost) +"
+        f" split({split_victim}->{sibling.name}) + hard-kill({kill_victim.name}) +"
+        " supervised rebuild — root /query bitwise-equal to the accepted-snapshot"
+        " oracle, every rebalance visible in obs counters",
+        flush=True,
+    )
+
+
+def _loadgen_arm() -> None:
+    from metrics_tpu import obs
+    from metrics_tpu.serve.loadgen import run_loadgen
+
+    obs.reset()
+    out = run_loadgen(
+        n_clients=1000,
+        fan_out=(4, 16),
+        payloads_per_client=3,
+        samples_per_payload=128,
+        num_bins=128,
+        seed=SEED,
+        verify=True,
+        churn=True,
+    )
+    assert out["verified_bitwise"] is True
+    assert out["churn_events"].get("joined") and out["churn_events"].get("killed")
+    assert out["serve_churn_merges_per_s"] > 0
+    print(
+        f"elastic smoke [loadgen]: 1000 clients x 3 snapshots,"
+        f" {out['churn_events']['joined']} joined + {out['churn_events']['killed']}"
+        f" hard-killed+healed mid-window at"
+        f" {out['serve_churn_merges_per_s']:.0f} merges/s — root bitwise-equal",
+        flush=True,
+    )
+
+
+def main() -> None:
+    _orchestrated_arm()
+    _loadgen_arm()
+    print("elastic smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

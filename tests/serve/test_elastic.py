@@ -1,0 +1,1346 @@
+"""Elastic membership: the rebalance protocol is bitwise-invisible.
+
+The elasticity story rests on three claims, each pinned here: (1) the
+seeded consistent-hash ring moves ONLY the clients whose assignment
+actually changed on a membership change; (2) every join / drain / split /
+merge — including a client or whole subtree moving to a NEW parent
+mid-stream, and a move racing an in-flight duplicate of the final ship —
+leaves the root bitwise-equal to the flat oracle merge of the accepted
+snapshots; (3) a draining node never strands a payload it accepted
+(queued-but-unfolded payloads are folded, held snapshots are handed off
+at their exact watermarks).
+"""
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import (
+    AggregationTree,
+    Aggregator,
+    Autoscaler,
+    DrainingError,
+    ElasticFleet,
+    HashRing,
+    MetricsServer,
+    ResilienceConfig,
+    Router,
+    ServeError,
+)
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "t"
+
+
+def factory() -> MetricCollection:
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+class _Clients:
+    """N simulated clients shipping cumulative snapshots via a router."""
+
+    def __init__(self, n: int, seed: int = 0) -> None:
+        self.rng = np.random.default_rng(seed)
+        self.colls = {f"client-{c:03d}": factory() for c in range(n)}
+        self.final = {}
+        self.step = {cid: 0 for cid in self.colls}
+
+    def ship_all(self, fleet: ElasticFleet) -> None:
+        for cid in sorted(self.colls):
+            self.ship(fleet, cid)
+
+    def ship(self, fleet: ElasticFleet, cid: str) -> bytes:
+        coll = self.colls[cid]
+        n = 32
+        preds = jnp.asarray(self.rng.uniform(0, 1, n).astype(np.float32))
+        target = jnp.asarray((self.rng.uniform(0, 1, n) < 0.5).astype(np.int32))
+        coll["auroc"].update(preds, target)
+        coll["seen"].update(jnp.asarray(float(n)))
+        coll["peak"].update(preds)
+        blob = encode_state(
+            coll, tenant=TENANT, client_id=cid, watermark=(0, self.step[cid])
+        )
+        self.step[cid] += 1
+        self.final[cid] = blob
+        fleet.router.route(cid).ingest(blob)
+        return blob
+
+
+def assert_root_equals_oracle(tree: AggregationTree, final_snapshots) -> None:
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(TENANT, factory)
+    for blob in final_snapshots.values():
+        flat.ingest(blob)
+    flat.flush()
+    ft = flat._tenant(TENANT)
+    if ft.merged_leaves is None:
+        ft.fold()
+    tree.root.aggregator.flush()
+    rt = tree.root.aggregator._tenant(TENANT)
+    if rt.merged_leaves is None:
+        rt.fold()
+    assert rt.spec == ft.spec
+    for (path, _), ours, oracle in zip(rt.spec, rt.merged_leaves, ft.merged_leaves):
+        assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+            f"root leaf {'/'.join(path)} != flat oracle"
+        )
+
+
+def build_fleet(fan_out=(2, 4), seed=7, **tree_kwargs) -> ElasticFleet:
+    tree = AggregationTree(fan_out=fan_out, tenants={TENANT: factory}, **tree_kwargs)
+    return ElasticFleet(tree, seed=seed)
+
+
+# ----------------------------------------------------------------------
+# HashRing / Router
+# ----------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_deterministic_across_instances(self):
+        a = HashRing(seed=5)
+        b = HashRing(seed=5)
+        for m in ("n0", "n1", "n2"):
+            a.add(m)
+            b.add(m)
+        keys = [f"client-{i}" for i in range(200)]
+        assert [a.assign(k) for k in keys] == [b.assign(k) for k in keys]
+
+    def test_seed_changes_assignment(self):
+        a, b = HashRing(seed=1), HashRing(seed=2)
+        for m in ("n0", "n1", "n2", "n3"):
+            a.add(m)
+            b.add(m)
+        keys = [f"client-{i}" for i in range(200)]
+        assert [a.assign(k) for k in keys] != [b.assign(k) for k in keys]
+
+    def test_add_moves_only_affected_keys(self):
+        ring = HashRing(seed=3)
+        for m in ("n0", "n1", "n2"):
+            ring.add(m)
+        keys = [f"client-{i}" for i in range(500)]
+        before = {k: ring.assign(k) for k in keys}
+        ring.add("n3")
+        after = {k: ring.assign(k) for k in keys}
+        moved = {k for k in keys if before[k] != after[k]}
+        # every moved key moved TO the new member, never between survivors
+        assert moved and all(after[k] == "n3" for k in moved)
+        # and the move set is a minority share (~1/4 expected)
+        assert len(moved) < len(keys) / 2
+
+    def test_remove_moves_only_the_removed_members_keys(self):
+        ring = HashRing(seed=3)
+        for m in ("n0", "n1", "n2", "n3"):
+            ring.add(m)
+        keys = [f"client-{i}" for i in range(500)]
+        before = {k: ring.assign(k) for k in keys}
+        ring.remove("n1")
+        after = {k: ring.assign(k) for k in keys}
+        for k in keys:
+            if before[k] != "n1":
+                assert after[k] == before[k], "a survivor's key moved on remove"
+            else:
+                assert after[k] != "n1"
+
+    def test_balance_within_reason(self):
+        ring = HashRing(seed=0, vnodes=64)
+        for m in ("n0", "n1", "n2", "n3"):
+            ring.add(m)
+        counts = {m: 0 for m in ring.members()}
+        for i in range(4000):
+            counts[ring.assign(f"client-{i}")] += 1
+        assert max(counts.values()) < 3 * min(counts.values()), counts
+
+    def test_empty_ring_refuses(self):
+        with pytest.raises(ServeError, match="empty"):
+            HashRing().assign("x")
+
+    def test_duplicate_member_refused(self):
+        ring = HashRing()
+        ring.add("n0")
+        with pytest.raises(ValueError, match="already present"):
+            ring.add("n0")
+        with pytest.raises(ValueError, match="not present"):
+            ring.remove("n9")
+
+
+class TestRouter:
+    def test_standalone_router(self):
+        tree = AggregationTree(fan_out=(3,), tenants={TENANT: factory})
+        router = Router(vnodes=16, seed=1)
+        for leaf in tree.leaves:
+            router.add(leaf.name, leaf)
+        assert router.members() == sorted(n.name for n in tree.leaves)
+        cid = "client-xyz"
+        assert router.route(cid) is router.member_node(router.assign(cid)).aggregator
+        removed = router.remove(router.assign(cid))
+        assert removed.name not in router
+        assert router.assign(cid) != removed.name
+        with pytest.raises(ServeError, match="not a ring member"):
+            router.member_node(removed.name)
+
+    def test_route_and_version(self):
+        fleet = build_fleet()
+        router = fleet.router
+        v0 = router.version
+        cid = "client-000"
+        assert router.route(cid) is fleet.tree.node_by_name(router.assign(cid)).aggregator
+        joined = fleet.join_node()
+        assert router.version > v0
+        assert joined.name in router
+        assert len(router) == 5
+
+
+# ----------------------------------------------------------------------
+# join / drain / split / merge, bitwise at the root
+# ----------------------------------------------------------------------
+
+
+class TestJoinDrainBitwise:
+    def test_join_mid_stream_bitwise(self):
+        fleet = build_fleet()
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        fleet.join_node()
+        clients.ship_all(fleet)  # next ships route by the NEW membership
+        fleet.pump()
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_join_rehomes_only_moved_clients(self):
+        fleet = build_fleet()
+        clients = _Clients(60)
+        clients.ship_all(fleet)
+        fleet.pump()
+        before = {cid: fleet.router.assign(cid) for cid in clients.colls}
+        joined = fleet.join_node()
+        after = {cid: fleet.router.assign(cid) for cid in clients.colls}
+        moved = {cid for cid in before if before[cid] != after[cid]}
+        assert moved and all(after[cid] == joined.name for cid in moved)
+        # the handed-off snapshots are ACCEPTED at the new node already
+        for cid in moved:
+            assert joined.aggregator.client_watermark(TENANT, cid) == (0, 0)
+        # unmoved clients were untouched (still at their old homes only)
+        for cid in set(before) - moved:
+            assert before[cid] == after[cid]
+
+    def test_drain_without_further_ships_bitwise(self):
+        """The pure-handoff case: clients never ship again after the
+        drain, so ONLY the handoff can preserve their state."""
+        fleet = build_fleet()
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        fleet.drain_node(fleet.router.members()[0])
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_drain_then_ships_bitwise(self):
+        fleet = build_fleet()
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        summary = fleet.drain_node(fleet.router.members()[1])
+        assert summary["rehomed_clients"] > 0
+        clients.ship_all(fleet)
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_split_and_merge_bitwise(self):
+        fleet = build_fleet()
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        sibling = fleet.split_node(fleet.router.members()[0])
+        assert sibling.name in fleet.router
+        clients.ship_all(fleet)
+        fleet.pump()
+        assert_root_equals_oracle(fleet.tree, clients.final)
+        fleet.merge_node(sibling)
+        assert sibling.name not in fleet.router
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_repeated_churn_converges(self):
+        fleet = build_fleet(fan_out=(2, 2), seed=11)
+        clients = _Clients(30, seed=4)
+        for round_i in range(4):
+            clients.ship_all(fleet)
+            fleet.pump()
+            if round_i == 0:
+                fleet.join_node()
+            elif round_i == 1:
+                fleet.drain_node(fleet.router.members()[0])
+            elif round_i == 2:
+                fleet.split_node(fleet.router.members()[-1])
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_handoff_overrides_target_circuit(self):
+        """A client whose circuit is open at the TARGET (it shipped garbage
+        there earlier) must still have its vetted snapshot handed off —
+        the firewall judges live wire traffic, not control-plane moves."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7, resilience=ResilienceConfig(error_threshold=1))
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+        held = [
+            c
+            for c in victim.aggregator._tenant(TENANT).clients
+            if not c.startswith("node:")
+        ]
+        cid = held[0]
+        # open cid's circuit at every possible post-drain home (threshold 1)
+        for m in fleet.router.members():
+            if m != victim_name:
+                fleet.router.member_node(m).aggregator.firewall.record_error(TENANT, cid)
+        summary = fleet.drain_node(victim)
+        assert summary["rehomed_clients"] == len(held)
+        new_home = fleet.router.route(cid)
+        assert new_home.client_watermark(TENANT, cid) == (0, 0)
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_drain_refused_under_dead_parent(self):
+        """Draining a node whose parent is dead would lose the final ship
+        AND the tombstone — refuse it, as add_node refuses dead parents."""
+        from metrics_tpu.ft import faults
+
+        fleet = build_fleet()
+        victim = fleet.tree.leaves[0]
+        faults.kill_node(victim.parent)
+        with pytest.raises(ServeError, match="parent.*dead|dead.*parent"):
+            fleet.drain_node(victim)
+        assert victim.name in fleet.router  # nothing changed
+
+    def test_zombie_forward_after_drain_is_inert(self):
+        """A pump thread's late forward() on an already-drained node must
+        no-op: landing after the tombstone-retire it would ADVANCE the
+        watermark and be re-admitted as a rejoined node — resurrecting the
+        drained node's frozen state next to its re-homed clients forever
+        (found by the concurrent-pump verify drive)."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim = fleet.tree.node_by_name(fleet.router.members()[0])
+        parent = victim.parent
+        fleet.drain_node(victim)
+        assert victim.detached is True
+        assert victim.forward() == 0  # the zombie pump's late call
+        parent.aggregator.flush()
+        assert f"node:{victim.name}" not in parent.aggregator._tenant(TENANT).clients
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_drain_root_refused(self):
+        fleet = build_fleet()
+        with pytest.raises(ServeError, match="root"):
+            fleet.drain_node(fleet.tree.root)
+
+    def test_drain_last_leaf_refused(self):
+        tree = AggregationTree(fan_out=(1,), tenants={TENANT: factory})
+        fleet = ElasticFleet(tree)
+        with pytest.raises(ServeError, match="last ring member"):
+            fleet.drain_node(fleet.router.members()[0])
+
+    def test_join_rehomes_queued_but_unfolded_clients(self):
+        """A client whose accepted payload still sits QUEUED at its old
+        home has no slot yet — the re-home must flush sources first, or
+        the later flush would land a frozen copy nothing ever retires."""
+        fleet = build_fleet()
+        clients = _Clients(60)
+        # ship WITHOUT folding: every payload stays in its leaf's queue
+        for cid in sorted(clients.colls):
+            clients.ship(fleet, cid)
+        assigns = {cid: fleet.router.assign(cid) for cid in clients.colls}
+        joined = fleet.join_node()
+        moved = [cid for cid in assigns if fleet.router.assign(cid) == joined.name]
+        assert moved, "ring moved no client; pick another seed"
+        for cid in moved:
+            assert joined.aggregator.client_watermark(TENANT, cid) == (0, 0), cid
+            old = fleet.tree.node_by_name(assigns[cid]).aggregator._tenant(TENANT)
+            assert cid not in old.clients and cid in old.retired
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_takeout_client_atomic_and_restorable(self):
+        """The handoff read side: takeout removes + tombstones in one
+        step, and re-accepting the returned payload restores the slot
+        (the delivery-failure rollback path)."""
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        coll = factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        agg.ingest(encode_state(coll, tenant=TENANT, client_id="c0", watermark=(0, 3)))
+        agg.flush()
+        payload = agg.takeout_client(TENANT, "c0")
+        tenant = agg._tenant(TENANT)
+        assert payload is not None and payload.watermark == (0, 3)
+        assert "c0" not in tenant.clients and "c0" in tenant.retired
+        assert agg.takeout_client(TENANT, "c0") is None  # idempotent read side
+        agg.ingest(payload)  # the rollback: rehomed_from + equal watermark
+        agg.flush()
+        assert "c0" in tenant.clients and "c0" not in tenant.retired
+        assert tenant.clients["c0"].journal.watermark == (0, 3)
+
+    def test_failed_drain_rehomes_interim_detour_copies(self, monkeypatch):
+        """Traffic does not stop during a wedged drain: clients routed to
+        detour leaves while the node was out of the ring must be handed
+        BACK on rollback — frozen detour copies would double count."""
+        fleet = build_fleet()
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+        victims_clients = [
+            cid for cid in clients.colls if fleet.router.assign(cid) == victim_name
+        ]
+        assert victims_clients
+
+        def wedged_drain(self, timeout_s=30.0):
+            self._draining = True
+            # mid-drain, the fleet keeps serving: the victim's clients ship
+            # a new interval to their DETOUR homes (victim is out of the ring)
+            for cid in victims_clients:
+                assert fleet.router.assign(cid) != victim_name
+                clients.ship(fleet, cid)
+            raise ServeError("injected: queue cannot empty")
+
+        monkeypatch.setattr(Aggregator, "drain", wedged_drain)
+        with pytest.raises(ServeError, match="injected"):
+            fleet.drain_node(victim)
+        monkeypatch.undo()
+        assert victim_name in fleet.router
+        # the detour copies were handed back: the victim holds the NEW
+        # interval and no other leaf holds a live copy
+        for cid in victims_clients:
+            assert victim.aggregator.client_watermark(TENANT, cid) == (0, 1), cid
+            for member in fleet.router.members():
+                if member == victim_name:
+                    continue
+                other = fleet.router.member_node(member).aggregator._tenant(TENANT)
+                assert cid not in other.clients, (cid, member)
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_failed_drain_rolls_back_ring_and_admission(self, monkeypatch):
+        """A drain whose queue cannot empty must leave the fleet EXACTLY as
+        it was: node back in the ring AND admitting again — a ring member
+        stuck refusing ingest would blackhole ~1/n of the keyspace."""
+        fleet = build_fleet()
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+
+        def wedged_drain(self, timeout_s=30.0):
+            self._draining = True
+            raise ServeError("injected: queue cannot empty")
+
+        monkeypatch.setattr(Aggregator, "drain", wedged_drain)
+        with pytest.raises(ServeError, match="injected"):
+            fleet.drain_node(victim)
+        monkeypatch.undo()
+        assert victim_name in fleet.router
+        assert victim.aggregator.draining is False
+        clients.ship_all(fleet)  # the re-admitted node accepts again
+        fleet.pump()
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_handoff_survives_target_backpressure(self, monkeypatch):
+        """A full target queue mid-rebalance must not abort the drain (a
+        half-rebalanced fleet double-counts): the handoff falls back to a
+        synchronous accept and the root stays bitwise."""
+        from metrics_tpu.serve.aggregator import BackpressureError
+
+        fleet = build_fleet()
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim = fleet.tree.node_by_name(fleet.router.members()[0])
+        original_ingest = Aggregator.ingest
+        rejected = []
+
+        def full_queue_once(self, payload, **kwargs):
+            if getattr(payload, "meta", {}).get("rehomed_from") and not rejected:
+                rejected.append(self.name)
+                raise BackpressureError("injected: queue full")
+            return original_ingest(self, payload, **kwargs)
+
+        monkeypatch.setattr(Aggregator, "ingest", full_queue_once)
+        summary = fleet.drain_node(victim)
+        monkeypatch.undo()
+        assert rejected, "the injected backpressure never fired"
+        assert summary["rehomed_clients"] > 0
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_failed_join_does_not_leak_worker(self, monkeypatch):
+        import threading
+
+        fleet = build_fleet()
+        fleet.tree.root.aggregator.start()  # fleet runs background workers
+        try:
+            monkeypatch.setattr(
+                ElasticFleet, "node_ready", lambda self, node: (False, ["injected"])
+            )
+            with pytest.raises(ServeError, match="readiness probe"):
+                fleet.join_node("doomed")
+            assert not any(
+                t.name == "serve-agg-doomed" and t.is_alive()
+                for t in threading.enumerate()
+            ), "the failed join leaked its flush worker thread"
+        finally:
+            fleet.tree.root.aggregator.stop()
+
+    def test_failed_rehome_rolls_back_ring_admission(self, monkeypatch):
+        """A handoff failure AFTER ring admission must not leave a
+        half-rehomed member: the ring is restored, moved clients go back,
+        and the join stays retryable (the name is freed)."""
+        fleet = build_fleet()
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        before_members = set(fleet.router.members())
+        original = ElasticFleet._handoff_client
+        calls = []
+
+        def fail_second(self, src, client_id, targets=None):
+            calls.append(client_id)
+            if len(calls) == 2:
+                raise ServeError("injected: delivery exploded")
+            return original(self, src, client_id, targets)
+
+        monkeypatch.setattr(ElasticFleet, "_handoff_client", fail_second)
+        with pytest.raises(ServeError, match="injected"):
+            fleet.join_node("doomed2")
+        monkeypatch.undo()
+        assert set(fleet.router.members()) == before_members
+        assert all(n.name != "doomed2" for n in fleet.tree.nodes)
+        # nothing stranded on the removed node: every client is queryable
+        # at its (restored) ring home and the root matches the oracle
+        for cid in clients.colls:
+            assert fleet.router.route(cid).client_watermark(TENANT, cid) is not None
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+        fleet.join_node("doomed2")  # retryable: the name was freed
+        fleet.pump()
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_join_avoids_dead_parent(self):
+        """A join racing an unhealed intermediate kill must not attach the
+        new leaf under the corpse (every ship would drop)."""
+        from metrics_tpu.ft import faults
+
+        fleet = build_fleet()
+        dead = fleet.tree.levels[1][0]
+        faults.kill_node(dead)
+        joined = fleet.join_node()
+        assert joined.parent is not dead and not joined.parent.is_dead
+        with pytest.raises(ValueError, match="dead"):
+            fleet.tree.add_node("x", parent=dead)
+
+    def test_failed_probe_means_no_admission(self, monkeypatch):
+        fleet = build_fleet()
+        before = set(fleet.router.members())
+        monkeypatch.setattr(
+            ElasticFleet, "node_ready", lambda self, node: (False, ["injected"])
+        )
+        with pytest.raises(ServeError, match="readiness probe"):
+            fleet.join_node()
+        assert set(fleet.router.members()) == before
+        # the half-built node was detached again, not leaked into the tree
+        assert len(fleet.tree.leaves) == len(before)
+
+
+# ----------------------------------------------------------------------
+# cross-parent re-homing (the _resume_seq gap the issue names)
+# ----------------------------------------------------------------------
+
+
+class TestCrossParentRehoming:
+    def test_client_moves_to_new_parent_mid_stream(self):
+        """Drain every leaf under intermediate L1.0: its clients MUST land
+        on leaves under L1.1 — a cross-parent client move mid-stream."""
+        fleet = build_fleet(fan_out=(2, 4), seed=7)
+        tree = fleet.tree
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        inter_a = tree.levels[1][0]
+        for leaf in [n for n in tree.leaves if n.parent is inter_a]:
+            fleet.drain_node(leaf)
+        assert all(leaf.parent is not inter_a for leaf in tree.leaves)
+        clients.ship_all(fleet)  # every client now ships under a NEW parent
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+
+    def test_subtree_moves_to_new_parent_mid_stream(self):
+        """Drain an INTERMEDIATE: its child leaves re-parent to the peer
+        intermediate and their next cumulative ship (with the ship
+        sequence re-derived by _resume_seq) rebuilds the view there."""
+        fleet = build_fleet(fan_out=(2, 4), seed=7)
+        tree = fleet.tree
+        clients = _Clients(40)
+        clients.ship_all(fleet)
+        fleet.pump()
+        inter = tree.levels[1][0]
+        moved_leaves = [n for n in tree.leaves if n.parent is inter]
+        summary = fleet.drain_node(inter)
+        assert set(summary["reparented"]) == {n.name for n in moved_leaves}
+        for leaf in moved_leaves:
+            assert leaf.parent is tree.levels[1][0]  # the surviving peer
+            assert leaf._ship_seq is None  # _resume_seq re-derives at the new parent
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+        clients.ship_all(fleet)
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+
+    def test_move_racing_inflight_duplicate_of_final_ship(self):
+        """A chaos-duplicated copy of the drained node's FINAL upward ship
+        delivered AFTER the drain completed must drop against the
+        tombstone — not resurrect the re-homed state (double count)."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        tree = fleet.tree
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim = tree.node_by_name(fleet.router.members()[0])
+        parent = victim.parent
+        shipped = []
+        original_ingest = parent.aggregator.ingest
+
+        def capture(payload, **kwargs):
+            if isinstance(payload, (bytes, bytearray)):
+                shipped.append(bytes(payload))
+            return original_ingest(payload, **kwargs)
+
+        victim._send = capture
+        fleet.drain_node(victim)
+        assert shipped, "the drain never shipped its final cumulative snapshot"
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+        # the in-flight duplicate of the final ship lands late
+        import metrics_tpu.obs as obs
+
+        was = obs.enable()
+        try:
+            parent.aggregator.ingest(shipped[-1])
+            parent.aggregator.flush()
+            tenant = parent.aggregator._tenant(TENANT)
+            assert f"node:{victim.name}" not in tenant.clients
+            assert obs.get_counter("serve.dedup_drops", tenant=TENANT, kind="retired") >= 1
+        finally:
+            obs.enable(was)
+            obs.reset()
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+
+    def test_client_duplicate_final_ship_after_rehoming(self):
+        """The END-client version of the race: a duplicate of the client's
+        final ship delivered to its NEW home after the handoff dedups
+        against the handed-off watermark."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+        held = [
+            c
+            for c in victim.aggregator._tenant(TENANT).clients
+            if not c.startswith("node:")
+        ]
+        assert held
+        fleet.drain_node(victim)
+        cid = held[0]
+        new_home = fleet.router.route(cid)
+        assert new_home.client_watermark(TENANT, cid) == (0, 0)
+        new_home.ingest(clients.final[cid])  # the duplicate
+        new_home.flush()
+        assert new_home._tenant(TENANT).clients[cid].journal.folded == 1
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_tombstones_survive_checkpoint_restore(self, tmp_path):
+        """A checkpointing parent (the root) healed after a drain must come
+        back POST-drain: the drain writes a fresh checkpoint whose manifest
+        carries the tombstone, and restore repopulates it — a pre-drain
+        registry would resurrect the drained child's frozen final ship as
+        a live client the root then double-counts forever."""
+        tree = AggregationTree(
+            fan_out=(2,),
+            tenants={TENANT: factory},
+            checkpoint_root=str(tmp_path / "root-ckpt"),
+        )
+        fleet = ElasticFleet(tree, seed=7)
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        tree.save()  # the pre-drain checkpoint the heal must NOT come back to
+        victim_name = fleet.router.members()[0]
+        fleet.drain_node(victim_name)  # parent is the root: retires + saves
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+        from metrics_tpu.ft import faults
+        from metrics_tpu.serve import Supervisor
+
+        faults.kill_node(tree.root)
+        Supervisor(tree, warn=False).heal()
+        tenant = tree.root.aggregator._tenant(TENANT)
+        assert f"node:{victim_name}" not in tenant.clients
+        assert f"node:{victim_name}" in tenant.retired  # tombstone restored
+        # a chaos-duplicated final ship arriving post-heal still drops
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(tree, clients.final)
+
+    def test_rejoined_name_resumes_above_tombstone(self):
+        """A node re-joining under a previously drained NAME must resume
+        its ship sequence above the tombstoned watermark, or every ship
+        would drop as a retired duplicate (a silently frozen node)."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(name)
+        parent = victim.parent
+        fleet.drain_node(victim)
+        ghost_wm = parent.aggregator.client_watermark(TENANT, f"node:{name}")
+        assert ghost_wm is not None  # the tombstone answers
+        rejoined = fleet.join_node(name, parent)
+        clients.ship_all(fleet)
+        fleet.pump(rounds=2)
+        # the re-joined node's ships were ACCEPTED (sequence resumed above
+        # the tombstone), not dropped as retired duplicates
+        if rejoined.aggregator._tenant(TENANT).clients:
+            new_wm = parent.aggregator.client_watermark(TENANT, f"node:{name}")
+            assert new_wm is not None and new_wm > ghost_wm
+            assert f"node:{name}" in parent.aggregator._tenant(TENANT).clients
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_stale_routed_advancing_ship_drops_at_old_home(self):
+        """A ship whose route was resolved BEFORE a rebalance lands at the
+        old home with an ADVANCING watermark. Accepting it would resurrect
+        the client there — a double count nothing ever reconciles; the
+        drop is safe because the client's next correctly-routed cumulative
+        ship carries everything."""
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        clients = _Clients(20)
+        before = None
+        clients.ship_all(fleet)
+        fleet.pump()
+        before = {cid: fleet.router.assign(cid) for cid in clients.colls}
+        joined = fleet.join_node()  # old homes stay LIVE and accepting
+        moved = [cid for cid in before if fleet.router.assign(cid) == joined.name]
+        assert moved, "ring moved no client; pick another seed"
+        was = obs.enable()
+        try:
+            cid = moved[0]
+            old_home = fleet.tree.node_by_name(before[cid])
+            # the racing producer resolved its route BEFORE the join and
+            # ships interval 1 to the OLD (still accepting) home
+            coll = clients.colls[cid]
+            coll["seen"].update(jnp.asarray(32.0))
+            stale = encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, 1))
+            old_home.aggregator.ingest(stale)
+            old_home.aggregator.flush()
+            tenant = old_home.aggregator._tenant(TENANT)
+            assert cid not in tenant.clients and cid in tenant.retired
+            assert obs.get_counter("serve.dedup_drops", tenant=TENANT, kind="stale_route") == 1
+            # the correctly-routed ship repairs: same cumulative state lands
+            # at the new home and the root equals the oracle
+            clients.final[cid] = stale
+            fleet.router.route(cid).ingest(stale)
+            fleet.pump(rounds=2)
+            assert_root_equals_oracle(fleet.tree, clients.final)
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_corrupt_rehome_body_preserves_tombstone(self):
+        """A rehome payload whose BODY fails validation must not destroy
+        the tombstone: otherwise a later duplicate of the retired
+        identity's final ship would be accepted as a brand-new client."""
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        coll = factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        agg.ingest(encode_state(coll, tenant=TENANT, client_id="c0", watermark=(0, 0)))
+        agg.flush()
+        good = agg.client_snapshot(TENANT, "c0")  # rehomed_from meta, wm (0,0)
+        agg.retire_client("c0")
+        bad = dataclasses.replace(good, states={})  # hash matches, body gutted
+        agg.ingest(bad)
+        with pytest.warns(UserWarning, match="corrupted payload"):
+            agg.flush()
+        tenant = agg._tenant(TENANT)
+        assert "c0" in tenant.retired and "c0" not in tenant.clients
+        # the intact handoff is still re-admitted afterwards
+        agg.ingest(good)
+        agg.flush()
+        assert "c0" in tenant.clients and "c0" not in tenant.retired
+
+    def test_client_bounces_away_and_back(self):
+        """A→B→A: the client's assignment moves to a new node and back (the
+        node drains); the second handoff re-delivers the snapshot at the
+        tombstoned watermark and must be RE-ADMITTED, not dropped."""
+        fleet = build_fleet(fan_out=(2, 2), seed=7)
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        before = {cid: fleet.router.assign(cid) for cid in clients.colls}
+        joined = fleet.join_node()
+        bounced = [cid for cid in before if fleet.router.assign(cid) == joined.name]
+        assert bounced, "ring moved no client to the new node; pick another seed"
+        fleet.drain_node(joined)  # every bounced client goes home again
+        for cid in bounced:
+            home = fleet.router.route(cid)
+            assert home.client_watermark(TENANT, cid) == (0, 0), cid
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+
+# ----------------------------------------------------------------------
+# Aggregator.drain (the satellite regression)
+# ----------------------------------------------------------------------
+
+
+class TestAggregatorDrain:
+    def _payloads(self, n: int):
+        out = []
+        for c in range(n):
+            coll = factory()
+            coll["seen"].update(jnp.asarray(float(c + 1)))
+            out.append(
+                encode_state(coll, tenant=TENANT, client_id=f"c{c:03d}", watermark=(0, 0))
+            )
+        return out
+
+    def test_queued_payloads_all_folded_manual_mode(self):
+        agg = Aggregator("d", max_queue=64)
+        agg.register_tenant(TENANT, factory)
+        for blob in self._payloads(10):
+            agg.ingest(blob)
+        assert agg._queue.qsize() == 10  # queued, nothing folded yet
+        drained = agg.drain()
+        assert drained == 10
+        assert agg._queue.qsize() == 0
+        assert agg._tenant(TENANT).folded_payloads == 10
+
+    def test_queued_payloads_all_folded_worker_mode(self):
+        agg = Aggregator("d", max_queue=64, flush_interval_s=30.0)
+        agg.register_tenant(TENANT, factory)
+        agg.start()
+        try:
+            for blob in self._payloads(10):
+                agg.ingest(blob)
+            drained = agg.drain()
+            assert agg._queue.qsize() == 0
+            assert agg._tenant(TENANT).folded_payloads == 10
+            assert drained == 10
+            assert agg.worker_alive() is None  # worker stopped by the drain
+        finally:
+            agg.stop()
+
+    def test_ingest_refused_while_draining(self):
+        agg = Aggregator("d")
+        agg.register_tenant(TENANT, factory)
+        blob = self._payloads(1)[0]
+        agg.ingest(blob)
+        agg.drain()
+        with pytest.raises(DrainingError, match="draining"):
+            agg.ingest(blob)
+        assert agg.draining is True
+
+    def test_drain_idempotent(self):
+        agg = Aggregator("d")
+        agg.register_tenant(TENANT, factory)
+        agg.ingest(self._payloads(1)[0])
+        assert agg.drain() == 1
+        assert agg.drain() == 0
+
+    def test_forward_survives_draining_parent(self):
+        """One draining hop must not abort the pump sweep: a child's ship
+        into a mid-drain parent is a transport failure like any other —
+        counted, survived, repaired by the post-reparent cumulative ship."""
+        import metrics_tpu.obs as obs
+
+        tree = AggregationTree(fan_out=(1, 2), tenants={TENANT: factory})
+        leaf = tree.leaves[0]
+        leaf.aggregator.ingest(self._payloads(1)[0])
+        tree.levels[1][0].aggregator.drain()  # the intermediate parent drains
+        was = obs.enable()
+        try:
+            with pytest.warns(UserWarning, match="could not ship upward"):
+                shipped = tree.pump()  # must complete the sweep, not raise
+            # the leaf's ship was refused (counted), but the sweep went on:
+            # the draining intermediate still forwarded ITS state to the
+            # root (drain closes admission, not the node's own uplink)
+            assert shipped == 1
+            assert obs.sum_counter("serve.forward_errors") >= 1
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_tombstone_table_bounded(self, monkeypatch):
+        from metrics_tpu.serve import aggregator as agg_mod
+
+        monkeypatch.setattr(agg_mod, "MAX_RETIRED_TOMBSTONES", 3)
+        agg = Aggregator("d")
+        agg.register_tenant(TENANT, factory)
+        for blob in self._payloads(5):
+            agg.ingest(blob)
+        agg.flush()
+        import metrics_tpu.obs as obs
+
+        was = obs.enable()
+        try:
+            for c in range(5):
+                agg.retire_client(f"c{c:03d}")
+            tenant = agg._tenant(TENANT)
+            assert len(tenant.retired) == 3
+            # least-recently-retired evicted first
+            assert sorted(tenant.retired) == ["c002", "c003", "c004"]
+            assert obs.get_counter("serve.tombstones_evicted", tenant=TENANT) == 2
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+
+# ----------------------------------------------------------------------
+# Autoscaler
+# ----------------------------------------------------------------------
+
+
+class TestAutoscaler:
+    def test_split_on_queue_depth(self):
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            hot = fleet.router.members()[0]
+            obs.set_gauge("serve.queue_depth", 500.0, node=hot)
+            scaler = Autoscaler(fleet, split_queue_depth=100.0)
+            decisions = scaler.evaluate()
+            assert decisions == [
+                {
+                    "action": "split",
+                    "node": hot,
+                    "reason": decisions[0]["reason"],
+                }
+            ]
+            assert "queue_depth=500" in decisions[0]["reason"]
+            executed = scaler.step()
+            assert executed[0]["joined"] in fleet.router
+            assert obs.get_counter("serve.autoscaler_decisions", action="split") == 1
+            assert obs.get_counter("serve.rebalances", kind="split") == 1
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_split_on_queue_wait_p99(self):
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            hot = fleet.router.members()[-1]
+            for _ in range(20):
+                obs.observe("serve.hop_queue_wait_ms", 900.0, node=hot)
+            scaler = Autoscaler(fleet, split_queue_wait_p99_ms=250.0)
+            decisions = scaler.evaluate()
+            assert len(decisions) == 1 and decisions[0]["action"] == "split"
+            assert decisions[0]["node"] == hot
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_wait_trigger_judges_its_own_worst_node(self):
+        """The deepest-queue leaf and the slowest-wait leaf differ: the
+        wait trigger must still fire, naming the slow one."""
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            deep, slow = fleet.router.members()[0], fleet.router.members()[1]
+            obs.set_gauge("serve.queue_depth", 50.0, node=deep)  # deepest, below threshold
+            for _ in range(20):
+                obs.observe("serve.hop_queue_wait_ms", 900.0, node=slow)
+            scaler = Autoscaler(
+                fleet, split_queue_depth=100.0, split_queue_wait_p99_ms=250.0
+            )
+            decisions = scaler.evaluate()
+            assert len(decisions) == 1 and decisions[0]["node"] == slow, decisions
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_merge_when_fleet_idle(self):
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            for m in fleet.router.members():
+                obs.set_gauge("serve.queue_depth", 0.0, node=m)
+            scaler = Autoscaler(fleet, merge_queue_depth=0.0, min_leaves=2)
+            decisions = scaler.step()
+            assert len(decisions) == 1 and decisions[0]["action"] == "merge"
+            assert len(fleet.router) == 3
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_merge_refused_on_missing_telemetry(self):
+        """A cold/disarmed obs registry must be INERT, not read as an idle
+        fleet: merging on absent depth series would drain a loaded fleet
+        down to min_leaves one cooldown window at a time."""
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            scaler = Autoscaler(fleet, merge_queue_depth=0.0, min_leaves=1)
+            assert scaler.evaluate() == []  # no gauges at all -> no merge
+            members = fleet.router.members()
+            for m in members[:-1]:  # one member still unreported -> no merge
+                obs.set_gauge("serve.queue_depth", 0.0, node=m)
+            assert scaler.evaluate() == []
+            obs.set_gauge("serve.queue_depth", 0.0, node=members[-1])
+            assert scaler.evaluate()  # full telemetry -> the merge may fire
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_min_leaves_and_cooldown_respected(self):
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet(fan_out=(1, 3))
+        was = obs.enable()
+        try:
+            for m in fleet.router.members():
+                obs.set_gauge("serve.queue_depth", 0.0, node=m)
+            ticks = iter([0.0, 0.0, 1.0, 100.0, 100.0])
+            scaler = Autoscaler(
+                fleet,
+                merge_queue_depth=0.0,
+                min_leaves=1,
+                cooldown_s=60.0,
+                clock=lambda: next(ticks),
+            )
+            assert scaler.step()  # first action executes
+            assert scaler.step() == []  # cooling down
+            assert scaler.step()  # cooldown elapsed, second merge
+            assert len(fleet.router) == 1
+            # at min_leaves nothing more merges
+            assert Autoscaler(fleet, merge_queue_depth=0.0, min_leaves=1).evaluate() == []
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_disarmed_is_inert(self):
+        fleet = build_fleet()
+        assert Autoscaler(fleet).evaluate() == []
+
+    def test_failed_action_arms_cooldown_and_is_reported(self, monkeypatch):
+        """A wedged merge must not be re-attempted with zero backoff on
+        the next tick, and the failure is reported, never raised out of
+        the policy loop."""
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            for m in fleet.router.members():
+                obs.set_gauge("serve.queue_depth", 0.0, node=m)
+            monkeypatch.setattr(
+                ElasticFleet,
+                "merge_node",
+                lambda self, node, **kw: (_ for _ in ()).throw(ServeError("wedged")),
+            )
+            ticks = iter([0.0, 10.0, 30.0])
+            scaler = Autoscaler(
+                fleet,
+                merge_queue_depth=0.0,
+                min_leaves=1,
+                cooldown_s=60.0,
+                clock=lambda: next(ticks),
+            )
+            executed = scaler.step()
+            assert executed and executed[0]["error"] == "wedged"
+            assert obs.get_counter("serve.autoscaler_errors", action="merge") == 1
+            assert scaler.step() == []  # the FAILED attempt armed the cooldown
+            assert len(fleet.router) == 4  # nothing actually merged
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+
+# ----------------------------------------------------------------------
+# telemetry + health
+# ----------------------------------------------------------------------
+
+
+class TestChurnTelemetry:
+    def test_rebalance_counters_and_histograms(self):
+        import metrics_tpu.obs as obs
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            clients = _Clients(20)
+            clients.ship_all(fleet)
+            fleet.pump()
+            fleet.join_node()
+            fleet.drain_node(fleet.router.members()[0])
+            fleet.split_node(fleet.router.members()[0])
+            fleet.merge_node(fleet.router.members()[-1])
+            for kind in ("join", "drain", "split", "merge"):
+                assert obs.get_counter("serve.rebalances", kind=kind) == 1, kind
+                hist = obs.get_histogram("serve.rebalance_ms", kind=kind)
+                assert hist is not None and hist.count == 1, kind
+            # the in-flight gauge is CLEARED after every rebalance, and its
+            # node= label named the rebalanced node (drains name the
+            # drained leaf; anonymous joins fall back to the coordinator)
+            assert obs.get_gauge("serve.rebalance_started_ts", node="root") == 0.0
+            drained_gauges = [
+                key
+                for key in obs.snapshot()["gauges"]
+                if key.startswith("serve.rebalance_started_ts{") and "root" not in key
+            ]
+            assert drained_gauges, "no per-node rebalance gauge was stamped"
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_heal_ms_recorded(self):
+        import metrics_tpu.obs as obs
+        from metrics_tpu.ft import faults
+        from metrics_tpu.serve import Supervisor
+
+        fleet = build_fleet()
+        was = obs.enable()
+        try:
+            faults.kill_node(fleet.tree.levels[1][0])
+            Supervisor(fleet.tree, warn=False).heal()
+            hist = obs.get_histogram("serve.heal_ms", kind="rebuild_node")
+            assert hist is not None and hist.count == 1
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+    def test_rebalance_stuck_condition(self):
+        import time
+
+        import metrics_tpu.obs as obs
+        from metrics_tpu.obs.health import HealthMonitor
+
+        was = obs.enable()
+        try:
+            monitor = HealthMonitor(
+                warn=False,
+                skew_threshold_ms=None,
+                clamp_risk=False,
+                degraded_syncs=False,
+                rebalance_stuck_s=60.0,
+            )
+            assert monitor.check()["healthy"] is True  # no gauge -> healthy
+            obs.set_gauge("serve.rebalance_started_ts", time.time() - 5.0, node="root")
+            assert monitor.check()["healthy"] is True  # in flight but young
+            obs.set_gauge("serve.rebalance_started_ts", time.time() - 3600.0, node="root")
+            report = monitor.check()
+            assert [w["kind"] for w in report["warnings"]] == ["rebalance_stuck"]
+            obs.set_gauge("serve.rebalance_started_ts", 0.0, node="root")
+            assert monitor.check()["healthy"] is True  # completion clears it
+        finally:
+            obs.enable(was)
+            obs.reset()
+
+
+# ----------------------------------------------------------------------
+# operator HTTP levers
+# ----------------------------------------------------------------------
+
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as err:
+        return err.code, json.load(err)
+
+
+class TestAdminEndpoints:
+    def test_unquarantine_lever(self):
+        agg = Aggregator("n", resilience=ResilienceConfig())
+        agg.register_tenant(TENANT, factory)
+        agg.firewall.record_poison(TENANT, "bad-client", "test poison")
+        assert agg.firewall.is_quarantined(TENANT, "bad-client")
+        server = MetricsServer(agg, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body = _post(
+                f"{base}/admin/unquarantine", {"tenant": TENANT, "client": "bad-client"}
+            )
+            assert (status, body["lifted"]) == (200, True)
+            assert not agg.firewall.is_quarantined(TENANT, "bad-client")
+            # second lift finds nothing
+            status, body = _post(
+                f"{base}/admin/unquarantine", {"tenant": TENANT, "client": "bad-client"}
+            )
+            assert (status, body["lifted"]) == (200, False)
+            # 400 on a malformed body, 404 on an unknown tenant — the
+            # /ingest-consistent error contract
+            status, _ = _post(f"{base}/admin/unquarantine", {"tenant": TENANT})
+            assert status == 400
+            status, _ = _post(
+                f"{base}/admin/unquarantine", {"tenant": "nope", "client": "x"}
+            )
+            assert status == 404
+        finally:
+            server.stop()
+
+    def test_unquarantine_without_firewall_is_400(self):
+        agg = Aggregator("n")
+        agg.register_tenant(TENANT, factory)
+        server = MetricsServer(agg, port=0).start()
+        try:
+            status, body = _post(
+                f"http://127.0.0.1:{server.port}/admin/unquarantine",
+                {"tenant": TENANT, "client": "c"},
+            )
+            assert status == 400 and "firewall" in body["error"]
+        finally:
+            server.stop()
+
+    def test_admin_drain_route(self):
+        agg = Aggregator("n")
+        agg.register_tenant(TENANT, factory)
+        coll = factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        blob = encode_state(coll, tenant=TENANT, client_id="c", watermark=(0, 0))
+        agg.ingest(blob)
+        server = MetricsServer(agg, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, body = _post(f"{base}/admin/drain", {})
+            assert status == 200 and body["drained"] == 1 and body["draining"] is True
+            # the node now answers ready=503 and refuses ingest with 503
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}/healthz/ready", timeout=10)
+            assert exc.value.code == 503
+            req = urllib.request.Request(f"{base}/ingest", data=blob)
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 503
+            # 400 on a malformed timeout
+            status, _ = _post(f"{base}/admin/drain", {"timeout_s": "nope"})
+            assert status == 400
+        finally:
+            server.stop()
+
+    def test_admin_drain_runs_fleet_protocol_when_wired(self):
+        """Draining a ring member over HTTP must run the FULL protocol —
+        admission-only closure would leave the router assigning ~1/n of
+        clients to a node refusing everything."""
+        fleet = build_fleet()
+        clients = _Clients(20)
+        clients.ship_all(fleet)
+        fleet.pump()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+        server = MetricsServer(victim.aggregator, port=0, fleet=fleet).start()
+        try:
+            status, body = _post(f"http://127.0.0.1:{server.port}/admin/drain", {})
+            assert status == 200 and body["protocol"] == "fleet", body
+            assert body["rehomed_clients"] > 0
+        finally:
+            server.stop()
+        assert victim_name not in fleet.router
+        fleet.pump(rounds=2)
+        assert_root_equals_oracle(fleet.tree, clients.final)
+
+    def test_admin_drain_resolves_member_by_name(self):
+        """A Supervisor heal swaps a fresh Aggregator into the node: the
+        fleet lookup must match by NAME, or the healed node would silently
+        get a local-only drain while its name stayed in the ring."""
+        fleet = build_fleet()
+        victim = fleet.tree.node_by_name(fleet.router.members()[0])
+        server = MetricsServer(victim.aggregator, port=0, fleet=fleet)
+        # the heal: a fresh aggregator object under the same node name
+        victim.revive(fleet.tree._build_aggregator(victim.name))
+        out = server.admin_drain()
+        assert out["protocol"] == "fleet"
+        assert victim.name not in fleet.router
+        server._httpd.server_close()
+
+    def test_admin_drain_precondition_failures_answer_409(self):
+        """Draining the root (or the last ring member) can never succeed —
+        automation keying on 5xx must not retry it forever."""
+        fleet = build_fleet()
+        server = MetricsServer(fleet.tree.root.aggregator, port=0, fleet=fleet).start()
+        try:
+            status, body = _post(f"http://127.0.0.1:{server.port}/admin/drain", {})
+            assert status == 409 and "root" in body["error"]
+        finally:
+            server.stop()
+
+    def test_admin_drain_refuses_non_member_when_fleet_wired(self):
+        fleet = build_fleet()
+        stray = Aggregator("not-in-this-fleet")
+        stray.register_tenant(TENANT, factory)
+        server = MetricsServer(stray, port=0, fleet=fleet).start()
+        try:
+            status, body = _post(f"http://127.0.0.1:{server.port}/admin/drain", {})
+            assert status == 400 and "not a member" in body["error"]
+            assert stray.draining is False  # no silent local fallback
+        finally:
+            server.stop()
+
+    def test_admin_drain_bad_timeout_mutates_nothing(self):
+        fleet = build_fleet()
+        victim_name = fleet.router.members()[0]
+        victim = fleet.tree.node_by_name(victim_name)
+        server = MetricsServer(victim.aggregator, port=0, fleet=fleet).start()
+        try:
+            status, _ = _post(
+                f"http://127.0.0.1:{server.port}/admin/drain", {"timeout_s": "nope"}
+            )
+            assert status == 400
+            assert victim_name in fleet.router  # validated BEFORE the ring exit
+            assert victim.aggregator.draining is False
+        finally:
+            server.stop()
+
+    def test_unknown_admin_route_404(self):
+        agg = Aggregator("n")
+        server = MetricsServer(agg, port=0).start()
+        try:
+            status, _ = _post(f"http://127.0.0.1:{server.port}/admin/nope", {})
+            assert status == 404
+        finally:
+            server.stop()
