@@ -658,6 +658,41 @@ def bench_serve_churn(n_clients: int = 1000) -> dict:
     return {"serve_churn_merges_per_s": out["serve_churn_merges_per_s"]}
 
 
+def bench_region(n_regions: int = 3, n_clients: int = 300) -> dict:
+    """Multi-region serving: cross-root replication throughput + the
+    freshness cost of global reads.
+
+    - ``serve_cross_region_merges_per_s`` — accepted ``region:<name>``
+      replica merges per second across every region's global view while
+      clients keep ingesting regionally (a RATE row, ``unit="/s"``, gate
+      inverted): a regression means the cross-region replication path —
+      encode + retry-policied ship + watermark-dedup'd accept + fold —
+      got more expensive.
+    - ``serve_global_query_staleness_ms`` — p99 of the worst-peer replica
+      age observed by :meth:`Region.query_global` (each round queries
+      every region): how stale the global answer runs at this replication
+      cadence. Lower is better, gated like any latency row. The
+      ``region_smoke`` CI step pins the same mesh's partition-heal and
+      kill+promote arms bitwise; these rows only time it.
+    """
+    from metrics_tpu.serve.loadgen import run_region_loadgen
+
+    out = run_region_loadgen(
+        n_regions=n_regions,
+        n_clients=n_clients,
+        fan_out=(2,),
+        payloads_per_client=2,
+        samples_per_payload=256,
+        num_bins=256,
+        verify=False,
+        seed=13,
+    )
+    return {
+        "serve_cross_region_merges_per_s": out["serve_cross_region_merges_per_s"],
+        "serve_global_query_staleness_ms": out["serve_global_query_staleness_ms"],
+    }
+
+
 def bench_aot() -> dict:
     """Cold-vs-warm first fold: the execution-engine acceptance rows.
 
@@ -1314,6 +1349,29 @@ def main(
             ),
             baseline="best_prior_self",
             unit="/s",
+        )
+        # multi-region rows (round 14): cross-root replication throughput
+        # (rate row, inverted gate) and the global-read freshness cost —
+        # the region_smoke CI step pins the same mesh bitwise
+        region_rows = section(bench_region)
+        emit(
+            "serve_cross_region_merges_per_s",
+            region_rows["serve_cross_region_merges_per_s"],
+            prior.get(
+                "serve_cross_region_merges_per_s",
+                region_rows["serve_cross_region_merges_per_s"],
+            ),
+            baseline="best_prior_self",
+            unit="/s",
+        )
+        emit(
+            "serve_global_query_staleness_ms",
+            region_rows["serve_global_query_staleness_ms"],
+            prior.get(
+                "serve_global_query_staleness_ms",
+                region_rows["serve_global_query_staleness_ms"],
+            ),
+            baseline="best_prior_self",
         )
     except Exception as err:  # noqa: BLE001 — serve rows must not kill the sweep
         print(f"SKIPPED serve rows: {err}", file=sys.stderr)

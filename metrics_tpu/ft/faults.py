@@ -57,9 +57,12 @@ __all__ = [
     "inject",
     "join_node",
     "kill_node",
+    "kill_region",
     "maybe_fail",
     "now",
     "partition",
+    "promote_region",
+    "region_partition",
     "split_node",
     "transient_gather_failures",
 ]
@@ -394,3 +397,76 @@ def split_node(fleet: Any, node: Any, name: Optional[str] = None) -> Any:
     node."""
     _chaos_inc("split")
     return fleet.split_node(node, name)
+
+
+# -- multi-region injectors (the region_smoke harness's levers) ------------
+#
+# Same philosophy as the churn injectors: thin seams over the PRODUCTION
+# mechanisms of :class:`~metrics_tpu.serve.region.RegionalMesh` — the
+# chaos harness drives the real replication links and the real promotion
+# protocol, and every injected event is auditable under the same
+# ``chaos.injected{kind=}`` family as the wire faults.
+
+
+@contextmanager
+def region_partition(mesh: Any, *names: str) -> Iterator[None]:
+    """Sever the DCN between the named region(s) and the rest of the mesh
+    for the ``with`` block — both directions, like a real partition.
+
+    Every cross-partition replication ship is silently dropped (counted
+    under ``chaos.injected{kind=region_partition}``); links WITHIN each
+    side stay up, so a two-sided partition is the named set vs everyone
+    else. During the partition each side keeps answering ``/query`` with
+    local-complete / global-stale values (the degraded-read contract);
+    the sender-side symptom is ``serve.replication_errors`` — none here,
+    the drop looks like success to the link, matching a black-holing
+    network — and the receiver-side symptom is a growing
+    ``serve.peer_staleness_ms{peer=}``, the ``peer_stale`` /
+    ``partition_detected`` conditions'
+    :class:`~metrics_tpu.obs.health.HealthMonitor` signal. On exit the
+    original links are restored and the next cumulative cross-ship repairs
+    every global view **bitwise** — no anti-entropy pass exists to need.
+    """
+    isolated = {str(n) for n in names}
+
+    def _drop(_payload: bytes) -> None:
+        _chaos_inc("region_partition")
+
+    with mesh._lock:
+        saved = {
+            key: link
+            for key, link in mesh._links.items()
+            if (key[0] in isolated) != (key[1] in isolated)
+        }
+        for key in saved:
+            mesh._links[key] = _drop
+    try:
+        yield
+    finally:
+        with mesh._lock:
+            for key, link in saved.items():
+                # restore only links the block did not rewire underneath us
+                # (a concurrent promote rebuilds its region's links)
+                if mesh._links.get(key) is _drop:
+                    mesh._links[key] = link
+
+
+def kill_region(mesh: Any, name: str) -> Any:
+    """Hard-kill a region's root (``Region.hard_kill``): its in-memory
+    regional state vanishes with no cleanup and every region surface
+    raises until :func:`promote_region` installs a warm standby. Counted
+    under ``chaos.injected{kind=region_kill}``. Returns the (now dead)
+    region — the harness's would-be zombie."""
+    _chaos_inc("region_kill")
+    region = mesh.region(name)
+    region.hard_kill()
+    return region
+
+
+def promote_region(mesh: Any, name: str) -> Any:
+    """Inject a generation-fenced failover (``chaos.injected{kind=promote}``):
+    the full production promotion — warm standby, checkpoint restore,
+    successor generation minted and fenced at every reachable peer — runs
+    under whatever wire faults are armed. Returns the promoted region."""
+    _chaos_inc("promote")
+    return mesh.promote(name)

@@ -92,6 +92,18 @@ class RetryPolicy:
         timeout_s: per-attempt wall-clock budget (None = no watchdog — a
             hard-hung collective is then NOT detected; set this on
             preemptible fleets).
+        deadline_s: TOTAL wall-clock budget for the whole retry cycle
+            (None = unbounded). The attempt cap bounds *how many* retries
+            run, but a full backoff schedule can still stack far past the
+            caller's own timeout — a cross-region replication tick with a
+            2 s cadence must not sleep 30 s into the next three ticks. The
+            deadline truncates the backoff schedule so cumulative sleep
+            never exceeds it (:func:`backoff_schedule` reflects the
+            truncation deterministically — pinnable in tests), and
+            ``call_with_retries`` additionally stops retrying the moment
+            the measured elapsed time (attempts included) reaches the
+            budget. Exhausting the deadline behaves exactly like
+            exhausting ``max_retries``: degraded fallback or raise.
         degraded_fallback: on exhaustion, return the caller's per-host
             partial result instead of raising.
         retry_on_timeout: retry after a timed-out attempt. Default False:
@@ -127,6 +139,7 @@ class RetryPolicy:
     backoff_factor: float = 2.0
     max_backoff_s: float = 30.0
     timeout_s: Optional[float] = None
+    deadline_s: Optional[float] = None
     degraded_fallback: bool = True
     retry_on_timeout: bool = False
     non_retryable: tuple = (TypeError, ValueError, AssertionError, NotImplementedError)
@@ -140,6 +153,8 @@ class RetryPolicy:
             raise ValueError(f"max_retries must be >= 0, got {self.max_retries}")
         if self.timeout_s is not None and self.timeout_s <= 0:
             raise ValueError(f"timeout_s must be positive (or None), got {self.timeout_s}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive (or None), got {self.deadline_s}")
         if self.jitter not in ("none", "decorrelated"):
             raise ValueError(
                 f"jitter must be 'none' or 'decorrelated', got {self.jitter!r}"
@@ -238,13 +253,36 @@ def backoff_schedule(policy: RetryPolicy, op: str = "") -> Iterator[float]:
     (same seed → same schedule; different seeds → decorrelated ones). With
     ``jitter_seed=None`` the stream seeds from OS entropy per call.
 
+    With :attr:`RetryPolicy.deadline_s` set, the schedule is additionally
+    truncated so the CUMULATIVE sleep never exceeds the deadline: the
+    first delay that would overrun yields only the remaining budget, and
+    the schedule then STOPS (``StopIteration``) — deterministic, so the
+    exact truncated production sleeps are pinnable too. (Attempt run time
+    also spends the budget; ``call_with_retries`` enforces that half
+    against the wall clock.)
+
     ``call_with_retries`` consumes exactly this generator, so a pinned
     schedule in a test is the schedule production sleeps.
     """
+    budget = policy.deadline_s
+
+    def _spend(delay: float) -> Iterator[float]:
+        # truncate against the remaining deadline budget; a zero-budget
+        # yield would be a pointless no-sleep retry, so the schedule ends
+        nonlocal budget
+        if budget is not None:
+            if budget <= 0.0:
+                return
+            delay = min(delay, budget)
+            budget -= delay
+        yield delay
+
     if policy.jitter == "none":
         delay = policy.backoff_s
         while True:
-            yield min(delay, policy.max_backoff_s)
+            yield from _spend(min(delay, policy.max_backoff_s))
+            if budget is not None and budget <= 0.0:
+                return
             delay *= policy.backoff_factor
     import hashlib
     import random
@@ -257,7 +295,9 @@ def backoff_schedule(policy: RetryPolicy, op: str = "") -> Iterator[float]:
     prev = policy.backoff_s
     while True:
         prev = min(rng.uniform(policy.backoff_s, 3.0 * prev), policy.max_backoff_s)
-        yield prev
+        yield from _spend(prev)
+        if budget is not None and budget <= 0.0:
+            return
 
 
 def _attempt(fn: Callable[[], Any], timeout_s: Optional[float], op: str) -> Any:
@@ -309,6 +349,7 @@ def call_with_retries(
     """
     p = policy if policy is not None else _policy
     delays = backoff_schedule(p, op)
+    start = time.monotonic()
     last_error: Optional[BaseException] = None
     attempts = 0
     for attempt in range(p.max_retries + 1):
@@ -324,9 +365,25 @@ def call_with_retries(
             if isinstance(err, AttemptTimeout) and not p.retry_on_timeout:
                 break  # the ghost attempt may still be in flight; don't race it
             if attempt < p.max_retries:
+                # the deadline covers attempts AND sleeps: the schedule
+                # already bounds cumulative sleep, but slow failing
+                # attempts spend the budget too — measure the wall clock
+                # and stop the cycle the moment it is gone (exhaustion,
+                # same as running out of attempts)
+                remaining = (
+                    None if p.deadline_s is None else p.deadline_s - (time.monotonic() - start)
+                )
+                if remaining is not None and remaining <= 0.0:
+                    break
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    break  # schedule's sleep budget exhausted
+                if remaining is not None:
+                    delay = min(delay, remaining)
                 if _obs_enabled():
                     _obs_inc("ft.retries", op=op)
-                time.sleep(next(delays))
+                time.sleep(delay)
     assert last_error is not None
     # report the attempts that actually ran — a no-retry timeout breaks out
     # after ONE, and claiming max_retries+1 would mislead incident triage

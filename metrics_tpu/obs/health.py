@@ -47,6 +47,22 @@ these are the registry-only verdicts):
   currently open: some client is being refused for repeated invalid
   payloads. Current state, not the cumulative open-transition counter: a
   circuit that probes back closed reads healthy again.
+* ``peer_stale`` — the worst ``serve.peer_staleness_ms`` gauge (one per
+  cross-region replication peer, exported by
+  :meth:`metrics_tpu.serve.region.Region.peer_staleness_s`) exceeds
+  ``peer_staleness_ms``: some peer region's replica is aging and global
+  ``/query`` answers are drifting toward local-only.
+* ``partition_detected`` — a ``serve.peers_unreachable`` gauge is
+  nonzero: a region's replication sweeps are actively FAILING against
+  one or more peers (connection refused / dead region), the sender-side
+  half of a DCN partition. The receiver-side half is ``peer_stale`` —
+  a black-holing partition drops ships without failing them, so arm
+  both.
+* ``fenced_zombie`` — the ``serve.fenced_ships`` counter fired: a
+  superseded pre-failover root is still shipping and being refused by
+  the generation fence. The data is safe (that is the fence's job);
+  the alert exists because a zombie burning its backoff schedule
+  against 4xx responses forever deserves decommissioning, not silence.
 * ``rebalance_stuck`` — a ``serve.rebalance_started_ts`` gauge (stamped
   by :class:`metrics_tpu.serve.elastic.ElasticFleet` for the duration of
   every join/drain/split/merge, cleared on completion; the ``node=``
@@ -101,6 +117,15 @@ class HealthMonitor:
             condition when an elastic rebalance has been in flight (its
             ``serve.rebalance_started_ts`` gauge nonzero) for more than
             this many seconds (``None`` disarms).
+        peer_staleness_ms: arm the multi-region ``peer_stale`` condition
+            when the worst ``serve.peer_staleness_ms`` gauge (a peer
+            region's replica age) exceeds this (``None`` disarms).
+        partition_detected: arm the multi-region ``partition_detected``
+            condition (a ``serve.peers_unreachable`` gauge reports a
+            region actively failing to reach peers).
+        fenced_zombie: arm the multi-region ``fenced_zombie`` condition
+            (the ``serve.fenced_ships`` counter fired: a superseded
+            pre-failover root is shipping into the generation fence).
         federated: read every condition off the federated fleet view
             (local registry merged with the piggybacked per-node
             snapshots) instead of local registry state — the root-of-tree
@@ -129,6 +154,9 @@ class HealthMonitor:
         quarantine: bool = False,
         circuit_open: bool = False,
         rebalance_stuck_s: Optional[float] = None,
+        peer_staleness_ms: Optional[float] = None,
+        partition_detected: bool = False,
+        fenced_zombie: bool = False,
         federated: bool = False,
         node_staleness_s: Optional[float] = None,
         name: str = "default",
@@ -143,6 +171,9 @@ class HealthMonitor:
         self.quarantine = bool(quarantine)
         self.circuit_open = bool(circuit_open)
         self.rebalance_stuck_s = rebalance_stuck_s
+        self.peer_staleness_ms = peer_staleness_ms
+        self.partition_detected = bool(partition_detected)
+        self.fenced_zombie = bool(fenced_zombie)
         self.federated = bool(federated)
         self.node_staleness_s = node_staleness_s
         self.name = str(name)
@@ -365,6 +396,55 @@ class HealthMonitor:
             )
         return None
 
+    def _check_peer_stale(self) -> Optional[str]:
+        if self.peer_staleness_ms is None:
+            return None
+        # one series per (region, peer) replication edge; the worst age is
+        # the verdict, and in federated mode the series span every region
+        stale = {
+            key: value
+            for key, value in self._gauges().items()
+            if (key == "serve.peer_staleness_ms" or key.startswith("serve.peer_staleness_ms{"))
+            and value > self.peer_staleness_ms
+        }
+        if stale:
+            worst = max(stale, key=stale.get)
+            return (
+                f"{len(stale)} cross-region replication peer(s) stale beyond"
+                f" {self.peer_staleness_ms:.0f} ms (worst: {worst},"
+                f" {stale[worst]:.0f} ms) — global /query answers are drifting"
+                " toward local-only for the affected regions (partition, dead"
+                " peer, or a wedged replication loop)"
+            )
+        return None
+
+    def _check_partition_detected(self) -> Optional[str]:
+        if not self.partition_detected:
+            return None
+        unreachable = sum(self._gauge_series("serve.peers_unreachable"))
+        if unreachable:
+            return (
+                f"{int(unreachable)} cross-region replication link(s) actively"
+                " failing (serve.peers_unreachable) — a DCN partition or dead"
+                " region; each side keeps serving local-complete / global-stale"
+                " answers, and the next successful cumulative cross-ship repairs"
+                " the global views bitwise on heal"
+            )
+        return None
+
+    def _check_fenced_zombie(self) -> Optional[str]:
+        if not self.fenced_zombie:
+            return None
+        fenced = self._counter_sum("serve.fenced_ships")
+        if fenced:
+            return (
+                f"{int(fenced)} generation-fenced ship(s) refused"
+                " (serve.fenced_ships): a superseded pre-failover root is still"
+                " shipping — the fence is holding (no state resurrected), but"
+                " the zombie should be decommissioned"
+            )
+        return None
+
     def _check_rebalance_stuck(self) -> Optional[str]:
         if self.rebalance_stuck_s is None:
             return None
@@ -415,6 +495,9 @@ class HealthMonitor:
             ("quarantine", self._check_quarantine),
             ("circuit_open", self._check_circuit_open),
             ("rebalance_stuck", self._check_rebalance_stuck),
+            ("peer_stale", self._check_peer_stale),
+            ("partition_detected", self._check_partition_detected),
+            ("fenced_zombie", self._check_fenced_zombie),
         )
         warnings: List[Dict[str, str]] = []
         with self._check_lock:
@@ -468,6 +551,9 @@ class HealthMonitor:
                 ("quarantine", self.quarantine or None),
                 ("circuit_open", self.circuit_open or None),
                 ("rebalance_stuck_s", self.rebalance_stuck_s),
+                ("peer_staleness_ms", self.peer_staleness_ms),
+                ("partition_detected", self.partition_detected or None),
+                ("fenced_zombie", self.fenced_zombie or None),
                 ("federated", self.federated or None),
                 ("node_staleness_s", self.node_staleness_s),
             )

@@ -38,6 +38,15 @@ primitives the library already proved:
   handoff + tombstone rebalance keeps the root bitwise-equal to the flat
   oracle through topology churn, and the queue-pressure
   :class:`Autoscaler` reading the federated fleet signals.
+* :mod:`~metrics_tpu.serve.region` — multi-region serving: a
+  :class:`RegionalMesh` of regional roots cross-merging their cumulative
+  aggregates as ordinary wire clients (``region:<name>`` identities,
+  exactly-once by watermark dedup), partition-tolerant degraded reads
+  (local-complete / global-stale with per-region freshness and an
+  optional ``max_staleness_s`` 503 policy), and generation-fenced
+  failover to warm standbys (:class:`FencedGenerationError` refuses
+  zombie pre-failover roots; promotion performs zero backend compiles
+  through the :mod:`metrics_tpu.engine` store).
 
 See ``docs/serving.md`` for the architecture, the exactly-once semantics
 and the self-healing guarantees.
@@ -46,6 +55,7 @@ from metrics_tpu.serve.aggregator import (
     Aggregator,
     BackpressureError,
     DrainingError,
+    FencedGenerationError,
     ServeError,
     UnknownTenantError,
 )
@@ -57,6 +67,12 @@ from metrics_tpu.serve.elastic import (
     Router,
 )
 from metrics_tpu.serve.endpoints import MetricsServer
+from metrics_tpu.serve.region import (
+    Region,
+    RegionDownError,
+    RegionalMesh,
+    StaleGlobalViewError,
+)
 from metrics_tpu.serve.resilience import (
     CircuitOpenError,
     ClientFirewall,
@@ -90,6 +106,7 @@ __all__ = [
     "ClientFirewall",
     "DrainingError",
     "ElasticFleet",
+    "FencedGenerationError",
     "HashRing",
     "MAX_WIRE_BYTES",
     "MetricPayload",
@@ -97,10 +114,14 @@ __all__ = [
     "NodeDownError",
     "QuarantinedClientError",
     "RebalancePreconditionError",
+    "Region",
+    "RegionDownError",
+    "RegionalMesh",
     "ResilienceConfig",
     "Router",
     "SchemaMismatchError",
     "ServeError",
+    "StaleGlobalViewError",
     "Supervisor",
     "UnknownTenantError",
     "WIRE_MAJOR",

@@ -70,6 +70,7 @@ __all__ = [
     "Aggregator",
     "BackpressureError",
     "DrainingError",
+    "FencedGenerationError",
     "ServeError",
     "UnknownTenantError",
 ]
@@ -114,7 +115,26 @@ class DrainingError(ServeError):
     payloads. Unlike backpressure this is not transient for THIS node — the
     client should re-resolve its route (the elastic
     :class:`~metrics_tpu.serve.elastic.Router` already points its next ship
-    at the new home)."""
+    at the new home). :attr:`retry_after_s` is derived from the drain
+    timeout: by then the drain has either completed (the ring points
+    elsewhere) or timed out and rolled back — either way the client's NEXT
+    resolve-and-ship is useful, where a hot retry against the draining
+    node is not (the ``Retry-After`` the HTTP surface answers with)."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class FencedGenerationError(ServeError):
+    """A payload carried ``meta["generation"]`` OLDER than the generation
+    fence recorded for its client identity: a zombie pre-failover root (or
+    a delayed replica of one) is trying to ship state a promotion already
+    superseded. Refused loudly and counted (``serve.fenced_ships``) —
+    merging it would resurrect pre-failover state next to the promoted
+    root's live stream, a divergence nothing downstream could detect. NOT
+    retryable: the zombie must be decommissioned (or re-promoted, which
+    mints a NEWER generation)."""
 
 
 @functools.partial(jax.jit, static_argnames=("reds",))
@@ -572,8 +592,21 @@ class Aggregator:
         self._worker: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._draining = False
+        self._drain_deadline: Optional[float] = None
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # generation fences: client identity -> minimum acceptable
+        # meta["generation"]. Advanced by accepted payloads carrying a
+        # NEWER generation and by an explicit fence_generation() (the
+        # multi-region promotion path); checked at ingest so a zombie
+        # pre-failover root's ship is refused loudly at the door. Rides
+        # the checkpoint manifest: a restored root must keep refusing the
+        # zombie its predecessor already fenced out.
+        self._generation_fences: Dict[str, int] = {}
+        # free-form JSON-safe metadata bundled into every checkpoint
+        # manifest (under extra.serve.node_meta) — the multi-region layer
+        # persists its own generation here so promotion survives restarts
+        self.manifest_extra: Dict[str, Any] = {}
         self._last_flush_s: Optional[float] = None
         self._firewall = None
         if resilience is not None and resilience is not False:
@@ -764,6 +797,62 @@ class Aggregator:
         return tenant
 
     # ------------------------------------------------------------------
+    # Generation fencing (the multi-region failover guard)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _payload_generation(payload: MetricPayload) -> Optional[int]:
+        """The payload's ``meta["generation"]`` when it is a plain int
+        (the wire-minor-3 contract); anything else — absent, or a foreign
+        producer's non-int — is simply unfenced traffic."""
+        gen = payload.meta.get("generation")
+        if isinstance(gen, bool) or not isinstance(gen, int):
+            return None
+        return gen
+
+    def fence_generation(self, client_id: str, generation: int) -> int:
+        """Raise the generation fence for ``client_id`` to at least
+        ``generation``; returns the resulting fence.
+
+        Once fenced, any payload for the identity whose
+        ``meta["generation"]`` is OLDER is refused at ingest with
+        :class:`FencedGenerationError` (and dropped at fold time if it
+        raced the fence into the queue), counted under
+        ``serve.fenced_ships`` — the mechanism that keeps a zombie
+        pre-failover regional root from resurrecting superseded state
+        (see :mod:`metrics_tpu.serve.region`). Monotonic: a value at or
+        below the current fence is a no-op. Fences also advance
+        automatically when a VALIDATED payload carries a newer
+        generation, and they ride the checkpoint manifest so a restored
+        node keeps refusing what its predecessor fenced out."""
+        client_id, generation = str(client_id), int(generation)
+        # under the registry lock: two concurrent learners (a promotion's
+        # proactive fence + a worker accepting the promoted root's first
+        # ship) must not interleave their read-modify-writes and leave the
+        # LOWER generation standing
+        with self._registry_lock:
+            fence = self._generation_fences.get(client_id)
+            if fence is None or generation > fence:
+                self._generation_fences[client_id] = generation
+                fence = generation
+        return fence
+
+    def generation_fence(self, client_id: str) -> Optional[int]:
+        """The current fence for an identity, or None when unfenced."""
+        return self._generation_fences.get(str(client_id))
+
+    def _fence_refuses(self, payload: MetricPayload) -> Optional[int]:
+        """The fence value refusing this payload, or None when admissible
+        (no generation meta, no fence, or generation >= fence)."""
+        gen = self._payload_generation(payload)
+        if gen is None:
+            return None
+        fence = self._generation_fences.get(payload.client_id)
+        if fence is not None and gen < fence:
+            return fence
+        return None
+
+    # ------------------------------------------------------------------
     # Ingestion
     # ------------------------------------------------------------------
 
@@ -808,7 +897,8 @@ class Aggregator:
                 # next ship at its new home
                 raise DrainingError(
                     f"aggregator {self.name!r} is draining and no longer admits"
-                    " payloads; re-resolve the route and ship to the new home"
+                    " payloads; re-resolve the route and ship to the new home",
+                    retry_after_s=self._drain_retry_after(),
                 )
             return self._ingest(payload, block=block, timeout=timeout)
         finally:
@@ -866,6 +956,24 @@ class Aggregator:
                     f"payload schema {payload.schema_hash} does not match tenant"
                     f" {payload.tenant!r} schema {tenant.schema_hash};"
                     f" differing: {'; '.join(diffs) or 'fingerprint only'}"
+                )
+            fence = self._fence_refuses(payload)
+            if fence is not None:
+                # a zombie pre-failover root (generation < fence) must be
+                # refused LOUDLY at the door — folding it would resurrect
+                # superseded state, and a silent drop would leave the
+                # zombie believing it is still the region's root
+                if _obs_enabled():
+                    _obs_inc(
+                        "serve.fenced_ships", tenant=payload.tenant, client=payload.client_id
+                    )
+                raise FencedGenerationError(
+                    f"aggregator {self.name!r} refuses payload from client"
+                    f" {payload.client_id!r}: meta generation"
+                    f" {self._payload_generation(payload)} is OLDER than the recorded"
+                    f" fence {fence} — a newer generation was promoted for this"
+                    " identity (failover); this sender is a superseded zombie and"
+                    " must stand down, not retry"
                 )
             if firewall is not None and self._shed_duplicate(tenant, payload):
                 # the payload validated — a shed duplicate is a HEALTHY
@@ -938,7 +1046,8 @@ class Aggregator:
                 # abort, not land a payload behind the drain's final flush
                 raise DrainingError(
                     f"aggregator {self.name!r} began draining while this ingest"
-                    " was waiting for queue space; re-resolve the route"
+                    " was waiting for queue space; re-resolve the route",
+                    retry_after_s=self._drain_retry_after(),
                 )
             worker = self._worker
             if worker is not None and not worker.is_alive() and not self._stop.is_set():
@@ -977,6 +1086,13 @@ class Aggregator:
                     if _federation.accept_snapshot(snap):
                         _obs_inc("obs.federation_accepts", node=self.name)
         tenant = self._tenant(payload.tenant)
+        if self._fence_refuses(payload) is not None:
+            # the fence advanced while this payload sat in the queue (a
+            # promotion raced the enqueue): same refusal as ingest, as a
+            # fold-side drop — the drop-not-crash family, still counted
+            if _obs_enabled():
+                _obs_inc("serve.fenced_ships", tenant=payload.tenant, client=payload.client_id)
+            return False
         epoch, step = int(payload.watermark[0]), int(payload.watermark[1])
         if epoch < 0 or step < 0:
             # decode_state refuses these on the wire; a directly-constructed
@@ -1091,6 +1207,12 @@ class Aggregator:
                     _obs_observe("serve.hop_queue_wait_ms", queue_wait_ms, node=self.name)
                     _obs_record_hop(slot.trace["id"], self.name, "queue_wait", queue_wait_ms)
             tenant.dirty = True
+        gen = self._payload_generation(payload)
+        if gen is not None:
+            # fence learning happens only AFTER the body validated and the
+            # snapshot was accepted: an unvalidated header must not be able
+            # to advance the fence (it could lock out the live root)
+            self.fence_generation(payload.client_id, gen)
         if _obs_enabled():
             _obs_observe("serve.ingest_ms", (time.perf_counter() - t0) * 1000.0, tenant=payload.tenant)
             _obs_gauge("serve.clients", float(len(tenant.clients)), tenant=payload.tenant)
@@ -1210,6 +1332,18 @@ class Aggregator:
         """True once :meth:`drain` has begun: ingest refuses new payloads."""
         return self._draining
 
+    def _drain_retry_after(self) -> float:
+        """The ``Retry-After`` a refused-while-draining client gets: time
+        to the drain's own deadline — by then the drain has completed (the
+        ring points elsewhere) or timed out and rolled back, so THAT is
+        when a re-resolve-and-retry becomes useful; hot-retrying sooner
+        can only collect more :class:`DrainingError`. Floored at 1s; falls
+        back to a couple of flush intervals if no deadline is stamped."""
+        deadline = self._drain_deadline
+        if deadline is None:
+            return max(1.0, self._flush_interval_s * 2.0)
+        return max(1.0, deadline - time.monotonic())
+
     def resume_admission(self) -> None:
         """Roll back a FAILED :meth:`drain`: re-open admission (and clear
         the ``/healthz/ready`` draining reason). The elastic drain protocol
@@ -1219,6 +1353,7 @@ class Aggregator:
         keyspace. Meaningless after a COMPLETED drain (state handed off,
         worker stopped); the elastic layer never calls it then."""
         self._draining = False
+        self._drain_deadline = None
 
     def drain(self, timeout_s: float = 30.0) -> int:
         """Graceful counterpart to :meth:`stop`: stop admitting, fold the
@@ -1235,8 +1370,11 @@ class Aggregator:
         stranding) if the queue cannot be emptied in time. Idempotent: a
         second call finds nothing to drain and returns 0. Returns the
         number of payloads drained."""
+        # stamp the deadline BEFORE the gate flips: every DrainingError
+        # raised from here on derives its Retry-After from it
+        self._drain_deadline = time.monotonic() + float(timeout_s)
         self._draining = True
-        deadline = time.monotonic() + float(timeout_s)
+        deadline = self._drain_deadline
         drained = self.flush()
         while True:
             with self._inflight_lock:
@@ -1431,6 +1569,10 @@ class Aggregator:
                     ]
                     tenant.clients[client_id] = slot
                 tenant.dirty = True
+        for client_id, gen in (serve_meta.get("fences") or {}).items():
+            # monotonic merge: a fence learned live since construction
+            # must not be LOWERED by an older checkpoint's record
+            self.fence_generation(client_id, int(gen))
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
         return manifest
@@ -1555,6 +1697,14 @@ class Aggregator:
         warmup = self._warmup_manifest()
         if warmup is not None:
             meta["warmup"] = warmup
+        if self._generation_fences:
+            # generation fences ride the manifest (tiny: identity -> int):
+            # a root healed from checkpoint must keep refusing the zombie
+            # its predecessor fenced out, or the failover guard dies with
+            # the process it protects against
+            meta["fences"] = {k: int(v) for k, v in sorted(self._generation_fences.items())}
+        if self.manifest_extra:
+            meta["node_meta"] = dict(self.manifest_extra)
         if not empty:
             for t_idx, tenant_id in enumerate(sorted(self._tenants)):
                 tenant = self._tenants[tenant_id]

@@ -14,11 +14,18 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   values ride ``/query``).
 * ``GET /query?tenant=ID`` — JSON merged values with the streaming
   metrics' rigorous ``error_bound`` / ``bounds`` envelopes, plus client
-  and watermark accounting (:meth:`Aggregator.query`).
+  and watermark accounting (:meth:`Aggregator.query`). With a
+  ``region=`` wired, ``&scope=global`` answers the region's GLOBAL view
+  instead — merged across every region's replica, carrying per-region
+  freshness and the ``degraded`` verdict; a ``stale_reads="reject"``
+  policy violation answers 503 naming the stale regions (the
+  multi-region degraded-read contract, ``docs/serving.md`` §9).
 * ``POST /ingest`` — the wire payload as the request body; 200 on accept,
   400 on malformed/schema-mismatched payloads, 404 for unknown tenants,
-  503 on queue backpressure. Tree nodes cross process boundaries by
-  pointing :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
+  503 on queue backpressure, 409 for a generation-fenced zombie ship
+  (a superseded pre-failover root: retrying can never succeed). Tree
+  nodes cross process boundaries by pointing
+  :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
   this route — the bytes are identical to the in-process path.
 * ``GET /trace`` — Chrome-trace JSON (:func:`metrics_tpu.obs.to_chrome_trace`):
   host spans plus per-hop payload lifecycles (queue-wait / fold / ship /
@@ -62,6 +69,7 @@ from metrics_tpu.serve.aggregator import (
     Aggregator,
     BackpressureError,
     DrainingError,
+    FencedGenerationError,
     ServeError,
     UnknownTenantError,
 )
@@ -95,6 +103,18 @@ class MetricsServer:
             handoff, tombstoned retirement) instead of only closing local
             admission — draining a ring member without re-homing its keys
             would blackhole ~1/n of the keyspace behind 503s.
+        region: the :class:`~metrics_tpu.serve.region.Region` this node
+            fronts, when multi-region serving is wired.
+            ``GET /query?tenant=ID&scope=global`` then answers the
+            region's GLOBAL view (:meth:`Region.query_global`): merged
+            values across every region's replica, plus per-region
+            freshness, the ``degraded`` verdict and ``stale_regions``
+            under the region's ``max_staleness_s`` policy. With
+            ``stale_reads="reject"`` a policy violation answers **503**
+            with the stale regions named in the body (and a
+            ``Retry-After`` hinting the staleness bound) — the
+            degraded-read contract, over HTTP. ``scope=local`` (the
+            default) keeps answering this aggregator's own view.
 
     Example::
 
@@ -114,9 +134,11 @@ class MetricsServer:
         ready_max_queue_frac: float = 0.9,
         ready_max_flush_age_s: Optional[float] = None,
         fleet: Optional[Any] = None,
+        region: Optional[Any] = None,
     ) -> None:
         self.aggregator = aggregator
         self.fleet = fleet
+        self.region = region
         self.ready_max_queue_frac = float(ready_max_queue_frac)
         self.ready_max_flush_age_s = ready_max_flush_age_s
         if arm_obs:
@@ -199,13 +221,24 @@ class MetricsServer:
             obs.observe("obs.scrape_ms", (_time.perf_counter() - t0) * 1000.0)
         return body
 
-    def render_query(self, tenant: str) -> Dict[str, Any]:
+    def render_query(self, tenant: str, scope: str = "local") -> Dict[str, Any]:
         import time as _time
 
         from metrics_tpu import obs
 
         t0 = _time.perf_counter()
-        out = self.aggregator.query(tenant)
+        if scope == "global":
+            if self.region is None:
+                raise ValueError(
+                    "scope=global requires a region-wired server"
+                    " (MetricsServer(..., region=...)); this node serves only its"
+                    " local view"
+                )
+            out = self.region.query_global(tenant)
+        elif scope == "local":
+            out = self.aggregator.query(tenant)
+        else:
+            raise ValueError(f"scope must be 'local' or 'global', got {scope!r}")
         if obs.enabled():
             obs.observe("serve.query_ms", (_time.perf_counter() - t0) * 1000.0, tenant=tenant)
         return out
@@ -383,6 +416,8 @@ def _make_handler(server: MetricsServer):
 
         def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
             parsed = urlparse(self.path)
+            from metrics_tpu.serve.region import StaleGlobalViewError
+
             try:
                 if parsed.path == "/metrics":
                     body = server.render_metrics().encode()
@@ -390,11 +425,36 @@ def _make_handler(server: MetricsServer):
                 elif parsed.path == "/trace":
                     self._reply(200, server.render_trace().encode(), "application/json")
                 elif parsed.path == "/query":
-                    tenant = (parse_qs(parsed.query).get("tenant") or [None])[0]
+                    params = parse_qs(parsed.query)
+                    tenant = (params.get("tenant") or [None])[0]
+                    scope = (params.get("scope") or ["local"])[0]
                     if tenant is None:
                         self._reply_json(400, {"error": "missing ?tenant= parameter"})
                         return
-                    self._reply_json(200, server.render_query(tenant))
+                    try:
+                        self._reply_json(200, server.render_query(tenant, scope))
+                    except StaleGlobalViewError as err:
+                        # the degraded-read contract's REJECT arm: peers
+                        # aged out past the region's max_staleness_s and
+                        # the policy forbids answering — 503 naming the
+                        # stale regions, so the caller can fail over to a
+                        # healthy region (or re-query scope=local)
+                        headers = None
+                        if err.retry_after_s is not None:
+                            headers = {
+                                "Retry-After": str(max(1, int(err.retry_after_s + 0.999)))
+                            }
+                        self._reply_json(
+                            503,
+                            {
+                                "error": str(err),
+                                "degraded": True,
+                                "stale_regions": err.stale_regions,
+                            },
+                            headers=headers,
+                        )
+                    except ValueError as err:
+                        self._reply_json(400, {"error": str(err)})
                 elif parsed.path == "/healthz/live":
                     self._reply_json(200, server.render_live())
                 elif parsed.path == "/healthz/ready":
@@ -504,9 +564,23 @@ def _make_handler(server: MetricsServer):
                 # 403, not 5xx: retrying cannot help a quarantined client
                 self._reply_json(403, {"error": str(err)})
             except DrainingError as err:
-                # 503 WITHOUT Retry-After: this node never comes back — the
-                # client's fix is to re-resolve its route, not to wait
-                self._reply_json(503, {"error": str(err)})
+                # 503 WITH a Retry-After derived from the drain timeout:
+                # by that point the drain has completed (the ring routes
+                # elsewhere) or rolled back — either way the client's next
+                # RE-RESOLVE-and-ship is useful, where a hot retry against
+                # the draining node only collects more 503s (the hint the
+                # backpressure and circuit-open paths already give)
+                retry_after = err.retry_after_s or 1.0
+                self._reply_json(
+                    503,
+                    {"error": str(err)},
+                    headers={"Retry-After": str(max(1, int(retry_after + 0.999)))},
+                )
+            except FencedGenerationError as err:
+                # 409, not 5xx and not Retry-After: a zombie pre-failover
+                # root's ship can NEVER succeed — a newer generation was
+                # promoted for its identity; retrying is the one wrong move
+                self._reply_json(409, {"error": str(err)})
             except (WireFormatError, SchemaMismatchError, ValueError) as err:
                 self._reply_json(400, {"error": str(err)})
             except CircuitOpenError as err:

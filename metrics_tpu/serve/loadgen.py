@@ -53,7 +53,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["run_loadgen"]
+__all__ = ["run_loadgen", "run_region_loadgen"]
 
 
 def _client_stream(rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
@@ -335,6 +335,133 @@ def run_loadgen(
                 raise AssertionError(
                     f"tree fold != flat fold at leaf {'/'.join(path)}"
                 )
+        out["verified_bitwise"] = True
+    return out
+
+
+def run_region_loadgen(
+    n_regions: int = 3,
+    n_clients: int = 300,
+    fan_out: Sequence[int] = (2,),
+    payloads_per_client: int = 2,
+    samples_per_payload: int = 256,
+    num_bins: int = 256,
+    seed: int = 0,
+    verify: bool = False,
+    tenant: str = "loadgen",
+) -> Dict[str, Any]:
+    """Drive a :class:`~metrics_tpu.serve.RegionalMesh` and return the
+    multi-region bench row values.
+
+    ``n_clients`` clients are split across ``n_regions`` regions (each an
+    in-region tree of shape ``fan_out``); every ship round folds a fresh
+    batch per client, delivers regionally, pumps each region's tree and
+    runs one full cross-region replication sweep — delivery + pump +
+    replicate are the timed segments (client fold/encode stays a client
+    budget, like :func:`run_loadgen`). Rows:
+
+    * ``serve_cross_region_merges_per_s`` — accepted ``region:*`` replica
+      merges per second summed over every region's global view (the
+      ``serve.cross_region_merges`` counter delta): the cross-root
+      replication throughput, an inverted-gate rate row.
+    * ``serve_global_query_staleness_ms`` — p99 of the worst-peer replica
+      age observed by global queries (``serve.global_query_staleness_ms``,
+      one sample per :meth:`Region.query_global` — each round queries
+      every region): the freshness cost of answering globally.
+
+    ``verify=True`` pins every region's global view bitwise against ONE
+    flat merge of every client's final snapshot — the multi-region
+    extension of the tree-equals-flat invariant.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import obs
+    from metrics_tpu.serve.aggregator import Aggregator
+    from metrics_tpu.serve.region import Region, RegionalMesh
+    from metrics_tpu.serve.wire import encode_state
+
+    if n_regions < 2:
+        raise ValueError(f"n_regions must be >= 2 (a mesh), got {n_regions}")
+
+    def factory():
+        from metrics_tpu.collections import MetricCollection
+        from metrics_tpu.streaming import StreamingAUROC
+
+        return MetricCollection({"auroc": StreamingAUROC(num_bins=num_bins)})
+
+    was_enabled = obs.enable()
+    try:
+        rng = np.random.default_rng(seed)
+        names = [f"r{i}" for i in range(n_regions)]
+        mesh = RegionalMesh(
+            [Region(name, {tenant: factory}, fan_out=fan_out) for name in names]
+        )
+        clients = [(f"client-{c:05d}", factory(), names[c % n_regions]) for c in range(n_clients)]
+        final_payloads: Dict[str, bytes] = {}
+
+        merges_before = obs.sum_counter("serve.cross_region_merges")
+        elapsed = 0.0
+        for r in range(payloads_per_client):
+            round_payloads = []
+            for client_id, client, region_name in clients:
+                batch = _client_stream(rng, samples_per_payload)
+                client.update(jnp.asarray(batch["preds"]), jnp.asarray(batch["target"]))
+                payload = encode_state(
+                    client, tenant=tenant, client_id=client_id, watermark=(0, r)
+                )
+                round_payloads.append((client_id, region_name, payload))
+                final_payloads[client_id] = payload
+            t0 = time.perf_counter()
+            for client_id, region_name, payload in round_payloads:
+                mesh.region(region_name).ingest(payload, client_id=client_id)
+            for name in names:
+                mesh.region(name).pump()
+            mesh.replicate()
+            elapsed += time.perf_counter() - t0
+            # every region answers globally each round — the staleness row
+            # is one worst-peer sample per (region, round) query, taken
+            # OUTSIDE the timed window: the rate row measures replication
+            # throughput, and folding query cost into it would let a read-
+            # path regression fire the replication gate
+            for name in names:
+                mesh.region(name).query_global(tenant)
+        merges = obs.sum_counter("serve.cross_region_merges") - merges_before
+        stale_p99 = 0.0
+        for name in names:
+            hist = obs.get_histogram("serve.global_query_staleness_ms", node=name)
+            if hist is not None and hist.count:
+                stale_p99 = max(stale_p99, float(hist.p99))
+    finally:
+        obs.enable(was_enabled)
+
+    out: Dict[str, Any] = {
+        "serve_cross_region_merges_per_s": merges / elapsed if elapsed > 0 else float("nan"),
+        "serve_global_query_staleness_ms": stale_p99,
+        "regions": int(n_regions),
+        "clients": int(n_clients),
+        "cross_region_merges": float(merges),
+        "elapsed_s": elapsed,
+    }
+    if verify:
+        flat = Aggregator("flat-reference")
+        flat.register_tenant(tenant, factory)
+        for client_id in sorted(final_payloads):
+            flat.ingest(final_payloads[client_id])
+        flat.flush()
+        flat_tenant = flat._tenant(tenant)
+        if flat_tenant.merged_leaves is None:
+            flat_tenant.fold()
+        for name in names:
+            gt = mesh.region(name).global_view._tenant(tenant)
+            if gt.merged_leaves is None:
+                gt.fold()
+            for (path, _), ours, oracle in zip(
+                gt.spec, gt.merged_leaves, flat_tenant.merged_leaves
+            ):
+                if not np.array_equal(np.asarray(ours), np.asarray(oracle)):
+                    raise AssertionError(
+                        f"region {name} global view != flat fold at leaf {'/'.join(path)}"
+                    )
         out["verified_bitwise"] = True
     return out
 

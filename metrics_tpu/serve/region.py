@@ -1,0 +1,922 @@
+"""Multi-region serving: cross-root replication, partition tolerance,
+generation-fenced failover.
+
+Every other layer of the serving tier terminates in ONE root — a single
+region outage takes down the global ``/query`` surface for every tenant.
+This module closes that gap with **zero new consistency machinery**:
+because every reduction the tier serves is an exact monoid
+(sketch / integer-sum / min / max — the same classes the tree invariant
+pins), the *global* answer is just the merge of the regions' *cumulative*
+snapshots, and replication, partition healing and failover all reduce to
+mechanisms the tier already proved:
+
+* **cross-root replication as ordinary wire traffic** — each
+  :class:`Region`'s root periodically ships its regional cumulative
+  aggregate to every peer as a wire client with identity
+  ``region:<name>`` (:mod:`metrics_tpu.serve.wire` minor 3 adds the
+  ``region`` / ``generation`` meta keys). The receiving side is a plain
+  :class:`~metrics_tpu.serve.Aggregator` (the region's **global view**),
+  so watermark keep-latest dedup makes the cross-merge **exactly-once and
+  order-free** — a duplicated, reordered or re-sent replica is absorbed
+  by the same journal comparison every client ship is.
+* **partition tolerance by construction** — during a DCN partition each
+  region keeps answering ``/query`` with **local-complete /
+  global-stale** values: its own clients' contributions are current, the
+  unreachable peers' replicas simply age. :meth:`Region.query_global`
+  reports per-region freshness, and an optional ``max_staleness_s``
+  policy either *marks* the answer degraded or *rejects* it
+  (:class:`StaleGlobalViewError` → HTTP 503). On heal, the next
+  cumulative cross-ship repairs the global view **bitwise** — cumulative
+  snapshots mean there is nothing to anti-entropy: the newest replica IS
+  the whole region.
+* **replication loop with bounded backoff** —
+  :meth:`RegionalMesh.replicate` (and the :meth:`RegionalMesh.start`
+  background loop) drives each ship under an
+  :class:`~metrics_tpu.ft.RetryPolicy` whose ``deadline_s`` caps the
+  whole retry cycle below the replication cadence (a cross-region call
+  must not stack a full backoff schedule past the caller's tick).
+  Failures are counted (``serve.replication_errors{peer=}``), surface as
+  the ``serve.peers_unreachable{node=}`` gauge, and per-peer staleness is
+  exported as ``serve.peer_staleness_ms{peer=}`` — the signals the
+  :class:`~metrics_tpu.obs.health.HealthMonitor` ``partition_detected`` /
+  ``peer_stale`` conditions watch.
+* **generation-fenced failover** — :meth:`RegionalMesh.promote` builds a
+  warm standby for a dead region: the global view restores from
+  :class:`~metrics_tpu.ft.CheckpointManager`, fold executables pre-warm
+  through the :mod:`metrics_tpu.engine` store (**zero backend compiles**
+  on promotion — the PR 11 contract), peers' next replicas repair the
+  rest, and a **monotonic generation number** — persisted in the
+  checkpoint manifest, stamped into wire meta on every ship — is bumped.
+  Peers fence the promoted generation
+  (:meth:`~metrics_tpu.serve.Aggregator.fence_generation`), so a zombie
+  old-generation root's ships are refused loudly
+  (``serve.fenced_ships``, :class:`~metrics_tpu.serve.FencedGenerationError`)
+  instead of resurrecting pre-failover state. The generation also rides
+  the replica **watermark epoch**, so the promoted root's ship sequence
+  restarts at ``(generation+1, 0) > (generation, anything)`` — resume
+  needs no watermark archaeology.
+
+The acceptance bar is the one PR 7/8/13 established:
+``tests/integrations/region_smoke.py`` pins every region's global
+``/query`` **bitwise-equal to the flat oracle merge of exactly the
+accepted snapshots** after partition + heal AND after kill +
+generation-fenced promotion, under 10% seeded wire chaos, with every
+injected fault visible in obs counters. See ``docs/serving.md`` §9.
+"""
+import itertools
+import threading
+import time
+import warnings
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.aggregator import Aggregator, ServeError
+from metrics_tpu.serve.wire import peek_header
+
+__all__ = [
+    "Region",
+    "RegionDownError",
+    "RegionalMesh",
+    "StaleGlobalViewError",
+]
+
+
+class RegionDownError(ServeError):
+    """The region's root is down (killed / partitioned away): a standby
+    must be promoted (:meth:`RegionalMesh.promote`) before it serves."""
+
+
+class StaleGlobalViewError(ServeError):
+    """The region's global view violates its ``max_staleness_s`` policy:
+    one or more peers' replicas have aged out (partition or dead peer).
+    Carries :attr:`stale_regions` and :attr:`retry_after_s` — the HTTP
+    surface answers 503, and the caller may instead query with the
+    ``degraded``-marking policy to read the local-complete values."""
+
+    def __init__(
+        self,
+        message: str,
+        stale_regions: Sequence[str] = (),
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        super().__init__(message)
+        self.stale_regions = list(stale_regions)
+        self.retry_after_s = retry_after_s
+
+
+class Region:
+    """One region of a :class:`RegionalMesh`: a regional aggregation tier
+    plus the region's **global view**.
+
+    Two aggregation surfaces, deliberately separate:
+
+    * the **regional root** folds only this region's own clients (either a
+      bare :class:`~metrics_tpu.serve.Aggregator`, or the root of an
+      :class:`~metrics_tpu.serve.AggregationTree` when ``fan_out`` is
+      given — optionally wrapped in an
+      :class:`~metrics_tpu.serve.ElasticFleet` with ``elastic=True``, so a
+      regional fleet keeps its live join/drain/split/merge). Its merged
+      state is what ships to peers — shipping the *global* view instead
+      would transitively double-count every peer's contribution.
+    * the **global view** (``<name>.global``) is an ordinary aggregator
+      whose clients are the regions themselves (``region:<name>``
+      identities, this region included). Its merged state answers global
+      ``/query``; keep-latest watermark dedup makes the cross-merge
+      exactly-once and order-free.
+
+    Args:
+        name: region identity — the ``region:<name>`` wire client id.
+        tenants: ``{tenant_id: collection factory}`` registered on every
+            aggregator of the region.
+        fan_out: build an in-region :class:`AggregationTree` with this
+            shape (``None`` = a single regional aggregator).
+        elastic: wrap the regional tree in an :class:`ElasticFleet`
+            (requires ``fan_out``); exposed as :attr:`fleet`.
+        checkpoint_dir: the GLOBAL VIEW's checkpoint directory — the
+            region's state of record, what a promoted standby restores.
+        engine: execution backend for every fold (see
+            :class:`~metrics_tpu.serve.Aggregator`); share one
+            :class:`~metrics_tpu.engine.AotEngine` store across the
+            original and its standby so promotion performs zero backend
+            compiles.
+        max_staleness_s: the degraded-read policy bound — a peer whose
+            replica is older than this is STALE (None = report freshness,
+            never judge).
+        stale_reads: ``"degraded"`` (default) marks the global answer
+            (``degraded: true`` + ``stale_regions``) when peers age out;
+            ``"reject"`` raises :class:`StaleGlobalViewError` instead
+            (the HTTP 503 contract).
+        resilience / max_queue / seed: forwarded to the regional tier.
+        generation: starting failover generation (normally 0; a promoted
+            standby is built by :meth:`standby` with the successor value).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        tenants: Dict[str, Callable[[], Any]],
+        *,
+        fan_out: Optional[Sequence[int]] = None,
+        elastic: bool = False,
+        checkpoint_dir: Optional[str] = None,
+        engine: Any = None,
+        max_staleness_s: Optional[float] = None,
+        stale_reads: str = "degraded",
+        resilience: Any = None,
+        max_queue: int = 4096,
+        seed: int = 0,
+        generation: int = 0,
+    ) -> None:
+        if stale_reads not in ("degraded", "reject"):
+            raise ValueError(f"stale_reads must be 'degraded' or 'reject', got {stale_reads!r}")
+        if elastic and fan_out is None:
+            raise ValueError("elastic=True requires a fan_out (an in-region tree to manage)")
+        self.name = str(name)
+        # retained so standby() can rebuild this region's exact recipe —
+        # the failover analogue of AggregationTree's retained factories
+        self._config = dict(
+            tenants=dict(tenants),
+            fan_out=None if fan_out is None else tuple(fan_out),
+            elastic=bool(elastic),
+            checkpoint_dir=checkpoint_dir,
+            engine=engine,
+            max_staleness_s=max_staleness_s,
+            stale_reads=stale_reads,
+            resilience=resilience,
+            max_queue=int(max_queue),
+            seed=int(seed),
+        )
+        self.max_staleness_s = None if max_staleness_s is None else float(max_staleness_s)
+        self.stale_reads = stale_reads
+        self.generation = int(generation)
+        self.down = False
+
+        # BOTH tiers checkpoint (when a dir is given): the global view is
+        # the region's replica table (peers + own), but the REGIONAL root's
+        # per-client slots are the only decomposable record of local
+        # traffic — a standby restored without them would ship an empty
+        # (generation+1) cumulative that SUPERSEDES the peers' last good
+        # replica of this region. With both restored, the promoted root's
+        # first ship carries the checkpointed regional state and the
+        # clients' own cumulative re-ships repair everything since (the
+        # at-least-once contract every restart in this tier leans on).
+        import os as _os
+
+        local_ckpt = None if checkpoint_dir is None else _os.path.join(checkpoint_dir, "local")
+        global_ckpt = None if checkpoint_dir is None else _os.path.join(checkpoint_dir, "global")
+        self.tree = None
+        self.fleet = None
+        if fan_out is not None:
+            from metrics_tpu.serve.tree import AggregationTree
+
+            self.tree = AggregationTree(
+                fan_out,
+                tenants,
+                checkpoint_root=local_ckpt,
+                max_queue=max_queue,
+                resilience=resilience,
+                engine=engine,
+            )
+            if elastic:
+                from metrics_tpu.serve.elastic import ElasticFleet
+
+                self.fleet = ElasticFleet(self.tree, seed=seed)
+            self.local_root = self.tree.root.aggregator
+        else:
+            self.local_root = Aggregator(
+                f"{self.name}.local",
+                max_queue=max_queue,
+                checkpoint_dir=local_ckpt,
+                resilience=resilience,
+                engine=engine,
+            )
+            for tenant_id, factory in tenants.items():
+                self.local_root.register_tenant(tenant_id, factory)
+
+        self.global_view = Aggregator(
+            f"{self.name}.global",
+            max_queue=max_queue,
+            checkpoint_dir=global_ckpt,
+            engine=engine,
+        )
+        for tenant_id, factory in tenants.items():
+            self.global_view.register_tenant(tenant_id, factory)
+        self._stamp_manifest_extra()
+
+        # replica ship sequence WITHIN the current generation: watermark =
+        # (generation, seq), so a promoted successor's (gen+1, 0) always
+        # supersedes every predecessor ship — resume without archaeology
+        self._ship_seq = itertools.count(0)
+        self._peers: List[str] = []  # mesh-wired peer names (freshness surface)
+        self._peer_last_accept: Dict[str, float] = {}  # peer -> monotonic stamp
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # regional (client-facing) tier
+    # ------------------------------------------------------------------
+
+    def route(self, client_id: str) -> Aggregator:
+        """The regional aggregator ``client_id`` ships to: the elastic
+        router's live assignment, a stable leaf of the regional tree, or
+        the single regional aggregator."""
+        self._require_up()
+        if self.fleet is not None:
+            return self.fleet.router.route(client_id)
+        if self.tree is not None:
+            leaves = self.tree.leaves
+            return leaves[zlib.crc32(str(client_id).encode()) % len(leaves)].aggregator
+        return self.local_root
+
+    def ingest(self, payload: Any, client_id: Optional[str] = None, **kwargs: Any) -> bool:
+        """Ingest one client payload into the regional tier (routing by
+        ``client_id`` when given — pass it to honor the elastic per-ship
+        Router contract; header-peeked otherwise for raw bytes)."""
+        if client_id is None and isinstance(payload, (bytes, bytearray, memoryview)):
+            try:
+                _, header = peek_header(bytes(payload))
+                client_id = str(header.get("client"))
+            except Exception:  # noqa: BLE001 — unframed garbage: any route refuses it
+                client_id = "?"
+        return self.route(client_id if client_id is not None else "?").ingest(payload, **kwargs)
+
+    def pump(self, rounds: int = 1) -> int:
+        """Propagate the regional tree bottom-up (no-op for a bare
+        regional aggregator beyond a flush)."""
+        self._require_up()
+        if self.tree is not None:
+            return self.tree.pump(rounds)
+        self.local_root.flush()
+        return 0
+
+    # ------------------------------------------------------------------
+    # replication surface (what the mesh drives)
+    # ------------------------------------------------------------------
+
+    def snapshot_payloads(self, tenants: Optional[Sequence[str]] = None) -> List[bytes]:
+        """Encode this region's cumulative aggregate — one wire payload
+        per tenant (all registered tenants, or just ``tenants``), identity
+        ``region:<name>``, watermark ``(generation, seq)``, meta carrying
+        ``region`` + ``generation`` (wire minor 3). All tenants share one
+        ship sequence (the :class:`~metrics_tpu.serve.tree.AggregatorNode`
+        convention); a single-tenant ship at seq N followed by a full
+        sweep at N+1 is safe by the cumulative contract."""
+        self._require_up()
+        self.local_root.flush()
+        with self._lock:
+            seq = next(self._ship_seq)
+            generation = self.generation
+        payloads: List[bytes] = []
+        from metrics_tpu.serve.wire import encode_state
+
+        for tenant_id in (
+            self.local_root.tenants() if tenants is None else [str(t) for t in tenants]
+        ):
+            view = self.local_root.collection(tenant_id, flush=False)
+            tenant = self.local_root._tenant(tenant_id)
+            with tenant.view_lock:
+                payloads.append(
+                    encode_state(
+                        view,
+                        tenant=tenant_id,
+                        client_id=f"region:{self.name}",
+                        watermark=(generation, seq),
+                        meta={"region": self.name, "generation": generation},
+                    )
+                )
+        return payloads
+
+    def accept_replica(self, data: bytes) -> bool:
+        """Receive one peer replica (or a self-ship) into the global view.
+
+        Plain :meth:`~metrics_tpu.serve.Aggregator.ingest` — watermark
+        dedup and the generation fence do all the correctness work; this
+        wrapper only adds the per-peer staleness bookkeeping and the
+        ``serve.cross_region_merges`` count. Raises exactly what ingest
+        raises (:class:`~metrics_tpu.serve.FencedGenerationError` for a
+        zombie, wire/schema errors for corrupt or incompatible replicas —
+        ``schema_diff`` names the exact differing path when regions
+        disagree on a tenant's schema)."""
+        self._require_up()
+        peer = header = None
+        try:
+            _, header = peek_header(bytes(data))
+            meta = header.get("meta") or {}
+            peer = str(meta.get("region")) if meta.get("region") is not None else None
+        except Exception:  # noqa: BLE001 — ingest below raises the loud version
+            header = None
+        before = None
+        if header is not None:
+            try:
+                before = self.global_view.client_watermark(
+                    str(header["tenant"]), str(header["client"])
+                )
+            except Exception:  # noqa: BLE001 — unknown tenant: ingest raises below
+                before = None
+        accepted = self.global_view.ingest(data)
+        if accepted:
+            # fold synchronously: replication runs at control-plane cadence,
+            # not the hot ingest path, and the caller needs the dedup
+            # verdict NOW — ingest only enqueues, so "did this replica
+            # advance its region's watermark" (and the fence learning that
+            # rides acceptance) materializes at this flush
+            self.global_view.flush()
+            if header is not None:
+                try:
+                    wm = (int(header["watermark"][0]), int(header["watermark"][1]))
+                    after = self.global_view.client_watermark(
+                        str(header["tenant"]), str(header["client"])
+                    )
+                    # accepted = this payload ADVANCED the watermark to its
+                    # own mark; a duplicate (before == wm) or a stale /
+                    # fence-dropped delivery (after unchanged) did not
+                    accepted = after == wm and before != wm
+                except Exception:  # noqa: BLE001 — accounting only; the fold stands
+                    accepted = False
+        if peer is not None and peer != self.name:
+            # even a dedup-shed duplicate proves the peer is alive and its
+            # link healthy — staleness measures REACHABILITY, not novelty
+            with self._lock:
+                self._peer_last_accept[peer] = time.monotonic()
+            if _obs_enabled() and accepted:
+                _obs_inc("serve.cross_region_merges", node=self.name, peer=peer)
+        return accepted
+
+    def peer_staleness_s(self) -> Dict[str, Optional[float]]:
+        """Per-peer replica age in seconds (None = never heard from).
+        Exports ``serve.peer_staleness_ms{node=,peer=}`` gauges as a side
+        effect — the surface :class:`~metrics_tpu.obs.health.HealthMonitor`'s
+        ``peer_stale`` condition reads."""
+        now = time.monotonic()
+        out: Dict[str, Optional[float]] = {}
+        with self._lock:
+            peers = list(self._peers)
+            stamps = dict(self._peer_last_accept)
+        armed = _obs_enabled()
+        for peer in peers:
+            last = stamps.get(peer)
+            age = None if last is None else max(0.0, now - last)
+            out[peer] = age
+            if armed and age is not None:
+                _obs_gauge("serve.peer_staleness_ms", age * 1000.0, node=self.name, peer=peer)
+        return out
+
+    # ------------------------------------------------------------------
+    # degraded-read contract
+    # ------------------------------------------------------------------
+
+    def query_global(self, tenant_id: str, *, refresh_local: bool = True) -> Dict[str, Any]:
+        """The region's GLOBAL answer with per-region freshness.
+
+        Extends :meth:`Aggregator.query` over the global view with a
+        ``regions`` freshness map (this region reads fresh by
+        construction — ``refresh_local`` self-ships the regional
+        cumulative first, so the answer is always **local-complete**),
+        the ``degraded`` verdict and ``stale_regions`` under the
+        ``max_staleness_s`` policy. With ``stale_reads="reject"`` a
+        policy violation raises :class:`StaleGlobalViewError` instead of
+        answering — the HTTP surface's 503. Observes the answer's
+        worst-peer staleness into ``serve.global_query_staleness_ms``."""
+        self._require_up()
+        if refresh_local:
+            # only the QUERIED tenant: a multi-tenant node must not pay
+            # T-1 irrelevant full-state encodes on every read
+            for blob in self.snapshot_payloads(tenants=[tenant_id]):
+                self.global_view.ingest(blob)
+        out = self.global_view.query(tenant_id)
+        staleness = self.peer_staleness_s()
+        regions: Dict[str, Any] = {
+            self.name: {"staleness_s": 0.0, "stale": False, "generation": self.generation}
+        }
+        stale_regions: List[str] = []
+        worst_ms = 0.0
+        for peer, age in sorted(staleness.items()):
+            stale = age is None or (
+                self.max_staleness_s is not None and age > self.max_staleness_s
+            )
+            regions[peer] = {
+                "staleness_s": age,
+                "stale": bool(stale),
+                "generation": self.global_view.generation_fence(f"region:{peer}"),
+            }
+            if stale:
+                stale_regions.append(peer)
+            if age is not None:
+                worst_ms = max(worst_ms, age * 1000.0)
+        out["region"] = self.name
+        out["generation"] = self.generation
+        out["regions"] = regions
+        out["local_complete"] = True
+        out["degraded"] = bool(stale_regions)
+        out["stale_regions"] = stale_regions
+        if _obs_enabled():
+            _obs_observe("serve.global_query_staleness_ms", worst_ms, node=self.name)
+        if stale_regions and self.stale_reads == "reject":
+            raise StaleGlobalViewError(
+                f"region {self.name!r} global view is STALE for"
+                f" {len(stale_regions)} peer region(s) ({', '.join(stale_regions)})"
+                + (
+                    f" beyond max_staleness_s={self.max_staleness_s}"
+                    if self.max_staleness_s is not None
+                    else " (never replicated)"
+                )
+                + " — answering would silently misrepresent the fleet; query this"
+                " region's local tier, a healthy region, or accept degraded reads"
+                " (stale_reads='degraded')",
+                stale_regions=stale_regions,
+                retry_after_s=self.max_staleness_s,
+            )
+        return out
+
+    # ------------------------------------------------------------------
+    # failure / failover surface
+    # ------------------------------------------------------------------
+
+    def _require_up(self) -> None:
+        if self.down:
+            raise RegionDownError(
+                f"region {self.name!r} is down (its root was killed); promote a"
+                " standby via RegionalMesh.promote() before using it"
+            )
+
+    def hard_kill(self) -> None:
+        """Simulate losing the region's root process: the regional tree's
+        root is hard-killed (state gone, no cleanup) and every region
+        surface raises :class:`RegionDownError` until a standby is
+        promoted. The global-view checkpoint on disk — and the peers'
+        copies of this region's replicas — are all that survive, which is
+        the whole failover design point."""
+        if self.tree is not None:
+            self.tree.root.hard_kill()
+        self.down = True
+
+    def _stamp_manifest_extra(self) -> None:
+        # the generation rides the checkpoint manifest so promotion
+        # survives restarts: a standby restored from this checkpoint minted
+        # its generation strictly above what is recorded here
+        self.global_view.manifest_extra = {
+            "region": self.name,
+            "generation": int(self.generation),
+        }
+
+    def set_generation(self, generation: int) -> None:
+        """Adopt a (promotion-minted) generation: stamped into every later
+        ship's watermark epoch + meta, persisted via the manifest; the
+        ship sequence restarts — ``(generation, 0)`` supersedes every
+        older-generation watermark by lexicographic comparison."""
+        with self._lock:
+            self.generation = int(generation)
+            self._ship_seq = itertools.count(0)
+        self._stamp_manifest_extra()
+        if _obs_enabled():
+            _obs_gauge("serve.region_generation", float(self.generation), region=self.name)
+
+    def save(self) -> str:
+        """Checkpoint the region's state of record: the regional root's
+        per-client slots AND the global view (replica slots + watermarks +
+        fences + generation manifest). Returns the global view's
+        checkpoint path."""
+        self._stamp_manifest_extra()
+        if self.local_root._manager is not None:
+            self.local_root.save()
+        return self.global_view.save()
+
+    def restore(self, path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Restore both tiers from their newest checkpoints (regional root
+        first — its per-client slots are what the first post-restore ship
+        carries); adopts the global manifest's recorded generation when it
+        is ahead of ours. Returns the global manifest (None on a fresh
+        start). No-op (None) for a region built without ``checkpoint_dir``
+        — a checkpointless region's failover relies wholly on the peers'
+        replicas and the clients' cumulative re-ships."""
+        if self.local_root._manager is not None:
+            self.local_root.restore()
+        if self.global_view._manager is None:
+            return None
+        manifest = self.global_view.restore(path)
+        if manifest is not None:
+            recorded = ((manifest.get("extra") or {}).get("serve") or {}).get("node_meta") or {}
+            gen = recorded.get("generation")
+            if gen is not None and int(gen) > self.generation:
+                self.set_generation(int(gen))
+        return manifest
+
+    def warmup(self) -> int:
+        """Pre-warm fold executables before traffic (global view + the
+        regional root): with a shared AOT program store this performs
+        zero backend compiles — the promotion path's cold-start
+        contract. Returns programs resolved."""
+        warmed = self.global_view.warmup()
+        warmed += self.local_root.warmup()
+        return warmed
+
+    def standby(self) -> "Region":
+        """Build this region's warm standby from the retained recipe: the
+        same name (the ``region:<name>`` identity IS the region — failover
+        replaces the root, not the region), tenants, topology, policy,
+        checkpoint dir and engine store. The mesh's
+        :meth:`~RegionalMesh.promote` restores + warms it and mints the
+        successor generation."""
+        return Region(self.name, self._config["tenants"], **{
+            k: v for k, v in self._config.items() if k != "tenants"
+        })
+
+
+class RegionalMesh:
+    """N regional roots cross-merging via the ordinary wire format.
+
+    Wires every region pair with a replication link (default: in-process
+    ``dst.accept_replica``; point :meth:`set_link` at an HTTP client to
+    cross real process boundaries — the payload bytes are identical), and
+    drives the replication loop: each :meth:`replicate` tick ships every
+    region's cumulative aggregate to itself and every peer under the
+    retry policy. Per-link failures never abort the sweep — they are
+    counted, surfaced as gauges, and repaired by the next tick's
+    cumulative ship (the same transient-by-contract stance
+    :meth:`~metrics_tpu.serve.tree.AggregatorNode.forward` takes).
+
+    Args:
+        regions: the mesh members (names must be unique).
+        retry_policy: per-ship :class:`~metrics_tpu.ft.RetryPolicy`; the
+            default caps the whole cycle with ``deadline_s`` well below
+            typical replication cadences and decorrelates the jitter per
+            (source, peer) link.
+        replicate_interval_s: the :meth:`start` background cadence.
+
+    Example::
+
+        mesh = RegionalMesh([
+            Region("us", tenants, checkpoint_dir=ckpt_us),
+            Region("eu", tenants, checkpoint_dir=ckpt_eu),
+            Region("ap", tenants, checkpoint_dir=ckpt_ap),
+        ])
+        mesh.region("us").ingest(payload)    # clients ship regionally
+        mesh.replicate()                     # or mesh.start()
+        mesh.region("eu").query_global("t")  # any region answers globally
+    """
+
+    def __init__(
+        self,
+        regions: Sequence[Region],
+        *,
+        retry_policy: Any = None,
+        replicate_interval_s: float = 1.0,
+    ) -> None:
+        from metrics_tpu.ft.retry import RetryPolicy
+
+        self._regions: Dict[str, Region] = {}
+        self._links: Dict[Tuple[str, str], Callable[[bytes], Any]] = {}
+        self._link_failures: Dict[Tuple[str, str], int] = {}
+        self._lock = threading.RLock()
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.replicate_interval_s = float(replicate_interval_s)
+        if retry_policy is None:
+            # deadline_s: each LINK's whole retry cycle (attempts + backoff)
+            # is a fraction of the cadence — links are retried sequentially
+            # within a sweep, so a budget equal to the full tick would let
+            # ONE dead peer push every source's sweep past the interval and
+            # age healthy peers' replicas too. A quarter-tick per link keeps
+            # even a several-dead-peer sweep inside a couple of intervals.
+            retry_policy = RetryPolicy(
+                max_retries=2,
+                backoff_s=0.05,
+                max_backoff_s=1.0,
+                deadline_s=max(0.1, self.replicate_interval_s / 4.0),
+                jitter="decorrelated",
+                jitter_seed=0,
+                degraded_fallback=True,
+            )
+        self.retry_policy = retry_policy
+        for region in regions:
+            self.add_region(region)
+
+    # ------------------------------------------------------------------
+    # membership / wiring
+    # ------------------------------------------------------------------
+
+    def add_region(self, region: Region) -> Region:
+        with self._lock:
+            if region.name in self._regions:
+                raise ServeError(f"region {region.name!r} is already in the mesh")
+            self._regions[region.name] = region
+            for peer_name, peer in self._regions.items():
+                if peer_name == region.name:
+                    continue
+                self._links[(region.name, peer_name)] = self._default_link(peer)
+                self._links[(peer_name, region.name)] = self._default_link(region)
+            self._rewire_peer_lists()
+        if _obs_enabled():
+            _obs_gauge("serve.mesh_regions", float(len(self._regions)))
+        return region
+
+    @staticmethod
+    def _default_link(dst: Region) -> Callable[[bytes], Any]:
+        return dst.accept_replica
+
+    def _rewire_peer_lists(self) -> None:
+        names = sorted(self._regions)
+        for name, region in self._regions.items():
+            with region._lock:
+                region._peers = [n for n in names if n != name]
+
+    def set_link(self, src: str, dst: str, send: Callable[[bytes], Any]) -> None:
+        """Override one directed replication link (e.g. an HTTP POST to
+        the peer's ``/ingest`` — the bytes are the same). The chaos
+        :func:`~metrics_tpu.ft.faults.region_partition` injector swaps
+        these too."""
+        key = (str(src), str(dst))
+        with self._lock:
+            if key not in self._links:
+                raise ServeError(f"no replication link {src!r} -> {dst!r} in this mesh")
+            self._links[key] = send
+
+    def region(self, name: str) -> Region:
+        with self._lock:
+            region = self._regions.get(str(name))
+        if region is None:
+            raise ServeError(
+                f"no region {name!r} in this mesh (regions: {sorted(self._regions)})"
+            )
+        return region
+
+    def regions(self) -> List[str]:
+        with self._lock:
+            return sorted(self._regions)
+
+    # ------------------------------------------------------------------
+    # the replication loop
+    # ------------------------------------------------------------------
+
+    def replicate(self, rounds: int = 1) -> int:
+        """One (or more) full replication sweep(s): every live region
+        ships its cumulative regional aggregate to itself and every peer.
+        Returns payloads delivered (self-ships included). Per-peer
+        failures are retried under the policy (bounded by its
+        ``deadline_s``), then counted under
+        ``serve.replication_errors{node=,peer=}`` and reflected in the
+        ``serve.peers_unreachable{node=}`` gauge — never raised: the next
+        sweep's cumulative ship repairs everything a missed one skipped."""
+        from dataclasses import replace
+
+        from metrics_tpu.ft.retry import call_with_retries
+
+        delivered = 0
+        for _ in range(int(rounds)):
+            with self._lock:
+                regions = dict(self._regions)
+                links = dict(self._links)
+            for src_name, src in sorted(regions.items()):
+                if src.down:
+                    continue
+                try:
+                    payloads = src.snapshot_payloads()
+                except Exception as err:  # noqa: BLE001 — a source that cannot
+                    # snapshot (marked down, or its tree root died without the
+                    # kill_region seam) must not abort the sweep for every
+                    # OTHER region; the (src, src) failure key reads as "the
+                    # source itself", counted and one-shot-warned like a link
+                    if not isinstance(err, RegionDownError):
+                        self._note_link_failure(src_name, src_name, err)
+                    self._export_unreachable(src_name)
+                    continue
+                with self._lock:
+                    # a healthy snapshot clears the source's own failure key
+                    # (nothing else ever would — the success pop below only
+                    # covers real (src, dst) links, and a permanently stale
+                    # entry would page partition_detected on a healed mesh)
+                    self._link_failures.pop((src_name, src_name), None)
+                # self-ship first: the region's own global view must be
+                # local-complete even when every peer is unreachable
+                for blob in payloads:
+                    src.global_view.ingest(blob)
+                    delivered += 1
+                for dst_name in sorted(regions):
+                    if dst_name == src_name:
+                        continue
+                    link = links[(src_name, dst_name)]
+                    # distinct (src, dst) jitter streams: two regions that
+                    # lose the same peer at the same instant must not
+                    # thunder back in lockstep
+                    policy = replace(
+                        self.retry_policy,
+                        jitter_seed=(
+                            None
+                            if self.retry_policy.jitter_seed is None
+                            else self.retry_policy.jitter_seed
+                            + (zlib.crc32(f"{src_name}->{dst_name}".encode()) & 0xFFFF)
+                        ),
+                    )
+
+                    def _ship(link=link, payloads=payloads):
+                        for blob in payloads:
+                            link(blob)
+                        return len(payloads)
+
+                    try:
+                        delivered += call_with_retries(
+                            _ship,
+                            op=f"region.replicate:{src_name}->{dst_name}",
+                            policy=policy,
+                            fallback=None,
+                        )
+                        with self._lock:
+                            self._link_failures.pop((src_name, dst_name), None)
+                    except Exception as err:  # noqa: BLE001 — one bad link must
+                        # not abort the sweep for every other peer. The family
+                        # is broad on purpose: retries exhausted
+                        # (DegradedSyncError), a dead/unpromoted peer
+                        # (RegionDownError), a fenced zombie identity, and a
+                        # cross-region SCHEMA disagreement (SchemaMismatchError
+                        # — whose message carries schema_diff's exact differing
+                        # path) all land in the same counted, one-shot-warned
+                        # bucket; the warning text names the real cause.
+                        self._note_link_failure(src_name, dst_name, err)
+                self._export_unreachable(src_name)
+            for region in regions.values():
+                # refresh the serve.peer_staleness_ms gauges every sweep:
+                # a BLACK-HOLING partition fails no link (the drop looks
+                # like success), so without this the peer_stale health
+                # condition would be blind until some global query happened
+                # to run — the background loop must keep the receiver-side
+                # signal live on its own
+                if not region.down:
+                    region.peer_staleness_s()
+        return delivered
+
+    def _note_link_failure(self, src: str, dst: str, err: BaseException) -> None:
+        with self._lock:
+            first = (src, dst) not in self._link_failures
+            self._link_failures[(src, dst)] = self._link_failures.get((src, dst), 0) + 1
+        if _obs_enabled():
+            _obs_inc("serve.replication_errors", node=src, peer=dst)
+        if first:
+            warnings.warn(
+                f"region {src!r} could not replicate to peer {dst!r} ({err});"
+                " the peer's global view serves LOCAL-COMPLETE / GLOBAL-STALE"
+                " answers until a sweep succeeds (cumulative ships repair on"
+                " heal; serve.replication_errors counts further failures).",
+                stacklevel=2,
+            )
+
+    def _export_unreachable(self, src: str) -> None:
+        if not _obs_enabled():
+            return
+        with self._lock:
+            unreachable = sum(1 for (s, _d) in self._link_failures if s == src)
+        _obs_gauge("serve.peers_unreachable", float(unreachable), node=src)
+
+    def start(self, interval_s: Optional[float] = None) -> "RegionalMesh":
+        """Run :meth:`replicate` on a daemon worker every
+        ``replicate_interval_s`` until :meth:`stop`. Idempotent."""
+        if interval_s is not None:
+            self.replicate_interval_s = float(interval_s)
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.replicate_interval_s):
+                try:
+                    self.replicate()
+                except Exception as err:  # noqa: BLE001 — a dying loop is a
+                    # silently-partitioned mesh; surface and keep sweeping
+                    if _obs_enabled():
+                        _obs_inc("serve.replication_loop_errors")
+                    warnings.warn(
+                        f"mesh replication sweep failed: {type(err).__name__}: {err}",
+                        stacklevel=2,
+                    )
+
+        self._worker = threading.Thread(target=loop, name="serve-mesh-replicate", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+
+    # ------------------------------------------------------------------
+    # failover
+    # ------------------------------------------------------------------
+
+    def promote(self, name: str) -> Region:
+        """Promote a warm standby for region ``name``'s (dead) root.
+
+        The standby is built from the region's retained recipe, **warmed
+        before traffic** (fold executables resolve through the shared
+        engine store — zero backend compiles when the store is warm),
+        restored from the global view's newest checkpoint (replica slots,
+        watermarks, fences, recorded generation), and minted the
+        **successor generation**: strictly above both the checkpoint's
+        record and the old in-memory root's. Every reachable peer fences
+        the promoted generation immediately (``fence_generation``), so a
+        zombie predecessor's ships are refused from this moment — even
+        before the standby's first replica teaches them. Peers' next
+        replicas repair anything the checkpoint missed (cumulative
+        snapshots; nothing to anti-entropy). The standby replaces the old
+        region in the mesh and is returned; the displaced object is left
+        untouched as the would-be zombie."""
+        t0 = time.perf_counter()
+        with self._lock:
+            old = self._regions.get(str(name))
+            if old is None:
+                raise ServeError(f"no region {name!r} in this mesh to promote")
+        standby = old.standby()
+        # warm FIRST: executables are ready the moment states land, and a
+        # corrupt cached program fails HERE, not under promoted traffic
+        standby.warmup()
+        standby.restore()
+        generation = max(standby.generation, old.generation) + 1
+        standby.set_generation(generation)
+        if standby.global_view._manager is not None:
+            # the minted generation must survive the next crash; a region
+            # built WITHOUT checkpoint_dir still promotes — its state
+            # repairs entirely from peers' replicas and client re-ships,
+            # and its generation floor is the displaced root's memory
+            standby.save()
+        with self._lock:
+            self._regions[str(name)] = standby
+            # rebuild every link touching the region: the old object's
+            # bound methods must not keep receiving (or sending) replicas
+            for peer_name, peer in self._regions.items():
+                if peer_name == str(name):
+                    continue
+                self._links[(str(name), peer_name)] = self._default_link(peer)
+                self._links[(peer_name, str(name))] = self._default_link(standby)
+                self._link_failures.pop((peer_name, str(name)), None)
+            self._rewire_peer_lists()
+            peers = [r for n, r in self._regions.items() if n != str(name)]
+        for peer in peers:
+            # proactive fence advance: the window between promotion and the
+            # standby's first replica must not admit a zombie ship
+            try:
+                peer.global_view.fence_generation(f"region:{name}", generation)
+            except Exception:  # noqa: BLE001 — an unreachable peer learns the
+                # fence from the standby's first accepted replica instead
+                continue
+        if _obs_enabled():
+            _obs_inc("serve.promotions", region=str(name))
+            _obs_gauge("serve.region_generation", float(generation), region=str(name))
+            _obs_observe("serve.promote_ms", (time.perf_counter() - t0) * 1000.0)
+        return standby
+
+    # ------------------------------------------------------------------
+    # read side
+    # ------------------------------------------------------------------
+
+    def query(self, tenant_id: str, region: Optional[str] = None) -> Dict[str, Any]:
+        """Global query at ``region`` (default: the first live region) —
+        the single-pane read over the whole mesh."""
+        if region is not None:
+            return self.region(region).query_global(tenant_id)
+        for name in self.regions():
+            candidate = self.region(name)
+            if not candidate.down:
+                return candidate.query_global(tenant_id)
+        raise RegionDownError("every region in the mesh is down")

@@ -76,7 +76,15 @@ WIRE_MAJOR = 1
 # an unarmed fleet ships byte-identical minor-2 payloads with empty meta.
 # Older decoders preserve the unknown meta keys untouched — additive, per
 # the minor contract.
-WIRE_MINOR = 2
+# minor 3: multi-region meta — ``meta["region"]`` (origin region name of a
+# cross-root replica, identity ``region:<name>``) and ``meta["generation"]``
+# (the monotonic failover generation stamped at standby promotion; an
+# aggregator holding a generation fence for the identity refuses OLDER
+# generations loudly instead of resurrecting pre-failover state). Plain
+# additive meta: a pre-upgrade aggregator decodes the payload, preserves
+# both keys untouched, and folds it like any other snapshot — the
+# rolling-regional-upgrade contract tests/serve/test_wire.py pins.
+WIRE_MINOR = 3
 # bounded-size payloads are the design contract (sketches are <=64KB by
 # construction); the default cap leaves headroom for multi-member
 # collections while still refusing an unbounded cat state that would turn

@@ -187,6 +187,124 @@ class TestCallWithRetries:
         with pytest.raises(ValueError, match="jitter"):
             RetryPolicy(jitter="full")
 
+
+class TestDeadline:
+    """deadline_s: the total wall-clock budget on top of the attempt cap,
+    so a cross-region call cannot stack a full backoff schedule past the
+    caller's own timeout."""
+
+    def test_schedule_truncated_exactly(self):
+        """backoff_schedule reflects the truncation: cumulative sleep
+        never exceeds the deadline, the overrunning delay is cut to the
+        remainder, and the schedule then STOPS."""
+        from metrics_tpu.ft import backoff_schedule
+
+        policy = RetryPolicy(
+            max_retries=9, backoff_s=1.0, backoff_factor=2.0, max_backoff_s=10.0,
+            deadline_s=4.0,
+        )
+        assert list(backoff_schedule(policy, "x")) == [1.0, 2.0, 1.0]
+
+    def test_schedule_exact_budget_boundary(self):
+        from metrics_tpu.ft import backoff_schedule
+
+        policy = RetryPolicy(
+            backoff_s=1.0, backoff_factor=2.0, max_backoff_s=10.0, deadline_s=3.0
+        )
+        # 1 + 2 consumes the budget exactly: no zero-length fourth sleep
+        assert list(backoff_schedule(policy, "x")) == [1.0, 2.0]
+
+    def test_decorrelated_schedule_truncates_too(self):
+        from metrics_tpu.ft import backoff_schedule
+
+        base = RetryPolicy(backoff_s=0.1, max_backoff_s=30.0, jitter="decorrelated", jitter_seed=5)
+        unbounded = [d for d, _ in zip(backoff_schedule(base, "op"), range(10))]
+        capped = RetryPolicy(
+            backoff_s=0.1, max_backoff_s=30.0, jitter="decorrelated", jitter_seed=5,
+            deadline_s=sum(unbounded[:3]) + unbounded[3] / 2,
+        )
+        got = list(backoff_schedule(capped, "op"))
+        assert got[:3] == unbounded[:3]  # same seeded stream, untruncated prefix
+        assert got[3] == pytest.approx(unbounded[3] / 2)  # cut to the remainder
+        assert len(got) == 4  # then stops
+        assert sum(got) == pytest.approx(capped.deadline_s)
+
+    def test_call_with_retries_sleeps_the_truncated_schedule(self, monkeypatch):
+        sleeps = []
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.sleep", sleeps.append)
+        policy = RetryPolicy(
+            max_retries=9, backoff_s=1.0, backoff_factor=2.0, max_backoff_s=10.0,
+            deadline_s=4.0,
+        )
+        with faults.inject("op_dl", count=99) as spec:
+            out = call_with_retries(
+                lambda: None, op="op_dl", policy=policy, fallback=lambda e: "degraded"
+            )
+        assert out == "degraded"
+        assert sleeps == [1.0, 2.0, 1.0]
+        # schedule exhausted -> exactly len(sleeps)+1 attempts, not max_retries+1
+        assert spec["raised"] == 4
+
+    def test_slow_attempts_spend_the_budget(self, monkeypatch):
+        """Attempt run time counts against the deadline too: a failing
+        call that takes longer than the whole budget must not retry at
+        all, even though the sleep schedule alone would allow it."""
+        fake_now = [0.0]
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.monotonic", lambda: fake_now[0])
+        sleeps = []
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.sleep", sleeps.append)
+        policy = RetryPolicy(max_retries=5, backoff_s=0.1, deadline_s=1.0)
+        calls = []
+
+        def slow_fail():
+            calls.append(1)
+            fake_now[0] += 2.0  # each attempt alone overruns the deadline
+            raise RuntimeError("transport")
+
+        out = call_with_retries(slow_fail, op="op_dl2", policy=policy, fallback=lambda e: "degraded")
+        assert out == "degraded"
+        assert calls == [1]  # exhausted by the wall clock, no retry
+        assert sleeps == []
+
+    def test_remaining_wall_budget_caps_the_sleep(self, monkeypatch):
+        """A sleep is cut to the REMAINING measured budget when attempts
+        already spent part of it."""
+        fake_now = [0.0]
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.monotonic", lambda: fake_now[0])
+        sleeps = []
+
+        def fake_sleep(d):
+            sleeps.append(d)
+            fake_now[0] += d
+
+        monkeypatch.setattr("metrics_tpu.ft.retry.time.sleep", fake_sleep)
+        policy = RetryPolicy(max_retries=5, backoff_s=4.0, deadline_s=5.0)
+        calls = []
+
+        def fail():
+            calls.append(1)
+            fake_now[0] += 0.25
+            raise RuntimeError("transport")
+
+        call_with_retries(fail, op="op_dl3", policy=policy, fallback=lambda e: None)
+        # the schedule yields 4.0 then (budget-truncated) 1.0, but attempts
+        # spent 2 x 0.25s of measured time, so the second sleep is trimmed
+        # to the real wall remainder 0.5 and the third attempt exhausts
+        assert sleeps == [4.0, pytest.approx(0.5)]
+        assert len(calls) == 3
+
+    def test_no_deadline_is_unchanged(self):
+        from metrics_tpu.ft import backoff_schedule
+
+        policy = RetryPolicy(max_retries=3, backoff_s=1.0, backoff_factor=2.0, max_backoff_s=3.0)
+        assert [d for d, _ in zip(backoff_schedule(policy, "x"), range(4))] == [1.0, 2.0, 3.0, 3.0]
+
+    def test_deadline_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=0.0)
+        with pytest.raises(ValueError, match="deadline_s"):
+            RetryPolicy(deadline_s=-1.0)
+
     def test_non_retryable_errors_fail_fast(self):
         """Deterministic programming errors (bad dtype, shape bug) must
         raise immediately — retrying fails identically, and degrading would

@@ -1,0 +1,303 @@
+"""CI smoke: multi-region serving survives partition and failover, bitwise.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.region_smoke``
+(the CI step does, mirroring ``elastic_smoke``). One 3-region
+:class:`~metrics_tpu.serve.RegionalMesh` (each region an in-region
+aggregation tree), clients delivering under a seeded 10%
+:class:`~metrics_tpu.ft.faults.WireChaos` schedule, driven through the
+two failure arcs the multi-region tier exists for:
+
+* **partition + heal** — one region is DCN-partitioned from the mesh
+  (:func:`~metrics_tpu.ft.faults.region_partition`) while every region
+  keeps ingesting its own clients; during the partition each side answers
+  ``/query`` with local-complete / global-stale values (per-region
+  freshness + ``degraded`` verdict; the ``stale_reads="reject"`` policy
+  answers 503 over HTTP), and on heal the next cumulative cross-ship
+  repairs every region's global view **bitwise** — no anti-entropy pass.
+* **kill + generation-fenced promotion** — a region's root is hard-killed
+  (:func:`~metrics_tpu.ft.faults.kill_region`; peers' replication sweeps
+  fail → ``partition_detected``), then a warm standby is promoted
+  (:func:`~metrics_tpu.ft.faults.promote_region`): checkpoint restore +
+  engine-store warmup with **zero backend compiles** asserted under the
+  jax.monitoring compile listener, the successor generation minted and
+  fenced at every peer — a captured pre-kill ZOMBIE ship is refused
+  loudly (``serve.fenced_ships``, HTTP 409 family) and never merged.
+
+Acceptance: after BOTH arcs, every region's global ``/query`` is
+bitwise-equal to the flat oracle merge of exactly the accepted snapshots,
+every injected fault is visible in obs counters, and the armed
+:class:`~metrics_tpu.obs.health.HealthMonitor` conditions
+(``peer_stale`` / ``partition_detected`` / ``fenced_zombie``) all fired.
+"""
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260805
+N_CLIENTS = 30
+N_INTERVALS = 3
+SAMPLES = 64
+TENANT = "region"
+REGIONS = ("r0", "r1", "r2")
+
+
+def _factory():
+    from metrics_tpu import MaxMetric, SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=128), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def _client_snapshots():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for c in range(N_CLIENTS):
+        cid = f"client-{c:03d}"
+        rng = np.random.default_rng(9000 + c)
+        coll = _factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            target = jnp.asarray(
+                (rng.uniform(0, 1, SAMPLES) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32)
+            )
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+            coll["peak"].update(preds)
+            blobs.append(encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval)))
+        out[cid] = blobs
+    return out
+
+
+def main() -> None:
+    import tempfile
+
+    import numpy as np
+
+    from metrics_tpu import engine as eng
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.ft.retry import RetryPolicy
+    from metrics_tpu.obs.health import HealthMonitor
+    from metrics_tpu.obs.registry import get_counter
+    from metrics_tpu.serve import (
+        Aggregator,
+        FencedGenerationError,
+        MetricsServer,
+        Region,
+        RegionalMesh,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, peek_header
+
+    obs.reset()
+    obs.enable()
+    assert obs.install_compile_listener(), "compile listener unavailable — cannot assert"
+    root = tempfile.mkdtemp(prefix="region_smoke_")
+    store = eng.ProgramStore(os.path.join(root, "store"))
+    tenants = {TENANT: _factory}
+    mesh = RegionalMesh(
+        [
+            Region(
+                name,
+                tenants,
+                fan_out=(2,),
+                checkpoint_dir=os.path.join(root, name),
+                engine=eng.AotEngine(store),
+            )
+            for name in REGIONS
+        ],
+        retry_policy=RetryPolicy(
+            max_retries=1, backoff_s=0.01, max_backoff_s=0.05, deadline_s=0.25,
+            jitter="decorrelated", jitter_seed=SEED,
+        ),
+    )
+    snapshots = _client_snapshots()
+    home = {cid: REGIONS[i % len(REGIONS)] for i, cid in enumerate(sorted(snapshots))}
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.025, p_duplicate=0.025, p_reorder=0.025, p_corrupt=0.025, p_delay=0.0
+    )
+    delivered = set()  # (client_id, interval) delivered uncorrupted + admitted
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                continue  # framing mangled: nothing to route, refused anywhere
+            cid = str(header["client"])
+            try:
+                mesh.region(home[cid]).ingest(blob, client_id=cid)
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+            else:
+                delivered.add((cid, int(header["watermark"][1])))
+
+    def deliver_interval(interval: int, chaotic: bool = True) -> None:
+        for cid in sorted(snapshots):
+            if chaotic:
+                _, now_blobs = chaos.plan(snapshots[cid][interval])
+                deliver(now_blobs)
+            else:
+                deliver([snapshots[cid][interval]])
+        if chaotic:
+            deliver(chaos.end_round())
+        for name in REGIONS:
+            mesh.region(name).pump()
+
+    monitor = HealthMonitor(
+        warn=False,
+        name="region",
+        peer_staleness_ms=50.0,
+        partition_detected=True,
+        fenced_zombie=True,
+    )
+
+    # ---- arc 1: partition r2, keep ingesting everywhere, heal -----------
+    with faults.region_partition(mesh, "r2"):
+        deliver_interval(0)
+        mesh.replicate()
+        time.sleep(0.08)  # let the partitioned peer's replica age past 50ms
+        q_healthy = mesh.region("r0").query_global(TENANT)
+        assert q_healthy["local_complete"] is True
+        assert "r2" in q_healthy["stale_regions"], q_healthy["regions"]
+        assert q_healthy["degraded"] is True
+        q_isolated = mesh.region("r2").query_global(TENANT)
+        assert set(q_isolated["stale_regions"]) == {"r0", "r1"}, q_isolated["regions"]
+        report = monitor.check()
+        fired = {w["kind"] for w in report["warnings"]}
+        assert "peer_stale" in fired, report
+        # the degraded-read REJECT policy over HTTP: 503 naming the region
+        r0 = mesh.region("r0")
+        r0.stale_reads, r0.max_staleness_s = "reject", 0.01
+        server = MetricsServer(r0.global_view, region=r0, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            try:
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}&scope=global", timeout=10)
+                raise AssertionError("stale global query must answer 503 under reject policy")
+            except urllib.error.HTTPError as err:
+                assert err.code == 503, err.code
+                body = json.loads(err.read().decode())
+                assert "r2" in body["stale_regions"], body
+            r0.stale_reads, r0.max_staleness_s = "degraded", None
+            q_http = json.load(
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}&scope=global", timeout=10)
+            )
+            assert q_http["degraded"] is True and "r2" in q_http["stale_regions"]
+        finally:
+            server.stop()
+    assert obs.get_counter("chaos.injected", kind="region_partition") >= 1
+
+    # ---- heal: the next cumulative cross-ship repairs bitwise -----------
+    deliver_interval(1)
+    mesh.replicate()
+    q_healed = mesh.region("r0").query_global(TENANT)
+    assert q_healed["degraded"] is False, q_healed["regions"]
+
+    # ---- arc 2: kill r1's root, promote under fencing -------------------
+    for name in REGIONS:
+        mesh.region(name).save()
+    zombie_blobs = mesh.region("r1").snapshot_payloads()  # the would-be zombie's ships
+    faults.kill_region(mesh, "r1")
+    mesh.replicate()  # sweeps to the dead region fail -> partition_detected
+    report = monitor.check()
+    fired = {w["kind"] for w in report["warnings"]}
+    assert "partition_detected" in fired, report
+    assert obs.sum_counter("serve.replication_errors") >= 1
+
+    # warm standby promotion: checkpoint restore + engine-store warmup, and
+    # the promoted tier's ENTIRE first round (replicate + folds + queries)
+    # must perform ZERO backend compiles — the PR 11 cold-start contract
+    eng.reset_memory_cache()
+    compiles_before = get_counter("jax.compiles")
+    promoted = faults.promote_region(mesh, "r1")
+    assert promoted.generation >= 1
+    deliver_interval(2, chaotic=False)  # clients keep shipping; cumulative repairs
+    mesh.replicate()
+    for name in REGIONS:
+        mesh.region(name).query_global(TENANT)
+    compiled = get_counter("jax.compiles") - compiles_before
+    assert compiled == 0, (
+        f"promotion + first post-failover round performed {compiled} backend"
+        " compile(s) — warm standby promotion must be compile-free"
+    )
+
+    # the zombie pre-failover root's ships are refused loudly, never merged
+    fenced = 0
+    for blob in zombie_blobs:
+        try:
+            mesh.region("r0").accept_replica(blob)
+        except FencedGenerationError:
+            fenced += 1
+    assert fenced == len(zombie_blobs), "every zombie ship must be fence-refused"
+    assert obs.sum_counter("serve.fenced_ships") >= fenced
+    report = monitor.check()
+    assert "fenced_zombie" in {w["kind"] for w in report["warnings"]}, report
+    mesh.replicate()
+
+    # ---- oracle: flat merge of exactly the accepted snapshots -----------
+    # interval 2 was delivered clean everywhere, so per client the highest
+    # accepted watermark is 2; earlier chaos fates are superseded by the
+    # cumulative contract (and nothing pre-checkpoint was lost: the
+    # promoted standby restored its regional slots and the clients'
+    # interval-2 re-ships repaired the tail)
+    accepted = {}
+    for cid, interval in delivered:
+        if cid not in accepted or interval > accepted[cid]:
+            accepted[cid] = interval
+    assert all(i == N_INTERVALS - 1 for i in accepted.values())
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(TENANT, _factory)
+    for cid, interval in sorted(accepted.items()):
+        flat.ingest(snapshots[cid][interval])
+    flat.flush()
+    flat_tenant = flat._tenant(TENANT)
+    if flat_tenant.merged_leaves is None:
+        flat_tenant.fold()
+    for name in REGIONS:
+        region = mesh.region(name)
+        region.query_global(TENANT)  # self-ship + fold so the view is current
+        gt = region.global_view._tenant(TENANT)
+        assert gt.spec == flat_tenant.spec
+        for (path, _), ours, oracle in zip(gt.spec, gt.merged_leaves, flat_tenant.merged_leaves):
+            assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+                f"region {name} global leaf {'/'.join(path)} differs from the"
+                " accepted-snapshot oracle after partition+heal and kill+promote"
+            )
+
+    # ---- every injected fault is visible in obs -------------------------
+    assert obs.get_counter("chaos.injected", kind="region_kill") == 1
+    assert obs.get_counter("chaos.injected", kind="promote") == 1
+    assert obs.get_counter("serve.promotions", region="r1") == 1
+    for kind, count in chaos.counts.items():
+        if kind in ("deliver", "reorder") or count == 0:
+            continue
+        assert obs.get_counter("chaos.injected", kind=kind) == count, kind
+    assert obs.sum_counter("serve.cross_region_merges") > 0
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    print(
+        f"region smoke: {N_CLIENTS} clients x {N_INTERVALS} intervals across"
+        f" {len(REGIONS)} regions at 10% wire faults ({faults_injected} injected)"
+        f" through partition(r2)+heal and kill(r1)+promote(gen {promoted.generation},"
+        f" {fenced} zombie ships fenced, zero backend compiles) — every region's"
+        " global /query bitwise-equal to the accepted-snapshot oracle",
+        flush=True,
+    )
+    print("region smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -156,6 +156,42 @@ class TestIngest:
         finally:
             srv.stop()
 
+    def test_ingest_draining_503_with_retry_after(self):
+        """A draining node's 503 must carry a Retry-After derived from the
+        drain timeout — clients used to get no hint and hot-retried a node
+        that refuses them by contract; by the deadline the drain has either
+        completed (the ring routes elsewhere) or rolled back, so THAT is
+        when the next resolve-and-ship is useful."""
+        agg = Aggregator("dr")
+        agg.register_tenant(TENANT, factory)
+        agg.drain(timeout_s=20.0)
+        srv = MetricsServer(agg, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(srv, "/ingest", snapshot("a", (0, 0)))
+            assert err.value.code == 503
+            assert "draining" in json.load(err.value)["error"]
+            # the deadline already elapsed (drain completed instantly), so
+            # the hint bottoms out at the 1s floor — present either way,
+            # matching the backpressure / circuit-open paths
+            assert int(err.value.headers["Retry-After"]) >= 1
+        finally:
+            srv.stop()
+
+    def test_draining_error_retry_after_tracks_the_deadline(self):
+        """Mid-drain, the hint is the time LEFT to the drain deadline."""
+        from metrics_tpu.serve.aggregator import DrainingError
+
+        agg = Aggregator("dr2")
+        agg.register_tenant(TENANT, factory)
+        agg._drain_deadline = __import__("time").monotonic() + 30.0
+        agg._draining = True
+        with pytest.raises(DrainingError) as err:
+            agg.ingest(snapshot("a", (0, 0)))
+        assert err.value.retry_after_s == pytest.approx(30.0, abs=1.0)
+        agg.resume_admission()
+        assert agg._drain_deadline is None
+
     def test_ingest_quarantined_client_403(self):
         from metrics_tpu.serve import ResilienceConfig
 

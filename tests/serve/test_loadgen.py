@@ -73,6 +73,36 @@ class TestLoadgen:
         with pytest.raises(ValueError, match="fault_rate"):
             run_loadgen(n_clients=1, fault_rate=1.5)
 
+    def test_region_rows_and_bitwise(self):
+        """The multi-region bench harness: both rows present, every
+        region's global view bitwise-equal to the flat oracle."""
+        from metrics_tpu.serve.loadgen import run_region_loadgen
+
+        out = run_region_loadgen(
+            n_regions=2,
+            n_clients=8,
+            fan_out=(2,),
+            payloads_per_client=2,
+            samples_per_payload=32,
+            num_bins=32,
+            verify=True,
+        )
+        assert out["verified_bitwise"] is True
+        assert out["regions"] == 2
+        assert out["serve_cross_region_merges_per_s"] > 0
+        # every round replicates each region to itself + its peer: with 2
+        # regions x 2 rounds, at least 4 cross-region merges were accepted
+        assert out["cross_region_merges"] >= 4
+        assert out["serve_global_query_staleness_ms"] >= 0
+
+    def test_region_count_validation(self):
+        import pytest
+
+        from metrics_tpu.serve.loadgen import run_region_loadgen
+
+        with pytest.raises(ValueError, match="n_regions"):
+            run_region_loadgen(n_regions=1)
+
     def test_cli_json(self, capsys):
         code = main(
             ["--clients", "6", "--fan-out", "2", "--payloads-per-client", "1", "--num-bins", "16", "--verify"]

@@ -1,0 +1,585 @@
+"""Multi-region serving: replication, degraded reads, fenced failover."""
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MaxMetric, SumMetric, obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.ft import faults
+from metrics_tpu.serve.aggregator import Aggregator, FencedGenerationError
+from metrics_tpu.serve.region import (
+    Region,
+    RegionDownError,
+    RegionalMesh,
+    StaleGlobalViewError,
+)
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "t"
+
+
+def factory():
+    return MetricCollection(
+        {"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric(), "peak": MaxMetric()}
+    )
+
+
+def client_payload(client_id: str, step: int = 0, seed: int = 0, scale: float = 1.0):
+    rng = np.random.default_rng(seed)
+    coll = factory()
+    for s in range(step + 1):
+        preds = jnp.asarray(rng.uniform(0, 1, 32).astype(np.float32))
+        target = jnp.asarray((rng.uniform(0, 1, 32) < 0.5).astype(np.int32))
+        coll["auroc"].update(preds, target)
+        coll["seen"].update(jnp.asarray(32.0 * scale))
+        coll["peak"].update(preds)
+    return encode_state(coll, tenant=TENANT, client_id=client_id, watermark=(0, step))
+
+
+def build_mesh(names=("us", "eu"), ckpt_root=None, **region_kwargs):
+    regions = []
+    for name in names:
+        kwargs = dict(region_kwargs)
+        if ckpt_root is not None:
+            kwargs["checkpoint_dir"] = f"{ckpt_root}/{name}"
+        regions.append(Region(name, {TENANT: factory}, **kwargs))
+    return RegionalMesh(regions)
+
+
+def merged_leaves(agg: Aggregator, tenant: str = TENANT):
+    t = agg._tenant(tenant)
+    if t.merged_leaves is None:
+        t.fold()
+    return t.spec, t.merged_leaves
+
+
+def assert_bitwise(a: Aggregator, b: Aggregator):
+    spec_a, leaves_a = merged_leaves(a)
+    spec_b, leaves_b = merged_leaves(b)
+    assert spec_a == spec_b
+    for (path, _), x, y in zip(spec_a, leaves_a, leaves_b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), path
+
+
+class TestCrossRegionMerge:
+    def test_every_region_global_equals_flat_oracle(self):
+        mesh = build_mesh(("us", "eu", "ap"), fan_out=(2,))
+        blobs = [client_payload(f"c{i}", seed=i) for i in range(9)]
+        for i, blob in enumerate(blobs):
+            mesh.region(["us", "eu", "ap"][i % 3]).ingest(blob, client_id=f"c{i}")
+        for name in mesh.regions():
+            mesh.region(name).pump()
+        mesh.replicate()
+        flat = Aggregator("flat")
+        flat.register_tenant(TENANT, factory)
+        for blob in blobs:
+            flat.ingest(blob)
+        flat.flush()
+        for name in mesh.regions():
+            mesh.region(name).query_global(TENANT)
+            assert_bitwise(mesh.region(name).global_view, flat)
+
+    def test_cross_merge_is_exactly_once_under_redelivery(self):
+        """Duplicated / re-sent replicas are absorbed by watermark dedup:
+        the cross-merge stays exactly-once and order-free."""
+        mesh = build_mesh(("us", "eu"))
+        mesh.region("us").ingest(client_payload("c0"), client_id="c0")
+        payloads = mesh.region("us").snapshot_payloads()
+        eu = mesh.region("eu")
+        for blob in payloads:
+            assert eu.accept_replica(blob) is True
+        for blob in reversed(payloads):  # re-sent, out of order
+            assert eu.accept_replica(blob) is False
+        flat = Aggregator("flat")
+        flat.register_tenant(TENANT, factory)
+        flat.ingest(client_payload("c0"))
+        flat.ingest(client_payload("region-self", seed=1))  # guard: differs
+        eu_q = eu.query_global(TENANT)
+        assert eu_q["values"]["seen"]["value"] == 32.0
+
+    def test_query_global_encodes_only_the_queried_tenant(self):
+        """A multi-tenant region must not pay T-1 irrelevant full-state
+        encodes on every global read."""
+        region = Region(
+            "us",
+            {TENANT: factory, "other": lambda: MetricCollection({"seen": SumMetric()})},
+        )
+        mesh = RegionalMesh([region, Region("eu", {TENANT: factory, "other": lambda: MetricCollection({"seen": SumMetric()})})])
+        shipped = []
+        original = region.snapshot_payloads
+
+        def spy(tenants=None):
+            shipped.append(tenants)
+            return original(tenants)
+
+        region.snapshot_payloads = spy
+        region.query_global(TENANT)
+        assert shipped == [[TENANT]]
+
+    def test_replica_carries_region_and_generation_meta(self):
+        mesh = build_mesh(("us", "eu"))
+        from metrics_tpu.serve.wire import decode_state
+
+        blob = mesh.region("us").snapshot_payloads()[0]
+        payload = decode_state(blob)
+        assert payload.client_id == "region:us"
+        assert payload.meta["region"] == "us"
+        assert payload.meta["generation"] == 0
+        assert payload.watermark == (0, 0)
+
+    def test_replication_loop_background(self):
+        mesh = build_mesh(("us", "eu"))
+        mesh.region("us").ingest(client_payload("c0"), client_id="c0")
+        mesh.start(interval_s=0.02)
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                q = mesh.region("eu").query_global(TENANT, refresh_local=False)
+                if q["values"]["seen"]["value"] == 32.0:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("background replication never delivered")
+        finally:
+            mesh.stop()
+
+
+class TestDegradedReads:
+    def test_partition_marks_degraded_and_heals_bitwise(self):
+        mesh = build_mesh(("us", "eu", "ap"))
+        for i in range(6):
+            mesh.region(["us", "eu", "ap"][i % 3]).ingest(
+                client_payload(f"c{i}", seed=i), client_id=f"c{i}"
+            )
+        with faults.region_partition(mesh, "ap"):
+            mesh.replicate()
+            q = mesh.region("us").query_global(TENANT)
+            assert q["degraded"] is True and q["stale_regions"] == ["ap"]
+            assert q["local_complete"] is True
+            # the isolated side still answers, everything else stale
+            q_ap = mesh.region("ap").query_global(TENANT)
+            assert set(q_ap["stale_regions"]) == {"eu", "us"}
+        mesh.replicate()  # heal: one cumulative cross-ship repairs
+        flat = Aggregator("flat")
+        flat.register_tenant(TENANT, factory)
+        for i in range(6):
+            flat.ingest(client_payload(f"c{i}", seed=i))
+        flat.flush()
+        for name in mesh.regions():
+            q = mesh.region(name).query_global(TENANT)
+            assert q["degraded"] is False, q["regions"]
+            assert_bitwise(mesh.region(name).global_view, flat)
+
+    def test_max_staleness_reject_raises_503_material(self):
+        mesh = build_mesh(("us", "eu"), max_staleness_s=0.01, stale_reads="reject")
+        mesh.replicate()
+        time.sleep(0.03)
+        with pytest.raises(StaleGlobalViewError) as err:
+            mesh.region("us").query_global(TENANT)
+        assert err.value.stale_regions == ["eu"]
+        assert err.value.retry_after_s == 0.01
+
+    def test_never_replicated_peer_is_stale(self):
+        mesh = build_mesh(("us", "eu"))
+        q = mesh.region("us").query_global(TENANT)
+        assert q["degraded"] is True and q["stale_regions"] == ["eu"]
+        assert q["regions"]["eu"]["staleness_s"] is None
+
+    def test_query_records_staleness_histogram(self):
+        obs.reset()
+        was = obs.enable()
+        try:
+            mesh = build_mesh(("us", "eu"))
+            mesh.replicate()
+            mesh.region("us").query_global(TENANT)
+            hist = obs.get_histogram("serve.global_query_staleness_ms", node="us")
+            assert hist is not None and hist.count == 1
+            gauge = obs.get_gauge("serve.peer_staleness_ms", node="us", peer="eu")
+            assert gauge is not None and gauge >= 0.0
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+
+class TestGenerationFencing:
+    def test_zombie_ship_refused_and_counted(self):
+        obs.reset()
+        was = obs.enable()
+        try:
+            mesh = build_mesh(("us", "eu"))
+            mesh.replicate()
+            eu = mesh.region("eu")
+            eu.global_view.fence_generation("region:us", 3)
+            zombie = mesh.region("us").snapshot_payloads()[0]  # generation 0
+            with pytest.raises(FencedGenerationError, match="zombie"):
+                eu.accept_replica(zombie)
+            assert obs.get_counter("serve.fenced_ships", tenant=TENANT, client="region:us") == 1
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+    def test_fence_advances_from_accepted_payloads(self):
+        mesh = build_mesh(("us", "eu"))
+        us = mesh.region("us")
+        us.set_generation(5)
+        mesh.replicate()
+        eu = mesh.region("eu")
+        assert eu.global_view.generation_fence("region:us") == 5
+        # an older-generation ship is now refused even without promote()
+        old = encode_state(
+            factory(), tenant=TENANT, client_id="region:us", watermark=(4, 99),
+            meta={"region": "us", "generation": 4},
+        )
+        with pytest.raises(FencedGenerationError):
+            eu.accept_replica(old)
+
+    def test_fence_survives_checkpoint_restore(self, tmp_path):
+        agg = Aggregator("a", checkpoint_dir=str(tmp_path))
+        agg.register_tenant(TENANT, factory)
+        agg.fence_generation("region:us", 7)
+        agg.save()
+        fresh = Aggregator("a", checkpoint_dir=str(tmp_path))
+        fresh.register_tenant(TENANT, factory)
+        fresh.restore()
+        assert fresh.generation_fence("region:us") == 7
+
+    def test_fenced_payload_raced_into_queue_is_dropped_at_fold(self):
+        """A zombie ship that passed ingest before the fence advanced must
+        be dropped at accept time, not folded."""
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        coll = factory()
+        coll["seen"].update(jnp.asarray(99.0))
+        blob = encode_state(
+            coll, tenant=TENANT, client_id="region:us", watermark=(0, 0),
+            meta={"region": "us", "generation": 0},
+        )
+        assert agg.ingest(blob) is True  # queued, unfenced at the time
+        agg.fence_generation("region:us", 1)  # promotion races the queue
+        agg.flush()
+        assert len(agg._tenant(TENANT).clients) == 0
+
+    def test_unfenced_and_non_int_generations_pass(self):
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        assert agg.ingest(client_payload("plain")) is True  # no generation meta
+        weird = encode_state(
+            factory(), tenant=TENANT, client_id="weird", watermark=(0, 0),
+            meta={"generation": "not-an-int"},
+        )
+        assert agg.ingest(weird) is True
+        agg.flush()
+        assert agg.generation_fence("weird") is None
+
+
+class TestFailover:
+    def test_promote_restores_and_fences(self):
+        obs.reset()
+        was = obs.enable()
+        try:
+            with tempfile.TemporaryDirectory() as root:
+                mesh = build_mesh(("us", "eu"), ckpt_root=root)
+                mesh.region("us").ingest(client_payload("c0"), client_id="c0")
+                mesh.replicate()
+                mesh.region("us").save()
+                zombie = mesh.region("us").snapshot_payloads()
+                faults.kill_region(mesh, "us")
+                with pytest.raises(RegionDownError):
+                    mesh.region("us").query_global(TENANT)
+                promoted = faults.promote_region(mesh, "us")
+                assert promoted.generation == 1
+                assert mesh.region("us") is promoted
+                # peers were proactively fenced at promotion
+                assert mesh.region("eu").global_view.generation_fence("region:us") == 1
+                for blob in zombie:
+                    with pytest.raises(FencedGenerationError):
+                        mesh.region("eu").accept_replica(blob)
+                mesh.replicate()
+                # the promoted region's restored slots + its gen-1 ships keep
+                # every region's global view equal to the flat oracle
+                flat = Aggregator("flat")
+                flat.register_tenant(TENANT, factory)
+                flat.ingest(client_payload("c0"))
+                flat.flush()
+                for name in mesh.regions():
+                    mesh.region(name).query_global(TENANT)
+                    assert_bitwise(mesh.region(name).global_view, flat)
+                assert obs.get_counter("chaos.injected", kind="region_kill") == 1
+                assert obs.get_counter("chaos.injected", kind="promote") == 1
+                assert obs.get_counter("serve.promotions", region="us") == 1
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+    def test_promoted_generation_survives_a_second_failover(self):
+        """Generation minting is monotonic across repeated promotions —
+        the manifest record is the floor, never the ceiling."""
+        with tempfile.TemporaryDirectory() as root:
+            mesh = build_mesh(("us", "eu"), ckpt_root=root)
+            mesh.region("us").save()
+            mesh.region("us").hard_kill()
+            first = mesh.promote("us")
+            assert first.generation == 1
+            first.save()
+            first.hard_kill()
+            second = mesh.promote("us")
+            assert second.generation == 2
+
+    def test_dead_region_drives_replication_errors_gauge(self):
+        obs.reset()
+        was = obs.enable()
+        try:
+            mesh = build_mesh(("us", "eu"))
+            mesh.region("eu").hard_kill()
+            mesh.replicate()
+            assert obs.get_counter("serve.replication_errors", node="us", peer="eu") == 1
+            assert obs.get_gauge("serve.peers_unreachable", node="us") == 1.0
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+    def test_promote_without_checkpoint_dir_repairs_from_peers(self):
+        """A checkpointless region still fails over: the standby restores
+        nothing, its generation floor is the displaced root's memory, and
+        peers' replicas + client re-ships repair the state."""
+        mesh = build_mesh(("us", "eu"))  # no checkpoint dirs
+        mesh.region("us").ingest(client_payload("c0"), client_id="c0")
+        mesh.replicate()
+        faults.kill_region(mesh, "us")
+        promoted = mesh.promote("us")
+        assert promoted.generation == 1
+        # the client re-ships its cumulative snapshot; peers re-replicate
+        promoted.ingest(client_payload("c0", step=1), client_id="c0")
+        mesh.replicate()
+        flat = Aggregator("flat")
+        flat.register_tenant(TENANT, factory)
+        flat.ingest(client_payload("c0", step=1))
+        flat.flush()
+        for name in mesh.regions():
+            mesh.region(name).query_global(TENANT)
+            assert_bitwise(mesh.region(name).global_view, flat)
+
+    def test_promote_requires_known_region(self):
+        mesh = build_mesh(("us", "eu"))
+        with pytest.raises(Exception, match="no region"):
+            mesh.promote("mars")
+
+    def test_source_failure_key_clears_on_recovery(self):
+        """A source that failed to snapshot (its (src, src) failure key)
+        must clear once it snapshots healthily again — a stale entry
+        would page partition_detected on a healed mesh forever."""
+        obs.reset()
+        was = obs.enable()
+        try:
+            mesh = build_mesh(("us", "eu"))
+            us = mesh.region("us")
+            us.tree = None  # sidestep down-flag: break only the snapshot
+            original = us.local_root
+            us.local_root = None  # snapshot_payloads -> AttributeError
+            with pytest.warns(UserWarning, match="could not replicate"):
+                mesh.replicate()
+            assert obs.get_gauge("serve.peers_unreachable", node="us") == 1.0
+            us.local_root = original  # heal the source
+            mesh.replicate()
+            assert obs.get_gauge("serve.peers_unreachable", node="us") == 0.0
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+    def test_replicate_sweep_exports_staleness_gauges(self):
+        """A black-holing partition fails no link, so the background sweep
+        itself must keep serve.peer_staleness_ms live — the peer_stale
+        condition cannot depend on query traffic."""
+        obs.reset()
+        was = obs.enable()
+        try:
+            mesh = build_mesh(("us", "eu"))
+            mesh.replicate()
+            with faults.region_partition(mesh, "eu"):
+                time.sleep(0.02)
+                mesh.replicate()  # no queries anywhere
+                gauge = obs.get_gauge("serve.peer_staleness_ms", node="us", peer="eu")
+                assert gauge is not None and gauge >= 20.0
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+
+class TestElasticRegion:
+    def test_elastic_region_stays_bitwise_through_churn(self):
+        """A regional fleet keeps its elasticity: join + drain inside one
+        region while the mesh replicates — global views stay equal to the
+        flat oracle (the rebalance is invisible across regions too)."""
+        mesh = build_mesh(("us", "eu"), fan_out=(2,), elastic=True, seed=3)
+        us = mesh.region("us")
+        blobs = [client_payload(f"c{i}", seed=i) for i in range(8)]
+        for i, blob in enumerate(blobs[:4]):
+            mesh.region("us").ingest(blob, client_id=f"c{i}")
+        for i, blob in enumerate(blobs[4:], start=4):
+            mesh.region("eu").ingest(blob, client_id=f"c{i}")
+        us.pump()
+        mesh.region("eu").pump()
+        mesh.replicate()
+        joined = us.fleet.join_node()
+        victim = next(n for n in us.fleet.router.members() if n != joined.name)
+        us.fleet.drain_node(victim)
+        us.pump()
+        mesh.replicate()
+        flat = Aggregator("flat")
+        flat.register_tenant(TENANT, factory)
+        for blob in blobs:
+            flat.ingest(blob)
+        flat.flush()
+        for name in mesh.regions():
+            mesh.region(name).query_global(TENANT)
+            assert_bitwise(mesh.region(name).global_view, flat)
+
+
+class TestMeshWiring:
+    def test_duplicate_region_name_refused(self):
+        with pytest.raises(Exception, match="already in the mesh"):
+            build_mesh(("us", "us"))
+
+    def test_set_link_unknown_pair_refused(self):
+        mesh = build_mesh(("us", "eu"))
+        with pytest.raises(Exception, match="no replication link"):
+            mesh.set_link("us", "mars", lambda b: None)
+
+    def test_schema_disagreement_between_regions_named(self):
+        """Regions disagreeing on a tenant schema: the replica is refused
+        with schema_diff naming the exact differing path, counted as a
+        replication error, and the sweep survives for other peers."""
+        other = Region(
+            "eu", {TENANT: lambda: MetricCollection({"auroc": StreamingAUROC(num_bins=32)})}
+        )
+        mesh = RegionalMesh([Region("us", {TENANT: factory}), other])
+        mesh.region("us").ingest(client_payload("c0"), client_id="c0")
+        from metrics_tpu.serve.wire import SchemaMismatchError
+
+        blob = mesh.region("us").snapshot_payloads()[0]
+        with pytest.raises(SchemaMismatchError, match="num_bins|config|bins"):
+            other.accept_replica(blob)
+        with pytest.warns(UserWarning, match="could not replicate"):
+            mesh.replicate()  # survives, counted — not raised
+
+    def test_stale_reads_param_validated(self):
+        with pytest.raises(ValueError, match="stale_reads"):
+            Region("us", {TENANT: factory}, stale_reads="maybe")
+        with pytest.raises(ValueError, match="elastic"):
+            Region("us", {TENANT: factory}, elastic=True)
+
+
+class TestHealthConditions:
+    def test_peer_stale_partition_and_zombie_conditions(self):
+        from metrics_tpu.obs.health import HealthMonitor
+
+        obs.reset()
+        was = obs.enable()
+        try:
+            monitor = HealthMonitor(
+                warn=False,
+                peer_staleness_ms=1.0,
+                partition_detected=True,
+                fenced_zombie=True,
+            )
+            assert monitor.check()["healthy"] is True
+            obs.set_gauge("serve.peer_staleness_ms", 50.0, node="us", peer="eu")
+            fired = {w["kind"] for w in monitor.check()["warnings"]}
+            assert fired == {"peer_stale"}
+            obs.set_gauge("serve.peers_unreachable", 1.0, node="us")
+            obs.inc("serve.fenced_ships", tenant=TENANT, client="region:us")
+            fired = {w["kind"] for w in monitor.check()["warnings"]}
+            assert {"peer_stale", "partition_detected", "fenced_zombie"} <= fired
+        finally:
+            obs.reset()
+            obs.enable(was)
+
+
+class TestRegionEndpoints:
+    def test_scope_global_and_reject_503(self):
+        import json
+        import urllib.error
+        import urllib.request
+
+        from metrics_tpu.serve.endpoints import MetricsServer
+
+        mesh = build_mesh(("us", "eu"))
+        us = mesh.region("us")
+        us.ingest(client_payload("c0"), client_id="c0")
+        mesh.replicate()
+        server = MetricsServer(us.global_view, region=us, port=0).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            q = json.load(
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}&scope=global", timeout=10)
+            )
+            assert q["region"] == "us" and q["degraded"] is False
+            assert q["values"]["seen"]["value"] == 32.0
+            # local scope still answers the wrapped aggregator's own view
+            q_local = json.load(
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}", timeout=10)
+            )
+            assert "regions" not in q_local
+            # bad scope -> 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}&scope=nope", timeout=10)
+            assert err.value.code == 400
+            # reject policy -> 503 naming the stale region, Retry-After set
+            us.stale_reads, us.max_staleness_s = "reject", 0.001
+            time.sleep(0.01)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(f"{base}/query?tenant={TENANT}&scope=global", timeout=10)
+            assert err.value.code == 503
+            body = json.loads(err.value.read().decode())
+            assert body["stale_regions"] == ["eu"]
+            assert int(err.value.headers["Retry-After"]) >= 1
+        finally:
+            server.stop()
+
+    def test_scope_global_without_region_is_400(self):
+        import urllib.error
+        import urllib.request
+
+        from metrics_tpu.serve.endpoints import MetricsServer
+
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        server = MetricsServer(agg, port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/query?tenant={TENANT}&scope=global",
+                    timeout=10,
+                )
+            assert err.value.code == 400
+        finally:
+            server.stop()
+
+    def test_fenced_ship_answers_409(self):
+        import urllib.error
+        import urllib.request
+
+        from metrics_tpu.serve.endpoints import MetricsServer
+
+        agg = Aggregator("a")
+        agg.register_tenant(TENANT, factory)
+        agg.fence_generation("region:us", 2)
+        blob = encode_state(
+            factory(), tenant=TENANT, client_id="region:us", watermark=(0, 0),
+            meta={"region": "us", "generation": 0},
+        )
+        server = MetricsServer(agg, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/ingest", data=blob
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 409
+        finally:
+            server.stop()

@@ -211,6 +211,79 @@ class TestVersionEvolution:
         assert any("only in" in d for d in schema_diff(schema_of(a), schema_of(b)))
 
 
+class TestMixedVersionFleet:
+    """The rolling-regional-upgrade satellite: during an upgrade, a
+    minor-bumped payload carrying the multi-region ``region`` /
+    ``generation`` meta keys must round-trip through a PRE-UPGRADE
+    aggregator undamaged — folded like any snapshot, meta preserved for
+    the next hop — and regions disagreeing on a tenant schema are refused
+    with the exact differing path named."""
+
+    def test_region_meta_round_trips_through_pre_upgrade_aggregator(self):
+        from metrics_tpu.serve.aggregator import Aggregator
+
+        coll = _filled()
+        blob = encode_state(
+            coll,
+            tenant="t",
+            client_id="region:us",
+            watermark=(2, 7),
+            meta={"region": "us", "generation": 2},
+        )
+        # the shape a FUTURE-minor regional encoder emits into a fleet
+        # mid-upgrade: bumped minor, region/generation meta, one more
+        # unknown header key for good measure
+        future = _reframe(
+            blob, minor=WIRE_MINOR + 1, extra_header={"mesh_epoch": 4}
+        )
+        # a pre-upgrade aggregator (no fences, no region wiring) accepts
+        # and folds it like any client snapshot — the fence path engages
+        # only when a fence exists, so unknown generations cost nothing
+        agg = Aggregator("pre-upgrade")
+        agg.register_tenant("t", lambda: _collection())
+        assert agg.ingest(future) is True
+        agg.flush()
+        assert agg.client_watermark("t", "region:us") == (2, 7)
+        q = agg.query("t")
+        assert q["values"]["seen"]["value"] == 200.0
+        # ...and the decode side preserved BOTH keys untouched, so a
+        # forwarding hop that re-encodes with `meta=payload.meta` carries
+        # them onward — the upgrade wavefront loses nothing
+        payload = decode_state(future)
+        assert payload.wire_version == (WIRE_MAJOR, WIRE_MINOR + 1)
+        assert payload.meta["region"] == "us"
+        assert payload.meta["generation"] == 2
+        reencoded = decode_state(
+            encode_state(
+                _collection(),
+                tenant="t",
+                client_id=payload.client_id,
+                watermark=payload.watermark,
+                meta=payload.meta,
+            )
+        )
+        assert reencoded.meta["region"] == "us" and reencoded.meta["generation"] == 2
+
+    def test_region_schema_disagreement_names_the_path(self):
+        """Two regions whose tenants drifted apart (a bin-count bump
+        rolled out to one region first) must refuse the cross-merge with
+        schema_diff naming the exact differing config path."""
+        from metrics_tpu.serve.aggregator import Aggregator
+
+        upgraded_region_ship = encode_state(
+            _filled(num_bins=128),
+            tenant="t",
+            client_id="region:eu",
+            watermark=(0, 0),
+            meta={"region": "eu", "generation": 0},
+        )
+        agg = Aggregator("us.global")
+        agg.register_tenant("t", lambda: _collection(num_bins=64))
+        with pytest.raises(SchemaMismatchError) as err:
+            agg.ingest(upgraded_region_ship)
+        assert "num_bins" in str(err.value) or "config" in str(err.value)
+
+
 def _map_header(data: bytes, fn) -> bytes:
     """Rebuild payload bytes with ``fn(header_dict)`` applied (same body)."""
     magic, major, minor, header_len = _PREAMBLE.unpack_from(data)
