@@ -803,6 +803,44 @@ def bench_aot() -> dict:
     }
 
 
+def bench_mesh_rows() -> dict:
+    """Sharded-state + topology-aware-sync rows (round 15; see
+    ``benchmarks/bench_mesh.py`` for the row semantics).
+
+    The two mesh rows need >= 2 devices: on a multi-device host (the TPU
+    sweep — acceptance values come from there) they run in-process; a
+    single-device CPU host spawns the module as a subprocess that
+    self-provisions an 8-device virtual mesh BEFORE backend init (the
+    parent's backend is already up, so the device count cannot change
+    in-process). The prefetch-overlap row is single-device and always
+    runs in-process.
+    """
+    import os
+    import subprocess
+    import sys
+
+    import jax
+
+    from benchmarks import bench_mesh
+
+    out = dict(bench_mesh.measure_prefetch())
+    if jax.device_count() >= 2:
+        out.update(bench_mesh.measure())
+        return out
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.bench_mesh"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(f"bench_mesh subprocess failed: {proc.stderr[-500:]}")
+    out.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+    return out
+
+
 def bench_probes() -> dict:
     """Chip-state calibration probes, one per op class.
 
@@ -1048,13 +1086,16 @@ def main(
         if not math.isfinite(ours_ms) or ours_ms <= 0:
             print(f"SKIPPED {name}: measurement invalid (dispatch noise > workload)", file=sys.stderr)
             return
+        # higher-is-better rows: throughput ("/s") and percentage-recovered
+        # ("%", e.g. prefetch overlap) — vs_baseline and the gates invert
+        higher_better = unit in ("/s", "%")
         row = {
             "metric": name,
             "value": round(ours_ms, 3),
             "unit": unit,
             # >1 always means "better than baseline": time ratio for
-            # latency rows, value ratio for rate ("/s") rows
-            "vs_baseline": round(ours_ms / base_ms if unit == "/s" else base_ms / ours_ms, 3),
+            # latency rows, value ratio for rate/percent rows
+            "vs_baseline": round(ours_ms / base_ms if higher_better else base_ms / ours_ms, 3),
             "baseline": baseline,
         }
         # bimodal-chip protocol (benchmarks/_timing.py): the value IS the
@@ -1094,7 +1135,7 @@ def main(
         probe_now = session_probe_values.get(probe)
         norm_best = prior_norm.get(name)
         if probe_now and norm_best is not None:
-            if unit == "/s":
+            if higher_better:
                 product = float(ours_ms) * probe_now
                 if product < norm_best / 1.5:
                     print(
@@ -1113,11 +1154,11 @@ def main(
                     file=sys.stderr,
                 )
             return
-        if unit == "/s":
+        if higher_better:
             if ours_ms < best / 1.5:
                 print(
-                    f"REGRESSION {name}: {float(ours_ms):.1f}/s vs best prior round"
-                    f" {best:.1f}/s ({best / float(ours_ms):.2f}x lower). No probe-bearing"
+                    f"REGRESSION {name}: {float(ours_ms):.1f}{unit} vs best prior round"
+                    f" {best:.1f}{unit} ({best / float(ours_ms):.2f}x lower). No probe-bearing"
                     " prior round exists for a state-invariant comparison.",
                     file=sys.stderr,
                 )
@@ -1397,6 +1438,36 @@ def main(
         )
     except Exception as err:  # noqa: BLE001 — engine rows must not kill the sweep
         print(f"SKIPPED aot engine rows: {err}", file=sys.stderr)
+
+    # sharded-state + topology-aware sync (round 15): the sharded 1M
+    # buffer-AUROC sync gates against its replicated A/B (the win IS the
+    # vs_baseline), the hierarchical/flat ratio and the prefetch-overlap
+    # percentage gate against their own best prior (overlap is a
+    # higher-is-better "%" row — inverted gate, the "/s" convention)
+    try:
+        mesh_rows = section(bench_mesh_rows)
+        emit(
+            "sharded_auroc_1M_sync_ms",
+            mesh_rows["sharded_auroc_1M_sync_ms"],
+            mesh_rows["replicated_auroc_1M_sync_ms"],
+            baseline="replicated_gather_same_state",
+        )
+        emit(
+            "hier_reduce_vs_flat_ratio",
+            mesh_rows["hier_reduce_vs_flat_ratio"],
+            prior.get("hier_reduce_vs_flat_ratio", mesh_rows["hier_reduce_vs_flat_ratio"]),
+            baseline="best_prior_self",
+            unit="x",
+        )
+        emit(
+            "epoch_prefetch_overlap_pct",
+            mesh_rows["epoch_prefetch_overlap_pct"],
+            prior.get("epoch_prefetch_overlap_pct", mesh_rows["epoch_prefetch_overlap_pct"]),
+            baseline="best_prior_self",
+            unit="%",
+        )
+    except Exception as err:  # noqa: BLE001 — mesh rows must not kill the sweep
+        print(f"SKIPPED mesh rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

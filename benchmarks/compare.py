@@ -93,12 +93,13 @@ PROBE_CLASS: Dict[str, str] = {
 
 
 def is_rate_metric(name: str, *rows: Any) -> bool:
-    """True for throughput rows (``unit="/s"`` / ``*_per_s``): HIGHER is
-    better, so the regression gate, the best-prior scan and the duplicate
-    keep-best rule all invert for them."""
-    if isinstance(name, str) and name.endswith("_per_s"):
+    """True for higher-is-better rows — throughput (``unit="/s"`` /
+    ``*_per_s``) and percentage-recovered rows (``unit="%"`` / ``*_pct``,
+    e.g. the prefetch-overlap row): the regression gate, the best-prior
+    scan and the duplicate keep-best rule all invert for them."""
+    if isinstance(name, str) and (name.endswith("_per_s") or name.endswith("_pct")):
         return True
-    return any(isinstance(r, dict) and r.get("unit") == "/s" for r in rows)
+    return any(isinstance(r, dict) and r.get("unit") in ("/s", "%") for r in rows)
 
 
 class CompareRefused(RuntimeError):

@@ -124,7 +124,10 @@ from metrics_tpu.steps import (  # noqa: E402, F401
     make_epoch,
     make_step,
     make_stream_step,
+    overlap_epoch_sync,
+    prefetch_to_device,
 )
+from metrics_tpu.utilities.sharding import StateShardSpec  # noqa: E402, F401
 from metrics_tpu.utilities.debug import debug_checks  # noqa: E402, F401
 from metrics_tpu.wrappers import (  # noqa: E402, F401
     BootStrapper,
@@ -195,6 +198,9 @@ __all__ = [
     "make_epoch",
     "make_step",
     "make_stream_step",
+    "overlap_epoch_sync",
+    "prefetch_to_device",
+    "StateShardSpec",
     "register_state_reduction",
     "debug_checks",
     "engine",

@@ -81,3 +81,45 @@ class AUROC(Metric):
         return _auroc_compute(
             preds, target, self.mode, self.num_classes, self.pos_label, self.average, self.max_fpr
         )
+
+
+# ---------------------------------------------------------------------------
+# Sharded (gather-free) compute — make_step(..., sharded_state=True)
+# ---------------------------------------------------------------------------
+# Binary AUROC over mesh-RESIDENT sample shards: instead of the replicated
+# path's materialized buffer gather (O(n_dev * capacity) HBM on every
+# device before the exact sort), a lax.ppermute ring pass counts
+# discordant pairs against each visiting shard's sorted negatives — same
+# total bytes as one all-gather, peak HBM stays O(capacity), and the value
+# matches the exact sorted path's trapezoidal/tie-half convention to f32
+# summation order. See utilities/sharding.sharded_sample_auroc.
+from metrics_tpu.utilities.buffers import CapacityBuffer as _CapacityBuffer  # noqa: E402
+from metrics_tpu.utilities.sharding import (  # noqa: E402
+    register_sharded_compute as _register_sharded_compute,
+    sharded_sample_auroc as _sharded_sample_auroc,
+)
+
+
+def _auroc_sharded(worker: AUROC, state: dict, axis_name: Any) -> Array:
+    if worker.mode != DataType.BINARY:
+        raise ValueError(
+            "sharded_state AUROC supports binary mode only (the ring pair count is a"
+            f" binary-score kernel); detected mode {worker.mode!r}. Use the replicated"
+            " gather sync (sharded_state=False) for multiclass/multilabel."
+        )
+    if not isinstance(state.get("preds"), _CapacityBuffer):
+        raise ValueError(
+            "sharded_state AUROC needs sample_capacity= (fixed-capacity buffers): unbounded"
+            " list states cannot be mesh-resident."
+        )
+    if worker.max_fpr is not None:
+        raise ValueError("sharded_state AUROC does not support max_fpr=; use the replicated sync.")
+    if worker.pos_label not in (None, 1):
+        raise ValueError(
+            f"sharded_state AUROC assumes pos_label=1 (got {worker.pos_label}); relabel the"
+            " targets or use the replicated sync."
+        )
+    return _sharded_sample_auroc(state["preds"], state["target"], axis_name)
+
+
+_register_sharded_compute(AUROC, _auroc_sharded)

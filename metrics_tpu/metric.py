@@ -204,6 +204,11 @@ class Metric(ABC):
         self._defaults: Dict[str, Union[Array, List]] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Union[str, Callable, None]] = {}
+        # declarative per-state sharding (utilities.sharding.StateShardSpec):
+        # which dim of the state's arrays distributes over the sync mesh
+        # axis — consumed by state_shardings() (the pjit layout) and the
+        # make_step(sharded_state=True) gather-free compute path
+        self._shard_specs: Dict[str, Any] = {}
         self._dtype = jnp.asarray(0.0).dtype
 
         self._update_count = 0
@@ -232,6 +237,7 @@ class Metric(ABC):
         default: Union[Array, List],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        shard_spec: Optional[Any] = None,
     ) -> None:
         """Register a metric state (reference ``metric.py:165``).
 
@@ -239,6 +245,15 @@ class Metric(ABC):
         ``cat``-accumulated state). ``dist_reduce_fx`` in ``{"sum", "mean",
         "cat", "min", "max", None, callable}`` declares how the state
         synchronizes across devices/processes.
+
+        ``shard_spec`` (a
+        :class:`~metrics_tpu.utilities.sharding.StateShardSpec`) declares
+        which dimension of the state distributes over the sync mesh axis —
+        the layout :meth:`state_shardings` lowers to pjit ``NamedSharding``
+        and the ``make_step(sharded_state=True)`` path reduce-scatters
+        along. Defaults: ``CapacityBuffer`` states shard their rows (dim
+        0), sketch states shard per their class's ``_shard_dims``
+        declaration, everything else stays replicated.
         """
         if isinstance(default, CapacityBuffer):
             if default:
@@ -263,9 +278,24 @@ class Metric(ABC):
         if dist_reduce_fx is not None and not callable(dist_reduce_fx) and dist_reduce_fx not in _VALID_REDUCTIONS:
             raise ValueError(f"`dist_reduce_fx` must be callable or one of {_VALID_REDUCTIONS + (None,)}")
 
+        if shard_spec is not None:
+            from metrics_tpu.utilities.sharding import StateShardSpec
+
+            if not isinstance(shard_spec, StateShardSpec):
+                raise ValueError(
+                    f"`shard_spec` must be a utilities.sharding.StateShardSpec, got {shard_spec!r}"
+                )
+        elif isinstance(default, CapacityBuffer):
+            from metrics_tpu.utilities.sharding import StateShardSpec
+
+            # rows distribute over the mesh (the buffer's declared axis)
+            shard_spec = StateShardSpec(dim=CapacityBuffer.SHARD_DIM)
+
         self._defaults[name] = deepcopy(default)
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        if shard_spec is not None:
+            self._shard_specs[name] = shard_spec
         setattr(self, name, deepcopy(default))
 
     # ------------------------------------------------------------------
@@ -571,6 +601,17 @@ class Metric(ABC):
     def state_pytree(self) -> Dict[str, Union[Array, List[Array]]]:
         """The metric state as a pytree (for jit/shard_map pipelines, orbax)."""
         return self._snapshot_state()
+
+    def state_shardings(self, mesh: Any, axis_name: Union[str, tuple]) -> Dict[str, Any]:
+        """The declarative shard specs lowered to a ``NamedSharding`` pytree
+        matching :meth:`state_pytree` — the pjit layout that keeps
+        ``CapacityBuffer`` rows and sketch bins RESIDENT across ``mesh``
+        (pass as ``in_shardings``/``out_shardings`` or to
+        ``jax.device_put``). See
+        :func:`metrics_tpu.utilities.sharding.state_named_shardings`."""
+        from metrics_tpu.utilities.sharding import state_named_shardings
+
+        return state_named_shardings(self, mesh, axis_name)
 
     def load_state_pytree(self, state: Dict[str, Union[Array, List[Array]]]) -> None:
         for name in self._defaults:

@@ -54,6 +54,7 @@ from metrics_tpu.obs.tracing import trace_span as _obs_span
 from metrics_tpu.streaming.sketches import Sketch
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.distributed import (
+    hierarchical_reduce_in_context,
     replicate_typed,
     sync_buffer_in_context,
     sync_reduce_in_context,
@@ -89,6 +90,8 @@ __all__ = [
     "make_epoch",
     "make_step",
     "make_stream_step",
+    "overlap_epoch_sync",
+    "prefetch_to_device",
 ]
 
 
@@ -199,6 +202,8 @@ def make_step(
     *init_args: Any,
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     with_value: bool = True,
+    sharded_state: bool = False,
+    hierarchical_sync: bool = False,
     **init_kwargs: Any,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build pure ``(init, step, compute)`` functions from a metric.
@@ -219,6 +224,28 @@ def make_step(
             batch-local metric value (the reference's ``forward`` result);
             when False, ``step`` returns ``(state', None)`` and skips that
             work.
+        sharded_state: keep big states MESH-RESIDENT through ``compute``:
+            instead of the replicated sync (psum all-reduce of sketch bins,
+            materialized all-gather of sample buffers), the metric's
+            registered gather-free kernel
+            (:func:`metrics_tpu.utilities.sharding.register_sharded_compute`)
+            reduce-scatters sketch bins / ring-passes buffer rows and
+            finishes with scalar collectives — no device ever holds the
+            full merged state. Built-ins cover ``StreamingAUROC`` /
+            ``StreamingAveragePrecision`` / ``StreamingQuantile`` (sharded
+            bins) and binary ``AUROC(sample_capacity=...)`` (resident
+            rows). Metrics without a registered kernel whose states are
+            all psum-family sync as usual (psum is already in-place);
+            gather-state metrics without a kernel raise at build time.
+        hierarchical_sync: with a MULTI-axis ``axis_name``, reduce each
+            psum-family state one axis at a time in the given order
+            (``axis_name[0]`` — pass the ICI/intra-slice axis — first, DCN
+            second) instead of one flat collective, so the fast fabric
+            combines first and the slow hop moves one already-reduced
+            operand. Every per-axis collective is visible to the
+            ``set_collective_seam`` hook and the ``sync.*`` counters in
+            issue order. Gather-typed states keep the flat collective
+            (concatenation order must not depend on the axis split).
 
     Returns:
         ``init() -> state``, ``step(state, *batch) -> (state', value)``,
@@ -250,6 +277,11 @@ def make_step(
     if isinstance(metric, MetricCollection):
         if init_args or init_kwargs:
             raise TypeError("make_step(collection) takes no extra args; configure the collection itself")
+        if sharded_state or hierarchical_sync:
+            raise ValueError(
+                "sharded_state/hierarchical_sync are per-metric knobs: build per-member steps"
+                " (one make_step per sharded metric) instead of a fused collection step."
+            )
         return _make_collection_step(metric, axis_name=axis_name, with_value=with_value)
 
     if isinstance(metric, Metric):
@@ -263,6 +295,12 @@ def make_step(
     from metrics_tpu.wrappers.classwise import ClasswiseWrapper
     from metrics_tpu.wrappers.minmax import MinMaxMetric
     from metrics_tpu.wrappers.multioutput import MultioutputWrapper
+
+    if (sharded_state or hierarchical_sync) and isinstance(template, WrapperMetric):
+        raise ValueError(
+            f"sharded_state/hierarchical_sync are not wired through {type(template).__name__}:"
+            " build the step from the base metric and apply the wrapper semantics outside it."
+        )
 
     if isinstance(template, BootStrapper):
         # the bootstrap replicate states are a fixed-shape stacked pytree —
@@ -360,6 +398,26 @@ def make_step(
         for r, d in zip(template._reductions.values(), template._defaults.values())
     )
 
+    # sharded-state compute: resolve the metric's gather-free kernel at
+    # BUILD time so an unsupported combination fails here, not inside a
+    # mesh trace. Metrics without a kernel whose states are all
+    # psum-family still qualify (psum already reduces in place); a
+    # gather-state metric without a kernel has no gather-free path.
+    _sharded_fn = None
+    if sharded_state:
+        from metrics_tpu.utilities.sharding import get_sharded_compute
+
+        if axis_name is None:
+            raise ValueError("sharded_state=True needs axis_name= (the mesh axis the state lives on)")
+        _sharded_fn = get_sharded_compute(type(template))
+        if _sharded_fn is None and has_gather_state:
+            raise ValueError(
+                f"{type(template).__name__} has gather-typed states but no registered sharded"
+                " compute — register one via"
+                " metrics_tpu.utilities.sharding.register_sharded_compute, or drop"
+                " sharded_state=True to use the replicated gather sync."
+            )
+
     def compute(state: State) -> Any:
         _obs_note_trace(_compute_label, _compute_token)
         # span shares _compute_label ("X.step_compute") with the counter —
@@ -368,7 +426,15 @@ def make_step(
             return _compute_impl(state)
 
     def _compute_impl(state: State) -> Any:
+        if axis_name is not None and _sharded_fn is not None:
+            # gather-free path: the kernel owns the mesh reduction (reduce-
+            # scatter / ring / scalar psums); the worker only provides
+            # static config (bins, q, detected input mode)
+            m = _load(state)
+            m._update_count = 1
+            return _sharded_fn(m, state, axis_name)
         if axis_name is not None:
+            _multi = isinstance(axis_name, (tuple, list)) and len(axis_name) > 1
             reduced: State = {}
             for name, value in state.items():
                 if isinstance(value, CapacityBuffer):
@@ -380,7 +446,15 @@ def make_step(
                     # leafwise psum/pmin/pmax == the sketch merge over the
                     # mesh (counts add, extremes extremize) — same payload
                     # shape as a sum state, no gather
-                    reduced[name] = sync_sketch_in_context(value, axis_name)
+                    reduced[name] = sync_sketch_in_context(
+                        value, axis_name, hierarchical=hierarchical_sync and _multi
+                    )
+                elif hierarchical_sync and _multi:
+                    # topology-ordered chain: axis_name[0] (ICI) first,
+                    # later axes (DCN) combine the already-reduced operand
+                    reduced[name] = hierarchical_reduce_in_context(
+                        value, template._reductions[name], axis_name, typed="varying"
+                    )
                 else:
                     reduced[name] = sync_reduce_in_context(
                         value, template._reductions[name], axis_name, typed="varying"
@@ -401,6 +475,72 @@ def make_step(
     return init, _obs_time_launch(step, _step_label), _obs_time_launch(compute, _compute_label)
 
 
+def _is_host_batch_leaf(a: Any) -> bool:
+    """Array-like (device OR host numpy) with at least an epoch axis."""
+    import numpy as np
+
+    return (_is_array(a) or isinstance(a, np.ndarray)) and getattr(a, "ndim", 0) >= 1
+
+
+def _run_prefetched(
+    run: Callable,
+    state: State,
+    batches: tuple,
+    kw_batches: dict,
+    k: int,
+    with_values: bool,
+) -> Tuple[State, Any]:
+    """Double-buffered chunked epoch fold (the ``prefetch=K`` driver).
+
+    The epoch axis splits into chunks of ``k`` batches; the driver enqueues
+    ``jax.device_put`` of chunk ``c + 1`` BEFORE dispatching the fold of
+    chunk ``c``, so the host-to-device transfer streams while the previous
+    launch executes (jax's async dispatch provides the overlap — the
+    driver only orders the enqueues and never blocks between chunks).
+    Chunks preserve batch order, so the chunked fold equals the monolithic
+    one by the same merge-combination argument as the flat epoch (bitwise
+    for integer-valued monoid states; float merge sums may reassociate by
+    an ulp, exactly like flat-vs-vmap).
+    """
+    keys = sorted(kw_batches)
+    n_pos = len(batches)
+    leaves = list(batches) + [kw_batches[kk] for kk in keys]
+    arr_idx = [i for i, a in enumerate(leaves) if _is_host_batch_leaf(a)]
+    if not arr_idx:
+        return run(state, *batches, **kw_batches)
+    n_batches = leaves[arr_idx[0]].shape[0]
+    if n_batches == 0:
+        return run(state, *batches, **kw_batches)
+
+    def _put_chunk(lo: int, hi: int) -> list:
+        return [
+            jax.device_put(a[lo:hi]) if i in arr_idx else a for i, a in enumerate(leaves)
+        ]
+
+    def _rebuild(chunk: list) -> Tuple[tuple, dict]:
+        return tuple(chunk[:n_pos]), dict(zip(keys, chunk[n_pos:]))
+
+    bounds = list(range(0, n_batches, k)) + [n_batches]
+    values_acc: list = []
+    nxt = _put_chunk(bounds[0], bounds[1])
+    for lo, hi in zip(bounds, bounds[1:]):
+        cur = nxt
+        if hi < n_batches:
+            # enqueue the NEXT transfer first: it streams while the fold
+            # dispatched just below executes
+            nxt = _put_chunk(hi, min(hi + k, n_batches))
+        args_c, kwargs_c = _rebuild(cur)
+        state, vals = run(state, *args_c, **kwargs_c)
+        if with_values and vals is not None:
+            values_acc.append(vals)
+    if with_values and values_acc:
+        values = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *values_acc
+        )
+        return state, values
+    return state, None
+
+
 # fold a stacked (B, *state) leaf down its leading axis with the state's own
 # declared reduction — the epoch-axis analogue of _MERGE_OPS (a vmapped
 # sketch state is a Sketch whose leaves carry the stacked axis)
@@ -419,6 +559,9 @@ def make_epoch(
     with_values: bool = False,
     jit_epoch: bool = True,
     engine: Any = None,
+    sharded_state: bool = False,
+    hierarchical_sync: bool = False,
+    prefetch: Optional[int] = None,
     **init_kwargs: Any,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, epoch, compute)``: a WHOLE epoch of batches per launch.
@@ -469,6 +612,20 @@ def make_epoch(
             zero backend compiles. The returned ``epoch`` then also
             exposes ``precompile(state, *batches)`` (``ShapeDtypeStruct``
             leaves accepted) for ahead-of-traffic warmup.
+        sharded_state / hierarchical_sync: as :func:`make_step` — the
+            gather-free mesh-resident compute, and the ICI-first/DCN-second
+            per-axis reduction chain.
+        prefetch: double-buffered host-to-device streaming. ``prefetch=K``
+            splits the epoch axis into chunks of ``K`` batches and, while
+            the fold of chunk ``c`` is in flight on device, ``jax.device_put``
+            of chunk ``c + 1`` streams concurrently — host-resident (numpy)
+            epochs never stall a launch waiting for a transfer. Folding in
+            chunks preserves batch order, so scan-path and count/sketch
+            states (integer-valued monoids) stay BITWISE equal to the
+            unchunked launch; merge-fold float sums may reassociate by an
+            ulp exactly as the flat-vs-vmap paths already may. The chunked
+            program traces once per distinct chunk shape (a ragged final
+            chunk costs one extra trace).
 
     Exactly-once resume:
         ``epoch`` accepts two reserved keyword arguments, ``resume_from``
@@ -497,13 +654,26 @@ def make_epoch(
     from metrics_tpu.collections import MetricCollection
     from metrics_tpu.wrappers.abstract import WrapperMetric
 
+    if prefetch is not None and (not isinstance(prefetch, int) or prefetch < 1):
+        raise ValueError(f"`prefetch` must be a positive int (batches per chunk) or None, got {prefetch!r}")
+
     if isinstance(metric, MetricCollection):
         # whole-collection fusion: one launch per epoch for every member,
         # update dedup across compute-grouped members, shared input pass
         if init_args or init_kwargs:
             raise TypeError("make_epoch(collection) takes no extra args; configure the collection itself")
+        if sharded_state or hierarchical_sync:
+            raise ValueError(
+                "sharded_state/hierarchical_sync are per-metric knobs: build per-member epochs"
+                " (one make_epoch per sharded metric) instead of a fused collection epoch."
+            )
         return make_collection_epoch(
-            metric, axis_name=axis_name, with_values=with_values, jit_epoch=jit_epoch, engine=engine
+            metric,
+            axis_name=axis_name,
+            with_values=with_values,
+            jit_epoch=jit_epoch,
+            engine=engine,
+            prefetch=prefetch,
         )
 
     # construct a class argument ONCE and hand the instance to make_step
@@ -519,7 +689,13 @@ def make_epoch(
         reductions = dict(metric._reductions)
 
     init, step, compute = make_step(
-        metric, *init_args, axis_name=axis_name, with_value=with_values, **init_kwargs
+        metric,
+        *init_args,
+        axis_name=axis_name,
+        with_value=with_values,
+        sharded_state=sharded_state,
+        hierarchical_sync=hierarchical_sync,
+        **init_kwargs,
     )
 
     def _split(batches: tuple, kw_batches: dict):
@@ -629,6 +805,8 @@ def make_epoch(
             leaves = list(batches) + list(kw_batches.values())
             n_batches = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
             _obs_epoch_launch(_epoch_label, n_batches)
+            if prefetch is not None:
+                return _run_prefetched(jitted, state, batches, kw_batches, prefetch, with_values)
             return jitted(state, *batches, **kw_batches)
 
         # keep the jitted-callable surface usable through the accounting
@@ -658,6 +836,8 @@ def make_epoch(
                 batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
                 if done:
                     return state, None
+            if prefetch is not None:
+                return _run_prefetched(_inner_epoch, state, batches, kw_batches, prefetch, with_values)
             return _inner_epoch(state, *batches, **kw_batches)
 
     return init, epoch, compute
@@ -669,6 +849,8 @@ def make_stream_step(
     axis_name: Optional[Union[str, Tuple[str, ...]]] = None,
     jit_step: bool = True,
     engine: Any = None,
+    sharded_state: bool = False,
+    hierarchical_sync: bool = False,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, stream_step, compute)`` from a windowed/decayed metric:
     one launch folds a batch AND emits the current window value.
@@ -697,6 +879,12 @@ def make_stream_step(
             forces the un-jitted step, ``"aot"`` resolves the step through
             the persistent program store (``stream_step.precompile`` is
             then exposed for ahead-of-traffic warmup).
+        sharded_state / hierarchical_sync: as :func:`make_step`, applied to
+            the BASE metric's mesh sync — a windowed ``StreamingAUROC``'s
+            per-step window value then computes from reduce-scattered bins
+            with no replicated merge. For host-resident streams, feed the
+            loop through :func:`prefetch_to_device` so the next batch's
+            transfer overlaps the current launch.
 
     The carry is a plain state pytree (ring position and in-slot counter
     ride as traced int32 scalars), so a monitoring loop can checkpoint it
@@ -732,7 +920,9 @@ def make_stream_step(
             f"make_stream_step expects a WindowedMetric or DecayedMetric instance, got"
             f" {type(metric).__name__}. Wrap the base metric first (metrics_tpu.streaming)."
         )
-    init, step, compute = make(metric, axis_name)
+    init, step, compute = make(
+        metric, axis_name, sharded_state=sharded_state, hierarchical_sync=hierarchical_sync
+    )
 
     obs_name = f"{type(metric).__name__}[{type(metric._worker).__name__}]"
     _step_label = f"{obs_name}.stream_step"
@@ -792,12 +982,104 @@ def make_stream_step(
     return init, stream_step, compute
 
 
+def prefetch_to_device(batches: Any, size: int = 2) -> Any:
+    """Generator: ``jax.device_put`` up to ``size`` batches AHEAD of the
+    consumer — the streaming-loop arm of ``make_epoch(prefetch=K)``.
+
+    Wrap any iterable of batches (tuples/dicts/pytrees of host numpy or
+    device arrays) feeding a :func:`make_stream_step` (or hand-written)
+    loop::
+
+        for preds, target in prefetch_to_device(batch_stream, size=2):
+            state, value = stream_step(state, preds, target)
+
+    While the current ``stream_step`` launch executes, the next batch's
+    host-to-device transfer is already streaming (jax's async dispatch —
+    ``device_put`` returns immediately), so the input pipeline never
+    stalls a launch. ``size`` bounds the transfers in flight (device
+    memory held ahead of consumption).
+    """
+    # validate EAGERLY (this outer function is not a generator), so a bad
+    # `size` raises at the call site, not at the first iteration
+    if not isinstance(size, int) or size < 1:
+        raise ValueError(f"`size` must be a positive int, got {size!r}")
+
+    def _generate() -> Any:
+        import collections
+
+        def _put(batch: Any) -> Any:
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a) if _is_host_batch_leaf(a) else a, batch
+            )
+
+        queue: Any = collections.deque()
+        for batch in batches:
+            queue.append(_put(batch))
+            if len(queue) >= size:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    return _generate()
+
+
+def overlap_epoch_sync(
+    epoch: Callable,
+    sync: Callable,
+    state: State,
+    chunks: Any,
+) -> Tuple[State, list]:
+    """Fold chunks while each previous chunk's sync collective is in flight.
+
+    The async arm of the topology-aware sync: ``sync`` (a compiled mesh
+    reduction — typically the ``compute`` of a ``make_epoch(...,
+    axis_name=..., hierarchical_sync=True)`` factory wrapped in the
+    caller's ``shard_map``/pjit program, or any pure jitted
+    state-to-snapshot function) is ISSUED on chunk ``N``'s folded state and
+    NOT waited on; the fold of chunk ``N + 1`` dispatches immediately
+    after, so the collective for batch ``N`` rides the fabric while the
+    device folds batch ``N + 1`` (jax async dispatch — the driver never
+    blocks). Folding is pure, so reading state ``N`` while state ``N + 1``
+    is being produced is race-free by construction.
+
+    Args:
+        epoch: ``epoch(state, *chunk) -> (state', _)`` from
+            :func:`make_epoch` (or any pure fold).
+        sync: ``sync(state) -> snapshot`` — the reduction to overlap.
+        state: initial carry.
+        chunks: iterable of per-chunk ``*batches`` tuples.
+
+    Returns:
+        ``(final_state, snapshots)`` — one un-blocked snapshot per chunk
+        (jax arrays are futures; block when consuming, e.g.
+        ``jax.block_until_ready(snapshots[-1])``).
+
+    Note:
+        Safe with a donated epoch carry: the snapshot's collective is
+        ENQUEUED before the donating fold of the next chunk, so on the
+        device stream it reads state ``N`` before the fold that reuses its
+        buffers executes.
+    """
+    snapshots: list = []
+    for chunk in chunks:
+        if not isinstance(chunk, tuple):
+            chunk = (chunk,)
+        state, _ = epoch(state, *chunk)
+        # issue the collective for THIS chunk's state; the next loop
+        # iteration's fold dispatches without waiting on it
+        snapshots.append(sync(state))
+    return state, snapshots
+
+
 def _windowed_fold(reductions: Dict[str, str], slots: State) -> State:
     return {name: _FOLD_OPS[red](slots[name]) for name, red in reductions.items()}
 
 
 def _make_windowed_stream_step(
-    metric: Any, axis_name: Optional[Union[str, Tuple[str, ...]]]
+    metric: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    sharded_state: bool = False,
+    hierarchical_sync: bool = False,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """WindowedMetric as a pure step: the carry is ``{"slots": ring of K
     state shards, "pos", "in_slot"}``; each step merges the batch
@@ -807,7 +1089,13 @@ def _make_windowed_stream_step(
     k = metric.window
     ups = metric.updates_per_slot
     reductions = dict(metric._base_reductions)
-    base_init, base_step, base_compute = make_step(metric._worker, axis_name=axis_name, with_value=False)
+    base_init, base_step, base_compute = make_step(
+        metric._worker,
+        axis_name=axis_name,
+        with_value=False,
+        sharded_state=sharded_state,
+        hierarchical_sync=hierarchical_sync,
+    )
 
     def _stack_slots(one: State) -> State:
         return {
@@ -862,7 +1150,10 @@ def _make_windowed_stream_step(
 
 
 def _make_decayed_stream_step(
-    metric: Any, axis_name: Optional[Union[str, Tuple[str, ...]]]
+    metric: Any,
+    axis_name: Optional[Union[str, Tuple[str, ...]]],
+    sharded_state: bool = False,
+    hierarchical_sync: bool = False,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """DecayedMetric as a pure step: the carry is the base state (int sum
     states lifted to f32 — decayed counts are fractional); each step scales
@@ -870,7 +1161,13 @@ def _make_decayed_stream_step(
     base compute of the decayed state."""
     decay = metric.decay
     reductions = dict(metric._base_reductions)
-    base_init, base_step, base_compute = make_step(metric._worker, axis_name=axis_name, with_value=False)
+    base_init, base_step, base_compute = make_step(
+        metric._worker,
+        axis_name=axis_name,
+        with_value=False,
+        sharded_state=sharded_state,
+        hierarchical_sync=hierarchical_sync,
+    )
 
     def _lift(state: State) -> State:
         return {
@@ -1520,6 +1817,7 @@ def make_collection_epoch(
     with_values: bool = False,
     jit_epoch: bool = True,
     engine: Any = None,
+    prefetch: Optional[int] = None,
 ) -> Tuple[Callable[[], State], Callable[..., Tuple[State, Any]], Callable[[State], Any]]:
     """Build ``(init, epoch, compute)`` folding a WHOLE collection's epoch in
     ONE jitted launch.
@@ -1565,6 +1863,9 @@ def make_collection_epoch(
             forces the un-jitted path, ``"aot"`` resolves the fused epoch
             (and the fused compute) through the persistent program store;
             ``epoch.precompile`` is then exposed for warmup.
+        prefetch: double-buffered host-to-device streaming as
+            :func:`make_epoch` — chunk ``c + 1``'s ``jax.device_put``
+            overlaps chunk ``c``'s in-flight fused fold.
 
     Exactly-once resume:
         ``epoch`` accepts the same reserved ``resume_from=`` /
@@ -1604,6 +1905,8 @@ def make_collection_epoch(
             f"make_collection_epoch expects a MetricCollection, got {type(collection).__name__};"
             " use make_epoch for a single metric."
         )
+    if prefetch is not None and (not isinstance(prefetch, int) or prefetch < 1):
+        raise ValueError(f"`prefetch` must be a positive int (batches per chunk) or None, got {prefetch!r}")
 
     plan = _collection_fusion_plan(collection, axis_name, with_values)
     children, groupable = plan["children"], plan["groupable"]
@@ -1777,6 +2080,8 @@ def make_collection_epoch(
             leaves = list(batches) + list(kw_batches.values())
             n_batches = next((a.shape[0] for a in leaves if getattr(a, "ndim", 0) >= 1), None)
             _obs_epoch_launch(_epoch_label, n_batches)
+            if prefetch is not None:
+                return _run_prefetched(jitted, state, batches, kw_batches, prefetch, with_values)
             return jitted(state, *batches, **kw_batches)
 
         epoch.__wrapped__ = raw_jitted
@@ -1799,6 +2104,8 @@ def make_collection_epoch(
                 batches, kw_batches, done = _apply_resume(resume_from, epoch_index, batches, kw_batches)
                 if done:
                     return state, None
+            if prefetch is not None:
+                return _run_prefetched(_inner_epoch, state, batches, kw_batches, prefetch, with_values)
             return _inner_epoch(state, *batches, **kw_batches)
 
     # dynamic-count states (CapacityBuffer, cat lists) need concrete fill

@@ -182,3 +182,39 @@ class StreamingQuantile(Metric):
         """Per-query half-width of :meth:`bounds`."""
         lo, hi = self.bounds()
         return (hi - lo) / 2.0
+
+
+# ---------------------------------------------------------------------------
+# Sharded (gather-free) computes — make_step(..., sharded_state=True)
+# ---------------------------------------------------------------------------
+# Registered here, beside the classes: the kernels reduce-scatter the
+# merged sketch bins over the mesh axis (each device keeps its 1/n bin
+# slice resident — no full merged replica ever exists) and finish with
+# segment-local math plus scalar collectives. See
+# metrics_tpu/utilities/sharding.py for the kernel contracts.
+from metrics_tpu.utilities.sharding import (  # noqa: E402
+    register_sharded_compute as _register_sharded_compute,
+    sharded_sketch_auroc as _sharded_sketch_auroc,
+    sharded_sketch_average_precision as _sharded_sketch_ap,
+    sharded_sketch_quantile as _sharded_sketch_quantile,
+)
+
+
+def _streaming_auroc_sharded(worker: StreamingAUROC, state: dict, axis_name: Any) -> Array:
+    lo, hi = _sharded_sketch_auroc(state["sketch"], axis_name)
+    return (lo + hi) / 2.0
+
+
+def _streaming_ap_sharded(worker: StreamingAveragePrecision, state: dict, axis_name: Any) -> Array:
+    lo, hi = _sharded_sketch_ap(state["sketch"], axis_name)
+    return (lo + hi) / 2.0
+
+
+def _streaming_quantile_sharded(worker: StreamingQuantile, state: dict, axis_name: Any) -> Array:
+    out = _sharded_sketch_quantile(state["sketch"], jnp.asarray(worker.q), axis_name)
+    return out[0] if worker._scalar_q else out
+
+
+_register_sharded_compute(StreamingAUROC, _streaming_auroc_sharded)
+_register_sharded_compute(StreamingAveragePrecision, _streaming_ap_sharded)
+_register_sharded_compute(StreamingQuantile, _streaming_quantile_sharded)

@@ -62,6 +62,14 @@ class Sketch:
       (:func:`metrics_tpu.utilities.distributed.sync_sketch_in_context`).
     * ``_config_fields`` — static Python aux (bin counts, ranges); two
       sketches merge only when their configs are equal.
+    * ``_shard_dims`` — the declarative sharding spec: ``{leaf_name: dim}``
+      naming which dimension of a leaf distributes over a mesh axis.
+      Consumed by :func:`metrics_tpu.utilities.sharding.state_named_shardings`
+      (the pjit layout) and
+      :func:`~metrics_tpu.utilities.sharding.shard_sketch_in_context` (the
+      reduce-scatter sync that leaves each device holding its bin slice
+      instead of a full merged replica). Leaves absent from the mapping
+      (extremes, scalars) stay replicated.
 
     The flatten/unflatten protocol intentionally accepts leaves of any
     shape: ``vmap``/``make_epoch`` stack a leading batch axis onto every
@@ -70,6 +78,7 @@ class Sketch:
 
     _leaf_fields: Tuple[Tuple[str, str], ...] = ()
     _config_fields: Tuple[str, ...] = ()
+    _shard_dims: Dict[str, int] = {}
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
         super().__init_subclass__(**kwargs)
@@ -262,6 +271,8 @@ class QuantileSketch(Sketch):
 
     _leaf_fields = (("counts", "sum"), ("minv", "min"), ("maxv", "max"))
     _config_fields = ("num_bins", "lo", "hi")
+    # bins distribute over the mesh; the exact min/max scalars replicate
+    _shard_dims = {"counts": 0}
 
     def __init__(self, num_bins: int = 1024, lo: float = 0.0, hi: float = 1.0) -> None:
         if num_bins < 1:
@@ -387,6 +398,8 @@ class ScoreLabelSketch(Sketch):
 
     _leaf_fields = (("pos", "sum"), ("neg", "sum"))
     _config_fields = ("num_bins",)
+    # both label histograms distribute bin-wise over the mesh
+    _shard_dims = {"pos": 0, "neg": 0}
 
     def __init__(self, num_bins: int = 2048) -> None:
         if num_bins < 2:

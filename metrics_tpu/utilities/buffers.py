@@ -48,7 +48,19 @@ class CapacityBuffer:
     sketch state (:mod:`metrics_tpu.streaming.sketches`): a few KB of
     summary regardless of stream length, with a documented error bound vs
     this exact-sample path (``docs/streaming.md``).
+
+    Sharding: buffer ROWS (``SHARD_DIM`` = the sample axis) distribute
+    over a mesh — ``Metric.add_state`` derives a dim-0
+    :class:`~metrics_tpu.utilities.sharding.StateShardSpec` for every
+    buffer state automatically, so ``state_shardings()`` keeps the rows
+    mesh-resident under pjit and ``make_step(sharded_state=True)``
+    computes over the resident shards with a ring pass instead of the
+    materialized ``sync_buffer_in_context`` gather
+    (:func:`metrics_tpu.utilities.sharding.sharded_sample_auroc`).
     """
+
+    #: the dimension that distributes over a mesh axis (samples/rows)
+    SHARD_DIM = 0
 
     def __init__(self, capacity: int, dtype: Any = None) -> None:
         if capacity <= 0:

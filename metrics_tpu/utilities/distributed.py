@@ -267,6 +267,65 @@ def replicate_typed(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> Array:
     return lax.pmax(x, axis_name)
 
 
+def reduce_scatter_in_context(
+    x: Array, axis_name: Union[str, Tuple[str, ...]], dim: int = 0
+) -> Array:
+    """Sum-reduce ``x`` over the axis AND shard the result along ``dim``.
+
+    ``lax.psum_scatter(tiled=True)``: device ``i`` ends holding slice ``i``
+    of the axis-sum — the sharded-state alternative to ``psum``, moving 1x
+    payload on an ICI ring (an all-reduce moves ~2x) and leaving each
+    device with ``1/n`` of the state resident instead of a full replica.
+    ``x.shape[dim]`` must divide evenly by the axis size (pad the operand
+    first otherwise — see ``utilities.sharding.shard_sketch_in_context``).
+
+    The output is device-varying by construction (each device holds a
+    DIFFERENT slice); consume it with the sharded compute kernels in
+    :mod:`metrics_tpu.utilities.sharding`, or restore a full replica with
+    an ``all_gather`` (at which point plain ``psum`` was cheaper).
+    """
+    nbytes = x.size * x.dtype.itemsize if hasattr(x, "size") else 0
+    x = _apply_seam(x, "psum_scatter", axis_name)
+    _obs_count_collective("psum_scatter", nbytes)
+    return lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def hierarchical_reduce_in_context(
+    x: Array,
+    reduce_fx: Union[str, Callable, None],
+    axis_names: Sequence[str],
+    typed: str = "invariant",
+) -> Array:
+    """Topology-ordered reduction: one collective per mesh axis, in order.
+
+    A flat ``psum(x, ("ici", "dcn"))`` leaves the reduction schedule to the
+    compiler; this chain makes the topology explicit — reduce over
+    ``axis_names[0]`` FIRST (pass the ICI/intra-slice axis there, so the
+    fast fabric combines first and the slow DCN hop moves one
+    already-reduced operand), then each following axis in order. For
+    ``sum``/``max``/``min`` the chain is exactly the flat reduction (the
+    monoid is associative); ``mean`` is exact on rectangular meshes (every
+    sub-group the same size — true for named mesh axes by construction).
+
+    Each hop runs through :func:`sync_reduce_in_context`, so the
+    ``set_collective_seam`` hook and the ``sync.collectives`` /
+    ``sync.payload_bytes`` counters observe every per-axis collective in
+    issue order — the MULTICHIP harness measures the ICI-vs-DCN split
+    directly. Gather-typed reductions (``cat``/None/callable) do not chain
+    (concatenation order would depend on the axis split); they fall back
+    to one flat gather over all the axes.
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    if reduce_fx not in _SUM_LIKE and reduce_fx not in ("max", "min"):
+        # gather-typed: device order of the concatenation must match the
+        # flat gather's — one collective over the full axis set
+        return sync_reduce_in_context(x, reduce_fx, tuple(axis_names), typed=typed)
+    for axis in axis_names:
+        x = sync_reduce_in_context(x, reduce_fx, axis, typed=typed)
+    return x
+
+
 def ring_allreduce(x: Array, axis_name: str, op: Callable[[Array, Array], Array] = jnp.add) -> Array:
     """Manual ring all-reduce via ``lax.ppermute`` (ring-attention pattern).
 
@@ -302,7 +361,10 @@ def ring_allreduce(x: Array, axis_name: str, op: Callable[[Array, Array], Array]
 
 
 def sync_sketch_in_context(
-    sketch: Any, axis_name: Union[str, Tuple[str, ...]], typed: str = "invariant"
+    sketch: Any,
+    axis_name: Union[str, Tuple[str, ...]],
+    typed: str = "invariant",
+    hierarchical: bool = False,
 ) -> Any:
     """Merge per-device sketch summaries inside shard_map/pmap.
 
@@ -315,11 +377,17 @@ def sync_sketch_in_context(
     the payload is the fixed sketch size (a few KB) — never a gather of
     samples. psum-family collectives are invariant-typed on every path, so
     ``typed`` only matters if a future sketch declares a gather-typed leaf.
+    ``hierarchical=True`` with a multi-axis ``axis_name`` reduces each leaf
+    one axis at a time in the given order (ICI-first — see
+    :func:`hierarchical_reduce_in_context`); the merged state is identical
+    by the monoid's associativity.
     """
-    reduced = {
-        name: sync_reduce_in_context(getattr(sketch, name), red, axis_name, typed=typed)
-        for name, red in sketch._leaf_fields
-    }
+    reduce_one = (
+        (lambda leaf, red: hierarchical_reduce_in_context(leaf, red, axis_name, typed=typed))
+        if hierarchical
+        else (lambda leaf, red: sync_reduce_in_context(leaf, red, axis_name, typed=typed))
+    )
+    reduced = {name: reduce_one(getattr(sketch, name), red) for name, red in sketch._leaf_fields}
     return sketch._replace_leaves(**reduced)
 
 
@@ -435,6 +503,67 @@ def sync_buffer_in_context(buf: Any, axis_name: Union[str, Tuple[str, ...]], typ
 # Eager cross-process gather (DCN / multi-host, host-side states)
 # ---------------------------------------------------------------------------
 
+# One eager DCN collective above this payload is split into dim-0 chunks:
+# a monolithic multi-hundred-MB process_allgather holds the host network's
+# buffers (and any retry policy's timeout budget) hostage to its slowest
+# fragment, while chunked gathers bound each collective, keep peak host
+# staging memory at chunk size x world, and give the retry watchdog a
+# meaningful per-collective unit. Chunk boundaries derive from the
+# (already-gathered) agreed shapes, so every process issues the same
+# collective sequence.
+_GATHER_CHUNK_BYTES: Optional[int] = 64 * 1024 * 1024
+
+
+def configure_gather_chunking(max_bytes: Optional[int] = 64 * 1024 * 1024) -> Optional[int]:
+    """Set the eager DCN gather's per-collective payload cap (bytes).
+
+    Payloads above the cap are gathered as multiple dim-0 chunks (counted
+    under ``sync.gather_chunks``; per-chunk bytes under
+    ``sync.payload_bytes{op=process_allgather_chunk}``). Pass ``None`` to
+    disable chunking (the monolithic pre-round-15 behaviour). Returns the
+    previous cap. Must be set identically on every process — the chunk
+    schedule is part of the collective sequence.
+    """
+    global _GATHER_CHUNK_BYTES
+    if max_bytes is not None and (not isinstance(max_bytes, int) or max_bytes <= 0):
+        raise ValueError(f"max_bytes must be a positive int or None, got {max_bytes!r}")
+    previous = _GATHER_CHUNK_BYTES
+    _GATHER_CHUNK_BYTES = max_bytes
+    return previous
+
+
+def _process_allgather_chunked(x: Array) -> Array:
+    """``multihost_utils.process_allgather`` with the >cap payload split
+    into dim-0 chunks (see :func:`configure_gather_chunking`).
+
+    The chunk count is a pure function of the operand's shape/dtype and the
+    cap — both identical on every process by the time this runs (equal
+    shapes, or the pad-to-max path already agreed on ``max_size``) — so all
+    processes issue matching collectives. Returns the stacked ``(P, *shape)``
+    result either way.
+    """
+    from jax.experimental import multihost_utils
+
+    limit = _GATHER_CHUNK_BYTES
+    nbytes = x.size * x.dtype.itemsize
+    if limit is None or nbytes <= limit or x.ndim == 0 or x.shape[0] <= 1:
+        return multihost_utils.process_allgather(x)
+    n_chunks = min(x.shape[0], -(-nbytes // limit))  # ceil-div, capped by rows
+    bounds = [round(i * x.shape[0] / n_chunks) for i in range(n_chunks + 1)]
+    if _obs_enabled():
+        _obs_inc("sync.gather_chunks", float(n_chunks))
+    parts = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        chunk = x[lo:hi]
+        if _obs_enabled():
+            _obs_inc(
+                "sync.payload_bytes",
+                float(chunk.size * chunk.dtype.itemsize),
+                op="process_allgather_chunk",
+            )
+        parts.append(multihost_utils.process_allgather(chunk))
+    return jnp.concatenate(parts, axis=1)  # parts are (P, chunk_rows, ...)
+
 
 def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
     """All-gather an array across JAX processes, handling uneven dim-0 shapes.
@@ -532,11 +661,11 @@ def _gather_all_tensors_impl(result: Array) -> List[Array]:
     max_size = tuple(int(s) for s in all_sizes.max(axis=0))
     all_equal = bool((all_sizes == all_sizes[0]).all())
     if all_equal:
-        gathered = multihost_utils.process_allgather(result)
+        gathered = _process_allgather_chunked(result)
         return [gathered[i] for i in range(gathered.shape[0])]
     pad_width = [(0, m - s) for m, s in zip(max_size, result.shape)]
     padded = jnp.pad(result, pad_width)
-    gathered = multihost_utils.process_allgather(padded)
+    gathered = _process_allgather_chunked(padded)
     out = []
     for i in range(gathered.shape[0]):
         slices = tuple(slice(0, int(d)) for d in all_sizes[i])

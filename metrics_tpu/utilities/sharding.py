@@ -1,0 +1,562 @@
+"""Mesh-native sharded metric state: declarative specs + gather-free compute.
+
+The replicated in-jit sync model (``sync_reduce_in_context`` /
+``sync_sketch_in_context`` / ``sync_buffer_in_context``) ends every
+``compute()`` with a FULL copy of each state on every device — a psum
+all-reduce for sketch bins (2x payload on an ICI ring), a materialized
+all-gather for sample buffers (n_dev x HBM at the root of the sort). At pod
+scale that is exactly the wrong shape: the state should stay RESIDENT
+across the mesh, and ``compute()`` should reduce in place.
+
+This module is the sharded-state execution path:
+
+* :class:`StateShardSpec` — the declarative per-state sharding spec
+  consumed by :meth:`metrics_tpu.Metric.add_state`. Sketches declare
+  per-leaf shard dims (``Sketch._shard_dims``); ``CapacityBuffer`` rows
+  shard along dim 0 by construction.
+* :func:`state_named_shardings` — the pjit surface: the spec lowered to a
+  ``NamedSharding`` pytree matching ``Metric.state_pytree()``, so a pjit
+  program (or ``jax.device_put``) keeps buffer rows and sketch bins
+  mesh-resident between folds with no code change to the metric.
+* :func:`shard_sketch_in_context` — the sharded in-jit sync: ``sum``
+  leaves **reduce-scatter** over the mesh axis (1x ring payload, each
+  device left holding its 1/n bin slice; a psum all-reduce would move 2x
+  and replicate), extremes psum-family as before.
+* sharded compute kernels (:func:`sharded_sketch_auroc`,
+  :func:`sharded_sketch_average_precision`, :func:`sharded_sketch_quantile`,
+  :func:`sharded_sample_auroc`) — segment-local partial computation plus
+  scalar-sized collectives, so no full state is ever materialized on one
+  device. The sample-buffer AUROC replaces the gather with a
+  ``lax.ppermute`` ring pass: each device's buffer transits the ring once
+  (same total bytes as an all-gather) but peak HBM stays O(capacity), not
+  O(n_dev * capacity).
+* :func:`register_sharded_compute` — the registry ``make_step(...,
+  sharded_state=True)`` resolves a metric's gather-free compute from
+  (built-ins registered by ``streaming/metrics.py`` and
+  ``classification/auroc.py``).
+
+Correctness contract: every kernel consumes the SAME folded states as the
+replicated path — the reduce-scatter of integer-valued bin counts equals
+the psum slice-for-slice bitwise (the sketch monoid's fold-order
+invariance), which ``tests/bases/test_sharded_state.py`` pins across mesh
+sizes and device permutations. Metric VALUES agree with the replicated
+compute to f32 summation order (exactly, while partial products stay
+integer-representable).
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.streaming.sketches import QuantileSketch, ScoreLabelSketch, Sketch
+from metrics_tpu.utilities.buffers import CapacityBuffer
+from metrics_tpu.utilities.distributed import (
+    _all_gather,
+    _axis_size,
+    _obs_count_collective,
+    reduce_scatter_in_context,
+    sync_reduce_in_context,
+)
+
+Array = jax.Array
+
+__all__ = [
+    "StateShardSpec",
+    "REPLICATED",
+    "get_sharded_compute",
+    "register_sharded_compute",
+    "shard_sketch_in_context",
+    "sharded_sample_auroc",
+    "sharded_sketch_auroc",
+    "sharded_sketch_average_precision",
+    "sharded_sketch_quantile",
+    "state_named_shardings",
+]
+
+
+class StateShardSpec:
+    """Declarative per-state sharding: leaves shard along ``dim`` over the
+    sync mesh axis.
+
+    Passed to :meth:`metrics_tpu.Metric.add_state(shard_spec=...)`. The
+    spec is LAYOUT, not protocol: it declares which dimension of the
+    state's arrays is distributable, and both consumers derive from it —
+    :func:`state_named_shardings` builds the pjit ``NamedSharding`` that
+    keeps the state mesh-resident, and the ``sharded_state=True`` compute
+    path reduce-scatters along it. ``dim=None`` (:data:`REPLICATED`)
+    declares the state must stay a full replica (the default for states
+    without a spec).
+    """
+
+    __slots__ = ("dim",)
+
+    def __init__(self, dim: Optional[int] = 0) -> None:
+        if dim is not None and (not isinstance(dim, int) or dim < 0):
+            raise ValueError(f"`dim` must be a non-negative int or None, got {dim!r}")
+        self.dim = dim
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, StateShardSpec) and other.dim == self.dim
+
+    def __hash__(self) -> int:
+        return hash((StateShardSpec, self.dim))
+
+    def __repr__(self) -> str:
+        return f"StateShardSpec(dim={self.dim})"
+
+
+REPLICATED = StateShardSpec(dim=None)
+
+
+def _scatter_axis(axis_name: Union[str, Tuple[str, ...]]) -> str:
+    """The axis the state scatters over.
+
+    Convention: for a hierarchical multi-axis sync the FIRST axis is the
+    fast/ICI one (reduced first, see ``hierarchical_reduce_in_context``);
+    the sharded state scatters over that same first axis so the resident
+    slices live within the fast fabric, and the remaining (DCN) axes
+    combine by plain psum of the already-scattered slices.
+    """
+    if isinstance(axis_name, (tuple, list)):
+        return axis_name[0]
+    return axis_name
+
+
+def _rest_axes(axis_name: Union[str, Tuple[str, ...]]) -> Tuple[str, ...]:
+    if isinstance(axis_name, (tuple, list)):
+        return tuple(axis_name[1:])
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# pjit surface: spec -> NamedSharding pytree
+# ---------------------------------------------------------------------------
+
+
+def _axis_total(mesh: Any, axis_name: Union[str, Tuple[str, ...]]) -> int:
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    total = 1
+    for n in names:
+        total *= int(mesh.shape[n])
+    return total
+
+
+def state_named_shardings(
+    metric: Any, mesh: Any, axis_name: Union[str, Tuple[str, ...]]
+) -> Dict[str, Any]:
+    """Lower a metric's declarative shard specs to a ``NamedSharding``
+    pytree matching ``state_pytree()``.
+
+    Use it as a pjit program's ``in_shardings``/``out_shardings`` (or with
+    ``jax.device_put``) so ``CapacityBuffer`` rows and sketch bins stay
+    RESIDENT across the mesh between folds — the state never exists as a
+    single-device array. States without a spec (and leaves whose shard dim
+    does not divide by the mesh axis) come back replicated.
+
+    Example::
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        shardings = state_named_shardings(metric, mesh, "dp")
+        state = jax.device_put(metric.state_pytree(), shardings)
+        epoch = jax.jit(raw_epoch, donate_argnums=0,
+                        in_shardings=(shardings, ...), out_shardings=(shardings, ...))
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = _axis_total(mesh, axis_name)
+    replicated = NamedSharding(mesh, P())
+
+    def _dim_sharding(leaf: Any, dim: Optional[int]) -> Any:
+        if (
+            dim is None
+            or not hasattr(leaf, "ndim")
+            or leaf.ndim <= dim
+            or leaf.shape[dim] % n != 0
+        ):
+            return replicated
+        spec = [None] * leaf.ndim
+        spec[dim] = axis_name if isinstance(axis_name, str) else tuple(axis_name)
+        return NamedSharding(mesh, P(*spec))
+
+    out: Dict[str, Any] = {}
+    for name, default in metric._defaults.items():
+        value = getattr(metric, name, default)
+        spec_obj = getattr(metric, "_shard_specs", {}).get(name)
+        # an EXPLICIT spec overrides the structural defaults everywhere:
+        # REPLICATED (dim=None) pins a full replica even for buffer rows /
+        # sketch bins, an explicit dim overrides the declared one
+        if isinstance(value, Sketch):
+            dims = type(value)._shard_dims
+            children, aux = value.tree_flatten()
+            shardings = tuple(
+                _dim_sharding(
+                    child,
+                    spec_obj.dim
+                    if spec_obj is not None and dims.get(lname) is not None
+                    else dims.get(lname),
+                )
+                for (lname, _red), child in zip(value._leaf_fields, children)
+            )
+            out[name] = type(value).tree_unflatten(aux, shardings)
+        elif isinstance(value, CapacityBuffer):
+            children, aux = value.tree_flatten()
+            # children = (count,) [+ (data,)] [+ (overflowed,)]: rows shard
+            # along the declared axis (add_state stores the buffer's
+            # SHARD_DIM spec; an explicit spec overrides), the fill counter
+            # and overflow flags replicate
+            row_dim = spec_obj.dim if spec_obj is not None else CapacityBuffer.SHARD_DIM
+            shardings = tuple(
+                _dim_sharding(child, row_dim) if child is value.data else replicated
+                for child in children
+            )
+            out[name] = CapacityBuffer.tree_unflatten(aux, shardings)
+        elif isinstance(value, list):
+            out[name] = [replicated for _ in value]
+        elif spec_obj is not None:
+            out[name] = _dim_sharding(value, spec_obj.dim)
+        else:
+            out[name] = replicated
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded in-jit sketch sync: reduce-scatter instead of all-reduce
+# ---------------------------------------------------------------------------
+
+
+def shard_sketch_in_context(
+    sketch: Sketch, axis_name: Union[str, Tuple[str, ...]]
+) -> Sketch:
+    """Merge per-device sketches over the mesh, leaving each device its SLICE.
+
+    The sharded-state arm of the sketch sync: ``sum`` leaves with a
+    declared shard dim **reduce-scatter** over the (first) mesh axis — the
+    merged leaf never exists in full on any device; device ``i`` holds
+    rows ``[i*L, (i+1)*L)`` of it (padded up to a multiple of the axis
+    size with zero-count rows, which are massless and thus invisible to
+    every query). Extreme leaves (scalars) and undeclared leaves psum-family
+    as in the replicated sync. Remaining (DCN) axes of a multi-axis sync
+    combine the already-scattered slices by plain psum — the ICI-first
+    hierarchical order by construction.
+
+    Returns the sharded view: a sketch whose sharded ``sum`` leaves hold
+    only the local slice, zero-padded up to a multiple of the axis size
+    with massless rows (NOT a valid full sketch — consume it with the
+    ``sharded_sketch_*`` kernels below). Because bin counts are
+    integer-valued f32, the scattered slices equal the corresponding
+    slices of the replicated psum BITWISE — the monoid fold-order
+    invariance the tests pin across mesh permutations.
+    """
+    scatter_ax = _scatter_axis(axis_name)
+    rest = _rest_axes(axis_name)
+    n = _axis_size(scatter_ax)
+    dims = type(sketch)._shard_dims
+    out: Dict[str, Any] = {}
+    for name, red in sketch._leaf_fields:
+        leaf = getattr(sketch, name)
+        dim = dims.get(name)
+        if red == "sum" and dim is not None and hasattr(leaf, "ndim") and leaf.ndim > dim:
+            pad = (-leaf.shape[dim]) % n
+            if pad:
+                widths = [(0, 0)] * leaf.ndim
+                widths[dim] = (0, pad)
+                leaf = jnp.pad(leaf, widths)
+            leaf = reduce_scatter_in_context(leaf, scatter_ax, dim=dim)
+            for ax in rest:
+                leaf = sync_reduce_in_context(leaf, "sum", ax)
+            out[name] = leaf
+        else:
+            out[name] = sync_reduce_in_context(
+                leaf, red, tuple([scatter_ax, *rest]) if rest else scatter_ax
+            )
+    return sketch._replace_leaves(**out)
+
+
+def _shard_exclusive_above(local_total: Array, axis_name: str) -> Tuple[Array, Array]:
+    """(sum over shards with HIGHER index, sum over LOWER index) of a
+    per-shard scalar — the segment-boundary terms of a sharded suffix/prefix
+    sum. One tiny all-gather of ``n`` scalars; never the state itself."""
+    n = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    totals = _all_gather(jnp.reshape(local_total, ()), axis_name, "varying")  # (n,)
+    ranks = jnp.arange(n)
+    above = jnp.where(ranks > idx, totals, jnp.zeros((), totals.dtype)).sum()
+    below = jnp.where(ranks < idx, totals, jnp.zeros((), totals.dtype)).sum()
+    return above, below
+
+
+def _psum_all(x: Array, axis_name: Union[str, Tuple[str, ...]]) -> Array:
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    return lax.psum(x, tuple(names))
+
+
+# ---------------------------------------------------------------------------
+# Sharded sketch computes: segment-local math + scalar collectives
+# ---------------------------------------------------------------------------
+
+
+def sharded_sketch_auroc(
+    sketch: ScoreLabelSketch, axis_name: Union[str, Tuple[str, ...]]
+) -> Tuple[Array, Array]:
+    """AUROC envelope ``(lo, hi)`` with the merged bins left SHARDED.
+
+    ``shard_sketch_in_context`` reduce-scatters the pos/neg histograms;
+    each device computes its slice's contribution to ``cross = sum_b
+    neg_b * pos_above_b`` (local suffix sums plus the higher-shard totals
+    from one n-scalar gather) and the cross/same/total terms psum as
+    scalars. Equivalent to ``ScoreLabelSketch.auroc_bounds()`` on the full
+    merged sketch — exactly, while the partial products stay
+    integer-representable in f32.
+    """
+    view = shard_sketch_in_context(sketch, axis_name)
+    scatter_ax = _scatter_axis(axis_name)
+    pos_l, neg_l = view.pos, view.neg  # local bin slices, ascending score
+    p_shard = pos_l.sum()
+    pos_above_shards, _ = _shard_exclusive_above(p_shard, scatter_ax)
+    # positives strictly above each LOCAL bin: local suffix + higher shards
+    local_above = jnp.concatenate(
+        [jnp.cumsum(pos_l[::-1])[::-1][1:], jnp.zeros((1,), pos_l.dtype)]
+    )
+    pos_above = local_above + pos_above_shards
+    # the scattered slices are GLOBAL sums (already combined over any
+    # non-scatter axes and replicated there), so the scalar partials sum
+    # over the SCATTER axis only — a full-tuple psum would multiply every
+    # term by the replication factor
+    cross = lax.psum((neg_l * pos_above).sum(), scatter_ax)
+    same = lax.psum((neg_l * pos_l).sum(), scatter_ax)
+    p_total = lax.psum(p_shard, scatter_ax)
+    n_total = lax.psum(neg_l.sum(), scatter_ax)
+    pn = jnp.maximum(p_total * n_total, 1.0)
+    lo = jnp.where(p_total * n_total > 0, cross / pn, jnp.nan)
+    hi = jnp.where(p_total * n_total > 0, (cross + same) / pn, jnp.nan)
+    return lo, hi
+
+
+def sharded_sketch_average_precision(
+    sketch: ScoreLabelSketch, axis_name: Union[str, Tuple[str, ...]]
+) -> Tuple[Array, Array]:
+    """Average-precision envelope ``(lo, hi)`` from sharded bins.
+
+    Same decomposition as :func:`sharded_sketch_auroc`: per-bin Jensen /
+    chord terms (``ScoreLabelSketch.average_precision_bounds``) are local
+    math once each bin knows the positives/negatives strictly above it —
+    local suffix sums plus the higher-shard totals. Scalar psums finish.
+    """
+    view = shard_sketch_in_context(sketch, axis_name)
+    scatter_ax = _scatter_axis(axis_name)
+    p, n = view.pos, view.neg
+    pos_above_shards, _ = _shard_exclusive_above(p.sum(), scatter_ax)
+    neg_above_shards, _ = _shard_exclusive_above(n.sum(), scatter_ax)
+    pos_above = (
+        jnp.concatenate([jnp.cumsum(p[::-1])[::-1][1:], jnp.zeros((1,), p.dtype)])
+        + pos_above_shards
+    )
+    neg_above = (
+        jnp.concatenate([jnp.cumsum(n[::-1])[::-1][1:], jnp.zeros((1,), n.dtype)])
+        + neg_above_shards
+    )
+    # identical per-bin terms to average_precision_bounds, on the local slice
+    has = p > 0
+    safe_p = jnp.where(has, p, 1.0)
+    j_mid = (safe_p + 1.0) / 2.0
+    upper_terms = safe_p * (pos_above + j_mid) / jnp.maximum(pos_above + neg_above + j_mid, 1.0)
+    denom0 = jnp.maximum(pos_above + neg_above + n + 1.0, 1.0)
+    denom1 = jnp.maximum(pos_above + neg_above + n + safe_p, 1.0)
+    lower_terms = safe_p * ((pos_above + 1.0) / denom0 + (pos_above + safe_p) / denom1) / 2.0
+    zero = jnp.zeros((), jnp.float32)
+    hi_local = jnp.where(has, upper_terms, zero).sum()
+    lo_local = jnp.where(has, lower_terms, zero).sum()
+    # scatter-axis-only psums: see sharded_sketch_auroc
+    p_total = jnp.maximum(lax.psum(p.sum(), scatter_ax), 1.0)
+    hi = lax.psum(hi_local, scatter_ax) / p_total
+    lo = lax.psum(lo_local, scatter_ax) / p_total
+    nanless = lax.psum(p.sum(), scatter_ax) > 0
+    return (
+        jnp.where(nanless, jnp.clip(lo, 0.0, 1.0), jnp.nan),
+        jnp.where(nanless, jnp.clip(hi, 0.0, 1.0), jnp.nan),
+    )
+
+
+def sharded_sketch_quantile(
+    sketch: QuantileSketch,
+    q: Union[float, Sequence[float], Array],
+    axis_name: Union[str, Tuple[str, ...]],
+) -> Array:
+    """Quantile envelope midpoints from sharded bins, bitwise-equal to
+    ``QuantileSketch.quantile`` on the full merged sketch.
+
+    The merged counts reduce-scatter (padded to a multiple of the axis
+    size with massless zero rows); the rank search runs segment-locally on
+    ``exclusive_prefix + local_cumsum`` — the same integer-valued partial
+    sums the replicated global cumsum produces, so EXACTLY one shard
+    claims each query's bin, and the claimed bin index (hence the edge
+    arithmetic, identical expression for expression) matches the
+    replicated ``searchsorted`` result exactly.
+    """
+    view = shard_sketch_in_context(sketch, axis_name)
+    scatter_ax = _scatter_axis(axis_name)
+    counts_l = view.counts  # local slice of the merged (num_bins + 2 [+ pad]) counts
+    minv, maxv = view.minv, view.maxv  # replicated synced extremes
+    local_len = counts_l.shape[0]
+    shard = lax.axis_index(scatter_ax)
+    q_arr = jnp.atleast_1d(jnp.asarray(q, jnp.float32))
+
+    local_total = counts_l.sum()
+    _above, below = _shard_exclusive_above(local_total, scatter_ax)
+    local_cum = below + jnp.cumsum(counts_l)
+    total = lax.psum(local_total, scatter_ax)  # scatter-axis only: see sharded_sketch_auroc
+    rank = jnp.clip(q_arr, 0.0, 1.0) * total
+    target = jnp.maximum(rank, jnp.finfo(jnp.float32).tiny)
+    # first global bin whose cumulative mass reaches the target: exactly one
+    # shard has below < target <= its last cumulative value
+    j = jnp.searchsorted(local_cum, target, side="left")  # (Q,), == local_len when not here
+    claim = (j < local_len) & (below < target)
+    g = shard * local_len + jnp.clip(j, 0, local_len - 1)  # global bin index
+    g = jnp.clip(g, 0, sketch.num_bins + 1)
+    # edge arithmetic identical to QuantileSketch._bin_edges on index g
+    width = (sketch.hi - sketch.lo) / sketch.num_bins
+    lo_edge = jnp.where(
+        g == 0, -jnp.inf, sketch.lo + width * (g - 1).astype(jnp.float32)
+    )
+    hi_edge = jnp.where(
+        g >= sketch.num_bins + 1, jnp.inf, sketch.lo + width * g.astype(jnp.float32)
+    )
+    lo_edge = jnp.clip(lo_edge, minv, maxv)
+    hi_edge = jnp.clip(hi_edge, minv, maxv)
+    zero = jnp.zeros((), jnp.float32)
+    lo_v = lax.psum(jnp.where(claim, lo_edge, zero), scatter_ax)
+    hi_v = lax.psum(jnp.where(claim, hi_edge, zero), scatter_ax)
+    # exact extremes at the endpoints, NaN on an empty sketch — the
+    # replicated quantile()'s exact semantics
+    lo_v = jnp.where(q_arr <= 0.0, minv, jnp.where(q_arr >= 1.0, maxv, lo_v))
+    hi_v = jnp.where(q_arr <= 0.0, minv, jnp.where(q_arr >= 1.0, maxv, hi_v))
+    out = jnp.where(total > 0, (lo_v + hi_v) / 2.0, jnp.nan)
+    return out[0] if jnp.ndim(q) == 0 else out
+
+
+# ---------------------------------------------------------------------------
+# Sharded sample-buffer compute: ring pair counting (no gather, O(cap) HBM)
+# ---------------------------------------------------------------------------
+
+
+def sharded_sample_auroc(
+    preds_buf: CapacityBuffer,
+    target_buf: CapacityBuffer,
+    axis_name: Union[str, Tuple[str, ...]],
+) -> Array:
+    """Exact binary AUROC over mesh-resident sample shards — NO gather.
+
+    The replicated path all-gathers every device's ``CapacityBuffer`` and
+    sorts the concatenation: O(n_dev * capacity) HBM on every device. This
+    kernel keeps each device's rows RESIDENT and counts discordant pairs
+    with a ``lax.ppermute`` ring pass (the ring-attention schedule): each
+    hop rotates only the visiting shard's sorted negative scores one
+    neighbour around the ring, the local positives count against them with
+    two ``searchsorted`` passes (strictly-below and ties), and after
+    ``n - 1`` hops every ordered shard pair has been counted exactly once.
+    Total bytes moved equal one all-gather; peak HBM stays O(capacity).
+
+        AUROC = (#[s_pos > s_neg] + 0.5 * #[s_pos == s_neg]) / (P * N)
+
+    which is exactly the trapezoidal/tie-half convention of the exact
+    sorted path, so the value matches ``AUROC.compute()`` on the gathered
+    samples to f32 summation order. Pair counts accumulate in f32 (the
+    exact path's own cumsums are f32 too); scores must be finite.
+
+    Multi-axis syncs ring over the flattened axis tuple — one ring over
+    every participating device, so cross-slice pairs are counted too.
+    """
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    if preds_buf.data is None or target_buf.data is None:
+        # SPMD-symmetric empty buffers: no samples anywhere
+        return jnp.asarray(jnp.nan, jnp.float32)
+    cap = preds_buf.capacity
+    scores = preds_buf.data.astype(jnp.float32).reshape(cap)
+    labels = target_buf.data.reshape(cap)
+    valid = jnp.arange(cap) < preds_buf.count
+    pos_mask = valid & (labels == 1)
+    neg_mask = valid & (labels != 1)
+    # padded sorted negatives: invalid/positive slots to +inf so they sort
+    # last and never count as "below" any finite positive score
+    neg_sorted = jnp.sort(jnp.where(neg_mask, scores, jnp.inf))
+    pos_w = pos_mask.astype(jnp.float32)
+
+    def count_against(visiting_neg_sorted: Array) -> Tuple[Array, Array]:
+        below = jnp.searchsorted(visiting_neg_sorted, scores, side="left")
+        at_or_below = jnp.searchsorted(visiting_neg_sorted, scores, side="right")
+        gt = (below.astype(jnp.float32) * pos_w).sum()
+        ties = ((at_or_below - below).astype(jnp.float32) * pos_w).sum()
+        return gt, ties
+
+    # +inf doubles as the padding sentinel, so a NON-FINITE real score
+    # would silently corrupt the pair counts (the replicated sort path
+    # handles it); poison the result to NaN instead — loud, not wrong
+    finite_ok = jnp.where(valid, jnp.isfinite(scores), True).all()
+    gt_acc, tie_acc = count_against(neg_sorted)  # hop 0: local pos vs local neg
+    # one flat ring over every participating device (multi-axis syncs ride
+    # the flattened axis tuple; lax.axis_index over a tuple is the
+    # row-major linear index, matching a tuple-axis ppermute's numbering)
+    n = 1
+    for ax in names:
+        n = n * _axis_size(ax)
+    if n > 1:
+        _obs_count_collective(
+            "ring_permute", int(neg_sorted.size * neg_sorted.dtype.itemsize) * (n - 1)
+        )
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def body(_h: Array, carry: Tuple[Array, Array, Array]) -> Tuple[Array, Array, Array]:
+            gt, ties, buf = carry
+            buf = lax.ppermute(buf, tuple(names), perm)
+            g, t = count_against(buf)
+            return gt + g, ties + t, buf
+
+        gt_acc, tie_acc, _ = lax.fori_loop(0, n - 1, body, (gt_acc, tie_acc, neg_sorted))
+    p_total = _psum_all(pos_w.sum(), axis_name)
+    n_total = _psum_all(neg_mask.astype(jnp.float32).sum(), axis_name)
+    gt_total = _psum_all(gt_acc, axis_name)
+    tie_total = _psum_all(tie_acc, axis_name)
+    pn = p_total * n_total
+    bad = _psum_all(1.0 - finite_ok.astype(jnp.float32), axis_name)
+    auroc = jnp.where(pn > 0, (gt_total + 0.5 * tie_total) / jnp.maximum(pn, 1.0), jnp.nan)
+    return jnp.where(bad > 0, jnp.nan, auroc)
+
+
+# ---------------------------------------------------------------------------
+# Registry: metric class -> gather-free sharded compute
+# ---------------------------------------------------------------------------
+
+_SHARDED_COMPUTES: Dict[type, Callable] = {}
+
+
+def register_sharded_compute(metric_cls: type, fn: Callable) -> None:
+    """Register the gather-free compute for a metric class.
+
+    ``fn(worker, state, axis_name) -> value`` runs INSIDE the mesh program
+    in place of the replicated sync + ``compute()``: ``worker`` is the
+    loaded metric instance (for static config — ``q``, ``mode``, bins),
+    ``state`` the UNSYNCED per-device state pytree, and the contract is
+    that ``fn`` reduces over ``axis_name`` itself using only
+    scatter/segment/scalar collectives — never a materialized full-state
+    gather. Resolution walks the MRO, so a subclass inherits its base's
+    kernel unless it registers its own.
+
+    Built-ins are registered by the modules that own the metric classes
+    (``streaming/metrics.py``, ``classification/auroc.py``).
+    """
+    if not isinstance(metric_cls, type):
+        raise ValueError(f"metric_cls must be a class, got {metric_cls!r}")
+    if not callable(fn):
+        raise ValueError("`fn` must be callable")
+    _SHARDED_COMPUTES[metric_cls] = fn
+
+
+def get_sharded_compute(metric_cls: type) -> Optional[Callable]:
+    """The registered sharded compute for ``metric_cls`` (MRO-resolved), or
+    ``None``."""
+    for cls in metric_cls.__mro__:
+        fn = _SHARDED_COMPUTES.get(cls)
+        if fn is not None:
+            return fn
+    return None

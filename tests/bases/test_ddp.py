@@ -291,3 +291,167 @@ def test_replicate_typed_bool(mesh):
     out = np.asarray(fn(jnp.asarray(x)))
     assert out.dtype == np.bool_
     assert out.all()
+
+
+class TestChunkedGather:
+    """>cap eager DCN payloads gather as dim-0 chunks (round 15 satellite).
+
+    The multi-process backend is mocked exactly as in
+    ``test_gather_all_tensors_uneven``: ``process_allgather`` stacks what
+    each rank would contribute, so the chunk schedule, the concat and the
+    counters run for real.
+    """
+
+    def test_multi_chunk_roundtrip_even_shapes(self, monkeypatch):
+        import metrics_tpu.utilities.distributed as dist_mod
+
+        rng = np.random.default_rng(0)
+        rank_arrays = [
+            jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32)) for _ in range(2)
+        ]
+        world = 2
+        chunks_seen = []
+
+        def fake_allgather(x):
+            # record every collective's payload shape; emulate the gather
+            chunks_seen.append(tuple(x.shape))
+            if len(chunks_seen) == 1:  # shape gather
+                return jnp.stack(
+                    [jnp.asarray(a.shape, dtype=jnp.int32) for a in rank_arrays]
+                )
+            lo = fake_allgather.offset
+            hi = lo + x.shape[0]
+            fake_allgather.offset = hi
+            return jnp.stack([a[lo:hi] for a in rank_arrays])
+
+        fake_allgather.offset = 0
+
+        class FakeMHU:
+            process_allgather = staticmethod(fake_allgather)
+
+        monkeypatch.setattr(jax, "process_count", lambda: world)
+        monkeypatch.setattr("jax.experimental.multihost_utils", FakeMHU)
+        # 64 * 4 * 4 bytes = 1 KiB per rank; cap at 300 bytes -> 4 chunks
+        prev = dist_mod.configure_gather_chunking(300)
+        try:
+            out = dist_mod.gather_all_tensors(rank_arrays[0])
+        finally:
+            dist_mod.configure_gather_chunking(prev)
+        assert len(out) == world
+        for got, want in zip(out, rank_arrays):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # shape gather + ceil(1024/300) = 4 data chunks
+        assert len(chunks_seen) == 1 + 4, chunks_seen
+        assert sum(s[0] for s in chunks_seen[1:]) == 64
+
+    def test_multi_chunk_roundtrip_uneven_shapes(self, monkeypatch):
+        import metrics_tpu.utilities.distributed as dist_mod
+
+        rng = np.random.default_rng(1)
+        rank_arrays = [
+            jnp.asarray(rng.normal(size=(48, 4)).astype(np.float32)),
+            jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32)),
+        ]
+        calls = []
+
+        def fake_allgather(x):
+            calls.append(tuple(x.shape))
+            if len(calls) == 1:
+                return jnp.stack(
+                    [jnp.asarray(a.shape, dtype=jnp.int32) for a in rank_arrays]
+                )
+            lo = fake_allgather.offset
+            hi = lo + x.shape[0]
+            fake_allgather.offset = hi
+            out = []
+            for a in rank_arrays:
+                padded = jnp.pad(a, [(0, 64 - a.shape[0]), (0, 0)])
+                out.append(padded[lo:hi])
+            return jnp.stack(out)
+
+        fake_allgather.offset = 0
+
+        class FakeMHU:
+            process_allgather = staticmethod(fake_allgather)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr("jax.experimental.multihost_utils", FakeMHU)
+        prev = dist_mod.configure_gather_chunking(512)
+        try:
+            out = dist_mod.gather_all_tensors(rank_arrays[0])
+        finally:
+            dist_mod.configure_gather_chunking(prev)
+        # trimmed back to each rank's true shape after the chunked gather
+        assert [tuple(o.shape) for o in out] == [(48, 4), (64, 4)]
+        for got, want in zip(out, rank_arrays):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert len(calls) > 2  # genuinely chunked
+
+    def test_chunk_counters(self, monkeypatch):
+        import metrics_tpu.obs as obs
+        import metrics_tpu.utilities.distributed as dist_mod
+
+        rank_arrays = [jnp.ones((32, 8), jnp.float32) for _ in range(2)]
+        offsets = [0]
+
+        def fake_allgather(x):
+            if x.dtype == jnp.int32 and x.ndim == 1:  # shape gather
+                return jnp.stack(
+                    [jnp.asarray(a.shape, dtype=jnp.int32) for a in rank_arrays]
+                )
+            lo = offsets[0]
+            offsets[0] = lo + x.shape[0]
+            return jnp.stack([a[lo : offsets[0]] for a in rank_arrays])
+
+        class FakeMHU:
+            process_allgather = staticmethod(fake_allgather)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr("jax.experimental.multihost_utils", FakeMHU)
+        obs.enable()
+        prev = dist_mod.configure_gather_chunking(256)  # 1 KiB payload -> 4 chunks
+        try:
+            obs.reset()
+            dist_mod.gather_all_tensors(rank_arrays[0])
+            assert obs.get_counter("sync.gather_chunks") == 4
+            assert obs.sum_counter("sync.payload_bytes") >= 1024
+        finally:
+            dist_mod.configure_gather_chunking(prev)
+            obs.reset()
+            obs.enable(False)
+
+    def test_below_cap_single_collective(self, monkeypatch):
+        import metrics_tpu.utilities.distributed as dist_mod
+
+        rank_arrays = [jnp.ones((8,), jnp.float32) for _ in range(2)]
+        calls = []
+
+        def fake_allgather(x):
+            calls.append(tuple(x.shape))
+            if len(calls) == 1:
+                return jnp.stack(
+                    [jnp.asarray(a.shape, dtype=jnp.int32) for a in rank_arrays]
+                )
+            return jnp.stack(rank_arrays)
+
+        class FakeMHU:
+            process_allgather = staticmethod(fake_allgather)
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr("jax.experimental.multihost_utils", FakeMHU)
+        out = dist_mod.gather_all_tensors(rank_arrays[0])  # default 64 MB cap
+        assert len(calls) == 2  # shape gather + ONE data gather
+        assert len(out) == 2
+
+    def test_configure_validation(self):
+        import metrics_tpu.utilities.distributed as dist_mod
+
+        with pytest.raises(ValueError, match="max_bytes"):
+            dist_mod.configure_gather_chunking(0)
+        with pytest.raises(ValueError, match="max_bytes"):
+            dist_mod.configure_gather_chunking(-5)
+        prev = dist_mod.configure_gather_chunking(None)  # disable = legacy monolith
+        try:
+            assert dist_mod._GATHER_CHUNK_BYTES is None
+        finally:
+            dist_mod.configure_gather_chunking(prev)

@@ -1,0 +1,693 @@
+"""Sharded-state execution path: mesh-permutation bitwise invariance.
+
+The round-15 proof obligation: the sharded path's folded/merged states are
+BITWISE identical to the replicated path's under every mesh size and device
+permutation (the sketch monoid's fold-order invariance), with zero
+materialized full-state gathers — and a sharded state survives a
+kill-resume through ``ft.CheckpointManager`` bitwise. 2/4/8-way meshes run
+on the suite's 8 virtual CPU devices.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import AUROC, Accuracy, StateShardSpec, make_step
+from metrics_tpu.metric import Metric
+from metrics_tpu.streaming import (
+    QuantileSketch,
+    ScoreLabelSketch,
+    StreamingAUROC,
+    StreamingAveragePrecision,
+    StreamingQuantile,
+)
+from metrics_tpu.utilities.sharding import (
+    REPLICATED,
+    get_sharded_compute,
+    register_sharded_compute,
+    shard_sketch_in_context,
+)
+
+try:
+    from jax import shard_map as _shard_map_mod  # noqa: F401  # jax>=0.6 style
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+N_DEV = 8
+
+# device permutations exercised per mesh size: identity, reversed, and a
+# fixed interleave — different PHYSICAL placements of the same logical
+# shards, plus (via the data reshuffle below) different fold orders
+def _perms(n):
+    rng = np.random.default_rng(42)
+    return [list(range(n)), list(reversed(range(n))), list(rng.permutation(n))]
+
+
+def _data(n=8 * 500, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.random(n, dtype=np.float32))
+    target = jnp.asarray((rng.random(n) < 0.35).astype(np.int32))
+    return preds, target
+
+
+class TestShardedSketchBitwise:
+    """Sharded merged bins == replicated/eager merged bins, bitwise."""
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    @pytest.mark.parametrize("perm_i", [0, 1, 2])
+    def test_scatter_slices_bitwise_vs_eager_merge(self, n_dev, perm_i):
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[perm_i]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        preds, target = _data()
+        template = ScoreLabelSketch(256)
+
+        def prog(p, t):
+            local = template.fold(p, t)
+            view = shard_sketch_in_context(local, "dp")
+            return view.pos, view.neg
+
+        fn = jax.jit(
+            shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp")))
+        )
+        pos, neg = fn(preds, target)
+        oracle = ScoreLabelSketch(256).fold(preds, target)  # one eager global fold
+        # concatenated scatter slices ARE the merged bins, bitwise — the
+        # monoid's fold-order invariance across shard counts and physical
+        # device placements
+        np.testing.assert_array_equal(np.asarray(pos), np.asarray(oracle.pos))
+        np.testing.assert_array_equal(np.asarray(neg), np.asarray(oracle.neg))
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_quantile_sketch_padded_scatter_bitwise(self, n_dev):
+        # 1026 count bins do NOT divide by the mesh: the scatter pads with
+        # massless rows; the real prefix must still match bitwise
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        rng = np.random.default_rng(3)
+        vals = jnp.asarray(rng.normal(0.4, 0.3, 8 * 256).astype(np.float32))
+        template = QuantileSketch(num_bins=1024, lo=0.0, hi=1.0)
+
+        def prog(v):
+            view = shard_sketch_in_context(template.fold(v), "dp")
+            return view.counts
+
+        counts = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P("dp")))(vals)
+        oracle = QuantileSketch(num_bins=1024, lo=0.0, hi=1.0).fold(vals)
+        np.testing.assert_array_equal(np.asarray(counts)[: 1024 + 2], np.asarray(oracle.counts))
+        assert not np.asarray(counts)[1024 + 2 :].any()  # pad rows stay massless
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    @pytest.mark.parametrize("perm_i", [0, 1, 2])
+    @pytest.mark.parametrize(
+        "cls, kwargs",
+        [
+            (StreamingAUROC, {"num_bins": 256}),
+            (StreamingAveragePrecision, {"num_bins": 256}),
+        ],
+    )
+    def test_sharded_value_matches_eager(self, n_dev, perm_i, cls, kwargs):
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[perm_i]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        preds, target = _data()
+        init, step, compute = make_step(
+            cls(**kwargs), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        eager = cls(**kwargs)
+        eager.update(preds, target)
+        assert float(fn(preds, target)) == pytest.approx(float(eager.compute()), abs=1e-6)
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_sharded_quantile_bitwise_value(self, n_dev):
+        # integer-valued partial sums: the sharded rank search finds the
+        # SAME bin, and the edge arithmetic is expression-identical — the
+        # value itself is bitwise, not just close
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        rng = np.random.default_rng(5)
+        vals = jnp.asarray(rng.normal(0.5, 0.25, 8 * 300).astype(np.float32))
+        q = (0.01, 0.25, 0.5, 0.9, 0.999)
+        init, step, compute = make_step(
+            StreamingQuantile(q=q, num_bins=128), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(v):
+            state, _ = step(init(), v)
+            return compute(state)
+
+        got = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P()))(vals)
+        eager = StreamingQuantile(q=q, num_bins=128)
+        eager.update(vals)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(eager.compute()))
+
+    def test_fold_order_invariance_across_shard_assignment(self):
+        # the SAME stream dealt to shards in different orders ends in the
+        # same scattered state bitwise (merge commutativity end to end)
+        preds, target = _data()
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        template = ScoreLabelSketch(128)
+
+        def prog(p, t):
+            view = shard_sketch_in_context(template.fold(p, t), "dp")
+            return view.pos, view.neg
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp"))))
+        base = fn(preds, target)
+        # block-permute the stream: different per-shard data, same multiset
+        order = np.concatenate([np.arange(i, preds.shape[0], 4) for i in range(4)])
+        permuted = fn(preds[order], target[order])
+        np.testing.assert_array_equal(np.asarray(base[0]), np.asarray(permuted[0]))
+        np.testing.assert_array_equal(np.asarray(base[1]), np.asarray(permuted[1]))
+
+
+class TestShardedBufferAUROC:
+    """Ring pair-count AUROC over mesh-resident CapacityBuffer rows."""
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    @pytest.mark.parametrize("perm_i", [0, 1, 2])
+    def test_matches_eager_exact(self, n_dev, perm_i):
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[perm_i]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        preds, target = _data(n=8 * 200, seed=7)
+        cap = preds.shape[0] // n_dev
+        init, step, compute = make_step(
+            AUROC(sample_capacity=cap), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        eager = AUROC()
+        eager.update(preds, target)
+        assert float(fn(preds, target)) == pytest.approx(float(eager.compute()), abs=1e-6)
+
+    def test_ties_counted_exactly(self):
+        # duplicate scores across shards: the tie-half convention must
+        # match the exact sorted path
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        rng = np.random.default_rng(11)
+        preds = jnp.asarray(rng.integers(0, 10, 4 * 64).astype(np.float32) / 10.0)
+        target = jnp.asarray((rng.random(4 * 64) < 0.5).astype(np.int32))
+        init, step, compute = make_step(
+            AUROC(sample_capacity=64), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        eager = AUROC()
+        eager.update(preds, target)
+        assert float(fn(preds, target)) == pytest.approx(float(eager.compute()), abs=1e-6)
+
+    def test_partial_fill_matches(self):
+        # uneven fill: each device's buffer only half full — padding rows
+        # must not count as samples
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        preds, target = _data(n=4 * 32, seed=13)
+        init, step, compute = make_step(
+            AUROC(sample_capacity=64), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        eager = AUROC()
+        eager.update(preds, target)
+        assert float(fn(preds, target)) == pytest.approx(float(eager.compute()), abs=1e-6)
+
+    def test_multiclass_refused_with_guidance(self):
+        init, step, compute = make_step(
+            AUROC(num_classes=3, sample_capacity=64),
+            axis_name="dp",
+            with_value=False,
+            sharded_state=True,
+        )
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+        rng = np.random.default_rng(1)
+        preds = jnp.asarray(rng.random((2 * 16, 3), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 3, 2 * 16))
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        with pytest.raises(ValueError, match="binary mode only"):
+            jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(
+                preds, target
+            )
+
+
+class TestZeroGatherObs:
+    """The sharded path emits NO materialized full-state gather."""
+
+    def test_sharded_trace_has_no_gather_collectives(self):
+        import metrics_tpu.obs as obs
+
+        preds, target = _data(n=4 * 128)
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        def build(sharded):
+            init, step, compute = make_step(
+                StreamingAUROC(num_bins=256),
+                axis_name="dp",
+                with_value=False,
+                sharded_state=sharded,
+            )
+
+            def prog(p, t):
+                state, _ = step(init(), p, t)
+                return compute(state)
+
+            return jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+
+        obs.enable()
+        try:
+            obs.reset()
+            jax.block_until_ready(build(True)(preds, target))
+            snap = obs.snapshot()["counters"]
+            ops = {
+                k: v
+                for k, v in snap.items()
+                if k.startswith("sync.collectives") or k.startswith("sync.payload_bytes")
+            }
+            # reduce-scatter present; the only all_gather is the n-scalar
+            # boundary term (4 floats = 16 bytes), never the state
+            assert any("psum_scatter" in k for k in ops), ops
+            gather_bytes = sum(
+                v for k, v in ops.items() if "payload_bytes" in k and "all_gather" in k
+            )
+            assert gather_bytes <= 64, ops  # scalar boundary terms only
+            assert not any("buffer_gather" in k for k in ops), ops
+        finally:
+            obs.reset()
+            obs.enable(False)
+
+    def test_sharded_buffer_trace_counts_ring_not_gather(self):
+        import metrics_tpu.obs as obs
+
+        preds, target = _data(n=4 * 64)
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        init, step, compute = make_step(
+            AUROC(sample_capacity=64), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        obs.enable()
+        try:
+            obs.reset()
+            jax.block_until_ready(fn(preds, target))
+            counters = obs.snapshot()["counters"]
+            assert any("ring_permute" in k for k in counters), counters
+            assert not any("buffer_gather" in k for k in counters), counters
+        finally:
+            obs.reset()
+            obs.enable(False)
+
+
+class TestShardedKillResume:
+    """A sharded state checkpointed mid-stream resumes bitwise."""
+
+    def test_checkpoint_roundtrip_sharded_sketch(self, tmp_path):
+        from metrics_tpu.ft import CheckpointManager
+
+        preds, target = _data(n=4 * 256, seed=21)
+        half = preds.shape[0] // 2
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+
+        # uninterrupted run
+        straight = StreamingAUROC(num_bins=256)
+        straight.update(preds[:half], target[:half])
+        straight.update(preds[half:], target[half:])
+
+        # killed-and-resumed run: fold half, checkpoint, restore into a
+        # FRESH metric (the revived process), fold the rest
+        first = StreamingAUROC(num_bins=256)
+        first.update(preds[:half], target[:half])
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last=2)
+        mgr.save(first)
+        revived = StreamingAUROC(num_bins=256)
+        mgr.restore(revived)
+        revived.update(preds[half:], target[half:])
+
+        np.testing.assert_array_equal(
+            np.asarray(straight.sketch.pos), np.asarray(revived.sketch.pos)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(straight.sketch.neg), np.asarray(revived.sketch.neg)
+        )
+
+        # and the SHARDED compute over the resumed state matches the
+        # uninterrupted one bitwise (same merged bins in, same program)
+        init, step, compute = make_step(
+            StreamingAUROC(num_bins=256), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        assert float(fn(preds, target)) == pytest.approx(float(revived.compute()), abs=1e-6)
+
+    def test_checkpoint_roundtrip_sharded_buffer_auroc(self, tmp_path):
+        from metrics_tpu.ft import CheckpointManager
+
+        preds, target = _data(n=4 * 128, seed=23)
+        half = preds.shape[0] // 2
+        straight = AUROC(sample_capacity=preds.shape[0])
+        straight.update(preds[:half], target[:half])
+        straight.update(preds[half:], target[half:])
+
+        first = AUROC(sample_capacity=preds.shape[0])
+        first.update(preds[:half], target[:half])
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last=2)
+        mgr.save(first)
+        revived = AUROC(sample_capacity=preds.shape[0])
+        mgr.restore(revived)
+        revived.update(preds[half:], target[half:])
+        np.testing.assert_array_equal(
+            np.asarray(straight.preds.data), np.asarray(revived.preds.data)
+        )
+        assert float(straight.compute()) == float(revived.compute())
+
+
+class TestDeclarativeSpecs:
+    """StateShardSpec validation + the pjit NamedSharding lowering."""
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="dim"):
+            StateShardSpec(dim=-1)
+        with pytest.raises(ValueError, match="dim"):
+            StateShardSpec(dim="rows")
+        assert StateShardSpec(0) == StateShardSpec(0)
+        assert REPLICATED.dim is None
+
+    def test_add_state_rejects_non_spec(self):
+        class Bad(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp.zeros(8), dist_reduce_fx="sum", shard_spec="rows")
+
+            def update(self):
+                pass
+
+            def compute(self):
+                return self.x
+
+        with pytest.raises(ValueError, match="StateShardSpec"):
+            Bad()
+
+    def test_buffer_state_gets_row_spec_automatically(self):
+        m = AUROC(sample_capacity=64)
+        assert m._shard_specs["preds"].dim == 0
+        assert m._shard_specs["target"].dim == 0
+
+    def test_state_named_shardings_layout(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        m = StreamingAUROC(num_bins=256)
+        m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+        shardings = m.state_shardings(mesh, "dp")
+        state = jax.device_put(m.state_pytree(), shardings)
+        # bin leaves live sharded: each device holds 256/4 rows
+        shards = state["sketch"].pos.addressable_shards
+        assert len(shards) == 4
+        assert shards[0].data.shape == (64,)
+        # resident state computes unchanged
+        m2 = StreamingAUROC(num_bins=256)
+        m2.load_state_pytree(state)
+        assert float(m2.compute()) == float(m.compute())
+
+    def test_state_named_shardings_buffer_rows(self):
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        m = AUROC(sample_capacity=64)
+        rng = np.random.default_rng(2)
+        m.update(
+            jnp.asarray(rng.random(64, dtype=np.float32)),
+            jnp.asarray((rng.random(64) < 0.5).astype(np.int32)),
+        )
+        shardings = m.state_shardings(mesh, "dp")
+        state = jax.device_put(m.state_pytree(), shardings)
+        shards = state["preds"].data.addressable_shards
+        assert len(shards) == 4
+        assert shards[0].data.shape == (16,)
+
+    def test_explicit_spec_on_plain_state(self):
+        class Custom(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state(
+                    "hist", jnp.zeros(32), dist_reduce_fx="sum", shard_spec=StateShardSpec(0)
+                )
+
+            def update(self, x):
+                self.hist = self.hist + x
+
+            def compute(self):
+                return self.hist.sum()
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        m = Custom()
+        sh = m.state_shardings(mesh, "dp")
+        state = jax.device_put(m.state_pytree(), sh)
+        assert len(state["hist"].addressable_shards) == 4
+
+    def test_indivisible_dim_falls_back_replicated(self):
+        class Odd(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state(
+                    "hist", jnp.zeros(33), dist_reduce_fx="sum", shard_spec=StateShardSpec(0)
+                )
+
+            def update(self, x):
+                self.hist = self.hist + x
+
+            def compute(self):
+                return self.hist.sum()
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        state = jax.device_put(Odd().state_pytree(), Odd().state_shardings(mesh, "dp"))
+        assert state["hist"].sharding.is_fully_replicated
+
+
+class TestShardedStateErrors:
+    def test_gather_state_without_kernel_raises_at_build(self):
+        from metrics_tpu.regression import SpearmanCorrCoef
+
+        with pytest.raises(ValueError, match="no registered sharded"):
+            make_step(
+                SpearmanCorrCoef(sample_capacity=64),
+                axis_name="dp",
+                sharded_state=True,
+            )
+
+    def test_sharded_without_axis_raises(self):
+        with pytest.raises(ValueError, match="axis_name"):
+            make_step(StreamingAUROC(num_bins=64), sharded_state=True)
+
+    def test_psum_family_metric_allowed_without_kernel(self):
+        # all-psum states are already gather-free; the knob is a no-op
+        init, step, compute = make_step(
+            Accuracy(num_classes=3), axis_name="dp", sharded_state=True, with_value=False
+        )
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.integers(0, 3, 4 * 16))
+        t = jnp.asarray(rng.integers(0, 3, 4 * 16))
+
+        def prog(pp, tt):
+            state, _ = step(init(), pp, tt)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        assert float(fn(p, t)) == pytest.approx(float((np.asarray(p) == np.asarray(t)).mean()))
+
+    def test_registry_resolves_mro_and_rejects_junk(self):
+        with pytest.raises(ValueError, match="class"):
+            register_sharded_compute("NotAClass", lambda *a: None)
+        with pytest.raises(ValueError, match="callable"):
+            register_sharded_compute(Accuracy, "not-callable")
+
+        class Sub(StreamingAUROC):
+            pass
+
+        assert get_sharded_compute(Sub) is get_sharded_compute(StreamingAUROC)
+        assert get_sharded_compute(Accuracy) is None
+
+
+class TestHierarchicalReduce:
+    """ICI-first/DCN-second ordered chain, observed through the seam."""
+
+    def test_seam_observes_ici_then_dcn_order(self):
+        import metrics_tpu.obs as obs
+        from metrics_tpu.utilities.distributed import set_collective_seam
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        init, step, compute = make_step(
+            Accuracy(num_classes=3),
+            axis_name=("ici", "dcn"),
+            with_value=False,
+            hierarchical_sync=True,
+        )
+        rng = np.random.default_rng(0)
+        p = jnp.asarray(rng.integers(0, 3, 8 * 16))
+        t = jnp.asarray(rng.integers(0, 3, 8 * 16))
+
+        def prog(pp, tt):
+            state, _ = step(init(), pp, tt)
+            return compute(state)
+
+        fn = jax.jit(
+            shard_map(prog, mesh, in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))), out_specs=P())
+        )
+        seen = []
+        obs.enable()
+        prev = set_collective_seam(lambda x, op, ax: (seen.append((op, ax)), x)[1])
+        try:
+            got = float(fn(p, t))
+        finally:
+            set_collective_seam(prev)
+            obs.reset()
+            obs.enable(False)
+        assert got == pytest.approx(float((np.asarray(p) == np.asarray(t)).mean()))
+        axes = [ax for _op, ax in seen]
+        assert "ici" in axes and "dcn" in axes, seen
+        # every ici hop precedes every dcn hop per state; since states
+        # reduce one after another, it suffices that the first collective
+        # is ici and ici never FOLLOWS dcn within a consecutive pair of
+        # the same state's chain — pin the global pattern: position of
+        # each dcn is right after its ici partner
+        for i, ax in enumerate(axes):
+            if ax == "dcn":
+                assert axes[i - 1] == "ici", seen
+
+    def test_hierarchical_equals_flat(self):
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        rng = np.random.default_rng(9)
+        p = jnp.asarray(rng.integers(0, 5, 8 * 32))
+        t = jnp.asarray(rng.integers(0, 5, 8 * 32))
+        outs = []
+        for hier in (False, True):
+            init, step, compute = make_step(
+                Accuracy(num_classes=5),
+                axis_name=("ici", "dcn"),
+                with_value=False,
+                hierarchical_sync=hier,
+            )
+
+            def prog(pp, tt):
+                state, _ = step(init(), pp, tt)
+                return compute(state)
+
+            fn = jax.jit(
+                shard_map(
+                    prog, mesh, in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))), out_specs=P()
+                )
+            )
+            outs.append(float(fn(p, t)))
+        assert outs[0] == outs[1]
+
+    def test_mean_reduction_exact_on_rectangular_mesh(self):
+        from metrics_tpu.utilities.distributed import hierarchical_reduce_in_context
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(8,)).astype(np.float32)
+
+        def prog(v):
+            return hierarchical_reduce_in_context(v.reshape(()), "mean", ("ici", "dcn"))
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P(("dcn", "ici")),), out_specs=P()))
+        assert float(fn(jnp.asarray(x))) == pytest.approx(float(x.mean()), rel=1e-6)
+
+    def test_gather_reductions_fall_back_flat(self):
+        from metrics_tpu.utilities.distributed import hierarchical_reduce_in_context
+
+        mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        x = np.arange(8, dtype=np.float32)
+
+        def prog(v):
+            return hierarchical_reduce_in_context(v, "cat", ("ici", "dcn"))
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P(("dcn", "ici")),), out_specs=P()))
+        got = np.sort(np.asarray(fn(jnp.asarray(x))))
+        np.testing.assert_allclose(got, x)
+
+
+class TestReviewHardening:
+    """Round-15 review findings pinned."""
+
+    def test_explicit_replicated_spec_overrides_buffer_rows(self):
+        # REPLICATED on a buffer state must pin a full replica — the
+        # structural rows-shard default must not win over an explicit spec
+        class PinnedAUROC(AUROC):
+            def __init__(self):
+                super().__init__(sample_capacity=64)
+                # re-register the preds state with an explicit opt-out
+                self.add_state(
+                    "preds",
+                    self._defaults["preds"].copy_empty(),
+                    dist_reduce_fx="cat",
+                    shard_spec=REPLICATED,
+                )
+
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        m = PinnedAUROC()
+        rng = np.random.default_rng(2)
+        m.update(
+            jnp.asarray(rng.random(64, dtype=np.float32)),
+            jnp.asarray((rng.random(64) < 0.5).astype(np.int32)),
+        )
+        state = jax.device_put(m.state_pytree(), m.state_shardings(mesh, "dp"))
+        assert state["preds"].data.sharding.is_fully_replicated  # explicit opt-out
+        assert len(state["target"].data.addressable_shards) == 4  # default rows-shard
+
+    def test_nonfinite_scores_poison_ring_auroc_to_nan(self):
+        # +inf doubles as the ring kernel's padding sentinel; a non-finite
+        # real score must poison the result loudly instead of silently
+        # diverging from the replicated sort path
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+        rng = np.random.default_rng(3)
+        preds = rng.random(4 * 32).astype(np.float32)
+        preds[5] = np.inf  # a "saturated logit" positive
+        target = (rng.random(4 * 32) < 0.5).astype(np.int32)
+        target[5] = 1
+        init, step, compute = make_step(
+            AUROC(sample_capacity=32), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+        assert np.isnan(float(fn(jnp.asarray(preds), jnp.asarray(target))))
+        # finite scores on the same shapes stay exact
+        preds[5] = 0.5
+        eager = AUROC()
+        eager.update(jnp.asarray(preds), jnp.asarray(target))
+        assert float(fn(jnp.asarray(preds), jnp.asarray(target))) == pytest.approx(
+            float(eager.compute()), abs=1e-6
+        )

@@ -22,6 +22,7 @@ from metrics_tpu import (
     MeanSquaredError,
     Precision,
     R2Score,
+    make_epoch,
     make_step,
 )
 
@@ -865,3 +866,132 @@ class TestEpochFusion:
         p = jnp.zeros((3, 8), jnp.float32)
         scanned = prims(jax.make_jaxpr(epoch2)(init2(), p, p).jaxpr, set())
         assert "scan" in scanned  # non-mergeable (running-moment) states ride lax.scan
+
+
+class TestPrefetch:
+    """make_epoch(prefetch=K): double-buffered chunked folds, bitwise parity."""
+
+    def _epoch_data(self, n_batches=16, batch=32, seed=0):
+        rng = np.random.default_rng(seed)
+        return (
+            rng.integers(0, 5, (n_batches, batch)),
+            rng.integers(0, 5, (n_batches, batch)),
+        )
+
+    @pytest.mark.parametrize("k", [1, 3, 4, 16, 32])
+    def test_count_states_bitwise_vs_unchunked(self, k):
+        pe, te = self._epoch_data()
+        init0, epoch0, compute0 = make_epoch(Accuracy, num_classes=5)
+        initk, epochk, computek = make_epoch(Accuracy, num_classes=5, prefetch=k)
+        s0, _ = epoch0(init0(), jnp.asarray(pe), jnp.asarray(te))
+        sk, _ = epochk(initk(), pe, te)  # host numpy inputs stream chunkwise
+        for name in s0:
+            np.testing.assert_array_equal(np.asarray(s0[name]), np.asarray(sk[name]))
+        assert float(compute0(s0)) == float(computek(sk))
+
+    def test_sketch_states_bitwise_vs_unchunked(self):
+        from metrics_tpu.streaming import StreamingAUROC
+
+        rng = np.random.default_rng(1)
+        pe = rng.random((12, 64), dtype=np.float32)
+        te = (rng.random((12, 64)) < 0.5).astype(np.int32)
+        init0, epoch0, _c0 = make_epoch(StreamingAUROC(num_bins=128))
+        initk, epochk, _ck = make_epoch(StreamingAUROC(num_bins=128), prefetch=5)
+        s0, _ = epoch0(init0(), jnp.asarray(pe), jnp.asarray(te))
+        sk, _ = epochk(initk(), pe, te)
+        np.testing.assert_array_equal(np.asarray(s0["sketch"].pos), np.asarray(sk["sketch"].pos))
+        np.testing.assert_array_equal(np.asarray(s0["sketch"].neg), np.asarray(sk["sketch"].neg))
+
+    def test_with_values_concatenates_chunks(self):
+        pe, te = self._epoch_data(n_batches=10)
+        init0, epoch0, _ = make_epoch(Accuracy, num_classes=5, with_values=True)
+        initk, epochk, _ = make_epoch(Accuracy, num_classes=5, with_values=True, prefetch=4)
+        _, v0 = epoch0(init0(), jnp.asarray(pe), jnp.asarray(te))
+        _, vk = epochk(initk(), pe, te)
+        assert np.asarray(vk).shape == np.asarray(v0).shape == (10,)
+        np.testing.assert_allclose(np.asarray(vk), np.asarray(v0), rtol=1e-6)
+
+    def test_float_merge_path_prefetch_allclose(self):
+        # float sum states: the chunked merge reassociates the additions
+        # (3 + 3 + 2 batches vs one flat sum) — allclose, the documented
+        # contract; count/sketch states above pin BITWISE
+        pe = np.random.default_rng(2).normal(size=(8, 16)).astype(np.float32)
+        init0, epoch0, compute0 = make_epoch(MeanMetric)
+        initk, epochk, computek = make_epoch(MeanMetric, prefetch=3)
+        s0, _ = epoch0(init0(), jnp.asarray(pe))
+        sk, _ = epochk(initk(), pe)
+        for name in s0:
+            np.testing.assert_allclose(np.asarray(s0[name]), np.asarray(sk[name]), rtol=1e-6)
+        assert float(compute0(s0)) == pytest.approx(float(computek(sk)), rel=1e-6)
+
+    def test_collection_epoch_prefetch(self):
+        from metrics_tpu import MetricCollection, Precision, Recall
+
+        pe, te = self._epoch_data(n_batches=9)
+        coll = MetricCollection(
+            [Precision(num_classes=5, average="macro"), Recall(num_classes=5, average="macro")]
+        )
+        init0, epoch0, compute0 = make_epoch(coll)
+        initk, epochk, computek = make_epoch(coll, prefetch=2)
+        s0, _ = epoch0(init0(), jnp.asarray(pe), jnp.asarray(te))
+        sk, _ = epochk(initk(), pe, te)
+        flat0 = jax.tree_util.tree_leaves(s0)
+        flatk = jax.tree_util.tree_leaves(sk)
+        for a, b in zip(flat0, flatk):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        v0, vk = compute0(s0), computek(sk)
+        for key in v0:
+            np.testing.assert_allclose(np.asarray(v0[key]), np.asarray(vk[key]), rtol=1e-6)
+
+    def test_resume_composes_with_prefetch(self):
+        from metrics_tpu.ft import BatchJournal
+
+        pe, te = self._epoch_data(n_batches=8)
+        journal = BatchJournal()
+        for b in range(3):
+            journal.record(epoch=0, step=b)
+        cursor = journal.resume_from
+        init0, epoch0, compute0 = make_epoch(Accuracy, num_classes=5)
+        initk, epochk, computek = make_epoch(Accuracy, num_classes=5, prefetch=2)
+        s0, _ = epoch0(init0(), jnp.asarray(pe[3:]), jnp.asarray(te[3:]))
+        sk, _ = epochk(initk(), pe, te, resume_from=cursor, epoch_index=0)
+        for name in s0:
+            np.testing.assert_array_equal(np.asarray(s0[name]), np.asarray(sk[name]))
+
+    def test_prefetch_validation(self):
+        with pytest.raises(ValueError, match="prefetch"):
+            make_epoch(Accuracy, num_classes=5, prefetch=0)
+        with pytest.raises(ValueError, match="prefetch"):
+            make_epoch(Accuracy, num_classes=5, prefetch=2.5)
+
+    def test_prefetch_to_device_preserves_order_and_values(self):
+        from metrics_tpu.steps import prefetch_to_device
+
+        pe, te = self._epoch_data(n_batches=6)
+        batches = [(pe[i], te[i]) for i in range(6)]
+        out = list(prefetch_to_device(batches, size=2))
+        assert len(out) == 6
+        for (p0, t0), (p1, t1) in zip(batches, out):
+            assert isinstance(p1, jax.Array)
+            np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+            np.testing.assert_array_equal(np.asarray(t0), np.asarray(t1))
+        with pytest.raises(ValueError, match="size"):
+            prefetch_to_device(batches, size=0)  # raises at the CALL, not first next()
+
+    def test_overlap_epoch_sync_snapshots(self):
+        from metrics_tpu.steps import overlap_epoch_sync
+
+        pe, te = self._epoch_data(n_batches=12)
+        init, epoch, compute = make_epoch(Accuracy, num_classes=5)
+        chunks = [
+            (jnp.asarray(pe[i : i + 4]), jnp.asarray(te[i : i + 4])) for i in range(0, 12, 4)
+        ]
+        final, snaps = overlap_epoch_sync(epoch, compute, init(), chunks)
+        assert len(snaps) == 3
+        # last snapshot == the full-epoch value; earlier ones are the
+        # running prefixes (folding is pure, so each reads its own state)
+        init2, epoch2, compute2 = make_epoch(Accuracy, num_classes=5)
+        s2, _ = epoch2(init2(), jnp.asarray(pe), jnp.asarray(te))
+        assert float(snaps[-1]) == float(compute2(s2))
+        prefix_state, _ = epoch2(init2(), jnp.asarray(pe[:4]), jnp.asarray(te[:4]))
+        assert float(snaps[0]) == float(compute2(prefix_state))

@@ -1,0 +1,180 @@
+"""CI smoke: the sharded-state path on 8 emulated CPU devices.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.mesh_smoke`` (the CI
+tier-1 job does). The cheap end-to-end arm of
+``tests/bases/test_sharded_state.py``:
+
+* sharded (reduce-scattered) sketch bins vs the replicated merge — BITWISE;
+* sharded ``StreamingAUROC`` / buffer-backed ``AUROC`` values vs the eager
+  oracle; ZERO materialized full-state gathers on the sharded trace,
+  asserted through the ``sync.collectives`` / ``sync.payload_bytes``
+  counters (only ``psum_scatter``/``psum``/ring + an n-scalar boundary
+  gather);
+* the ``set_collective_seam`` hook observes the hierarchical
+  ICI-first/DCN-second collective order on a 2x4 mesh;
+* ``make_epoch(prefetch=K)`` parity pinned bitwise against the unchunked
+  launch for count- and sketch-state metrics.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    import metrics_tpu  # noqa: F401  — compat shims install jax.shard_map
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def main() -> None:
+    import metrics_tpu.obs as obs
+    from metrics_tpu import AUROC, Accuracy, make_epoch, make_step
+    from metrics_tpu.streaming import ScoreLabelSketch, StreamingAUROC
+    from metrics_tpu.utilities.distributed import set_collective_seam
+    from metrics_tpu.utilities.sharding import shard_sketch_in_context
+
+    assert jax.device_count() >= 8, f"need 8 emulated devices, got {jax.device_count()}"
+    rng = np.random.default_rng(0)
+    n = 8 * 512
+    preds = jnp.asarray(rng.random(n, dtype=np.float32))
+    target = jnp.asarray((rng.random(n) < 0.4).astype(np.int32))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("dp",))
+
+    # 1. sharded bins == replicated merge, bitwise (the monoid argument)
+    template = ScoreLabelSketch(256)
+
+    def scatter_prog(p, t):
+        view = shard_sketch_in_context(template.fold(p, t), "dp")
+        return view.pos, view.neg
+
+    pos, neg = jax.jit(
+        _shard_map(scatter_prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=(P("dp"), P("dp")))
+    )(preds, target)
+    oracle = ScoreLabelSketch(256).fold(preds, target)
+    assert (np.asarray(pos) == np.asarray(oracle.pos)).all(), "scattered pos bins not bitwise"
+    assert (np.asarray(neg) == np.asarray(oracle.neg)).all(), "scattered neg bins not bitwise"
+
+    # 2. sharded compute values vs eager oracle + ZERO-gather obs pin
+    obs.enable()
+    try:
+        obs.reset()
+        init, step, compute = make_step(
+            StreamingAUROC(num_bins=256), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def sk_prog(p, t):
+            state, _ = step(init(), p, t)
+            return compute(state)
+
+        got = jax.jit(_shard_map(sk_prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(
+            preds, target
+        )
+        eager = StreamingAUROC(num_bins=256)
+        eager.update(preds, target)
+        assert abs(float(got) - float(eager.compute())) < 1e-6, (got, eager.compute())
+        counters = obs.snapshot()["counters"]
+        sync_keys = {k: v for k, v in counters.items() if k.startswith("sync.")}
+        assert any("psum_scatter" in k for k in sync_keys), sync_keys
+        big_gathers = sum(
+            v
+            for k, v in sync_keys.items()
+            if "payload_bytes" in k and ("all_gather" in k or "buffer_gather" in k)
+        )
+        assert big_gathers <= 64, f"sharded path materialized a gather: {sync_keys}"
+
+        # buffer-backed AUROC: ring pass, no gather, exact value
+        obs.reset()
+        cap = n // 8
+        init_b, step_b, compute_b = make_step(
+            AUROC(sample_capacity=cap), axis_name="dp", with_value=False, sharded_state=True
+        )
+
+        def buf_prog(p, t):
+            state, _ = step_b(init_b(), p, t)
+            return compute_b(state)
+
+        got_b = jax.jit(_shard_map(buf_prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(
+            preds, target
+        )
+        exact = AUROC()
+        exact.update(preds, target)
+        assert abs(float(got_b) - float(exact.compute())) < 1e-6, (got_b, exact.compute())
+        counters = obs.snapshot()["counters"]
+        assert any("ring_permute" in k for k in counters), counters
+        assert not any("buffer_gather" in k for k in counters), counters
+
+        # 3. seam observes the hierarchical ICI-then-DCN collective order
+        mesh2 = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("dcn", "ici"))
+        init_h, step_h, compute_h = make_step(
+            Accuracy(num_classes=5),
+            axis_name=("ici", "dcn"),
+            with_value=False,
+            hierarchical_sync=True,
+        )
+        pc = jnp.asarray(rng.integers(0, 5, n))
+        tc = jnp.asarray(rng.integers(0, 5, n))
+
+        def h_prog(p, t):
+            state, _ = step_h(init_h(), p, t)
+            return compute_h(state)
+
+        seen: list = []
+        prev = set_collective_seam(lambda x, op, ax: (seen.append((op, ax)), x)[1])
+        try:
+            got_h = jax.jit(
+                _shard_map(h_prog, mesh2, in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))), out_specs=P())
+            )(pc, tc)
+        finally:
+            set_collective_seam(prev)
+        assert abs(float(got_h) - float((np.asarray(pc) == np.asarray(tc)).mean())) < 1e-6
+        axes = [ax for _op, ax in seen]
+        assert "ici" in axes and "dcn" in axes, seen
+        for i, ax in enumerate(axes):
+            if ax == "dcn":
+                assert axes[i - 1] == "ici", f"DCN hop not preceded by its ICI hop: {seen}"
+    finally:
+        obs.reset()
+        obs.enable(False)
+
+    # 4. prefetch parity: chunked double-buffered fold bitwise vs monolithic
+    pe = np.asarray(rng.integers(0, 5, (16, 64)))
+    te = np.asarray(rng.integers(0, 5, (16, 64)))
+    init0, epoch0, compute0 = make_epoch(Accuracy, num_classes=5)
+    initk, epochk, computek = make_epoch(Accuracy, num_classes=5, prefetch=4)
+    s0, _ = epoch0(init0(), jnp.asarray(pe), jnp.asarray(te))
+    sk, _ = epochk(initk(), pe, te)
+    for name in s0:
+        assert (np.asarray(s0[name]) == np.asarray(sk[name])).all(), name
+    assert float(compute0(s0)) == float(computek(sk))
+
+    rng2 = np.random.default_rng(1)
+    pe2 = rng2.random((12, 128), dtype=np.float32)
+    te2 = (rng2.random((12, 128)) < 0.5).astype(np.int32)
+    initS, epochS, _ = make_epoch(StreamingAUROC(num_bins=128))
+    initP, epochP, _ = make_epoch(StreamingAUROC(num_bins=128), prefetch=5)
+    sS, _ = epochS(initS(), jnp.asarray(pe2), jnp.asarray(te2))
+    sP, _ = epochP(initP(), pe2, te2)
+    assert (np.asarray(sS["sketch"].pos) == np.asarray(sP["sketch"].pos)).all()
+    assert (np.asarray(sS["sketch"].neg) == np.asarray(sP["sketch"].neg)).all()
+
+    print(
+        "mesh smoke OK: scattered bins bitwise, sharded AUROC/sketch values exact,"
+        " zero materialized gathers, ICI-then-DCN seam order, prefetch parity pinned"
+    )
+
+
+if __name__ == "__main__":
+    main()
