@@ -693,6 +693,84 @@ def bench_region(n_regions: int = 3, n_clients: int = 300) -> dict:
     }
 
 
+def bench_history(n_clients: int = 64, n_intervals: int = 48) -> dict:
+    """Time-travel tier: interval ring-cut cost and range-query latency
+    at full ring length.
+
+    - ``history_ring_cut_ms`` — mean wall time of one
+      :meth:`~metrics_tpu.serve.MetricHistory.cut` (copy the folded
+      leaves, append through the compaction ladder, evaluate alert
+      rules) while ``n_intervals`` cumulative rounds stream through a
+      history-armed root: the per-interval tax of retaining history, the
+      cost a cadence-armed flush pays on the ingest path.
+    - ``history_range_query_p99_ms`` — p99 of full-horizon stepped delta
+      range queries (`/query?start=&end=&step=`) once every ring is at
+      capacity: resolve + exact monoid delta + load-and-compute per
+      interval, the read-side cost at MAX retained ring length. The
+      ``history_smoke`` CI step pins the same tier's accepted-snapshot
+      oracle bitwise; these rows only time it.
+    """
+    import time as _time
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.serve import Aggregator, HistoryConfig
+    from metrics_tpu.serve.wire import encode_state
+    from metrics_tpu.streaming import StreamingAUROC
+
+    def factory():
+        return MetricCollection({"auroc": StreamingAUROC(num_bins=256), "seen": SumMetric()})
+
+    tenant = "bench"
+    rng = np.random.default_rng(17)
+    blobs = []  # [interval][client] cumulative snapshots, encoded untimed
+    colls = [factory() for _ in range(n_clients)]
+    for interval in range(n_intervals):
+        round_blobs = []
+        for c, coll in enumerate(colls):
+            preds = jnp.asarray(rng.uniform(0, 1, 256).astype(np.float32))
+            target = jnp.asarray(
+                (rng.uniform(0, 1, 256) < 0.3 + 0.4 * np.asarray(preds)).astype(np.int32)
+            )
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(256.0))
+            round_blobs.append(
+                encode_state(coll, tenant=tenant, client_id=f"c{c:03d}", watermark=(0, interval))
+            )
+        blobs.append(round_blobs)
+
+    # a ladder deep enough that steady-state cuts keep rolling up
+    agg = Aggregator(
+        "bench-history",
+        history=HistoryConfig(cut_every_s=float("inf"), levels=((1.0, 16), (4.0, 8), (16.0, 4))),
+    )
+    agg.register_tenant(tenant, factory)
+    cut_ms = []
+    for interval in range(n_intervals):
+        for blob in blobs[interval]:
+            agg.ingest(blob)
+        agg.flush()
+        t0 = _time.perf_counter()
+        agg.history.cut(agg, now=float(interval))
+        cut_ms.append((_time.perf_counter() - t0) * 1000.0)
+
+    th = agg.history._tenants[tenant]
+    ts = [snap.t for _, snap in th.retained()]
+    query_ms = []
+    for _ in range(20):
+        t0 = _time.perf_counter()
+        agg.history_query(tenant, ts[0], ts[-1], step=1.0, mode="delta")
+        query_ms.append((_time.perf_counter() - t0) * 1000.0)
+    return {
+        "history_ring_cut_ms": float(np.mean(cut_ms)),
+        "history_range_query_p99_ms": float(np.percentile(query_ms, 99)),
+    }
+
+
 def bench_aot() -> dict:
     """Cold-vs-warm first fold: the execution-engine acceptance rows.
 
@@ -1411,6 +1489,25 @@ def main(
             prior.get(
                 "serve_global_query_staleness_ms",
                 region_rows["serve_global_query_staleness_ms"],
+            ),
+            baseline="best_prior_self",
+        )
+        # time-travel tier rows (round 17): the per-interval ring-cut tax
+        # and the read-side range-query latency at full ring length — the
+        # history_smoke CI step pins the same tier's oracle bitwise
+        history_rows = section(bench_history)
+        emit(
+            "history_ring_cut_ms",
+            history_rows["history_ring_cut_ms"],
+            prior.get("history_ring_cut_ms", history_rows["history_ring_cut_ms"]),
+            baseline="best_prior_self",
+        )
+        emit(
+            "history_range_query_p99_ms",
+            history_rows["history_range_query_p99_ms"],
+            prior.get(
+                "history_range_query_p99_ms",
+                history_rows["history_range_query_p99_ms"],
             ),
             baseline="best_prior_self",
         )

@@ -63,7 +63,9 @@ See ``docs/observability.md`` for the full guide.
 """
 from metrics_tpu.obs import registry as _registry  # noqa: F401
 from metrics_tpu.obs.export import (
+    family_help,
     merge_snapshots,
+    register_help,
     snapshot,
     to_chrome_trace,
     to_json,
@@ -119,6 +121,7 @@ __all__ = [
     "counters",
     "enable",
     "enabled",
+    "family_help",
     "federated_snapshot",
     "gauges",
     "get_counter",
@@ -139,6 +142,7 @@ __all__ = [
     "pytree_nbytes",
     "record_cost_analysis",
     "record_hop",
+    "register_help",
     "remote_snapshots",
     "reset",
     "set_gauge",

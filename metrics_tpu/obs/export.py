@@ -11,7 +11,10 @@ Prometheus naming: series ``a.b.c{x=y}`` becomes
 ``metrics_tpu_a_b_c{x="y"}`` — dots to underscores, every label value
 quoted with backslash/quote/newline escaped per the text exposition
 format, one ``# TYPE`` line per family (counters ``counter``, gauges
-``gauge``, histograms ``histogram``). Histogram series expand into the
+``gauge``, histograms ``histogram``), preceded by a ``# HELP`` line for
+every family with a registered description (:func:`register_help` /
+:data:`_FAMILY_HELP` — all built-in families ship one). Histogram series
+expand into the
 standard ``_bucket{le=...}`` cumulative counts (with a ``+Inf`` bucket),
 ``_sum`` and ``_count``. Spans are not exported to Prometheus (they are
 per-event, not a series); they ride the JSON dump.
@@ -30,7 +33,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from metrics_tpu.obs import registry as _reg
 
-__all__ = ["merge_snapshots", "snapshot", "to_chrome_trace", "to_json", "to_prometheus"]
+__all__ = [
+    "family_help",
+    "merge_snapshots",
+    "register_help",
+    "snapshot",
+    "to_chrome_trace",
+    "to_json",
+    "to_prometheus",
+]
 
 _KEY_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$", re.DOTALL)
 _NAME_SAFE = re.compile(r"[^a-zA-Z0-9_]")
@@ -159,6 +170,179 @@ def _prom_histogram(key: str, hist: Dict[str, Any], out: list) -> None:
     out.append(f"{name}_count{_fmt_labels(pairs)} {hist.get('count', cum)}")
 
 
+# ---------------------------------------------------------------------------
+# # HELP description registry — one sentence per known family, keyed on the
+# RAW dotted family name (the key up to its first "{"), emitted ahead of
+# the family's # TYPE line. Unknown families still export (TYPE only);
+# subsystems introducing a family at runtime add theirs via register_help().
+# ---------------------------------------------------------------------------
+
+_FAMILY_HELP: Dict[str, str] = {
+    # core metric lifecycle
+    "metric.updates": "Metric update() calls",
+    "metric.computes": "Metric compute() calls",
+    "metric.forwards": "Metric forward() calls (update + batch-value)",
+    "metric.resets": "Metric reset() calls",
+    "metric.syncs": "Cross-host state synchronisations",
+    "metric.sync_noops": "Syncs skipped because the world has one host",
+    "metric.sync_ms": "Wall time per cross-host synchronisation",
+    "metric.state_bytes": "Serialized state size per metric",
+    "collection.members": "Metrics held per MetricCollection",
+    "collection.update_groups": "Distinct update signatures per collection",
+    "collection.format_reuse": "Collection compute-group format reuses",
+    # compilation / tracing
+    "jax.compiles": "jit compilations triggered by metric programs",
+    "jax.compile_seconds": "Wall seconds spent in jit compilation",
+    "step.traces": "Retracings per named step (drift indicator)",
+    "step.latency_ms": "Per-step wall latency",
+    "step.eager_calls": "Steps executed eagerly (outside jit)",
+    "step.flops": "XLA cost-analysis FLOPs per step",
+    "step.bytes_accessed": "XLA cost-analysis bytes accessed per step",
+    "step.arithmetic_intensity": "FLOPs per byte accessed per step",
+    "compile.cache_hits": "Persistent compile-cache hits",
+    "compile.cache_misses": "Persistent compile-cache misses",
+    "compile.store_errors": "Persistent compile-cache store failures",
+    "compile.store_invalid": "Persistent compile-cache invalid entries",
+    "compile.warmup_mismatches": "AOT warmup signature mismatches",
+    "compile_cache.persistent_enabled": "Persistent compile cache armed (0/1)",
+    # sync / collectives
+    "sync.gathers": "gather_all_tensors collective launches",
+    "sync.gather_chunks": "Chunks shipped across gather launches",
+    "sync.collectives": "Collective ops issued by the sync layer",
+    "sync.latency_ms": "Collective latency per op",
+    "sync.payload_bytes": "Bytes moved per collective payload",
+    "sync.arrival_skew_ms": "This host's lead over the slowest peer at sync",
+    "sync.arrival_wait_ms": "Time parked in the pre-gather barrier",
+    "sync.arrival_skew_probe_failures": "Arrival-skew probe failures",
+    # buffers / epochs / streaming
+    "capacity_buffer.clamp_risk_appends": "Appends at/over buffer capacity",
+    "capacity_buffer.eager_overflows": "Eager-mode buffer overflows",
+    "capacity_buffer.checkify_guards_armed": "Checkify overflow guards armed",
+    "epoch.launches": "Device launches per epoch accumulation",
+    "epoch.batches_folded": "Batches folded into epoch state",
+    "epoch.batches_per_launch": "Batches amortized per device launch",
+    "stream.drift_checks": "DriftMonitor.check() calls",
+    "stream.drift_alerts": "Drift checks that crossed an alert threshold",
+    "stream.windows_expired": "WindowedMetric ring slots retired",
+    # fault tolerance
+    "ft.checkpoint_saves": "Checkpoint save() completions",
+    "ft.checkpoint_restores": "Checkpoint restore() completions",
+    "ft.checkpoint_save_ms": "Wall time per checkpoint save",
+    "ft.checkpoints_rotated": "Old checkpoints rotated out by keep=",
+    "ft.degraded_syncs": "Syncs that fell back to local-only state",
+    "ft.manifest_env_mismatches": "Restores into a mismatched environment",
+    "ft.retries": "Retry attempts by the ft retry policy",
+    "ft.save_timeouts": "Checkpoint saves abandoned on timeout",
+    # health / profiling / chaos
+    "health.checks": "HealthMonitor.check() calls",
+    "health.alerts": "Health conditions that fired, by kind",
+    "profile.captures": "Profiler trace captures",
+    "profile.capture_ms": "Wall time per profiler capture",
+    "profile.cost_analysis_failures": "XLA cost-analysis failures",
+    "chaos.injected": "Faults injected by the chaos layer",
+    "debug.checks_enabled": "Debug checks armed (0/1)",
+    # obs plane itself
+    "obs.scrape_ms": "Wall time per /metrics scrape (same-scrape sample)",
+    "obs.federation_accepts": "Remote node snapshots accepted",
+    "obs.federation_oversized": "Remote snapshots refused for size",
+    "obs.federation_nodes_dropped": "Federated nodes evicted from the table",
+    "obs.spans_dropped": "Spans dropped at the ring bound",
+    "obs.hops_dropped": "Hop records dropped at the ring bound",
+    "obs.series_dropped": "Series dropped at the per-family bound",
+    # serving tier
+    "serve.ingests": "Client snapshots accepted for fold",
+    "serve.ingest_ms": "Wall time per ingest acceptance",
+    "serve.merges": "Monoid merges performed by folds",
+    "serve.fold_stacked": "Payloads folded via the stacked fast path",
+    "serve.fold_errors": "Folds that raised and were quarantined",
+    "serve.flush_ms": "Wall time per queue flush",
+    "serve.flush_errors": "Flush worker iterations that raised",
+    "serve.forward_errors": "Interior-node forward failures",
+    "serve.queue_depth": "Current ingest queue depth",
+    "serve.clients": "Live clients per tenant",
+    "serve.tenants": "Registered tenants",
+    "serve.value": "Latest computed scalar per tenant metric",
+    "serve.query_ms": "Wall time per /query (same-scrape sample)",
+    "serve.rejected": "Payloads rejected at admission",
+    "serve.shed": "Payloads shed by backpressure",
+    "serve.accept_errors": "Ingest decode/validation failures",
+    "serve.wire_errors": "Wire-format decode failures",
+    "serve.dedup_drops": "Stale payloads dropped by keep-latest dedup",
+    "serve.poisoned": "Payloads flagged poisoned by the firewall",
+    "serve.quarantined": "Clients quarantined (cumulative)",
+    "serve.clients_quarantined": "Clients currently quarantined",
+    "serve.quarantine_drops": "Payloads dropped from quarantined clients",
+    "serve.circuit_open": "Circuit open transitions (cumulative)",
+    "serve.circuits_open": "Circuits currently open",
+    "serve.circuit_drops": "Payloads dropped by open circuits",
+    "serve.firewall_untracked": "Firewall events for untracked clients",
+    "serve.retired_clients": "Clients retired with tombstones",
+    "serve.tombstones_evicted": "Retirement tombstones evicted at the cap",
+    "serve.drains": "Node drains completed",
+    "serve.heals": "Supervisor heals performed",
+    "serve.heal_ms": "Wall time per supervisor heal",
+    "serve.hop_queue_wait_ms": "Payload wait in a hop's ingest queue",
+    "serve.hop_fold_ms": "Payload fold time at a hop",
+    "serve.hop_ship_ms": "Payload ship time out of a hop",
+    "serve.e2e_freshness_ms": "Encode-to-root-accept freshness per payload",
+    "serve.warmed_programs": "AOT-warmed fold programs",
+    "serve.ring_members": "Members in the elastic hash ring",
+    "serve.rebalances": "Elastic rebalances completed",
+    "serve.rebalance_ms": "Wall time per elastic rebalance",
+    "serve.rebalance_started_ts": "Wall-clock start of in-flight rebalance (0=idle)",
+    "serve.autoscaler_decisions": "Autoscaler scale decisions",
+    "serve.autoscaler_errors": "Autoscaler evaluation failures",
+    "serve.cross_region_merges": "Peer region snapshots merged into global view",
+    "serve.replication_errors": "Cross-region ship failures",
+    "serve.replication_loop_errors": "Replication loop iterations that raised",
+    "serve.peer_staleness_ms": "Age of a peer region's replica",
+    "serve.peers_unreachable": "Peer regions actively unreachable",
+    "serve.global_query_staleness_ms": "Worst peer age behind a global query",
+    "serve.mesh_regions": "Regions in the mesh",
+    "serve.promotions": "Standby-to-root promotions",
+    "serve.promote_ms": "Wall time per promotion",
+    "serve.region_generation": "Current region generation (failover fence)",
+    "serve.fenced_ships": "Ships refused by the generation fence",
+    # time-travel history (metrics_tpu.serve.history)
+    "history.cuts": "Interval snapshots cut into retention rings",
+    "history.cut_ms": "Wall time per history cut across tenants",
+    "history.cut_errors": "History cuts that raised (flush survives)",
+    "history.intervals": "Intervals currently retained per tenant",
+    "history.rollups": "Within-bucket rollup replacements at coarser levels",
+    "history.intervals_evicted": "Intervals evicted past the retention horizon",
+    "history.range_queries": "Range queries answered, by tenant and mode",
+    "history.range_query_ms": "Wall time per range query",
+    "history.fenced_range_queries": "Delta range queries refused across generations",
+    "history.alerts": "Alert rule firing edges, by rule and tenant",
+    "history.alert_active": "Alert rule currently firing (1) or clear (0)",
+}
+
+
+def register_help(family: str, text: str) -> None:
+    """Register (or override) the one-line ``# HELP`` text for a raw
+    dotted family name (e.g. ``"serve.ingests"``). Families without an
+    entry still export, with a ``# TYPE`` line only."""
+    _FAMILY_HELP[str(family)] = str(text)
+
+
+def family_help(family: str) -> Optional[str]:
+    """The registered ``# HELP`` text for a raw family name, or None."""
+    return _FAMILY_HELP.get(family)
+
+
+def _escape_help(text: str) -> str:
+    # exposition format: HELP text escapes backslash and newline only
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _family_header(key: str, base: str, kind: str, lines: list) -> None:
+    raw = key.split("{", 1)[0]
+    text = _FAMILY_HELP.get(raw)
+    if text is not None:
+        lines.append(f"# HELP {base} {_escape_help(text)}")
+    lines.append(f"# TYPE {base} {kind}")
+
+
 def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
     """Render a snapshot in the Prometheus text exposition format."""
     snap = snapshot() if snap is None else snap
@@ -169,13 +353,13 @@ def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
             base, _ = _prom_parts(key)
             if base not in typed:
                 typed.add(base)
-                lines.append(f"# TYPE {base} {kind}")
+                _family_header(key, base, kind, lines)
             _prom_series(key, snap[family][key], lines)
     for key in sorted(snap.get("histograms", {})):
         base, _ = _prom_parts(key)
         if base not in typed:
             typed.add(base)
-            lines.append(f"# TYPE {base} histogram")
+            _family_header(key, base, "histogram", lines)
         _prom_histogram(key, snap["histograms"][key], lines)
     return "\n".join(lines) + ("\n" if lines else "")
 

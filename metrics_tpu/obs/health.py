@@ -63,6 +63,12 @@ these are the registry-only verdicts):
   the generation fence. The data is safe (that is the fence's job);
   the alert exists because a zombie burning its backoff schedule
   against 4xx responses forever deserves decommissioning, not silence.
+* ``history_alert`` — a ``history.alert_active`` gauge is nonzero: a
+  root-evaluated alert rule (:class:`metrics_tpu.serve.history.AlertRule`
+  / :class:`~metrics_tpu.serve.history.DriftRule`, checked against every
+  freshly cut retention-ring interval) is currently firing. Current
+  state, not the cumulative ``history.alerts`` counter: a metric that
+  recovers stops firing here.
 * ``rebalance_stuck`` — a ``serve.rebalance_started_ts`` gauge (stamped
   by :class:`metrics_tpu.serve.elastic.ElasticFleet` for the duration of
   every join/drain/split/merge, cleared on completion; the ``node=``
@@ -126,6 +132,10 @@ class HealthMonitor:
         fenced_zombie: arm the multi-region ``fenced_zombie`` condition
             (the ``serve.fenced_ships`` counter fired: a superseded
             pre-failover root is shipping into the generation fence).
+        history_alert: arm the ``history_alert`` condition (a
+            ``history.alert_active`` gauge is nonzero: a root-evaluated
+            metric alert rule is currently firing over the retention
+            ring's interval deltas).
         federated: read every condition off the federated fleet view
             (local registry merged with the piggybacked per-node
             snapshots) instead of local registry state — the root-of-tree
@@ -157,6 +167,7 @@ class HealthMonitor:
         peer_staleness_ms: Optional[float] = None,
         partition_detected: bool = False,
         fenced_zombie: bool = False,
+        history_alert: bool = False,
         federated: bool = False,
         node_staleness_s: Optional[float] = None,
         name: str = "default",
@@ -174,6 +185,7 @@ class HealthMonitor:
         self.peer_staleness_ms = peer_staleness_ms
         self.partition_detected = bool(partition_detected)
         self.fenced_zombie = bool(fenced_zombie)
+        self.history_alert = bool(history_alert)
         self.federated = bool(federated)
         self.node_staleness_s = node_staleness_s
         self.name = str(name)
@@ -445,6 +457,27 @@ class HealthMonitor:
             )
         return None
 
+    def _check_history_alert(self) -> Optional[str]:
+        if not self.history_alert:
+            return None
+        # one series per firing (rule, tenant) — the gauge is edge-driven
+        # by MetricHistory (1 on healthy→firing, 0 on recovery), so this
+        # reads CURRENT alert state, not the cumulative history.alerts count
+        firing = sorted(
+            key
+            for key, value in self._gauges().items()
+            if (key == "history.alert_active" or key.startswith("history.alert_active{"))
+            and value
+        )
+        if firing:
+            return (
+                f"{len(firing)} metric alert rule(s) currently firing at the"
+                f" root (worst: {firing[0]}) — an interval delta crossed its"
+                " configured threshold or drift test; the firing edge was"
+                " warned once and counted under history.alerts{rule=,tenant=}"
+            )
+        return None
+
     def _check_rebalance_stuck(self) -> Optional[str]:
         if self.rebalance_stuck_s is None:
             return None
@@ -498,6 +531,7 @@ class HealthMonitor:
             ("peer_stale", self._check_peer_stale),
             ("partition_detected", self._check_partition_detected),
             ("fenced_zombie", self._check_fenced_zombie),
+            ("history_alert", self._check_history_alert),
         )
         warnings: List[Dict[str, str]] = []
         with self._check_lock:

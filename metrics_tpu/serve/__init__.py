@@ -38,6 +38,14 @@ primitives the library already proved:
   handoff + tombstone rebalance keeps the root bitwise-equal to the flat
   oracle through topology churn, and the queue-pressure
   :class:`Autoscaler` reading the federated fleet signals.
+* :mod:`~metrics_tpu.serve.history` — the time-travel tier
+  (``Aggregator(history=...)``): per-tenant retention rings of interval
+  snapshots cut from the deduped accepted state, exact 1m→1h→1d rollup
+  compaction by monoid merge, the ``/query?start=&end=`` range surface
+  (``delta`` vs ``cumulative`` with per-interval error envelopes),
+  root-evaluated alert rules (:class:`AlertRule` / :class:`DriftRule`)
+  and generation-fenced historical reads across failover — the root as
+  its own metrics database (``docs/serving.md`` §10).
 * :mod:`~metrics_tpu.serve.region` — multi-region serving: a
   :class:`RegionalMesh` of regional roots cross-merging their cumulative
   aggregates as ordinary wire clients (``region:<name>`` identities,
@@ -67,6 +75,15 @@ from metrics_tpu.serve.elastic import (
     Router,
 )
 from metrics_tpu.serve.endpoints import MetricsServer
+from metrics_tpu.serve.history import (
+    AlertRule,
+    DeltaUndefinedError,
+    DriftRule,
+    GenerationFencedRangeError,
+    HistoryConfig,
+    HistoryRetentionError,
+    MetricHistory,
+)
 from metrics_tpu.serve.region import (
     Region,
     RegionDownError,
@@ -100,15 +117,22 @@ __all__ = [
     "AggregationTree",
     "Aggregator",
     "AggregatorNode",
+    "AlertRule",
     "Autoscaler",
     "BackpressureError",
     "CircuitOpenError",
     "ClientFirewall",
+    "DeltaUndefinedError",
     "DrainingError",
+    "DriftRule",
     "ElasticFleet",
     "FencedGenerationError",
+    "GenerationFencedRangeError",
     "HashRing",
+    "HistoryConfig",
+    "HistoryRetentionError",
     "MAX_WIRE_BYTES",
+    "MetricHistory",
     "MetricPayload",
     "MetricsServer",
     "NodeDownError",

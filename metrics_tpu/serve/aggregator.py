@@ -543,6 +543,15 @@ class Aggregator:
             (``tests/integrations/aot_smoke.py`` pins it).
         prewarm_buckets: fold bucket sizes (padded client counts)
             ``register_tenant`` pre-lowers when an AOT engine is armed.
+        history: a :class:`~metrics_tpu.serve.history.HistoryConfig`
+            (or ``True`` for defaults) arming the node's time-travel
+            metrics database: every flush cadence-cuts per-tenant
+            interval snapshots into bounded retention rings with exact
+            monoid rollups, range queries (:meth:`history_query`, the
+            ``/query?start=&end=`` surface) and root-evaluated alert
+            rules — see :mod:`metrics_tpu.serve.history`. ``None``
+            (default) constructs nothing and adds zero work to the
+            ingest/fold path.
 
     Example::
 
@@ -567,6 +576,7 @@ class Aggregator:
         resilience: Any = None,
         engine: Any = None,
         prewarm_buckets: Tuple[int, ...] = (1, 2),
+        history: Any = None,
     ) -> None:
         if checkpoint_every is not None and checkpoint_every < 1:
             raise ValueError(f"checkpoint_every must be >= 1 (or None), got {checkpoint_every}")
@@ -615,6 +625,13 @@ class Aggregator:
 
             config = ResilienceConfig() if resilience is True else resilience
             self._firewall = ClientFirewall(config, node=self.name)
+        self._history = None
+        if history is not None and history is not False:
+            # deferred import: history.py imports ServeError from here
+            from metrics_tpu.serve.history import HistoryConfig, MetricHistory
+
+            hconfig = HistoryConfig() if history is True else history
+            self._history = MetricHistory(hconfig, node=self.name)
         self._manager = None
         if checkpoint_dir is not None:
             from metrics_tpu.ft.manager import CheckpointManager
@@ -626,6 +643,12 @@ class Aggregator:
         """The armed :class:`~metrics_tpu.serve.resilience.ClientFirewall`,
         or None when ``resilience=`` was not given."""
         return self._firewall
+
+    @property
+    def history(self):
+        """The armed :class:`~metrics_tpu.serve.history.MetricHistory`,
+        or None when ``history=`` was not given."""
+        return self._history
 
     # ------------------------------------------------------------------
     # Tenant registry
@@ -1271,6 +1294,22 @@ class Aggregator:
                     folded_any = True
                     if _obs_enabled():
                         _obs_inc("serve.merges", float(k), tenant=tenant.tenant_id)
+            if self._history is not None:
+                # the time-travel cut rides the flush (cadence-gated inside
+                # maybe_cut): the merged views it snapshots were folded just
+                # above, under this same _flush_lock hold. One `is None`
+                # check is ALL an unarmed node pays here.
+                try:
+                    self._history.maybe_cut(self)
+                except Exception as err:  # noqa: BLE001 — a history bug must
+                    # degrade to "no new interval", never halt aggregation
+                    if _obs_enabled():
+                        _obs_inc("history.cut_errors", node=self.name)
+                    warnings.warn(
+                        f"aggregator {self.name!r} history cut failed:"
+                        f" {type(err).__name__}: {err}",
+                        stacklevel=2,
+                    )
             self._flushes += 1
             self._last_flush_s = time.monotonic()
             if _obs_enabled():
@@ -1487,6 +1526,31 @@ class Aggregator:
             "values": values,
         }
 
+    def history_query(
+        self,
+        tenant_id: str,
+        start: float,
+        end: float,
+        *,
+        step: Optional[float] = None,
+        mode: str = "delta",
+    ) -> Dict[str, Any]:
+        """Range-query the node's time-travel history (requires
+        ``history=`` at construction): per-interval (``mode="delta"``)
+        or as-of (``mode="cumulative"``) values with streaming
+        ``bounds``/``error_bound`` envelopes — the ``/query`` surface's
+        ``start``/``end``/``step``/``mode`` parameters. Flushes first so
+        a due cadence cut lands before the range resolves. See
+        :meth:`~metrics_tpu.serve.history.MetricHistory.range_query`."""
+        if self._history is None:
+            raise ServeError(
+                f"aggregator {self.name!r} has no history armed; construct with"
+                " Aggregator(..., history=HistoryConfig(...)) to retain interval"
+                " snapshots and serve range queries"
+            )
+        self.flush()
+        return self._history.range_query(self, tenant_id, start, end, step=step, mode=mode)
+
     # ------------------------------------------------------------------
     # Persistence (ft.CheckpointManager)
     # ------------------------------------------------------------------
@@ -1573,6 +1637,15 @@ class Aggregator:
             # monotonic merge: a fence learned live since construction
             # must not be LOWERED by an older checkpoint's record
             self.fence_generation(client_id, int(gen))
+        history_meta = serve_meta.get("history")
+        if self._history is not None and history_meta is not None:
+            # the retention rings resume bitwise mid-ladder: indexes, cut
+            # times, per-interval generations and the eviction horizon are
+            # exactly what the predecessor saved (history_smoke pins the
+            # post-restore range answers against the flat oracle)
+            self._history.load_checkpoint_state(
+                proxy.tree.get("history", {}), history_meta, self
+            )
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
         return manifest
@@ -1735,6 +1808,14 @@ class Aggregator:
                         }
                 if slots:
                     tree[tslot] = slots
+            if self._history is not None:
+                # the retention rings ride the same checkpoint (atomic
+                # publish, rotation, one manifest): "history" cannot
+                # collide with the positional t%06d tenant slots
+                htree, hmeta = self._history.state_for_checkpoint()
+                if htree:
+                    tree["history"] = htree
+                meta["history"] = hmeta
         return _RegistryState(tree), meta
 
 

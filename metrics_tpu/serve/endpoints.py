@@ -20,6 +20,17 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   freshness and the ``degraded`` verdict; a ``stale_reads="reject"``
   policy violation answers 503 naming the stale regions (the
   multi-region degraded-read contract, ``docs/serving.md`` §9).
+  ``&start=&end=`` (epoch seconds, plus optional ``&step=`` and
+  ``&mode=delta|cumulative``) switches to the TIME-TRAVEL surface
+  (:meth:`Aggregator.history_query` over the retention rings,
+  ``docs/serving.md`` §10): per-interval deltas or as-of cumulative
+  values with per-interval error envelopes. Range-specific refusals map
+  to dedicated statuses — **400** for a delta query over a
+  non-invertible max/min state (``DeltaUndefinedError``), **416** for a
+  range older than the retention horizon (``HistoryRetentionError``),
+  **409** for a delta spanning a failover generation boundary
+  (``GenerationFencedRangeError``: re-query per generation, or
+  ``mode=cumulative``).
 * ``POST /ingest`` — the wire payload as the request body; 200 on accept,
   400 on malformed/schema-mismatched payloads, 404 for unknown tenants,
   503 on queue backpressure, 409 for a generation-fenced zombie ship
@@ -211,34 +222,65 @@ class MetricsServer:
                         obs.set_gauge(
                             "serve.value", float(arr), tenant=tenant_id, metric=name
                         )
+        if obs.enabled():
+            # self-sample BEFORE the snapshot is taken, so THIS scrape's
+            # exposition carries its own cost — the timed section covers
+            # the flush + per-tenant gauge refresh that dominate a scrape;
+            # only the final text render is excluded (an exporter cannot
+            # time a string it has not built yet). Observing after the
+            # snapshot hid every scrape's cost until the NEXT scrape, and
+            # the final scrape's cost forever.
+            obs.observe("obs.scrape_ms", (_time.perf_counter() - t0) * 1000.0)
         # federated_snapshot() already degrades to the plain local snapshot
         # when the table is empty — one table read either way
-        body = obs.to_prometheus(obs.federated_snapshot())
-        if obs.enabled():
-            # self-metrics land AFTER this body was rendered (an exporter
-            # cannot include its own in-flight sample); the next scrape
-            # exports them — the observability plane observes itself
-            obs.observe("obs.scrape_ms", (_time.perf_counter() - t0) * 1000.0)
-        return body
+        return obs.to_prometheus(obs.federated_snapshot())
 
-    def render_query(self, tenant: str, scope: str = "local") -> Dict[str, Any]:
+    def render_query(
+        self,
+        tenant: str,
+        scope: str = "local",
+        *,
+        start: Any = None,
+        end: Any = None,
+        step: Any = None,
+        mode: Optional[str] = None,
+    ) -> Dict[str, Any]:
         import time as _time
 
         from metrics_tpu import obs
 
         t0 = _time.perf_counter()
-        if scope == "global":
-            if self.region is None:
-                raise ValueError(
-                    "scope=global requires a region-wired server"
-                    " (MetricsServer(..., region=...)); this node serves only its"
-                    " local view"
-                )
-            out = self.region.query_global(tenant)
-        elif scope == "local":
-            out = self.aggregator.query(tenant)
-        else:
+        if scope not in ("local", "global"):
             raise ValueError(f"scope must be 'local' or 'global', got {scope!r}")
+        if scope == "global" and self.region is None:
+            raise ValueError(
+                "scope=global requires a region-wired server"
+                " (MetricsServer(..., region=...)); this node serves only its"
+                " local view"
+            )
+        if start is not None or end is not None or step is not None or mode is not None:
+            # time-travel branch: ?start=&end= select the retention-ring
+            # range surface. scope=global reads the region's GLOBAL view's
+            # history (the replica the cross-region ships repaired), so a
+            # range answer after failover is generation-fenced exactly like
+            # the local one.
+            if start is None or end is None:
+                raise ValueError(
+                    "range queries need BOTH ?start= and ?end= (epoch seconds);"
+                    " ?step= and ?mode=delta|cumulative are optional"
+                )
+            agg = self.region.global_view if scope == "global" else self.aggregator
+            out = agg.history_query(
+                tenant,
+                float(start),
+                float(end),
+                step=None if step is None else float(step),
+                mode="delta" if mode is None else str(mode),
+            )
+        elif scope == "global":
+            out = self.region.query_global(tenant)
+        else:
+            out = self.aggregator.query(tenant)
         if obs.enabled():
             obs.observe("serve.query_ms", (_time.perf_counter() - t0) * 1000.0, tenant=tenant)
         return out
@@ -371,6 +413,11 @@ class MetricsServer:
             "open_circuits": status["open_circuits"],
             "quarantined": status["quarantined"],
         }
+        if agg.history is not None:
+            # surfaced, NOT gating: a firing metric alert (AUROC regressed)
+            # is a data-quality page, not a routing signal — flipping ready
+            # would shift traffic off a perfectly serviceable node
+            out["history_alerts"] = agg.history.active_alerts()
         from metrics_tpu.obs import federation as _federation
 
         if _federation.remote_count():
@@ -425,6 +472,12 @@ def _make_handler(server: MetricsServer):
                 elif parsed.path == "/trace":
                     self._reply(200, server.render_trace().encode(), "application/json")
                 elif parsed.path == "/query":
+                    from metrics_tpu.serve.history import (
+                        DeltaUndefinedError,
+                        GenerationFencedRangeError,
+                        HistoryRetentionError,
+                    )
+
                     params = parse_qs(parsed.query)
                     tenant = (params.get("tenant") or [None])[0]
                     scope = (params.get("scope") or ["local"])[0]
@@ -432,7 +485,17 @@ def _make_handler(server: MetricsServer):
                         self._reply_json(400, {"error": "missing ?tenant= parameter"})
                         return
                     try:
-                        self._reply_json(200, server.render_query(tenant, scope))
+                        self._reply_json(
+                            200,
+                            server.render_query(
+                                tenant,
+                                scope,
+                                start=(params.get("start") or [None])[0],
+                                end=(params.get("end") or [None])[0],
+                                step=(params.get("step") or [None])[0],
+                                mode=(params.get("mode") or [None])[0],
+                            ),
+                        )
                     except StaleGlobalViewError as err:
                         # the degraded-read contract's REJECT arm: peers
                         # aged out past the region's max_staleness_s and
@@ -453,6 +516,27 @@ def _make_handler(server: MetricsServer):
                             },
                             headers=headers,
                         )
+                    except DeltaUndefinedError as err:
+                        # a delta over a non-invertible max/min state is a
+                        # CONTRACT refusal, not a server fault: the caller
+                        # should re-ask mode=cumulative
+                        self._reply_json(400, {"error": str(err), "mode_hint": "cumulative"})
+                    except HistoryRetentionError as err:
+                        # 416 Range Not Satisfiable: the asked-for range
+                        # predates the retention horizon (evicted intervals
+                        # cannot be resurrected — widen the ring caps)
+                        self._reply_json(416, {"error": str(err)})
+                    except GenerationFencedRangeError as err:
+                        # 409 Conflict: the delta spans a failover boundary;
+                        # per-generation sub-ranges (or mode=cumulative)
+                        # stay answerable
+                        self._reply_json(409, {"error": str(err), "fenced": True})
+                    except UnknownTenantError:
+                        raise  # outer handler maps to 404
+                    except ServeError as err:
+                        # e.g. a range query against a node with no history
+                        # armed — client-addressable, not a server fault
+                        self._reply_json(400, {"error": str(err)})
                     except ValueError as err:
                         self._reply_json(400, {"error": str(err)})
                 elif parsed.path == "/healthz/live":

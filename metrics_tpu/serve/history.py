@@ -1,0 +1,967 @@
+"""Time-travel metrics database at the serving root.
+
+The aggregation tier answers "what is the cumulative value *now*"; a
+fleet operator's actual question is "p99 AUROC over the last hour, per
+tenant, and when did it regress?". Because every servable state merges
+as an exact monoid (``sum`` / ``min`` / ``max`` / sketch — see
+:mod:`metrics_tpu.serve.aggregator`), the root can retain **interval
+snapshots** of its already-deduped merged state and answer ANY time
+range by pure monoid algebra — no approximation beyond each sketch's
+own pinned error bounds. :class:`MetricHistory` is that database:
+
+* **Retention rings** — per tenant, a ladder of bounded levels
+  (:class:`HistoryConfig.levels`): the finest ring holds one
+  *cumulative* snapshot per cut cadence, and eviction from level *i*
+  promotes into level *i+1* by keep-newest-per-coarse-bucket (the
+  ``WindowedMetric`` ring discipline, with the
+  ``MAX_RETIRED_TOMBSTONES`` bounding stance: every drop off the
+  coarsest level is COUNTED under ``history.intervals_evicted``, never
+  silent). Because snapshots are cumulative, keep-newest-per-bucket IS
+  the exact monoid rollup — the 1m→1h→1d compaction is bitwise-equal to
+  merging the raw fine intervals (pinned by
+  ``tests/serve/test_history.py``).
+* **Interval-delta algebra** — the delta of a cumulative snapshot pair
+  is computable exactly for ``sum`` leaves (subtract) and for sketch
+  states (count leaves subtract; the monotone ``minv``/``maxv``
+  extremes carry the newer snapshot's value, which is exact under
+  merge). Plain ``max``/``min`` metric states are a non-invertible
+  monoid — a delta query over them REFUSES with
+  :class:`DeltaUndefinedError` (loud, typed) rather than fabricating a
+  number. The algebra satisfies ``delta(a,b) ⊕ delta(b,c) ==
+  delta(a,c)`` bitwise for integer-valued leaves (the same class the
+  fold-order invariance pins).
+* **Range queries** — :meth:`MetricHistory.range_query` resolves
+  ``start``/``end``(/``step``) against the retained rings and answers
+  per-interval values WITH the streaming metrics' rigorous
+  ``error_bound()``/``bounds()`` envelopes, in ``delta`` or
+  ``cumulative`` mode (the ``/query`` HTTP surface's
+  ``start``/``end``/``step``/``mode`` parameters). A range that asks
+  for time the rings have already evicted raises
+  :class:`HistoryRetentionError` — bounded history answers exactly or
+  not at all.
+* **Root-evaluated alert rules** — :class:`AlertRule` (threshold) and
+  :class:`DriftRule` (:class:`~metrics_tpu.streaming.DriftMonitor` over
+  the interval delta) run at every cut, edge-triggered through the
+  one-shot-warn + obs counter machinery
+  (``history.alerts{rule=,tenant=}``), surfaced on ``/healthz/ready``
+  and ``/metrics``.
+* **Generation fencing of historical reads** — every interval records
+  the generation it was cut under (the multi-region ``(generation,
+  seq)`` watermark of PR 14). A promoted root refuses a DELTA spanning
+  a generation boundary with :class:`GenerationFencedRangeError`
+  (subtracting across a failover would difference two histories);
+  cumulative reads and within-generation deltas stay exact, and a
+  healed peer's cumulative re-ship repairs the global range view
+  bitwise from the next cut on.
+
+Durability rides the aggregator's existing checkpoint: the rings
+serialize into :meth:`Aggregator.save`'s registry state (positional
+``h000000`` slots + manifest metadata) and :meth:`Aggregator.restore`
+rebuilds them bitwise — a SIGKILLed root resumes its retention mid-ring
+(``tests/integrations/history_smoke.py``).
+
+Disabled mode is free: an aggregator constructed without ``history=``
+performs ZERO new work on the ingest/fold path (one ``is None`` check
+per flush; the jitted fold programs are untouched, so the HLO
+byte-identity pin holds).
+"""
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import observe as _obs_observe
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.aggregator import ServeError, _jsonable, _tree_set
+
+__all__ = [
+    "AlertRule",
+    "DeltaUndefinedError",
+    "DriftRule",
+    "GenerationFencedRangeError",
+    "HistoryConfig",
+    "HistoryRetentionError",
+    "IntervalSnapshot",
+    "MetricHistory",
+    "delta_leaves",
+    "merge_delta_leaves",
+]
+
+
+class HistoryError(ServeError):
+    """Base class for history-tier errors."""
+
+
+class DeltaUndefinedError(HistoryError):
+    """A delta (interval) query touched a state whose reduction is a
+    non-invertible monoid: plain ``max``/``min`` metric states know only
+    the running extreme, so the extreme *within* an interval is not
+    recoverable from two cumulative snapshots. Refused loudly — a
+    fabricated number here would be silently wrong, the one failure mode
+    the exact-monoid contract exists to prevent. Sketch-internal
+    ``minv``/``maxv`` leaves are NOT affected (they are cumulative
+    envelope bounds, carried exactly); query ``mode=cumulative`` or
+    re-model the metric as a sketch to get interval behavior."""
+
+
+class HistoryRetentionError(HistoryError):
+    """The requested range reaches before the earliest retained interval
+    AND older intervals have already been evicted (or no interval has
+    been cut at all): bounded history answers exactly or not at all.
+    The eviction horizon is visible under ``history.intervals_evicted``
+    and in every range answer's ``evicted`` count."""
+
+
+class GenerationFencedRangeError(HistoryError):
+    """A DELTA range query spans a generation boundary: the intervals on
+    either side were cut under different promoted roots (a multi-region
+    failover), and differencing across the boundary would subtract two
+    histories from each other. Counted under
+    ``history.fenced_range_queries`` and answered 409 on the HTTP
+    surface. Cumulative reads of either side stay exact — split the
+    range at the boundary, or query ``mode=cumulative``."""
+
+
+class HistoryConfig:
+    """Retention + alerting policy for a :class:`MetricHistory`.
+
+    Args:
+        cut_every_s: cadence at which :meth:`MetricHistory.maybe_cut`
+            (called from every :meth:`Aggregator.flush`) cuts a new
+            interval snapshot from each tenant's merged state.
+        levels: the compaction ladder, finest first, as ``(span_s,
+            capacity)`` pairs: level 0 retains ``capacity`` raw cuts;
+            eviction from level *i* promotes the evicted snapshot into
+            level *i+1*'s ``floor(t / span_s)`` bucket keeping the
+            newest cumulative per bucket (the exact monoid rollup);
+            eviction off the LAST level is counted
+            (``history.intervals_evicted``) and advances the retention
+            horizon. The default is a 1m→1h→1d ladder: 120 minutes of
+            minutes, 72 hours of hours, 30 days of days.
+        rules: :class:`AlertRule` / :class:`DriftRule` instances
+            evaluated at every cut (see :meth:`MetricHistory.cut`).
+    """
+
+    def __init__(
+        self,
+        cut_every_s: float = 60.0,
+        levels: Sequence[Tuple[float, int]] = ((60.0, 120), (3600.0, 72), (86400.0, 30)),
+        rules: Sequence[Any] = (),
+    ) -> None:
+        self.cut_every_s = float(cut_every_s)
+        if self.cut_every_s <= 0:
+            raise ValueError(f"cut_every_s must be > 0, got {cut_every_s}")
+        self.levels: Tuple[Tuple[float, int], ...] = tuple(
+            (float(span), int(cap)) for span, cap in levels
+        )
+        if not self.levels:
+            raise ValueError("levels must name at least one (span_s, capacity) ring")
+        for span, cap in self.levels:
+            if span <= 0 or cap < 1:
+                raise ValueError(
+                    f"every history level needs span_s > 0 and capacity >= 1, got {(span, cap)}"
+                )
+        spans = [span for span, _ in self.levels]
+        if spans != sorted(spans) or len(set(spans)) != len(spans):
+            raise ValueError(
+                f"history level spans must be strictly ascending (finest first), got {spans}"
+            )
+        self.rules: Tuple[Any, ...] = tuple(rules)
+        names = [(r.tenant, r.name) for r in self.rules]
+        if len(names) != len(set(names)):
+            raise ValueError(f"alert rule names must be unique per tenant, got {names}")
+
+
+class IntervalSnapshot:
+    """One retained interval: the tenant's CUMULATIVE merged state at cut
+    time, spec-ordered exactly like ``_Tenant.merged_leaves``. ``index``
+    is the tenant-monotonic cut counter (survives restore), ``t`` the
+    wall-clock cut time, ``generation`` the multi-region generation the
+    root held when cutting — the fence historical delta reads honor."""
+
+    __slots__ = ("index", "t", "generation", "clients", "folded", "leaves", "consensus")
+
+    def __init__(
+        self,
+        index: int,
+        t: float,
+        generation: int,
+        clients: int,
+        folded: int,
+        leaves: List[np.ndarray],
+        consensus: List[np.ndarray],
+    ) -> None:
+        self.index = int(index)
+        self.t = float(t)
+        self.generation = int(generation)
+        self.clients = int(clients)
+        self.folded = int(folded)
+        self.leaves = leaves
+        self.consensus = consensus
+
+    def meta(self) -> Dict[str, Any]:
+        return {
+            "index": self.index,
+            "t": self.t,
+            "generation": self.generation,
+            "clients": self.clients,
+        }
+
+
+# ----------------------------------------------------------------------
+# Interval-delta algebra (module-level, property-tested directly)
+# ----------------------------------------------------------------------
+
+
+def _is_sketch_extreme(path: Tuple[str, ...], red: str) -> bool:
+    """A sketch-internal min/max leaf (``minv``/``maxv``): a MONOTONE
+    cumulative envelope bound, not a windowed extreme — carried, never
+    subtracted, and exact under delta merge (``min(newer_b, newer_c) ==
+    newer_c`` because cumulative extremes only tighten)."""
+    return red in ("min", "max") and path[-1].startswith("__sketch_leaf_")
+
+
+def delta_leaves(
+    spec: Sequence[Tuple[Tuple[str, ...], str]],
+    newer: Sequence[np.ndarray],
+    older: Sequence[np.ndarray],
+) -> List[np.ndarray]:
+    """The exact interval delta of two CUMULATIVE spec-ordered leaf
+    lists (``newer`` at the interval end, ``older`` at its start).
+
+    ``sum`` leaves subtract (bitwise-exact for the integer leaves the
+    fold-order invariance pins — sketch counts, ``__update_count``,
+    integer sums); sketch ``minv``/``maxv`` extremes carry the newer
+    snapshot's value (see :func:`_is_sketch_extreme`); a plain
+    ``max``/``min`` state raises :class:`DeltaUndefinedError`.
+    """
+    out: List[np.ndarray] = []
+    for (path, red), new, old in zip(spec, newer, older):
+        if red == "sum":
+            out.append(np.subtract(new, old))
+        elif _is_sketch_extreme(path, red):
+            out.append(np.array(new, copy=True))
+        else:
+            raise DeltaUndefinedError(
+                f"state leaf {'/'.join(path)} has reduction {red!r}: a plain"
+                " max/min monoid is not invertible, so the interval delta of two"
+                " cumulative snapshots is undefined for it. Query"
+                " mode=cumulative, or model the metric as a mergeable sketch"
+                " (metrics_tpu.streaming) to get windowed extremes."
+            )
+    return out
+
+
+def merge_delta_leaves(
+    spec: Sequence[Tuple[Tuple[str, ...], str]],
+    earlier: Sequence[np.ndarray],
+    later: Sequence[np.ndarray],
+) -> List[np.ndarray]:
+    """Merge two ADJACENT interval deltas (``earlier`` then ``later``)
+    into the delta of the concatenated interval: ``merge(delta(a,b),
+    delta(b,c)) == delta(a,c)`` bitwise for integer leaves — the
+    property test's subject. ``sum`` leaves add; sketch extremes keep
+    the LATER interval's carried value (= the newer cumulative bound,
+    exactly what ``delta(a,c)`` carries)."""
+    out: List[np.ndarray] = []
+    for (path, red), a, b in zip(spec, earlier, later):
+        if red == "sum":
+            out.append(np.add(a, b))
+        elif _is_sketch_extreme(path, red):
+            out.append(np.array(b, copy=True))
+        else:
+            raise DeltaUndefinedError(
+                f"state leaf {'/'.join(path)} has reduction {red!r}: interval"
+                " deltas are undefined for plain max/min states"
+            )
+    return out
+
+
+# ----------------------------------------------------------------------
+# Alert rules
+# ----------------------------------------------------------------------
+
+
+class AlertRule:
+    """Threshold rule evaluated at the root on every interval cut.
+
+    Fires when the named metric's computed value crosses ``above`` /
+    ``below`` (inclusive of neither). ``on="delta"`` (default) evaluates
+    the metric over the just-cut interval's delta — the "did it regress
+    THIS minute" question; ``on="cumulative"`` evaluates the running
+    value. Firing is EDGE-TRIGGERED: the transition into violation
+    counts ``history.alerts{rule=,tenant=}`` once and emits one
+    ``rank_zero_warn``; a rule that stays in violation across many cuts
+    fires exactly once until it recovers and re-arms (the
+    ``HealthMonitor`` one-shot-warn discipline).
+
+    Args:
+        name: rule identity (the ``rule=`` obs label; unique per tenant).
+        tenant: tenant the rule watches.
+        metric: member name inside the tenant's collection.
+        above / below: fire when value > above, or value < below (at
+            least one required).
+        on: ``"delta"`` or ``"cumulative"``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        tenant: str,
+        metric: str,
+        *,
+        above: Optional[float] = None,
+        below: Optional[float] = None,
+        on: str = "delta",
+    ) -> None:
+        if above is None and below is None:
+            raise ValueError(f"alert rule {name!r} needs at least one of above=/below=")
+        if on not in ("delta", "cumulative"):
+            raise ValueError(f"alert rule {name!r}: on must be 'delta' or 'cumulative', got {on!r}")
+        self.name = str(name)
+        self.tenant = str(tenant)
+        self.metric = str(metric)
+        self.above = None if above is None else float(above)
+        self.below = None if below is None else float(below)
+        self.on = on
+
+    def check(self, value: Any, metric: Any) -> Optional[str]:
+        """Violation detail string, or None when healthy."""
+        arr = np.asarray(value)
+        if arr.ndim != 0 or not np.issubdtype(arr.dtype, np.number):
+            return None  # structured values have no scalar threshold
+        v = float(arr)
+        if self.above is not None and v > self.above:
+            return f"{self.metric}={v:g} above threshold {self.above:g} ({self.on})"
+        if self.below is not None and v < self.below:
+            return f"{self.metric}={v:g} below threshold {self.below:g} ({self.on})"
+        return None
+
+
+class DriftRule:
+    """Distribution-drift rule: a
+    :class:`~metrics_tpu.streaming.DriftMonitor` (PSI / KL / JS against
+    a frozen reference sketch) evaluated over each cut interval's state.
+    Same edge-triggered firing discipline as :class:`AlertRule`.
+
+    Args:
+        name / tenant / metric: as :class:`AlertRule` — ``metric`` must
+            be a sketch-backed member (the monitor extracts its sketch).
+        reference: the frozen reference sketch (or sketch-backed metric).
+        psi_threshold / kl_threshold / js_threshold: forwarded to
+            :class:`~metrics_tpu.streaming.DriftMonitor` (at least one).
+        on: ``"delta"`` (drift of the interval's own traffic) or
+            ``"cumulative"``.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        tenant: str,
+        metric: str,
+        reference: Any,
+        *,
+        psi_threshold: Optional[float] = 0.2,
+        kl_threshold: Optional[float] = None,
+        js_threshold: Optional[float] = None,
+        on: str = "delta",
+    ) -> None:
+        from metrics_tpu.streaming.drift import DriftMonitor
+
+        if on not in ("delta", "cumulative"):
+            raise ValueError(f"drift rule {name!r}: on must be 'delta' or 'cumulative', got {on!r}")
+        self.name = str(name)
+        self.tenant = str(tenant)
+        self.metric = str(metric)
+        self.on = on
+        # warn=False: the history layer owns the one-shot warning (edge-
+        # triggered per rule), the monitor just computes the divergences
+        self._monitor = DriftMonitor(
+            reference,
+            psi_threshold=psi_threshold,
+            kl_threshold=kl_threshold,
+            js_threshold=js_threshold,
+            name=self.name,
+            warn=False,
+        )
+
+    def check(self, value: Any, metric: Any) -> Optional[str]:
+        if metric is None:
+            return None
+        report = self._monitor.check(metric)
+        if not report.get("alert"):
+            return None
+        detail = ", ".join(
+            f"{k}={report[k]:.4f}" for k in ("psi", "kl", "js") if report.get(k) is not None
+        )
+        return f"{self.metric} drifted vs reference ({detail}, {self.on})"
+
+
+# ----------------------------------------------------------------------
+# Per-tenant retention rings
+# ----------------------------------------------------------------------
+
+
+class _TenantHistory:
+    """One tenant's retention ladder. Level 0 is an append-ordered list
+    of raw cuts; each coarser level keys buckets ``floor(t / span)`` to
+    the newest cumulative snapshot promoted into them (dict insertion
+    order == promotion order == chronological, so eviction pops the
+    oldest bucket). All mutation happens under ``MetricHistory._lock``.
+    """
+
+    __slots__ = ("tenant_id", "levels", "rings", "next_index", "evicted", "last_evicted_t")
+
+    def __init__(self, tenant_id: str, levels: Tuple[Tuple[float, int], ...]) -> None:
+        self.tenant_id = tenant_id
+        self.levels = levels
+        # rings[0]: List[IntervalSnapshot]; rings[i>0]: Dict[int, IntervalSnapshot]
+        self.rings: List[Any] = [[]] + [dict() for _ in levels[1:]]
+        self.next_index = 0
+        self.evicted = 0
+        self.last_evicted_t: Optional[float] = None
+
+    def append(self, snap: IntervalSnapshot) -> Tuple[int, int]:
+        """Insert a fresh cut; returns (rollups performed, evictions)."""
+        self.rings[0].append(snap)
+        rollups = evictions = 0
+        level = 0
+        overflow: List[IntervalSnapshot] = []
+        while level < len(self.levels):
+            cap = self.levels[level][1]
+            ring = self.rings[level]
+            for promoted in overflow:
+                rollups += 1
+                self._insert(level, promoted)
+            overflow = []
+            if level == 0:
+                while len(ring) > cap:
+                    overflow.append(ring.pop(0))
+            else:
+                while len(ring) > cap:
+                    oldest = next(iter(ring))
+                    overflow.append(ring.pop(oldest))
+            level += 1
+        for dropped in overflow:  # off the coarsest level: counted, never silent
+            evictions += 1
+            self.evicted += 1
+            t = dropped.t
+            if self.last_evicted_t is None or t > self.last_evicted_t:
+                self.last_evicted_t = t
+        return rollups, evictions
+
+    def _insert(self, level: int, snap: IntervalSnapshot) -> None:
+        """Keep-newest-cumulative-per-bucket: the exact monoid rollup
+        (a cumulative snapshot already IS the merge of everything before
+        it, so the newest per bucket equals merging the bucket's raw
+        intervals bitwise)."""
+        span = self.levels[level][0]
+        bucket = int(snap.t // span)
+        held = self.rings[level].get(bucket)
+        if held is None or (snap.t, snap.index) >= (held.t, held.index):
+            self.rings[level][bucket] = snap
+
+    def restore_insert(self, level: int, snap: IntervalSnapshot) -> None:
+        """Checkpoint replay: place a snapshot directly into its recorded
+        level, bypassing promotion (the ladder shape is restored as
+        saved, not re-derived)."""
+        if level == 0:
+            self.rings[0].append(snap)
+        else:
+            self._insert(level, snap)
+
+    def retained(self) -> List[Tuple[int, IntervalSnapshot]]:
+        """Every retained ``(level, snapshot)``, oldest first. Promotion
+        MOVES a snapshot between levels (never copies), so the list is
+        duplicate-free by construction."""
+        out: List[Tuple[int, IntervalSnapshot]] = []
+        for level, ring in enumerate(self.rings):
+            snaps = ring if level == 0 else ring.values()
+            out.extend((level, s) for s in snaps)
+        out.sort(key=lambda pair: (pair[1].t, pair[1].index))
+        return out
+
+    def newest(self) -> Optional[IntervalSnapshot]:
+        pairs = self.retained()
+        return pairs[-1][1] if pairs else None
+
+    def snapshot_at(self, t: float) -> Optional[IntervalSnapshot]:
+        """The newest retained snapshot cut at or before ``t`` (the
+        cumulative state AS OF ``t``), or None when history starts
+        after ``t``."""
+        best: Optional[IntervalSnapshot] = None
+        for _, snap in self.retained():
+            if snap.t <= t and (best is None or (snap.t, snap.index) > (best.t, best.index)):
+                best = snap
+        return best
+
+
+# ----------------------------------------------------------------------
+# The database
+# ----------------------------------------------------------------------
+
+
+class MetricHistory:
+    """Per-tenant time-travel store living inside one
+    :class:`~metrics_tpu.serve.Aggregator` (construct the aggregator
+    with ``history=HistoryConfig(...)`` — or ``history=True`` for the
+    defaults — and every flush cadence-cuts automatically; see the
+    module docstring for the full design).
+
+    Example::
+
+        agg = Aggregator("root", history=HistoryConfig(
+            cut_every_s=60.0,
+            rules=[AlertRule("seen-stall", "search", "seen", below=1.0)],
+        ))
+        agg.register_tenant("search", factory)
+        ...
+        agg.history_query("search", start=t0, end=t1, step=60.0)
+    """
+
+    def __init__(self, config: HistoryConfig, node: str = "?", generation: int = 0) -> None:
+        self.config = config
+        self.node = str(node)
+        # the multi-region generation new cuts are stamped with; the
+        # Region wiring advances it on set_generation()/promotion
+        self.generation = int(generation)
+        self._tenants: Dict[str, _TenantHistory] = {}
+        self._last_cut_s: Optional[float] = None
+        # (tenant, rule name) -> detail while firing; edge-trigger state
+        self._active: Dict[Tuple[str, str], str] = {}
+        self._warned_rules: set = set()
+        import threading
+
+        self._lock = threading.Lock()
+
+    # -- cutting ---------------------------------------------------------
+
+    def maybe_cut(self, aggregator: Any) -> int:
+        """Cadence gate for the flush hook: cut when ``cut_every_s`` has
+        elapsed since the last cut (first flush arms the clock without
+        cutting — an empty just-started node has nothing to retain).
+        Returns intervals cut (0 when the cadence has not elapsed)."""
+        now = time.time()
+        if self._last_cut_s is None:
+            self._last_cut_s = now
+            return 0
+        if now - self._last_cut_s < self.config.cut_every_s:
+            return 0
+        return self.cut(aggregator, now=now)
+
+    def cut(self, aggregator: Any, now: Optional[float] = None) -> int:
+        """Cut one interval snapshot per tenant from the aggregator's
+        merged (already-deduped, already-folded) state; evaluate alert
+        rules on the fresh interval. Safe inside the flush lock — errors
+        in one tenant's cut or rules never abort the others (the flush
+        loop's one-bad-tenant stance)."""
+        t0 = time.perf_counter()
+        now = time.time() if now is None else float(now)
+        self._last_cut_s = now
+        armed = _obs_enabled()
+        cuts = 0
+        for tenant_id in aggregator.tenants():
+            tenant = aggregator._tenant(tenant_id)
+            with tenant.view_lock:
+                if tenant.merged_leaves is None:
+                    continue  # nothing folded yet: no interval to retain
+                leaves = [np.array(leaf, copy=True) for leaf in tenant.merged_leaves]
+            # consensus leaves are byte-identical across clients by the fold
+            # contract; capture from any live slot, template when empty
+            with tenant.lock:
+                slot = next(iter(tenant.clients.values()), None)
+                consensus = [
+                    np.array(leaf, copy=True)
+                    for leaf in (slot.consensus if slot is not None else tenant.template_consensus)
+                ]
+                clients = len(tenant.clients)
+            folded = tenant.folded_payloads
+            with self._lock:
+                th = self._tenants.get(tenant_id)
+                if th is None:
+                    th = self._tenants[tenant_id] = _TenantHistory(tenant_id, self.config.levels)
+                prev = th.newest()
+                snap = IntervalSnapshot(
+                    th.next_index, now, self.generation, clients, folded, leaves, consensus,
+                )
+                th.next_index += 1
+                rollups, evictions = th.append(snap)
+                retained = len(th.retained())
+            cuts += 1
+            if armed:
+                _obs_inc("history.cuts", tenant=tenant_id)
+                _obs_gauge("history.intervals", float(retained), tenant=tenant_id)
+                if rollups:
+                    _obs_inc("history.rollups", float(rollups), tenant=tenant_id)
+                if evictions:
+                    _obs_inc("history.intervals_evicted", float(evictions), tenant=tenant_id)
+            self._evaluate_rules(tenant, prev, snap)
+        if armed and cuts:
+            _obs_observe("history.cut_ms", (time.perf_counter() - t0) * 1000.0)
+        return cuts
+
+    # -- alert evaluation ------------------------------------------------
+
+    def _evaluate_rules(self, tenant: Any, prev: Optional[IntervalSnapshot],
+                        snap: IntervalSnapshot) -> None:
+        rules = [r for r in self.config.rules if r.tenant == tenant.tenant_id]
+        if not rules:
+            return
+        for rule in rules:
+            try:
+                detail = self._check_rule(tenant, rule, prev, snap)
+            except DeltaUndefinedError as err:
+                # a delta rule over a non-invertible state is a CONFIG
+                # error: warn once per rule, never abort the flush
+                key = (rule.tenant, rule.name)
+                if key not in self._warned_rules:
+                    self._warned_rules.add(key)
+                    warnings.warn(
+                        f"history alert rule {rule.name!r} (tenant {rule.tenant!r})"
+                        f" cannot evaluate: {err}", stacklevel=2,
+                    )
+                continue
+            except Exception as err:  # noqa: BLE001 — rule errors must not kill flushes
+                key = (rule.tenant, rule.name)
+                if key not in self._warned_rules:
+                    self._warned_rules.add(key)
+                    warnings.warn(
+                        f"history alert rule {rule.name!r} (tenant {rule.tenant!r})"
+                        f" failed: {type(err).__name__}: {err}", stacklevel=2,
+                    )
+                continue
+            self._transition(rule, detail)
+
+    def _check_rule(self, tenant: Any, rule: Any, prev: Optional[IntervalSnapshot],
+                    snap: IntervalSnapshot) -> Optional[str]:
+        if rule.on == "delta":
+            if prev is None or prev.generation != snap.generation:
+                return None  # no fenceable baseline: the interval is undefined
+            leaves = delta_leaves(tenant.spec, snap.leaves, prev.leaves)
+        else:
+            leaves = snap.leaves
+        def probe(view: Any) -> Optional[str]:
+            computed = view.compute()
+            if rule.metric not in computed:
+                return None
+            return rule.check(computed[rule.metric], dict(view.items()).get(rule.metric))
+        return self._with_loaded(tenant, leaves, snap.consensus, probe)
+
+    def _transition(self, rule: Any, detail: Optional[str]) -> None:
+        """Edge-triggered firing through the obs + one-shot-warn
+        machinery: healthy→firing counts once and warns once per rule;
+        firing→healthy re-arms (and clears the active gauge)."""
+        key = (rule.tenant, rule.name)
+        was_active = key in self._active
+        if detail is not None:
+            self._active[key] = detail
+            if not was_active:
+                if _obs_enabled():
+                    _obs_inc("history.alerts", rule=rule.name, tenant=rule.tenant)
+                    _obs_gauge("history.alert_active", 1.0, rule=rule.name, tenant=rule.tenant)
+                if key not in self._warned_rules:
+                    self._warned_rules.add(key)
+                    from metrics_tpu.utilities.prints import rank_zero_warn
+
+                    rank_zero_warn(
+                        f"history alert {rule.name!r} FIRING for tenant"
+                        f" {rule.tenant!r} on node {self.node!r}: {detail}"
+                        " (counted under history.alerts; edge-triggered — warns"
+                        " once until the rule recovers)"
+                    )
+        elif was_active:
+            del self._active[key]
+            if _obs_enabled():
+                _obs_gauge("history.alert_active", 0.0, rule=rule.name, tenant=rule.tenant)
+
+    def active_alerts(self) -> List[Dict[str, str]]:
+        """Currently-firing rules (the ``/healthz/ready`` reasons feed)."""
+        with self._lock:
+            return [
+                {"rule": name, "tenant": tenant, "detail": detail}
+                for (tenant, name), detail in sorted(self._active.items())
+            ]
+
+    def reset_warnings(self) -> None:
+        """Re-arm every rule's one-shot warning (test hook, mirroring
+        :meth:`~metrics_tpu.obs.HealthMonitor.reset_warnings`)."""
+        self._warned_rules.clear()
+
+    # -- range queries ---------------------------------------------------
+
+    def range_query(
+        self,
+        aggregator: Any,
+        tenant_id: str,
+        start: float,
+        end: float,
+        *,
+        step: Optional[float] = None,
+        mode: str = "delta",
+    ) -> Dict[str, Any]:
+        """Answer ``[start, end]`` from the retained rings.
+
+        ``mode="cumulative"`` returns one point per tick: the merged
+        state AS OF that time (the newest retained snapshot at or before
+        it). ``mode="delta"`` returns one interval per consecutive tick
+        pair: the exact difference of the two resolved cumulative
+        snapshots (:func:`delta_leaves`). Every entry carries the
+        computed values WITH ``bounds``/``error_bound`` envelopes where
+        the metric documents them. Without ``step`` the whole range is
+        one interval (or two points).
+
+        Raises :class:`HistoryRetentionError` when the range reaches
+        past the eviction horizon, :class:`DeltaUndefinedError` for a
+        delta over plain max/min states, and
+        :class:`GenerationFencedRangeError` for a delta spanning a
+        generation boundary.
+        """
+        t0 = time.perf_counter()
+        start, end = float(start), float(end)
+        if end < start:
+            raise ValueError(f"range end {end} precedes start {start}")
+        if mode not in ("delta", "cumulative"):
+            raise ValueError(f"mode must be 'delta' or 'cumulative', got {mode!r}")
+        if step is not None and float(step) <= 0:
+            raise ValueError(f"step must be > 0, got {step}")
+        tenant = aggregator._tenant(tenant_id)
+        with self._lock:
+            th = self._tenants.get(tenant_id)
+            if th is None:
+                raise HistoryRetentionError(
+                    f"tenant {tenant_id!r} has no retained history on node"
+                    f" {self.node!r}: no interval has been cut yet (history cuts"
+                    f" every {self.config.cut_every_s}s of flushed traffic)"
+                )
+            pairs = th.retained()
+            evicted, last_evicted_t = th.evicted, th.last_evicted_t
+        if _obs_enabled():
+            _obs_inc("history.range_queries", tenant=tenant_id, mode=mode)
+
+        def resolve(t: float) -> Optional[IntervalSnapshot]:
+            best: Optional[IntervalSnapshot] = None
+            for _, snap in pairs:
+                if snap.t <= t and (best is None or (snap.t, snap.index) > (best.t, best.index)):
+                    best = snap
+            if best is None and evicted:
+                raise HistoryRetentionError(
+                    f"tenant {tenant_id!r}: time {t} precedes the earliest retained"
+                    f" interval and {evicted} older interval(s) were already evicted"
+                    f" (horizon ~{last_evicted_t}); bounded history answers exactly"
+                    " or not at all — widen the retention ladder"
+                    " (HistoryConfig.levels) to keep more"
+                )
+            return best
+
+        ticks = [start]
+        if step is not None:
+            tick = start + float(step)
+            while tick < end - 1e-9:
+                ticks.append(tick)
+                tick += float(step)
+        ticks.append(end)
+
+        out: Dict[str, Any] = {
+            "tenant": tenant.tenant_id,
+            "mode": mode,
+            "start": start,
+            "end": end,
+            "step": step,
+            "generation": self.generation,
+            "retained": len(pairs),
+            "evicted": evicted,
+        }
+        if mode == "cumulative":
+            points: List[Dict[str, Any]] = []
+            for tick in ticks:
+                snap = resolve(tick)
+                if snap is None:
+                    points.append({"t": tick, "snapshot": None, "values": None})
+                    continue
+                values = self._with_loaded(tenant, snap.leaves, snap.consensus, _values_of)
+                points.append({"t": tick, "snapshot": snap.meta(), "values": values})
+            out["points"] = points
+        else:
+            intervals: List[Dict[str, Any]] = []
+            for a, b in zip(ticks[:-1], ticks[1:]):
+                base, head = resolve(a), resolve(b)
+                entry: Dict[str, Any] = {"start": a, "end": b}
+                if head is None:
+                    # history starts after this tick pair and nothing was
+                    # ever evicted: the interval is exactly empty
+                    entry.update(snapshot=None, baseline=None, values=None)
+                    intervals.append(entry)
+                    continue
+                if base is not None and base.generation != head.generation:
+                    if _obs_enabled():
+                        _obs_inc("history.fenced_range_queries", tenant=tenant_id)
+                    raise GenerationFencedRangeError(
+                        f"tenant {tenant_id!r}: delta [{a}, {b}] spans a generation"
+                        f" boundary ({base.generation} -> {head.generation}) — the"
+                        " two sides were cut under different promoted roots and"
+                        " differencing across a failover would subtract two"
+                        " histories. Split the range at the boundary or query"
+                        " mode=cumulative."
+                    )
+                older = base.leaves if base is not None else tenant.template_leaves
+                leaves = delta_leaves(tenant.spec, head.leaves, older)
+                values = self._with_loaded(tenant, leaves, head.consensus, _values_of)
+                entry.update(
+                    snapshot=head.meta(),
+                    baseline=None if base is None else base.meta(),
+                    values=values,
+                )
+                intervals.append(entry)
+            out["intervals"] = intervals
+        if _obs_enabled():
+            _obs_observe("history.range_query_ms", (time.perf_counter() - t0) * 1000.0)
+        return out
+
+    def _with_loaded(self, tenant: Any, leaves: Sequence[np.ndarray],
+                     consensus: Sequence[np.ndarray], fn: Callable[[Any], Any]) -> Any:
+        """Run ``fn(view)`` with the tenant's view state TEMPORARILY
+        replaced by the given spec-ordered leaves, under ``view_lock``
+        with capture-and-restore — the live merged state (and any
+        concurrent scrape) is bitwise undisturbed."""
+        from metrics_tpu.utilities.checkpoint import (
+            load_metric_state_tree,
+            metric_state_to_tree,
+        )
+
+        tree: Dict[str, Any] = {}
+        for (path, _), leaf in zip(tenant.spec, leaves):
+            _tree_set(tree, path, leaf)
+        for path, leaf in zip(tenant.consensus_paths, consensus):
+            _tree_set(tree, path, leaf)
+        with tenant.view_lock:
+            saved = metric_state_to_tree(tenant.view)
+            try:
+                load_metric_state_tree(tenant.view, tree)
+                with warnings.catch_warnings():
+                    # an EMPTY interval (no traffic between two cuts) is a
+                    # legitimate history answer, not the compute-before-
+                    # update misuse the base-class warning polices
+                    warnings.filterwarnings(
+                        "ignore", message=".*compute.*method of metric.*"
+                    )
+                    return fn(tenant.view)
+            finally:
+                load_metric_state_tree(tenant.view, saved)
+
+    # -- introspection ---------------------------------------------------
+
+    def tenant_intervals(self, tenant_id: str) -> List[Dict[str, Any]]:
+        """Retained interval descriptors (oldest first) for one tenant —
+        the admin/debug view of the ring ladder."""
+        with self._lock:
+            th = self._tenants.get(str(tenant_id))
+            if th is None:
+                return []
+            return [dict(snap.meta(), level=level) for level, snap in th.retained()]
+
+    def evicted_count(self, tenant_id: str) -> int:
+        with self._lock:
+            th = self._tenants.get(str(tenant_id))
+            return 0 if th is None else th.evicted
+
+    # -- durability (rides Aggregator.save/restore) ----------------------
+
+    def state_for_checkpoint(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(pytree, manifest meta) of every retained ring — positional
+        ``h000000`` slots exactly like the registry's tenant slots
+        (hostile tenant ids never become filesystem paths)."""
+        tree: Dict[str, Any] = {}
+        meta: Dict[str, Any] = {"tenants": {}, "intervals": {}, "state": {}}
+        with self._lock:
+            for h_idx, tenant_id in enumerate(sorted(self._tenants)):
+                th = self._tenants[tenant_id]
+                hslot = f"h{h_idx:06d}"
+                meta["tenants"][hslot] = tenant_id
+                meta["state"][hslot] = {
+                    "next_index": th.next_index,
+                    "evicted": th.evicted,
+                    "last_evicted_t": th.last_evicted_t,
+                }
+                descriptors: List[List[Any]] = []
+                slots: Dict[str, Any] = {}
+                for j, (level, snap) in enumerate(th.retained()):
+                    descriptors.append(
+                        [snap.index, snap.t, snap.generation, level, snap.clients, snap.folded]
+                    )
+                    slots[f"i{j:06d}"] = {
+                        "leaves": {f"l{i:06d}": leaf for i, leaf in enumerate(snap.leaves)},
+                        "consensus": {
+                            f"l{i:06d}": leaf for i, leaf in enumerate(snap.consensus)
+                        },
+                    }
+                meta["intervals"][hslot] = descriptors
+                if slots:
+                    tree[hslot] = slots
+            meta["generation"] = self.generation
+            meta["last_cut_s"] = self._last_cut_s
+        return tree, meta
+
+    def load_checkpoint_state(self, tree: Dict[str, Any], meta: Dict[str, Any],
+                              aggregator: Any) -> None:
+        """Rebuild the rings bitwise from a checkpoint written by
+        :meth:`state_for_checkpoint` (called from
+        :meth:`Aggregator.restore` after tenants re-registered). Rings
+        are replaced wholesale; tenants the checkpoint does not name
+        keep whatever they have (a fresh node: nothing)."""
+        with self._lock:
+            for hslot, tenant_id in (meta.get("tenants") or {}).items():
+                if tenant_id not in aggregator._tenants:
+                    continue  # aggregator.restore already validated registration
+                tenant = aggregator._tenants[tenant_id]
+                th = _TenantHistory(tenant_id, self.config.levels)
+                state = (meta.get("state") or {}).get(hslot) or {}
+                th.next_index = int(state.get("next_index", 0))
+                th.evicted = int(state.get("evicted", 0))
+                last_t = state.get("last_evicted_t")
+                th.last_evicted_t = None if last_t is None else float(last_t)
+                slots = tree.get(hslot, {})
+                for j, desc in enumerate(meta.get("intervals", {}).get(hslot) or []):
+                    index, t, generation, level, clients, folded = desc
+                    data = slots[f"i{j:06d}"]
+                    leaves = [
+                        np.asarray(data["leaves"][f"l{i:06d}"]).astype(tpl.dtype).reshape(tpl.shape)
+                        for i, tpl in enumerate(tenant.template_leaves)
+                    ]
+                    consensus = [
+                        np.asarray(data["consensus"][f"l{i:06d}"]).astype(tpl.dtype).reshape(tpl.shape)
+                        for i, tpl in enumerate(tenant.template_consensus)
+                    ]
+                    th.restore_insert(
+                        min(int(level), len(self.config.levels) - 1),
+                        IntervalSnapshot(
+                            int(index), float(t), int(generation), int(clients),
+                            int(folded), leaves, consensus,
+                        ),
+                    )
+                self._tenants[tenant_id] = th
+            gen = meta.get("generation")
+            if gen is not None and int(gen) > self.generation:
+                self.generation = int(gen)
+            if _obs_enabled():
+                for tenant_id, th in self._tenants.items():
+                    _obs_gauge("history.intervals", float(len(th.retained())), tenant=tenant_id)
+
+
+def _values_of(view: Any) -> Dict[str, Any]:
+    """Computed values + streaming envelopes of a (temporarily loaded)
+    collection view — the same shape :meth:`Aggregator.query` answers."""
+    values: Dict[str, Any] = {}
+    computed = view.compute()
+    members = dict(view.items())
+    for name, value in computed.items():
+        entry: Dict[str, Any] = {"value": _jsonable(value)}
+        metric = members.get(name)
+        if metric is not None and hasattr(metric, "bounds") and hasattr(metric, "error_bound"):
+            lo, hi = metric.bounds()
+            entry["bounds"] = [_jsonable(lo), _jsonable(hi)]
+            entry["error_bound"] = _jsonable(metric.error_bound())
+        values[name] = entry
+    return values

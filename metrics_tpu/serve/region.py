@@ -153,6 +153,14 @@ class Region:
         resilience / max_queue / seed: forwarded to the regional tier.
         generation: starting failover generation (normally 0; a promoted
             standby is built by :meth:`standby` with the successor value).
+        history: arm the GLOBAL view's time-travel tier — ``True`` for
+            :class:`~metrics_tpu.serve.history.HistoryConfig` defaults, or
+            a config instance. Interval cuts stamp the region's failover
+            generation, so delta range queries across a promotion are
+            fenced (:class:`~metrics_tpu.serve.history.GenerationFencedRangeError`)
+            until re-asked per generation or as ``mode=cumulative``;
+            retained in the standby recipe, so a promoted successor is
+            history-armed too.
     """
 
     def __init__(
@@ -170,6 +178,7 @@ class Region:
         max_queue: int = 4096,
         seed: int = 0,
         generation: int = 0,
+        history: Any = None,
     ) -> None:
         if stale_reads not in ("degraded", "reject"):
             raise ValueError(f"stale_reads must be 'degraded' or 'reject', got {stale_reads!r}")
@@ -189,6 +198,7 @@ class Region:
             resilience=resilience,
             max_queue=int(max_queue),
             seed=int(seed),
+            history=history,
         )
         self.max_staleness_s = None if max_staleness_s is None else float(max_staleness_s)
         self.stale_reads = stale_reads
@@ -237,14 +247,21 @@ class Region:
             for tenant_id, factory in tenants.items():
                 self.local_root.register_tenant(tenant_id, factory)
 
+        # history arms the GLOBAL view: the replica table is the one state
+        # whose intervals answer "per tenant, across every region, over
+        # time" — and its checkpoint/restore + generation fencing ride the
+        # same global_ckpt manifest the failover protocol already repairs
         self.global_view = Aggregator(
             f"{self.name}.global",
             max_queue=max_queue,
             checkpoint_dir=global_ckpt,
             engine=engine,
+            history=history,
         )
         for tenant_id, factory in tenants.items():
             self.global_view.register_tenant(tenant_id, factory)
+        if self.global_view.history is not None:
+            self.global_view.history.generation = int(generation)
         self._stamp_manifest_extra()
 
         # replica ship sequence WITHIN the current generation: watermark =
@@ -510,6 +527,11 @@ class Region:
         with self._lock:
             self.generation = int(generation)
             self._ship_seq = itertools.count(0)
+        if self.global_view.history is not None:
+            # later interval cuts stamp the new generation, fencing delta
+            # range queries across the failover boundary (pre-promotion
+            # intervals keep their OLD stamp — cumulative reads stay exact)
+            self.global_view.history.generation = int(generation)
         self._stamp_manifest_extra()
         if _obs_enabled():
             _obs_gauge("serve.region_generation", float(self.generation), region=self.name)

@@ -477,19 +477,34 @@ class TestHistograms:
 
 def _parse_prometheus(text: str):
     """Minimal exposition-format parser for the round-trip test: returns
-    ({family: kind}, [(name, {label: value}, float)]). Honours quoted label
-    values with backslash escapes — format drift here must fail loudly."""
+    ({family: kind}, [(name, {label: value}, float)], {family: help}).
+    Honours quoted label values with backslash escapes, and HELP-line
+    escaping (backslash/newline) — format drift here must fail loudly."""
     import re as _re
 
-    types, series = {}, []
+    types, series, helps = {}, [], {}
     name_re = _re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("#"):
             parts = line.split(" ", 3)
-            assert parts[0] == "#" and parts[1] == "TYPE", line
+            assert parts[0] == "#" and parts[1] in ("TYPE", "HELP"), line
             assert name_re.match(parts[2]), parts[2]
+            if parts[1] == "HELP":
+                assert parts[2] not in helps, f"family {parts[2]} HELP twice"
+                # HELP precedes TYPE for its family (Prometheus convention)
+                assert parts[2] not in types, f"HELP for {parts[2]} after its TYPE"
+                raw, buf, i = parts[3] if len(parts) > 3 else "", [], 0
+                while i < len(raw):
+                    if raw[i] == "\\":
+                        buf.append({"n": "\n", "\\": "\\"}[raw[i + 1]])
+                        i += 2
+                    else:
+                        buf.append(raw[i])
+                        i += 1
+                helps[parts[2]] = "".join(buf)
+                continue
             assert parts[3] in ("counter", "gauge", "histogram"), line
             assert parts[2] not in types, f"family {parts[2]} typed twice"
             types[parts[2]] = parts[3]
@@ -520,7 +535,7 @@ def _parse_prometheus(text: str):
             labels = {}
         assert name_re.match(name.split("_bucket")[0]), name
         series.append((name, labels, float(value_str)))
-    return types, series
+    return types, series, helps
 
 
 class TestPrometheusRoundTrip:
@@ -532,12 +547,24 @@ class TestPrometheusRoundTrip:
         obs.inc("events", 3, kind="a")
         obs.inc("events", kind='hosti,le="v\\al\nue')
         obs.set_gauge("level", 7.25, zone="z1")
+        obs.inc("serve.ingests", 2)  # built-in family: ships a HELP line
         for v in (0.5, 5.0, 50.0):
             obs.observe("lat", v, step="epoch")
-        types, series = _parse_prometheus(obs.to_prometheus())
+        obs.register_help("events", "hostile\\help\ntext")
+        try:
+            types, series, helps = _parse_prometheus(obs.to_prometheus())
+        finally:
+            from metrics_tpu.obs import export as _export
+
+            _export._FAMILY_HELP.pop("events", None)
         assert types["metrics_tpu_events"] == "counter"
         assert types["metrics_tpu_level"] == "gauge"
         assert types["metrics_tpu_lat"] == "histogram"
+        # HELP: registered families carry one escaped line ahead of TYPE;
+        # unregistered families export with TYPE only
+        assert helps["metrics_tpu_events"] == "hostile\\help\ntext"
+        assert helps["metrics_tpu_serve_ingests"] == obs.family_help("serve.ingests")
+        assert "metrics_tpu_level" not in helps
         by_name = {}
         for name, labels, value in series:
             by_name.setdefault(name, []).append((labels, value))

@@ -185,7 +185,7 @@ class TestMergeAlgebra:
         )
         series: dict = {}
         for line in text.strip().splitlines():
-            if line.startswith("# TYPE"):
+            if line.startswith(("# TYPE", "# HELP")):
                 continue
             m = line_re.match(line)
             assert m is not None, f"unparseable exposition line: {line!r}"

@@ -1,0 +1,331 @@
+"""CI smoke: the time-travel tier keeps its bitwise oracle under chaos.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.history_smoke``
+(the CI step does, mirroring ``region_smoke``). One history-armed root
+(:class:`~metrics_tpu.serve.Aggregator` with ``history=HistoryConfig``)
+fed a loadgen-style client stream under a seeded 10%
+:class:`~metrics_tpu.ft.faults.WireChaos` schedule, rings cut live after
+every interval, driven through the failure arcs the tier exists for:
+
+* **kill + restore mid-retention** — the root is checkpointed and
+  hard-killed (state dropped with no drain), a fresh root restores the
+  ring ladder from the :class:`~metrics_tpu.ft.CheckpointManager`
+  manifest and keeps cutting; restored-era and post-restore snapshots
+  answer from the same ladder.
+* **injected metric regression** — two intervals of inverted label
+  correlation crater the interval-delta AUROC; the root-evaluated
+  :class:`~metrics_tpu.serve.AlertRule` fires **exactly once**
+  (edge-triggered through the one-shot-warn machinery), stays firing
+  without recounting, and clears on recovery.
+* **sustained traffic vs the ring bound** — more intervals than the
+  compaction ladder retains: rollups compact by monoid merge, the
+  coarsest ring evicts, and evictions are counted under
+  ``history.intervals_evicted`` while pre-horizon queries refuse with
+  :class:`~metrics_tpu.serve.HistoryRetentionError`.
+* **cross-region failover fencing** — a two-region mesh with
+  history-armed global views: kill + generation-fenced promotion, then a
+  delta range spanning the promotion boundary refuses with
+  :class:`~metrics_tpu.serve.GenerationFencedRangeError` while
+  per-generation sub-ranges and cumulative reads stay exact, and the
+  healed peers' next cumulative re-ship repairs the global range view
+  **bitwise**.
+
+Acceptance: EVERY retained interval snapshot — through ring rotation,
+rollup compaction, kill+restore and failover — is bitwise-equal to the
+flat oracle merge of exactly the snapshots accepted by that cut, and
+every injected fault is visible in obs counters.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260806
+N_CLIENTS = 16
+N_INTERVALS = 12
+SAMPLES = 48
+TENANT = "hist"
+REGRESSED = (8, 9)  # intervals shipped with inverted label correlation
+KILL_AFTER = 5  # cut + checkpoint interval 5, then kill + restore
+LEVELS = ((1.0, 2), (2.0, 2), (4.0, 1))  # 12 cuts overflow this ladder
+REGIONS = ("east", "west")
+
+
+def _factory():
+    from metrics_tpu import SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingAUROC
+
+    # both members are delta-queryable (sum subtracts, the sketch's
+    # histogram bins subtract) — the alert rule reads interval AUROC
+    return MetricCollection({"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric()})
+
+
+def _client_snapshots():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for c in range(N_CLIENTS):
+        cid = f"client-{c:03d}"
+        rng = np.random.default_rng(7000 + c)
+        coll = _factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            preds = jnp.asarray(rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            p = np.asarray(preds)
+            if interval in REGRESSED:
+                prob = 0.7 - 0.4 * p  # anti-correlated: interval AUROC ~ 0.3
+            else:
+                prob = 0.3 + 0.4 * p  # healthy: interval AUROC ~ 0.7
+            target = jnp.asarray((rng.uniform(0, 1, SAMPLES) < prob).astype(np.int32))
+            coll["auroc"].update(preds, target)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+            blobs.append(encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval)))
+        out[cid] = blobs
+    return out
+
+
+def main() -> None:
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.serve import (
+        Aggregator,
+        AlertRule,
+        GenerationFencedRangeError,
+        HistoryConfig,
+        HistoryRetentionError,
+        Region,
+        RegionalMesh,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, peek_header
+
+    obs.reset()
+    obs.enable()
+    root = tempfile.mkdtemp(prefix="history_smoke_")
+    rule = AlertRule("auroc-regression", TENANT, "auroc", below=0.45, on="delta")
+
+    def history_config():
+        return HistoryConfig(cut_every_s=float("inf"), levels=LEVELS, rules=(rule,))
+
+    agg = Aggregator("history-root", checkpoint_dir=root, history=history_config())
+    agg.register_tenant(TENANT, _factory)
+    snapshots = _client_snapshots()
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.025, p_duplicate=0.025, p_reorder=0.025, p_corrupt=0.025, p_delay=0.0
+    )
+    delivered = set()  # (client_id, interval) delivered uncorrupted + admitted
+    accepted_at_cut = {}  # interval -> {client_id: highest accepted interval}
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                continue  # framing mangled: refused before routing
+            cid = str(header["client"])
+            try:
+                agg.ingest(blob)
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+            else:
+                delivered.add((cid, int(header["watermark"][1])))
+
+    def flat_oracle(accepted):
+        flat = Aggregator(f"flat-oracle-{len(accepted_at_cut)}")
+        flat.register_tenant(TENANT, _factory)
+        for cid, interval in sorted(accepted.items()):
+            flat.ingest(snapshots[cid][interval])
+        flat.flush()
+        ft = flat._tenant(TENANT)
+        if ft.merged_leaves is None:
+            ft.fold()
+        return ft
+
+    # ---- the loadgen stream: cut rings live, kill + restore mid-way -----
+    restored = False
+    with warnings.catch_warnings():
+        # the injected regression's one-shot FIRING warn is the point, not noise
+        warnings.filterwarnings("ignore", message=".*history alert.*FIRING.*")
+        for interval in range(N_INTERVALS):
+            for cid in sorted(snapshots):
+                _, now_blobs = chaos.plan(snapshots[cid][interval])
+                deliver(now_blobs)
+            deliver(chaos.end_round())
+            agg.flush()
+            agg.history.cut(agg, now=float(interval))
+            accepted = {}
+            for cid, iv in delivered:
+                if cid not in accepted or iv > accepted[cid]:
+                    accepted[cid] = iv
+            accepted_at_cut[interval] = accepted
+            if interval == KILL_AFTER:
+                # checkpoint, then SIGKILL-sim: drop the root with no drain
+                # and restore the ring ladder into a brand-new process image
+                agg.save()
+                agg = Aggregator("history-root-revived", checkpoint_dir=root,
+                                 history=history_config())
+                agg.register_tenant(TENANT, _factory)
+                agg.restore()
+                restored = True
+                th = agg.history._tenants[TENANT]
+                assert th.newest() is not None and th.newest().t == float(KILL_AFTER), (
+                    "restore must reproduce the ladder up to the checkpointed cut"
+                )
+    assert restored
+
+    # ---- ring bound enforced under sustained traffic --------------------
+    th = agg.history._tenants[TENANT]
+    capacity = sum(cap for _, cap in LEVELS)
+    retained = th.retained()
+    assert len(retained) <= capacity, (len(retained), capacity)
+    assert th.evicted >= 1, "12 cuts must overflow the coarsest ring"
+    assert obs.get_counter("history.intervals_evicted", tenant=TENANT) == th.evicted
+    assert obs.get_counter("history.rollups", tenant=TENANT) >= 1
+    assert obs.get_counter("history.cuts", tenant=TENANT) == float(N_INTERVALS)
+    cut_hist = obs.get_histogram("history.cut_ms")
+    assert cut_hist is not None and cut_hist.count == N_INTERVALS
+
+    # ---- the oracle pin: every retained snapshot, bitwise ----------------
+    # through ring rotation, rollup compaction and the kill+restore, each
+    # retained interval must equal the flat merge of exactly the client
+    # snapshots its cut had accepted
+    tenant = agg._tenant(TENANT)
+    spans_restore = 0
+    for _, snap in retained:
+        ft = flat_oracle(accepted_at_cut[int(snap.t)])
+        assert tenant.spec == ft.spec
+        for (path, _), ours, oracle in zip(tenant.spec, snap.leaves, ft.merged_leaves):
+            assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+                f"retained interval t={snap.t} leaf {'/'.join(path)} differs"
+                " from the accepted-snapshot oracle"
+            )
+        if snap.t <= float(KILL_AFTER):
+            spans_restore += 1
+    assert spans_restore >= 1, "a restored-era snapshot must survive to the end"
+
+    # ---- range queries over the ladder ----------------------------------
+    ts = [snap.t for _, snap in retained]
+    res = agg.history_query(TENANT, ts[0], ts[-1], step=2.0, mode="delta")
+    assert res["generation"] == 0 and len(res["intervals"]) >= 2
+
+    def seen_between(a, b):
+        acc_a, acc_b = accepted_at_cut[a], accepted_at_cut[b]
+        return float(sum(
+            ((acc_b[c] + 1) - (acc_a.get(c, -1) + 1)) * SAMPLES for c in acc_b
+        ))
+
+    total_seen = sum(iv["values"]["seen"]["value"] for iv in res["intervals"])
+    assert total_seen == seen_between(int(ts[0]), int(ts[-1])), (
+        "composed interval deltas must telescope to the endpoint delta"
+    )
+    for iv in res["intervals"]:
+        lo, hi = iv["values"]["auroc"]["bounds"]
+        assert lo <= iv["values"]["auroc"]["value"] <= hi
+        assert iv["values"]["auroc"]["error_bound"] >= 0.0
+    try:
+        agg.history_query(TENANT, 0.0, ts[0] - 1.0)
+        raise AssertionError("pre-horizon range must refuse: those intervals evicted")
+    except HistoryRetentionError:
+        pass
+    assert obs.get_counter("history.range_queries", tenant=TENANT, mode="delta") >= 2
+
+    # ---- the injected regression fired its rule exactly once -------------
+    assert obs.get_counter("history.alerts", rule=rule.name, tenant=TENANT) == 1, (
+        "two regressed intervals + recovery must count ONE edge-triggered firing"
+    )
+    assert agg.history.active_alerts() == [], "the rule must have cleared on recovery"
+    assert obs.get_gauge("history.alert_active", rule=rule.name, tenant=TENANT) == 0.0
+
+    # ---- every injected wire fault is visible in obs ---------------------
+    for kind, count in chaos.counts.items():
+        if kind in ("deliver", "reorder") or count == 0:
+            continue
+        assert obs.get_counter("chaos.injected", kind=kind) == count, kind
+
+    # ---- failover arm: generation-fenced historical reads ----------------
+    mesh = RegionalMesh([
+        Region(name, {TENANT: _factory}, checkpoint_dir=os.path.join(root, name),
+               history=HistoryConfig(cut_every_s=float("inf"), levels=((1.0, 8),)))
+        for name in REGIONS
+    ])
+    home = {cid: REGIONS[i % len(REGIONS)] for i, cid in enumerate(sorted(snapshots))}
+
+    def deliver_and_cut(interval: int) -> None:
+        for cid in sorted(snapshots):
+            mesh.region(home[cid]).ingest(snapshots[cid][interval], client_id=cid)
+        mesh.replicate()
+        for name in REGIONS:
+            region = mesh.region(name)
+            region.query_global(TENANT)  # self-ship + fold: the view is current
+            region.global_view.history.cut(region.global_view, now=float(interval))
+
+    deliver_and_cut(0)
+    deliver_and_cut(1)
+    for name in REGIONS:
+        mesh.region(name).save()
+    faults.kill_region(mesh, "west")
+    promoted = faults.promote_region(mesh, "west")
+    assert promoted.generation >= 1
+    assert promoted.global_view.history.generation == promoted.generation, (
+        "promotion must stamp the successor generation into the history tier"
+    )
+    gth = promoted.global_view.history._tenants[TENANT]
+    assert gth.newest() is not None and gth.newest().t == 1.0, (
+        "the promoted standby must restore the pre-kill ring ladder"
+    )
+    deliver_and_cut(2)  # the healed peers' cumulative re-ships repair the view
+
+    # a delta range across the promotion boundary is fenced, loudly
+    try:
+        promoted.global_view.history_query(TENANT, 1.0, 2.0, mode="delta")
+        raise AssertionError("cross-generation delta range must be fenced")
+    except GenerationFencedRangeError:
+        pass
+    assert obs.get_counter("history.fenced_range_queries", tenant=TENANT) >= 1
+    # per-generation sub-ranges and cumulative reads stay exact
+    pre = promoted.global_view.history_query(TENANT, 0.0, 1.0, mode="delta")
+    assert len(pre["intervals"]) == 1
+    cum = promoted.global_view.history_query(TENANT, 0.0, 2.0, mode="cumulative")
+    gens = [pt["snapshot"]["generation"] for pt in cum["points"]]
+    assert gens[0] < promoted.generation and gens[-1] == promoted.generation, gens
+
+    # post-heal the global range view is bitwise-equal to the flat oracle
+    everyone = {cid: 2 for cid in snapshots}
+    ft = flat_oracle(everyone)
+    for name in REGIONS:
+        gv = mesh.region(name).global_view
+        newest = gv.history._tenants[TENANT].newest()
+        gt = gv._tenant(TENANT)
+        assert gt.spec == ft.spec
+        for (path, _), ours, oracle in zip(gt.spec, newest.leaves, ft.merged_leaves):
+            assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+                f"region {name} global interval leaf {'/'.join(path)} differs"
+                " from the oracle after kill+promote — the re-ship must repair"
+                " the range view bitwise"
+            )
+    assert obs.get_counter("chaos.injected", kind="region_kill") == 1
+    assert obs.get_counter("chaos.injected", kind="promote") == 1
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    print(
+        f"history smoke: {N_CLIENTS} clients x {N_INTERVALS} intervals at 10% wire"
+        f" faults ({faults_injected} injected) through live ring cuts, kill+restore"
+        f" @ t={KILL_AFTER}, {int(th.evicted)} eviction(s) under the"
+        f" {LEVELS} ladder, one edge-triggered alert firing, and a"
+        f" generation-fenced failover (gen {promoted.generation}) — every retained"
+        " interval bitwise-equal to the accepted-snapshot oracle",
+        flush=True,
+    )
+    print("history smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

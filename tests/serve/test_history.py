@@ -1,0 +1,399 @@
+"""Time-travel tier: retention rings, delta algebra, rollups, alerts.
+
+Pins the PR-17 contracts: the interval-delta algebra is an exact monoid
+action (``delta(a,b) ⊕ delta(b,c) == delta(a,c)`` bitwise for sum and
+sketch states, loud typed refusal for plain max/min), rings stay bounded
+with counted evictions, rollup compaction is bitwise-invisible to range
+answers, checkpoint restore reproduces the ladder bitwise, alert rules
+are edge-triggered through the one-shot-warn machinery, and failover
+generations fence delta reads while cumulative reads stay exact.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.obs as obs
+from metrics_tpu.aggregation import MaxMetric, SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import Aggregator, MetricsServer, ServeError
+from metrics_tpu.serve.history import (
+    AlertRule,
+    DeltaUndefinedError,
+    GenerationFencedRangeError,
+    HistoryConfig,
+    HistoryRetentionError,
+    delta_leaves,
+    merge_delta_leaves,
+)
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingAUROC
+
+TENANT = "hist"
+N_CLIENTS = 3
+SAMPLES = 32
+
+
+def factory() -> MetricCollection:
+    return MetricCollection({"auroc": StreamingAUROC(num_bins=64), "seen": SumMetric()})
+
+
+def max_factory() -> MetricCollection:
+    return MetricCollection({"peak": MaxMetric(), "seen": SumMetric()})
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    was = obs.enabled()
+    obs.enable(False)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.enable(was)
+
+
+def manual_history(**kwargs) -> HistoryConfig:
+    # cut_every_s=inf: cuts happen ONLY via explicit cut(now=...) calls, so
+    # synthetic timestamps never interleave with wall-clock cadence cuts
+    kwargs.setdefault("cut_every_s", float("inf"))
+    return HistoryConfig(**kwargs)
+
+
+def feed(agg, interval: int, rng, *, fac=factory, tenant: str = TENANT) -> None:
+    """Ship every client's CUMULATIVE state through interval `interval`
+    (the at-least-once contract: each ship carries everything so far)."""
+    for c in range(N_CLIENTS):
+        coll = fac()
+        client_rng = np.random.default_rng(1000 * c + 7)
+        for k in range(interval + 1):
+            scores = jnp.asarray(client_rng.uniform(0, 1, SAMPLES).astype(np.float32))
+            labels = jnp.asarray((client_rng.uniform(0, 1, SAMPLES) < 0.5).astype(np.int32))
+            if "auroc" in dict(coll.items()):
+                coll["auroc"].update(scores, labels)
+            if "peak" in dict(coll.items()):
+                coll["peak"].update(scores)
+            coll["seen"].update(jnp.asarray(float(SAMPLES)))
+        agg.ingest(encode_state(coll, tenant=tenant, client_id=f"c{c}", watermark=(0, interval)))
+    agg.flush()
+
+
+def build_history(n_intervals: int, config=None, fac=factory):
+    agg = Aggregator("hist-test", history=config or manual_history())
+    agg.register_tenant(TENANT, fac)
+    rng = np.random.default_rng(0)
+    for interval in range(n_intervals):
+        feed(agg, interval, rng, fac=fac)
+        agg.history.cut(agg, now=float(interval))
+    return agg
+
+
+class TestDeltaAlgebra:
+    """delta(a,b) ⊕ delta(b,c) == delta(a,c), bitwise, per spec leaf."""
+
+    def _cumulative_leaves(self, n: int):
+        """Three+ genuinely different cumulative leaf snapshots for the
+        real tenant spec, captured from live folds (not synthesized —
+        the algebra must hold on what the aggregator actually stores)."""
+        agg = build_history(n)
+        tenant = agg._tenant(TENANT)
+        th = agg.history._tenants[TENANT]
+        snaps = [snap for _, snap in th.retained()]
+        assert len(snaps) == n
+        return tenant.spec, [s.leaves for s in snaps]
+
+    def test_delta_compose_associative_bitwise(self):
+        spec, cum = self._cumulative_leaves(4)
+        a, b, c = cum[0], cum[2], cum[3]
+        direct = delta_leaves(spec, c, a)
+        composed = merge_delta_leaves(spec, delta_leaves(spec, b, a), delta_leaves(spec, c, b))
+        for (path, red), lhs, rhs in zip(spec, direct, composed):
+            assert lhs.dtype == rhs.dtype, path
+            assert np.array_equal(lhs, rhs), (path, red)
+
+    def test_fold_order_invariance_of_deltas(self):
+        # composing left-to-right vs right-nested over three intervals
+        # lands bitwise identical (associativity across fold orders)
+        spec, cum = self._cumulative_leaves(4)
+        d01 = delta_leaves(spec, cum[1], cum[0])
+        d12 = delta_leaves(spec, cum[2], cum[1])
+        d23 = delta_leaves(spec, cum[3], cum[2])
+        left = merge_delta_leaves(spec, merge_delta_leaves(spec, d01, d12), d23)
+        right = merge_delta_leaves(spec, d01, merge_delta_leaves(spec, d12, d23))
+        for (path, _), lhs, rhs in zip(spec, left, right):
+            assert np.array_equal(lhs, rhs), path
+
+    def test_sum_leaves_subtract_sketch_extremes_carry(self):
+        spec, cum = self._cumulative_leaves(2)
+        d = delta_leaves(spec, cum[1], cum[0])
+        for (path, red), older, newer, leaf in zip(spec, cum[0], cum[1], d):
+            if red == "sum":
+                assert np.array_equal(leaf, np.subtract(newer, older)), path
+            else:  # sketch envelope extreme: carried from the newer snapshot
+                assert np.array_equal(leaf, newer), path
+
+    def test_plain_max_state_refuses_delta_loudly(self):
+        agg = build_history(3, fac=max_factory)
+        tenant = agg._tenant(TENANT)
+        th = agg.history._tenants[TENANT]
+        snaps = [s for _, s in th.retained()]
+        with pytest.raises(DeltaUndefinedError, match="max/min monoid is not invertible"):
+            delta_leaves(tenant.spec, snaps[1].leaves, snaps[0].leaves)
+        with pytest.raises(DeltaUndefinedError):
+            agg.history_query(TENANT, 0.0, 2.0, mode="delta")
+        # the SAME state answers cumulatively — refusal is mode-scoped
+        out = agg.history_query(TENANT, 0.0, 2.0, mode="cumulative")
+        assert out["points"][-1]["values"]["peak"]["value"] is not None
+
+
+class TestRetentionRings:
+    def test_bounded_with_counted_evictions(self):
+        obs.enable(True)
+        levels = ((1.0, 3), (2.0, 2), (4.0, 2))
+        n = 24  # promotion into the coarsest ring lags the cut head, so
+        # overrunning ALL its buckets takes a sustained stream
+        agg = build_history(n, config=manual_history(levels=levels))
+        th = agg.history._tenants[TENANT]
+        cap_total = sum(cap for _, cap in levels)
+        assert len(th.retained()) <= cap_total
+        assert th.evicted == agg.history.evicted_count(TENANT) > 0
+        assert obs.get_counter("history.intervals_evicted", tenant=TENANT) == th.evicted
+        assert obs.get_counter("history.cuts", tenant=TENANT) == n
+        assert obs.get_gauge("history.intervals", tenant=TENANT) == len(th.retained())
+        # beyond-horizon range: exact or not at all
+        with pytest.raises(HistoryRetentionError, match="already evicted"):
+            agg.history_query(TENANT, float(th.retained()[0][1].t) - 4.0, float(n - 1))
+
+    def test_rollup_is_bitwise_invisible_to_range_answers(self):
+        # a cumulative snapshot that survived promotion into a coarser
+        # bucket answers the same delta it would have answered raw
+        levels = ((1.0, 2), (8.0, 4))
+        agg = build_history(6, config=manual_history(levels=levels))
+        th = agg.history._tenants[TENANT]
+        assert any(level > 0 for level, _ in th.retained())  # compaction happened
+        tenant = agg._tenant(TENANT)
+        by_t = {snap.t: snap for _, snap in th.retained()}
+        assert 5.0 in by_t and by_t[5.0].index == 5  # newest raw
+        # whole-range delta == compose of the per-retained-step deltas,
+        # BITWISE per spec leaf (rollup compaction changed which snapshots
+        # are held, never what any held snapshot answers)
+        out = agg.history_query(TENANT, min(by_t), 5.0, mode="delta")
+        whole = out["intervals"][0]["values"]["seen"]["value"]
+        ts = sorted(by_t)
+        spec = tenant.spec
+        acc = None
+        for t_prev, t_next in zip(ts[:-1], ts[1:]):
+            d = delta_leaves(spec, by_t[t_next].leaves, by_t[t_prev].leaves)
+            acc = d if acc is None else merge_delta_leaves(spec, acc, d)
+        direct = delta_leaves(spec, by_t[5.0].leaves, by_t[ts[0]].leaves)
+        for (path, _), lhs, rhs in zip(spec, direct, acc):
+            assert np.array_equal(lhs, rhs), path
+        # exact count check: each interval ships SAMPLES per client
+        assert whole == float(N_CLIENTS * SAMPLES * (5 - ts[0]))
+
+    def test_empty_prefix_is_identity_not_error(self):
+        # queries before the first cut, with nothing evicted, answer the
+        # exact identity (delta == cumulative since process start)
+        agg = build_history(3)
+        out = agg.history_query(TENANT, -100.0, 2.0, mode="delta")
+        assert out["evicted"] == 0
+        assert out["intervals"][0]["baseline"] is None
+        assert out["intervals"][0]["values"]["seen"]["value"] == float(
+            N_CLIENTS * SAMPLES * 3
+        )
+
+    def test_range_values_carry_error_envelopes(self):
+        agg = build_history(3)
+        out = agg.history_query(TENANT, 0.0, 2.0, step=1.0, mode="delta")
+        assert len(out["intervals"]) == 2
+        for entry in out["intervals"]:
+            auroc = entry["values"]["auroc"]
+            assert "error_bound" in auroc and "bounds" in auroc
+            lo, hi = auroc["bounds"]
+            assert lo <= auroc["value"] <= hi
+
+    def test_live_query_undisturbed_by_range_reads(self):
+        agg = build_history(4)
+        before = agg.query(TENANT)["values"]["seen"]["value"]
+        agg.history_query(TENANT, 0.0, 3.0, step=1.0)
+        agg.history_query(TENANT, 1.0, 2.0, mode="cumulative")
+        assert agg.query(TENANT)["values"]["seen"]["value"] == before
+
+
+class TestDurability:
+    def test_restore_reproduces_ladder_bitwise(self, tmp_path):
+        config = manual_history(levels=((1.0, 3), (4.0, 3)))
+        agg = Aggregator("a", checkpoint_dir=str(tmp_path), history=config)
+        agg.register_tenant(TENANT, factory)
+        rng = np.random.default_rng(0)
+        for interval in range(6):
+            feed(agg, interval, rng)
+            agg.history.cut(agg, now=float(interval))
+        agg.save()
+        want = agg.history_query(TENANT, 1.0, 5.0, step=2.0, mode="delta")
+
+        revived = Aggregator(
+            "b", checkpoint_dir=str(tmp_path), history=manual_history(levels=((1.0, 3), (4.0, 3)))
+        )
+        revived.register_tenant(TENANT, factory)
+        revived.restore()
+        ta, tb = agg.history._tenants[TENANT], revived.history._tenants[TENANT]
+        assert tb.next_index == ta.next_index and tb.evicted == ta.evicted
+        pa, pb = ta.retained(), tb.retained()
+        assert [(lvl, s.index, s.t, s.generation) for lvl, s in pa] == [
+            (lvl, s.index, s.t, s.generation) for lvl, s in pb
+        ]
+        for (_, sa), (_, sb) in zip(pa, pb):
+            for la, lb in zip(sa.leaves, sb.leaves):
+                assert la.dtype == lb.dtype and np.array_equal(la, lb)
+            for ca, cb in zip(sa.consensus, sb.consensus):
+                assert np.array_equal(ca, cb)
+        got = revived.history_query(TENANT, 1.0, 5.0, step=2.0, mode="delta")
+        assert got["intervals"] == want["intervals"]
+
+    def test_restore_without_history_armed_is_ignored(self, tmp_path):
+        agg = Aggregator("a", checkpoint_dir=str(tmp_path), history=manual_history())
+        agg.register_tenant(TENANT, factory)
+        feed(agg, 0, np.random.default_rng(0))
+        agg.history.cut(agg, now=0.0)
+        agg.save()
+        plain = Aggregator("b", checkpoint_dir=str(tmp_path))
+        plain.register_tenant(TENANT, factory)
+        plain.restore()  # history slots in the checkpoint, no history armed
+        assert plain.history is None
+        assert plain.query(TENANT)["clients"] == N_CLIENTS
+
+
+class TestAlertRules:
+    def _regression_agg(self):
+        rule = AlertRule("seen-stall", TENANT, "seen", below=float(N_CLIENTS * SAMPLES) - 0.5)
+        return Aggregator(
+            "alerts", history=manual_history(rules=[rule])
+        )
+
+    def test_edge_triggered_exactly_once_with_one_shot_warn(self):
+        obs.enable(True)
+        agg = self._regression_agg()
+        agg.register_tenant(TENANT, factory)
+        rng = np.random.default_rng(0)
+        feed(agg, 0, rng)
+        agg.history.cut(agg, now=0.0)  # first cut: no delta baseline yet
+        feed(agg, 1, rng)
+        with pytest.warns(UserWarning, match="seen-stall.*FIRING") as rec:
+            agg.history.cut(agg, now=1.0)  # healthy delta? no: below fires?
+            # interval 1 delta carries a full batch -> healthy, no firing
+            # on THIS cut; stall the stream instead:
+            agg.flush()
+            agg.history.cut(agg, now=2.0)  # delta == empty -> seen=0 -> fire
+            agg.history.cut(agg, now=3.0)  # still stalled: NO second count
+        assert obs.get_counter("history.alerts", rule="seen-stall", tenant=TENANT) == 1
+        assert obs.get_gauge("history.alert_active", rule="seen-stall", tenant=TENANT) == 1.0
+        firing = [w for w in rec if "FIRING" in str(w.message)]
+        assert len(firing) == 1  # one-shot warn while it stays in violation
+        assert agg.history.active_alerts() == [
+            {
+                "rule": "seen-stall",
+                "tenant": TENANT,
+                "detail": agg.history.active_alerts()[0]["detail"],
+            }
+        ]
+        # recovery clears the gauge and re-arms the edge
+        feed(agg, 2, rng)
+        agg.history.cut(agg, now=4.0)
+        assert agg.history.active_alerts() == []
+        assert obs.get_gauge("history.alert_active", rule="seen-stall", tenant=TENANT) == 0.0
+        agg.flush()
+        agg.history.cut(agg, now=5.0)  # stalled again: second EDGE counts
+        assert obs.get_counter("history.alerts", rule="seen-stall", tenant=TENANT) == 2
+
+    def test_ready_surfaces_active_alerts_without_gating(self):
+        agg = self._regression_agg()
+        agg.register_tenant(TENANT, factory)
+        rng = np.random.default_rng(0)
+        feed(agg, 0, rng)
+        agg.history.cut(agg, now=0.0)
+        agg.flush()
+        with pytest.warns(UserWarning, match="FIRING"):
+            agg.history.cut(agg, now=1.0)
+        server = MetricsServer(agg, port=0)
+        ready = server.render_ready()
+        assert ready["ready"] is True  # data-quality alert, not a routing signal
+        assert ready["history_alerts"][0]["rule"] == "seen-stall"
+
+    def test_health_monitor_history_alert_condition(self):
+        obs.enable(True)
+        monitor = obs.HealthMonitor(
+            skew_threshold_ms=None, clamp_risk=False, degraded_syncs=False,
+            history_alert=True, warn=False,
+        )
+        assert monitor.check()["healthy"] is True
+        obs.set_gauge("history.alert_active", 1.0, rule="r", tenant=TENANT)
+        report = monitor.check()
+        assert report["healthy"] is False
+        assert report["warnings"][0]["kind"] == "history_alert"
+        obs.set_gauge("history.alert_active", 0.0, rule="r", tenant=TENANT)
+        assert monitor.check()["healthy"] is True
+
+
+class TestGenerationFence:
+    def test_delta_fenced_across_generations_cumulative_exact(self):
+        obs.enable(True)
+        agg = build_history(2)
+        agg.history.generation = 1  # a promotion adopted this root
+        rng = np.random.default_rng(0)
+        feed(agg, 2, rng)
+        agg.history.cut(agg, now=2.0)
+        with pytest.raises(GenerationFencedRangeError, match="generation"):
+            agg.history_query(TENANT, 1.0, 2.0, mode="delta")
+        assert obs.get_counter("history.fenced_range_queries", tenant=TENANT) == 1
+        # per-generation sub-ranges and cumulative reads stay exact
+        assert agg.history_query(TENANT, 0.0, 1.0, mode="delta")["intervals"]
+        out = agg.history_query(TENANT, 0.0, 2.0, mode="cumulative")
+        assert out["points"][-1]["snapshot"]["generation"] == 1
+        assert out["points"][0]["snapshot"]["generation"] == 0
+
+    def test_delta_alert_rules_skip_the_boundary(self):
+        rule = AlertRule("stall", TENANT, "seen", below=1.0)
+        agg = Aggregator("gen", history=manual_history(rules=[rule]))
+        agg.register_tenant(TENANT, factory)
+        rng = np.random.default_rng(0)
+        feed(agg, 0, rng)
+        agg.history.cut(agg, now=0.0)
+        agg.history.generation = 1
+        agg.flush()
+        # the stalled delta WOULD fire, but its baseline is fenced out
+        agg.history.cut(agg, now=1.0)
+        assert agg.history.active_alerts() == []
+
+
+class TestDisabledModeStaysFree:
+    def test_no_history_no_new_work(self):
+        agg = Aggregator("plain")
+        agg.register_tenant(TENANT, factory)
+        assert agg.history is None
+        feed(agg, 0, np.random.default_rng(0))
+        with pytest.raises(ServeError, match="no history armed"):
+            agg.history_query(TENANT, 0.0, 1.0)
+        obs.enable(True)
+        agg.flush()
+        assert obs.get_counter("history.cuts", tenant=TENANT) == 0
+
+    def test_first_flush_arms_clock_without_cutting(self):
+        agg = Aggregator("armed", history=HistoryConfig(cut_every_s=9_999.0))
+        agg.register_tenant(TENANT, factory)
+        feed(agg, 0, np.random.default_rng(0))  # flush -> maybe_cut arms only
+        assert agg.history._tenants == {}
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="cut_every_s"):
+            HistoryConfig(cut_every_s=0.0)
+        with pytest.raises(ValueError, match="ascending"):
+            HistoryConfig(levels=((60.0, 2), (30.0, 2)))
+        with pytest.raises(ValueError, match="capacity"):
+            HistoryConfig(levels=((60.0, 0),))
+        with pytest.raises(ValueError, match="unique"):
+            HistoryConfig(rules=[
+                AlertRule("r", TENANT, "seen", above=1.0),
+                AlertRule("r", TENANT, "seen", below=0.0),
+            ])
+        with pytest.raises(ValueError, match="above=/below="):
+            AlertRule("r", TENANT, "seen")

@@ -288,14 +288,20 @@ class TestEndpoints:
         agg.register_tenant(TENANT, factory)
         agg.ingest(client_blob(0, np.random.default_rng(0)))
         server = MetricsServer(agg, port=0)
-        server.render_metrics()
-        assert obs.get_histogram("obs.scrape_ms").count == 1
         server.render_query(TENANT)
         assert obs.get_histogram("serve.query_ms", tenant=TENANT).count == 1
-        # the NEXT scrape exports the previous one's self-sample
+        # the FIRST scrape already exports its own self-sample (observed
+        # before the snapshot is cut) — hiding it until the NEXT scrape
+        # would lose the final scrape's cost entirely
         body = server.render_metrics()
+        assert obs.get_histogram("obs.scrape_ms").count == 1
         assert "metrics_tpu_obs_scrape_ms_bucket" in body
         assert "metrics_tpu_serve_query_ms_bucket" in body
+        # and the sample rides the exposition it timed: the rendered count
+        # already includes this scrape
+        import re
+
+        assert re.search(r"metrics_tpu_obs_scrape_ms_count(\{[^}]*\})? 1\b", body)
 
     def test_ready_reports_fleet_nodes_when_federated(self):
         obs.enable(True)
