@@ -558,6 +558,65 @@ def bench_streaming(n: int = N_SAMPLES) -> dict:
     return out
 
 
+def bench_sketch_families(n: int = N_SAMPLES) -> dict:
+    """The new sketch trio's hot paths over the standard 1M-sample stream.
+
+    - ``streaming_topk_1M_update`` — fold 1M zipf-distributed ids into a
+      256-bucket x 4-row :class:`~metrics_tpu.streaming.HeavyHitterSketch`
+      (one jitted scatter-add launch over counts + per-bit mass planes).
+    - ``streaming_topk_1M_merge`` — one heavy-hitter merge (the mesh /
+      window / resume combine op; pure elementwise adds), fori-loop
+      amortized like the AUROC merge row.
+    - ``distinct_count_1M_update`` — fold 1M ids into a precision-12
+      :class:`~metrics_tpu.streaming.DistinctCountSketch` (hash + rho +
+      scatter-max over 4096 registers).
+    - ``cooccur_fold_1M`` — fold 1M (row, col) label pairs into a
+      5000x5000-space :class:`~metrics_tpu.streaming.CoOccurrenceSketch`
+      (pair packing + binned scatter-adds + exact marginals).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.streaming import (
+        CoOccurrenceSketch,
+        DistinctCountSketch,
+        HeavyHitterSketch,
+    )
+
+    rng = np.random.default_rng(17)
+    ids = jnp.asarray((rng.zipf(1.3, n) % 100_000).astype(np.int32))
+    rows_lbl = jnp.asarray((rng.integers(0, 5000, n)).astype(np.int32))
+    cols_lbl = jnp.asarray((rng.integers(0, 5000, n)).astype(np.int32))
+    out: dict = {}
+
+    hh = HeavyHitterSketch(capacity=256, depth=4, id_bits=24)
+    hh_fold = jax.jit(lambda x: hh.fold(x))
+    out["streaming_topk_1M_update"] = _min_ms(lambda: jax.block_until_ready(hh_fold(ids)))
+
+    sketch_a = hh_fold(ids)
+    sketch_b = hh_fold(jnp.flip(ids))
+
+    @jax.jit
+    def merge_k(a, b):
+        return jax.lax.fori_loop(0, K_REPEATS, lambda _, acc: acc.merge(b), a)
+
+    out["streaming_topk_1M_merge"] = (
+        _min_ms(lambda: jax.block_until_ready(merge_k(sketch_a, sketch_b))) / K_REPEATS
+    )
+
+    dc = DistinctCountSketch(precision=12)
+    dc_fold = jax.jit(lambda x: dc.fold(x))
+    out["distinct_count_1M_update"] = _min_ms(lambda: jax.block_until_ready(dc_fold(ids)))
+
+    co = CoOccurrenceSketch(num_rows=5000, num_cols=5000, capacity=256, depth=4)
+    co_fold = jax.jit(lambda r, c: co.fold(r, c))
+    out["cooccur_fold_1M"] = _min_ms(
+        lambda: jax.block_until_ready(co_fold(rows_lbl, cols_lbl))
+    )
+    return out
+
+
 def bench_serve(n_clients: int = 1000) -> dict:
     """Serving-tier sustained aggregation: 1k clients, 3-level tree.
 
@@ -1406,6 +1465,26 @@ def main(
         )
     except Exception as err:  # noqa: BLE001 — streaming rows must not kill the sweep
         print(f"SKIPPED streaming rows: {err}", file=sys.stderr)
+
+    # sketch families: heavy-hitter / distinct-count / co-occurrence fold
+    # and merge hot paths over the same 1M-sample stream; each row gates
+    # against its own best prior round
+    try:
+        sketch_rows = section(bench_sketch_families)
+        for row_name in (
+            "streaming_topk_1M_update",
+            "streaming_topk_1M_merge",
+            "distinct_count_1M_update",
+            "cooccur_fold_1M",
+        ):
+            emit(
+                row_name,
+                sketch_rows[row_name],
+                prior.get(row_name, sketch_rows[row_name]),
+                baseline="best_prior_self",
+            )
+    except Exception as err:  # noqa: BLE001 — sketch rows must not kill the sweep
+        print(f"SKIPPED sketch family rows: {err}", file=sys.stderr)
 
     # serving tier: 1000 simulated clients shipping sketch snapshots
     # through a 3-level aggregation tree — sustained merge throughput

@@ -224,6 +224,9 @@ _FAMILY_HELP: Dict[str, str] = {
     "stream.drift_checks": "DriftMonitor.check() calls",
     "stream.drift_alerts": "Drift checks that crossed an alert threshold",
     "stream.windows_expired": "WindowedMetric ring slots retired",
+    "stream.hh_queries": "StreamingTopK bound/envelope queries",
+    "stream.distinct_queries": "StreamingDistinctCount bound/envelope queries",
+    "stream.cooccur_queries": "StreamingConfusion cell/top-cell bound queries",
     # fault tolerance
     "ft.checkpoint_saves": "Checkpoint save() completions",
     "ft.checkpoint_restores": "Checkpoint restore() completions",

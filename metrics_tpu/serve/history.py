@@ -76,6 +76,7 @@ from metrics_tpu.obs.registry import inc as _obs_inc
 from metrics_tpu.obs.registry import observe as _obs_observe
 from metrics_tpu.obs.registry import set_gauge as _obs_gauge
 from metrics_tpu.serve.aggregator import ServeError, _jsonable, _tree_set
+from metrics_tpu.streaming.sketches import delta_envelope_leaf
 
 __all__ = [
     "AlertRule",
@@ -216,12 +217,28 @@ class IntervalSnapshot:
 # ----------------------------------------------------------------------
 
 
+_SKETCH_LEAF_PREFIX = "__sketch_leaf_"
+
+
 def _is_sketch_extreme(path: Tuple[str, ...], red: str) -> bool:
-    """A sketch-internal min/max leaf (``minv``/``maxv``): a MONOTONE
-    cumulative envelope bound, not a windowed extreme — carried, never
-    subtracted, and exact under delta merge (``min(newer_b, newer_c) ==
-    newer_c`` because cumulative extremes only tighten)."""
-    return red in ("min", "max") and path[-1].startswith("__sketch_leaf_")
+    """A sketch-internal min/max leaf that is a MONOTONE cumulative
+    envelope bound (a quantile sketch's ``minv``/``maxv``), not a
+    windowed extreme — carried, never subtracted, and exact under delta
+    merge (``min(newer_b, newer_c) == newer_c`` because cumulative
+    extremes only tighten).
+
+    Not every sketch min/max leaf qualifies: HLL max-registers are the
+    canonical counterexample (their carry would silently answer "uniques
+    ever" to a "uniques this interval" query), so the judgment is
+    delegated to the sketch registry's per-class
+    ``_delta_envelope_leaves`` declarations via
+    :func:`metrics_tpu.streaming.sketches.delta_envelope_leaf` — an
+    undeclared min/max leaf falls through to the refusing arm."""
+    return (
+        red in ("min", "max")
+        and path[-1].startswith(_SKETCH_LEAF_PREFIX)
+        and delta_envelope_leaf(path[-1][len(_SKETCH_LEAF_PREFIX):])
+    )
 
 
 def delta_leaves(
@@ -246,11 +263,13 @@ def delta_leaves(
             out.append(np.array(new, copy=True))
         else:
             raise DeltaUndefinedError(
-                f"state leaf {'/'.join(path)} has reduction {red!r}: a plain"
-                " max/min monoid is not invertible, so the interval delta of two"
-                " cumulative snapshots is undefined for it. Query"
-                " mode=cumulative, or model the metric as a mergeable sketch"
-                " (metrics_tpu.streaming) to get windowed extremes."
+                f"state leaf {'/'.join(path)} has reduction {red!r}: a"
+                " max/min monoid is not invertible, so the interval delta of"
+                " two cumulative snapshots is undefined for it (for an HLL"
+                " register array the carry would answer 'uniques ever', not"
+                " 'uniques this interval'). Query mode=cumulative, or use a"
+                " windowed metric instance (metrics_tpu.streaming windows)"
+                " for per-interval values."
             )
     return out
 

@@ -29,6 +29,11 @@ from typing import Any
 # itself imports Sketch for the "sketch" reduction registry); everything
 # depending on Metric loads lazily through __getattr__ to keep this package
 # importable mid-way through metrics_tpu.metric's own import.
+from metrics_tpu.streaming.distinct import DistinctCountSketch  # noqa: F401
+from metrics_tpu.streaming.heavy import (  # noqa: F401
+    CoOccurrenceSketch,
+    HeavyHitterSketch,
+)
 from metrics_tpu.streaming.sketches import (  # noqa: F401
     QuantileSketch,
     ScoreLabelSketch,
@@ -38,14 +43,20 @@ from metrics_tpu.streaming.sketches import (  # noqa: F401
 )
 
 __all__ = [
+    "CoOccurrenceSketch",
     "DecayedMetric",
+    "DistinctCountSketch",
     "DriftMonitor",
+    "HeavyHitterSketch",
     "QuantileSketch",
     "ScoreLabelSketch",
     "Sketch",
     "StreamingAUROC",
     "StreamingAveragePrecision",
+    "StreamingConfusion",
+    "StreamingDistinctCount",
     "StreamingQuantile",
+    "StreamingTopK",
     "WindowedMetric",
     "js_divergence",
     "kl_divergence",
@@ -57,7 +68,10 @@ __all__ = [
 _LAZY = {
     "StreamingAUROC": "metrics_tpu.streaming.metrics",
     "StreamingAveragePrecision": "metrics_tpu.streaming.metrics",
+    "StreamingConfusion": "metrics_tpu.streaming.metrics",
+    "StreamingDistinctCount": "metrics_tpu.streaming.metrics",
     "StreamingQuantile": "metrics_tpu.streaming.metrics",
+    "StreamingTopK": "metrics_tpu.streaming.metrics",
     "WindowedMetric": "metrics_tpu.streaming.windows",
     "DecayedMetric": "metrics_tpu.streaming.windows",
     "DriftMonitor": "metrics_tpu.streaming.drift",

@@ -1,0 +1,138 @@
+"""Distinct-count sketch: HyperLogLog registers with an exact bitwise merge.
+
+HyperLogLog (Flajolet et al. 2007) is the rare randomized-analysis sketch
+whose MERGE is nonetheless a perfect algebraic object: each register
+holds the max leading-zero rank ever observed for its hash slice, so the
+union of two streams is the elementwise ``max`` of their register arrays
+— a true idempotent commutative monoid (``a ∨ a == a``, any fold order,
+any duplication, bitwise identical). That idempotence is worth calling
+out: unlike the sum-family sketches, re-merging the SAME HLL payload
+twice is harmless, and mesh sync rides the existing ``pmax`` path of
+``sync_sketch_in_context`` with no dedup caveats.
+
+The flip side, and why :mod:`metrics_tpu.serve.history` must refuse
+interval deltas over these registers: ``max`` is not invertible. Knowing
+the registers at t1 and t2 says nothing about the uniques *between* them
+(every register may already have been saturated at t1). Distinct counts
+over a window come from :class:`~metrics_tpu.streaming.windows.
+WindowedMetric` (fresh sketch per window) — never from subtracting
+cumulative snapshots.
+
+Determinism: ids hash through the fixed :func:`~metrics_tpu.streaming.
+hashing.fmix32` finalizer (no PRNG key), so every process — client,
+root re-fold, resume replay — maps an id to the same register/rank and
+the monoid stays bitwise across the whole platform.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.streaming.hashing import fmix32, leading_rho, register_index
+from metrics_tpu.streaming.sketches import Sketch
+
+Array = jax.Array
+
+__all__ = ["DistinctCountSketch"]
+
+# bias-correction constant alpha_m for m >= 128 (Flajolet et al., Fig. 3);
+# small-m special cases below
+_ALPHA_LARGE = 0.7213
+_ALPHA_DENOM = 1.079
+
+
+def _alpha(m: int) -> float:
+    if m <= 16:
+        return 0.673
+    if m <= 32:
+        return 0.697
+    if m <= 64:
+        return 0.709
+    return _ALPHA_LARGE / (1.0 + _ALPHA_DENOM / m)
+
+
+class DistinctCountSketch(Sketch):
+    """HyperLogLog cardinality summary with an EXACT bitwise merge.
+
+    State: ``2^precision`` int32 registers; ``regs`` carries the ``max``
+    reduction, so merge == elementwise max — idempotent, commutative,
+    associative, bitwise, with the all-zero fresh sketch as identity.
+    Standard error of :meth:`estimate` is ``1.04 / sqrt(2^precision)``
+    (~1.6% at the default ``precision=12``, 16KB of registers), with
+    linear-counting below ~2.5m and the 32-bit large-range correction
+    above 2^32/30 (Flajolet et al. 2007).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import DistinctCountSketch
+        >>> sk = DistinctCountSketch(precision=12)
+        >>> sk = sk.fold(jnp.arange(10_000))
+        >>> abs(float(sk.estimate()) / 10_000 - 1.0) < 3 * float(sk.relative_error())
+        True
+    """
+
+    _leaf_fields = (("regs", "max"),)
+    _config_fields = ("precision",)
+    _shard_dims = {"regs": 0}
+
+    def __init__(self, precision: int = 12) -> None:
+        if not 4 <= precision <= 18:
+            raise ValueError(f"`precision` must be in [4, 18], got {precision}")
+        self.precision = int(precision)
+        self.regs = jnp.zeros(1 << self.precision, jnp.int32)
+
+    @property
+    def num_registers(self) -> int:
+        return 1 << self.precision
+
+    # -- accumulation ----------------------------------------------------
+
+    def fold(self, ids: Array) -> "DistinctCountSketch":
+        """A new sketch with a batch of integer ids observed. Pure and
+        jit-safe: one hash + one scatter-max. Duplicate ids are free —
+        the register max is already at least their rank."""
+        h = fmix32(jnp.ravel(jnp.asarray(ids)).astype(jnp.uint32))
+        idx = register_index(h, self.precision)
+        rho = leading_rho(h, self.precision)
+        return self._replace_leaves(regs=self.regs.at[idx].max(rho))
+
+    # -- queries ---------------------------------------------------------
+
+    def estimate(self) -> Array:
+        """Estimated number of distinct ids folded in (f32 scalar), with
+        the standard linear-counting and large-range corrections."""
+        return _hll_estimate(self.regs, self.precision)
+
+    def relative_error(self) -> Array:
+        """The standard-error envelope ``1.04 / sqrt(m)`` — the estimate
+        is within ``±2σ`` of the truth ~95% of the time."""
+        return jnp.float32(1.04 / float(self.num_registers) ** 0.5)
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """``(lower, upper)`` 2-sigma envelope around :meth:`estimate`."""
+        est = self.estimate()
+        sigma = 2.0 * self.relative_error()
+        return est * (1.0 - sigma), est * (1.0 + sigma)
+
+    def bin_masses(self) -> Array:
+        """Normalized register-rank masses (drift input: the register
+        profile distinguishes cardinality regimes)."""
+        total = jnp.maximum(self.regs.sum().astype(jnp.float32), 1.0)
+        return self.regs.astype(jnp.float32) / total
+
+
+def _hll_estimate(regs: Array, precision: int) -> Array:
+    """The corrected HLL estimator over a full register array (also the
+    final step of the mesh-sharded kernel, which pmax-syncs registers and
+    computes locally — see ``utilities/sharding.py``)."""
+    m = 1 << precision
+    regs_f = regs.astype(jnp.float32)
+    raw = _alpha(m) * m * m / jnp.exp2(-regs_f).sum()
+    zeros = (regs == 0).sum().astype(jnp.float32)
+    # small-range: linear counting when any register is still empty
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    # large-range: correct for 32-bit hash collisions
+    two32 = jnp.float32(2.0**32)
+    large = -two32 * jnp.log1p(-est / two32)
+    return jnp.where(est > two32 / 30.0, large, est)

@@ -1,0 +1,114 @@
+"""Deterministic, jit-safe hashing primitives for the sketch families.
+
+Every hashed sketch in :mod:`metrics_tpu.streaming` needs the same three
+ingredients, and they must be DETERMINISTIC — fixed constants, no PRNG
+keys — so that two processes (a client and the root re-folding its
+payload, a preemption-resume replay, a mesh permutation) bucket every id
+identically and the merge algebra stays bitwise:
+
+* :func:`fmix32` — the murmur3 32-bit finalizer, a full-avalanche
+  bijection on ``uint32``. All index/signature derivation starts here.
+* :func:`row_hash` / :func:`bucket_index` — per-row keyed hashes for
+  depth-``D`` bucketed sketches (:class:`~metrics_tpu.streaming.heavy.
+  HeavyHitterSketch`, :class:`~metrics_tpu.streaming.heavy.
+  CoOccurrenceSketch`): row ``r`` xors a fixed odd seed into the id
+  before finalizing, so rows are pairwise-independent-in-practice but
+  reproducible everywhere.
+* :func:`bit_planes` / :func:`pack_bits` — the id<->bit-plane codec for
+  the linear id-recovery trick (majority vote over exact per-bit mass
+  sums, see ``streaming/heavy.py``).
+
+Everything here is pure ``jnp`` integer arithmetic on ``uint32`` (wraps
+mod 2^32 by dtype), valid inside ``jit``/``scan``/``vmap``/``shard_map``.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+__all__ = [
+    "ROW_SEEDS",
+    "bit_planes",
+    "bucket_index",
+    "fmix32",
+    "leading_rho",
+    "pack_bits",
+    "register_index",
+    "row_hash",
+]
+
+# fixed per-row xor seeds: fmix32(golden-ratio odd multiples) computed once
+# in plain Python — depth is capped by this table's length (raise it by
+# extending the table; NEVER reorder, existing states depend on it)
+_GOLDEN = 0x9E3779B9
+
+
+def _py_fmix32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+ROW_SEEDS = tuple(_py_fmix32(_GOLDEN * (r + 1)) for r in range(16))
+
+
+def fmix32(x: Array) -> Array:
+    """Murmur3 32-bit finalizer: a deterministic full-avalanche bijection
+    on ``uint32`` values (pure jnp, jit-safe)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def row_hash(ids: Array, row: int) -> Array:
+    """The row-``row`` keyed hash of ``ids``: ``fmix32(id ^ seed_row)``."""
+    if not 0 <= row < len(ROW_SEEDS):
+        raise ValueError(f"row {row} outside the fixed seed table (depth <= {len(ROW_SEEDS)})")
+    return fmix32(ids.astype(jnp.uint32) ^ jnp.uint32(ROW_SEEDS[row]))
+
+
+def bucket_index(ids: Array, row: int, width: int) -> Array:
+    """Deterministic bucket of each id in row ``row`` of a width-``width``
+    table (int32, in ``[0, width)``)."""
+    return (row_hash(ids, row) % jnp.uint32(width)).astype(jnp.int32)
+
+
+def bit_planes(ids: Array, num_bits: int) -> Array:
+    """``float32[..., num_bits]`` bit decomposition of integer ids (LSB
+    first) — the per-update votes the linear id-recovery sums."""
+    shifts = jnp.arange(num_bits, dtype=jnp.uint32)
+    return ((ids.astype(jnp.uint32)[..., None] >> shifts) & jnp.uint32(1)).astype(jnp.float32)
+
+
+def pack_bits(bits: Array) -> Array:
+    """Inverse of :func:`bit_planes`: pack a ``bool/float[..., B]`` plane
+    stack (LSB first) back into ``uint32`` ids."""
+    num_bits = bits.shape[-1]
+    shifts = jnp.arange(num_bits, dtype=jnp.uint32)
+    return (bits.astype(jnp.uint32) << shifts).sum(axis=-1).astype(jnp.uint32)
+
+
+def leading_rho(hashes: Array, precision_bits: int) -> Array:
+    """HLL rank: position of the leftmost 1-bit in the ``32 - p`` hash
+    bits BELOW the register-index bits, counted from 1; ``32 - p + 1``
+    when they are all zero. ``int32``, in ``[1, 33 - p]``."""
+    p = int(precision_bits)
+    tail_bits = 32 - p
+    tail = hashes.astype(jnp.uint32) & jnp.uint32((1 << tail_bits) - 1)
+    shifted = tail << jnp.uint32(p)  # tail promoted to the high bits
+    rho = lax.clz(shifted).astype(jnp.int32) + 1
+    return jnp.where(tail == 0, jnp.int32(tail_bits + 1), rho)
+
+
+def register_index(hashes: Array, precision_bits: int) -> Array:
+    """HLL register index: the TOP ``p`` hash bits (int32, ``[0, 2^p)``)."""
+    return (hashes.astype(jnp.uint32) >> jnp.uint32(32 - int(precision_bits))).astype(jnp.int32)

@@ -1,0 +1,418 @@
+"""Heavy-hitter and co-occurrence sketches: exact-monoid frequency summaries.
+
+**Why these are linear sketches and not textbook SpaceSaving.** Classic
+SpaceSaving (Metwally et al.) decides *at update time* which counter to
+evict — so two summaries merged in different orders hold different
+states, and only the error BOUND survives reordering (Agarwal et al.,
+"Mergeable Summaries"). That is not good enough here: this platform's
+entire distribution story — ``lax.scan`` epoch folds, stacked pow-2
+serve-tree folds, mesh reduce-scatter, history rollups, elastic
+rebalance replays — assumes every sketch leaf merges by an exact
+leafwise ``sum``/``min``/``max`` monoid, pinned BITWISE across fold
+orders. So, exactly as :class:`~metrics_tpu.streaming.sketches.
+QuantileSketch` chose fixed bins over randomized KLL compaction,
+:class:`HeavyHitterSketch` chooses determinism over update-time
+eviction: update and merge are LOSSLESS LINEAR projections (exact
+integer-valued sums — a true commutative monoid, fold order can never
+change state), and the SpaceSaving-style condensation to fixed-capacity
+``(id, count, overestimate)`` arrays happens only at **compute time**,
+where nothing merges afterwards.
+
+**The linear id-recovery trick.** Each of ``depth`` rows hashes an id
+into one of ``capacity`` buckets and adds its weight to the bucket's
+total (a count-min row) AND to one exact per-bit mass sum for every set
+bit of the id (``bitsums[r, b, j] = total weight in bucket b whose id
+has bit j set``). All leaves are sums, so the merge is exact. At query
+time a bucket dominated by one id reproduces that id by per-bit majority
+vote, and the bit sums yield *deterministic, rigorous* per-item bounds:
+
+* upper: ``f(x) <= min_r min_j side_j(x)`` where ``side_j(x)`` is the
+  bucket mass agreeing with ``x``'s bit ``j`` (every unit of ``x``'s
+  mass agrees with ``x`` at every bit);
+* lower: ``f(x) >= counts[r,b] - sum_j minority_j(x)`` (every OTHER id
+  in the bucket disagrees with ``x`` in at least one bit, so its mass is
+  counted in at least one minority term).
+
+``estimate() = upper`` keeps SpaceSaving's reporting contract — never an
+underestimate, with a per-item overestimate envelope ``upper - lower``
+(``tests/streaming/test_sketch_families.py`` pins both sides at 1M
+samples).
+
+:class:`CoOccurrenceSketch` is the same machinery over packed
+``(row, col)`` pair ids — confusion/co-occurrence structure for label
+spaces far beyond the C<=128 pallas tile — plus EXACT per-axis marginal
+counts that tighten the upper bound (a cell can never exceed its row or
+column total).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.streaming.hashing import ROW_SEEDS, bit_planes, bucket_index, pack_bits
+from metrics_tpu.streaming.sketches import Sketch
+
+Array = jax.Array
+
+__all__ = ["CoOccurrenceSketch", "HeavyHitterSketch"]
+
+
+# ---------------------------------------------------------------------------
+# shared linear-decode core (pure jnp; used by both sketches AND by the
+# sharded mesh kernels in utilities/sharding.py)
+# ---------------------------------------------------------------------------
+
+
+def _fold_linear(
+    counts: Array, bitsums: Array, ids: Array, weights: Optional[Array], width: int
+) -> Tuple[Array, Array]:
+    """Scatter a batch of (id, weight) pairs into every row of the
+    count/bit-plane arrays. Pure and jit-safe; exact integer-valued f32
+    sums, so folds commute bitwise with merges."""
+    ids = jnp.ravel(jnp.asarray(ids)).astype(jnp.uint32)
+    w = (
+        jnp.ones(ids.shape, jnp.float32)
+        if weights is None
+        else jnp.ravel(jnp.asarray(weights)).astype(jnp.float32)
+    )
+    depth, _w, num_bits = bitsums.shape
+    bits = bit_planes(ids, num_bits)  # [N, B]
+    votes = w[:, None] * bits
+    for r in range(depth):
+        b = bucket_index(ids, r, width)
+        counts = counts.at[r, b].add(w)
+        bitsums = bitsums.at[r, b, :].add(votes)
+    return counts, bitsums
+
+
+def _decode_candidates(counts: Array, bitsums: Array, width: int) -> Tuple[Array, Array]:
+    """Majority-decode every cell of every row into a candidate id.
+
+    Returns ``(ids uint32[D, W], valid bool[D, W])`` — a cell is a valid
+    candidate only when it holds mass and its decoded id hashes back to
+    that very cell (the self-consistency check that rejects cells whose
+    majority vote is collision noise)."""
+    depth, w = counts.shape
+    maj = (2.0 * bitsums) > counts[..., None]  # strict: zero mass decodes id 0 invalidly
+    ids = pack_bits(maj)  # [D, W]
+    cols = jnp.arange(w, dtype=jnp.int32)[None, :]
+    valid = counts > 0
+    for r in range(depth):
+        home = bucket_index(ids[r], r, width)[None, :] == cols
+        valid = valid.at[r].set(valid[r] & home[0])
+    return ids, valid
+
+
+def _candidate_bounds(
+    counts: Array, bitsums: Array, ids: Array, width: int
+) -> Tuple[Array, Array]:
+    """Rigorous per-id ``(lower, upper)`` frequency bounds for a flat id
+    vector, from full (merged) count/bit-plane arrays.
+
+    ``upper``: for every row and bit, the bucket mass AGREEING with the
+    id's bit is >= its true count — take the min. ``lower``: the bucket
+    total minus the sum of per-bit DISAGREEING masses — every colliding
+    id disagrees somewhere, so the subtraction can only overshoot.
+    """
+    depth, _w, num_bits = bitsums.shape
+    bits = bit_planes(ids, num_bits)  # [M, B]
+    uppers, lowers = [], []
+    for r in range(depth):
+        b = bucket_index(ids, r, width)  # [M]
+        c = counts[r, b]  # [M]
+        bs = bitsums[r, b, :]  # [M, B]
+        agree = jnp.where(bits > 0, bs, c[:, None] - bs)
+        uppers.append(jnp.minimum(agree.min(axis=-1), c))
+        lowers.append(c - (c[:, None] - agree).sum(axis=-1))
+    upper = jnp.stack(uppers).min(axis=0)
+    lower = jnp.clip(jnp.stack(lowers).max(axis=0), 0.0, None)
+    return jnp.minimum(lower, upper), upper
+
+
+def _rank_candidates(
+    ids: Array, valid: Array, lower: Array, upper: Array, k: int
+) -> Tuple[Array, Array, Array]:
+    """Deterministic top-``k`` selection over a flat candidate set.
+
+    Duplicates (the same id decoded from several rows) collapse to one
+    entry; ordering is by (estimate desc, id asc) — a total order, so the
+    reported arrays are identical regardless of candidate enumeration
+    order (the compute-time face of merge determinism). Returns
+    ``(ids int32[k], estimates f32[k], overestimates f32[k])`` with empty
+    slots as ``id=-1, estimate=0, overestimate=0``.
+    """
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    flat_valid = valid.reshape(-1)
+    flat_up = jnp.where(flat_valid, upper.reshape(-1), -jnp.inf)
+    flat_lo = lower.reshape(-1)
+    # collapse duplicates: sort by (id, valid-first) and keep the first of
+    # each id run (equal ids carry equal bounds — same merged arrays, same
+    # arithmetic). Valid-first matters: an unrelated cell can spuriously
+    # decode the same bit pattern yet fail its home-bucket check, and it
+    # must not shadow the genuine occurrence.
+    order = jnp.lexsort((~flat_valid, flat_ids))
+    sid = flat_ids[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    keep = first & flat_valid[order]
+    up = jnp.where(keep, flat_up[order], -jnp.inf)
+    lo = flat_lo[order]
+    # (estimate desc, id asc): lexsort's last key is primary
+    rank = jnp.lexsort((sid, -up))
+    top = rank[:k]
+    got = up[top] > -jnp.inf
+    return (
+        jnp.where(got, sid[top], -1).astype(jnp.int32),
+        jnp.where(got, up[top], 0.0).astype(jnp.float32),
+        jnp.where(got, up[top] - lo[top], 0.0).astype(jnp.float32),
+    )
+
+
+class HeavyHitterSketch(Sketch):
+    """Deterministic heavy-hitter summary with an exact (bitwise) monoid
+    merge and compute-time SpaceSaving condensation.
+
+    State: ``depth`` count-min rows of ``capacity`` buckets
+    (``counts[D, W]``) plus exact per-bit id-mass sums
+    (``bitsums[D, W, id_bits]``) — ``4 * D * W * (1 + id_bits)`` bytes,
+    fixed, regardless of stream length or cardinality. Every leaf is an
+    integer-valued f32 sum, so ``merge`` is associative + commutative
+    BITWISE with the fresh sketch as identity — fold order, shard count,
+    and mesh permutation can never change state (see module docstring for
+    why update-time eviction was rejected).
+
+    :meth:`topk` materializes the classic fixed-capacity
+    ``(id, count, overestimate)`` arrays at query time: counts NEVER
+    underestimate, and each item's rigorous overestimate envelope comes
+    from the exact bit-plane bounds. Ids must be non-negative and below
+    ``2 ** id_bits`` (larger ids alias by truncation — raise ``id_bits``
+    for wider id spaces).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import HeavyHitterSketch
+        >>> sk = HeavyHitterSketch(capacity=64, depth=4, id_bits=16)
+        >>> sk = sk.fold(jnp.asarray([7, 7, 7, 9, 9, 3]))
+        >>> ids, counts, over = sk.topk(2)
+        >>> [int(i) for i in ids], [float(c) for c in counts]
+        ([7, 9], [3.0, 2.0])
+    """
+
+    _leaf_fields = (("counts", "sum"), ("bitsums", "sum"))
+    _config_fields = ("capacity", "depth", "id_bits")
+    # buckets distribute over the mesh lane-wise (dim 1 of every row)
+    _shard_dims = {"counts": 1, "bitsums": 1}
+
+    def __init__(self, capacity: int = 256, depth: int = 4, id_bits: int = 24) -> None:
+        if capacity < 2:
+            raise ValueError(f"`capacity` must be >= 2, got {capacity}")
+        if not 1 <= depth <= len(ROW_SEEDS):
+            raise ValueError(f"`depth` must be in [1, {len(ROW_SEEDS)}], got {depth}")
+        if not 1 <= id_bits <= 31:
+            raise ValueError(f"`id_bits` must be in [1, 31], got {id_bits}")
+        self.capacity = int(capacity)
+        self.depth = int(depth)
+        self.id_bits = int(id_bits)
+        self.counts = jnp.zeros((self.depth, self.capacity), jnp.float32)
+        self.bitsums = jnp.zeros((self.depth, self.capacity, self.id_bits), jnp.float32)
+
+    # -- accumulation ----------------------------------------------------
+
+    def fold(self, ids: Array, weights: Optional[Array] = None) -> "HeavyHitterSketch":
+        """A new sketch with a batch of integer ids (optionally weighted)
+        folded in. Pure, jit-safe: ``depth`` scatter-adds."""
+        counts, bitsums = _fold_linear(self.counts, self.bitsums, ids, weights, self.capacity)
+        return self._replace_leaves(counts=counts, bitsums=bitsums)
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def count(self) -> Array:
+        """Total folded weight (row 0's mass — every row holds all of it)."""
+        return self.counts[0].sum()
+
+    def estimate(self, ids: Array) -> Array:
+        """Frequency estimates for ``ids`` — rigorous UPPER bounds (the
+        SpaceSaving contract: never an underestimate)."""
+        _lo, up = _candidate_bounds(
+            self.counts, self.bitsums, jnp.ravel(jnp.asarray(ids)).astype(jnp.uint32), self.capacity
+        )
+        return up
+
+    def frequency_bounds(self, ids: Array) -> Tuple[Array, Array]:
+        """Rigorous per-id ``(lower, upper)`` envelope: the true count of
+        every queried id lies inside, deterministically (no probabilistic
+        caveat — both sides are theorems of the exact bit-plane sums)."""
+        return _candidate_bounds(
+            self.counts, self.bitsums, jnp.ravel(jnp.asarray(ids)).astype(jnp.uint32), self.capacity
+        )
+
+    def topk(self, k: int) -> Tuple[Array, Array, Array]:
+        """The fixed-capacity SpaceSaving-style condensation:
+        ``(ids int32[k], counts f32[k], overestimates f32[k])``, ordered
+        by (count desc, id asc); empty slots carry ``id=-1``. The true
+        count of item ``i`` lies in ``[counts[i] - overestimates[i],
+        counts[i]]`` — always."""
+        ids, valid = _decode_candidates(self.counts, self.bitsums, self.capacity)
+        lo, up = _candidate_bounds(self.counts, self.bitsums, ids.reshape(-1), self.capacity)
+        return _rank_candidates(ids, valid, lo, up, int(k))
+
+    def bin_masses(self) -> Array:
+        """Normalized row-0 bucket masses (drift-monitor input: the
+        hashed frequency profile of the stream)."""
+        total = jnp.maximum(self.counts[0].sum(), 1.0)
+        return self.counts[0] / total
+
+
+class CoOccurrenceSketch(Sketch):
+    """Mergeable confusion/co-occurrence counts for label spaces beyond
+    the C<=128 pallas confusion tile.
+
+    ``(row, col)`` pairs pack into a single id (``row * num_cols + col``)
+    and feed the same exact-sum linear structure as
+    :class:`HeavyHitterSketch` — hashed ``(row, col)`` binning with an
+    exact bitwise sum merge — plus EXACT per-axis marginals
+    (``row_marg``/``col_marg``), which both tighten the per-cell upper
+    bound (a cell never exceeds its row or column total) and answer the
+    marginal label distributions exactly.
+
+    State: ``4 * (D * W * (1 + ceil(log2(R*C))) + R + C)`` bytes, fixed.
+    Collision behaviour is per-CELL, not per-class: a 10k x 10k label
+    space costs the same device bytes as a 100 x 100 one.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import CoOccurrenceSketch
+        >>> sk = CoOccurrenceSketch(num_rows=1000, num_cols=1000, capacity=64)
+        >>> sk = sk.fold(jnp.asarray([3, 3, 7]), jnp.asarray([3, 5, 7]))
+        >>> lo, hi = sk.cell_bounds(jnp.asarray([3]), jnp.asarray([3]))
+        >>> float(lo[0]) <= 1.0 <= float(hi[0])
+        True
+    """
+
+    _leaf_fields = (
+        ("cells", "sum"),
+        ("bitsums", "sum"),
+        ("row_marg", "sum"),
+        ("col_marg", "sum"),
+    )
+    _config_fields = ("num_rows", "num_cols", "capacity", "depth")
+    # hashed cell tables distribute lane-wise; the exact marginals are
+    # small and stay replicated
+    _shard_dims = {"cells": 1, "bitsums": 1}
+
+    def __init__(
+        self, num_rows: int, num_cols: Optional[int] = None, capacity: int = 256, depth: int = 4
+    ) -> None:
+        num_cols = num_rows if num_cols is None else num_cols
+        if num_rows < 1 or num_cols < 1:
+            raise ValueError(f"label space must be positive, got {num_rows} x {num_cols}")
+        if num_rows * num_cols > 1 << 31:
+            raise ValueError(
+                f"label space {num_rows} x {num_cols} exceeds 2^31 packed pair ids;"
+                " hash the labels down first"
+            )
+        if capacity < 2:
+            raise ValueError(f"`capacity` must be >= 2, got {capacity}")
+        if not 1 <= depth <= len(ROW_SEEDS):
+            raise ValueError(f"`depth` must be in [1, {len(ROW_SEEDS)}], got {depth}")
+        self.num_rows = int(num_rows)
+        self.num_cols = int(num_cols)
+        self.capacity = int(capacity)
+        self.depth = int(depth)
+        self.cells = jnp.zeros((self.depth, self.capacity), jnp.float32)
+        self.bitsums = jnp.zeros((self.depth, self.capacity, self._pair_bits), jnp.float32)
+        self.row_marg = jnp.zeros(self.num_rows, jnp.float32)
+        self.col_marg = jnp.zeros(self.num_cols, jnp.float32)
+
+    @property
+    def _pair_bits(self) -> int:
+        return max((self.num_rows * self.num_cols - 1).bit_length(), 1)
+
+    def _pack(self, rows: Array, cols: Array) -> Array:
+        return rows.astype(jnp.uint32) * jnp.uint32(self.num_cols) + cols.astype(jnp.uint32)
+
+    def _unpack(self, pair_ids: Array) -> Tuple[Array, Array]:
+        pair_ids = pair_ids.astype(jnp.uint32)
+        return (
+            (pair_ids // jnp.uint32(self.num_cols)).astype(jnp.int32),
+            (pair_ids % jnp.uint32(self.num_cols)).astype(jnp.int32),
+        )
+
+    # -- accumulation ----------------------------------------------------
+
+    def fold(
+        self, rows: Array, cols: Array, weights: Optional[Array] = None
+    ) -> "CoOccurrenceSketch":
+        """A new sketch with a batch of ``(row, col)`` label pairs folded
+        in (confusion convention: row = true label, col = prediction).
+        Pure, jit-safe."""
+        rows = jnp.ravel(jnp.asarray(rows)).astype(jnp.int32)
+        cols = jnp.ravel(jnp.asarray(cols)).astype(jnp.int32)
+        w = (
+            jnp.ones(rows.shape, jnp.float32)
+            if weights is None
+            else jnp.ravel(jnp.asarray(weights)).astype(jnp.float32)
+        )
+        cells, bitsums = _fold_linear(
+            self.cells, self.bitsums, self._pack(rows, cols), w, self.capacity
+        )
+        return self._replace_leaves(
+            cells=cells,
+            bitsums=bitsums,
+            row_marg=self.row_marg.at[rows].add(w),
+            col_marg=self.col_marg.at[cols].add(w),
+        )
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def count(self) -> Array:
+        """Total folded weight."""
+        return self.row_marg.sum()
+
+    def cell_bounds(self, rows: Array, cols: Array) -> Tuple[Array, Array]:
+        """Rigorous ``(lower, upper)`` count envelope for each queried
+        ``(row, col)`` cell: linear-decode bounds intersected with the
+        exact marginals (``true <= min(row total, col total)``)."""
+        rows = jnp.ravel(jnp.asarray(rows)).astype(jnp.int32)
+        cols = jnp.ravel(jnp.asarray(cols)).astype(jnp.int32)
+        lo, up = _candidate_bounds(self.cells, self.bitsums, self._pack(rows, cols), self.capacity)
+        up = jnp.minimum(up, jnp.minimum(self.row_marg[rows], self.col_marg[cols]))
+        return jnp.minimum(lo, up), up
+
+    def cell_estimate(self, rows: Array, cols: Array) -> Array:
+        """Per-cell count estimates — rigorous upper bounds (never an
+        underestimate; the collision bound is ``estimate - lower``)."""
+        _lo, up = self.cell_bounds(rows, cols)
+        return up
+
+    def top_cells(self, k: int) -> Tuple[Array, Array, Array, Array]:
+        """The ``k`` heaviest cells:
+        ``(rows int32[k], cols int32[k], counts f32[k], overestimates
+        f32[k])`` ordered by (count desc, packed id asc); empty slots
+        carry ``row=col=-1``. Same contract as
+        :meth:`HeavyHitterSketch.topk`, marginal-tightened."""
+        ids, valid = _decode_candidates(self.cells, self.bitsums, self.capacity)
+        flat = ids.reshape(-1)
+        in_space = flat < jnp.uint32(self.num_rows * self.num_cols)
+        lo, up = _candidate_bounds(self.cells, self.bitsums, flat, self.capacity)
+        r_idx, c_idx = self._unpack(jnp.where(in_space, flat, 0))
+        up = jnp.minimum(up, jnp.minimum(self.row_marg[r_idx], self.col_marg[c_idx]))
+        lo = jnp.minimum(lo, up)
+        pair_ids, counts, over = _rank_candidates(
+            ids, valid & in_space.reshape(valid.shape), lo, up, int(k)
+        )
+        got = pair_ids >= 0
+        rr, cc = self._unpack(jnp.where(got, pair_ids, 0))
+        return (
+            jnp.where(got, rr, -1).astype(jnp.int32),
+            jnp.where(got, cc, -1).astype(jnp.int32),
+            counts,
+            over,
+        )
+
+    def bin_masses(self) -> Array:
+        """Normalized row-marginal masses (drift input: the true-label
+        distribution, exact)."""
+        total = jnp.maximum(self.row_marg.sum(), 1.0)
+        return self.row_marg / total

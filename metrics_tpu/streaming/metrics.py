@@ -20,11 +20,21 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.streaming.distinct import DistinctCountSketch
+from metrics_tpu.streaming.heavy import CoOccurrenceSketch, HeavyHitterSketch
 from metrics_tpu.streaming.sketches import QuantileSketch, ScoreLabelSketch
 
 Array = jax.Array
 
-__all__ = ["StreamingAUROC", "StreamingAveragePrecision", "StreamingQuantile"]
+__all__ = [
+    "StreamingAUROC",
+    "StreamingAveragePrecision",
+    "StreamingConfusion",
+    "StreamingDistinctCount",
+    "StreamingQuantile",
+    "StreamingTopK",
+]
 
 
 class StreamingAUROC(Metric):
@@ -184,6 +194,194 @@ class StreamingQuantile(Metric):
         return (hi - lo) / 2.0
 
 
+class StreamingTopK(Metric):
+    """The ``k`` most frequent ids of an unbounded stream, fixed memory.
+
+    Integer ids (error classes, labels, user cohorts — anything hashable
+    to ``[0, 2^id_bits)``) fold into a
+    :class:`~metrics_tpu.streaming.heavy.HeavyHitterSketch`;
+    :meth:`compute` returns ``(ids, counts)`` for the ``k`` heaviest
+    (SpaceSaving reporting contract: counts never underestimate, empty
+    slots carry ``id=-1``) and :meth:`error_bound` the rigorous per-item
+    overestimate envelope — the true count of reported item ``i`` lies in
+    ``[counts[i] - error_bound()[i], counts[i]]``, always. Default state
+    is ~100 KB regardless of stream length or id cardinality.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import StreamingTopK
+        >>> m = StreamingTopK(k=2, capacity=64, id_bits=16)
+        >>> m.update(jnp.asarray([7, 7, 7, 9, 9, 3]))
+        >>> ids, counts = m.compute()
+        >>> [int(i) for i in ids], [float(c) for c in counts]
+        ([7, 9], [3.0, 2.0])
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        k: int = 10,
+        capacity: int = 256,
+        depth: int = 4,
+        id_bits: int = 24,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if k < 1:
+            raise ValueError(f"`k` must be >= 1, got {k}")
+        self.k = int(k)
+        self.add_state(
+            "sketch", default=HeavyHitterSketch(capacity, depth, id_bits), dist_reduce_fx="sketch"
+        )
+
+    def update(self, ids: Array, weights: Optional[Array] = None) -> None:
+        self.sketch = self.sketch.fold(ids, weights)
+
+    def compute(self) -> Tuple[Array, Array]:
+        ids, counts, _over = self.sketch.topk(self.k)
+        return ids, counts
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """Per-item rigorous ``(lower, upper)`` count envelope for the
+        reported top-``k`` (``upper`` is the reported count)."""
+        _obs_inc("stream.hh_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            _ids, counts, over = self.sketch.topk(self.k)
+        return counts - over, counts
+
+    def error_bound(self) -> Array:
+        """Per-item overestimate envelope of the reported counts."""
+        lo, hi = self.bounds()
+        return hi - lo
+
+
+class StreamingDistinctCount(Metric):
+    """Distinct ids over an unbounded stream in ``4 * 2^precision`` bytes.
+
+    "Unique users per tenant per window" at millions-of-users scale: ids
+    fold into a :class:`~metrics_tpu.streaming.distinct.
+    DistinctCountSketch` (HyperLogLog; merge is an exact idempotent
+    bitwise max, so duplicate shipping and any fold order are harmless);
+    :meth:`compute` returns the corrected cardinality estimate and
+    :meth:`error_bound` the absolute 2-sigma envelope
+    ``2 * 1.04 / sqrt(2^precision) * estimate`` (~3.2% at the default
+    ``precision=12``). Note the registers are NOT invertible — interval
+    deltas over history snapshots refuse (use a windowed instance for
+    per-window uniques).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import StreamingDistinctCount
+        >>> m = StreamingDistinctCount(precision=12)
+        >>> m.update(jnp.arange(10_000))
+        >>> abs(float(m.compute()) - 10_000) < float(m.error_bound())
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, precision: int = 12, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.precision = int(precision)
+        self.add_state("sketch", default=DistinctCountSketch(precision), dist_reduce_fx="sketch")
+
+    def update(self, ids: Array) -> None:
+        self.sketch = self.sketch.fold(ids)
+
+    def compute(self) -> Array:
+        return self.sketch.estimate()
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """2-sigma ``(lower, upper)`` envelope around the estimate."""
+        _obs_inc("stream.distinct_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            return self.sketch.bounds()
+
+    def error_bound(self) -> Array:
+        """Absolute half-width of :meth:`bounds` (2-sigma)."""
+        lo, hi = self.bounds()
+        return (hi - lo) / 2.0
+
+
+class StreamingConfusion(Metric):
+    """Confusion/co-occurrence structure for label spaces beyond the
+    C<=128 exact tile, in fixed memory.
+
+    ``(target, prediction)`` pairs fold into a
+    :class:`~metrics_tpu.streaming.heavy.CoOccurrenceSketch` — hashed
+    cell binning with an exact sum merge plus EXACT per-axis marginals.
+    :meth:`compute` returns ``(rows, cols, counts)`` for the ``k``
+    heaviest cells (counts never underestimate; empty slots ``-1``) and
+    :meth:`error_bound` the per-cell collision envelope;
+    :meth:`cell_bounds` answers arbitrary cells. A 10k x 10k label space
+    costs the same device bytes as 100 x 100.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.streaming import StreamingConfusion
+        >>> m = StreamingConfusion(num_rows=1000, k=1, capacity=64)
+        >>> m.update(jnp.asarray([3, 3, 7]), jnp.asarray([3, 3, 9]))
+        >>> rows, cols, counts = m.compute()  # k=1: squeezed to scalars
+        >>> int(rows), int(cols), float(counts)
+        (3, 3, 2.0)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_rows: int,
+        num_cols: Optional[int] = None,
+        k: int = 16,
+        capacity: int = 256,
+        depth: int = 4,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if k < 1:
+            raise ValueError(f"`k` must be >= 1, got {k}")
+        self.k = int(k)
+        self.add_state(
+            "sketch",
+            default=CoOccurrenceSketch(num_rows, num_cols, capacity, depth),
+            dist_reduce_fx="sketch",
+        )
+
+    def update(self, target: Array, preds: Array, weights: Optional[Array] = None) -> None:
+        self.sketch = self.sketch.fold(target, preds, weights)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        rows, cols, counts, _over = self.sketch.top_cells(self.k)
+        return rows, cols, counts
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """Per-cell rigorous ``(lower, upper)`` envelope for the reported
+        top-``k`` cells (``upper`` is the reported count)."""
+        _obs_inc("stream.cooccur_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            _r, _c, counts, over = self.sketch.top_cells(self.k)
+        return counts - over, counts
+
+    def error_bound(self) -> Array:
+        """Per-cell collision envelope of the reported counts."""
+        lo, hi = self.bounds()
+        return hi - lo
+
+    def cell_bounds(self, target: Array, preds: Array) -> Tuple[Array, Array]:
+        """Rigorous ``(lower, upper)`` count envelope for arbitrary
+        queried ``(target, prediction)`` cells."""
+        _obs_inc("stream.cooccur_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            return self.sketch.cell_bounds(target, preds)
+
+
 # ---------------------------------------------------------------------------
 # Sharded (gather-free) computes — make_step(..., sharded_state=True)
 # ---------------------------------------------------------------------------
@@ -196,7 +394,10 @@ from metrics_tpu.utilities.sharding import (  # noqa: E402
     register_sharded_compute as _register_sharded_compute,
     sharded_sketch_auroc as _sharded_sketch_auroc,
     sharded_sketch_average_precision as _sharded_sketch_ap,
+    sharded_sketch_cooccur_top_cells as _sharded_cooccur_top_cells,
+    sharded_sketch_distinct as _sharded_sketch_distinct,
     sharded_sketch_quantile as _sharded_sketch_quantile,
+    sharded_sketch_topk as _sharded_sketch_topk,
 )
 
 
@@ -215,6 +416,29 @@ def _streaming_quantile_sharded(worker: StreamingQuantile, state: dict, axis_nam
     return out[0] if worker._scalar_q else out
 
 
+def _streaming_topk_sharded(
+    worker: StreamingTopK, state: dict, axis_name: Any
+) -> Tuple[Array, Array]:
+    ids, counts, _over = _sharded_sketch_topk(state["sketch"], worker.k, axis_name)
+    return ids, counts
+
+
+def _streaming_distinct_sharded(
+    worker: StreamingDistinctCount, state: dict, axis_name: Any
+) -> Array:
+    return _sharded_sketch_distinct(state["sketch"], axis_name)
+
+
+def _streaming_confusion_sharded(
+    worker: StreamingConfusion, state: dict, axis_name: Any
+) -> Tuple[Array, Array, Array]:
+    rows, cols, counts, _over = _sharded_cooccur_top_cells(state["sketch"], worker.k, axis_name)
+    return rows, cols, counts
+
+
 _register_sharded_compute(StreamingAUROC, _streaming_auroc_sharded)
 _register_sharded_compute(StreamingAveragePrecision, _streaming_ap_sharded)
 _register_sharded_compute(StreamingQuantile, _streaming_quantile_sharded)
+_register_sharded_compute(StreamingTopK, _streaming_topk_sharded)
+_register_sharded_compute(StreamingDistinctCount, _streaming_distinct_sharded)
+_register_sharded_compute(StreamingConfusion, _streaming_confusion_sharded)

@@ -45,7 +45,13 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
-__all__ = ["QuantileSketch", "ScoreLabelSketch", "Sketch", "sketch_from_pack_tree"]
+__all__ = [
+    "QuantileSketch",
+    "ScoreLabelSketch",
+    "Sketch",
+    "delta_envelope_leaf",
+    "sketch_from_pack_tree",
+]
 
 # class registry for checkpoint round-trips (utilities/checkpoint._unpack)
 _SKETCH_REGISTRY: Dict[str, Type["Sketch"]] = {}
@@ -70,6 +76,15 @@ class Sketch:
       reduce-scatter sync that leaves each device holding its bin slice
       instead of a full merged replica). Leaves absent from the mapping
       (extremes, scalars) stay replicated.
+    * ``_delta_envelope_leaves`` — the names of min/max leaves that are
+      cumulative ENVELOPE bounds (a quantile sketch's running
+      ``minv``/``maxv``): over a history interval delta they may be
+      carried from the newer snapshot and stay a valid bound for the
+      interval. min/max leaves NOT named here (HLL max-registers, whose
+      carried value would silently answer "uniques ever" to a "uniques
+      this interval" query) make interval deltas refuse with
+      :class:`~metrics_tpu.serve.history.DeltaUndefinedError` — consult
+      via :func:`delta_envelope_leaf`.
 
     The flatten/unflatten protocol intentionally accepts leaves of any
     shape: ``vmap``/``make_epoch`` stack a leading batch axis onto every
@@ -79,6 +94,7 @@ class Sketch:
     _leaf_fields: Tuple[Tuple[str, str], ...] = ()
     _config_fields: Tuple[str, ...] = ()
     _shard_dims: Dict[str, int] = {}
+    _delta_envelope_leaves: Tuple[str, ...] = ()
 
     def __init_subclass__(cls, **kwargs: Any) -> None:
         super().__init_subclass__(**kwargs)
@@ -271,6 +287,7 @@ class QuantileSketch(Sketch):
 
     _leaf_fields = (("counts", "sum"), ("minv", "min"), ("maxv", "max"))
     _config_fields = ("num_bins", "lo", "hi")
+    _delta_envelope_leaves = ("minv", "maxv")
     # bins distribute over the mesh; the exact min/max scalars replicate
     _shard_dims = {"counts": 0}
 
@@ -555,3 +572,35 @@ def merge_all(sketches: Sequence[Sketch]) -> Sketch:
     if not sketches:
         raise ValueError("merge_all needs at least one sketch")
     return functools.reduce(lambda a, b: a.merge(b), sketches)
+
+
+def delta_envelope_leaf(leaf_name: str) -> bool:
+    """Whether a min/max sketch leaf named ``leaf_name`` is a cumulative
+    ENVELOPE bound — carryable through history interval deltas — according
+    to every registered sketch class's ``_delta_envelope_leaves``.
+
+    The history tier's delta algebra sees spec paths
+    (``__sketch_leaf_<name>``), not sketch classes, so the answer is
+    resolved by leaf NAME across the registry. Registration guards the
+    ambiguity: if one class declares a min/max leaf name an envelope and
+    another uses the same name for a non-invertible extreme (an HLL
+    register array), this raises rather than guess — rename the leaf.
+    """
+    envelope = False
+    plain = False
+    for cls in _SKETCH_REGISTRY.values():
+        for name, red in cls._leaf_fields:
+            if name != leaf_name or red not in ("min", "max"):
+                continue
+            if name in cls._delta_envelope_leaves:
+                envelope = True
+            else:
+                plain = True
+    if envelope and plain:
+        raise ValueError(
+            f"sketch leaf name {leaf_name!r} is declared a delta-envelope"
+            " bound by one registered sketch class and a plain extreme by"
+            " another — leaf names must be unambiguous for the history"
+            " delta algebra; rename one of them"
+        )
+    return envelope

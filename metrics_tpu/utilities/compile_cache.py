@@ -2,8 +2,12 @@
 
 Benches, tests and doctests compile hundreds of programs — many small, a
 few (retrieval sort/segment at 1M docs, InceptionV3) taking minutes on a
-cold process. One cache dir serves them all; the threshold is low enough
-that the small doctest programs are cached too.
+cold process. One cache dir serves them all; the min-compile-time threshold
+is zero so every program — including the hundreds of sub-100ms test jits,
+which in aggregate dominate suite wall-clock on a 1-core runner — is cached.
+Tests that need a compile the cache could falsify (op-metadata assertions,
+executable serialization) opt out via the ``isolated_compile_cache``
+fixture in ``tests/conftest.py``.
 """
 import os
 
@@ -29,7 +33,7 @@ def enable_persistent_cache() -> None:
 
     try:
         jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except (AttributeError, KeyError) as err:  # older jax without the knob
         from metrics_tpu.utilities.prints import rank_zero_debug
 

@@ -49,6 +49,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from metrics_tpu.streaming.distinct import DistinctCountSketch, _hll_estimate
+from metrics_tpu.streaming.hashing import bucket_index, pack_bits
+from metrics_tpu.streaming.heavy import CoOccurrenceSketch, HeavyHitterSketch, _rank_candidates
 from metrics_tpu.streaming.sketches import QuantileSketch, ScoreLabelSketch, Sketch
 from metrics_tpu.utilities.buffers import CapacityBuffer
 from metrics_tpu.utilities.distributed import (
@@ -56,6 +59,7 @@ from metrics_tpu.utilities.distributed import (
     _axis_size,
     _obs_count_collective,
     reduce_scatter_in_context,
+    replicate_typed,
     sync_reduce_in_context,
 )
 
@@ -70,7 +74,10 @@ __all__ = [
     "sharded_sample_auroc",
     "sharded_sketch_auroc",
     "sharded_sketch_average_precision",
+    "sharded_sketch_cooccur_top_cells",
+    "sharded_sketch_distinct",
     "sharded_sketch_quantile",
+    "sharded_sketch_topk",
     "state_named_shardings",
 ]
 
@@ -433,6 +440,132 @@ def sharded_sketch_quantile(
     hi_v = jnp.where(q_arr <= 0.0, minv, jnp.where(q_arr >= 1.0, maxv, hi_v))
     out = jnp.where(total > 0, (lo_v + hi_v) / 2.0, jnp.nan)
     return out[0] if jnp.ndim(q) == 0 else out
+
+
+# ---------------------------------------------------------------------------
+# Sharded linear-sketch computes: heavy hitters / co-occurrence / distinct
+# ---------------------------------------------------------------------------
+# The heavy-hitter family's merged state reduce-scatters bucket-wise
+# (shard dim 1 of counts[D, W] and bitsums[D, W, B]); the full merged
+# tables never exist on one device. Decode + bounds then split as:
+#   * each device majority-decodes the candidates of ITS bucket slice
+#     (the scattered slices are exact global sums for those buckets);
+#   * candidate ids — KB-sized, never the state — all-gather once;
+#   * per-(candidate, row) bound terms are owned by exactly one device
+#     (whoever holds the bucket that candidate hashes to in that row),
+#     so the min-over-rows upper / max-over-rows lower finish with one
+#     pmin/pmax over the candidate vector. min/max are exact, the owned
+#     terms are the same f32 values the replicated decode computes, and
+#     _rank_candidates' (estimate desc, id asc) total order is
+#     enumeration-invariant — the reported arrays match the replicated
+#     topk() BITWISE.
+
+
+def _sharded_linear_candidates(
+    counts_l: Array, bitsums_l: Array, width: int, scatter_ax: str
+) -> Tuple[Array, Array, Array, Array]:
+    """Decode + bound candidates from bucket-sharded linear-sketch slices.
+
+    Returns replicated flat ``(ids int32[M], valid bool[M], lower f32[M],
+    upper f32[M])`` over all ``M = n_dev * depth * local_width`` candidate
+    slots (padded slots are massless -> invalid).
+    """
+    depth, local_len = counts_l.shape
+    shard = lax.axis_index(scatter_ax)
+    cols_global = shard * local_len + jnp.arange(local_len, dtype=jnp.int32)
+    # local decode: majority bits per owned cell + home-bucket self-check
+    maj = (2.0 * bitsums_l) > counts_l[..., None]
+    ids_local = pack_bits(maj)  # uint32 [D, local]
+    valid_local = counts_l > 0
+    for r in range(depth):
+        valid_local = valid_local.at[r].set(
+            valid_local[r] & (bucket_index(ids_local[r], r, width) == cols_global)
+        )
+    # candidate gather: KB of ids, never the state; the gathered vectors
+    # are device-identical but varying-typed — re-type them (pmax identity,
+    # exact for ints) so the ranked outputs satisfy out_specs=P()
+    ids = _all_gather(ids_local.reshape(-1), scatter_ax, "varying").reshape(-1)
+    ids = replicate_typed(ids, scatter_ax)
+    valid = _all_gather(valid_local.reshape(-1).astype(jnp.int32), scatter_ax, "varying")
+    valid = replicate_typed(valid.reshape(-1), scatter_ax) > 0
+    # owned per-(candidate, row) bound terms, then pmin/pmax to finish
+    num_bits = bitsums_l.shape[-1]
+    bits = ((ids[:, None] >> jnp.arange(num_bits, dtype=jnp.uint32)) & jnp.uint32(1)) > 0
+    uppers, lowers = [], []
+    for r in range(depth):
+        b = bucket_index(ids, r, width)  # global bucket, [M]
+        mine = (b // local_len) == shard
+        lb = jnp.clip(b - shard * local_len, 0, local_len - 1)
+        c = counts_l[r, lb]
+        bs = bitsums_l[r, lb, :]
+        agree = jnp.where(bits, bs, c[:, None] - bs)
+        up_r = jnp.minimum(agree.min(axis=-1), c)
+        lo_r = c - (c[:, None] - agree).sum(axis=-1)
+        uppers.append(jnp.where(mine, up_r, jnp.inf))
+        lowers.append(jnp.where(mine, lo_r, -jnp.inf))
+    upper = lax.pmin(jnp.stack(uppers).min(axis=0), scatter_ax)
+    lower = jnp.clip(lax.pmax(jnp.stack(lowers).max(axis=0), scatter_ax), 0.0, None)
+    return ids, valid, jnp.minimum(lower, upper), upper
+
+
+def sharded_sketch_topk(
+    sketch: HeavyHitterSketch, k: int, axis_name: Union[str, Tuple[str, ...]]
+) -> Tuple[Array, Array, Array]:
+    """``HeavyHitterSketch.topk(k)`` with the merged tables left SHARDED —
+    bitwise-equal ``(ids, counts, overestimates)`` to the replicated
+    condensation (see the block comment above for the decomposition)."""
+    view = shard_sketch_in_context(sketch, axis_name)
+    scatter_ax = _scatter_axis(axis_name)
+    ids, valid, lo, up = _sharded_linear_candidates(
+        view.counts, view.bitsums, sketch.capacity, scatter_ax
+    )
+    return _rank_candidates(ids, valid, lo, up, int(k))
+
+
+def sharded_sketch_cooccur_top_cells(
+    sketch: CoOccurrenceSketch, k: int, axis_name: Union[str, Tuple[str, ...]]
+) -> Tuple[Array, Array, Array, Array]:
+    """``CoOccurrenceSketch.top_cells(k)`` from bucket-sharded cell tables.
+
+    Same candidate decomposition as :func:`sharded_sketch_topk`; the exact
+    marginals carry no shard dim, so the shard view holds them fully
+    synced (psum — replicated) and the marginal clamp is local math."""
+    view = shard_sketch_in_context(sketch, axis_name)
+    scatter_ax = _scatter_axis(axis_name)
+    ids, valid, lo, up = _sharded_linear_candidates(
+        view.cells, view.bitsums, sketch.capacity, scatter_ax
+    )
+    in_space = ids < jnp.uint32(sketch.num_rows * sketch.num_cols)
+    safe = jnp.where(in_space, ids, 0)
+    r_idx, c_idx = sketch._unpack(safe)
+    up = jnp.minimum(up, jnp.minimum(view.row_marg[r_idx], view.col_marg[c_idx]))
+    lo = jnp.minimum(lo, up)
+    pair_ids, counts, over = _rank_candidates(ids, valid & in_space, lo, up, int(k))
+    got = pair_ids >= 0
+    rr, cc = sketch._unpack(jnp.where(got, pair_ids, 0))
+    return (
+        jnp.where(got, rr, -1).astype(jnp.int32),
+        jnp.where(got, cc, -1).astype(jnp.int32),
+        counts,
+        over,
+    )
+
+
+def sharded_sketch_distinct(
+    sketch: DistinctCountSketch, axis_name: Union[str, Tuple[str, ...]]
+) -> Array:
+    """``DistinctCountSketch.estimate()`` under the sharded-state path.
+
+    HLL registers carry the ``max`` reduction, so the shard view syncs
+    them by pmax (idempotent — the one collective whose "reduce-scatter"
+    IS its all-reduce payload-wise) and the corrected estimator runs
+    locally on the full register array: at 2^p int32 registers (16KB at
+    p=12) the state is smaller than one candidate gather of the
+    heavy-hitter family, and evaluating it whole keeps the estimate
+    bitwise-equal to the replicated compute (a segmented harmonic sum
+    would reorder f32 addition)."""
+    view = shard_sketch_in_context(sketch, axis_name)
+    return _hll_estimate(view.regs, sketch.precision)
 
 
 # ---------------------------------------------------------------------------

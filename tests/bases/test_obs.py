@@ -82,7 +82,12 @@ class TestDisabledIsFree:
             hlo_bypassed = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
         assert hlo_disabled == hlo_bypassed
 
+    @pytest.mark.usefixtures("isolated_compile_cache")
     def test_enable_disable_round_trip_identical(self):
+        # isolated cache dir: the enabled-mode compile in the middle must
+        # not deposit a scoped executable under the shared cache's
+        # metadata-stripped key, where later disabled-mode compiles (here
+        # and in other tests) would be served it
         init, step, _ = make_step(Accuracy, num_classes=3)
         before = _compiled_hlo(step, init(), _PREDS, _TARGET)
         obs.enable()
@@ -105,23 +110,22 @@ class TestDisabledIsFree:
 
 
 class TestLifecycleTracing:
+    @pytest.mark.usefixtures("isolated_compile_cache")
     def test_enabled_lowering_carries_named_scopes(self):
         # the persistent compile cache strips op metadata from its KEY, so
         # a scope-free executable cached by an earlier disabled-mode run
         # would be served for the enabled-mode compile and hide the scopes
-        # this test pins — compile fresh for the comparison
-        try:
-            jax.config.update("jax_enable_compilation_cache", False)
-            init, step, _ = make_step(Accuracy, num_classes=3)
-            hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
-            assert "Accuracy.step" not in hlo_off
-            obs.enable()
-            init2, step2, _ = make_step(Accuracy, num_classes=3)
-            hlo_on = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
-            assert "Accuracy.step" in hlo_on
-            assert "Accuracy.update" in hlo_on
-        finally:
-            jax.config.update("jax_enable_compilation_cache", True)
+        # this test pins — the isolated (empty) cache dir forces both
+        # compiles fresh (the enable-knob toggle this test used to rely on
+        # stops blocking reads once the cache is initialized)
+        init, step, _ = make_step(Accuracy, num_classes=3)
+        hlo_off = _compiled_hlo(step, init(), _PREDS, _TARGET)
+        assert "Accuracy.step" not in hlo_off
+        obs.enable()
+        init2, step2, _ = make_step(Accuracy, num_classes=3)
+        hlo_on = _compiled_hlo(step2, init2(), _PREDS, _TARGET)
+        assert "Accuracy.step" in hlo_on
+        assert "Accuracy.update" in hlo_on
 
     def test_span_per_lifecycle_phase(self):
         obs.enable()

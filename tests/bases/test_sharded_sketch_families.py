@@ -1,0 +1,206 @@
+"""Mesh-sharded heavy-hitter / distinct / co-occurrence: bitwise pins.
+
+The new sketch trio rides the same sharded-state contract
+``tests/bases/test_sharded_state.py`` pins for the original sketches:
+
+* ``shard_sketch_in_context`` leaves each device an exact slice of the
+  merged bucket tables (sum leaves reduce-scatter; HLL max-registers
+  pmax), bitwise-equal to the eager global fold across 2/4/8-way meshes
+  and physical device permutations;
+* the gather-free kernels (``sharded_sketch_topk`` /
+  ``sharded_sketch_cooccur_top_cells`` / ``sharded_sketch_distinct``)
+  report BITWISE the same values as the replicated compute — the
+  condensation's (estimate desc, id asc) total order is
+  enumeration-invariant, so even the top-k ID ARRAYS match exactly;
+* ``make_step(..., sharded_state=True)`` resolves the registered kernels
+  for the Streaming metrics end to end.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.steps import make_step
+from metrics_tpu.streaming import (
+    CoOccurrenceSketch,
+    DistinctCountSketch,
+    HeavyHitterSketch,
+    StreamingDistinctCount,
+    StreamingTopK,
+)
+from metrics_tpu.utilities.sharding import (
+    get_sharded_compute,
+    shard_sketch_in_context,
+    sharded_sketch_cooccur_top_cells,
+    sharded_sketch_distinct,
+    sharded_sketch_topk,
+)
+
+try:
+    from jax import shard_map as _shard_map_mod  # noqa: F401  # jax>=0.6 style
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def shard_map(f, mesh, in_specs, out_specs):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+N_DEV = 8
+
+
+def _perms(n):
+    rng = np.random.default_rng(42)
+    return [list(range(n)), list(reversed(range(n))), list(rng.permutation(n))]
+
+
+def _ids(n=8 * 600, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray((rng.zipf(1.4, n) % 3000).astype(np.int32))
+
+
+class TestShardedScatterBitwise:
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_heavy_hitter_scatter_slices_bitwise(self, n_dev):
+        # device permutations are swept on the topk kernel below; here a
+        # single reversed order checks scatter placement without paying
+        # another 9 shard_map compiles
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[1]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        ids = _ids()
+        # capacity 100 does not divide 8: exercises the massless padding
+        template = HeavyHitterSketch(capacity=100, depth=4, id_bits=20)
+
+        def prog(x):
+            view = shard_sketch_in_context(template.fold(x), "dp")
+            return view.counts, view.bitsums
+
+        fn = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=(P(None, "dp"), P(None, "dp"))))
+        counts, bitsums = fn(ids)
+        oracle = HeavyHitterSketch(capacity=100, depth=4, id_bits=20).fold(ids)
+        np.testing.assert_array_equal(np.asarray(counts)[:, :100], np.asarray(oracle.counts))
+        np.testing.assert_array_equal(np.asarray(bitsums)[:, :100], np.asarray(oracle.bitsums))
+        assert not np.asarray(counts)[:, 100:].any()  # pad buckets stay massless
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_hll_registers_pmax_bitwise(self, n_dev):
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        ids = _ids(seed=4)
+        template = DistinctCountSketch(precision=10)
+
+        def prog(x):
+            return shard_sketch_in_context(template.fold(x), "dp").regs
+
+        regs = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P()))(ids)
+        oracle = DistinctCountSketch(precision=10).fold(ids)
+        np.testing.assert_array_equal(np.asarray(regs), np.asarray(oracle.regs))
+
+
+class TestShardedKernelsBitwise:
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    @pytest.mark.parametrize("perm_i", [0, 1, 2])
+    def test_topk_kernel_bitwise(self, n_dev, perm_i):
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[perm_i]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        ids = _ids(seed=1)
+        template = HeavyHitterSketch(capacity=96, depth=4, id_bits=20)
+
+        def prog(x):
+            return sharded_sketch_topk(template.fold(x), 8, "dp")
+
+        got = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P()))(ids)
+        ref = HeavyHitterSketch(capacity=96, depth=4, id_bits=20).fold(ids).topk(8)
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_cooccur_kernel_bitwise(self, n_dev):
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        ids = np.asarray(_ids(seed=2))
+        rows, cols = jnp.asarray(ids % 500), jnp.asarray((ids * 13) % 500)
+        template = CoOccurrenceSketch(num_rows=500, num_cols=500, capacity=96, depth=4)
+
+        def prog(r, c):
+            return sharded_sketch_cooccur_top_cells(template.fold(r, c), 6, "dp")
+
+        got = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(rows, cols)
+        ref = (
+            CoOccurrenceSketch(num_rows=500, num_cols=500, capacity=96, depth=4)
+            .fold(rows, cols)
+            .top_cells(6)
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_distinct_kernel_bitwise(self, n_dev):
+        # permutation sweep lives on the topk kernel; pmax of registers
+        # is order-free by the same monoid argument
+        devices = np.asarray(jax.devices()[:N_DEV])[_perms(N_DEV)[2]][:n_dev]
+        mesh = Mesh(devices, ("dp",))
+        ids = _ids(seed=3)
+        template = DistinctCountSketch(precision=10)
+
+        def prog(x):
+            return sharded_sketch_distinct(template.fold(x), "dp")
+
+        got = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P()))(ids)
+        ref = DistinctCountSketch(precision=10).fold(ids).estimate()
+        assert float(got) == float(ref)
+
+
+class TestShardedMetricEndToEnd:
+    def test_kernels_registered(self):
+        from metrics_tpu.streaming import StreamingConfusion
+
+        for cls in (StreamingTopK, StreamingDistinctCount, StreamingConfusion):
+            assert get_sharded_compute(cls) is not None, cls.__name__
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_topk_metric_sharded_step_bitwise(self, n_dev):
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        ids = _ids(seed=6)
+        init, step, compute = make_step(
+            StreamingTopK(k=5, capacity=64, id_bits=16),
+            axis_name="dp",
+            with_value=False,
+            sharded_state=True,
+        )
+
+        def prog(x):
+            state, _ = step(init(), x)
+            return compute(state)
+
+        got_ids, got_counts = jax.jit(
+            shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P())
+        )(ids)
+        eager = StreamingTopK(k=5, capacity=64, id_bits=16)
+        eager.update(ids)
+        ref_ids, ref_counts = eager.compute()
+        np.testing.assert_array_equal(np.asarray(got_ids), np.asarray(ref_ids))
+        np.testing.assert_array_equal(np.asarray(got_counts), np.asarray(ref_counts))
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_distinct_metric_sharded_step_bitwise(self, n_dev):
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("dp",))
+        ids = _ids(seed=7)
+        init, step, compute = make_step(
+            StreamingDistinctCount(precision=10),
+            axis_name="dp",
+            with_value=False,
+            sharded_state=True,
+        )
+
+        def prog(x):
+            state, _ = step(init(), x)
+            return compute(state)
+
+        got = jax.jit(shard_map(prog, mesh, in_specs=(P("dp"),), out_specs=P()))(ids)
+        eager = StreamingDistinctCount(precision=10)
+        eager.update(ids)
+        assert float(got) == float(eager.compute())

@@ -22,6 +22,35 @@ try:
 except AttributeError:  # older jax: the XLA_FLAGS fallback above applies
     pass
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def isolated_compile_cache():
+    """Detach the persistent XLA compile cache for the duration of a test.
+
+    For tests that pin what a real backend compile produces — op metadata
+    carried by obs named scopes, executables the engine's ProgramStore must
+    serialize — the shared on-disk cache is a confound: the cache key
+    strips op metadata, so it happily serves a scope-free executable for a
+    scoped compile (and vice versa, even for two compiles INSIDE one test),
+    and a cache-served executable re-serializes into a blob that cannot be
+    deserialized ("Symbols not found").
+    ``jax.config.update("jax_enable_compilation_cache", False)`` is NOT a
+    substitute: once any compile has initialized the cache, the knob no
+    longer blocks reads (jax 0.4.x memoizes cache setup) — unsetting the
+    cache *dir* plus ``reset_cache()`` is what actually detaches it.
+    """
+    from jax.experimental.compilation_cache import compilation_cache as cc
+
+    from metrics_tpu.utilities.compile_cache import CACHE_DIR
+
+    jax.config.update("jax_compilation_cache_dir", None)
+    cc.reset_cache()
+    yield
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    cc.reset_cache()
+
 NUM_BATCHES = 4
 BATCH_SIZE = 32
 NUM_CLASSES = 5

@@ -10,13 +10,13 @@ moment accumulation, and sqrtm numerics as one pipeline.
 """
 import numpy as np
 import pytest
-import scipy.linalg
 
 import jax.numpy as jnp
 
 from metrics_tpu import FrechetInceptionDistance, InceptionScore, KernelInceptionDistance
 from metrics_tpu.image.backbones import NoTrainInceptionV3
 from metrics_tpu.image.backbones.convert import convert_inception_state_dict, save_flat_npz
+from metrics_tpu.image.backbones.inception import _inception_forward
 
 from tests.image.backbone_golden_lib import golden_input, inception_torch_state_dict
 
@@ -37,12 +37,31 @@ def imgs():
     return jnp.asarray(real), jnp.asarray(fake)
 
 
-def _features(weights_npz, imgs, tap):
-    net = NoTrainInceptionV3([tap], weights_path=weights_npz)
-    return np.asarray(net(imgs), dtype=np.float64)
+@pytest.fixture(scope="module")
+def oracle_feats(weights_npz, imgs):
+    """Oracle features for every test in this module, extracted ONCE.
+
+    One two-tap net and two forwards replace five single-tap nets (each
+    paying a weights reload + a full InceptionV3 forward on this host); the
+    taps come from the same golden-pinned backbone either way.
+    """
+    real, fake = imgs
+    net = NoTrainInceptionV3(["2048", "logits"], weights_path=weights_npz)
+
+    def taps(x):
+        f2048, logits = _inception_forward(net.module, net.variables, x)
+        n = x.shape[0]
+        return (
+            np.asarray(f2048, dtype=np.float64).reshape(n, -1),
+            np.asarray(logits, dtype=np.float64).reshape(n, -1),
+        )
+
+    f_real, logits_real = taps(real)
+    f_fake, _ = taps(fake)
+    return f_real, f_fake, logits_real
 
 
-def test_fid_through_real_backbone(weights_npz, imgs):
+def test_fid_through_real_backbone(weights_npz, imgs, oracle_feats):
     real, fake = imgs
     fid = FrechetInceptionDistance(feature=2048, weights_path=weights_npz)
     # two streaming updates per distribution: moments must accumulate
@@ -52,17 +71,26 @@ def test_fid_through_real_backbone(weights_npz, imgs):
     fid.update(fake[N // 2 :], real=False)
     got = float(fid.compute())
 
-    f_real = _features(weights_npz, real, "2048")
-    f_fake = _features(weights_npz, fake, "2048")
+    f_real, f_fake, _ = oracle_feats
     mu1, mu2 = f_real.mean(0), f_fake.mean(0)
-    s1 = np.cov(f_real, rowvar=False)
-    s2 = np.cov(f_fake, rowvar=False)
-    covmean = scipy.linalg.sqrtm(s1 @ s2)
-    want = float((mu1 - mu2) @ (mu1 - mu2) + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean.real))
+    # trace(sqrtm(s1 @ s2)) without forming 2048x2048 covariances: with
+    # centered C, D (rows scaled by 1/sqrt(n-1)), s1 @ s2 = CtC DtD shares
+    # its nonzero eigenvalues with the N x N product (C Dt)(D Ct), and for
+    # a product of PSD matrices those eigenvalues are real nonnegative —
+    # the published formula evaluated exactly through the low-rank identity
+    # (a dense scipy sqrtm at 2048^2 costs ~10 s on this host for the same
+    # number).
+    C = (f_real - mu1) / np.sqrt(N - 1)
+    D = (f_fake - mu2) / np.sqrt(N - 1)
+    small = (C @ D.T) @ (D @ C.T)
+    tr_covmean = np.sqrt(np.maximum(np.linalg.eigvals(small).real, 0.0)).sum()
+    tr_s1 = (C * C).sum()
+    tr_s2 = (D * D).sum()
+    want = float((mu1 - mu2) @ (mu1 - mu2) + tr_s1 + tr_s2 - 2 * tr_covmean)
     np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
 
 
-def test_kid_through_real_backbone(weights_npz, imgs):
+def test_kid_through_real_backbone(weights_npz, imgs, oracle_feats):
     real, fake = imgs
     kid = KernelInceptionDistance(
         feature=2048, weights_path=weights_npz, subsets=1, subset_size=N
@@ -71,8 +99,7 @@ def test_kid_through_real_backbone(weights_npz, imgs):
     kid.update(fake, real=False)
     mean, std = kid.compute()
 
-    f1 = _features(weights_npz, real, "2048")
-    f2 = _features(weights_npz, fake, "2048")
+    f1, f2, _ = oracle_feats
     gamma = 1.0 / f1.shape[1]
     k11 = (f1 @ f1.T * gamma + 1.0) ** 3
     k22 = (f2 @ f2.T * gamma + 1.0) ** 3
@@ -112,13 +139,13 @@ def test_lpips_metric_through_golden_tower(tmp_path):
     np.testing.assert_allclose(float(m.compute()), goldens["lpips/alex"].mean(), atol=5e-4)
 
 
-def test_inception_score_through_real_backbone(weights_npz, imgs):
+def test_inception_score_through_real_backbone(weights_npz, imgs, oracle_feats):
     real, _ = imgs
     iscore = InceptionScore(weights_path=weights_npz, splits=2)
     iscore.update(real)
     mean, std = iscore.compute()
 
-    logits = _features(weights_npz, real, "logits")
+    _, _, logits = oracle_feats
     probs = np.exp(logits - logits.max(1, keepdims=True))
     probs /= probs.sum(1, keepdims=True)
     kls = []

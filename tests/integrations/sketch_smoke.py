@@ -1,0 +1,262 @@
+"""CI smoke: the sketch trio survives the hostile fleet, bitwise.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.sketch_smoke``
+(the CI step does, mirroring ``elastic_smoke``). 1000 clients ship two
+cumulative snapshot intervals of heavy-hitter / distinct-count /
+co-occurrence state through an elastic :class:`~metrics_tpu.serve.
+AggregationTree`, consulting the consistent-hash Router per ship, under
+a seeded 10% :class:`~metrics_tpu.ft.faults.WireChaos` schedule (drop /
+duplicate / reorder / corrupt / delay). Between intervals a node JOINS
+and an intermediate is HARD-KILLED and rebuilt by the Supervisor.
+
+Acceptance, all asserted here:
+
+* the final root merged state is **bitwise-equal to the flat oracle
+  merge of exactly the accepted snapshots** — linear-sketch merges are
+  exact integer-valued sums (HLL registers an idempotent max), so chaos
+  duplicates, reordering, and topology churn must be invisible;
+* the root's answers carry **rigorous envelopes vs exact references**
+  computed directly from the accepted samples: every reported heavy
+  hitter's true count lies inside ``bounds()``, the exact top item is
+  reported, the distinct estimate lands within 3 sigma of the true
+  unique count, and every reported co-occurrence cell's bound interval
+  contains the exact pair count;
+* the HTTP ``/query`` surface agrees with the in-process query.
+"""
+import collections
+import json
+import os
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260806
+N_CLIENTS = 1000
+N_INTERVALS = 2
+SAMPLES = 64
+TENANT = "sketch"
+FAN_OUT = (2, 4)
+ID_SPACE = 2000
+LABELS = 200
+
+
+def _factory():
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import (
+        StreamingConfusion,
+        StreamingDistinctCount,
+        StreamingTopK,
+    )
+
+    return MetricCollection(
+        {
+            "topk": StreamingTopK(k=8, capacity=256, depth=4, id_bits=20),
+            "uniq": StreamingDistinctCount(precision=12),
+            "conf": StreamingConfusion(num_rows=LABELS, k=8, capacity=256, depth=4),
+        }
+    )
+
+
+def _client_data():
+    """Per-client per-interval id batches (numpy, also the exact oracle's
+    raw material)."""
+    import numpy as np
+
+    data = {}
+    for c in range(N_CLIENTS):
+        rng = np.random.default_rng(9000 + c)
+        data[f"client-{c:04d}"] = [
+            (rng.zipf(1.3, SAMPLES) % ID_SPACE).astype(np.int32)
+            for _ in range(N_INTERVALS)
+        ]
+    return data
+
+
+def _client_snapshots(data):
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for cid, batches in data.items():
+        coll = _factory()
+        blobs = []
+        for interval, batch in enumerate(batches):
+            ids = jnp.asarray(batch)
+            coll["topk"].update(ids)
+            coll["uniq"].update(ids)
+            coll["conf"].update(ids % LABELS, (ids * 7) % LABELS)
+            blobs.append(
+                encode_state(coll, tenant=TENANT, client_id=cid, watermark=(0, interval))
+            )
+        out[cid] = blobs
+    return out
+
+
+def main() -> None:
+    import numpy as np
+
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.serve import (
+        AggregationTree,
+        Aggregator,
+        ElasticFleet,
+        MetricsServer,
+        ResilienceConfig,
+        Supervisor,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, peek_header
+
+    obs.reset()
+    obs.enable()
+    data = _client_data()
+    snapshots = _client_snapshots(data)
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.02, p_duplicate=0.02, p_reorder=0.02, p_corrupt=0.02, p_delay=0.02
+    )
+    tree = AggregationTree(
+        fan_out=FAN_OUT,
+        tenants={TENANT: _factory},
+        resilience=ResilienceConfig(error_threshold=3),
+    )
+    fleet = ElasticFleet(tree, seed=SEED)
+    supervisor = Supervisor(tree, heartbeat_timeout_s=5.0, name="supervisor", warn=False)
+
+    delivered = set()  # (client_id, interval) delivered uncorrupted + admitted
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                continue  # corruption mangled the framing: refused anywhere
+            cid = str(header["client"])
+            try:
+                fleet.router.route(cid).ingest(blob)  # router consulted PER SHIP
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+            else:
+                delivered.add((cid, int(header["watermark"][1])))
+
+    def deliver_interval(interval: int) -> None:
+        for cid in sorted(snapshots):
+            _, now_blobs = chaos.plan(snapshots[cid][interval])
+            deliver(now_blobs)
+        deliver(chaos.end_round())
+
+    # interval 0, then a node JOINS (ring re-homing under live traffic)
+    deliver_interval(0)
+    fleet.pump()
+    joined = faults.join_node(fleet)
+    assert joined.name in fleet.router.members()
+
+    # interval 1, then an intermediate HARD-KILL + supervised rebuild
+    deliver_interval(1)
+    fleet.pump()
+    kill_victim = chaos.choice(tree.levels[1])
+    faults.kill_node(kill_victim)
+    assert "dead_node" in {f["kind"] for f in supervisor.check()["findings"]}
+    actions = supervisor.heal()
+    assert any(a["action"] == "rebuild_node" and a["node"] == kill_victim.name for a in actions)
+    deliver(chaos.flush())
+    fleet.pump(rounds=3)
+
+    # ---- oracle: flat merge of exactly the accepted snapshots -----------
+    accepted = {}
+    for cid, interval in delivered:
+        if cid not in accepted or interval > accepted[cid]:
+            accepted[cid] = interval
+    assert len(accepted) > 0.8 * N_CLIENTS  # 10% chaos cannot eat the fleet
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(TENANT, _factory)
+    for cid, interval in sorted(accepted.items()):
+        flat.ingest(snapshots[cid][interval])
+    flat.flush()
+    flat_tenant = flat._tenant(TENANT)
+    if flat_tenant.merged_leaves is None:
+        flat_tenant.fold()
+    tree.root.aggregator.flush()
+    root_tenant = tree.root.aggregator._tenant(TENANT)
+    if root_tenant.merged_leaves is None:
+        root_tenant.fold()
+    assert root_tenant.spec == flat_tenant.spec
+    for (path, _), ours, oracle in zip(
+        root_tenant.spec, root_tenant.merged_leaves, flat_tenant.merged_leaves
+    ):
+        assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+            f"root leaf {'/'.join(path)} differs from the accepted-snapshot oracle"
+            " after join + intermediate-kill churn at 10% wire faults"
+        )
+
+    # ---- envelopes vs EXACT references from the accepted samples --------
+    exact = collections.Counter()
+    exact_cells = collections.Counter()
+    for cid, interval in sorted(accepted.items()):
+        for batch in data[cid][: interval + 1]:
+            for i in batch.tolist():
+                exact[i] += 1
+                exact_cells[(i % LABELS, (i * 7) % LABELS)] += 1
+    exact_uniques = len(exact)
+
+    view = tree.root.aggregator.collection(TENANT)
+    ids, counts = (np.asarray(a) for a in view["topk"].compute())
+    lo, hi = (np.asarray(a) for a in view["topk"].bounds())
+    reported = [int(i) for i in ids if i >= 0]
+    assert len(reported) == 8
+    for slot, item in enumerate(ids.tolist()):
+        if item < 0:
+            continue
+        true = exact[item]
+        assert lo[slot] <= true <= hi[slot], (
+            f"heavy hitter {item}: true count {true} outside [{lo[slot]}, {hi[slot]}]"
+        )
+    true_top = exact.most_common(1)[0][0]
+    assert true_top in reported, f"exact top item {true_top} missing from reported top-k"
+
+    est = float(view["uniq"].compute())
+    sigma = float(view["uniq"].error_bound())  # relative error, 1.04/sqrt(m)
+    assert abs(est - exact_uniques) <= 3.0 * sigma * exact_uniques, (
+        f"distinct estimate {est} vs exact {exact_uniques} beyond 3 sigma"
+    )
+
+    rows, cols, cell_counts = (np.asarray(a) for a in view["conf"].compute())
+    import jax.numpy as jnp
+
+    clo, chi = (
+        np.asarray(a)
+        for a in view["conf"].cell_bounds(jnp.asarray(rows), jnp.asarray(cols))
+    )
+    for slot, (r, c) in enumerate(zip(rows.tolist(), cols.tolist())):
+        if r < 0:
+            continue
+        true = exact_cells[(r, c)]
+        assert clo[slot] <= true <= chi[slot], (
+            f"cell ({r},{c}): true {true} outside [{clo[slot]}, {chi[slot]}]"
+        )
+
+    # ---- the HTTP surface agrees ----------------------------------------
+    server = MetricsServer(tree.root.aggregator, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        q = json.load(urllib.request.urlopen(f"{base}/query?tenant={TENANT}", timeout=10))
+        offline = tree.root.aggregator.query(TENANT)
+        assert q == json.loads(json.dumps(offline)), "HTTP /query != in-process query"
+    finally:
+        server.stop()
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    print(
+        f"sketch smoke: {len(accepted)}/{N_CLIENTS} clients accepted x {N_INTERVALS}"
+        f" intervals at 10% wire faults ({faults_injected} injected) through"
+        f" join({joined.name}) + hard-kill({kill_victim.name}) + supervised rebuild —"
+        f" root bitwise-equal to the flat oracle; top-{len(reported)} envelopes, distinct"
+        f" ({est:.0f} vs exact {exact_uniques}), and co-occurrence cell bounds all hold"
+        " against the exact references",
+        flush=True,
+    )
+    print("sketch smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+"""Serve-tier contracts for the new sketch families.
+
+Heavy hitters, distinct counts, and co-occurrence ride the whole serving
+platform generically; this file pins the seams that carry sharp edges:
+
+* aggregator round trip: multi-client ingest + at-least-once re-ship
+  dedup leaves the root state bitwise-equal to the flat oracle merge;
+* wire evolution: a FUTURE-minor payload with unknown keys decodes; a
+  changed capacity / precision / label-space is a different schema,
+  refused loudly with ``schema_diff`` naming the exact config path;
+* history: sum-family sketch leaves subtract exactly and compose
+  (``delta(a,b) ⊕ delta(b,c) == delta(a,c)`` bitwise); HLL max-registers
+  REFUSE interval deltas (``DeltaUndefinedError`` → the endpoints' 400 +
+  ``mode_hint`` arm) while cumulative reads stay exact — the
+  ``_delta_envelope_leaves`` registry satellite.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu.obs as obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.serve import Aggregator
+from metrics_tpu.serve.history import (
+    DeltaUndefinedError,
+    HistoryConfig,
+    delta_leaves,
+    merge_delta_leaves,
+)
+from metrics_tpu.serve.wire import (
+    SchemaMismatchError,
+    apply_payload,
+    decode_state,
+    encode_state,
+    schema_diff,
+    schema_of,
+)
+from metrics_tpu.streaming import (
+    StreamingConfusion,
+    StreamingDistinctCount,
+    StreamingTopK,
+)
+
+TENANT = "sketchy"
+N_CLIENTS = 4
+SAMPLES = 64
+
+
+def factory() -> MetricCollection:
+    return MetricCollection(
+        {
+            "topk": StreamingTopK(k=5, capacity=64, id_bits=16),
+            "uniq": StreamingDistinctCount(precision=8),
+            "conf": StreamingConfusion(num_rows=200, k=4, capacity=64),
+        }
+    )
+
+
+def sum_factory() -> MetricCollection:
+    """Sum-family sketches only (no HLL): the delta-friendly subset."""
+    return MetricCollection(
+        {
+            "topk": StreamingTopK(k=5, capacity=64, id_bits=16),
+            "conf": StreamingConfusion(num_rows=200, k=4, capacity=64),
+        }
+    )
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    was = obs.enabled()
+    obs.enable(False)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.enable(was)
+
+
+def _client_coll(client: int, intervals: int, fac=factory) -> MetricCollection:
+    """The client's CUMULATIVE state through `intervals` intervals."""
+    coll = fac()
+    rng = np.random.default_rng(1000 * client + 3)
+    for _ in range(intervals + 1):
+        ids = jnp.asarray((rng.zipf(1.5, SAMPLES) % 500).astype(np.int32))
+        coll["topk"].update(ids)
+        if "uniq" in dict(coll.items()):
+            coll["uniq"].update(ids)
+        coll["conf"].update(ids % 200, (ids * 7) % 200)
+    return coll
+
+
+def feed(agg, interval: int, fac=factory) -> None:
+    for c in range(N_CLIENTS):
+        coll = _client_coll(c, interval, fac)
+        blob = encode_state(coll, tenant=TENANT, client_id=f"c{c}", watermark=(0, interval))
+        agg.ingest(blob)
+        if c == 0:  # at-least-once: a duplicate re-ship must dedup away
+            agg.ingest(blob)
+    agg.flush()
+
+
+def _leaves_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b))
+    )
+
+
+class TestAggregatorRoundTrip:
+    def test_root_state_bitwise_vs_flat_oracle(self):
+        agg = Aggregator("sketch-root")
+        agg.register_tenant(TENANT, factory)
+        feed(agg, 0)
+        # flat oracle: merge every client's sketch states directly (once
+        # each — the duplicate re-ship must have deduped away)
+        oracle = factory()
+        for c in range(N_CLIENTS):
+            coll = _client_coll(c, 0)
+            for name in ("topk", "uniq", "conf"):
+                oracle[name].sketch = oracle[name].sketch.merge(coll[name].sketch)
+        view = agg.collection(TENANT)
+        for name in ("topk", "uniq", "conf"):
+            assert _leaves_equal(view[name].sketch, oracle[name].sketch), name
+        out = agg.query(TENANT)
+        assert out["clients"] == N_CLIENTS
+        vals = out["values"]
+        ids, counts = oracle["topk"].compute()
+        got_ids, got_counts = vals["topk"]["value"]
+        assert np.array_equal(np.asarray(got_ids, dtype=np.int64), np.asarray(ids))
+        assert np.array_equal(np.asarray(got_counts, dtype=np.float32), np.asarray(counts))
+        assert vals["uniq"]["value"] == float(oracle["uniq"].compute())
+        for got, want in zip(vals["conf"]["value"], oracle["conf"].compute()):
+            assert np.array_equal(np.asarray(got, dtype=np.float64), np.asarray(want, dtype=np.float64))
+        # streaming members surface their rigorous envelopes on the wire
+        assert np.asarray(vals["topk"]["error_bound"]).min() >= 0.0
+        assert vals["uniq"]["bounds"][0] <= vals["uniq"]["value"] <= vals["uniq"]["bounds"][1]
+
+
+class TestWireEvolution:
+    def test_future_minor_unknown_keys_decode(self):
+        coll = _client_coll(0, 0)
+        blob = encode_state(coll, tenant=TENANT, client_id="c0", watermark=(0, 0))
+        # splice in a bumped minor + unknown header/meta keys, the shape a
+        # future encoder would emit (same helper contract test_wire pins)
+        import json
+        import struct
+
+        pre = struct.Struct("<4sHHI")
+        magic, maj, minor, hlen = pre.unpack_from(blob)
+        header = json.loads(blob[pre.size : pre.size + hlen].decode())
+        header["sketch_hint"] = {"experimental": True}
+        header.setdefault("meta", {})["fleet_zone"] = "z9"
+        raw = json.dumps(header, sort_keys=True).encode()
+        future = pre.pack(magic, maj, minor + 3, len(raw)) + raw + blob[pre.size + hlen :]
+
+        payload = decode_state(future)
+        assert payload.meta["fleet_zone"] == "z9"
+        clone = factory()
+        apply_payload(clone, payload)
+        for name in ("topk", "uniq", "conf"):
+            assert _leaves_equal(coll[name].sketch, clone[name].sketch), name
+
+    @pytest.mark.parametrize(
+        "other, path_frag",
+        [
+            (
+                lambda: MetricCollection(
+                    {
+                        "topk": StreamingTopK(k=5, capacity=128, id_bits=16),
+                        "uniq": StreamingDistinctCount(precision=8),
+                        "conf": StreamingConfusion(num_rows=200, k=4, capacity=64),
+                    }
+                ),
+                "topk.states.sketch.config.capacity",
+            ),
+            (
+                lambda: MetricCollection(
+                    {
+                        "topk": StreamingTopK(k=5, capacity=64, id_bits=16),
+                        "uniq": StreamingDistinctCount(precision=10),
+                        "conf": StreamingConfusion(num_rows=200, k=4, capacity=64),
+                    }
+                ),
+                "uniq.states.sketch.config.precision",
+            ),
+            (
+                lambda: MetricCollection(
+                    {
+                        "topk": StreamingTopK(k=5, capacity=64, id_bits=16),
+                        "uniq": StreamingDistinctCount(precision=8),
+                        "conf": StreamingConfusion(num_rows=500, k=4, capacity=64),
+                    }
+                ),
+                "conf.states.sketch.config.num_rows",
+            ),
+        ],
+    )
+    def test_config_change_rejected_naming_path(self, other, path_frag):
+        """A bucket/register/label-space change is a DIFFERENT schema:
+        refused loudly, with schema_diff naming the exact config path —
+        never merged silently into incompatible tables."""
+        diffs = schema_diff(schema_of(factory()), schema_of(other()))
+        assert any(path_frag in d for d in diffs), diffs
+
+        blob = encode_state(other(), tenant=TENANT, client_id="c0", watermark=(0, 0))
+        agg = Aggregator("schema-guard")
+        agg.register_tenant(TENANT, factory)
+        with pytest.raises(SchemaMismatchError):
+            agg.ingest(blob)
+            agg.flush()
+
+
+class TestHistoryDeltas:
+    def _history(self, fac, n_intervals=4):
+        agg = Aggregator(
+            "sketch-hist", history=HistoryConfig(cut_every_s=float("inf"))
+        )
+        agg.register_tenant(TENANT, fac)
+        for interval in range(n_intervals):
+            feed(agg, interval, fac)
+            agg.history.cut(agg, now=float(interval))
+        tenant = agg._tenant(TENANT)
+        th = agg.history._tenants[TENANT]
+        return agg, tenant.spec, [s.leaves for _, s in th.retained()]
+
+    def test_sum_family_delta_composes_bitwise(self):
+        """delta(a,b) ⊕ delta(b,c) == delta(a,c) bitwise for the
+        heavy-hitter and co-occurrence leaf families (all exact sums)."""
+        _agg, spec, cum = self._history(sum_factory)
+        a, b, c = cum[0], cum[2], cum[3]
+        direct = delta_leaves(spec, c, a)
+        composed = merge_delta_leaves(spec, delta_leaves(spec, b, a), delta_leaves(spec, c, b))
+        for (path, red), lhs, rhs in zip(spec, direct, composed):
+            assert np.array_equal(lhs, rhs), (path, red)
+        # and the deltas really are subtractions of cumulative snapshots
+        for (path, red), older, newer, leaf in zip(spec, a, c, direct):
+            assert red == "sum", path  # no extreme leaves in this family
+            assert np.array_equal(leaf, np.subtract(newer, older)), path
+
+    def test_hll_registers_refuse_delta_cumulative_exact(self):
+        """The HLL max-register leaf is NOT invertible: delta queries
+        refuse with the typed error (the endpoints' HTTP 400 +
+        mode_hint arm), while cumulative reads stay exact."""
+        agg, spec, cum = self._history(factory)
+        with pytest.raises(DeltaUndefinedError, match="not invertible"):
+            delta_leaves(spec, cum[1], cum[0])
+        with pytest.raises(DeltaUndefinedError):
+            agg.history_query(TENANT, 0.0, 3.0, mode="delta")
+        out = agg.history_query(TENANT, 0.0, 3.0, mode="cumulative")
+        assert out["points"][-1]["values"]["uniq"]["value"] is not None
+
+    def test_delta_mode_works_without_hll_member(self):
+        """The refusal is leaf-scoped, not collection-scoped: the same
+        query shape answers in delta mode when no HLL member is present."""
+        agg, _spec, _cum = self._history(sum_factory)
+        out = agg.history_query(TENANT, 0.0, 3.0, step=1.0, mode="delta")
+        assert len(out["intervals"]) == 3
+        assert all(iv["values"] is not None for iv in out["intervals"][1:])
