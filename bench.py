@@ -830,6 +830,115 @@ def bench_history(n_clients: int = 64, n_intervals: int = 48) -> dict:
     }
 
 
+def bench_llm_experiment(n_queries: int = 10_000, docs: int = 100) -> dict:
+    """LLM-eval + experimentation tier: the three hot paths the new
+    tenants add.
+
+    - ``llm_perplexity_1M_update`` — fold 1M masked per-token log-probs
+      into :class:`~metrics_tpu.llm.StreamingPerplexity`'s sum states
+      (two masked reductions; the whole-eval-stream ingest cost).
+    - ``rag_ndcg_k10_1M_docs_compute`` — score 10k queries x 100 docs at
+      k=10 through :class:`~metrics_tpu.llm.StreamingRAGQuality`'s dense
+      segment-local ``lax.top_k`` path (hit-rate + MRR + NDCG in one
+      launch over the 1M-document batch).
+    - ``experiment_decision_p99_ms`` — p99 wall time of one
+      :meth:`~metrics_tpu.experiment.DecisionEngine.evaluate` against
+      retained history snapshots (arm fold + stats extraction + mSPRT
+      step): the per-cut tax every armed experiment adds to the root's
+      cut path. The ``experiment_smoke`` CI step pins the same tier's
+      decisions bitwise; these rows only time it.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from benchmarks._timing import measure_ms_scaled
+    from metrics_tpu.llm import StreamingPerplexity, StreamingRAGQuality
+
+    out: dict = {}
+    n = n_queries * docs
+
+    lp = jax.random.uniform(jax.random.PRNGKey(0), (n,), minval=-6.0, maxval=0.0)
+    mask = (jax.random.uniform(jax.random.PRNGKey(1), (n,)) > 0.1).astype(jnp.float32)
+    ppl = StreamingPerplexity()
+
+    def make_ppl(k, lp=lp, mask=mask):
+        @jax.jit
+        def run(lp=lp, mask=mask):
+            def body(i, carry):
+                s, c = carry
+                lpi = lp + 0.0001 * i
+                return (s + (lpi * mask).sum(), c + mask.sum())
+
+            s, c = jax.lax.fori_loop(0, k, body, (jnp.zeros(()), jnp.zeros(())))
+            return s + c
+
+        return run
+
+    out["llm_perplexity_1M_update"] = measure_ms_scaled(make_ppl, K_REPEATS)
+
+    preds = jax.random.uniform(jax.random.PRNGKey(2), (n,))
+    target = (jax.random.uniform(jax.random.PRNGKey(3), (n,)) > 0.9).astype(jnp.int32)
+    rag = StreamingRAGQuality(k=10)
+
+    def make_rag(k, preds=preds, target=target):
+        @jax.jit
+        def run(preds=preds, target=target):
+            def body(i, acc):
+                hit, rr, ndcg = rag._dense_scores(
+                    preds * (1.0 + 0.0001 * i), target, (n_queries, docs)
+                )
+                return acc + hit.sum() + rr.sum() + ndcg.sum()
+
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(()))
+
+        return run
+
+    out["rag_ndcg_k10_1M_docs_compute"] = measure_ms_scaled(make_rag, 40)
+
+    # the decision row is host-side: a real history-armed root with one
+    # retained cut per arm, timed through the engine's evaluate() path
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.experiment import ArmSpec, DecisionEngine, Experiment, SequentialTest
+    from metrics_tpu.serve import Aggregator, HistoryConfig
+    from metrics_tpu.serve.wire import encode_state
+    from metrics_tpu.streaming import StreamingQuantile
+
+    def factory():
+        return MetricCollection({"lat": StreamingQuantile(num_bins=128, lo=0.0, hi=1.0)})
+
+    agg = Aggregator("bench-exp", history=HistoryConfig(cut_every_s=float("inf")))
+    exp = Experiment(
+        "bench",
+        arms=[ArmSpec("control", factory), ArmSpec("treatment", factory)],
+        metric="lat",
+        # a null feed + huge min_samples keeps the verdict "continue", so
+        # every timed evaluate() runs the FULL stats + mSPRT path (sticky
+        # decided experiments short-circuit and would time a dict copy)
+        test=SequentialTest(alpha=0.05, tau=0.1, min_samples=1 << 40, family="mean"),
+    )
+    exp.register(agg)
+    engine = DecisionEngine(agg, [exp])
+    rng = np.random.default_rng(17)
+    for tid in exp.tenant_ids():
+        for c in range(64):
+            coll = factory()
+            coll["lat"].update(jnp.asarray(rng.uniform(0, 1, 256).astype(np.float32)))
+            agg.ingest(encode_state(coll, tenant=tid, client_id=f"c{c:03d}", watermark=(0, 0)))
+    agg.flush()
+    agg.history.cut(agg, now=0.0)
+    engine.evaluate("bench")  # warm the fold caches untimed
+    eval_ms = []
+    for _ in range(200):
+        t0 = _time.perf_counter()
+        engine.evaluate("bench")
+        eval_ms.append((_time.perf_counter() - t0) * 1000.0)
+    out["experiment_decision_p99_ms"] = float(np.percentile(eval_ms, 99))
+    return out
+
+
 def bench_aot() -> dict:
     """Cold-vs-warm first fold: the execution-engine acceptance rows.
 
@@ -1644,6 +1753,26 @@ def main(
         )
     except Exception as err:  # noqa: BLE001 — mesh rows must not kill the sweep
         print(f"SKIPPED mesh rows: {err}", file=sys.stderr)
+
+    # LLM-eval + experimentation tier (round 19): the eval-stream ingest
+    # and RAG scoring kernels plus the host-side per-cut decision tax —
+    # the experiment_smoke CI step pins the tier's decisions bitwise,
+    # these rows only time it (TPU sweep supplies acceptance values)
+    try:
+        llm_rows = section(bench_llm_experiment)
+        for row_name in (
+            "llm_perplexity_1M_update",
+            "rag_ndcg_k10_1M_docs_compute",
+            "experiment_decision_p99_ms",
+        ):
+            emit(
+                row_name,
+                llm_rows[row_name],
+                prior.get(row_name, llm_rows[row_name]),
+                baseline="best_prior_self",
+            )
+    except Exception as err:  # noqa: BLE001 — llm rows must not kill the sweep
+        print(f"SKIPPED llm/experiment rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

@@ -113,7 +113,9 @@ from metrics_tpu.text import (  # noqa: E402, F401
     WordInfoPreserved,
 )
 from metrics_tpu import engine  # noqa: E402, F401
+from metrics_tpu import experiment  # noqa: E402, F401
 from metrics_tpu import ft  # noqa: E402, F401
+from metrics_tpu import llm  # noqa: E402, F401
 from metrics_tpu import obs  # noqa: E402, F401
 from metrics_tpu import serve  # noqa: E402, F401
 from metrics_tpu import streaming  # noqa: E402, F401
@@ -204,7 +206,9 @@ __all__ = [
     "register_state_reduction",
     "debug_checks",
     "engine",
+    "experiment",
     "ft",
+    "llm",
     "obs",
     "serve",
     "streaming",

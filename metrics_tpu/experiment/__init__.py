@@ -1,0 +1,42 @@
+"""Online experimentation: per-arm tenants + always-valid sequential decisions.
+
+The second new serving workload of ROADMAP open item 2: live A/B
+experimentation over the metrics the platform already aggregates. The
+design splits cleanly along the platform's existing seams:
+
+* :class:`Experiment` / :class:`ArmSpec` — each arm is an ordinary
+  aggregator TENANT (``"<exp_id>/<arm>"``), so arm evidence inherits the
+  wire schema, dedup, elastic-tree aggregation, chaos tolerance, history
+  retention, checkpoints and generation fencing without one new code
+  path on the hot ingest/fold loop.
+* :class:`SequentialTest` — an mSPRT-style always-valid p-value and
+  confidence sequence (Johari et al.; Howard et al.), computed from
+  sketch bin masses with the sketch's rigorous error envelope FOLDED
+  INTO the decision boundary: a sketch can never fabricate significance
+  that exact samples would not support, only delay it.
+* :class:`DecisionEngine` — evaluated at the root on every history cut,
+  edge-triggered ship/stop/continue through the one-shot-warn + obs
+  counter machinery, durable in ft checkpoints, generation-fenced across
+  failover, and served on ``GET /experiment/<id>``.
+
+See ``docs/serving.md`` (experimentation section) for the worked flow.
+"""
+from metrics_tpu.experiment.experiment import ArmSpec, DecisionEngine, Experiment
+from metrics_tpu.experiment.sequential import (
+    ArmStats,
+    SequentialTest,
+    arm_stats_from_samples,
+    arm_stats_from_sketch,
+    mixture_lr,
+)
+
+__all__ = [
+    "ArmSpec",
+    "ArmStats",
+    "DecisionEngine",
+    "Experiment",
+    "SequentialTest",
+    "arm_stats_from_samples",
+    "arm_stats_from_sketch",
+    "mixture_lr",
+]

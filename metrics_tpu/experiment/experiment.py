@@ -1,0 +1,346 @@
+"""Online experimentation at the serving root: arms, tenants, decisions.
+
+An :class:`Experiment` maps each arm of an A/B test onto its own
+aggregator TENANT — arms inherit the platform's entire serving contract
+for free (wire schema + dedup, elastic tree, chaos tolerance, history
+rings, checkpoints, generation fencing) because they ARE ordinary
+tenants. The :class:`DecisionEngine` then rides the history tier's cut
+hook: on every interval cut it extracts per-arm evidence from the
+just-retained cumulative snapshots (via the same capture-and-restore
+state probing the alert rules use), folds it through the experiment's
+:class:`~metrics_tpu.experiment.SequentialTest`, and fires SHIP / STOP
+verdicts edge-triggered through the one-shot-warn + obs counter
+machinery (``experiment.decisions{exp=,verdict=}``).
+
+Durability and failover ride the existing seams: the engine's decision
+state (always-valid p-value, verdict, evidence) serializes into the
+aggregator's checkpoint manifest beside the history rings — a SIGKILLed
+root resumes with bitwise-identical decisions — and evaluation is
+GENERATION-FENCED: a cut whose arm snapshots straddle a failover
+boundary is skipped (counted under ``experiment.fenced_evaluations``)
+rather than compared across two histories, exactly the history tier's
+delta-fencing stance.
+"""
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from metrics_tpu.experiment.sequential import ArmStats, SequentialTest, arm_stats_from_sketch
+from metrics_tpu.obs.registry import enabled as _obs_enabled
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.obs.registry import set_gauge as _obs_gauge
+from metrics_tpu.serve.aggregator import ServeError
+
+__all__ = ["ArmSpec", "DecisionEngine", "Experiment"]
+
+
+class ArmSpec:
+    """One experiment arm: a name and the metric-collection factory its
+    tenant registers with (every arm of an experiment must use the SAME
+    schema — the sequential test compares like evidence)."""
+
+    def __init__(self, name: str, factory: Callable[[], Any]) -> None:
+        if not str(name):
+            raise ValueError("arm name must be non-empty")
+        if not callable(factory):
+            raise ValueError(f"arm {name!r}: factory must be a zero-arg callable")
+        self.name = str(name)
+        self.factory = factory
+
+
+class Experiment:
+    """A two-arm online experiment over per-arm aggregator tenants.
+
+    Args:
+        exp_id: experiment identity (tenant ids are
+            ``"<exp_id>/<arm name>"``; the ``exp=`` obs label).
+        arms: exactly two :class:`ArmSpec` — ``arms[0]`` is the CONTROL,
+            ``arms[1]`` the treatment.
+        metric: member name inside each arm's collection supplying the
+            evidence. The member must expose a mergeable sketch state:
+            a :class:`~metrics_tpu.streaming.sketches.QuantileSketch`
+            (``family="mean"``) or
+            :class:`~metrics_tpu.streaming.sketches.ScoreLabelSketch`
+            (``family="rate"``) — :class:`StreamingRAGQuality`'s NDCG
+            sketch, :class:`StreamingQuantile`, :class:`StreamingAUROC`
+            all qualify.
+        test: the :class:`~metrics_tpu.experiment.SequentialTest`
+            (defaults to one at ``alpha=0.05``; its ``family`` selects
+            the evidence extraction).
+        higher_is_better: direction of goodness for the watched value
+            (``None``: read the member metric's own ``higher_is_better``
+            at evaluation time, defaulting True). A ``False`` direction
+            negates the effect, so "ship" always means "treatment is
+            significantly BETTER".
+    """
+
+    def __init__(
+        self,
+        exp_id: str,
+        arms: Sequence[ArmSpec],
+        metric: str,
+        test: Optional[SequentialTest] = None,
+        higher_is_better: Optional[bool] = None,
+    ) -> None:
+        if not str(exp_id):
+            raise ValueError("exp_id must be non-empty")
+        arms = list(arms)
+        if len(arms) != 2:
+            raise ValueError(f"experiment {exp_id!r} needs exactly 2 arms, got {len(arms)}")
+        if arms[0].name == arms[1].name:
+            raise ValueError(f"experiment {exp_id!r}: arm names must differ")
+        self.exp_id = str(exp_id)
+        self.arms = arms
+        self.metric = str(metric)
+        self.test = test if test is not None else SequentialTest()
+        self.higher_is_better = higher_is_better
+
+    @property
+    def control(self) -> ArmSpec:
+        return self.arms[0]
+
+    @property
+    def treatment(self) -> ArmSpec:
+        return self.arms[1]
+
+    def tenant_id(self, arm: ArmSpec) -> str:
+        return f"{self.exp_id}/{arm.name}"
+
+    def tenant_ids(self) -> List[str]:
+        return [self.tenant_id(arm) for arm in self.arms]
+
+    def register(self, aggregator: Any) -> List[str]:
+        """Register one tenant per arm on ``aggregator``; returns the
+        tenant ids. Idempotent-unfriendly by design — the aggregator
+        refuses duplicate registration loudly, like any tenant."""
+        for arm in self.arms:
+            aggregator.register_tenant(self.tenant_id(arm), arm.factory)
+        return self.tenant_ids()
+
+
+def _fresh_record(exp: Experiment) -> Dict[str, Any]:
+    return {
+        "experiment": exp.exp_id,
+        "verdict": "continue",
+        "p_value": 1.0,
+        "evaluations": 0,
+        "fenced": 0,
+        "evidence": None,
+        "decision": None,
+        "generation": None,
+    }
+
+
+class DecisionEngine:
+    """Root-side experiment evaluator riding the history cut hook.
+
+    Construct AFTER the aggregator (which must be armed with
+    ``history=``) and after each experiment's :meth:`Experiment.register`;
+    re-attach (same experiments) before :meth:`Aggregator.restore` so the
+    saved decision state has somewhere to land. Evaluation order is
+    deterministic (sorted experiment id), decisions are STICKY (a fired
+    ship/stop is never re-litigated — re-run the experiment under a new
+    id instead), and the whole evaluation is a pure function of durable
+    state: retained history snapshots + the persisted always-valid
+    p-value. That purity is what the kill-resume bitwise pin in
+    ``tests/integrations/experiment_smoke.py`` checks.
+    """
+
+    def __init__(self, aggregator: Any, experiments: Sequence[Experiment] = ()) -> None:
+        if aggregator.history is None:
+            raise ServeError(
+                f"aggregator {aggregator.name!r} has no history armed; the decision"
+                " engine evaluates on interval cuts — construct the aggregator with"
+                " history=HistoryConfig(...)"
+            )
+        self._aggregator = aggregator
+        self._history = aggregator.history
+        self._experiments: Dict[str, Experiment] = {}
+        self._state: Dict[str, Dict[str, Any]] = {}
+        self._warned: set = set()
+        for exp in experiments:
+            self.add(exp)
+        self._history.add_cut_hook(self._on_cut)
+        # the aggregator exposes the engine (endpoints, checkpoint seam)
+        aggregator._experiment_engine = self
+
+    # -- registry --------------------------------------------------------
+
+    def add(self, experiment: Experiment) -> None:
+        if experiment.exp_id in self._experiments:
+            raise ServeError(f"experiment {experiment.exp_id!r} is already attached")
+        self._experiments[experiment.exp_id] = experiment
+        self._state[experiment.exp_id] = _fresh_record(experiment)
+        if _obs_enabled():
+            _obs_gauge("experiment.active", 1.0, exp=experiment.exp_id)
+
+    def experiment_ids(self) -> List[str]:
+        return sorted(self._experiments)
+
+    # -- evaluation ------------------------------------------------------
+
+    def _on_cut(self, history: Any, aggregator: Any) -> None:
+        for exp_id in self.experiment_ids():
+            try:
+                self.evaluate(exp_id)
+            except Exception as err:  # noqa: BLE001 — a decision bug must not kill cuts
+                if exp_id not in self._warned:
+                    self._warned.add(exp_id)
+                    warnings.warn(
+                        f"experiment {exp_id!r} evaluation failed:"
+                        f" {type(err).__name__}: {err}",
+                        stacklevel=2,
+                    )
+
+    def _arm_snapshot(self, tenant_id: str) -> Optional[Any]:
+        th = self._history._tenants.get(tenant_id)
+        return None if th is None else th.newest()
+
+    def _extract_stats(self, exp: Experiment, tenant_id: str, snap: Any) -> Optional[ArmStats]:
+        tenant = self._aggregator._tenant(tenant_id)
+
+        def probe(view: Any) -> Optional[ArmStats]:
+            member = dict(view.items()).get(exp.metric)
+            if member is None:
+                raise ServeError(
+                    f"experiment {exp.exp_id!r}: metric {exp.metric!r} is not a"
+                    f" member of tenant {tenant_id!r}'s collection"
+                )
+            sketch = self._evidence_sketch(member)
+            if sketch is None:
+                raise ServeError(
+                    f"experiment {exp.exp_id!r}: metric {exp.metric!r} exposes no"
+                    " QuantileSketch/ScoreLabelSketch state — sequential evidence"
+                    " needs a mergeable sketch (or rate) family"
+                )
+            stats = arm_stats_from_sketch(sketch, exp.test.family)
+            flip = exp.higher_is_better
+            if flip is None:
+                flip = getattr(member, "higher_is_better", True)
+                flip = True if flip is None else bool(flip)
+            if not flip:
+                stats = ArmStats(stats.n, -stats.mean, stats.var, stats.halfwidth)
+            return stats
+
+        return self._history._with_loaded(tenant, snap.leaves, snap.consensus, probe)
+
+    @staticmethod
+    def _evidence_sketch(member: Any) -> Optional[Any]:
+        from metrics_tpu.streaming.sketches import QuantileSketch, ScoreLabelSketch
+
+        for attr in ("sketch", "ndcg_sketch"):
+            candidate = getattr(member, attr, None)
+            if isinstance(candidate, (QuantileSketch, ScoreLabelSketch)):
+                return candidate
+        return None
+
+    def evaluate(self, exp_id: str) -> Dict[str, Any]:
+        """Evaluate one experiment against the newest retained arm
+        snapshots; returns (a copy of) the durable record. Pure in the
+        durable state: same snapshots + same persisted p-value produce
+        the same record, which is the checkpoint-reproducibility pin."""
+        exp = self._experiments[exp_id]
+        rec = self._state[exp_id]
+        if rec["verdict"] != "continue":
+            return dict(rec)  # sticky: decided experiments are frozen
+        snap_c = self._arm_snapshot(exp.tenant_id(exp.control))
+        snap_t = self._arm_snapshot(exp.tenant_id(exp.treatment))
+        if snap_c is None or snap_t is None:
+            return dict(rec)  # nothing retained yet for one arm
+        if snap_c.generation != snap_t.generation or snap_c.generation != self._history.generation:
+            # the arms' snapshots straddle a failover boundary: comparing
+            # them would difference two histories — skip, loudly counted
+            rec["fenced"] += 1
+            if _obs_enabled():
+                _obs_inc("experiment.fenced_evaluations", exp=exp_id)
+            return dict(rec)
+        stats_c = self._extract_stats(exp, exp.tenant_id(exp.control), snap_c)
+        stats_t = self._extract_stats(exp, exp.tenant_id(exp.treatment), snap_t)
+        result = exp.test.step(stats_c, stats_t, prev_p=rec["p_value"])
+        rec["evaluations"] += 1
+        rec["p_value"] = result["p_value"]
+        rec["generation"] = snap_c.generation
+        rec["evidence"] = dict(
+            result,
+            control={"tenant": exp.tenant_id(exp.control), "snapshot": snap_c.meta()},
+            treatment={"tenant": exp.tenant_id(exp.treatment), "snapshot": snap_t.meta()},
+        )
+        if _obs_enabled():
+            _obs_inc("experiment.evaluations", exp=exp_id)
+        if result["verdict"] != "continue":
+            rec["verdict"] = result["verdict"]
+            rec["decision"] = {
+                "verdict": result["verdict"],
+                "p_value": result["p_value"],
+                "diff": result["diff"],
+                "ci": list(result["ci"]),
+                "generation": snap_c.generation,
+                "cut": {"control": snap_c.index, "treatment": snap_t.index},
+                "evaluations": rec["evaluations"],
+            }
+            if _obs_enabled():
+                _obs_inc("experiment.decisions", exp=exp_id, verdict=result["verdict"])
+                _obs_gauge("experiment.active", 0.0, exp=exp_id)
+            key = ("decision", exp_id)
+            if key not in self._warned:
+                self._warned.add(key)
+                from metrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"experiment {exp_id!r} DECIDED: {result['verdict'].upper()}"
+                    f" (always-valid p={result['p_value']:.6f} <="
+                    f" alpha={exp.test.alpha:g}, diff={result['diff']:+.6g},"
+                    f" ci=[{result['ci'][0]:.6g}, {result['ci'][1]:.6g}])"
+                    " — edge-triggered: counted once under"
+                    " experiment.decisions and frozen until re-run under a"
+                    " new experiment id"
+                )
+        return dict(rec)
+
+    # -- reporting (GET /experiment/<id>) --------------------------------
+
+    def report(self, exp_id: str) -> Dict[str, Any]:
+        """The JSON answer for ``GET /experiment/<id>``."""
+        exp = self._experiments.get(exp_id)
+        if exp is None:
+            raise KeyError(exp_id)
+        if _obs_enabled():
+            _obs_inc("experiment.queries", exp=exp_id)
+        rec = self._state[exp_id]
+        return {
+            "experiment": exp.exp_id,
+            "metric": exp.metric,
+            "arms": {
+                "control": exp.tenant_id(exp.control),
+                "treatment": exp.tenant_id(exp.treatment),
+            },
+            "test": exp.test.config(),
+            **{k: rec[k] for k in (
+                "verdict", "p_value", "evaluations", "fenced", "evidence", "decision",
+                "generation",
+            )},
+        }
+
+    # -- durability (rides Aggregator.save/restore) ----------------------
+
+    def state_for_checkpoint(self) -> Dict[str, Any]:
+        """JSON-safe decision state for the checkpoint manifest (tiny:
+        one record per experiment — no array tree needed)."""
+        return {exp_id: dict(self._state[exp_id]) for exp_id in self.experiment_ids()}
+
+    def load_checkpoint_state(self, meta: Dict[str, Any]) -> None:
+        """Adopt the saved decision records wholesale (bitwise: the
+        records are plain JSON and replace the fresh ones). Experiments
+        the checkpoint does not name keep their fresh record; saved
+        records for unattached experiments are ignored (the aggregator's
+        re-register-before-restore stance)."""
+        for exp_id, saved in (meta or {}).items():
+            if exp_id not in self._experiments:
+                continue
+            self._state[exp_id] = dict(saved)
+            if _obs_enabled():
+                active = 1.0 if saved.get("verdict") == "continue" else 0.0
+                _obs_gauge("experiment.active", active, exp=exp_id)
+            if saved.get("verdict") != "continue":
+                # the decision already warned on the node that made it;
+                # a restored root must not re-announce (or re-count) it
+                self._warned.add(("decision", exp_id))

@@ -1,0 +1,33 @@
+"""LLM-evaluation tenants: streaming perplexity, QA overlap, RAG quality.
+
+The serving platform's north-star workload (ROADMAP open item 2) is
+millions of inference workers emitting eval traffic. Every metric here is
+therefore built on the platform's two aggregation primitives:
+
+* **exact sum monoids** — token-level perplexity and SQuAD-convention
+  token-F1/exact-match decompose into a handful of scalar sums, so
+  thousands of workers aggregate BITWISE through the elastic serve tree
+  (fold order can never change state), and
+* **mergeable sketches** — :class:`StreamingRAGQuality` carries a
+  :class:`~metrics_tpu.streaming.sketches.QuantileSketch` of per-query
+  NDCG beside its exact means, so a 1M–1B-document eval's score
+  *distribution* survives the tree with a documented error envelope.
+
+All classes are ordinary :class:`~metrics_tpu.metric.Metric` subclasses:
+they ride ``MetricCollection``, ``make_step``/``make_stream_step`` (pure
+fixed-shape states), the wire schema + dedup, epoch fusion, mesh
+``sharded_state=True`` where sketch-backed, history rings, and
+kill-resume bitwise — the same contracts the classification tenants pin.
+See ``docs/llm_eval.md`` for the monoid/envelope arguments and a worked
+RAG example.
+"""
+from metrics_tpu.llm.perplexity import StreamingPerplexity
+from metrics_tpu.llm.qa import StreamingExactMatch, StreamingTokenF1
+from metrics_tpu.llm.rag import StreamingRAGQuality
+
+__all__ = [
+    "StreamingExactMatch",
+    "StreamingPerplexity",
+    "StreamingRAGQuality",
+    "StreamingTokenF1",
+]

@@ -1,0 +1,137 @@
+"""Streaming perplexity over an unbounded token stream.
+
+Perplexity is a pure function of two scalar sums — total log-probability
+and total token count — so the metric state is an EXACT commutative
+monoid: merges are float additions of integer-weighted partial sums, and
+the serve tree / mesh / scan fold order can never change the result
+beyond float addition order (the platform ships per-client states through
+the pow-2 stacked fold, so the reduction order is itself deterministic —
+the fleet bitwise oracle in ``tests/integrations/experiment_smoke.py``
+pins root state == flat offline merge).
+"""
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs.registry import inc as _obs_inc
+
+Array = jax.Array
+
+__all__ = ["StreamingPerplexity"]
+
+_LN2 = math.log(2.0)
+
+
+class StreamingPerplexity(Metric):
+    """Corpus perplexity from summed token log-probabilities, O(1) state.
+
+    ``update`` takes per-token **natural-log** probabilities (the shape is
+    free — ``(N,)``, ``(B, T)``, anything; an optional ``mask`` of the
+    same shape excludes padding) and folds them into three scalar sums:
+    ``log_prob_sum``, ``token_count`` and optionally ``byte_count`` for
+    the tokenizer-independent bits-per-byte variant. The update is pure
+    ``jnp`` arithmetic on fixed-shape state, so the metric is a valid
+    ``jit``/``scan``/``vmap`` carry and rides
+    :func:`~metrics_tpu.steps.make_stream_step` unchanged.
+
+    ``compute`` returns ``exp(-log_prob_sum / token_count)``;
+    :meth:`bits_per_byte` returns ``-log_prob_sum / (ln 2 * byte_count)``
+    (report ``num_bytes`` in ``update`` to enable it). Both are EXACT
+    functions of the stream — :meth:`error_bound` is identically zero,
+    which is what lets an experiment's sequential test treat perplexity
+    evidence at face value (zero envelope half-width).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.llm import StreamingPerplexity
+        >>> m = StreamingPerplexity()
+        >>> m.update(jnp.log(jnp.asarray([0.5, 0.25, 0.5, 0.25])))
+        >>> float(jnp.round(m.compute(), 4))  # geometric mean prob ~ 0.3536
+        2.8284
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("log_prob_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("token_count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("byte_count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(
+        self,
+        log_probs: Array,
+        mask: Optional[Array] = None,
+        num_bytes: Optional[Array] = None,
+    ) -> None:
+        """Fold a batch of per-token natural-log probabilities.
+
+        Args:
+            log_probs: per-token ``log p(token)`` values, any shape.
+            mask: optional same-shape mask; tokens with a zero/False mask
+                contribute nothing (padding convention).
+            num_bytes: optional total byte count of the decoded text this
+                batch scored (scalar or array; summed) — enables
+                :meth:`bits_per_byte`.
+        """
+        lp = jnp.ravel(jnp.asarray(log_probs)).astype(jnp.float32)
+        if mask is None:
+            m = jnp.ones_like(lp)
+        else:
+            m = jnp.ravel(jnp.asarray(mask)).astype(jnp.float32)
+        self.log_prob_sum = self.log_prob_sum + (lp * m).sum()
+        self.token_count = self.token_count + m.sum()
+        if num_bytes is not None:
+            self.byte_count = self.byte_count + jnp.sum(jnp.asarray(num_bytes)).astype(jnp.float32)
+
+    def compute(self) -> Array:
+        """``exp(-log_prob_sum / token_count)`` — NaN before any token."""
+        count = self.token_count
+        return jnp.where(count > 0, jnp.exp(-self.log_prob_sum / jnp.maximum(count, 1.0)), jnp.nan)
+
+    def bits_per_byte(self) -> Array:
+        """Tokenizer-independent ``-log2-prob per byte`` (needs
+        ``num_bytes`` reported in ``update``); NaN before any byte."""
+        _obs_inc("llm.perplexity_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            nbytes = self.byte_count
+            return jnp.where(
+                nbytes > 0, -self.log_prob_sum / (_LN2 * jnp.maximum(nbytes, 1.0)), jnp.nan
+            )
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """Degenerate (lower, upper) interval: the sums are exact, so the
+        envelope collapses to the value itself."""
+        _obs_inc("llm.perplexity_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            value = self.compute()
+        return value, value
+
+    def error_bound(self) -> Array:
+        """Identically zero — perplexity is an exact function of exact
+        sum states (no sketch approximation anywhere)."""
+        lo, hi = self.bounds()
+        return (hi - lo) / 2.0
+
+
+# gather-free mesh compute: the three scalars psum over the axis; no
+# materialized full-state gather is ever needed for pure sum states
+from metrics_tpu.utilities.sharding import (  # noqa: E402
+    register_sharded_compute as _register_sharded_compute,
+)
+
+
+def _streaming_perplexity_sharded(
+    worker: StreamingPerplexity, state: dict, axis_name: Any
+) -> Array:
+    lp = jax.lax.psum(state["log_prob_sum"], axis_name)
+    count = jax.lax.psum(state["token_count"], axis_name)
+    return jnp.where(count > 0, jnp.exp(-lp / jnp.maximum(count, 1.0)), jnp.nan)
+
+
+_register_sharded_compute(StreamingPerplexity, _streaming_perplexity_sharded)

@@ -1,0 +1,135 @@
+"""Streaming SQuAD-convention QA overlap: token-F1 and exact match.
+
+Both metrics follow the official SQuAD v1.1 evaluation semantics by
+reusing the normalization/overlap helpers of
+``metrics_tpu/functional/text/squad.py`` (lowercase, strip
+punctuation/articles, token-level F1, max over ground truths). Strings
+are normalized HOST-side — text never touches the device — and only the
+two scalar sums ``(score_sum, count)`` live as device state, so the
+metric is an exact sum monoid that aggregates bitwise through the serve
+tree like every other sum-reduced tenant.
+"""
+from typing import Any, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.squad import _exact_match_score, _f1_score
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs.registry import inc as _obs_inc
+
+Array = jax.Array
+
+__all__ = ["StreamingExactMatch", "StreamingTokenF1"]
+
+TEXT = Union[str, Sequence[str]]
+TARGETS = Union[str, Sequence[str], Sequence[Sequence[str]]]
+
+
+def _as_list(text: TEXT) -> List[str]:
+    return [text] if isinstance(text, str) else list(text)
+
+
+def _target_lists(target: TARGETS, n: int) -> List[List[str]]:
+    """Per-prediction ground-truth lists (one answer or many per item)."""
+    if isinstance(target, str):
+        groups: List[List[str]] = [[target]]
+    else:
+        groups = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(groups) != n:
+        raise ValueError(f"got {n} predictions but {len(groups)} target groups")
+    for i, g in enumerate(groups):
+        if not g:
+            raise ValueError(f"target group {i} is empty — every question needs >= 1 answer")
+    return groups
+
+
+class _StreamingOverlap(Metric):
+    """Shared host-scored / device-summed machinery for the QA pair."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    @staticmethod
+    def _score(prediction: str, ground_truth: str) -> float:
+        raise NotImplementedError
+
+    def update(self, preds: TEXT, target: TARGETS) -> None:
+        """Score prediction strings against their ground truth(s) —
+        SQuAD convention: max over a question's ground truths."""
+        pred_list = _as_list(preds)
+        groups = _target_lists(target, len(pred_list))
+        total = 0.0
+        for pred, answers in zip(pred_list, groups):
+            total += max(self._score(pred, answer) for answer in answers)
+        self.score_sum = self.score_sum + jnp.asarray(total, jnp.float32)
+        self.count = self.count + jnp.asarray(float(len(pred_list)), jnp.float32)
+
+    def compute(self) -> Array:
+        """Mean score over every question streamed so far (NaN before
+        the first question)."""
+        return jnp.where(self.count > 0, self.score_sum / jnp.maximum(self.count, 1.0), jnp.nan)
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """Degenerate interval — the sums are exact."""
+        _obs_inc("llm.qa_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            value = self.compute()
+        return value, value
+
+    def error_bound(self) -> Array:
+        """Identically zero (exact sum states, no sketch)."""
+        lo, hi = self.bounds()
+        return (hi - lo) / 2.0
+
+
+class StreamingTokenF1(_StreamingOverlap):
+    """Mean SQuAD token-overlap F1 over an unbounded QA stream, O(1) state.
+
+    Example:
+        >>> from metrics_tpu.llm import StreamingTokenF1
+        >>> m = StreamingTokenF1()
+        >>> m.update("the cat sat", [["a cat sat", "the dog ran"]])
+        >>> float(m.compute())
+        1.0
+    """
+
+    @staticmethod
+    def _score(prediction: str, ground_truth: str) -> float:
+        return _f1_score(prediction, ground_truth)
+
+
+class StreamingExactMatch(_StreamingOverlap):
+    """Mean SQuAD exact-match rate over an unbounded QA stream, O(1) state.
+
+    Example:
+        >>> from metrics_tpu.llm import StreamingExactMatch
+        >>> m = StreamingExactMatch()
+        >>> m.update(["An Answer!"], ["an answer"])
+        >>> float(m.compute())
+        1.0
+    """
+
+    @staticmethod
+    def _score(prediction: str, ground_truth: str) -> float:
+        return _exact_match_score(prediction, ground_truth)
+
+
+from metrics_tpu.utilities.sharding import (  # noqa: E402
+    register_sharded_compute as _register_sharded_compute,
+)
+
+
+def _streaming_overlap_sharded(worker: _StreamingOverlap, state: dict, axis_name: Any) -> Array:
+    total = jax.lax.psum(state["score_sum"], axis_name)
+    count = jax.lax.psum(state["count"], axis_name)
+    return jnp.where(count > 0, total / jnp.maximum(count, 1.0), jnp.nan)
+
+
+_register_sharded_compute(_StreamingOverlap, _streaming_overlap_sharded)

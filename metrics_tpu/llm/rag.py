@@ -1,0 +1,206 @@
+"""Streaming RAG retrieval quality: hit-rate / MRR / NDCG @k at fleet scale.
+
+Per-query scores come from the segment-local ``lax.top_k`` fast path
+(``functional/retrieval/_segment.py``, PR 1) when the batch is dense
+(every query the same contiguous document count) and from the full
+sort + segmented-scan pipeline otherwise — both agree bitwise on the
+dense layout. The metric then ships ONLY monoid state:
+
+* exact scalar sums (``hit_sum``, ``mrr_sum``, ``ndcg_sum``,
+  ``query_count``) — the three means are exact functions of the stream,
+  so the serve tree aggregates them losslessly from 1M to 1B documents;
+* a :class:`~metrics_tpu.streaming.sketches.QuantileSketch` over the
+  per-query NDCG scores — the score *distribution* (tail quality, drift)
+  survives aggregation with a documented error envelope, and backs the
+  ``mean`` family of :class:`metrics_tpu.experiment.SequentialTest`.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval._segment import (
+    dense_group_shape,
+    hit_rate_scores,
+    hit_rate_scores_topk,
+    make_group_context,
+    make_topk_context,
+    ndcg_scores,
+    ndcg_scores_topk,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.obs.registry import inc as _obs_inc
+from metrics_tpu.streaming.sketches import QuantileSketch
+
+Array = jax.Array
+
+__all__ = ["StreamingRAGQuality"]
+
+
+class StreamingRAGQuality(Metric):
+    """Hit-rate / MRR / NDCG @k over an unbounded stream of retrieval
+    queries, in fixed device memory.
+
+    ``update(preds, target, indexes)`` takes the flat retrieval-batch
+    layout every in-tree retrieval metric uses (scores, relevances and a
+    query id per document). Each query is scored once — hit-rate@k,
+    reciprocal-rank@k and NDCG@k — and folds into exact sums plus a
+    per-query NDCG :class:`~metrics_tpu.streaming.sketches.QuantileSketch`.
+
+    :meth:`compute` returns a shape-``(3,)`` array
+    ``[hit_rate@k, mrr@k, ndcg@k]`` (means over all queries; NaN before
+    the first query). The means are EXACT — :meth:`error_bound` is zero —
+    while :meth:`ndcg_quantile` answers distributional queries from the
+    sketch with the sketch's rigorous envelope
+    (:meth:`ndcg_quantile_bounds`).
+
+    MRR here is reciprocal rank **@k**: a query whose first relevant
+    document ranks below ``k`` scores 0, matching what a top-``k``
+    retrieval stack can actually surface (the unbounded variant is
+    :class:`metrics_tpu.retrieval.RetrievalMRR`).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.llm import StreamingRAGQuality
+        >>> m = StreamingRAGQuality(k=2)
+        >>> m.update(
+        ...     jnp.asarray([0.9, 0.3, 0.1, 0.8, 0.6, 0.2]),
+        ...     jnp.asarray([1, 0, 0, 0, 1, 0]),
+        ...     jnp.asarray([0, 0, 0, 1, 1, 1]),
+        ... )
+        >>> [float(x) for x in m.compute()]  # hit@2, mrr@2, ndcg@2
+        [1.0, 0.75, 0.8154648542404175]
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        k: int = 10,
+        num_bins: int = 128,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if k < 1:
+            raise ValueError(f"`k` must be >= 1, got {k}")
+        self.k = int(k)
+        self.num_bins = int(num_bins)
+        self.add_state("hit_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("mrr_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("ndcg_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("query_count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state(
+            "ndcg_sketch", default=QuantileSketch(num_bins, 0.0, 1.0), dist_reduce_fx="sketch"
+        )
+
+    # -- per-query scoring ----------------------------------------------
+
+    def _dense_scores(
+        self, preds: Array, target: Array, shape: Tuple[int, int]
+    ) -> Tuple[Array, Array, Array]:
+        tctx = make_topk_context(preds, target, shape, self.k)
+        hit = hit_rate_scores_topk(tctx)
+        ndcg = ndcg_scores_topk(tctx)
+        t = tctx.topk_target > 0
+        first_hit = jnp.argmax(t, axis=1)
+        rr = jnp.where(t.any(axis=1), 1.0 / (first_hit + 1).astype(jnp.float32), 0.0)
+        return hit, rr, ndcg
+
+    def _ragged_scores(
+        self, preds: Array, target: Array, indexes: Array
+    ) -> Tuple[Array, Array, Array, Array]:
+        ctx = make_group_context(preds, target, indexes)
+        hit = hit_rate_scores(ctx, self.k)
+        ndcg = ndcg_scores(ctx, self.k)
+        sentinel = ctx.num_segments
+        in_k = (ctx.target > 0) & (ctx.rank < self.k)
+        first_hit = ctx.group_min(jnp.where(in_k, ctx.rank, sentinel))
+        rr = jnp.where(first_hit < sentinel, 1.0 / (first_hit + 1).astype(jnp.float32), 0.0)
+        return hit, rr, ndcg, ctx.nonempty
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Fold a flat retrieval batch: one score triple per query.
+
+        Args:
+            preds: per-document retrieval scores, ``(N,)``.
+            target: per-document relevances (binary or graded), ``(N,)``.
+            indexes: per-document query ids, ``(N,)`` — grouping key.
+        """
+        preds = jnp.ravel(jnp.asarray(preds)).astype(jnp.float32)
+        target = jnp.ravel(jnp.asarray(target))
+        indexes = jnp.ravel(jnp.asarray(indexes))
+        shape = dense_group_shape(indexes)
+        if shape is not None:
+            hit, rr, ndcg = self._dense_scores(preds, target, shape)
+            weights = jnp.ones_like(ndcg)
+            n = jnp.asarray(float(shape[0]), jnp.float32)
+        else:
+            hit, rr, ndcg, mask = self._ragged_scores(preds, target, indexes)
+            weights = mask.astype(jnp.float32)
+            hit, rr, ndcg = hit * weights, rr * weights, ndcg * weights
+            n = weights.sum()
+        self.hit_sum = self.hit_sum + hit.sum()
+        self.mrr_sum = self.mrr_sum + rr.sum()
+        self.ndcg_sum = self.ndcg_sum + ndcg.sum()
+        self.query_count = self.query_count + n
+        self.ndcg_sketch = self.ndcg_sketch.fold(ndcg, weights=weights)
+
+    # -- queries ---------------------------------------------------------
+
+    def compute(self) -> Array:
+        """``[hit_rate@k, mrr@k, ndcg@k]`` means (shape ``(3,)``)."""
+        n = self.query_count
+        sums = jnp.stack([self.hit_sum, self.mrr_sum, self.ndcg_sum])
+        return jnp.where(n > 0, sums / jnp.maximum(n, 1.0), jnp.nan)
+
+    def bounds(self) -> Tuple[Array, Array]:
+        """Degenerate per-component interval — the means are exact sums
+        (the sketch only serves distributional queries)."""
+        _obs_inc("llm.rag_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            value = self.compute()
+        return value, value
+
+    def error_bound(self) -> Array:
+        """Identically zero for the three means."""
+        lo, hi = self.bounds()
+        return (hi - lo) / 2.0
+
+    def ndcg_quantile(self, q: Any) -> Array:
+        """Quantile(s) of the per-query NDCG distribution — sketch
+        midpoint, accurate to :meth:`ndcg_quantile_bounds`' half-width."""
+        _obs_inc("llm.rag_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            return self.ndcg_sketch.quantile(jnp.asarray(q))
+
+    def ndcg_quantile_bounds(self, q: Any) -> Tuple[Array, Array]:
+        """Rigorous (lower, upper) envelope for :meth:`ndcg_quantile`."""
+        _obs_inc("llm.rag_queries")
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            return self.ndcg_sketch.quantile_bounds(jnp.asarray(q))
+
+
+# gather-free mesh compute: scalar sums psum; the NDCG sketch stays
+# reduce-scattered (its quantile queries go through the sharded kernel
+# in utilities/sharding.py when asked for — the headline triple needs
+# only the exact scalars)
+from metrics_tpu.utilities.sharding import (  # noqa: E402
+    register_sharded_compute as _register_sharded_compute,
+)
+
+
+def _streaming_rag_sharded(worker: StreamingRAGQuality, state: dict, axis_name: Any) -> Array:
+    n = jax.lax.psum(state["query_count"], axis_name)
+    sums = jnp.stack(
+        [
+            jax.lax.psum(state["hit_sum"], axis_name),
+            jax.lax.psum(state["mrr_sum"], axis_name),
+            jax.lax.psum(state["ndcg_sum"], axis_name),
+        ]
+    )
+    return jnp.where(n > 0, sums / jnp.maximum(n, 1.0), jnp.nan)
+
+
+_register_sharded_compute(StreamingRAGQuality, _streaming_rag_sharded)

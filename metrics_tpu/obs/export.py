@@ -225,6 +225,7 @@ _FAMILY_HELP: Dict[str, str] = {
     "stream.drift_alerts": "Drift checks that crossed an alert threshold",
     "stream.windows_expired": "WindowedMetric ring slots retired",
     "stream.hh_queries": "StreamingTopK bound/envelope queries",
+    "stream.churn_queries": "StreamingTopK certified top-k churn queries",
     "stream.distinct_queries": "StreamingDistinctCount bound/envelope queries",
     "stream.cooccur_queries": "StreamingConfusion cell/top-cell bound queries",
     # fault tolerance
@@ -318,6 +319,16 @@ _FAMILY_HELP: Dict[str, str] = {
     "history.fenced_range_queries": "Delta range queries refused across generations",
     "history.alerts": "Alert rule firing edges, by rule and tenant",
     "history.alert_active": "Alert rule currently firing (1) or clear (0)",
+    # LLM evaluation (metrics_tpu.llm)
+    "llm.perplexity_queries": "StreamingPerplexity bound/bits-per-byte queries",
+    "llm.qa_queries": "StreamingTokenF1/ExactMatch bound queries",
+    "llm.rag_queries": "StreamingRAGQuality bound/quantile queries",
+    # online experimentation (metrics_tpu.experiment)
+    "experiment.evaluations": "Sequential-test evaluations at history cuts, by experiment",
+    "experiment.decisions": "Edge-triggered ship/stop decisions, by experiment and verdict",
+    "experiment.fenced_evaluations": "Evaluations skipped across failover generations",
+    "experiment.queries": "GET /experiment/<id> reports answered",
+    "experiment.active": "Experiment still collecting (1) or decided (0)",
 }
 
 

@@ -650,6 +650,12 @@ class Aggregator:
         or None when ``history=`` was not given."""
         return self._history
 
+    @property
+    def experiments(self):
+        """The attached :class:`~metrics_tpu.experiment.DecisionEngine`,
+        or None when no engine has been constructed over this node."""
+        return getattr(self, "_experiment_engine", None)
+
     # ------------------------------------------------------------------
     # Tenant registry
     # ------------------------------------------------------------------
@@ -1646,6 +1652,13 @@ class Aggregator:
             self._history.load_checkpoint_state(
                 proxy.tree.get("history", {}), history_meta, self
             )
+        experiments_meta = serve_meta.get("experiments")
+        engine = getattr(self, "_experiment_engine", None)
+        if engine is not None and experiments_meta is not None:
+            # attach the DecisionEngine (same experiments) BEFORE
+            # restore(), like tenants re-register before restore: the
+            # saved always-valid p-values and verdicts land wholesale
+            engine.load_checkpoint_state(experiments_meta)
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
         return manifest
@@ -1816,6 +1829,12 @@ class Aggregator:
                 if htree:
                     tree["history"] = htree
                 meta["history"] = hmeta
+            engine = getattr(self, "_experiment_engine", None)
+            if engine is not None:
+                # experiment decisions + evidence are tiny JSON records:
+                # they ride the manifest beside the history rings, so a
+                # restored root resumes with bitwise-identical verdicts
+                meta["experiments"] = engine.state_for_checkpoint()
         return _RegistryState(tree), meta
 
 

@@ -38,6 +38,14 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   nodes cross process boundaries by pointing
   :class:`~metrics_tpu.serve.tree.AggregatorNode`'s ``send`` at
   this route — the bytes are identical to the in-process path.
+* ``GET /experiment/<id>`` — JSON report for one registered experiment
+  (:meth:`~metrics_tpu.experiment.DecisionEngine.report`): per-arm
+  tenants, test configuration, the always-valid p-value, evaluation /
+  fencing counts, the latest evidence cut and — once the engine has
+  decided — the durable ship/stop decision record. **404** for an
+  unknown experiment id, **400** when no decision engine is attached to
+  this aggregator (experimentation is a ROOT concern; leaves serve only
+  their tenants).
 * ``GET /trace`` — Chrome-trace JSON (:func:`metrics_tpu.obs.to_chrome_trace`):
   host spans plus per-hop payload lifecycles (queue-wait / fold / ship /
   e2e per trace id), loadable in Perfetto — the debug view behind the
@@ -284,6 +292,20 @@ class MetricsServer:
         if obs.enabled():
             obs.observe("serve.query_ms", (_time.perf_counter() - t0) * 1000.0, tenant=tenant)
         return out
+
+    def render_experiment(self, exp_id: str) -> Dict[str, Any]:
+        """The ``GET /experiment/<id>`` body: the decision engine's full
+        report (arms, test config, always-valid p-value, evidence cut,
+        durable decision). Raises :class:`ServeError` when no engine is
+        attached (400) and ``KeyError`` for an unknown id (404)."""
+        engine = self.aggregator.experiments
+        if engine is None:
+            raise ServeError(
+                f"aggregator {self.aggregator.name!r} has no decision engine"
+                " attached (DecisionEngine(aggregator, ...)); experiments are"
+                " served at the root"
+            )
+        return engine.report(exp_id)
 
     def render_trace(self) -> str:
         """The ``/trace`` body: host spans + per-hop payload lifecycles as
@@ -538,6 +560,16 @@ def _make_handler(server: MetricsServer):
                         # armed — client-addressable, not a server fault
                         self._reply_json(400, {"error": str(err)})
                     except ValueError as err:
+                        self._reply_json(400, {"error": str(err)})
+                elif parsed.path.startswith("/experiment/"):
+                    exp_id = parsed.path[len("/experiment/") :]
+                    try:
+                        self._reply_json(200, server.render_experiment(exp_id))
+                    except KeyError:
+                        self._reply_json(404, {"error": f"unknown experiment {exp_id!r}"})
+                    except ServeError as err:
+                        # no engine attached: client-addressable (ask the
+                        # root), not a server fault
                         self._reply_json(400, {"error": str(err)})
                 elif parsed.path == "/healthz/live":
                     self._reply_json(200, server.render_live())

@@ -552,6 +552,12 @@ class MetricHistory:
         # (tenant, rule name) -> detail while firing; edge-trigger state
         self._active: Dict[Tuple[str, str], str] = {}
         self._warned_rules: set = set()
+        # post-cut observers (the experiment DecisionEngine attaches
+        # here): called once per cut() AFTER every tenant's interval has
+        # been retained, with (history, aggregator). Hook errors degrade
+        # to a one-shot warning — a decision bug must never block cuts.
+        self._cut_hooks: List[Callable[["MetricHistory", Any], None]] = []
+        self._warned_hooks: set = set()
         import threading
 
         self._lock = threading.Lock()
@@ -618,9 +624,29 @@ class MetricHistory:
                 if evictions:
                     _obs_inc("history.intervals_evicted", float(evictions), tenant=tenant_id)
             self._evaluate_rules(tenant, prev, snap)
+        if cuts:
+            for hook in tuple(self._cut_hooks):
+                try:
+                    hook(self, aggregator)
+                except Exception as err:  # noqa: BLE001 — observers must not kill cuts
+                    key = getattr(hook, "__qualname__", repr(hook))
+                    if key not in self._warned_hooks:
+                        self._warned_hooks.add(key)
+                        warnings.warn(
+                            f"history cut hook {key} failed:"
+                            f" {type(err).__name__}: {err}", stacklevel=2,
+                        )
         if armed and cuts:
             _obs_observe("history.cut_ms", (time.perf_counter() - t0) * 1000.0)
         return cuts
+
+    def add_cut_hook(self, hook: Callable[["MetricHistory", Any], None]) -> None:
+        """Attach a post-cut observer ``hook(history, aggregator)`` —
+        invoked once per :meth:`cut` after all tenants' intervals land
+        (the :class:`~metrics_tpu.experiment.DecisionEngine` seam)."""
+        if not callable(hook):
+            raise ValueError("cut hook must be callable")
+        self._cut_hooks.append(hook)
 
     # -- alert evaluation ------------------------------------------------
 
@@ -828,6 +854,9 @@ class MetricHistory:
                 older = base.leaves if base is not None else tenant.template_leaves
                 leaves = delta_leaves(tenant.spec, head.leaves, older)
                 values = self._with_loaded(tenant, leaves, head.consensus, _values_of)
+                for name, extra in self._topk_churn(tenant, base, head).items():
+                    if name in values:
+                        values[name].update(extra)
                 entry.update(
                     snapshot=head.meta(),
                     baseline=None if base is None else base.meta(),
@@ -838,6 +867,50 @@ class MetricHistory:
         if _obs_enabled():
             _obs_observe("history.range_query_ms", (time.perf_counter() - t0) * 1000.0)
         return out
+
+    def _topk_churn(self, tenant: Any, base: Optional[IntervalSnapshot],
+                    head: Optional[IntervalSnapshot]) -> Dict[str, Dict[str, Any]]:
+        """Per-member top-k churn enrichment for one delta interval:
+        which ids ``entered``/``exited``/``stayed`` in the CERTIFIED
+        top-k between the interval's baseline and head cumulative
+        snapshots (:meth:`~metrics_tpu.streaming.StreamingTopK.churn`'s
+        semantics over retained rings). An ambiguous envelope overlap
+        refuses THAT member (``churn_undefined``), never the whole range
+        answer; a missing baseline churns against the empty set (history
+        starts inside the asked-for interval and nothing was evicted)."""
+        from metrics_tpu.streaming.metrics import ChurnUndefinedError, StreamingTopK
+
+        names = [n for n, m in dict(tenant.view.items()).items()
+                 if isinstance(m, StreamingTopK)]
+        if not names or head is None:
+            return {}
+
+        def grab(view: Any) -> Dict[str, Any]:
+            out: Dict[str, Any] = {}
+            for name in names:
+                member = dict(view.items())[name]
+                try:
+                    out[name] = {int(i) for i in member.certified_topk()}
+                except ChurnUndefinedError as err:
+                    out[name] = err
+            return out
+
+        old = ({n: set() for n in names} if base is None
+               else self._with_loaded(tenant, base.leaves, base.consensus, grab))
+        new = self._with_loaded(tenant, head.leaves, head.consensus, grab)
+        enriched: Dict[str, Dict[str, Any]] = {}
+        for name in names:
+            o, w = old[name], new[name]
+            if isinstance(o, Exception) or isinstance(w, Exception):
+                err = o if isinstance(o, Exception) else w
+                enriched[name] = {"churn_undefined": str(err)}
+            else:
+                enriched[name] = {"churn": {
+                    "entered": sorted(w - o),
+                    "exited": sorted(o - w),
+                    "stayed": sorted(w & o),
+                }}
+        return enriched
 
     def _with_loaded(self, tenant: Any, leaves: Sequence[np.ndarray],
                      consensus: Sequence[np.ndarray], fn: Callable[[Any], Any]) -> Any:

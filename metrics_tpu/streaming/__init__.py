@@ -43,6 +43,7 @@ from metrics_tpu.streaming.sketches import (  # noqa: F401
 )
 
 __all__ = [
+    "ChurnUndefinedError",
     "CoOccurrenceSketch",
     "DecayedMetric",
     "DistinctCountSketch",
@@ -66,6 +67,7 @@ __all__ = [
 ]
 
 _LAZY = {
+    "ChurnUndefinedError": "metrics_tpu.streaming.metrics",
     "StreamingAUROC": "metrics_tpu.streaming.metrics",
     "StreamingAveragePrecision": "metrics_tpu.streaming.metrics",
     "StreamingConfusion": "metrics_tpu.streaming.metrics",

@@ -14,10 +14,11 @@ fixed-shape scan carry and merges under the ``"sketch"`` reduction),
 :class:`metrics_tpu.ft.CheckpointManager` (manifest round-trip, exactly-once
 resume) without special cases.
 """
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.obs.registry import inc as _obs_inc
@@ -28,6 +29,7 @@ from metrics_tpu.streaming.sketches import QuantileSketch, ScoreLabelSketch
 Array = jax.Array
 
 __all__ = [
+    "ChurnUndefinedError",
     "StreamingAUROC",
     "StreamingAveragePrecision",
     "StreamingConfusion",
@@ -35,6 +37,15 @@ __all__ = [
     "StreamingQuantile",
     "StreamingTopK",
 ]
+
+
+class ChurnUndefinedError(ValueError):
+    """Top-k membership is AMBIGUOUS: the rigorous count envelopes of the
+    k-th and (k+1)-th heaviest candidates overlap, so the set boundary —
+    and therefore any entered/exited churn verdict — cannot be certified.
+    The bounded-answers stance: refuse loudly rather than report churn
+    that a heavier sketch (or the exact stream) could contradict. Widen
+    ``capacity``/``depth``, or lower ``k``."""
 
 
 class StreamingAUROC(Metric):
@@ -256,6 +267,76 @@ class StreamingTopK(Metric):
         """Per-item overestimate envelope of the reported counts."""
         lo, hi = self.bounds()
         return hi - lo
+
+    def certified_topk(self) -> np.ndarray:
+        """The top-``k`` id set with CERTIFIED membership boundary.
+
+        The set is certain when the k-th heaviest candidate's rigorous
+        LOWER count bound strictly exceeds every possible competitor's
+        UPPER bound — the (k+1)-th reported candidate AND any id the
+        sketch could not decode at all. Undecoded ids are bounded by the
+        RESIDUAL mass ``total - sum(candidate lower bounds)``: distinct
+        ids partition the stream mass, so no unreported id can hold more
+        than what the decoded candidates leave unaccounted. Raises
+        :class:`ChurnUndefinedError` when the envelopes overlap (a
+        saturated sketch leaves most mass undecodable, so the residual
+        refuses loudly rather than certifying a fabricated boundary).
+        """
+        with self.sync_context(should_sync=self._to_sync, should_unsync=True):
+            depth, width = self.sketch.counts.shape[:2]
+            n_cand = max(self.k + 1, int(depth) * int(width))
+            ids, counts, over = self.sketch.topk(n_cand)
+            total = float(np.asarray(self.sketch.counts)[0].sum())
+        ids = np.asarray(ids)
+        counts = np.asarray(counts)
+        over = np.asarray(over)
+        valid = ids >= 0
+        unreported_ub = max(total - float((counts - over)[valid].sum()), 0.0)
+        member = ids[: self.k]
+        member = member[member >= 0]
+        next_upper = unreported_ub
+        if member.size == self.k and valid.size > self.k and valid[self.k]:
+            next_upper = max(next_upper, float(counts[self.k]))
+        kth_lower = (
+            float(counts[self.k - 1] - over[self.k - 1]) if member.size == self.k else 0.0
+        )
+        # a short member list is exact only when NO mass is unaccounted;
+        # a full one must clear every competitor strictly
+        certified = next_upper == 0.0 or (member.size == self.k and kth_lower > next_upper)
+        if not certified:
+            raise ChurnUndefinedError(
+                f"top-{self.k} membership is ambiguous: the k-th candidate's"
+                f" lower count bound {kth_lower:g} does not exceed the best"
+                f" competitor's upper bound {next_upper:g} (reported (k+1)-th"
+                " candidate or residual undecoded mass) — entered/exited churn"
+                " cannot be certified. Widen the sketch (capacity/depth) or"
+                " lower k."
+            )
+        return member
+
+    def churn(self, newer: "StreamingTopK") -> Dict[str, List[int]]:
+        """Top-k membership churn from this state (interval ``a``) to
+        ``newer`` (interval ``b``): ``StreamingTopK.churn(a, b)`` answers
+        which ids ``entered``/``exited``/``stayed`` in the certified
+        top-``k`` between two history snapshots of the same stream (the
+        ``/query?mode=delta`` enrichment reads retained ring snapshots
+        through this path). Refuses with :class:`ChurnUndefinedError`
+        when EITHER side's membership boundary is ambiguous — a churn
+        verdict built on an uncertain set would fabricate arrivals."""
+        if not isinstance(newer, StreamingTopK):
+            raise ValueError(
+                f"churn compares two StreamingTopK states, got {type(newer).__name__}"
+            )
+        if newer.k != self.k:
+            raise ValueError(f"churn needs matching k: {self.k} vs {newer.k}")
+        _obs_inc("stream.churn_queries")
+        old_ids = {int(i) for i in self.certified_topk()}
+        new_ids = {int(i) for i in newer.certified_topk()}
+        return {
+            "entered": sorted(new_ids - old_ids),
+            "exited": sorted(old_ids - new_ids),
+            "stayed": sorted(new_ids & old_ids),
+        }
 
 
 class StreamingDistinctCount(Metric):

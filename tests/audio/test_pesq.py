@@ -134,9 +134,16 @@ class TestPesqGoldens:
 
         goldens = load_goldens()
         if not goldens:
-            pytest.skip(
-                "no PESQ golden fixture committed yet — run "
-                "`python -m tests.audio.generate_pesq_goldens` on a pesq-equipped machine"
+            # xfail, not skip: the absent fixture is a KNOWN parity gap
+            # (ROADMAP 2c) that must stay loud in every run's summary until
+            # someone commits the goldens — the container cannot install the
+            # pesq C library, so the one-command path has to run elsewhere
+            pytest.xfail(
+                "PESQ golden fixture not committed (tests/audio/pesq_goldens.json"
+                " missing) and the pesq C library is not installable in this"
+                " container — generate and commit the fixture with"
+                " `python -m tests.audio.generate_pesq_goldens` on a"
+                " pesq-equipped machine"
             )
         corpus = make_corpus()
         for case_id, golden in goldens.items():

@@ -552,6 +552,10 @@ class TestPrometheusRoundTrip:
         obs.inc("events", kind='hosti,le="v\\al\nue')
         obs.set_gauge("level", 7.25, zone="z1")
         obs.inc("serve.ingests", 2)  # built-in family: ships a HELP line
+        # the llm.* / experiment.* families registered by the eval and
+        # experimentation tenants ship HELP like any built-in
+        obs.inc("llm.rag_queries", 1)
+        obs.inc("experiment.decisions", 1, exp="e1", verdict="ship")
         for v in (0.5, 5.0, 50.0):
             obs.observe("lat", v, step="epoch")
         obs.register_help("events", "hostile\\help\ntext")
@@ -568,6 +572,10 @@ class TestPrometheusRoundTrip:
         # unregistered families export with TYPE only
         assert helps["metrics_tpu_events"] == "hostile\\help\ntext"
         assert helps["metrics_tpu_serve_ingests"] == obs.family_help("serve.ingests")
+        assert helps["metrics_tpu_llm_rag_queries"] == obs.family_help("llm.rag_queries")
+        assert helps["metrics_tpu_experiment_decisions"] == obs.family_help(
+            "experiment.decisions"
+        )
         assert "metrics_tpu_level" not in helps
         by_name = {}
         for name, labels, value in series:

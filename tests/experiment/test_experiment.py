@@ -1,0 +1,233 @@
+"""DecisionEngine: cut-hook evaluation, fencing, durability, endpoint."""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.obs as obs
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.experiment import ArmSpec, DecisionEngine, Experiment, SequentialTest
+from metrics_tpu.serve import Aggregator, MetricsServer, ServeError
+from metrics_tpu.serve.history import HistoryConfig
+from metrics_tpu.serve.wire import encode_state
+from metrics_tpu.streaming import StreamingQuantile
+
+EXP = "latency-cut"
+N_CLIENTS = 2
+SAMPLES = 64
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    was = obs.enabled()
+    obs.enable(False)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.enable(was)
+
+
+def _factory():
+    return MetricCollection({"lat": StreamingQuantile(num_bins=128, lo=0.0, hi=1.0)})
+
+
+def _build(checkpoint_dir=None, alpha=0.05):
+    agg = Aggregator(
+        "root",
+        history=HistoryConfig(cut_every_s=float("inf")),
+        checkpoint_dir=checkpoint_dir,
+    )
+    exp = Experiment(
+        EXP,
+        arms=[ArmSpec("control", _factory), ArmSpec("treatment", _factory)],
+        metric="lat",
+        test=SequentialTest(alpha=alpha, tau=0.1, min_samples=100, family="mean"),
+        higher_is_better=False,  # latency: lower is better
+    )
+    exp.register(agg)
+    engine = DecisionEngine(agg, [exp])
+    return agg, exp, engine
+
+
+def _feed(agg, exp, interval, effect):
+    """Cumulative clients re-ship interval [0, interval] per arm."""
+    for arm, shift in ((exp.control, 0.0), (exp.treatment, -effect)):
+        tid = exp.tenant_id(arm)
+        for c in range(N_CLIENTS):
+            coll = _factory()
+            rng = np.random.default_rng(1000 * c + (7 if shift == 0.0 else 13))
+            for _ in range(interval + 1):
+                vals = np.clip(rng.normal(0.5 + shift, 0.05, SAMPLES), 0.0, 1.0)
+                coll["lat"].update(jnp.asarray(vals.astype(np.float32)))
+            agg.ingest(
+                encode_state(coll, tenant=tid, client_id=f"c{c}", watermark=(0, interval))
+            )
+    agg.flush()
+
+
+class TestDecisions:
+    def test_true_effect_ships_once_and_sticks(self):
+        obs.enable()
+        obs.reset()
+        agg, exp, engine = _build()
+        decided_at = None
+        with pytest.warns(UserWarning, match="DECIDED: SHIP"):
+            for interval in range(6):
+                _feed(agg, exp, interval, effect=0.2)
+                agg.history.cut(agg, now=float(interval))  # hook evaluates
+                rec = engine.report(EXP)
+                if rec["verdict"] != "continue" and decided_at is None:
+                    decided_at = (interval, rec["evaluations"])
+        assert decided_at is not None
+        final = engine.evaluate(EXP)
+        assert final["verdict"] == "ship"
+        # sticky: later cuts never re-litigate or re-count the decision
+        assert final["evaluations"] == decided_at[1]
+        dec = [
+            v
+            for k, v in obs.snapshot()["counters"].items()
+            if k.startswith("experiment.decisions")
+        ]
+        assert sum(dec) == 1
+        assert final["decision"]["verdict"] == "ship"
+        assert final["decision"]["p_value"] <= 0.05
+
+    def test_null_effect_never_fires(self):
+        obs.enable()
+        obs.reset()
+        agg, exp, engine = _build()
+        for interval in range(6):
+            _feed(agg, exp, interval, effect=0.0)
+            agg.history.cut(agg, now=float(interval))
+        rec = engine.report(EXP)
+        assert rec["verdict"] == "continue"
+        assert rec["decision"] is None
+        assert rec["evaluations"] == 6
+
+    def test_generation_fence_skips_cross_failover_comparison(self):
+        obs.enable()
+        obs.reset()
+        agg, exp, engine = _build()
+        _feed(agg, exp, 0, effect=0.0)
+        agg.history.cut(agg, now=0.0)
+        before = engine.report(EXP)["fenced"]
+        # a failover bumps the history generation: retained snapshots now
+        # belong to the old history and must not be compared
+        agg.history.generation += 1
+        rec = engine.evaluate(EXP)
+        assert rec["fenced"] == before + 1
+        assert rec["verdict"] == "continue"
+        fenced = [
+            v
+            for k, v in obs.snapshot()["counters"].items()
+            if k.startswith("experiment.fenced_evaluations")
+        ]
+        assert sum(fenced) >= 1
+
+
+class TestDurability:
+    def test_checkpoint_roundtrip_is_bitwise(self, tmp_path):
+        obs.enable()
+        obs.reset()
+        agg, exp, engine = _build(checkpoint_dir=str(tmp_path))
+        with pytest.warns(UserWarning, match="DECIDED"):
+            for interval in range(4):
+                _feed(agg, exp, interval, effect=0.2)
+                agg.history.cut(agg, now=float(interval))
+        assert engine.report(EXP)["verdict"] == "ship"
+        path = agg.save()
+        agg2, exp2, engine2 = _build(checkpoint_dir=str(tmp_path))
+        agg2.restore(path)
+        assert json.dumps(engine.state_for_checkpoint(), sort_keys=True) == json.dumps(
+            engine2.state_for_checkpoint(), sort_keys=True
+        )
+        # a restored root must not re-announce (or re-count) the decision
+        assert ("decision", EXP) in engine2._warned
+
+    def test_unknown_saved_experiments_are_ignored(self):
+        agg, exp, engine = _build()
+        engine.load_checkpoint_state({"never-attached": {"verdict": "ship"}})
+        with pytest.raises(KeyError):
+            engine.report("never-attached")
+
+
+class TestReporting:
+    def test_report_shape_and_unknown_id(self):
+        agg, exp, engine = _build()
+        rep = engine.report(EXP)
+        assert rep["arms"] == {
+            "control": f"{EXP}/control",
+            "treatment": f"{EXP}/treatment",
+        }
+        assert rep["test"]["alpha"] == 0.05
+        assert rep["verdict"] == "continue"
+        with pytest.raises(KeyError):
+            engine.report("nope")
+
+    def test_http_endpoint(self):
+        agg, exp, engine = _build()
+        server = MetricsServer(agg, port=0).start()
+        try:
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/experiment/{EXP}"
+                ).read()
+            )
+            assert body["experiment"] == EXP and body["verdict"] == "continue"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/experiment/nope"
+                )
+            assert exc.value.code == 404
+        finally:
+            server.stop()
+
+    def test_endpoint_without_engine_is_400(self):
+        agg = Aggregator("plain", history=HistoryConfig(cut_every_s=float("inf")))
+        server = MetricsServer(agg, port=0)
+        with pytest.raises(ServeError, match="no decision engine"):
+            server.render_experiment("anything")
+
+
+class TestContracts:
+    def test_engine_requires_history(self):
+        agg = Aggregator("nohist")
+        with pytest.raises(ServeError, match="no history armed"):
+            DecisionEngine(agg)
+
+    def test_duplicate_experiment_rejected(self):
+        agg, exp, engine = _build()
+        with pytest.raises(ServeError, match="already attached"):
+            engine.add(exp)
+
+    def test_experiment_validation(self):
+        arms = [ArmSpec("a", _factory), ArmSpec("b", _factory)]
+        with pytest.raises(ValueError, match="exactly 2 arms"):
+            Experiment("x", arms[:1], metric="lat")
+        with pytest.raises(ValueError, match="must differ"):
+            Experiment("x", [arms[0], ArmSpec("a", _factory)], metric="lat")
+        with pytest.raises(ValueError, match="non-empty"):
+            Experiment("", arms, metric="lat")
+        with pytest.raises(ValueError, match="factory"):
+            ArmSpec("a", factory=None)
+
+    def test_missing_metric_member_warns_not_raises(self):
+        """A decision bug must not kill the cut path: the hook swallows
+        the error with a one-shot warning."""
+        agg = Aggregator("root", history=HistoryConfig(cut_every_s=float("inf")))
+        exp = Experiment(
+            EXP,
+            arms=[ArmSpec("control", _factory), ArmSpec("treatment", _factory)],
+            metric="not-a-member",
+            test=SequentialTest(min_samples=1),
+        )
+        exp.register(agg)
+        engine = DecisionEngine(agg, [exp])
+        _feed(agg, exp, 0, effect=0.0)
+        with pytest.warns(UserWarning, match="evaluation failed"):
+            agg.history.cut(agg, now=0.0)
+        with pytest.raises(ServeError, match="not a.*member"):
+            engine.evaluate(EXP)

@@ -1,0 +1,199 @@
+"""SequentialTest: always-valid calibration, coverage, envelope folding.
+
+The ISSUE-pinned correctness properties live here:
+
+* seeded null simulation — the always-valid p-value crosses ``alpha``
+  in at most an ``alpha`` fraction of 1k monitored runs;
+* the confidence sequence covers the true effect uniformly over cuts;
+* sketch-derived decisions imply the exact-sample decision at the same
+  cut (the envelope can delay significance, never fabricate it).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.experiment import (
+    ArmStats,
+    SequentialTest,
+    arm_stats_from_samples,
+    arm_stats_from_sketch,
+    mixture_lr,
+)
+from metrics_tpu.streaming import QuantileSketch, ScoreLabelSketch
+
+
+def _cumulative_stats(x: np.ndarray):
+    """Per-cut (n, mean, var) for runs x (cuts*batch) sample matrices."""
+    runs, cuts, batch = x.shape
+    flat = x.reshape(runs, cuts * batch)
+    csum = np.cumsum(flat, axis=1)
+    csq = np.cumsum(flat**2, axis=1)
+    ends = np.arange(1, cuts + 1) * batch
+    n = ends.astype(np.float64)
+    s = csum[:, ends - 1]
+    s2 = csq[:, ends - 1]
+    mean = s / n
+    var = np.maximum(s2 / n - mean**2, 0.0)
+    return n, mean, var
+
+
+class TestNullCalibration:
+    def test_always_valid_under_null_1k_runs(self):
+        """Monitoring at every cut, the p-value dips below alpha in at
+        most an alpha fraction of null runs (Ville's inequality)."""
+        rng = np.random.default_rng(2026)
+        runs, cuts, batch = 1000, 10, 100
+        control = rng.standard_normal((runs, cuts, batch))
+        treatment = rng.standard_normal((runs, cuts, batch))
+        test = SequentialTest(alpha=0.05, tau=0.2, min_samples=batch)
+        n, mean_c, var_c = _cumulative_stats(control)
+        _, mean_t, var_t = _cumulative_stats(treatment)
+        crossed = np.zeros(runs, dtype=bool)
+        for r in range(runs):
+            p = 1.0
+            for c in range(cuts):
+                out = test.step(
+                    ArmStats(n[c], mean_c[r, c], var_c[r, c], 0.0),
+                    ArmStats(n[c], mean_t[r, c], var_t[r, c], 0.0),
+                    prev_p=p,
+                )
+                p = out["p_value"]
+            crossed[r] = p <= test.alpha
+        assert crossed.mean() <= test.alpha
+
+    def test_mixture_lr_is_martingale_shaped(self):
+        # LR = 1 exactly at zero effect, grows with |diff|, vectorized
+        assert float(mixture_lr(0.0, 1.0, 0.5)) < 1.0
+        assert float(mixture_lr(0.0, 0.0, 0.5)) == 1.0
+        lrs = mixture_lr(np.asarray([0.0, 0.5, 1.0]), 0.01, 0.5)
+        assert lrs.shape == (3,) and np.all(np.diff(lrs) > 0)
+
+
+class TestConfidenceSequence:
+    def test_covers_true_effect_uniformly(self):
+        rng = np.random.default_rng(7)
+        runs, cuts, batch, effect = 400, 8, 100, 0.3
+        control = rng.standard_normal((runs, cuts, batch))
+        treatment = rng.standard_normal((runs, cuts, batch)) + effect
+        test = SequentialTest(alpha=0.05, tau=0.2, min_samples=batch)
+        n, mean_c, var_c = _cumulative_stats(control)
+        _, mean_t, var_t = _cumulative_stats(treatment)
+        covered = np.zeros(runs, dtype=bool)
+        for r in range(runs):
+            ok = True
+            for c in range(cuts):
+                out = test.step(
+                    ArmStats(n[c], mean_c[r, c], var_c[r, c], 0.0),
+                    ArmStats(n[c], mean_t[r, c], var_t[r, c], 0.0),
+                )
+                lo, hi = out["ci"]
+                ok = ok and (lo <= effect <= hi)
+            covered[r] = ok
+        assert covered.mean() >= 1.0 - test.alpha
+
+    def test_halfwidth_shrinks_with_evidence(self):
+        test = SequentialTest(alpha=0.05, tau=0.2)
+        assert test.confidence_halfwidth(0.0) == float("inf")
+        assert test.confidence_halfwidth(0.001) < test.confidence_halfwidth(0.1)
+
+
+class TestSketchNeverFabricates:
+    def test_sketch_decision_implies_exact_decision(self):
+        """Whenever the sketch-evidence chain fires, the exact-sample
+        chain has already fired the same verdict — the envelope only
+        delays, never fabricates."""
+        rng = np.random.default_rng(42)
+        cuts, batch, effect = 12, 200, 0.08
+        test = SequentialTest(alpha=0.05, tau=0.1, min_samples=batch)
+        sk_c = QuantileSketch(num_bins=64, lo=0.0, hi=1.0)
+        sk_t = QuantileSketch(num_bins=64, lo=0.0, hi=1.0)
+        all_c, all_t = [], []
+        p_exact = p_sketch = 1.0
+        exact_fired_at = sketch_fired_at = None
+        for cut in range(cuts):
+            c = np.clip(rng.normal(0.5, 0.1, batch), 0.0, 1.0)
+            t = np.clip(rng.normal(0.5 + effect, 0.1, batch), 0.0, 1.0)
+            all_c.append(c)
+            all_t.append(t)
+            sk_c = sk_c.fold(jnp.asarray(c))
+            sk_t = sk_t.fold(jnp.asarray(t))
+            exact = test.step(
+                arm_stats_from_samples(np.concatenate(all_c)),
+                arm_stats_from_samples(np.concatenate(all_t)),
+                prev_p=p_exact,
+            )
+            sketch = test.step(
+                arm_stats_from_sketch(sk_c, family="mean"),
+                arm_stats_from_sketch(sk_t, family="mean"),
+                prev_p=p_sketch,
+            )
+            p_exact, p_sketch = exact["p_value"], sketch["p_value"]
+            if exact["verdict"] != "continue" and exact_fired_at is None:
+                exact_fired_at = (cut, exact["verdict"])
+            if sketch["verdict"] != "continue" and sketch_fired_at is None:
+                sketch_fired_at = (cut, sketch["verdict"])
+            if sketch["verdict"] != "continue":
+                assert exact["verdict"] == sketch["verdict"]
+        # non-vacuous: this seeded stream fires on both evidence paths
+        assert exact_fired_at is not None and exact_fired_at[1] == "ship"
+        assert sketch_fired_at is not None and sketch_fired_at[1] == "ship"
+        assert exact_fired_at[0] <= sketch_fired_at[0]
+
+    def test_envelope_swallows_small_effects(self):
+        # combined halfwidth exceeds the observed diff: the effective
+        # effect is zero, the LR stays at 1 and no verdict can fire
+        test = SequentialTest(alpha=0.05, tau=0.1, min_samples=10)
+        out = test.step(
+            ArmStats(1000.0, 0.50, 0.01, 0.03),
+            ArmStats(1000.0, 0.52, 0.01, 0.03),
+        )
+        assert out["effective_diff"] == 0.0
+        assert out["verdict"] == "continue"
+        assert out["p_value"] == 1.0
+        assert out["envelope"] == pytest.approx(0.06)
+
+    def test_rate_family_is_exact(self):
+        sk = ScoreLabelSketch(num_bins=64)
+        sk = sk.fold(
+            jnp.asarray([0.1, 0.8, 0.4, 0.9, 0.7]), jnp.asarray([0, 1, 0, 1, 1])
+        )
+        stats = arm_stats_from_sketch(sk, family="rate")
+        assert stats.n == 5.0
+        assert stats.mean == pytest.approx(0.6)
+        assert stats.var == pytest.approx(0.24)
+        assert stats.halfwidth == 0.0
+
+    def test_mean_family_halfwidth_bounds_mean_error(self):
+        rng = np.random.default_rng(5)
+        x = rng.uniform(0.0, 1.0, 2000)
+        sk = QuantileSketch(num_bins=128, lo=0.0, hi=1.0).fold(jnp.asarray(x))
+        stats = arm_stats_from_sketch(sk, family="mean")
+        assert abs(stats.mean - x.mean()) <= stats.halfwidth + 1e-6
+        assert stats.var >= x.var() - 1e-6  # conservative upper bound
+
+
+class TestContracts:
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="alpha"):
+            SequentialTest(alpha=1.5)
+        with pytest.raises(ValueError, match="tau"):
+            SequentialTest(tau=0.0)
+        with pytest.raises(ValueError, match="family"):
+            SequentialTest(family="median")
+
+    def test_sketch_family_validation(self):
+        with pytest.raises(ValueError, match="rate"):
+            arm_stats_from_sketch(QuantileSketch(8, 0.0, 1.0), family="rate")
+        with pytest.raises(ValueError, match="mean"):
+            arm_stats_from_sketch(ScoreLabelSketch(8), family="mean")
+
+    def test_step_is_pure(self):
+        test = SequentialTest(alpha=0.05, tau=0.1, min_samples=10)
+        c = ArmStats(500.0, 0.4, 0.02, 0.0)
+        t = ArmStats(500.0, 0.55, 0.02, 0.0)
+        assert test.step(c, t, 0.7) == test.step(c, t, 0.7)
+
+    def test_empty_arm_stats(self):
+        assert arm_stats_from_samples([]) == ArmStats(0.0, 0.0, 0.0, 0.0)
+        empty = arm_stats_from_sketch(QuantileSketch(8, 0.0, 1.0), "mean")
+        assert empty.n == 0.0

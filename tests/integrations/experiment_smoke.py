@@ -1,0 +1,351 @@
+"""CI smoke: LLM-eval tenants and the decision engine under fleet chaos.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.experiment_smoke``
+(the CI step does, mirroring ``history_smoke``). A two-arm online
+experiment — 500 clients per arm — plus an LLM-eval tenant
+(perplexity / token-F1 / RAG quality) ship cumulative snapshots through
+an elastic :class:`~metrics_tpu.serve.AggregationTree` under a seeded
+10% :class:`~metrics_tpu.ft.faults.WireChaos` schedule, with a node
+JOIN and an intermediate HARD-KILL + supervised heal mid-run. The tree
+root forwards its merged state to a history-armed DECISION root where a
+:class:`~metrics_tpu.experiment.DecisionEngine` evaluates on every cut.
+
+Acceptance, all asserted here:
+
+* the injected true effect fires **exactly one** SHIP decision
+  (edge-triggered, counted once under ``experiment.decisions``) — and it
+  fires AFTER the decision root was checkpointed, hard-killed and
+  restored, so the always-valid p-value demonstrably continues from
+  durable state;
+* the null experiment **never** fires across the seeded run (the
+  type-I spot check riding the same traffic);
+* the decision root's final records are **bitwise-equal** to an
+  uninterrupted reference run fed the identical forwarded payloads —
+  kill-resume is invisible to decisions;
+* the LLM tenant's root state is **bitwise-equal to the flat oracle
+  merge of exactly the accepted snapshots**, at the tree root AND at
+  the restored decision root (sum/sketch monoid states survive chaos
+  duplicates, elastic churn, and kill-resume exactly).
+"""
+import json
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260807
+N_PER_ARM = 500
+N_LLM_CLIENTS = 100
+N_INTERVALS = 4
+SAMPLES = 8  # latency samples per client per interval
+KILL_AFTER = 1  # checkpoint + kill + restore the decision root after this cut
+FAN_OUT = (2, 4)
+EXP_TRUE = "checkout-latency"
+EXP_NULL = "null-check"
+LLM_TENANT = "llm-eval"
+# min_samples so the true effect cannot decide before cut 2 — i.e. only
+# AFTER the kill+restore at cut 1 (cumulative per-arm n at cut k is
+# roughly 500 * 8 * (k+1) minus chaos losses)
+MIN_SAMPLES = 10_000
+
+
+def _lat_factory():
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingQuantile
+
+    return MetricCollection({"lat": StreamingQuantile(num_bins=128, lo=0.0, hi=1.0)})
+
+
+def _llm_factory():
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.llm import StreamingPerplexity, StreamingRAGQuality, StreamingTokenF1
+
+    return MetricCollection(
+        {
+            "ppl": StreamingPerplexity(),
+            "f1": StreamingTokenF1(),
+            "rag": StreamingRAGQuality(k=4, num_bins=64),
+        }
+    )
+
+
+def _experiments():
+    from metrics_tpu.experiment import ArmSpec, Experiment, SequentialTest
+
+    true_exp = Experiment(
+        EXP_TRUE,
+        arms=[ArmSpec("control", _lat_factory), ArmSpec("treatment", _lat_factory)],
+        metric="lat",
+        test=SequentialTest(alpha=0.05, tau=0.1, min_samples=MIN_SAMPLES, family="mean"),
+        higher_is_better=False,  # latency: lower is better -> ship
+    )
+    null_exp = Experiment(
+        EXP_NULL,
+        arms=[ArmSpec("control", _lat_factory), ArmSpec("treatment", _lat_factory)],
+        metric="lat",
+        test=SequentialTest(alpha=0.05, tau=0.1, min_samples=MIN_SAMPLES, family="mean"),
+        higher_is_better=False,
+    )
+    return true_exp, null_exp
+
+
+def _arm_tenants():
+    true_exp, null_exp = _experiments()
+    return {tid: _lat_factory for exp in (true_exp, null_exp) for tid in exp.tenant_ids()}
+
+
+def _client_snapshots():
+    """Pre-encode every client's cumulative wire blobs, per tenant."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    # arm traffic: treatment of the TRUE experiment is genuinely faster;
+    # every other arm draws the same latency distribution
+    shifts = {
+        f"{EXP_TRUE}/control": 0.0,
+        f"{EXP_TRUE}/treatment": -0.10,
+        f"{EXP_NULL}/control": 0.0,
+        f"{EXP_NULL}/treatment": 0.0,
+    }
+    out = {}
+    for tid, shift in shifts.items():
+        for c in range(N_PER_ARM):
+            cid = f"{tid}:c{c:03d}"
+            rng = np.random.default_rng(abs(hash(tid)) % 100_000 + c)
+            coll = _lat_factory()
+            blobs = []
+            for interval in range(N_INTERVALS):
+                vals = np.clip(rng.normal(0.5 + shift, 0.05, SAMPLES), 0.0, 1.0)
+                coll["lat"].update(jnp.asarray(vals.astype(np.float32)))
+                blobs.append(
+                    encode_state(coll, tenant=tid, client_id=cid, watermark=(0, interval))
+                )
+            out[cid] = (tid, blobs)
+    for c in range(N_LLM_CLIENTS):
+        cid = f"llm:c{c:03d}"
+        rng = np.random.default_rng(50_000 + c)
+        coll = _llm_factory()
+        blobs = []
+        for interval in range(N_INTERVALS):
+            # quantized to the 2^-10 dyadic grid: every partial sum of
+            # log_prob_sum is then exactly representable in float32
+            # (|total| * 1024 << 2^24), so the tree-shaped fold at the
+            # root is BITWISE the flat oracle fold regardless of the
+            # association order the elastic topology happens to produce
+            lp = np.round(np.log(rng.uniform(0.1, 1.0, 32)) * 1024.0) / 1024.0
+            lp = lp.astype(np.float32)
+            coll["ppl"].update(jnp.asarray(lp), num_bytes=64)
+            pred = f"answer {rng.integers(0, 4)}"
+            gold = f"answer {rng.integers(0, 4)}"
+            coll["f1"].update([pred], [gold])
+            scores = rng.permutation(16).astype(np.float32)
+            rel = (rng.uniform(size=16) < 0.3).astype(np.int32)
+            idx = np.repeat(np.arange(4), 4)
+            coll["rag"].update(jnp.asarray(scores), jnp.asarray(rel), jnp.asarray(idx))
+            blobs.append(
+                encode_state(coll, tenant=LLM_TENANT, client_id=cid, watermark=(0, interval))
+            )
+        out[cid] = (LLM_TENANT, blobs)
+    return out
+
+
+def main() -> None:
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    from metrics_tpu import obs
+    from metrics_tpu.experiment import DecisionEngine
+    from metrics_tpu.ft import faults
+    from metrics_tpu.serve import (
+        AggregationTree,
+        Aggregator,
+        ElasticFleet,
+        HistoryConfig,
+        ResilienceConfig,
+        Supervisor,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, encode_state, peek_header
+
+    obs.reset()
+    obs.enable()
+    root_dir = tempfile.mkdtemp(prefix="experiment_smoke_")
+    tenants = dict(_arm_tenants())
+    tenants[LLM_TENANT] = _llm_factory
+    snapshots = _client_snapshots()
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.025, p_duplicate=0.025, p_reorder=0.025, p_corrupt=0.025, p_delay=0.0
+    )
+    tree = AggregationTree(
+        fan_out=FAN_OUT, tenants=tenants, resilience=ResilienceConfig(error_threshold=3)
+    )
+    fleet = ElasticFleet(tree, seed=SEED)
+    supervisor = Supervisor(tree, heartbeat_timeout_s=5.0, name="supervisor", warn=False)
+
+    def build_decision_root(name):
+        agg = Aggregator(
+            name,
+            checkpoint_dir=root_dir,
+            history=HistoryConfig(cut_every_s=float("inf")),
+        )
+        for tid, fac in tenants.items():
+            agg.register_tenant(tid, fac)
+        engine = DecisionEngine(agg, list(_experiments()))
+        return agg, engine
+
+    decision_root, engine = build_decision_root("decision-root")
+    delivered = set()  # (client_id, interval) accepted into the tree
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                continue  # framing mangled: refused before routing
+            cid = str(header["client"])
+            try:
+                fleet.router.route(cid).ingest(blob)  # router consulted PER SHIP
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+            else:
+                delivered.add((cid, int(header["watermark"][1])))
+
+    # ---- the loadgen stream through the elastic tree --------------------
+    forwarded = []  # per interval: the tree-root -> decision-root payloads
+    restored = False
+    joined = kill_victim = None
+    with warnings.catch_warnings():
+        # the true experiment's one-shot DECIDED warn is the point, not noise
+        warnings.filterwarnings("ignore", message=".*DECIDED.*")
+        for interval in range(N_INTERVALS):
+            for cid in sorted(snapshots):
+                _, now_blobs = chaos.plan(snapshots[cid][1][interval])
+                deliver(now_blobs)
+            deliver(chaos.end_round())
+            if interval == 0:  # elastic churn arc: JOIN under live traffic
+                fleet.pump()
+                joined = faults.join_node(fleet)
+                assert joined.name in fleet.router.members()
+            if interval == 2:  # intermediate HARD-KILL + supervised heal
+                fleet.pump()
+                kill_victim = chaos.choice(tree.levels[1])
+                faults.kill_node(kill_victim)
+                assert "dead_node" in {f["kind"] for f in supervisor.check()["findings"]}
+                actions = supervisor.heal()
+                assert any(
+                    a["action"] == "rebuild_node" and a["node"] == kill_victim.name
+                    for a in actions
+                )
+                deliver(chaos.flush())
+            fleet.pump(rounds=3)
+            # forward the tree root's merged cumulative state to the
+            # history-armed decision root, one payload per tenant
+            tree.root.aggregator.flush()
+            ships = [
+                encode_state(
+                    tree.root.aggregator.collection(tid),
+                    tenant=tid,
+                    client_id="tree-root",
+                    watermark=(0, interval),
+                )
+                for tid in sorted(tenants)
+            ]
+            forwarded.append(ships)
+            for blob in ships:
+                decision_root.ingest(blob)
+            decision_root.flush()
+            decision_root.history.cut(decision_root, now=float(interval))
+            if interval == KILL_AFTER:
+                # checkpoint, then SIGKILL-sim: drop the decision root with
+                # no drain; a fresh root + engine restores (attach-before-
+                # restore) and keeps deciding from the durable p-value
+                decision_root.save()
+                decision_root, engine = build_decision_root("decision-root-revived")
+                decision_root.restore()
+                restored = True
+                assert engine.report(EXP_TRUE)["verdict"] == "continue", (
+                    "min_samples must hold the verdict until after the restore"
+                )
+    assert restored and joined is not None and kill_victim is not None
+
+    # ---- exactly one ship, fired AFTER the kill+restore ------------------
+    rec = engine.report(EXP_TRUE)
+    assert rec["verdict"] == "ship", rec
+    assert rec["decision"]["cut"]["control"] > KILL_AFTER, (
+        "the decision must postdate the restore — otherwise this run never"
+        " exercised post-restore continuation"
+    )
+    assert obs.get_counter("experiment.decisions", exp=EXP_TRUE, verdict="ship") == 1
+    assert obs.get_gauge("experiment.active", exp=EXP_TRUE) == 0.0
+    null_rec = engine.report(EXP_NULL)
+    assert null_rec["verdict"] == "continue", null_rec
+    assert null_rec["evaluations"] >= 1
+    assert obs.get_counter("experiment.decisions", exp=EXP_NULL, verdict="ship") == 0
+    assert obs.get_counter("experiment.decisions", exp=EXP_NULL, verdict="stop") == 0
+
+    # ---- kill-resume bitwise: an uninterrupted reference run -------------
+    ref_dir = tempfile.mkdtemp(prefix="experiment_smoke_ref_")
+    ref = Aggregator("reference-root", checkpoint_dir=ref_dir,
+                     history=HistoryConfig(cut_every_s=float("inf")))
+    for tid, fac in tenants.items():
+        ref.register_tenant(tid, fac)
+    ref_engine = DecisionEngine(ref, list(_experiments()))
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=".*DECIDED.*")
+        for interval, ships in enumerate(forwarded):
+            for blob in ships:
+                ref.ingest(blob)
+            ref.flush()
+            ref.history.cut(ref, now=float(interval))
+    assert json.dumps(engine.state_for_checkpoint(), sort_keys=True) == json.dumps(
+        ref_engine.state_for_checkpoint(), sort_keys=True
+    ), "kill-resume must be invisible to the decision records, bitwise"
+
+    # ---- LLM tenant: bitwise flat oracle at tree root AND decision root --
+    accepted = {}
+    for cid, interval in delivered:
+        if snapshots[cid][0] == LLM_TENANT and (cid not in accepted or interval > accepted[cid]):
+            accepted[cid] = interval
+    assert len(accepted) > 0.8 * N_LLM_CLIENTS  # 10% chaos cannot eat the fleet
+    flat = Aggregator("flat-oracle")
+    flat.register_tenant(LLM_TENANT, _llm_factory)
+    for cid, interval in sorted(accepted.items()):
+        flat.ingest(snapshots[cid][1][interval])
+    flat.flush()
+    flat_tenant = flat._tenant(LLM_TENANT)
+    if flat_tenant.merged_leaves is None:
+        flat_tenant.fold()
+    for label, agg in (("tree root", tree.root.aggregator), ("decision root", decision_root)):
+        t = agg._tenant(LLM_TENANT)
+        if t.merged_leaves is None:
+            t.fold()
+        assert t.spec == flat_tenant.spec
+        for (path, _), ours, oracle in zip(t.spec, t.merged_leaves, flat_tenant.merged_leaves):
+            assert np.array_equal(np.asarray(ours), np.asarray(oracle)), (
+                f"{label} LLM leaf {'/'.join(path)} differs from the"
+                " accepted-snapshot oracle after elastic churn + kill-resume"
+            )
+    view = decision_root.collection(LLM_TENANT)
+    assert float(view["ppl"].compute()) > 1.0
+    hit, mrr, ndcg = (float(x) for x in view["rag"].compute())
+    assert 0.0 <= mrr <= 1.0 and 0.0 <= ndcg <= 1.0 and 0.0 <= hit <= 1.0
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    n_clients = 4 * N_PER_ARM + N_LLM_CLIENTS
+    print(
+        f"experiment smoke: {n_clients} clients x {N_INTERVALS} intervals at 10% wire"
+        f" faults ({faults_injected} injected) through join({joined.name}) +"
+        f" hard-kill({kill_victim.name}) + heal, decision root kill+restore @"
+        f" t={KILL_AFTER} — one post-restore SHIP (p={rec['decision']['p_value']:.3g}),"
+        f" null continue (p={null_rec['p_value']:.3g}), records bitwise vs the"
+        " uninterrupted reference, LLM root states bitwise vs the flat oracle",
+        flush=True,
+    )
+    print("experiment smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""StreamingPerplexity: exact sums, masks, bits-per-byte, monoid merge."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.llm import StreamingPerplexity
+
+
+def _ref_ppl(log_probs: np.ndarray) -> float:
+    return float(np.exp(-np.mean(np.asarray(log_probs, dtype=np.float64))))
+
+
+class TestValues:
+    def test_matches_reference_on_random_stream(self):
+        rng = np.random.default_rng(0)
+        lp = np.log(rng.uniform(0.05, 1.0, 4096)).astype(np.float32)
+        m = StreamingPerplexity()
+        for i in range(0, lp.size, 1024):
+            m.update(jnp.asarray(lp[i : i + 1024]))
+        assert float(m.compute()) == pytest.approx(_ref_ppl(lp), rel=1e-5)
+
+    def test_uniform_distribution_gives_vocab_size(self):
+        # uniform over V tokens: perplexity == V exactly
+        m = StreamingPerplexity()
+        m.update(jnp.full((256,), -np.log(50.0)))
+        assert float(m.compute()) == pytest.approx(50.0, rel=1e-5)
+
+    def test_mask_excludes_padding(self):
+        lp = jnp.log(jnp.asarray([[0.5, 0.25], [0.5, 1e-9]]))
+        mask = jnp.asarray([[1, 1], [1, 0]])
+        m = StreamingPerplexity()
+        m.update(lp, mask=mask)
+        expected = _ref_ppl(np.log([0.5, 0.25, 0.5]))
+        assert float(m.compute()) == pytest.approx(expected, rel=1e-5)
+
+    def test_nan_before_first_token(self):
+        m = StreamingPerplexity()
+        with pytest.warns(UserWarning, match="compute"):
+            assert np.isnan(float(m.compute()))
+
+    def test_bits_per_byte(self):
+        # 16 tokens at p=1/4 over 8 bytes: -log2 p * 16 / 8 = 4 bits/byte
+        m = StreamingPerplexity()
+        m.update(jnp.full((16,), np.log(0.25)), num_bytes=8)
+        assert float(m.bits_per_byte()) == pytest.approx(4.0, rel=1e-5)
+
+    def test_bits_per_byte_nan_without_bytes(self):
+        m = StreamingPerplexity()
+        m.update(jnp.asarray([-1.0]))
+        assert np.isnan(float(m.bits_per_byte()))
+
+
+class TestContracts:
+    def test_exact_envelope_is_degenerate(self):
+        m = StreamingPerplexity()
+        m.update(jnp.log(jnp.asarray([0.5, 0.25])))
+        lo, hi = m.bounds()
+        assert float(lo) == float(hi) == float(m.compute())
+        assert float(m.error_bound()) == 0.0
+
+    def test_sum_monoid_merge_equals_single_pass(self):
+        rng = np.random.default_rng(1)
+        lp = np.log(rng.uniform(0.1, 1.0, 512)).astype(np.float32)
+        whole = StreamingPerplexity()
+        whole.update(jnp.asarray(lp), num_bytes=100)
+        a, b = StreamingPerplexity(), StreamingPerplexity()
+        a.update(jnp.asarray(lp[:200]), num_bytes=40)
+        b.update(jnp.asarray(lp[200:]), num_bytes=60)
+        merged_sum = float(a.log_prob_sum) + float(b.log_prob_sum)
+        merged_count = float(a.token_count) + float(b.token_count)
+        merged_bytes = float(a.byte_count) + float(b.byte_count)
+        assert merged_sum == pytest.approx(float(whole.log_prob_sum), rel=1e-6)
+        assert merged_count == float(whole.token_count)
+        assert merged_bytes == float(whole.byte_count)
+
+    def test_update_is_jittable_carry(self):
+        """The state folds under jit with fixed shapes (scan-carry safety)."""
+        m = StreamingPerplexity()
+
+        @jax.jit
+        def fold(state, lp):
+            return {
+                "log_prob_sum": state["log_prob_sum"] + lp.sum(),
+                "token_count": state["token_count"] + float(lp.size),
+            }
+
+        state = {"log_prob_sum": m.log_prob_sum, "token_count": m.token_count}
+        lp = jnp.log(jnp.asarray([0.5, 0.25, 0.5, 0.25]))
+        state = fold(state, lp)
+        m.log_prob_sum, m.token_count = state["log_prob_sum"], state["token_count"]
+        assert float(m.compute()) == pytest.approx(2.8284, abs=1e-3)

@@ -1,0 +1,85 @@
+"""StreamingTokenF1 / StreamingExactMatch: SQuAD-convention scoring."""
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text.squad import _exact_match_score, _f1_score
+from metrics_tpu.llm import StreamingExactMatch, StreamingTokenF1
+
+
+class TestTokenF1:
+    def test_matches_squad_helper_per_example(self):
+        cases = [
+            ("the cat sat on the mat", "a cat sat on a mat"),
+            ("Paris", "paris."),
+            ("completely wrong", "the right answer"),
+            ("", "anything"),
+        ]
+        m = StreamingTokenF1()
+        for pred, gold in cases:
+            m.update([pred], [gold])
+        expected = np.mean([_f1_score(p, g) for p, g in cases])
+        assert float(m.compute()) == pytest.approx(float(expected), rel=1e-6)
+
+    def test_max_over_ground_truths(self):
+        # SQuAD convention: a question with several gold answers scores
+        # the best overlap, not the first
+        m = StreamingTokenF1()
+        m.update(["the cat"], [["a dog", "the cat", "unrelated"]])
+        assert float(m.compute()) == pytest.approx(1.0)
+
+    def test_normalization_strips_articles_and_case(self):
+        m = StreamingTokenF1()
+        m.update(["The Cat!"], ["a cat"])
+        assert float(m.compute()) == pytest.approx(1.0)
+
+
+class TestExactMatch:
+    def test_matches_squad_helper(self):
+        cases = [("An Answer!", "an answer"), ("near miss", "nearmiss")]
+        m = StreamingExactMatch()
+        for pred, gold in cases:
+            m.update([pred], [gold])
+        expected = np.mean([_exact_match_score(p, g) for p, g in cases])
+        assert float(m.compute()) == pytest.approx(float(expected))
+
+    def test_scalar_string_inputs(self):
+        m = StreamingExactMatch()
+        m.update("Paris", "paris")
+        assert float(m.compute()) == 1.0
+
+
+class TestContracts:
+    def test_mismatched_lengths_raise(self):
+        m = StreamingTokenF1()
+        with pytest.raises(ValueError, match="2 predictions but 1 target"):
+            m.update(["a", "b"], ["a"])
+
+    def test_empty_target_group_raises(self):
+        m = StreamingTokenF1()
+        with pytest.raises(ValueError, match="group 0 is empty"):
+            m.update(["a"], [[]])
+
+    def test_nan_before_first_question(self):
+        m = StreamingTokenF1()
+        with pytest.warns(UserWarning, match="compute"):
+            assert np.isnan(float(m.compute()))
+
+    def test_exact_envelope_is_degenerate(self):
+        m = StreamingExactMatch()
+        m.update(["x"], ["x"])
+        lo, hi = m.bounds()
+        assert float(lo) == float(hi) == 1.0
+        assert float(m.error_bound()) == 0.0
+
+    def test_sum_monoid_merge_equals_single_pass(self):
+        preds = ["the cat sat", "paris", "wrong entirely", "an answer"]
+        golds = [["a cat sat"], ["Paris"], ["right"], ["answer"]]
+        whole = StreamingTokenF1()
+        whole.update(preds, golds)
+        a, b = StreamingTokenF1(), StreamingTokenF1()
+        a.update(preds[:2], golds[:2])
+        b.update(preds[2:], golds[2:])
+        merged = (float(a.score_sum) + float(b.score_sum)) / (
+            float(a.count) + float(b.count)
+        )
+        assert merged == pytest.approx(float(whole.compute()), rel=1e-6)

@@ -1,0 +1,121 @@
+"""StreamingRAGQuality: hit/MRR/NDCG @k, dense/ragged parity, envelopes."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.llm import StreamingRAGQuality
+
+
+def _ref_hit_mrr(scores: np.ndarray, target: np.ndarray, k: int):
+    order = np.argsort(-scores, kind="stable")
+    topk = target[order[:k]] > 0
+    hit = float(topk.any())
+    rr = 1.0 / (int(np.argmax(topk)) + 1) if topk.any() else 0.0
+    return hit, rr
+
+
+class TestValues:
+    def test_docstring_pin(self):
+        m = StreamingRAGQuality(k=2)
+        m.update(
+            jnp.asarray([0.9, 0.3, 0.1, 0.8, 0.6, 0.2]),
+            jnp.asarray([1, 0, 0, 0, 1, 0]),
+            jnp.asarray([0, 0, 0, 1, 1, 1]),
+        )
+        got = [float(x) for x in m.compute()]
+        assert got == pytest.approx([1.0, 0.75, 0.8154648542404175], rel=1e-6)
+
+    def test_hit_and_mrr_match_reference(self):
+        rng = np.random.default_rng(7)
+        n_queries, n_docs, k = 8, 16, 5
+        scores = rng.permutation(n_queries * n_docs).astype(np.float32)
+        target = (rng.uniform(size=n_queries * n_docs) < 0.2).astype(np.int32)
+        indexes = np.repeat(np.arange(n_queries), n_docs)
+        m = StreamingRAGQuality(k=k)
+        m.update(jnp.asarray(scores), jnp.asarray(target), jnp.asarray(indexes))
+        refs = [
+            _ref_hit_mrr(scores[q * n_docs : (q + 1) * n_docs],
+                         target[q * n_docs : (q + 1) * n_docs], k)
+            for q in range(n_queries)
+        ]
+        hit, mrr, _ = (float(x) for x in m.compute())
+        assert hit == pytest.approx(np.mean([r[0] for r in refs]), rel=1e-6)
+        assert mrr == pytest.approx(np.mean([r[1] for r in refs]), rel=1e-6)
+
+    def test_dense_and_ragged_paths_agree(self):
+        rng = np.random.default_rng(11)
+        n_queries, n_docs = 6, 12
+        scores = rng.permutation(n_queries * n_docs).astype(np.float32)
+        target = (rng.uniform(size=n_queries * n_docs) < 0.3).astype(np.int32)
+        indexes = np.repeat(np.arange(n_queries), n_docs)
+        dense = StreamingRAGQuality(k=4)
+        dense.update(jnp.asarray(scores), jnp.asarray(target), jnp.asarray(indexes))
+        # same documents in shuffled order: groups no longer contiguous,
+        # so the segment fallback scores them
+        perm = rng.permutation(scores.size)
+        ragged = StreamingRAGQuality(k=4)
+        ragged.update(
+            jnp.asarray(scores[perm]),
+            jnp.asarray(target[perm]),
+            jnp.asarray(indexes[perm]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(dense.compute()), np.asarray(ragged.compute()), rtol=1e-6
+        )
+
+    def test_nan_before_first_query(self):
+        m = StreamingRAGQuality(k=3)
+        with pytest.warns(UserWarning, match="compute"):
+            assert np.all(np.isnan(np.asarray(m.compute())))
+
+
+class TestContracts:
+    def test_k_validation(self):
+        with pytest.raises(ValueError, match="`k` must be >= 1"):
+            StreamingRAGQuality(k=0)
+
+    def test_means_exact_envelope(self):
+        m = StreamingRAGQuality(k=2)
+        m.update(
+            jnp.asarray([0.9, 0.3, 0.1]),
+            jnp.asarray([1, 0, 0]),
+            jnp.asarray([0, 0, 0]),
+        )
+        lo, hi = m.bounds()
+        np.testing.assert_array_equal(np.asarray(lo), np.asarray(hi))
+        np.testing.assert_array_equal(np.asarray(m.error_bound()), 0.0)
+
+    def test_ndcg_quantile_bounds_bracket_exact(self):
+        # 4 perfect queries (ndcg 1.0) and 4 at the doctest's second-query
+        # value: the upper median is known exactly
+        perfect = ([0.9, 0.3, 0.1], [1, 0, 0])
+        partial = ([0.8, 0.6, 0.2], [0, 1, 0])
+        m = StreamingRAGQuality(k=2, num_bins=256)
+        for qid in range(8):
+            s, t = perfect if qid < 4 else partial
+            m.update(jnp.asarray(s), jnp.asarray(t), jnp.full((3,), qid))
+        exact = 2.0 * 0.8154648542404175 - 1.0  # partial query's ndcg@2
+        lo, hi = (float(np.asarray(x).reshape(())) for x in m.ndcg_quantile_bounds(0.25))
+        mid = float(np.asarray(m.ndcg_quantile(0.25)).reshape(()))
+        # float32 bin edges: the exact value can sit on a boundary
+        assert lo - 1e-6 <= exact <= hi + 1e-6
+        assert lo <= mid <= hi
+        assert hi - lo <= 2.0 / 256 + 1e-6
+
+    def test_sum_monoid_merge_equals_single_pass(self):
+        rng = np.random.default_rng(3)
+        n_queries, n_docs = 10, 8
+        scores = rng.permutation(n_queries * n_docs).astype(np.float32)
+        target = (rng.uniform(size=n_queries * n_docs) < 0.25).astype(np.int32)
+        indexes = np.repeat(np.arange(n_queries), n_docs)
+        whole = StreamingRAGQuality(k=3)
+        whole.update(jnp.asarray(scores), jnp.asarray(target), jnp.asarray(indexes))
+        cut = 5 * n_docs
+        a, b = StreamingRAGQuality(k=3), StreamingRAGQuality(k=3)
+        a.update(jnp.asarray(scores[:cut]), jnp.asarray(target[:cut]),
+                 jnp.asarray(indexes[:cut]))
+        b.update(jnp.asarray(scores[cut:]), jnp.asarray(target[cut:]),
+                 jnp.asarray(indexes[cut:]))
+        for leaf in ("hit_sum", "mrr_sum", "ndcg_sum", "query_count"):
+            merged = float(getattr(a, leaf)) + float(getattr(b, leaf))
+            assert merged == pytest.approx(float(getattr(whole, leaf)), rel=1e-6)

@@ -27,7 +27,7 @@ from metrics_tpu.serve.history import (
     merge_delta_leaves,
 )
 from metrics_tpu.serve.wire import encode_state
-from metrics_tpu.streaming import StreamingAUROC
+from metrics_tpu.streaming import StreamingAUROC, StreamingTopK
 
 TENANT = "hist"
 N_CLIENTS = 3
@@ -397,3 +397,74 @@ class TestDisabledModeStaysFree:
             ])
         with pytest.raises(ValueError, match="above=/below="):
             AlertRule("r", TENANT, "seen")
+
+
+class TestTopKChurnExposure:
+    """`/query?mode=delta` enriches StreamingTopK members with certified
+    top-k churn between the interval's baseline and head snapshots."""
+
+    IDS = {0: [7] * 10 + [9] * 8 + [3], 1: [7] * 2 + [3] * 20}
+
+    def _build(self, fac):
+        agg = Aggregator("hist-churn", history=manual_history())
+        agg.register_tenant(TENANT, fac)
+        for interval in range(2):
+            for c in range(N_CLIENTS):
+                coll = fac()
+                for k in range(interval + 1):
+                    coll["hot"].update(jnp.asarray(self.IDS[k], dtype=jnp.int32))
+                    coll["seen"].update(jnp.asarray(1.0))
+                agg.ingest(encode_state(
+                    coll, tenant=TENANT, client_id=f"c{c}", watermark=(0, interval)))
+            agg.flush()
+            agg.history.cut(agg, now=float(interval))
+        return agg
+
+    def test_delta_answer_carries_certified_churn(self):
+        def fac():
+            return MetricCollection({
+                "hot": StreamingTopK(k=2, capacity=64, id_bits=16),
+                "seen": SumMetric(),
+            })
+
+        agg = self._build(fac)
+        out = agg.history_query(TENANT, 0.0, 1.0, mode="delta")
+        (entry,) = out["intervals"]
+        assert entry["values"]["hot"]["churn"] == {
+            "entered": [3],
+            "exited": [9],
+            "stayed": [7],
+        }
+        # non-topk members are untouched by the enrichment
+        assert "churn" not in entry["values"]["seen"]
+
+    def test_ambiguous_member_refuses_alone(self):
+        def fac():
+            return MetricCollection({
+                "hot": StreamingTopK(k=2, capacity=4, depth=1, id_bits=16),
+                "seen": SumMetric(),
+            })
+
+        agg = Aggregator("hist-churn-sat", history=manual_history())
+        agg.register_tenant(TENANT, fac)
+        rng = np.random.default_rng(0)
+        for interval in range(2):
+            for c in range(N_CLIENTS):
+                coll = fac()
+                client_rng = np.random.default_rng(100 * c)
+                for _ in range(interval + 1):
+                    coll["hot"].update(jnp.asarray(
+                        client_rng.integers(0, 5000, 2048), dtype=jnp.int32))
+                    coll["seen"].update(jnp.asarray(1.0))
+                agg.ingest(encode_state(
+                    coll, tenant=TENANT, client_id=f"c{c}", watermark=(0, interval)))
+            agg.flush()
+            agg.history.cut(agg, now=float(interval))
+        _ = rng
+        out = agg.history_query(TENANT, 0.0, 1.0, mode="delta")
+        (entry,) = out["intervals"]
+        # the saturated member refuses loudly; the range answer (and the
+        # exact sum member) still arrive
+        assert "ambiguous" in entry["values"]["hot"]["churn_undefined"]
+        assert "churn" not in entry["values"]["hot"]
+        assert entry["values"]["seen"]["value"] is not None

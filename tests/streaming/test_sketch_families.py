@@ -23,6 +23,7 @@ import numpy as np
 import pytest
 
 from metrics_tpu.streaming import (
+    ChurnUndefinedError,
     CoOccurrenceSketch,
     DistinctCountSketch,
     HeavyHitterSketch,
@@ -374,6 +375,43 @@ class TestStreamingMetrics:
         for i in range(20):
             truth = exact[(int(t[i]), int(p[i]))]
             assert float(lo[i]) - 1e-6 <= truth <= float(hi[i]) + 1e-6
+
+    def test_certified_topk_and_churn(self):
+        # interval a: {7, 9} dominate; interval b: 3 overtakes 9
+        a = StreamingTopK(k=2, capacity=64, id_bits=16)
+        a.update(jnp.asarray([7] * 10 + [9] * 8 + [3] * 1))
+        b = StreamingTopK(k=2, capacity=64, id_bits=16)
+        b.update(jnp.asarray([7] * 12 + [9] * 8 + [3] * 20))
+        assert sorted(int(i) for i in a.certified_topk()) == [7, 9]
+        assert StreamingTopK.churn(a, b) == {
+            "entered": [3],
+            "exited": [9],
+            "stayed": [7],
+        }
+
+    def test_churn_never_evicted_is_exact(self):
+        # fewer distinct ids than capacity: membership is exact even
+        # though the (k+1)-th slot is empty
+        a = StreamingTopK(k=3, capacity=64, id_bits=16)
+        a.update(jnp.asarray([1, 1, 2]))
+        assert sorted(int(i) for i in a.certified_topk()) == [1, 2]
+
+    def test_churn_refuses_ambiguous_membership(self):
+        # a saturated width-1 sketch: evictions inflate overestimates
+        # until the k-th lower bound cannot clear the (k+1)-th upper
+        rng = np.random.default_rng(0)
+        m = StreamingTopK(k=2, capacity=4, depth=1, id_bits=16)
+        m.update(jnp.asarray(rng.integers(0, 5000, 4096)))
+        with pytest.raises(ChurnUndefinedError, match="ambiguous"):
+            m.certified_topk()
+
+    def test_churn_validates_operands(self):
+        a = StreamingTopK(k=2, capacity=64, id_bits=16)
+        b = StreamingTopK(k=3, capacity=64, id_bits=16)
+        with pytest.raises(ValueError, match="matching k"):
+            a.churn(b)
+        with pytest.raises(ValueError, match="two StreamingTopK"):
+            a.churn("not a metric")
 
     def test_metric_reset_and_weighted_update(self, stream):
         m = StreamingTopK(k=3, capacity=64, id_bits=16)
