@@ -939,6 +939,108 @@ def bench_llm_experiment(n_queries: int = 10_000, docs: int = 100) -> dict:
     return out
 
 
+def bench_slo(n_tenants: int = 8, n_clients: int = 32, n_rounds: int = 24) -> dict:
+    """Tenant-facing SLO plane: the two costs the tier adds to a root.
+
+    - ``slo_eval_p99_ms`` — p99 wall time of one
+      :meth:`~metrics_tpu.obs.slo.SLOEngine.evaluate_all` across
+      ``n_tenants`` tenants with live ingest/freshness/canary budgets
+      (registry reads + window differencing + burn-rate rules): the
+      per-cut tax every armed SLO adds to the root's cut path.
+    - ``meter_overhead_pct`` — percent of UNARMED ingest throughput
+      retained with obs (metering + SLO counters) armed on the ingest
+      hot path, i.e. ``100 * unarmed_wall / armed_wall``: 100 means zero
+      overhead, lower means the armed tax grew — the ``%`` convention
+      gates it inverted (higher is better) like the prefetch-overlap
+      row. The ``slo_smoke`` CI step pins the tier's alert/canary
+      semantics bitwise; these rows only time it.
+    """
+    import time as _time
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import obs
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.obs.prober import CanaryProber
+    from metrics_tpu.obs.slo import SLOEngine
+    from metrics_tpu.serve import Aggregator, HistoryConfig
+    from metrics_tpu.serve.wire import encode_state
+    from metrics_tpu.streaming import StreamingQuantile
+
+    def factory():
+        return MetricCollection(
+            {"seen": SumMetric(), "lat": StreamingQuantile(num_bins=64, lo=0.0, hi=1.0)}
+        )
+
+    out: dict = {}
+    was_enabled = obs.enabled()
+    rng = np.random.default_rng(23)
+    try:
+        obs.enable()
+        agg = Aggregator("bench-slo", history=HistoryConfig(cut_every_s=float("inf")))
+        tenants = [f"t{i:02d}" for i in range(n_tenants)]
+        for tid in tenants:
+            agg.register_tenant(tid, factory)
+        prober = CanaryProber(agg)
+        for tid in tenants:
+            for c in range(n_clients):
+                coll = factory()
+                coll["seen"].update(jnp.asarray(1.0))
+                coll["lat"].update(jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32)))
+                agg.ingest(
+                    encode_state(coll, tenant=tid, client_id=f"{tid}:c{c:03d}", watermark=(0, 0))
+                )
+        prober.probe()
+        agg.flush()
+        engine = SLOEngine(agg)  # default ingest/freshness/query/canary slos
+        agg.history.cut(agg, now=0.0)  # warms the budget table untimed
+        eval_ms = []
+        for i in range(200):
+            t0 = _time.perf_counter()
+            engine.evaluate_all(now=float(i + 1))
+            eval_ms.append((_time.perf_counter() - t0) * 1000.0)
+        out["slo_eval_p99_ms"] = float(np.percentile(eval_ms, 99))
+
+        # metering tax: identical pre-encoded cumulative streams through
+        # two fresh roots, obs armed vs disarmed; one round warms each
+        # (compile + dedup-journal setup) before the timed remainder
+        streams = []
+        for c in range(n_clients):
+            coll = factory()
+            blobs = []
+            for r in range(n_rounds):
+                coll["seen"].update(jnp.asarray(1.0))
+                coll["lat"].update(jnp.asarray(rng.uniform(0, 1, 64).astype(np.float32)))
+                blobs.append(
+                    encode_state(coll, tenant="t00", client_id=f"m:c{c:03d}", watermark=(0, r))
+                )
+            streams.append(blobs)
+
+        def run_mode(armed: bool) -> float:
+            obs.enable(armed)
+            root = Aggregator(f"bench-meter-{'armed' if armed else 'unarmed'}")
+            root.register_tenant("t00", factory)
+            for blobs in streams:  # warm round, untimed
+                root.ingest(blobs[0])
+            root.flush()
+            t0 = _time.perf_counter()
+            for r in range(1, n_rounds):
+                for blobs in streams:
+                    root.ingest(blobs[r])
+                root.flush()
+            return _time.perf_counter() - t0
+
+        unarmed_s = run_mode(False)
+        armed_s = run_mode(True)
+        out["meter_overhead_pct"] = 100.0 * unarmed_s / armed_s
+    finally:
+        obs.enable(was_enabled)
+    return out
+
+
 def bench_aot() -> dict:
     """Cold-vs-warm first fold: the execution-engine acceptance rows.
 
@@ -1773,6 +1875,30 @@ def main(
             )
     except Exception as err:  # noqa: BLE001 — llm rows must not kill the sweep
         print(f"SKIPPED llm/experiment rows: {err}", file=sys.stderr)
+
+    # tenant-facing SLO plane (round 20): the per-cut budget-evaluation
+    # tax and the metering tax on the ingest hot path — the slo_smoke CI
+    # step pins the tier's alert/canary/bitwise semantics, these rows
+    # only time it (TPU sweep supplies acceptance values). The overhead
+    # row is retained-throughput percent: 100 = zero armed overhead,
+    # and the "%" unit gates it inverted (lower = regression)
+    try:
+        slo_rows = section(bench_slo)
+        emit(
+            "slo_eval_p99_ms",
+            slo_rows["slo_eval_p99_ms"],
+            prior.get("slo_eval_p99_ms", slo_rows["slo_eval_p99_ms"]),
+            baseline="best_prior_self",
+        )
+        emit(
+            "meter_overhead_pct",
+            slo_rows["meter_overhead_pct"],
+            prior.get("meter_overhead_pct", slo_rows["meter_overhead_pct"]),
+            baseline="best_prior_self",
+            unit="%",
+        )
+    except Exception as err:  # noqa: BLE001 — slo rows must not kill the sweep
+        print(f"SKIPPED slo rows: {err}", file=sys.stderr)
 
     # headline LAST (the driver's tail-line parse keeps its round-1 meaning)
     emit("accuracy_1M_update_compute_wallclock", section(bench_accuracy_tpu), base_accuracy())

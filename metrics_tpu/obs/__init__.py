@@ -79,6 +79,8 @@ from metrics_tpu.obs.federation import (
     wire_snapshots,
 )
 from metrics_tpu.obs.health import HealthMonitor
+from metrics_tpu.obs.meter import tenant_id_hash, top_consumers
+from metrics_tpu.obs.prober import CANARY_TENANT, CanaryProber, canary_metrics
 from metrics_tpu.obs.profile import instrument, profile, record_cost_analysis, time_launch
 from metrics_tpu.obs.recompile import (
     compile_listener_installed,
@@ -109,16 +111,24 @@ from metrics_tpu.obs.registry import (
     spans,
     sum_counter,
 )
+from metrics_tpu.obs.slo import ErrorBudget, SLODef, SLOEngine, default_slos
 from metrics_tpu.obs.tracing import pytree_nbytes, trace_span
 
 __all__ = [
+    "CANARY_TENANT",
+    "CanaryProber",
+    "ErrorBudget",
     "HISTOGRAM_EDGES",
     "HealthMonitor",
     "HistogramSnapshot",
+    "SLODef",
+    "SLOEngine",
     "accept_snapshot",
+    "canary_metrics",
     "compile_listener_installed",
     "configure",
     "counters",
+    "default_slos",
     "enable",
     "enabled",
     "family_help",
@@ -150,10 +160,12 @@ __all__ = [
     "snapshot",
     "spans",
     "sum_counter",
+    "tenant_id_hash",
     "time_launch",
     "to_chrome_trace",
     "to_json",
     "to_prometheus",
+    "top_consumers",
     "trace_span",
     "track_compiles",
     "wire_snapshots",
@@ -166,10 +178,24 @@ def reset() -> None:
     flag, config and node identity survive — this separates measurement
     windows, it doesn't disarm the layer). Clearing the trace/federation
     state here is what keeps back-to-back bench rounds and tests from
-    bleeding fleet state into each other."""
+    bleeding fleet state into each other.
+
+    The SLO plane's satellites clear too: the metering sketch/pending map,
+    every live :class:`~metrics_tpu.obs.slo.SLOEngine`'s budget table, and
+    every live :class:`~metrics_tpu.obs.prober.CanaryProber`'s verdict
+    tallies — via ``sys.modules`` so importing :mod:`metrics_tpu.obs`
+    never drags in the serving tier those modules touch."""
+    import sys
+
     from metrics_tpu.obs import federation as _federation
+    from metrics_tpu.obs import meter as _meter
     from metrics_tpu.obs import recompile as _recompile
 
     _registry.reset()
     _federation.reset()
     _recompile.reset_storm_warnings()
+    _meter.reset()
+    for modname in ("metrics_tpu.obs.slo", "metrics_tpu.obs.prober"):
+        mod = sys.modules.get(modname)
+        if mod is not None:
+            mod.reset()

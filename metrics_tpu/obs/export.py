@@ -329,6 +329,27 @@ _FAMILY_HELP: Dict[str, str] = {
     "experiment.fenced_evaluations": "Evaluations skipped across failover generations",
     "experiment.queries": "GET /experiment/<id> reports answered",
     "experiment.active": "Experiment still collecting (1) or decided (0)",
+    # tenant-facing SLO plane (metrics_tpu.obs.slo)
+    "slo.evaluations": "SLO evaluations at history cuts, by slo",
+    "slo.alerts": "Edge-triggered burn-rate alert firings, by tenant and slo",
+    "slo.alert_active": "Burn-rate alert currently firing (1) or clear (0)",
+    "slo.burn_rate": "Error-budget burn rate over the fast/slow window",
+    "slo.budget_remaining": "Fraction of the error budget left this period",
+    "slo.sli": "Good-fraction SLI over the fast window, by tenant and slo",
+    "slo.fenced_evaluations": "Budget baselines rebased across failover generations",
+    "slo.ingest_errors": "Failed tenant ingests, by reason (accept/backpressure/shed/wire)",
+    "slo.queries": "GET /slo reports answered",
+    # per-tenant usage metering (metrics_tpu.obs.meter)
+    "meter.wire_bytes": "Wire payload bytes decoded, by tenant",
+    "meter.queue_ms": "Ingest-to-accept queue residency, by tenant",
+    "meter.fold_ms": "Fold wall time attributed to the tenant",
+    "meter.state_bytes": "Resident client + merged state bytes, by tenant",
+    "meter.history_bytes": "Retention-ring bytes held for the tenant",
+    # synthetic canary probes (metrics_tpu.obs.prober)
+    "probe.probes": "Canary probe round trips completed, by node",
+    "probe.results": "Canary verdicts, by node (match/mismatch/pending)",
+    "probe.round_trip_ms": "Canary ship-to-verified round-trip latency",
+    "probe.healthy": "Canary bitwise-correct so far (1) or mismatched (0)",
 }
 
 

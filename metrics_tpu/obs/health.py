@@ -69,6 +69,14 @@ these are the registry-only verdicts):
   freshly cut retention-ring interval) is currently firing. Current
   state, not the cumulative ``history.alerts`` counter: a metric that
   recovers stops firing here.
+* ``slo_burn`` — an ``slo.alert_active`` gauge is nonzero: some tenant's
+  dual-window burn rate (:class:`metrics_tpu.obs.slo.SLOEngine`) is
+  currently over its page thresholds. Current state, not the cumulative
+  ``slo.alerts`` counter: a tenant whose burn clears stops firing.
+* ``canary_mismatch`` — a ``probe.healthy`` gauge reads 0: some node's
+  :class:`~metrics_tpu.obs.prober.CanaryProber` saw a bitwise MISMATCH
+  between a known-answer probe and the node's ``/query`` answer — the
+  one condition here that means answers (not plumbing) are wrong.
 * ``rebalance_stuck`` — a ``serve.rebalance_started_ts`` gauge (stamped
   by :class:`metrics_tpu.serve.elastic.ElasticFleet` for the duration of
   every join/drain/split/merge, cleared on completion; the ``node=``
@@ -136,6 +144,12 @@ class HealthMonitor:
             ``history.alert_active`` gauge is nonzero: a root-evaluated
             metric alert rule is currently firing over the retention
             ring's interval deltas).
+        slo_alert: arm the ``slo_burn`` condition (an ``slo.alert_active``
+            gauge is nonzero: some tenant's error-budget burn rate is
+            currently over its fast+slow page thresholds).
+        canary: arm the ``canary_mismatch`` condition (a ``probe.healthy``
+            gauge reads 0: a node's synthetic canary answer diverged
+            bitwise from its local oracle).
         federated: read every condition off the federated fleet view
             (local registry merged with the piggybacked per-node
             snapshots) instead of local registry state — the root-of-tree
@@ -168,6 +182,8 @@ class HealthMonitor:
         partition_detected: bool = False,
         fenced_zombie: bool = False,
         history_alert: bool = False,
+        slo_alert: bool = False,
+        canary: bool = False,
         federated: bool = False,
         node_staleness_s: Optional[float] = None,
         name: str = "default",
@@ -186,6 +202,8 @@ class HealthMonitor:
         self.partition_detected = bool(partition_detected)
         self.fenced_zombie = bool(fenced_zombie)
         self.history_alert = bool(history_alert)
+        self.slo_alert = bool(slo_alert)
+        self.canary = bool(canary)
         self.federated = bool(federated)
         self.node_staleness_s = node_staleness_s
         self.name = str(name)
@@ -478,6 +496,48 @@ class HealthMonitor:
             )
         return None
 
+    def _check_slo_burn(self) -> Optional[str]:
+        if not self.slo_alert:
+            return None
+        # one series per firing (tenant, slo) — edge-driven by SLOEngine
+        # (1 on clear→firing, 0 on recovery), so this reads CURRENT alert
+        # state, not the cumulative slo.alerts count
+        firing = sorted(
+            key
+            for key, value in self._gauges().items()
+            if (key == "slo.alert_active" or key.startswith("slo.alert_active{"))
+            and value
+        )
+        if firing:
+            return (
+                f"{len(firing)} tenant SLO(s) currently burning error budget"
+                f" past the fast+slow page thresholds (worst: {firing[0]}) —"
+                " the firing edge was warned once and counted under"
+                " slo.alerts{tenant=,slo=}; see GET /slo for budgets"
+            )
+        return None
+
+    def _check_canary_mismatch(self) -> Optional[str]:
+        if not self.canary:
+            return None
+        # probe.healthy is 1 while every verdict matched bitwise, 0 from
+        # the first mismatch on — only nodes running a prober export it,
+        # so an exact-zero read IS a mismatch, never an idle default
+        mismatched = sorted(
+            key
+            for key, value in self._gauges().items()
+            if (key == "probe.healthy" or key.startswith("probe.healthy{"))
+            and value == 0.0
+        )
+        if mismatched:
+            return (
+                f"{len(mismatched)} node(s) with a canary MISMATCH"
+                f" (worst: {mismatched[0]}) — a known-answer probe's /query"
+                " answer diverged bitwise from the local oracle: the node is"
+                " serving WRONG answers, not merely slow ones"
+            )
+        return None
+
     def _check_rebalance_stuck(self) -> Optional[str]:
         if self.rebalance_stuck_s is None:
             return None
@@ -532,6 +592,8 @@ class HealthMonitor:
             ("partition_detected", self._check_partition_detected),
             ("fenced_zombie", self._check_fenced_zombie),
             ("history_alert", self._check_history_alert),
+            ("slo_burn", self._check_slo_burn),
+            ("canary_mismatch", self._check_canary_mismatch),
         )
         warnings: List[Dict[str, str]] = []
         with self._check_lock:
@@ -588,6 +650,8 @@ class HealthMonitor:
                 ("peer_staleness_ms", self.peer_staleness_ms),
                 ("partition_detected", self.partition_detected or None),
                 ("fenced_zombie", self.fenced_zombie or None),
+                ("slo_alert", self.slo_alert or None),
+                ("canary", self.canary or None),
                 ("federated", self.federated or None),
                 ("node_staleness_s", self.node_staleness_s),
             )

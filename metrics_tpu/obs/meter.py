@@ -1,0 +1,157 @@
+"""Per-tenant usage metering: bounded top-consumer ranking.
+
+The serving tier's hot path attributes resource consumption per tenant
+through the ordinary registry families — ``meter.wire_bytes{tenant=}``
+(counter), ``meter.queue_ms``/``meter.fold_ms{tenant=}`` (histograms),
+``meter.state_bytes``/``meter.history_bytes{tenant=}`` (gauges). Those
+series are cardinality-guarded by ``max_series_per_family`` and federate
+through :func:`metrics_tpu.obs.export.merge_snapshots` like every other
+family (counters sum, gauges keep node labels, histograms merge
+bucketwise-exact), so the fleet view needs no new machinery.
+
+What a capped registry CANNOT answer is "who are the top consumers" once
+the tenant space outgrows the cap: the guard drops the overflow series,
+exactly as designed. This module keeps the *ranking* exact-enough anyway
+with the in-tree :class:`~metrics_tpu.streaming.heavy.HeavyHitterSketch`
+— every charged byte lands in a fixed-size linear sketch keyed on a
+stable 24-bit hash of the tenant id, so the root ranks millions of
+tenants in O(capacity) memory with a computable overestimate bound.
+
+Cost model (documented in ``docs/observability.md`` §10): the hot path
+pays one dict add per charge (:func:`charge` buffers into a bounded
+pending map); the jitted sketch fold runs only when the pending map
+fills (:data:`PENDING_CAP` distinct tenants — a hostile many-tenant
+flood amortizes one fold per 1024 fresh ids) or when a ranking is
+actually read (:func:`top_consumers`). Unarmed
+(:func:`metrics_tpu.obs.enabled` false) the aggregator never calls in
+here at all — zero cost, the disabled-mode HLO pin stays byte-identical.
+
+:func:`metrics_tpu.obs.reset` clears the sketch, the pending map and the
+id->name table alongside the registry.
+"""
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "charge",
+    "pending_tenants",
+    "reset",
+    "tenant_id_hash",
+    "top_consumers",
+]
+
+# distinct tenants buffered host-side before a fold is forced; also the
+# bound on the id->name table divisor below. Keeps the hot path free of
+# per-payload device dispatch while bounding memory against id floods.
+PENDING_CAP = 1024
+
+# id->name entries retained for rendering (a ranking of hashes alone is
+# useless to an operator). Bounded: a hostile flood evicts names, never
+# grows the table — the sketch itself keeps ranking the hashes exactly
+# as before, rendered as "~<hash>".
+NAME_CAP = 4096
+
+# hash space: HeavyHitterSketch ids must be non-negative < 2**id_bits
+ID_BITS = 24
+
+_lock = threading.Lock()
+_pending: Dict[str, float] = {}
+_names: Dict[int, str] = {}
+_sketch: Optional[Any] = None
+
+
+def tenant_id_hash(tenant: str) -> int:
+    """Stable 24-bit sketch id for a tenant name (blake2b, process- and
+    host-independent so per-node sketches stay monoid-mergeable)."""
+    digest = hashlib.blake2b(str(tenant).encode("utf-8"), digest_size=4).digest()
+    return int.from_bytes(digest, "big") & ((1 << ID_BITS) - 1)
+
+
+def charge(tenant: str, weight: float) -> None:
+    """Attribute ``weight`` (bytes) of consumption to ``tenant``.
+
+    Host-side dict add only; the jitted sketch fold is deferred until the
+    pending map holds :data:`PENDING_CAP` distinct tenants or a ranking
+    is read. Non-positive weights are ignored (nothing to rank)."""
+    w = float(weight)
+    if w <= 0.0:
+        return
+    tenant = str(tenant)
+    with _lock:
+        _pending[tenant] = _pending.get(tenant, 0.0) + w
+        if len(_pending) < PENDING_CAP:
+            return
+        drain = dict(_pending)
+        _pending.clear()
+    _fold_into_sketch(drain)
+
+
+def _fold_into_sketch(drain: Dict[str, float]) -> None:
+    """One batched sketch fold over a drained pending map."""
+    global _sketch
+    if not drain:
+        return
+    import numpy as np
+
+    from metrics_tpu.streaming.heavy import HeavyHitterSketch
+
+    ids = np.asarray([tenant_id_hash(t) for t in sorted(drain)], dtype=np.int32)
+    weights = np.asarray([drain[t] for t in sorted(drain)], dtype=np.float32)
+    with _lock:
+        if _sketch is None:
+            _sketch = HeavyHitterSketch(id_bits=ID_BITS)
+        _sketch = _sketch.fold(ids, weights)
+        for t in drain:
+            h = tenant_id_hash(t)
+            if h in _names or len(_names) < NAME_CAP:
+                _names[h] = t
+
+
+def top_consumers(k: int = 10) -> List[Dict[str, Any]]:
+    """The fleet's top-``k`` consumers by charged bytes: drained pending
+    map folded into the sketch first, so the answer is current. Each row
+    carries the resolved tenant name (or ``~<hash>`` when the bounded
+    name table evicted it), the estimated byte count, and the sketch's
+    overestimate bound — the honesty term a capped ranking owes."""
+    with _lock:
+        drain = dict(_pending)
+        _pending.clear()
+    _fold_into_sketch(drain)
+    with _lock:
+        sketch = _sketch
+        names = dict(_names)
+    if sketch is None or int(sketch.count) == 0:
+        return []
+    import numpy as np
+
+    ids, counts, over = sketch.topk(int(k))
+    rows: List[Dict[str, Any]] = []
+    for tid, count, bound in zip(np.asarray(ids), np.asarray(counts), np.asarray(over)):
+        tid = int(tid)
+        if tid < 0:
+            continue  # empty sketch slot
+        rows.append(
+            {
+                "tenant": names.get(tid, f"~{tid}"),
+                "bytes": float(count),
+                "overestimate": float(bound),
+            }
+        )
+    return rows
+
+
+def pending_tenants() -> int:
+    """Distinct tenants currently buffered host-side (test/debug probe)."""
+    with _lock:
+        return len(_pending)
+
+
+def reset() -> None:
+    """Drop the sketch, pending charges and the id->name table
+    (:func:`metrics_tpu.obs.reset` calls this alongside the registry)."""
+    global _sketch
+    with _lock:
+        _pending.clear()
+        _names.clear()
+        _sketch = None

@@ -1,0 +1,268 @@
+"""Synthetic canary probes: black-box correctness through the real path.
+
+Every other signal in :mod:`metrics_tpu.obs` is white-box — the serving
+tier reporting on itself. A fleet that silently folds wrong answers
+keeps all of those green. The :class:`CanaryProber` closes that gap by
+continuously shipping **known-answer payloads** through a reserved
+``__canary__`` tenant on the production ingest path — same wire
+encoding, same dedup journal, same fold kernels — and verifying the
+aggregator's ``/query`` answer **bitwise** against a locally-computed
+oracle.
+
+The oracle argument (documented in ``docs/observability.md`` §10): the
+canary schema is two :class:`~metrics_tpu.aggregation.SumMetric` s fed
+small integers, so every cumulative total is exactly representable in
+float32 and the fold is associative bitwise — the probe's expected
+answer is not a tolerance band but THE answer, and any deviation
+(a corrupted leaf, a double-fold, a stale-view read) is a mismatch, not
+noise. Verification keys on the aggregator's **accepted watermark** for
+the probe client: a ship lost in flight leaves the root at an older
+watermark whose values must still match that step's oracle exactly, so
+wire chaos cannot fake a red canary — only a wrong fold can.
+
+Probes record ``probe.probes``/``probe.results{verdict=}``/
+``probe.round_trip_ms``/``probe.healthy`` per node; the match/mismatch
+verdict counters are the **correctness SLI** the ``canary``
+:class:`~metrics_tpu.obs.slo.SLODef` consumes, and
+``/healthz/ready`` surfaces :meth:`CanaryProber.status` beside the
+history alerts. One prober per aggregator: the reserved tenant's state
+on a node must come only from its own prober or the oracle comparison
+would be comparing against someone else's probes (enforced at attach).
+"""
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = ["CANARY_TENANT", "CanaryProber", "canary_metrics", "reset"]
+
+# the reserved synthetic tenant (also re-exported by metrics_tpu.obs.slo)
+CANARY_TENANT = "__canary__"
+
+# oracle entries retained per prober: verification needs the oracle at
+# whatever watermark the aggregator last ACCEPTED, which trails the ship
+# sequence by at most the in-flight window — 256 is generous headroom
+_ORACLE_CAP = 256
+
+_PROBERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def canary_metrics() -> Any:
+    """The canary tenant's schema: an integer-fed checksum sum plus a
+    payload counter — exact in float32, hence bitwise-verifiable. Pass
+    this factory wherever tenant dicts are built if a node must have the
+    tenant registered before its prober attaches."""
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.collections import MetricCollection
+
+    return MetricCollection({"checksum": SumMetric(), "payloads": SumMetric()})
+
+
+class CanaryProber:
+    """Ships known-answer payloads through ``aggregator``'s real ingest
+    path and verifies query answers bitwise against the local oracle.
+
+    Args:
+        aggregator: the :class:`~metrics_tpu.serve.Aggregator` under
+            test. The reserved tenant is registered here if missing, and
+            the prober attaches as ``aggregator._canary_prober`` (one
+            per aggregator — a second attach raises).
+        ingest: optional override for payload delivery (e.g. an HTTP
+            client posting to the node's ``/ingest``). Defaults to
+            calling ``aggregator.ingest`` in-process. Whatever the
+            transport, payloads must land on **this** aggregator —
+            verification reads its accepted watermark.
+        client_id: wire identity of the probe client; defaults to
+            ``canary:<node>``.
+    """
+
+    def __init__(
+        self,
+        aggregator: Any,
+        *,
+        ingest: Optional[Callable[[bytes], Any]] = None,
+        client_id: Optional[str] = None,
+    ) -> None:
+        from metrics_tpu.serve.aggregator import ServeError
+
+        if getattr(aggregator, "_canary_prober", None) is not None:
+            raise ServeError(
+                f"aggregator {aggregator.name!r} already has a canary prober;"
+                " the reserved tenant's state must come from exactly one"
+                " oracle or bitwise verification is meaningless"
+            )
+        self._aggregator = aggregator
+        self._ingest = ingest if ingest is not None else aggregator.ingest
+        self._client = str(client_id) if client_id else f"canary:{aggregator.name}"
+        if CANARY_TENANT not in aggregator.tenants():
+            aggregator.register_tenant(CANARY_TENANT, canary_metrics)
+        self._lock = threading.Lock()
+        self._collection = canary_metrics()
+        self._seq = 0
+        self._total = 0.0
+        self._count = 0.0
+        # seq -> (cumulative checksum, cumulative payload count)
+        self._oracle: Dict[int, Tuple[float, float]] = {}
+        self._matches = 0
+        self._mismatches = 0
+        self._pending = 0
+        self._last_verdict: Optional[str] = None
+        self._last_rtt_ms: Optional[float] = None
+        aggregator._canary_prober = self
+        _PROBERS.add(self)
+
+    # -- shipping --------------------------------------------------------
+
+    def _next_value(self) -> float:
+        # deterministic small integers: cumulative sums stay exactly
+        # representable in float32 for ~160k probes (sum < 2**24)
+        return float((self._seq * 37) % 101 + 1)
+
+    def ship(self) -> bytes:
+        """Encode and deliver the next cumulative probe payload; returns
+        the wire blob (tests replay it through chaos planners)."""
+        import jax.numpy as jnp
+
+        from metrics_tpu.serve.wire import encode_state
+
+        with self._lock:
+            value = self._next_value()
+            self._collection["checksum"].update(jnp.asarray(value))
+            self._collection["payloads"].update(jnp.asarray(1.0))
+            self._total += value
+            self._count += 1.0
+            seq = self._seq
+            self._oracle[seq] = (self._total, self._count)
+            while len(self._oracle) > _ORACLE_CAP:
+                del self._oracle[min(self._oracle)]
+            self._seq += 1
+            blob = encode_state(
+                self._collection,
+                tenant=CANARY_TENANT,
+                client_id=self._client,
+                watermark=(0, seq),
+                meta={"canary": True},
+            )
+        self._ingest(blob)
+        return blob
+
+    # -- verification ----------------------------------------------------
+
+    def verify(self) -> str:
+        """Compare the aggregator's answer for the canary tenant bitwise
+        against the oracle at its **accepted** watermark. Returns the
+        verdict: ``"match"`` | ``"mismatch"`` | ``"pending"`` (nothing
+        accepted yet, or the accepted step already aged out of the
+        oracle ring — neither is evidence of a wrong fold)."""
+        wm = self._aggregator.client_watermark(CANARY_TENANT, self._client)
+        verdict = "pending"
+        if wm is not None:
+            with self._lock:
+                expected = self._oracle.get(int(wm[1]))
+            if expected is not None:
+                answer = self._aggregator.query(CANARY_TENANT)["values"]
+                got_sum = float(answer["checksum"]["value"])
+                got_count = float(answer["payloads"]["value"])
+                ok = got_sum == expected[0] and got_count == expected[1]
+                verdict = "match" if ok else "mismatch"
+        with self._lock:
+            if verdict == "match":
+                self._matches += 1
+            elif verdict == "mismatch":
+                self._mismatches += 1
+            else:
+                self._pending += 1
+            self._last_verdict = verdict
+            healthy = self._mismatches == 0
+        if _reg.enabled():
+            _reg.inc("probe.results", node=self._aggregator.name, verdict=verdict)
+            _reg.set_gauge(
+                "probe.healthy", 1.0 if healthy else 0.0, node=self._aggregator.name
+            )
+        return verdict
+
+    def probe(self, flush: bool = True) -> str:
+        """One full round trip: ship, (optionally) flush so the payload
+        folds, verify. Records ``probe.probes`` and the round-trip
+        latency histogram; returns the verdict."""
+        t0 = time.perf_counter()
+        self.ship()
+        if flush:
+            self._aggregator.flush()
+        verdict = self.verify()
+        rtt_ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            self._last_rtt_ms = rtt_ms
+        if _reg.enabled():
+            _reg.inc("probe.probes", node=self._aggregator.name)
+            _reg.observe("probe.round_trip_ms", rtt_ms, node=self._aggregator.name)
+        return verdict
+
+    # -- failover --------------------------------------------------------
+
+    def rebind(
+        self, aggregator: Any, *, ingest: Optional[Callable[[bytes], Any]] = None
+    ) -> None:
+        """Follow a checkpoint kill+restore: re-attach to the revived
+        aggregator, keeping the ship sequence, cumulative collection and
+        oracle ring. The revived dedup journal remembers the old client
+        watermarks, so a FRESH prober's ships would all shed as stale
+        duplicates and its empty oracle could never verify again — the
+        surviving prober IS the oracle continuity across the restore.
+        One-per-aggregator is enforced on the new node; the old node, if
+        still alive, releases its slot."""
+        from metrics_tpu.serve.aggregator import ServeError
+
+        if getattr(aggregator, "_canary_prober", None) not in (None, self):
+            raise ServeError(
+                f"aggregator {aggregator.name!r} already has a canary prober;"
+                " rebind the existing one or detach it first"
+            )
+        with self._lock:
+            old = self._aggregator
+            if getattr(old, "_canary_prober", None) is self:
+                old._canary_prober = None
+            self._aggregator = aggregator
+            self._ingest = ingest if ingest is not None else aggregator.ingest
+            aggregator._canary_prober = self
+
+    # -- reporting -------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The ``/healthz/ready`` detail block: healthy means zero
+        bitwise mismatches since the stats were last reset."""
+        with self._lock:
+            return {
+                "node": self._aggregator.name,
+                "tenant": CANARY_TENANT,
+                "client": self._client,
+                "probes_shipped": self._seq,
+                "matches": self._matches,
+                "mismatches": self._mismatches,
+                "pending": self._pending,
+                "healthy": self._mismatches == 0,
+                "last_verdict": self._last_verdict,
+                "last_rtt_ms": self._last_rtt_ms,
+            }
+
+    def reset_stats(self) -> None:
+        """Zero the verdict tallies (:func:`metrics_tpu.obs.reset` calls
+        this on every live prober). The ship sequence, the cumulative
+        collection and the oracle ring survive — they are wire state
+        shared with the aggregator's dedup journal, and rewinding them
+        would make every post-reset ship a dropped duplicate."""
+        with self._lock:
+            self._matches = 0
+            self._mismatches = 0
+            self._pending = 0
+            self._last_verdict = None
+            self._last_rtt_ms = None
+
+
+def reset() -> None:
+    """Clear verdict bookkeeping on every live prober — the hook
+    :func:`metrics_tpu.obs.reset` calls alongside the registry."""
+    for prober in list(_PROBERS):
+        prober.reset_stats()

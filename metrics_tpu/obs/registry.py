@@ -341,6 +341,8 @@ class HistogramSnapshot:
         if not 0.0 <= q <= 1.0:
             raise ValueError(f"quantile must be in [0, 1], got {q}")
         target = q * self.count
+        nonzero = [i for i, c in enumerate(self.counts) if c]
+        first_nz, last_nz = nonzero[0], nonzero[-1]
         cum = 0
         for i, c in enumerate(self.counts):
             if c == 0:
@@ -350,6 +352,15 @@ class HistogramSnapshot:
             if cum >= target:
                 lo = HISTOGRAM_EDGES[i - 1] if i > 0 else 0.0
                 hi = HISTOGRAM_EDGES[i] if i < len(HISTOGRAM_EDGES) else self.max
+                # the observed extremes live in the first/last hit bucket by
+                # construction (bisect puts min/max there), so interpolating
+                # from the bucket EDGE would smear a tight single-bucket
+                # series across the whole bucket and then clamp every
+                # quantile to max — anchor those two buckets on min/max
+                if i == first_nz:
+                    lo = self.min
+                if i == last_nz:
+                    hi = self.max
                 value = lo + (hi - lo) * ((target - prev) / c)
                 return min(max(value, self.min), self.max)
         return self.max

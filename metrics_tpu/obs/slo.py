@@ -1,0 +1,603 @@
+"""Per-tenant SLOs: declarative SLIs, error budgets, burn-rate alerting.
+
+The serving tier's white-box counters answer "what is the fleet doing";
+this module answers the operator's actual page-worthy question — "which
+TENANT is unhealthy, and how fast is it burning its error budget". Three
+pieces:
+
+* :class:`SLODef` — a declarative objective over an SLI computed from
+  the existing :mod:`metrics_tpu.obs` registry. The built-ins
+  (:func:`default_slos`) read families the aggregator already records
+  per tenant: **ingest success** (``serve.ingests`` vs the
+  ``slo.ingest_errors{tenant=,reason=}`` failures instrumented at the
+  ingest/accept/shed seams), **freshness** (the per-tenant
+  ``serve.e2e_freshness_ms{node=,tenant=}`` histogram — good means a
+  payload went encode-to-queryable under the threshold), **query
+  latency** (``serve.query_ms{tenant=}``), and **canary correctness**
+  (the :mod:`metrics_tpu.obs.prober` ``probe.results`` verdicts for the
+  reserved ``__canary__`` tenant).
+
+* :class:`ErrorBudget` — one durable record per ``(tenant, slo)``:
+  monotone rebased good/bad totals, a bounded sample ring for window
+  differencing, the firing flag and alert/evaluation counts. JSON-safe,
+  so it rides the aggregator's checkpoint manifest bitwise
+  (``meta["slo"]``, beside the history rings and experiment records).
+
+* :class:`SLOEngine` — rides the same :meth:`MetricHistory.add_cut_hook`
+  seam the experiment :class:`~metrics_tpu.experiment.DecisionEngine`
+  uses: every cut evaluates every attached SLO for every tenant,
+  differencing cumulative registry totals into per-window event deltas.
+  Alerting is the Google-SRE multi-window multi-burn-rate rule: fire
+  when the burn rate over BOTH the fast and slow window exceeds the
+  rule's threshold (fast window catches the step change, slow window
+  keeps one-sample blips from paging). Transitions are edge-triggered
+  through the one-shot-warn machinery — ``slo.alerts{tenant=,slo=}``
+  counts firing EDGES, ``slo.alert_active`` is the level, recovery
+  clears the gauge and re-arms the counter exactly like
+  ``MetricHistory._transition``.
+
+Failover fencing: every record carries the history generation it was
+built under. A promotion mints a new generation AND a new process whose
+registry counters restart — differencing across that boundary would
+subtract two unrelated histories, so the engine rebases the raw
+baselines instead (counted under ``slo.fenced_evaluations``); the
+durable rebased totals and the consumed budget survive untouched.
+
+Unarmed cost: an aggregator without an attached engine pays nothing
+(the cut hook is never registered); with obs disabled the engine's
+sources read zero and the hot-path instrumentation never runs — the
+disabled-mode HLO byte-identity pin is untouched.
+"""
+import threading
+import warnings
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from metrics_tpu.obs import registry as _reg
+
+__all__ = [
+    "CANARY_TENANT",
+    "ErrorBudget",
+    "SLODef",
+    "SLOEngine",
+    "default_slos",
+    "reset",
+]
+
+# the reserved synthetic-probe tenant (see metrics_tpu.obs.prober); the
+# canary SLI only ever evaluates for this tenant
+CANARY_TENANT = "__canary__"
+
+# engines register here so metrics_tpu.obs.reset() can clear budget
+# tables without the obs package importing the serving tier
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+# samples kept per (tenant, slo) ring past the window horizon — one
+# anchor at-or-before the slow window start is required for exact
+# differencing; the rest is headroom for irregular cut cadences
+_MAX_SAMPLES = 512
+
+
+class SLODef:
+    """One declarative objective: ``sli`` names the source, ``objective``
+    the target good-fraction, the dual windows the burn-rate rule.
+
+    Args:
+        name: the slo label on every exported series and alert.
+        sli: ``"ingest_success"`` | ``"freshness"`` | ``"query_latency"``
+            | ``"canary"`` — which registry families feed good/bad.
+        objective: target good-fraction in (0, 1); ``1 - objective`` is
+            the error budget.
+        threshold_ms: for histogram-backed SLIs (freshness, query
+            latency): an observation at or under this is *good*. The
+            cutoff snaps to the nearest shared histogram bucket edge so
+            the good-count is exact, not interpolated.
+        fast_window_s / slow_window_s: the two burn-rate windows.
+        fast_burn / slow_burn: burn-rate thresholds; the alert fires
+            when BOTH windows exceed their threshold (the SRE-workbook
+            14.4x/6x page rule shape).
+        budget_window_s: the accounting period ``budget_remaining`` is
+            computed over (defaults to 24h).
+    """
+
+    _SLIS = ("ingest_success", "freshness", "query_latency", "canary")
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        sli: str,
+        objective: float,
+        threshold_ms: Optional[float] = None,
+        fast_window_s: float = 300.0,
+        slow_window_s: float = 3600.0,
+        fast_burn: float = 14.4,
+        slow_burn: float = 6.0,
+        budget_window_s: float = 86400.0,
+    ) -> None:
+        if sli not in self._SLIS:
+            raise ValueError(f"unknown sli {sli!r}; expected one of {self._SLIS}")
+        if not 0.0 < float(objective) < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        if sli in ("freshness", "query_latency") and threshold_ms is None:
+            raise ValueError(f"sli {sli!r} needs threshold_ms (what counts as good)")
+        if float(fast_window_s) <= 0 or float(slow_window_s) <= 0:
+            raise ValueError("windows must be positive")
+        if float(fast_window_s) > float(slow_window_s):
+            raise ValueError(
+                f"fast window ({fast_window_s}s) must not exceed slow window"
+                f" ({slow_window_s}s)"
+            )
+        self.name = str(name)
+        self.sli = sli
+        self.objective = float(objective)
+        self.threshold_ms = None if threshold_ms is None else float(threshold_ms)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.fast_burn = float(fast_burn)
+        self.slow_burn = float(slow_burn)
+        self.budget_window_s = float(budget_window_s)
+
+    @property
+    def budget_fraction(self) -> float:
+        return 1.0 - self.objective
+
+    def config(self) -> Dict[str, Any]:
+        """JSON-safe definition (the ``GET /slo`` report's slos block)."""
+        return {
+            "sli": self.sli,
+            "objective": self.objective,
+            "threshold_ms": self.threshold_ms,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "fast_burn": self.fast_burn,
+            "slow_burn": self.slow_burn,
+            "budget_window_s": self.budget_window_s,
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"SLODef({self.name!r}, sli={self.sli!r}, objective={self.objective},"
+            f" windows=({self.fast_window_s:g}s@{self.fast_burn:g}x,"
+            f" {self.slow_window_s:g}s@{self.slow_burn:g}x))"
+        )
+
+
+def default_slos() -> List[SLODef]:
+    """The three built-in white-box SLOs plus the canary's black-box one.
+
+    Objectives are deliberately conservative defaults — a deployment
+    tunes them per tenant class; the smoke and tests construct their own
+    tighter definitions."""
+    return [
+        SLODef("ingest", sli="ingest_success", objective=0.999),
+        SLODef("freshness", sli="freshness", objective=0.99, threshold_ms=60_000.0),
+        SLODef("query_latency", sli="query_latency", objective=0.99, threshold_ms=250.0),
+        SLODef("canary", sli="canary", objective=0.999),
+    ]
+
+
+def _histogram_good_bad(
+    name: str, threshold_ms: float, **labels: Any
+) -> Optional[Tuple[float, float]]:
+    """Cumulative (good, bad) split of one histogram series at the bucket
+    edge nearest ``threshold_ms`` — exact, because bucket counts are."""
+    snap = _reg.get_histogram(name, **labels)
+    if snap is None:
+        return None
+    good = 0.0
+    for edge, count in zip(_reg.HISTOGRAM_EDGES, snap.counts):
+        if edge <= threshold_ms:
+            good += count
+        else:
+            break
+    return good, float(snap.count) - good
+
+
+class ErrorBudget:
+    """The durable per-``(tenant, slo)`` record. Plain-dict state
+    (:meth:`to_dict`/:meth:`from_dict`) so checkpoints carry it bitwise."""
+
+    __slots__ = (
+        "tenant", "slo", "raw_good", "raw_bad", "good", "bad",
+        "samples", "firing", "alerts", "evaluations", "fenced", "generation",
+    )
+
+    def __init__(self, tenant: str, slo: str, *, generation: int = 0) -> None:
+        self.tenant = str(tenant)
+        self.slo = str(slo)
+        # last cumulative registry totals seen (the differencing baseline)
+        self.raw_good = 0.0
+        self.raw_bad = 0.0
+        # monotone REBASED totals: survive counter resets and failovers
+        self.good = 0.0
+        self.bad = 0.0
+        # [t, good, bad] rings (rebased totals) for window differencing
+        self.samples: List[List[float]] = []
+        self.firing = False
+        self.alerts = 0
+        self.evaluations = 0
+        self.fenced = 0
+        self.generation = int(generation)
+
+    # -- accounting ------------------------------------------------------
+
+    def observe(self, now: float, raw_good: float, raw_bad: float, horizon_s: float) -> None:
+        """Fold one cumulative reading into the rebased totals + ring. A
+        raw total BELOW the stored baseline means the source registry
+        restarted (restore into a fresh process): the events counted so
+        far are new work, so the delta rebases from zero rather than
+        going negative or double-counting."""
+        d_good = raw_good - self.raw_good
+        if d_good < 0:
+            d_good = raw_good
+        d_bad = raw_bad - self.raw_bad
+        if d_bad < 0:
+            d_bad = raw_bad
+        self.raw_good = float(raw_good)
+        self.raw_bad = float(raw_bad)
+        self.good += d_good
+        self.bad += d_bad
+        self.samples.append([float(now), self.good, self.bad])
+        self._prune(now, horizon_s)
+
+    def _prune(self, now: float, horizon_s: float) -> None:
+        cutoff = now - horizon_s
+        # keep ONE anchor at-or-before the horizon: window differencing
+        # needs the newest sample older than the window start
+        while len(self.samples) > 2 and self.samples[1][0] <= cutoff:
+            self.samples.pop(0)
+        while len(self.samples) > _MAX_SAMPLES:
+            self.samples.pop(0)
+
+    def _baseline(self, now: float, window_s: float) -> Tuple[float, float]:
+        """Rebased (good, bad) totals at the window start: the newest
+        sample at-or-before ``now - window_s``, or the implicit (0, 0)
+        origin when tracking is younger than the window."""
+        start = now - window_s
+        base_good, base_bad = 0.0, 0.0
+        for t, g, b in self.samples:
+            if t <= start:
+                base_good, base_bad = g, b
+            else:
+                break
+        return base_good, base_bad
+
+    def window_counts(self, now: float, window_s: float) -> Tuple[float, float]:
+        """(good, bad) event counts inside the window ending at ``now``."""
+        base_good, base_bad = self._baseline(now, window_s)
+        return max(0.0, self.good - base_good), max(0.0, self.bad - base_bad)
+
+    def burn_rate(self, now: float, window_s: float, budget_fraction: float) -> float:
+        """Observed bad-fraction over the window divided by the allowed
+        fraction — 1.0 burns the budget exactly at its sustainable rate."""
+        good, bad = self.window_counts(now, window_s)
+        total = good + bad
+        if total <= 0.0:
+            return 0.0
+        return (bad / total) / max(budget_fraction, 1e-12)
+
+    def sli(self, now: float, window_s: float) -> Optional[float]:
+        """Good-fraction over the window; None when no events landed."""
+        good, bad = self.window_counts(now, window_s)
+        total = good + bad
+        if total <= 0.0:
+            return None
+        return good / total
+
+    def budget_remaining(self, now: float, slo: SLODef) -> float:
+        """Fraction of the error budget left over ``budget_window_s``
+        (clamped to [0, 1])."""
+        burn = self.burn_rate(now, slo.budget_window_s, slo.budget_fraction)
+        return min(1.0, max(0.0, 1.0 - burn))
+
+    # -- durability ------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.tenant,
+            "slo": self.slo,
+            "raw_good": self.raw_good,
+            "raw_bad": self.raw_bad,
+            "good": self.good,
+            "bad": self.bad,
+            "samples": [list(s) for s in self.samples],
+            "firing": bool(self.firing),
+            "alerts": int(self.alerts),
+            "evaluations": int(self.evaluations),
+            "fenced": int(self.fenced),
+            "generation": int(self.generation),
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ErrorBudget":
+        rec = cls(str(data["tenant"]), str(data["slo"]), generation=int(data.get("generation", 0)))
+        rec.raw_good = float(data.get("raw_good", 0.0))
+        rec.raw_bad = float(data.get("raw_bad", 0.0))
+        rec.good = float(data.get("good", 0.0))
+        rec.bad = float(data.get("bad", 0.0))
+        rec.samples = [[float(v) for v in s] for s in (data.get("samples") or [])]
+        rec.firing = bool(data.get("firing", False))
+        rec.alerts = int(data.get("alerts", 0))
+        rec.evaluations = int(data.get("evaluations", 0))
+        rec.fenced = int(data.get("fenced", 0))
+        return rec
+
+
+class SLOEngine:
+    """Evaluates attached :class:`SLODef` s for every tenant on each
+    history cut; owns the per-``(tenant, slo)`` :class:`ErrorBudget`
+    table and the ``GET /slo`` report.
+
+    Construction requires a history-armed aggregator (the cut hook is
+    the evaluation clock, exactly the DecisionEngine seam) and attaches
+    the engine as ``aggregator.slo``.
+    """
+
+    def __init__(self, aggregator: Any, slos: Optional[List[SLODef]] = None) -> None:
+        from metrics_tpu.serve.aggregator import ServeError
+
+        if aggregator.history is None:
+            raise ServeError(
+                f"aggregator {aggregator.name!r} has no history armed; the SLO"
+                " engine evaluates on interval cuts — construct the aggregator"
+                " with history=HistoryConfig(...)"
+            )
+        slos = default_slos() if slos is None else list(slos)
+        names = [s.name for s in slos]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate slo names: {sorted(names)}")
+        self._aggregator = aggregator
+        self._history = aggregator.history
+        self._slos: Dict[str, SLODef] = {s.name: s for s in slos}
+        self._budgets: Dict[Tuple[str, str], ErrorBudget] = {}
+        self._warned: set = set()
+        self._lock = threading.Lock()
+        self._history.add_cut_hook(self._on_cut)
+        aggregator._slo_engine = self
+        _ENGINES.add(self)
+
+    # -- registry --------------------------------------------------------
+
+    def slo_names(self) -> List[str]:
+        return sorted(self._slos)
+
+    def budget(self, tenant: str, slo: str) -> Optional[ErrorBudget]:
+        with self._lock:
+            return self._budgets.get((str(tenant), str(slo)))
+
+    # -- evaluation ------------------------------------------------------
+
+    def _on_cut(self, history: Any, aggregator: Any) -> None:
+        try:
+            self.evaluate_all(now=history._last_cut_s)
+        except Exception as err:  # noqa: BLE001 — an SLO bug must not kill cuts
+            if "evaluate_all" not in self._warned:
+                self._warned.add("evaluate_all")
+                warnings.warn(
+                    f"slo evaluation failed: {type(err).__name__}: {err}",
+                    stacklevel=2,
+                )
+
+    def _sli_totals(self, slo: SLODef, tenant: str) -> Optional[Tuple[float, float]]:
+        """Cumulative (good, bad) registry totals for one SLI, or None
+        when the SLI does not apply to / has never observed the tenant."""
+        node = self._aggregator.name
+        if slo.sli == "ingest_success":
+            good = _reg.get_counter("serve.ingests", tenant=tenant)
+            bad = 0.0
+            for reason in ("accept", "backpressure", "shed", "wire"):
+                bad += _reg.get_counter("slo.ingest_errors", tenant=tenant, reason=reason)
+            if good == 0.0 and bad == 0.0:
+                return None
+            return good, bad
+        if slo.sli == "freshness":
+            return _histogram_good_bad(
+                "serve.e2e_freshness_ms", slo.threshold_ms, node=node, tenant=tenant
+            )
+        if slo.sli == "query_latency":
+            return _histogram_good_bad("serve.query_ms", slo.threshold_ms, tenant=tenant)
+        if slo.sli == "canary":
+            if tenant != CANARY_TENANT:
+                return None
+            good = _reg.get_counter("probe.results", node=node, verdict="match")
+            bad = _reg.get_counter("probe.results", node=node, verdict="mismatch")
+            if good == 0.0 and bad == 0.0:
+                return None
+            return good, bad
+        return None
+
+    def evaluate_all(self, now: Optional[float] = None) -> int:
+        """Evaluate every (tenant, slo) pair with data; returns the number
+        of evaluations performed. ``now`` defaults to the history's last
+        cut time so manually-driven cuts stay deterministic in tests."""
+        if now is None:
+            now = self._history._last_cut_s
+        if now is None:
+            import time
+
+            now = time.time()
+        now = float(now)
+        evaluated = 0
+        for tenant in sorted(self._aggregator.tenants()):
+            for name in self.slo_names():
+                if self.evaluate(tenant, name, now):
+                    evaluated += 1
+        self._meter_history_bytes()
+        return evaluated
+
+    def evaluate(self, tenant: str, slo_name: str, now: float) -> bool:
+        """One (tenant, slo) evaluation: difference cumulative totals,
+        update the budget ring, apply the dual-window burn rule with
+        edge-triggered transitions. Returns True when an evaluation
+        actually ran (the SLI had data)."""
+        slo = self._slos[slo_name]
+        totals = self._sli_totals(slo, tenant)
+        if totals is None:
+            return False
+        armed = _reg.enabled()
+        with self._lock:
+            rec = self._budgets.get((tenant, slo_name))
+            if rec is None:
+                rec = self._budgets[(tenant, slo_name)] = ErrorBudget(
+                    tenant, slo_name, generation=self._history.generation
+                )
+            if rec.generation != self._history.generation:
+                # failover fence: the registry these baselines came from
+                # belongs to a superseded generation — rebase rather than
+                # difference two unrelated histories. The rebased totals
+                # and consumed budget survive; only the raw baseline drops.
+                rec.generation = self._history.generation
+                rec.raw_good = 0.0
+                rec.raw_bad = 0.0
+                rec.fenced += 1
+                if armed:
+                    _reg.inc("slo.fenced_evaluations", tenant=tenant, slo=slo_name)
+            horizon = max(slo.slow_window_s, slo.budget_window_s)
+            rec.observe(now, totals[0], totals[1], horizon)
+            rec.evaluations += 1
+            burn_fast = rec.burn_rate(now, slo.fast_window_s, slo.budget_fraction)
+            burn_slow = rec.burn_rate(now, slo.slow_window_s, slo.budget_fraction)
+            firing_now = burn_fast >= slo.fast_burn and burn_slow >= slo.slow_burn
+            fired_edge = firing_now and not rec.firing
+            cleared_edge = rec.firing and not firing_now
+            rec.firing = firing_now
+            if fired_edge:
+                rec.alerts += 1
+            sli = rec.sli(now, slo.fast_window_s)
+            remaining = rec.budget_remaining(now, slo)
+        if armed:
+            _reg.inc("slo.evaluations", slo=slo_name)
+            _reg.set_gauge("slo.burn_rate", burn_fast, tenant=tenant, slo=slo_name, window="fast")
+            _reg.set_gauge("slo.burn_rate", burn_slow, tenant=tenant, slo=slo_name, window="slow")
+            _reg.set_gauge("slo.budget_remaining", remaining, tenant=tenant, slo=slo_name)
+            if sli is not None:
+                _reg.set_gauge("slo.sli", sli, tenant=tenant, slo=slo_name)
+        if fired_edge:
+            if armed:
+                _reg.inc("slo.alerts", tenant=tenant, slo=slo_name)
+                _reg.set_gauge("slo.alert_active", 1.0, tenant=tenant, slo=slo_name)
+            key = ("alert", tenant, slo_name)
+            if key not in self._warned:
+                # one-shot: a clear re-arms the COUNTER (a new burn is a
+                # new edge) but not the warning — log-noise discipline,
+                # same stance as MetricHistory._transition
+                self._warned.add(key)
+                from metrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"SLO BURN: tenant {tenant!r} slo {slo_name!r} is burning its"
+                    f" error budget (fast {burn_fast:.1f}x >= {slo.fast_burn:g}x"
+                    f" AND slow {burn_slow:.1f}x >= {slo.slow_burn:g}x;"
+                    f" budget remaining {remaining:.1%}) — edge-triggered:"
+                    " counted once under slo.alerts until the burn clears"
+                )
+        elif cleared_edge and armed:
+            _reg.set_gauge("slo.alert_active", 0.0, tenant=tenant, slo=slo_name)
+        return True
+
+    def _meter_history_bytes(self) -> None:
+        """Retained-ring footprint per tenant (``meter.history_bytes``):
+        nbytes metadata over retained interval snapshots — no copies."""
+        if not _reg.enabled():
+            return
+        for tenant_id, th in list(self._history._tenants.items()):
+            total = 0
+            for _, snap in th.retained():
+                total += sum(int(leaf.nbytes) for leaf in snap.leaves)
+            _reg.set_gauge("meter.history_bytes", float(total), tenant=tenant_id)
+
+    # -- reporting (GET /slo) --------------------------------------------
+
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        """Currently-firing (tenant, slo) pairs — the surfaced-not-gating
+        detail ``/healthz/ready`` renders beside ``history_alerts``."""
+        with self._lock:
+            return [
+                {"tenant": rec.tenant, "slo": rec.slo, "alerts": rec.alerts}
+                for (_, _), rec in sorted(self._budgets.items())
+                if rec.firing
+            ]
+
+    def report(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The JSON answer for ``GET /slo``: definitions, per-tenant SLI
+        values, burn rates, budget remaining, and active alerts."""
+        if now is None:
+            now = self._history._last_cut_s
+        if now is None:
+            import time
+
+            now = time.time()
+        now = float(now)
+        if _reg.enabled():
+            _reg.inc("slo.queries")
+        tenants: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            items = sorted(self._budgets.items())
+            for (tenant, name), rec in items:
+                slo = self._slos[name]
+                tenants.setdefault(tenant, {})[name] = {
+                    "sli": rec.sli(now, slo.fast_window_s),
+                    "burn_fast": rec.burn_rate(now, slo.fast_window_s, slo.budget_fraction),
+                    "burn_slow": rec.burn_rate(now, slo.slow_window_s, slo.budget_fraction),
+                    "budget_remaining": rec.budget_remaining(now, slo),
+                    "firing": rec.firing,
+                    "alerts": rec.alerts,
+                    "evaluations": rec.evaluations,
+                    "fenced": rec.fenced,
+                    "good": rec.good,
+                    "bad": rec.bad,
+                }
+        return {
+            "node": self._aggregator.name,
+            "generation": self._history.generation,
+            "slos": {name: self._slos[name].config() for name in self.slo_names()},
+            "tenants": tenants,
+            "active_alerts": self.active_alerts(),
+        }
+
+    # -- durability (rides Aggregator.save/restore) ----------------------
+
+    def state_for_checkpoint(self) -> Dict[str, Any]:
+        """JSON-safe budget table for the checkpoint manifest
+        (``meta["slo"]``): nested ``{tenant: {slo: record}}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for (tenant, name), rec in sorted(self._budgets.items()):
+                out.setdefault(tenant, {})[name] = rec.to_dict()
+        return out
+
+    def load_checkpoint_state(self, meta: Dict[str, Any]) -> None:
+        """Adopt saved budget records wholesale (bitwise: plain JSON
+        replacing the fresh table). Records for slos this engine does not
+        define are ignored (the re-register-before-restore stance);
+        already-firing records suppress the one-shot re-warn — the alert
+        edge was announced by the node that saw it."""
+        with self._lock:
+            for tenant, slos in (meta or {}).items():
+                for name, saved in (slos or {}).items():
+                    if name not in self._slos:
+                        continue
+                    rec = ErrorBudget.from_dict(dict(saved, tenant=tenant, slo=name))
+                    self._budgets[(str(tenant), str(name))] = rec
+                    if rec.firing:
+                        self._warned.add(("alert", str(tenant), str(name)))
+                        if _reg.enabled():
+                            _reg.set_gauge(
+                                "slo.alert_active", 1.0, tenant=str(tenant), slo=str(name)
+                            )
+
+    def reset_budgets(self) -> None:
+        """Drop every budget record and re-arm the one-shot warnings
+        (:func:`metrics_tpu.obs.reset` clears all live engines this way)."""
+        with self._lock:
+            self._budgets.clear()
+            self._warned.clear()
+
+
+def reset() -> None:
+    """Clear the budget tables of every live engine — the module-level
+    hook :func:`metrics_tpu.obs.reset` calls so SLO state cannot bleed
+    between measurement windows."""
+    for engine in list(_ENGINES):
+        engine.reset_budgets()

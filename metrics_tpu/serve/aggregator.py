@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.ft.journal import BatchJournal
+from metrics_tpu.obs import meter as _obs_meter
 from metrics_tpu.obs.registry import enabled as _obs_enabled
 from metrics_tpu.obs.registry import inc as _obs_inc
 from metrics_tpu.obs.registry import observe as _obs_observe
@@ -455,12 +456,26 @@ class _Tenant:
             fold_ms = (time.perf_counter() - t_fold) * 1000.0
             self.last_fold_ms = fold_ms
             _obs_observe("serve.hop_fold_ms", fold_ms, node=self.node)
+            # metering: the same fold latency split per tenant (the tenant
+            # IS the fold unit here), plus the tenant's resident state
+            # footprint — k client snapshots of the fixed schema plus the
+            # merged view, all template-shaped by construction
+            _obs_observe("meter.fold_ms", fold_ms, tenant=self.tenant_id)
+            schema_bytes = sum(int(t.nbytes) for t in self.template_leaves) + sum(
+                int(t.nbytes) for t in self.template_consensus
+            )
+            _obs_gauge(
+                "meter.state_bytes", float((k + 1) * schema_bytes), tenant=self.tenant_id
+            )
             now = time.time()
             for trace in fresh_traces:
+                freshness_ms = max(0.0, (now - trace["encoded_at"]) * 1000.0)
+                _obs_observe("serve.e2e_freshness_ms", freshness_ms, node=self.node)
+                # per-tenant variant (additional series, same family): the
+                # freshness SLI differences its bucket counts per tenant
                 _obs_observe(
-                    "serve.e2e_freshness_ms",
-                    max(0.0, (now - trace["encoded_at"]) * 1000.0),
-                    node=self.node,
+                    "serve.e2e_freshness_ms", freshness_ms,
+                    node=self.node, tenant=self.tenant_id,
                 )
                 _obs_record_hop(trace["id"], self.node, "fold", fold_ms)
         return k
@@ -655,6 +670,18 @@ class Aggregator:
         """The attached :class:`~metrics_tpu.experiment.DecisionEngine`,
         or None when no engine has been constructed over this node."""
         return getattr(self, "_experiment_engine", None)
+
+    @property
+    def slo(self):
+        """The attached :class:`~metrics_tpu.obs.slo.SLOEngine`, or None
+        when no SLO plane has been constructed over this node."""
+        return getattr(self, "_slo_engine", None)
+
+    @property
+    def canary(self):
+        """The attached :class:`~metrics_tpu.obs.prober.CanaryProber`,
+        or None when no prober has been constructed over this node."""
+        return getattr(self, "_canary_prober", None)
 
     # ------------------------------------------------------------------
     # Tenant registry
@@ -944,8 +971,10 @@ class Aggregator:
         t0 = time.perf_counter()
         firewall = self._firewall
         identity: Optional[Tuple[str, str]] = None
+        wire_bytes = 0  # decoded-object ingest (in-process hop) ships no wire
         if isinstance(payload, (bytes, bytearray, memoryview)):
             data = bytes(payload)
+            wire_bytes = len(data)
             peeked = None
             if firewall is not None:
                 try:
@@ -969,6 +998,7 @@ class Aggregator:
                 if firewall is not None and identity is not None:
                     if _obs_enabled():
                         _obs_inc("serve.wire_errors", tenant=identity[0])
+                        _obs_inc("slo.ingest_errors", tenant=identity[0], reason="wire")
                     if identity[0] in self._tenants:
                         firewall.record_error(*identity)
                 raise
@@ -1014,6 +1044,7 @@ class Aggregator:
             except queue.Full:
                 if _obs_enabled():
                     _obs_inc("serve.rejected", tenant=payload.tenant)
+                    _obs_inc("slo.ingest_errors", tenant=payload.tenant, reason="backpressure")
                 raise BackpressureError(
                     f"aggregator {self.name!r} ingest queue is full"
                     f" (max_queue={self._queue.maxsize}); retry with backoff"
@@ -1036,6 +1067,12 @@ class Aggregator:
             # process, and an unlabeled gauge would be last-writer-wins —
             # an idle leaf masking a saturated root from HealthMonitor
             _obs_gauge("serve.queue_depth", float(self._queue.qsize()), node=self.name)
+            if wire_bytes:
+                # metering: decoded bytes attributed to the tenant, both as
+                # an ordinary (capped, federable) counter family and into
+                # the bounded top-consumer sketch (one host dict add here)
+                _obs_inc("meter.wire_bytes", float(wire_bytes), tenant=payload.tenant)
+                _obs_meter.charge(payload.tenant, float(wire_bytes))
         return True
 
     def _shed_duplicate(self, tenant: "_Tenant", payload: MetricPayload) -> bool:
@@ -1057,6 +1094,7 @@ class Aggregator:
             return False
         if _obs_enabled():
             _obs_inc("serve.shed", tenant=payload.tenant, reason="duplicate_watermark")
+            _obs_inc("slo.ingest_errors", tenant=payload.tenant, reason="shed")
         return True
 
     def _put_payload(
@@ -1234,6 +1272,15 @@ class Aggregator:
                     }
                     slot.trace_fresh = True
                     _obs_observe("serve.hop_queue_wait_ms", queue_wait_ms, node=self.name)
+                    # ADDITIONAL per-tenant series in the same family: the
+                    # node-only series keeps its exactly-one-sample-per-
+                    # accept contract (tests pin it); the tenant split is
+                    # what the SLO plane and /tenants need
+                    _obs_observe(
+                        "serve.hop_queue_wait_ms", queue_wait_ms,
+                        node=self.name, tenant=payload.tenant,
+                    )
+                    _obs_observe("meter.queue_ms", queue_wait_ms, tenant=payload.tenant)
                     _obs_record_hop(slot.trace["id"], self.name, "queue_wait", queue_wait_ms)
             tenant.dirty = True
         gen = self._payload_generation(payload)
@@ -1272,6 +1319,7 @@ class Aggregator:
                 except ServeError as err:
                     if _obs_enabled():
                         _obs_inc("serve.accept_errors", tenant=payload.tenant)
+                        _obs_inc("slo.ingest_errors", tenant=payload.tenant, reason="accept")
                     warnings.warn(
                         f"aggregator {self.name!r} dropped a corrupted payload from"
                         f" client {payload.client_id!r}: {err}",
@@ -1659,6 +1707,12 @@ class Aggregator:
             # restore(), like tenants re-register before restore: the
             # saved always-valid p-values and verdicts land wholesale
             engine.load_checkpoint_state(experiments_meta)
+        slo_meta = serve_meta.get("slo")
+        slo_engine = getattr(self, "_slo_engine", None)
+        if slo_engine is not None and slo_meta is not None:
+            # same attach-before-restore contract as experiments: the
+            # saved error budgets land wholesale, bitwise
+            slo_engine.load_checkpoint_state(slo_meta)
         if _obs_enabled():
             _obs_gauge("serve.tenants", float(len(self._tenants)))
         return manifest
@@ -1835,6 +1889,12 @@ class Aggregator:
                 # they ride the manifest beside the history rings, so a
                 # restored root resumes with bitwise-identical verdicts
                 meta["experiments"] = engine.state_for_checkpoint()
+            slo_engine = getattr(self, "_slo_engine", None)
+            if slo_engine is not None:
+                # error budgets are consumed capital: a restore that reset
+                # them would hand every flooding tenant a fresh budget per
+                # failover, so they ride the manifest like decisions do
+                meta["slo"] = slo_engine.state_for_checkpoint()
         return _RegistryState(tree), meta
 
 

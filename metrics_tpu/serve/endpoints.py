@@ -46,6 +46,16 @@ the container doesn't bake. One :class:`MetricsServer` wraps one
   unknown experiment id, **400** when no decision engine is attached to
   this aggregator (experimentation is a ROOT concern; leaves serve only
   their tenants).
+* ``GET /slo`` — the tenant-facing SLO report
+  (:meth:`~metrics_tpu.obs.slo.SLOEngine.report`): definitions, per-tenant
+  SLI values, fast/slow burn rates, budget remaining and the
+  currently-firing alerts. **400** when no SLO engine is attached
+  (``SLOEngine(aggregator, ...)`` — an SLO plane is a root concern).
+* ``GET /tenants`` — metered usage per tenant (wire bytes, resident state
+  bytes, history-ring bytes, client/ingest counts from the ``meter.*``
+  families) plus the fleet's sketch-backed top-consumer ranking
+  (:func:`metrics_tpu.obs.meter.top_consumers`) with its overestimate
+  bounds.
 * ``GET /trace`` — Chrome-trace JSON (:func:`metrics_tpu.obs.to_chrome_trace`):
   host spans plus per-hop payload lifecycles (queue-wait / fold / ship /
   e2e per trace id), loadable in Perfetto — the debug view behind the
@@ -307,6 +317,47 @@ class MetricsServer:
             )
         return engine.report(exp_id)
 
+    def render_slo(self) -> Dict[str, Any]:
+        """The ``GET /slo`` body: the attached engine's full report
+        (definitions, per-tenant SLIs, burn rates, budgets, active
+        alerts). Raises :class:`ServeError` when no engine is attached
+        (400 — SLOs are evaluated at the root, like experiments)."""
+        engine = self.aggregator.slo
+        if engine is None:
+            raise ServeError(
+                f"aggregator {self.aggregator.name!r} has no SLO engine attached"
+                " (SLOEngine(aggregator, ...)); the SLO plane is served at the"
+                " root"
+            )
+        return engine.report()
+
+    def render_tenants(self, top: int = 10) -> Dict[str, Any]:
+        """The ``GET /tenants`` body: per-registered-tenant metered usage
+        from the ``meter.*`` families plus the bounded sketch ranking —
+        the ranking covers tenants the cardinality cap may have dropped
+        from the registry, each row carrying its overestimate bound."""
+        from metrics_tpu import obs
+        from metrics_tpu.obs import meter as _meter
+
+        agg = self.aggregator
+        tenants: Dict[str, Any] = {}
+        for tenant_id in agg.tenants():
+            entry: Dict[str, Any] = {
+                "clients": len(agg._tenant(tenant_id).clients),
+                "ingests": obs.get_counter("serve.ingests", tenant=tenant_id),
+                "wire_bytes": obs.get_counter("meter.wire_bytes", tenant=tenant_id),
+            }
+            for family in ("meter.state_bytes", "meter.history_bytes"):
+                value = obs.get_gauge(family, tenant=tenant_id)
+                if value is not None:
+                    entry[family.split(".", 1)[1]] = value
+            tenants[tenant_id] = entry
+        return {
+            "node": agg.name,
+            "tenants": tenants,
+            "top_consumers": _meter.top_consumers(int(top)),
+        }
+
     def render_trace(self) -> str:
         """The ``/trace`` body: host spans + per-hop payload lifecycles as
         Chrome-trace JSON (load it in Perfetto / ``chrome://tracing``)."""
@@ -440,6 +491,16 @@ class MetricsServer:
             # is a data-quality page, not a routing signal — flipping ready
             # would shift traffic off a perfectly serviceable node
             out["history_alerts"] = agg.history.active_alerts()
+        if agg.slo is not None:
+            # same stance: a tenant burning ITS budget is that tenant's
+            # page, not a reason to route every other tenant away
+            out["slo_alerts"] = agg.slo.active_alerts()
+        if agg.canary is not None:
+            # the black-box correctness verdict: a bitwise MISMATCH is the
+            # one signal here that does mean "this node's answers are
+            # wrong" — still surfaced (the operator decides), with the
+            # healthy flag front and center for automation
+            out["canary"] = agg.canary.status()
         from metrics_tpu.obs import federation as _federation
 
         if _federation.remote_count():
@@ -559,6 +620,20 @@ def _make_handler(server: MetricsServer):
                         # e.g. a range query against a node with no history
                         # armed — client-addressable, not a server fault
                         self._reply_json(400, {"error": str(err)})
+                    except ValueError as err:
+                        self._reply_json(400, {"error": str(err)})
+                elif parsed.path == "/slo":
+                    try:
+                        self._reply_json(200, server.render_slo())
+                    except ServeError as err:
+                        # no engine attached: client-addressable (ask the
+                        # root), not a server fault
+                        self._reply_json(400, {"error": str(err)})
+                elif parsed.path == "/tenants":
+                    params = parse_qs(parsed.query)
+                    top = (params.get("top") or ["10"])[0]
+                    try:
+                        self._reply_json(200, server.render_tenants(int(top)))
                     except ValueError as err:
                         self._reply_json(400, {"error": str(err)})
                 elif parsed.path.startswith("/experiment/"):

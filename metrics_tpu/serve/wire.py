@@ -256,7 +256,14 @@ def encode_state(
             watermark, or any per-client monotonic counter).
         collection: logical collection name (defaults to ``tenant``).
         meta: free-form JSON-safe side data (forward-compatible: decoders
-            keep keys they don't understand).
+            keep keys they don't understand). Reserved keys in use:
+            ``trace`` (hop provenance, added below when obs is armed),
+            ``rehomed_from`` / ``generation`` (elastic handoff and
+            failover fencing), and ``canary: True`` — stamped by
+            :class:`metrics_tpu.obs.prober.CanaryProber` so synthetic
+            known-answer traffic through the reserved ``__canary__``
+            tenant is distinguishable on the wire from real tenant data
+            (no structural change; the payload folds like any other).
         max_bytes: refuse to build a payload larger than this (``None``
             disables the check). Bounded payloads are the serving-tier
             contract — an unbounded ``cat`` state should stream through a

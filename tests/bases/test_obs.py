@@ -416,6 +416,28 @@ class TestExport:
         assert obs.enabled() is True
         assert obs.counters() == {}
 
+    def test_reset_clears_metering_state(self):
+        """The SLO plane's usage-metering satellite state (pending charge
+        map, heavy-hitter sketch, tenant name table) is measurement-window
+        state and must clear with the registry — a bench round or test
+        must not inherit the previous round's top-consumer ranking.
+        (The serve-tier SLO engine + canary prober reset coverage lives in
+        ``tests/serve/test_slo.py`` beside their fixtures.)"""
+        from metrics_tpu.obs import meter
+
+        obs.enable()
+        meter.charge("tenant-a", 1024.0)
+        meter.charge("tenant-b", 64.0)
+        assert meter.pending_tenants() == 2
+        top = meter.top_consumers(k=4)
+        assert [row["tenant"] for row in top] == ["tenant-a", "tenant-b"]
+        obs.reset()
+        assert meter.pending_tenants() == 0
+        assert meter.top_consumers(k=4) == []
+        # the module stays usable after the clear
+        meter.charge("tenant-c", 8.0)
+        assert [row["tenant"] for row in meter.top_consumers(k=1)] == ["tenant-c"]
+
 
 class TestHistograms:
     def test_observe_counts_sum_and_percentiles(self):
@@ -455,6 +477,46 @@ class TestHistograms:
         obs.observe("x", 1.0)
         with pytest.raises(ValueError, match="quantile"):
             obs.get_histogram("x").percentile(1.5)
+
+    def test_percentile_monotone_on_sparse_series_and_merges(self):
+        """Property pin for the sparse-series interpolation: on 1- and
+        2-bucket snapshots, ``percentile(q)`` must be non-decreasing in q
+        and land exactly on ``min``/``max`` at the ends — both straight
+        from the registry and after a bucketwise :func:`merge_snapshots`
+        round trip (fleet percentiles run the same anchoring math on
+        summed buckets)."""
+        from metrics_tpu.obs.registry import HISTOGRAM_EDGES, HistogramSnapshot
+
+        obs.enable()
+        cases = {
+            "hist.one": [3.7] * 5,  # one interior bucket
+            "hist.two": [1.0] * 3 + [50.0] * 2,  # two separated buckets
+            "hist.tight": [2.0, 2.0 + 1e-7],  # two values, one bucket
+            "hist.over": [1.0, 10.0 * HISTOGRAM_EDGES[-1]],  # interior + overflow
+        }
+        qs = [i / 20 for i in range(21)]
+        for name, values in cases.items():
+            for v in values:
+                obs.observe(name, v)
+
+        def check(h, vmin, vmax, label):
+            got = [h.percentile(q) for q in qs]
+            assert got == sorted(got), f"{label}: percentiles not monotone: {got}"
+            assert got[0] == vmin and got[-1] == vmax, label
+
+        for name, values in cases.items():
+            check(obs.get_histogram(name), min(values), max(values), name)
+
+        # post-merge: two nodes observing the same series — bucket counts
+        # double, extremes survive, and monotonicity must hold on the
+        # reconstructed fleet snapshot too
+        a, b = obs.snapshot(), obs.snapshot()
+        a["node"], b["node"] = "nodeA", "nodeB"
+        merged = obs.merge_snapshots(a, b)
+        for name, values in cases.items():
+            h = HistogramSnapshot.from_dict(merged["histograms"][name])
+            assert h.count == 2 * len(values)
+            check(h, min(values), max(values), f"merged:{name}")
 
     def test_snapshot_and_reset(self):
         obs.enable()
@@ -556,6 +618,14 @@ class TestPrometheusRoundTrip:
         # experimentation tenants ship HELP like any built-in
         obs.inc("llm.rag_queries", 1)
         obs.inc("experiment.decisions", 1, exp="e1", verdict="ship")
+        # SLO-plane families (PR 20): counters, gauges and histograms from
+        # all three new prefixes ship HELP and must survive the re-parse
+        obs.inc("slo.alerts", 1, tenant="t0", slo="ingest")
+        obs.set_gauge("slo.budget_remaining", 0.75, tenant="t0", slo="ingest")
+        obs.inc("meter.wire_bytes", 512.0, tenant="t0")
+        obs.observe("meter.fold_ms", 1.5, tenant="t0")
+        obs.inc("probe.results", 1, node="n0", verdict="match")
+        obs.set_gauge("probe.healthy", 1.0, node="n0")
         for v in (0.5, 5.0, 50.0):
             obs.observe("lat", v, step="epoch")
         obs.register_help("events", "hostile\\help\ntext")
@@ -576,6 +646,19 @@ class TestPrometheusRoundTrip:
         assert helps["metrics_tpu_experiment_decisions"] == obs.family_help(
             "experiment.decisions"
         )
+        # every SLO-plane family exercised above carries a registered HELP
+        for family, prom in (
+            ("slo.alerts", "metrics_tpu_slo_alerts"),
+            ("slo.budget_remaining", "metrics_tpu_slo_budget_remaining"),
+            ("meter.wire_bytes", "metrics_tpu_meter_wire_bytes"),
+            ("meter.fold_ms", "metrics_tpu_meter_fold_ms"),
+            ("probe.results", "metrics_tpu_probe_results"),
+            ("probe.healthy", "metrics_tpu_probe_healthy"),
+        ):
+            assert obs.family_help(family), family
+            assert helps[prom] == obs.family_help(family)
+        assert types["metrics_tpu_slo_budget_remaining"] == "gauge"
+        assert types["metrics_tpu_meter_fold_ms"] == "histogram"
         assert "metrics_tpu_level" not in helps
         by_name = {}
         for name, labels, value in series:

@@ -127,14 +127,28 @@ def main() -> None:
     assert out["verified_bitwise"] is True
     assert np.isfinite(out["serve_e2e_freshness_ms"]), out
     assert np.isfinite(out["serve_hop_fold_p99_ms"]), out
-    total_hops = sum(
+    # the family carries TWO views of the same event since the SLO plane
+    # landed: the node-only series and the per-tenant variant the
+    # freshness SLI differences — each must account for every accepted
+    # payload exactly once (duplicates and stale replays leave no record)
+    node_hops = sum(
         hist["count"]
         for key, hist in obs.histograms().items()
-        if key.startswith("serve.hop_queue_wait_ms{") and "flat-reference" not in key
+        if key.startswith("serve.hop_queue_wait_ms{")
+        and "flat-reference" not in key
+        and "tenant=" not in key
     )
-    assert total_hops == out["accepted_payloads"] > 0, (
-        f"hop records ({total_hops}) must account for every accepted payload"
-        f" ({out['accepted_payloads']}) under 10% seeded faults"
+    tenant_hops = sum(
+        hist["count"]
+        for key, hist in obs.histograms().items()
+        if key.startswith("serve.hop_queue_wait_ms{")
+        and "flat-reference" not in key
+        and "tenant=" in key
+    )
+    assert node_hops == tenant_hops == out["accepted_payloads"] > 0, (
+        f"hop records (node-only {node_hops}, per-tenant {tenant_hops}) must"
+        f" account for every accepted payload ({out['accepted_payloads']})"
+        " under 10% seeded faults"
     )
 
     # -- 4: zero-cost pin -------------------------------------------------
@@ -148,7 +162,7 @@ def main() -> None:
         "fleet obs smoke OK: 8-client 2-level tree fully hop-attributed,"
         f" root e2e freshness p99 {fresh.p99:.2f}ms, /trace serves"
         f" {len(events)} Chrome-trace events, chaos arm accounted"
-        f" {total_hops} accepted payloads at 10% faults, unarmed wire clean"
+        f" {node_hops} accepted payloads at 10% faults, unarmed wire clean"
     )
 
 

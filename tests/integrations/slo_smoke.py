@@ -1,0 +1,342 @@
+"""CI smoke: the tenant-facing SLO plane under fleet chaos.
+
+Run as ``JAX_PLATFORMS=cpu python -m tests.integrations.slo_smoke``
+(the CI step does, mirroring ``experiment_smoke``). Three tenants ship
+cumulative snapshots through an elastic
+:class:`~metrics_tpu.serve.AggregationTree` under a seeded 10%
+:class:`~metrics_tpu.ft.faults.WireChaos` schedule, with a node JOIN
+and an intermediate HARD-KILL + supervised heal mid-run. The tree root
+forwards its merged state to a history-armed, firewall-armed SLO root
+where a :class:`~metrics_tpu.obs.slo.SLOEngine` evaluates per-tenant
+error budgets on every cut and a
+:class:`~metrics_tpu.obs.prober.CanaryProber` round-trips known-answer
+payloads through the real ingest path.
+
+Acceptance, all asserted here:
+
+* one tenant (``gamma``) suffers an injected two-interval wire-error
+  flood: its burn-rate alert fires **exactly once** (edge-triggered,
+  one ``slo.alerts`` increment, one ``SLO BURN`` warning) and clears
+  after the flood ages out of both windows;
+* the healthy tenants never alert and keep (near-)full error budgets
+  riding the SAME 10% chaos traffic;
+* the canary stays green through the fleet kill+heal AND the SLO root's
+  own checkpoint kill+restore (the prober rebinds, keeping its oracle);
+* the budget table survives the checkpoint kill+restore **bitwise**
+  (the revived engine's state equals the pre-kill state exactly);
+* ``GET /slo`` and ``GET /tenants`` parse and match in-process state.
+"""
+import json
+import os
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SEED = 20260807
+TENANTS = ("alpha", "beta", "gamma")
+FLOOD_TENANT = "gamma"
+N_CLIENTS = 30  # per tenant
+N_INTERVALS = 6
+FAN_OUT = (2, 4)
+CUT_SPACING_S = 100.0
+FLOOD_INTERVALS = (2, 3)
+FLOOD_ERRORS = 150  # corrupt blobs per flood interval
+KILL_AFTER = 3  # checkpoint + kill + restore the SLO root after this cut
+
+
+def _factory():
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.streaming import StreamingQuantile
+
+    return MetricCollection(
+        {"seen": SumMetric(), "lat": StreamingQuantile(num_bins=64, lo=0.0, hi=1.0)}
+    )
+
+
+def _slos():
+    """Window/burn parameters matched to the manual cut cadence: cuts
+    land CUT_SPACING_S apart, so the fast window sees one cut's delta
+    and the slow window roughly two — a two-interval flood trips both
+    rules at its first cut and ages out two cuts after it stops."""
+    from metrics_tpu.obs.slo import SLODef
+
+    return [
+        SLODef(
+            "ingest",
+            sli="ingest_success",
+            objective=0.9,
+            fast_window_s=60.0,
+            slow_window_s=240.0,
+            fast_burn=3.0,
+            slow_burn=2.0,
+        ),
+        SLODef("freshness", sli="freshness", objective=0.5, threshold_ms=60_000.0),
+        SLODef("canary", sli="canary", objective=0.999),
+    ]
+
+
+def _client_snapshots():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    out = {}
+    for tid in TENANTS:
+        for c in range(N_CLIENTS):
+            cid = f"{tid}:c{c:03d}"
+            rng = np.random.default_rng(abs(hash(tid)) % 100_000 + c)
+            coll = _factory()
+            blobs = []
+            for interval in range(N_INTERVALS):
+                vals = np.clip(rng.normal(0.5, 0.1, 16), 0.0, 1.0).astype(np.float32)
+                coll["seen"].update(jnp.asarray(float(len(vals))))
+                coll["lat"].update(jnp.asarray(vals))
+                blobs.append(
+                    encode_state(coll, tenant=tid, client_id=cid, watermark=(0, interval))
+                )
+            out[cid] = (tid, blobs)
+    return out
+
+
+def _corrupt_blobs(interval: int) -> list:
+    """FLOOD_ERRORS wire blobs for the flood tenant with valid framing
+    but a flipped payload byte: the header parses (so the error is
+    ATTRIBUTED to the tenant) and the crc32 refuses the body (so each
+    counts one ``slo.ingest_errors{reason=wire}``). Distinct spoofed
+    client ids keep any single identity under the firewall's circuit
+    threshold — this is a tenant-level burn, not one bad client."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.serve.wire import encode_state
+
+    blobs = []
+    for i in range(FLOOD_ERRORS):
+        coll = _factory()
+        coll["seen"].update(jnp.asarray(1.0))
+        blob = bytearray(
+            encode_state(
+                coll,
+                tenant=FLOOD_TENANT,
+                client_id=f"ghost-{interval}-{i:03d}",
+                watermark=(0, 0),
+            )
+        )
+        blob[-3] ^= 0xFF
+        blobs.append(bytes(blob))
+    return blobs
+
+
+def _get_json(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def main() -> None:
+    import tempfile
+    import warnings
+
+    from metrics_tpu import obs
+    from metrics_tpu.ft import faults
+    from metrics_tpu.obs.prober import CANARY_TENANT, CanaryProber, canary_metrics
+    from metrics_tpu.obs.slo import SLOEngine
+    from metrics_tpu.serve import (
+        AggregationTree,
+        Aggregator,
+        ElasticFleet,
+        HistoryConfig,
+        MetricsServer,
+        ResilienceConfig,
+        Supervisor,
+    )
+    from metrics_tpu.serve.wire import WireFormatError, encode_state, peek_header
+
+    obs.reset()
+    obs.enable()
+    root_dir = tempfile.mkdtemp(prefix="slo_smoke_")
+    tenants = {tid: _factory for tid in TENANTS}
+    snapshots = _client_snapshots()
+    chaos = faults.WireChaos(
+        SEED, p_drop=0.025, p_duplicate=0.025, p_reorder=0.025, p_corrupt=0.025, p_delay=0.0
+    )
+    tree = AggregationTree(
+        fan_out=FAN_OUT, tenants=tenants, resilience=ResilienceConfig(error_threshold=3)
+    )
+    fleet = ElasticFleet(tree, seed=SEED)
+    supervisor = Supervisor(tree, heartbeat_timeout_s=5.0, name="supervisor", warn=False)
+
+    def build_slo_root(name):
+        agg = Aggregator(
+            name,
+            checkpoint_dir=root_dir,
+            history=HistoryConfig(cut_every_s=float("inf")),
+            resilience=True,  # the firewall seam attributes wire errors per tenant
+        )
+        for tid, fac in tenants.items():
+            agg.register_tenant(tid, fac)
+        agg.register_tenant(CANARY_TENANT, canary_metrics)
+        engine = SLOEngine(agg, slos=_slos())
+        return agg, engine
+
+    slo_root, engine = build_slo_root("slo-root")
+    prober = CanaryProber(slo_root)
+
+    def deliver(blobs) -> None:
+        for blob in blobs:
+            try:
+                _, header = peek_header(blob)
+            except WireFormatError:
+                continue  # framing mangled: refused before routing
+            cid = str(header["client"])
+            try:
+                fleet.router.route(cid).ingest(blob)  # router consulted PER SHIP
+            except WireFormatError:
+                pass  # corrupt-in-flight: refused by the crc32
+
+    restored = False
+    joined = kill_victim = None
+    wire_errors_injected = 0
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for interval in range(N_INTERVALS):
+            for cid in sorted(snapshots):
+                _, now_blobs = chaos.plan(snapshots[cid][1][interval])
+                deliver(now_blobs)
+            deliver(chaos.end_round())
+            if interval == 0:  # elastic churn arc: JOIN under live traffic
+                fleet.pump()
+                joined = faults.join_node(fleet)
+                assert joined.name in fleet.router.members()
+            if interval == 2:  # intermediate HARD-KILL + supervised heal
+                fleet.pump()
+                kill_victim = chaos.choice(tree.levels[1])
+                faults.kill_node(kill_victim)
+                assert "dead_node" in {f["kind"] for f in supervisor.check()["findings"]}
+                actions = supervisor.heal()
+                assert any(
+                    a["action"] == "rebuild_node" and a["node"] == kill_victim.name
+                    for a in actions
+                )
+                deliver(chaos.flush())
+            fleet.pump(rounds=3)
+            tree.root.aggregator.flush()
+            for tid in sorted(tenants):
+                slo_root.ingest(
+                    encode_state(
+                        tree.root.aggregator.collection(tid),
+                        tenant=tid,
+                        client_id="tree-root",
+                        watermark=(0, interval),
+                    )
+                )
+            if interval in FLOOD_INTERVALS:
+                for bad in _corrupt_blobs(interval):
+                    try:
+                        slo_root.ingest(bad)
+                    except WireFormatError:
+                        wire_errors_injected += 1
+            # the canary rides the same ingest path every interval —
+            # through the fleet kill+heal AND the SLO root's kill+restore
+            assert prober.probe() == "match", prober.status()
+            slo_root.flush()
+            slo_root.history.cut(slo_root, now=interval * CUT_SPACING_S)
+            if interval == FLOOD_INTERVALS[0]:
+                rec = engine.budget(FLOOD_TENANT, "ingest")
+                assert rec is not None and rec.firing and rec.alerts == 1, (
+                    "the flood must trip the dual-window rule at its first cut"
+                )
+            if interval == KILL_AFTER:
+                # checkpoint, then SIGKILL-sim: drop the SLO root with no
+                # drain; a fresh root + engine restores (attach-before-
+                # restore) and the prober REBINDS, keeping its oracle
+                want_state = json.dumps(engine.state_for_checkpoint(), sort_keys=True)
+                slo_root.save()
+                slo_root, engine = build_slo_root("slo-root-revived")
+                slo_root.restore()
+                prober.rebind(slo_root)
+                restored = True
+                got_state = json.dumps(engine.state_for_checkpoint(), sort_keys=True)
+                assert got_state == want_state, (
+                    "the budget table must survive checkpoint kill+restore bitwise"
+                )
+                assert engine.budget(FLOOD_TENANT, "ingest").firing, (
+                    "the restored record must still be firing — no duplicate edge"
+                )
+    assert restored and joined is not None and kill_victim is not None
+    assert wire_errors_injected == FLOOD_ERRORS * len(FLOOD_INTERVALS)
+
+    # ---- exactly one alert, edge-triggered, recovered --------------------
+    burns = [w for w in caught if "SLO BURN" in str(w.message)]
+    assert len(burns) == 1, [str(w.message) for w in burns]
+    rec = engine.budget(FLOOD_TENANT, "ingest")
+    assert rec.alerts == 1, rec.to_dict()
+    assert rec.firing is False, "the flood must age out of both windows by the last cut"
+    assert obs.get_counter("slo.alerts", tenant=FLOOD_TENANT, slo="ingest") == 1
+    assert obs.get_gauge("slo.alert_active", tenant=FLOOD_TENANT, slo="ingest") == 0.0
+    assert engine.active_alerts() == []
+
+    # ---- healthy tenants unaffected --------------------------------------
+    flood_remaining = rec.budget_remaining(
+        (N_INTERVALS - 1) * CUT_SPACING_S, engine._slos["ingest"]
+    )
+    for tid in TENANTS:
+        if tid == FLOOD_TENANT:
+            continue
+        healthy = engine.budget(tid, "ingest")
+        assert healthy is not None and healthy.alerts == 0 and not healthy.firing
+        remaining = healthy.budget_remaining(
+            (N_INTERVALS - 1) * CUT_SPACING_S, engine._slos["ingest"]
+        )
+        assert remaining > 0.7, (tid, remaining)
+        assert remaining > flood_remaining, (
+            "the flood tenant must have burned visibly more budget than the"
+            " healthy tenants riding the same chaos"
+        )
+        assert obs.get_counter("slo.alerts", tenant=tid, slo="ingest") == 0
+
+    # ---- canary green end to end -----------------------------------------
+    status = prober.status()
+    assert status["healthy"] is True and status["mismatches"] == 0
+    assert status["matches"] == N_INTERVALS
+    canary_rec = engine.budget(CANARY_TENANT, "canary")
+    assert canary_rec is not None and canary_rec.bad == 0.0
+
+    # ---- /slo and /tenants parse and match in-process state --------------
+    server = MetricsServer(slo_root, port=0, arm_obs=False).start()
+    try:
+        slo_body = _get_json(server.port, "/slo")
+        assert slo_body == json.loads(json.dumps(server.render_slo())), (
+            "GET /slo must match the in-process report"
+        )
+        assert set(slo_body["slos"]) == {"ingest", "freshness", "canary"}
+        assert slo_body["tenants"][FLOOD_TENANT]["ingest"]["alerts"] == 1
+        assert slo_body["active_alerts"] == []
+        tenants_body = _get_json(server.port, "/tenants")
+        assert set(tenants_body["tenants"]) >= set(TENANTS) | {CANARY_TENANT}
+        for tid in TENANTS:
+            assert tenants_body["tenants"][tid]["wire_bytes"] > 0
+        ranked = {row["tenant"] for row in tenants_body["top_consumers"]}
+        assert set(TENANTS) <= ranked
+        ready = _get_json(server.port, "/healthz/ready")
+        assert ready["canary"]["healthy"] is True
+        assert ready["slo_alerts"] == []
+    finally:
+        server.stop()
+
+    faults_injected = sum(v for k, v in chaos.counts.items() if k != "deliver")
+    print(
+        f"slo smoke: {len(TENANTS) * N_CLIENTS} clients x {N_INTERVALS} intervals at"
+        f" 10% wire faults ({faults_injected} injected) through join({joined.name}) +"
+        f" hard-kill({kill_victim.name}) + heal, SLO root kill+restore @"
+        f" t={KILL_AFTER} — {FLOOD_TENANT} alert fired exactly once and recovered,"
+        f" healthy budgets intact, canary {status['matches']}/{N_INTERVALS} green,"
+        " budgets bitwise across restore, /slo + /tenants consistent",
+        flush=True,
+    )
+    print("slo smoke OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -284,6 +284,70 @@ class TestHealth:
             srv.stop()
 
 
+class TestSLORoutes:
+    def test_slo_400_without_engine(self, server):
+        """SLOs are evaluated at the root; a plain node answers 400 with
+        the attach hint, not a 500."""
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server, "/slo")
+        assert err.value.code == 400
+        assert "SLO engine" in json.load(err.value)["error"]
+
+    def test_slo_route_serves_the_engine_report(self):
+        from metrics_tpu import obs
+        from metrics_tpu.serve import HistoryConfig
+
+        obs.reset()  # earlier servers in this module armed obs and charged counters
+        obs.enable()
+        try:
+            agg = Aggregator("slo-http", history=HistoryConfig(cut_every_s=float("inf")))
+            agg.register_tenant(TENANT, factory)
+            engine = obs.SLOEngine(agg)
+            agg.ingest(snapshot("c0", (0, 0)))
+            agg.flush()
+            agg.history.cut(agg, now=0.0)
+            srv = MetricsServer(agg, port=0).start()
+            try:
+                body = json.load(_get(srv, "/slo"))
+                assert body["node"] == "slo-http"
+                assert set(body["slos"]) == set(engine.slo_names())
+                assert body["tenants"][TENANT]["ingest"]["good"] == 1.0
+                assert body["active_alerts"] == []
+            finally:
+                srv.stop()
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+    def test_tenants_route_meters_usage_and_honors_top(self):
+        from metrics_tpu import obs
+
+        obs.reset()  # isolate the metering sketch from earlier armed servers
+        obs.enable()
+        try:
+            agg = Aggregator("meter-http")
+            agg.register_tenant(TENANT, factory)
+            agg.register_tenant("other", factory)
+            agg.ingest(snapshot("c0", (0, 0)))
+            agg.flush()
+            srv = MetricsServer(agg, port=0).start()
+            try:
+                body = json.load(_get(srv, "/tenants"))
+                assert set(body["tenants"]) == {TENANT, "other"}
+                assert body["tenants"][TENANT]["wire_bytes"] > 0
+                assert body["tenants"][TENANT]["clients"] == 1
+                # ?top= bounds the sketch ranking, not the exact table
+                capped = json.load(_get(srv, "/tenants?top=1"))
+                assert len(capped["top_consumers"]) == 1
+                assert capped["top_consumers"][0]["tenant"] == TENANT
+                assert set(capped["tenants"]) == {TENANT, "other"}
+            finally:
+                srv.stop()
+        finally:
+            obs.enable(False)
+            obs.reset()
+
+
 class TestIngestSizeCap:
     def test_oversized_post_rejected_before_reading_body(self, server):
         """A Content-Length past the wire cap answers 413 without buffering

@@ -1,0 +1,552 @@
+"""Tenant-facing SLO plane: SLIs, burn-rate budgets, metering, canaries.
+
+Pins the PR-20 contracts: burn-rate alerts are edge-triggered (fire
+exactly once per burn, re-arm on recovery), error budgets survive
+checkpoint kill+restore bitwise and failover generations via rebasing
+fences, the canary prober verifies query answers bitwise against its
+local oracle (wire loss reads ``pending``, never a false red), usage
+metering attributes bytes per tenant with a bounded sketch ranking, the
+per-tenant hop/freshness series stay under the registry's cardinality
+cap against a hostile many-tenant flood, and ``obs.reset()`` clears all
+of it.
+"""
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu.obs as obs
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.obs import meter
+from metrics_tpu.obs.prober import CANARY_TENANT, CanaryProber, canary_metrics
+from metrics_tpu.obs.slo import ErrorBudget, SLODef, SLOEngine, default_slos
+from metrics_tpu.serve import Aggregator, ServeError
+from metrics_tpu.serve.history import HistoryConfig
+from metrics_tpu.serve.wire import encode_state
+
+TENANT = "t0"
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    was = obs.enabled()
+    obs.enable(False)
+    obs.reset()
+    yield
+    obs.reset()
+    obs.enable(was)
+
+
+def factory() -> MetricCollection:
+    return MetricCollection({"seen": SumMetric()})
+
+
+def manual_history(**kwargs) -> HistoryConfig:
+    kwargs.setdefault("cut_every_s", float("inf"))
+    return HistoryConfig(**kwargs)
+
+
+def ship(agg: Aggregator, interval: int, *, tenant: str = TENANT, cid: str = "c0") -> None:
+    """One client's cumulative state through ``interval``."""
+    coll = factory()
+    for _ in range(interval + 1):
+        coll["seen"].update(jnp.asarray(1.0))
+    agg.ingest(encode_state(coll, tenant=tenant, client_id=cid, watermark=(0, interval)))
+    agg.flush()
+
+
+def fast_slo() -> SLODef:
+    """Deterministic small-window objective for manually-timed cuts:
+    cuts land 100s apart, so the fast window sees exactly the last cut's
+    delta and the slow window the last two."""
+    return SLODef(
+        "ingest",
+        sli="ingest_success",
+        objective=0.9,
+        fast_window_s=60.0,
+        slow_window_s=240.0,
+        fast_burn=2.0,
+        slow_burn=1.5,
+    )
+
+
+class TestSLODef:
+    def test_defaults_cover_the_four_built_in_slis(self):
+        slos = default_slos()
+        assert sorted(s.name for s in slos) == ["canary", "freshness", "ingest", "query_latency"]
+        assert {s.sli for s in slos} == {"canary", "freshness", "ingest_success", "query_latency"}
+        for s in slos:
+            assert 0.0 < s.objective < 1.0
+            assert s.budget_fraction == pytest.approx(1.0 - s.objective)
+
+    def test_unknown_sli_rejected(self):
+        with pytest.raises(ValueError, match="sli"):
+            SLODef("x", sli="vibes", objective=0.99)
+
+    def test_objective_bounds_enforced(self):
+        for bad in (0.0, 1.0, -0.5, 1.5):
+            with pytest.raises(ValueError, match="objective"):
+                SLODef("x", sli="ingest_success", objective=bad)
+
+    def test_histogram_slis_require_threshold(self):
+        with pytest.raises(ValueError, match="threshold_ms"):
+            SLODef("x", sli="freshness", objective=0.99)
+        with pytest.raises(ValueError, match="threshold_ms"):
+            SLODef("x", sli="query_latency", objective=0.99)
+
+    def test_fast_window_must_not_exceed_slow(self):
+        with pytest.raises(ValueError, match="window"):
+            SLODef(
+                "x", sli="ingest_success", objective=0.99,
+                fast_window_s=600.0, slow_window_s=300.0,
+            )
+
+
+class TestErrorBudget:
+    def test_counter_reset_rebases_instead_of_double_counting(self):
+        rec = ErrorBudget("t", "s")
+        rec.observe(0.0, 10.0, 1.0, horizon_s=1e9)
+        assert (rec.good, rec.bad) == (10.0, 1.0)
+        # the source registry restarted: raw totals fall BELOW the stored
+        # baseline — the new reading is new work, counted from zero
+        rec.observe(1.0, 2.0, 0.0, horizon_s=1e9)
+        assert (rec.good, rec.bad) == (12.0, 1.0)
+        rec.observe(2.0, 3.0, 1.0, horizon_s=1e9)
+        assert (rec.good, rec.bad) == (13.0, 2.0)
+
+    def test_window_differencing_uses_the_newest_anchor(self):
+        rec = ErrorBudget("t", "s")
+        rec.observe(0.0, 10.0, 0.0, horizon_s=1e9)
+        rec.observe(100.0, 20.0, 0.0, horizon_s=1e9)
+        rec.observe(200.0, 20.0, 10.0, horizon_s=1e9)
+        # window [140, 200]: baseline is the t=100 sample, not the origin
+        assert rec.window_counts(200.0, 60.0) == (0.0, 10.0)
+        assert rec.burn_rate(200.0, 60.0, 0.1) == pytest.approx(10.0)
+        assert rec.sli(200.0, 60.0) == pytest.approx(0.0)
+        # the full-horizon window sees everything
+        assert rec.window_counts(200.0, 1e6) == (20.0, 10.0)
+
+    def test_budget_remaining_clamped_to_unit_interval(self):
+        slo = fast_slo()
+        rec = ErrorBudget("t", "s")
+        rec.observe(0.0, 0.0, 100.0, horizon_s=1e9)  # all bad: burn >> 1
+        assert rec.budget_remaining(0.0, slo) == 0.0
+        fresh = ErrorBudget("t", "s")
+        fresh.observe(0.0, 100.0, 0.0, horizon_s=1e9)
+        assert fresh.budget_remaining(0.0, slo) == 1.0
+
+    def test_json_round_trip_is_bitwise(self):
+        rec = ErrorBudget("t", "s", generation=3)
+        for i in range(5):
+            rec.observe(float(i), 10.0 * (i + 1), float(i), horizon_s=1e9)
+        rec.firing = True
+        rec.alerts = 2
+        rec.fenced = 1
+        revived = ErrorBudget.from_dict(json.loads(json.dumps(rec.to_dict())))
+        assert json.dumps(revived.to_dict(), sort_keys=True) == json.dumps(
+            rec.to_dict(), sort_keys=True
+        )
+
+    def test_sample_ring_stays_bounded(self):
+        from metrics_tpu.obs.slo import _MAX_SAMPLES
+
+        rec = ErrorBudget("t", "s")
+        for i in range(_MAX_SAMPLES + 200):
+            rec.observe(float(i), float(i), 0.0, horizon_s=1e12)
+        assert len(rec.samples) <= _MAX_SAMPLES
+        # totals are unaffected by pruning
+        assert rec.good == float(_MAX_SAMPLES + 199)
+
+
+def engine_agg(slos=None, **agg_kwargs):
+    agg = Aggregator("slo-node", history=manual_history(), **agg_kwargs)
+    agg.register_tenant(TENANT, factory)
+    engine = SLOEngine(agg, slos=[fast_slo()] if slos is None else slos)
+    return agg, engine
+
+
+class TestSLOEngine:
+    def test_requires_history_armed(self):
+        bare = Aggregator("bare")
+        with pytest.raises(ServeError, match="history"):
+            SLOEngine(bare)
+
+    def test_duplicate_slo_names_rejected(self):
+        agg = Aggregator("dup", history=manual_history())
+        with pytest.raises(ValueError, match="duplicate"):
+            SLOEngine(agg, slos=[fast_slo(), fast_slo()])
+
+    def test_attaches_as_aggregator_slo(self):
+        agg, engine = engine_agg()
+        assert agg.slo is engine
+        assert engine.slo_names() == ["ingest"]
+
+    def test_cut_evaluates_and_records_series(self):
+        obs.enable()
+        agg, engine = engine_agg()
+        ship(agg, 0)
+        agg.history.cut(agg, now=0.0)
+        assert obs.get_counter("slo.evaluations", slo="ingest") == 1
+        rec = engine.budget(TENANT, "ingest")
+        assert rec is not None and rec.evaluations == 1
+        assert (rec.good, rec.bad) == (1.0, 0.0)
+        assert obs.get_gauge("slo.sli", tenant=TENANT, slo="ingest") == 1.0
+        assert obs.get_gauge("slo.budget_remaining", tenant=TENANT, slo="ingest") == 1.0
+        # the cut also refreshed the per-tenant history-ring footprint
+        assert obs.get_gauge("meter.history_bytes", tenant=TENANT) > 0
+
+    def test_burn_alert_fires_once_clears_and_rearms(self):
+        """The full arc: healthy -> flood (alert EDGE, counted once) ->
+        still burning (no double count) -> recovery (gauge clears) ->
+        second flood (new edge, counter re-armed) — and the one-shot
+        warning prints exactly once across both edges."""
+        obs.enable()
+        agg, engine = engine_agg()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # t=0,100: healthy baseline
+            ship(agg, 0)
+            agg.history.cut(agg, now=0.0)
+            ship(agg, 1)
+            agg.history.cut(agg, now=100.0)
+            assert obs.get_counter("slo.alerts", tenant=TENANT, slo="ingest") == 0.0
+            # t=200: flood — one good ingest, 50 failures
+            obs.inc("slo.ingest_errors", 50, tenant=TENANT, reason="accept")
+            ship(agg, 2)
+            agg.history.cut(agg, now=200.0)
+            rec = engine.budget(TENANT, "ingest")
+            assert rec.firing is True and rec.alerts == 1
+            assert obs.get_counter("slo.alerts", tenant=TENANT, slo="ingest") == 1.0
+            assert obs.get_gauge("slo.alert_active", tenant=TENANT, slo="ingest") == 1.0
+            assert engine.active_alerts() == [{"tenant": TENANT, "slo": "ingest", "alerts": 1}]
+            # t=210: still burning — level holds, edge counter does not
+            obs.inc("slo.ingest_errors", 10, tenant=TENANT, reason="shed")
+            agg.history.cut(agg, now=210.0)
+            assert obs.get_counter("slo.alerts", tenant=TENANT, slo="ingest") == 1.0
+            # t=600: the flood aged past both windows — recovery edge
+            ship(agg, 3)
+            agg.history.cut(agg, now=600.0)
+            rec = engine.budget(TENANT, "ingest")
+            assert rec.firing is False
+            assert obs.get_gauge("slo.alert_active", tenant=TENANT, slo="ingest") == 0.0
+            assert engine.active_alerts() == []
+            # t=700: a SECOND flood is a new edge — the counter re-arms
+            obs.inc("slo.ingest_errors", 50, tenant=TENANT, reason="backpressure")
+            ship(agg, 4)
+            agg.history.cut(agg, now=700.0)
+            assert engine.budget(TENANT, "ingest").alerts == 2
+            assert obs.get_counter("slo.alerts", tenant=TENANT, slo="ingest") == 2.0
+        burns = [w for w in caught if "SLO BURN" in str(w.message)]
+        assert len(burns) == 1  # one-shot: the second edge counts, not warns
+
+    def test_generation_fence_rebases_raw_baselines(self):
+        """A failover promotion mints a new generation whose registry
+        restarts from zero — differencing across it would go negative.
+        The fence rebases: consumed budget survives, nothing is lost."""
+        obs.enable()
+        agg, engine = engine_agg()
+        ship(agg, 0)
+        agg.history.cut(agg, now=0.0)
+        rec = engine.budget(TENANT, "ingest")
+        assert (rec.good, rec.fenced) == (1.0, 0)
+        # simulate promotion: new generation + registry counter restart
+        # (registry-only reset: obs.reset() would clear the budget table
+        # itself, which is the MEASUREMENT-window contract, not failover)
+        from metrics_tpu.obs import registry as _registry
+
+        agg.history.generation += 1
+        _registry.reset()
+        ship(agg, 1)  # fresh registry: serve.ingests restarts at 1
+        agg.history.cut(agg, now=100.0)
+        rec = engine.budget(TENANT, "ingest")
+        assert rec.fenced == 1 and rec.generation == agg.history.generation
+        assert rec.good == 2.0  # 1 pre-failover + 1 post, no double count
+        assert obs.get_counter("slo.fenced_evaluations", tenant=TENANT, slo="ingest") == 1.0
+
+    def test_budget_state_rides_checkpoints_bitwise(self, tmp_path):
+        obs.enable()
+        agg = Aggregator("ckpt", checkpoint_dir=str(tmp_path), history=manual_history())
+        agg.register_tenant(TENANT, factory)
+        engine = SLOEngine(agg, slos=[fast_slo()])
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*SLO BURN.*")
+            ship(agg, 0)
+            agg.history.cut(agg, now=0.0)
+            obs.inc("slo.ingest_errors", 50, tenant=TENANT, reason="accept")
+            ship(agg, 1)
+            agg.history.cut(agg, now=100.0)
+        want = json.dumps(engine.state_for_checkpoint(), sort_keys=True)
+        assert engine.budget(TENANT, "ingest").firing is True
+        agg.save()
+
+        revived = Aggregator("ckpt2", checkpoint_dir=str(tmp_path), history=manual_history())
+        revived.register_tenant(TENANT, factory)
+        engine2 = SLOEngine(revived, slos=[fast_slo()])  # attach BEFORE restore
+        revived.restore()
+        assert json.dumps(engine2.state_for_checkpoint(), sort_keys=True) == want
+        # the revived firing record re-sets the level gauge and suppresses
+        # a duplicate one-shot warn (the edge was announced pre-kill)
+        assert obs.get_gauge("slo.alert_active", tenant=TENANT, slo="ingest") == 1.0
+        assert ("alert", TENANT, "ingest") in engine2._warned
+
+    def test_report_shape_and_query_counter(self):
+        obs.enable()
+        agg, engine = engine_agg()
+        ship(agg, 0)
+        agg.history.cut(agg, now=0.0)
+        report = engine.report(now=0.0)
+        assert report["node"] == "slo-node"
+        assert set(report["slos"]) == {"ingest"}
+        entry = report["tenants"][TENANT]["ingest"]
+        assert entry["sli"] == 1.0 and entry["firing"] is False
+        assert entry["budget_remaining"] == 1.0
+        assert report["active_alerts"] == []
+        assert obs.get_counter("slo.queries") == 1
+
+    def test_reset_clears_engine_prober_and_meter_state(self):
+        """Satellite (c): ``obs.reset()`` clears the whole SLO plane —
+        budget tables, prober verdict tallies, metering sketch — while
+        the engine/prober stay attached and usable."""
+        obs.enable()
+        agg, engine = engine_agg()
+        prober = CanaryProber(agg)
+        ship(agg, 0)
+        assert prober.probe() == "match"
+        agg.history.cut(agg, now=0.0)
+        assert engine.budget(TENANT, "ingest") is not None
+        assert prober.status()["matches"] == 1
+        assert meter.pending_tenants() > 0 or meter.top_consumers(1)
+
+        obs.reset()
+        assert engine.budget(TENANT, "ingest") is None
+        status = prober.status()
+        assert status["matches"] == 0 and status["last_verdict"] is None
+        assert meter.pending_tenants() == 0 and meter.top_consumers(4) == []
+        # still live: the next probe and cut start a fresh window
+        obs.enable()
+        assert prober.probe() == "match"
+        ship(agg, 1)
+        agg.history.cut(agg, now=100.0)
+        assert engine.budget(TENANT, "ingest").evaluations == 1
+
+
+class TestCanaryProber:
+    def test_probe_matches_through_the_real_path(self):
+        obs.enable()
+        agg = Aggregator("canary-node")
+        prober = CanaryProber(agg)
+        assert agg.canary is prober
+        assert CANARY_TENANT in agg.tenants()
+        for _ in range(3):
+            assert prober.probe() == "match"
+        status = prober.status()
+        assert status["healthy"] is True and status["matches"] == 3
+        assert obs.get_counter("probe.results", node="canary-node", verdict="match") == 3
+        assert obs.get_gauge("probe.healthy", node="canary-node") == 1.0
+        assert obs.get_histogram("probe.round_trip_ms", node="canary-node").count == 3
+
+    def test_dropped_ships_read_pending_never_red(self):
+        """Wire loss must not fake a red canary: nothing was accepted, so
+        the verdict is pending and healthy stays True."""
+        agg = Aggregator("lossy")
+        prober = CanaryProber(agg, ingest=lambda blob: None)  # black hole
+        assert prober.probe() == "pending"
+        status = prober.status()
+        assert status["pending"] == 1 and status["healthy"] is True
+
+    def test_foreign_state_on_the_reserved_tenant_reads_mismatch(self):
+        """The detection contract: state on ``__canary__`` that did not
+        come from this prober's oracle makes the bitwise check fail."""
+        obs.enable()
+        agg = Aggregator("tampered")
+        prober = CanaryProber(agg)
+        assert prober.probe() == "match"
+        intruder = canary_metrics()
+        intruder["checksum"].update(jnp.asarray(999.0))
+        intruder["payloads"].update(jnp.asarray(1.0))
+        agg.ingest(
+            encode_state(intruder, tenant=CANARY_TENANT, client_id="intruder", watermark=(0, 0))
+        )
+        agg.flush()
+        assert prober.verify() == "mismatch"
+        assert prober.status()["healthy"] is False
+        assert obs.get_gauge("probe.healthy", node="tampered") == 0.0
+
+    def test_one_prober_per_aggregator(self):
+        agg = Aggregator("single")
+        CanaryProber(agg)
+        with pytest.raises(ServeError, match="already has a canary prober"):
+            CanaryProber(agg)
+
+    def test_rebind_follows_a_checkpoint_restore(self, tmp_path):
+        """A revived aggregator's restored dedup journal remembers the
+        old canary watermarks, so only the ORIGINAL prober (oracle ring
+        intact) can keep verifying — ``rebind`` carries it across."""
+        agg = Aggregator("canary-a", checkpoint_dir=str(tmp_path))
+        prober = CanaryProber(agg)
+        for _ in range(3):
+            assert prober.probe() == "match"
+        agg.save()
+        revived = Aggregator("canary-b", checkpoint_dir=str(tmp_path))
+        revived.register_tenant(CANARY_TENANT, canary_metrics)
+        revived.restore()
+        prober.rebind(revived)
+        assert revived.canary is prober
+        assert agg.canary is None, "the old node's slot is released"
+        assert prober.probe() == "match", prober.status()
+        assert prober.status()["probes_shipped"] == 4
+        # the released slot accepts a fresh prober; an occupied one refuses
+        CanaryProber(agg)
+        with pytest.raises(ServeError, match="already has a canary prober"):
+            prober.rebind(agg)
+
+    def test_canary_slo_consumes_probe_verdicts(self):
+        obs.enable()
+        agg = Aggregator("canary-slo", history=manual_history())
+        agg.register_tenant(TENANT, factory)
+        engine = SLOEngine(agg)  # default slos include the canary objective
+        prober = CanaryProber(agg)
+        for _ in range(3):
+            prober.probe()
+        agg.history.cut(agg, now=0.0)
+        rec = engine.budget(CANARY_TENANT, "canary")
+        assert rec is not None and (rec.good, rec.bad) == (3.0, 0.0)
+        # the canary SLI never applies to ordinary tenants
+        assert engine.budget(TENANT, "canary") is None
+
+
+class TestMetering:
+    def test_ingest_charges_wire_bytes_per_tenant(self):
+        obs.enable()
+        agg = Aggregator("metered")
+        for t in ("a", "b"):
+            agg.register_tenant(t, factory)
+        ship(agg, 0, tenant="a", cid="c0")
+        ship(agg, 0, tenant="a", cid="c1")
+        ship(agg, 0, tenant="b", cid="c0")
+        assert obs.get_counter("meter.wire_bytes", tenant="a") > obs.get_counter(
+            "meter.wire_bytes", tenant="b"
+        ) > 0
+        rows = meter.top_consumers(k=4)
+        assert [r["tenant"] for r in rows] == ["a", "b"]
+        assert rows[0]["bytes"] == pytest.approx(
+            obs.get_counter("meter.wire_bytes", tenant="a")
+        )
+        # fold/state families landed per tenant too
+        assert obs.get_histogram("meter.fold_ms", tenant="a").count >= 1
+        assert obs.get_gauge("meter.state_bytes", tenant="a") > 0
+
+    def test_tenant_id_hash_is_stable_and_bounded(self):
+        from metrics_tpu.obs.meter import ID_BITS, tenant_id_hash
+
+        ids = {tenant_id_hash(f"tenant-{i}") for i in range(256)}
+        assert len(ids) == 256  # no collisions across a realistic roster
+        for tid in ids:
+            assert 0 <= tid < (1 << ID_BITS)
+        assert tenant_id_hash("x") == tenant_id_hash("x")
+
+    def test_disabled_obs_charges_nothing(self):
+        agg = Aggregator("dark")
+        agg.register_tenant(TENANT, factory)
+        ship(agg, 0)
+        assert meter.pending_tenants() == 0
+        assert meter.top_consumers(4) == []
+        assert obs.counters() == {}
+
+
+class TestPerTenantSeriesAndCardinality:
+    def test_freshness_and_queue_wait_carry_tenant_variants(self):
+        """Satellite (a): the node-only hop series gain per-tenant
+        variants with IDENTICAL sample counts — the node-only series the
+        exactly-once tests pin are untouched."""
+        obs.enable()
+        agg = Aggregator("pt")
+        agg.register_tenant(TENANT, factory)
+        for c in range(3):
+            ship(agg, 0, cid=f"c{c}")
+        node_only = obs.get_histogram("serve.hop_queue_wait_ms", node="pt")
+        per_tenant = obs.get_histogram("serve.hop_queue_wait_ms", node="pt", tenant=TENANT)
+        assert node_only is not None and per_tenant is not None
+        assert node_only.count == per_tenant.count == 3
+        fresh_node = obs.get_histogram("serve.e2e_freshness_ms", node="pt")
+        fresh_tenant = obs.get_histogram("serve.e2e_freshness_ms", node="pt", tenant=TENANT)
+        assert fresh_node.count == fresh_tenant.count == 3
+        assert obs.get_histogram("meter.queue_ms", tenant=TENANT).count == 3
+
+    def test_hostile_tenant_flood_is_capped_not_unbounded(self):
+        """A hostile many-tenant flood must not blow registry cardinality:
+        past ``max_series_per_family`` new per-tenant series are dropped
+        and counted, and every already-admitted series keeps recording."""
+        obs.enable()
+        prev = obs.configure(max_series_per_family=4)
+        try:
+            agg = Aggregator("flood")
+            n_tenants = 12
+            for i in range(n_tenants):
+                agg.register_tenant(f"flood-{i:02d}", factory)
+            for i in range(n_tenants):
+                ship(agg, 0, tenant=f"flood-{i:02d}")
+            for family in (
+                "serve.hop_queue_wait_ms",
+                "serve.e2e_freshness_ms",
+                "meter.queue_ms",
+                "meter.wire_bytes",
+            ):
+                live = [k for k in {**obs.counters(), **obs.histograms()} if
+                        k == family or k.startswith(family + "{")]
+                assert len(live) <= 4, family
+                assert obs.get_counter("obs.series_dropped", family=family) > 0, family
+            # admitted series kept recording through the flood: the first
+            # tenant's payloads all landed in its per-tenant series
+            first = obs.get_histogram("serve.hop_queue_wait_ms", node="flood", tenant="flood-00")
+            if first is not None:  # admitted before the cap filled
+                assert first.count == 1
+            # the sketch ranking still covers EVERY tenant the cap dropped
+            assert len(meter.top_consumers(k=n_tenants)) == n_tenants
+        finally:
+            obs.configure(**prev)
+
+
+class TestEndpointRenderers:
+    def test_render_slo_requires_an_engine(self):
+        from metrics_tpu.serve.endpoints import MetricsServer
+
+        agg = Aggregator("no-engine")
+        server = MetricsServer(agg, port=0).start()
+        try:
+            with pytest.raises(ServeError, match="engine"):
+                server.render_slo()
+        finally:
+            server.stop()
+
+    def test_render_slo_and_tenants_match_in_process_state(self):
+        from metrics_tpu.serve.endpoints import MetricsServer
+
+        obs.enable()
+        agg, engine = engine_agg()
+        prober = CanaryProber(agg)
+        ship(agg, 0)
+        prober.probe()
+        agg.history.cut(agg, now=0.0)
+        server = MetricsServer(agg, port=0, arm_obs=False).start()
+        try:
+            body = server.render_slo()
+            assert body["node"] == "slo-node"
+            # the canary's ships land on the real ingest path, so it
+            # carries an ingest_success budget beside the real tenant
+            assert set(body["tenants"]) == {TENANT, CANARY_TENANT}
+            tenants = server.render_tenants(top=4)
+            assert set(tenants["tenants"]) >= {TENANT, CANARY_TENANT}
+            usage = tenants["tenants"][TENANT]
+            assert usage["wire_bytes"] > 0
+            ranked = [r["tenant"] for r in tenants["top_consumers"]]
+            assert set(ranked) == {TENANT, CANARY_TENANT}
+            ready = server.render_ready()
+            assert ready["canary"]["healthy"] is True
+            assert ready["slo_alerts"] == []
+        finally:
+            server.stop()
